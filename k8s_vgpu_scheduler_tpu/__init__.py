@@ -1,0 +1,28 @@
+"""k8s_vgpu_scheduler_tpu — a TPU-native fractional-accelerator scheduler for Kubernetes.
+
+A ground-up rebuild of the capabilities of the 4paradigm OpenAIOS vGPU scheduler
+(reference: /root/reference) for Google TPU hardware:
+
+- Pods request fractions of TPU chips via extended resources ``google.com/tpu``
+  (virtual-chip count), ``google.com/tpumem`` (HBM MiB), ``google.com/tpucores``
+  (percentage of per-chip compute).
+- A scheduler extender (``scheduler/``) implements Filter/Bind with an
+  ICI-topology-aware score engine: multi-chip requests are placed on contiguous
+  torus slices (closed-form slice math in ``topology/``, replacing the
+  reference's external ``cntopo`` ring solver).
+- A node agent (``deviceplugin/``) speaks the kubelet device-plugin gRPC API,
+  splits every physical chip into virtual devices and performs the
+  annotation-mediated allocate handshake.
+- An in-container enforcement shim (``lib/tpu`` C++ + ``shim/`` Python) hard-caps
+  per-pod HBM and dispatch rate against a shared-memory accounting region
+  (the TPU analog of the reference's LD_PRELOAD CUDA intercept).
+- A node monitor (``monitor/``) scans the shared regions, drives the
+  priority-feedback throttle loop and exports Prometheus metrics.
+- ``models/``, ``ops/``, ``parallel/`` hold the JAX/TPU compute path used by the
+  benchmark harness: flax models, pallas kernels, and mesh/sharding utilities
+  (ring-attention sequence parallelism, dp/tp/sp meshes).
+
+Layer map and parity citations: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from .client import KubeClient
+from .fake import FakeKube
+from .rest import RestKube, load_incluster
+
+__all__ = ["KubeClient", "FakeKube", "RestKube", "load_incluster"]

@@ -1,0 +1,96 @@
+"""Kubernetes client abstraction.
+
+The reference links the full client-go machinery (pkg/k8sutil/client.go); this
+rebuild needs only a narrow slice of the API — pods/nodes get/list/patch plus
+Binding — so we define that slice as an interface and provide two
+implementations: :class:`~k8s_vgpu_scheduler_tpu.k8s.rest.RestKube` (raw
+apiserver REST, in-cluster) and :class:`~k8s_vgpu_scheduler_tpu.k8s.fake.FakeKube`
+(in-memory, for tests — the envtest/fake-clientset pattern SURVEY.md §4 says
+the reference lacks).
+
+Kubernetes objects are represented as plain dicts in their JSON wire shape.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class Conflict(Exception):
+    """409 from the apiserver (optimistic-concurrency loss)."""
+
+
+class NotFound(Exception):
+    """404 from the apiserver."""
+
+
+class KubeClient:
+    """The narrow apiserver surface this framework consumes."""
+
+    # -- pods -----------------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+        raise NotImplementedError
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        raise NotImplementedError
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+    ) -> dict:
+        """Merge-patch metadata.annotations; a None value deletes the key."""
+        raise NotImplementedError
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        """POST a v1.Binding (reference scheduler.go:250)."""
+        raise NotImplementedError
+
+    # -- nodes ----------------------------------------------------------------
+    def list_nodes(self) -> List[dict]:
+        raise NotImplementedError
+
+    def get_node(self, name: str) -> dict:
+        raise NotImplementedError
+
+    def patch_node_annotations(
+        self,
+        name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        """Merge-patch node annotations.  When ``resource_version`` is given it
+        is included in the patch body, turning the patch into a compare-and-swap:
+        the apiserver rejects it with 409 (:class:`Conflict`) if the node changed
+        since that version.  The node-lock acquire path depends on this.
+        """
+        raise NotImplementedError
+
+
+# --- dict-pod helpers (shared by scheduler + plugin) -------------------------
+
+def pod_meta(pod: dict) -> dict:
+    return pod.setdefault("metadata", {})
+
+
+def pod_annotations(pod: dict) -> dict:
+    return pod_meta(pod).setdefault("annotations", {})
+
+
+def pod_name(pod: dict) -> str:
+    return pod_meta(pod).get("name", "")
+
+
+def pod_namespace(pod: dict) -> str:
+    return pod_meta(pod).get("namespace", "default")
+
+
+def pod_uid(pod: dict) -> str:
+    return pod_meta(pod).get("uid", "")
+
+
+def pod_phase(pod: dict) -> str:
+    return pod.get("status", {}).get("phase", "")
+
+
+def is_pod_terminated(pod: dict) -> bool:
+    """Reference k8sutil.IsPodInTerminatedState (pod.go)."""
+    return pod_phase(pod) in ("Succeeded", "Failed")

@@ -1,0 +1,147 @@
+"""In-memory fake apiserver for tests.
+
+Implements the :class:`KubeClient` slice.  Nodes carry a monotonically
+increasing ``metadata.resourceVersion`` that is bumped on every annotation
+patch, and a patch supplying ``resource_version`` fails with
+:class:`Conflict` when it does not match — mirroring the apiserver's
+optimistic concurrency so the node-lock CAS path (util/nodelock.py) can be
+tested for multi-writer contention, a scenario SURVEY.md §4 notes the
+reference never tests.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .client import Conflict, KubeClient, NotFound
+
+
+def _apply_annotation_patch(obj: dict, annotations: Dict[str, Optional[str]]) -> None:
+    anns = obj.setdefault("metadata", {}).setdefault("annotations", {})
+    for k, v in annotations.items():
+        if v is None:
+            anns.pop(k, None)
+        else:
+            anns[k] = v
+
+
+class FakeKube(KubeClient):
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._pods: Dict[str, dict] = {}  # "ns/name" -> pod
+        self._nodes: Dict[str, dict] = {}
+        self.bindings: List[dict] = []
+        self._rv = 0
+        # Informer-style subscribers: fn(event, pod) with event in
+        # {"ADDED", "MODIFIED", "DELETED"}.
+        self._pod_watchers: List[Callable[[str, dict], None]] = []
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    # -- test setup helpers ---------------------------------------------------
+    def add_node(self, node: dict) -> None:
+        with self._lock:
+            node.setdefault("metadata", {}).setdefault(
+                "resourceVersion", self._next_rv()
+            )
+            self._nodes[node["metadata"]["name"]] = node
+
+    def create_pod(self, pod: dict) -> dict:
+        with self._lock:
+            key = f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
+            self._pods[key] = pod
+            watchers = list(self._pod_watchers)
+            snapshot = copy.deepcopy(pod)
+        for w in watchers:
+            w("ADDED", snapshot)
+        return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop(f"{namespace}/{name}", None)
+            watchers = list(self._pod_watchers)
+        if pod is not None:
+            for w in watchers:
+                w("DELETED", copy.deepcopy(pod))
+
+    def watch_pods(self, fn: Callable[[str, dict], None]) -> None:
+        with self._lock:
+            self._pod_watchers.append(fn)
+            existing = [copy.deepcopy(p) for p in self._pods.values()]
+        for p in existing:
+            fn("ADDED", p)
+
+    # -- KubeClient -----------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            pods = [
+                copy.deepcopy(p)
+                for k, p in self._pods.items()
+                if namespace is None or k.split("/", 1)[0] == namespace
+            ]
+        return pods
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            pod = self._pods.get(f"{namespace}/{name}")
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            return copy.deepcopy(pod)
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+    ) -> dict:
+        with self._lock:
+            pod = self._pods.get(f"{namespace}/{name}")
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            _apply_annotation_patch(pod, annotations)
+            snapshot = copy.deepcopy(pod)
+            watchers = list(self._pod_watchers)
+        for w in watchers:
+            w("MODIFIED", snapshot)
+        return snapshot
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        with self._lock:
+            pod = self._pods.get(f"{namespace}/{name}")
+            if pod is None:
+                raise NotFound(f"pod {namespace}/{name}")
+            pod["spec"]["nodeName"] = node
+            self.bindings.append({"namespace": namespace, "name": name, "node": node})
+
+    def list_nodes(self) -> List[dict]:
+        with self._lock:
+            return [copy.deepcopy(n) for n in self._nodes.values()]
+
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFound(f"node {name}")
+            return copy.deepcopy(node)
+
+    def patch_node_annotations(
+        self,
+        name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                raise NotFound(f"node {name}")
+            if (
+                resource_version is not None
+                and node["metadata"].get("resourceVersion") != resource_version
+            ):
+                raise Conflict(
+                    f"node {name}: resourceVersion {resource_version} is stale"
+                )
+            _apply_annotation_patch(node, annotations)
+            node["metadata"]["resourceVersion"] = self._next_rv()
+            return copy.deepcopy(node)

@@ -1,0 +1,123 @@
+"""Raw-REST Kubernetes client (in-cluster).
+
+The reference uses client-go with in-cluster → kubeconfig fallback
+(pkg/k8sutil/client.go:42).  This rebuild carries no vendored client library;
+the consumed API surface is small enough that plain HTTPS against the
+apiserver is the sturdier choice for an offline-built image.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from .client import Conflict, KubeClient, NotFound
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def load_incluster() -> "RestKube":
+    host = os.environ["KUBERNETES_SERVICE_HOST"]
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    with open(os.path.join(SA_DIR, "token")) as f:
+        token = f.read().strip()
+    return RestKube(
+        base_url=f"https://{host}:{port}",
+        token=token,
+        ca_file=os.path.join(SA_DIR, "ca.crt"),
+    )
+
+
+class RestKube(KubeClient):
+    def __init__(self, base_url: str, token: str = "", ca_file: Optional[str] = None,
+                 insecure: bool = False) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        if insecure:
+            self._ctx = ssl._create_unverified_context()
+        elif ca_file:
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ctx = ssl.create_default_context()
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 content_type: str = "application/json") -> dict:
+        url = self.base_url + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise NotFound(path) from e
+            if e.code == 409:
+                raise Conflict(path) from e
+            raise
+        return json.loads(payload) if payload else {}
+
+    # -- pods -----------------------------------------------------------------
+    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+        path = (
+            f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
+        )
+        return self._request("GET", path).get("items", [])
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._request("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+    ) -> dict:
+        return self._request(
+            "PATCH",
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            {"metadata": {"annotations": annotations}},
+            content_type="application/merge-patch+json",
+        )
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            {
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+            },
+        )
+
+    # -- nodes ----------------------------------------------------------------
+    def list_nodes(self) -> List[dict]:
+        return self._request("GET", "/api/v1/nodes").get("items", [])
+
+    def get_node(self, name: str) -> dict:
+        return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def patch_node_annotations(
+        self,
+        name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        meta: dict = {"annotations": annotations}
+        if resource_version is not None:
+            # Including resourceVersion in a merge patch makes the apiserver
+            # enforce optimistic concurrency (409 on mismatch).
+            meta["resourceVersion"] = resource_version
+        return self._request(
+            "PATCH",
+            f"/api/v1/nodes/{name}",
+            {"metadata": meta},
+            content_type="application/merge-patch+json",
+        )

@@ -1,0 +1,1 @@
+from . import codec, config, nodelock, protocol, resources, types  # noqa: F401

@@ -1,0 +1,57 @@
+"""Framework configuration.
+
+The reference scatters configuration over mutable package globals
+(pkg/util/util.go:35–47, pkg/device-plugin/config:528–537); SURVEY.md §5
+flags that as a rebuild smell, so here everything lives in one immutable
+Config object passed explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceNames:
+    """Extended-resource names pods use to request fractional TPUs.
+
+    Reference flags: --resource-name/-mem/-mem-percentage/-cores/-priority
+    (util.go:35–47) with nvidia.com/* defaults; ours default to the
+    google.com/tpu* family per BASELINE.json's north star.
+    """
+
+    count: str = "google.com/tpu"
+    memory: str = "google.com/tpumem"
+    memory_percentage: str = "google.com/tpumem-percentage"
+    cores: str = "google.com/tpucores"
+    priority: str = "vtpu.dev/task-priority"
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    resources: ResourceNames = dataclasses.field(default_factory=ResourceNames)
+    scheduler_name: str = "vtpu-scheduler"
+
+    # Defaults applied when a pod requests chips but no memory/cores
+    # (reference: --default-mem/--default-cores, cmd/scheduler/main.go:50–63;
+    # default-mem 0 means "whole chip memory").
+    default_mem: int = 0
+    default_cores: int = 0
+
+    # Node-agent knobs (reference pkg/device-plugin/config:528–537).
+    device_split_count: int = 10
+    device_memory_scaling: float = 1.0
+    device_cores_scaling: float = 1.0
+    disable_core_limit: bool = False
+    node_name: str = ""
+    scheduler_endpoint: str = "127.0.0.1:9090"
+
+    # Enforcement shim.
+    shim_host_dir: str = "/usr/local/vtpu"
+    cache_host_dir: str = "/tmp/vtpu/containers"
+
+    # Topology placement policy default for multi-chip requests.
+    topology_policy: str = "best-effort"
+
+
+DEFAULT_CONFIG = Config()

@@ -1,0 +1,84 @@
+"""Pod-spec → device-request decoding.
+
+Reference: pkg/k8sutil/pod.go:121–208 (``Resourcereqs``): walk each
+container's resource *limits* and build one ContainerDeviceRequest per
+container.  Semantics preserved:
+
+- count resource (google.com/tpu) is the number of virtual chips;
+- memory may be absolute MiB (google.com/tpumem) or a percentage of each
+  chip's HBM (google.com/tpumem-percentage); absolute wins if both set;
+- neither set → default_mem, and if default_mem==0 → 100% of chip HBM
+  (score.go:146–148 resolves percentages at fit time);
+- cores (google.com/tpucores) defaults to default_cores.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .config import Config
+from .types import TPU_DEVICE, ContainerDeviceRequest
+
+
+class QuantityError(ValueError):
+    """A resource value that a k8s apiserver would have admitted but we cannot
+    interpret; callers must fail the *pod*, not the process."""
+
+
+def _quantity_to_int(q) -> int:
+    """Parse a k8s resource quantity (extended resources must be integers,
+    but tolerate plain strings/ints and the full binary/decimal suffix set)."""
+    if isinstance(q, (int, float)):
+        return int(q)
+    s = str(q).strip()
+    mult = 1
+    for suffix, m in (
+        ("Ki", 1024), ("Mi", 1024 ** 2), ("Gi", 1024 ** 3),
+        ("Ti", 1024 ** 4), ("Pi", 1024 ** 5), ("Ei", 1024 ** 6),
+        ("k", 1000), ("M", 1000 ** 2), ("G", 1000 ** 3),
+        ("T", 1000 ** 4), ("P", 1000 ** 5), ("E", 1000 ** 6),
+    ):
+        if s.endswith(suffix):
+            mult = m
+            s = s[: -len(suffix)]
+            break
+    try:
+        return int(float(s) * mult)
+    except ValueError as e:
+        raise QuantityError(f"unparseable resource quantity {q!r}") from e
+
+
+def container_requests(pod: dict, cfg: Config) -> List[ContainerDeviceRequest]:
+    """One ContainerDeviceRequest per container (nums==0 when the container
+    requests no TPU)."""
+    res = cfg.resources
+    out: List[ContainerDeviceRequest] = []
+    for ctr in pod.get("spec", {}).get("containers", []):
+        limits = dict(ctr.get("resources", {}).get("requests", {}))
+        limits.update(ctr.get("resources", {}).get("limits", {}))
+        nums = _quantity_to_int(limits.get(res.count, 0))
+        if nums <= 0:
+            out.append(ContainerDeviceRequest(nums=0))
+            continue
+        memreq = _quantity_to_int(limits.get(res.memory, 0))
+        mem_pct = _quantity_to_int(limits.get(res.memory_percentage, 0))
+        if memreq == 0 and mem_pct == 0:
+            if cfg.default_mem > 0:
+                memreq = cfg.default_mem
+            else:
+                mem_pct = 100
+        cores = _quantity_to_int(limits.get(res.cores, cfg.default_cores))
+        out.append(
+            ContainerDeviceRequest(
+                nums=nums,
+                type=TPU_DEVICE,
+                memreq=memreq,
+                mem_percentage_req=mem_pct,
+                coresreq=cores,
+            )
+        )
+    return out
+
+
+def pod_requests_any(pod: dict, cfg: Config) -> bool:
+    return any(r.nums > 0 for r in container_requests(pod, cfg))

@@ -1,0 +1,110 @@
+"""Core scheduling types and the annotation vocabulary.
+
+TPU-native counterpart of the reference's ``pkg/util/types.go`` (see
+/root/reference/pkg/util/types.go:19–96).  Where the reference uses the
+``4pd.io/*`` annotation namespace and ``nvidia.com/*`` resource names, this
+framework uses ``vtpu.dev/*`` annotations and ``google.com/tpu*`` extended
+resources.  Pod annotations are the *scheduling database*: every decision the
+extender makes crosses to the node agent through them (annotation-as-WAL —
+reference scheduler.go:66–86 rebuilds all state from annotations on restart,
+and so do we).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+# --- Annotation keys (the inter-process scheduling protocol) -----------------
+# Reference equivalents: 4pd.io/vgpu-time, 4pd.io/vgpu-ids-new,
+# 4pd.io/devices-to-allocate, 4pd.io/vgpu-node, 4pd.io/bind-time,
+# 4pd.io/bind-phase (types.go:22–28).
+ASSIGNED_TIME_ANNOTATION = "vtpu.dev/assigned-time"
+ASSIGNED_IDS_ANNOTATION = "vtpu.dev/assigned-ids"
+TO_ALLOCATE_ANNOTATION = "vtpu.dev/devices-to-allocate"
+ASSIGNED_NODE_ANNOTATION = "vtpu.dev/assigned-node"
+BIND_TIME_ANNOTATION = "vtpu.dev/bind-time"
+BIND_PHASE_ANNOTATION = "vtpu.dev/bind-phase"
+
+# TPU-type affinity (reference: nvidia.com/use-gputype / nouse-gputype,
+# types.go:30–31; consumed by score.go:67–87).
+TPU_USE_TYPE_ANNOTATION = "vtpu.dev/use-tputype"
+TPU_NOUSE_TYPE_ANNOTATION = "vtpu.dev/nouse-tputype"
+
+# Node annotation used as a cluster-wide mutex for the bind/allocate two-phase
+# commit (reference: 4pd.io/mutex.lock, types.go:57; nodelock.go:144–230).
+NODE_LOCK_ANNOTATION = "vtpu.dev/mutex.lock"
+MAX_LOCK_RETRY = 5
+NODE_LOCK_EXPIRE_SECONDS = 300.0
+
+# Bind phases (reference types.go:33–35).
+BIND_ALLOCATING = "allocating"
+BIND_FAILED = "failed"
+BIND_SUCCESS = "success"
+
+# Topology placement policies for multi-chip requests — gate whether a request
+# may be satisfied by chips that do NOT form a contiguous ICI slice.
+# (Reference: MLULink ring policies best-effort/restricted/guaranteed,
+# types.go:44–46, consumed by the mlu allocators.)
+BEST_EFFORT = "best-effort"
+RESTRICTED = "restricted"
+GUARANTEED = "guaranteed"
+
+# Device-type vocabulary. The reference distinguishes NVIDIA vs MLU
+# (types.go:48–53); we distinguish TPU generations, which is what type
+# affinity filters match against (e.g. "TPU-v5e", "TPU-v5p").
+TPU_DEVICE = "TPU"
+TPU_COMMON_WORD = "TPU"
+
+# A single pod may hold at most this many device grants (reference
+# DeviceLimit=100, types.go:41).
+DEVICE_LIMIT = 100
+
+# Per-container runtime env consumed by the enforcement shim (lib/tpu).
+# Reference analogs: CUDA_DEVICE_MEMORY_LIMIT_<i>, CUDA_DEVICE_SM_LIMIT,
+# CUDA_DEVICE_MEMORY_SHARED_CACHE, CUDA_OVERSUBSCRIBE, CUDA_TASK_PRIORITY,
+# GPU_CORE_UTILIZATION_POLICY (plugin.go:353–371, api/types.go:19–22).
+ENV_MEMORY_LIMIT_PREFIX = "TPU_DEVICE_MEMORY_LIMIT_"
+ENV_CORE_LIMIT = "TPU_DEVICE_CORE_LIMIT"
+ENV_SHARED_CACHE = "TPU_DEVICE_MEMORY_SHARED_CACHE"
+ENV_OVERSUBSCRIBE = "TPU_OVERSUBSCRIBE"
+ENV_TASK_PRIORITY = "TPU_TASK_PRIORITY"
+ENV_CORE_POLICY = "TPU_CORE_UTILIZATION_POLICY"
+ENV_VISIBLE_DEVICES = "TPU_VISIBLE_CHIPS"
+
+
+@dataclasses.dataclass
+class ContainerDevice:
+    """One virtual-device grant to one container.
+
+    Reference: ContainerDevice{UUID, Type, Usedmem, Usedcores}
+    (types.go:79–84).  ``usedmem`` is HBM MiB; ``usedcores`` is a 0–100
+    percentage of one chip's compute.
+    """
+
+    uuid: str
+    type: str
+    usedmem: int
+    usedcores: int
+
+
+@dataclasses.dataclass
+class ContainerDeviceRequest:
+    """One container's decoded resource request.
+
+    Reference: ContainerDeviceRequest{Nums, Type, Memreq, MemPercentagereq,
+    Coresreq} (types.go:86–92).  Exactly one of ``memreq`` /
+    ``mem_percentage_req`` is meaningful; memreq==0 with a percentage set means
+    "fraction of whole-chip HBM", resolved against the chip's size at scoring
+    time (reference score.go:146–148).
+    """
+
+    nums: int
+    type: str = TPU_DEVICE
+    memreq: int = 0
+    mem_percentage_req: int = 0
+    coresreq: int = 0
+
+
+ContainerDevices = List[ContainerDevice]
+PodDevices = List[ContainerDevices]

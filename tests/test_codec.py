@@ -1,0 +1,57 @@
+"""Annotation wire-format round-trip tests.
+
+The reference's only util test is stale and does not compile
+(SURVEY.md §4, util_test.go:198–203); this suite is the fixed version.
+"""
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.util import codec
+from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+
+def dev(uuid="TPU-abc-0", dtype="TPU-v5e", mem=3000, cores=30):
+    return ContainerDevice(uuid=uuid, type=dtype, usedmem=mem, usedcores=cores)
+
+
+class TestRoundTrip:
+    def test_single_device(self):
+        pd = [[dev()]]
+        s = codec.encode_pod_devices(pd)
+        assert s == "TPU-abc-0,TPU-v5e,3000,30:"
+        assert codec.decode_pod_devices(s) == pd
+
+    def test_multi_container_multi_device(self):
+        pd = [
+            [dev("u0-0"), dev("u0-1", mem=1000, cores=0)],
+            [],
+            [dev("u2-0", dtype="TPU-v5p", mem=95000, cores=100)],
+        ]
+        assert codec.decode_pod_devices(codec.encode_pod_devices(pd)) == pd
+
+    def test_empty(self):
+        assert codec.encode_pod_devices([]) == ""
+        assert codec.decode_pod_devices("") == []
+
+    def test_empty_container_round_trip(self):
+        pd = [[], []]
+        assert codec.decode_pod_devices(codec.encode_pod_devices(pd)) == pd
+
+
+class TestStrictness:
+    def test_reserved_chars_rejected_at_encode(self):
+        with pytest.raises(codec.CodecError):
+            codec.encode_container_devices([dev(uuid="bad,uuid")])
+        with pytest.raises(codec.CodecError):
+            codec.encode_container_devices([dev(uuid="bad:uuid")])
+
+    def test_malformed_entry_rejected_at_decode(self):
+        with pytest.raises(codec.CodecError):
+            codec.decode_container_devices("only,three,fields:")
+        with pytest.raises(codec.CodecError):
+            codec.decode_container_devices("u,t,notanint,4:")
+
+    def test_trailing_colon_tolerated(self):
+        assert codec.decode_container_devices("u,t,1,2:") == [
+            ContainerDevice("u", "t", 1, 2)
+        ]

@@ -1,0 +1,185 @@
+"""Node lock + allocate-handshake tests against the fake apiserver."""
+
+import datetime
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.util import codec, nodelock, protocol
+from k8s_vgpu_scheduler_tpu.util.types import (
+    ASSIGNED_NODE_ANNOTATION,
+    BIND_ALLOCATING,
+    BIND_FAILED,
+    BIND_PHASE_ANNOTATION,
+    BIND_SUCCESS,
+    BIND_TIME_ANNOTATION,
+    NODE_LOCK_ANNOTATION,
+    TO_ALLOCATE_ANNOTATION,
+    ContainerDevice,
+)
+
+
+def make_node(name="node-a"):
+    return {"metadata": {"name": name, "annotations": {}}}
+
+
+def make_pod(name="p1", node="node-a", to_allocate=""):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "uid": f"uid-{name}",
+            "annotations": {
+                BIND_TIME_ANNOTATION: "123",
+                BIND_PHASE_ANNOTATION: BIND_ALLOCATING,
+                ASSIGNED_NODE_ANNOTATION: node,
+                TO_ALLOCATE_ANNOTATION: to_allocate,
+            },
+        },
+        "spec": {"containers": []},
+    }
+
+
+class TestNodeLock:
+    def test_lock_release(self):
+        kube = FakeKube()
+        kube.add_node(make_node())
+        nodelock.lock_node(kube, "node-a")
+        assert nodelock.is_locked(kube, "node-a")
+        # Second acquire fails fast (fresh lock, no retries budget to outlive it).
+        with pytest.raises(nodelock.NodeLockError):
+            nodelock.lock_node(kube, "node-a", retries=2, backoff=0.01)
+        nodelock.release_node(kube, "node-a")
+        assert not nodelock.is_locked(kube, "node-a")
+        nodelock.lock_node(kube, "node-a")
+
+    def test_stale_lock_broken(self):
+        kube = FakeKube()
+        node = make_node()
+        old = datetime.datetime.now(datetime.timezone.utc) - datetime.timedelta(
+            seconds=nodelock.NODE_LOCK_EXPIRE_SECONDS + 10
+        )
+        node["metadata"]["annotations"][NODE_LOCK_ANNOTATION] = old.strftime(
+            "%Y-%m-%dT%H:%M:%SZ"
+        )
+        kube.add_node(node)
+        nodelock.lock_node(kube, "node-a", retries=1)
+        assert nodelock.is_locked(kube, "node-a")
+
+    def test_garbage_lock_broken(self):
+        kube = FakeKube()
+        node = make_node()
+        node["metadata"]["annotations"][NODE_LOCK_ANNOTATION] = "not-a-time"
+        kube.add_node(node)
+        nodelock.lock_node(kube, "node-a", retries=1)
+
+
+class TestHandshake:
+    def two_container_pod(self):
+        to_alloc = codec.encode_pod_devices(
+            [
+                [ContainerDevice("chip-0-v0", "TPU-v5e", 3000, 30)],
+                [ContainerDevice("chip-1-v0", "TPU-v5e", 1000, 0)],
+            ]
+        )
+        return make_pod(to_allocate=to_alloc)
+
+    def test_pending_pod_found_only_for_matching_node(self):
+        kube = FakeKube()
+        kube.create_pod(self.two_container_pod())
+        assert protocol.get_pending_pod(kube, "node-a") is not None
+        assert protocol.get_pending_pod(kube, "node-b") is None
+
+    def test_pending_pod_ignores_wrong_phase(self):
+        kube = FakeKube()
+        pod = self.two_container_pod()
+        pod["metadata"]["annotations"][BIND_PHASE_ANNOTATION] = BIND_SUCCESS
+        kube.create_pod(pod)
+        assert protocol.get_pending_pod(kube, "node-a") is None
+
+    def test_full_allocate_sequence(self):
+        kube = FakeKube()
+        kube.add_node(make_node())
+        nodelock.lock_node(kube, "node-a")
+        kube.create_pod(self.two_container_pod())
+
+        pod = protocol.get_pending_pod(kube, "node-a")
+        first = protocol.get_next_device_request("TPU", pod)
+        assert [d.uuid for d in first] == ["chip-0-v0"]
+        protocol.erase_next_device_type(kube, "TPU", pod)
+
+        # Not all containers allocated yet → phase stays allocating, lock held.
+        protocol.pod_allocation_try_success(kube, pod)
+        refreshed = kube.get_pod("default", "p1")
+        assert (
+            refreshed["metadata"]["annotations"][BIND_PHASE_ANNOTATION]
+            == BIND_ALLOCATING
+        )
+        assert nodelock.is_locked(kube, "node-a")
+
+        pod = protocol.get_pending_pod(kube, "node-a")
+        second = protocol.get_next_device_request("TPU", pod)
+        assert [d.uuid for d in second] == ["chip-1-v0"]
+        protocol.erase_next_device_type(kube, "TPU", pod)
+        protocol.pod_allocation_try_success(kube, pod)
+
+        refreshed = kube.get_pod("default", "p1")
+        assert (
+            refreshed["metadata"]["annotations"][BIND_PHASE_ANNOTATION] == BIND_SUCCESS
+        )
+        assert not nodelock.is_locked(kube, "node-a")
+
+    def test_allocation_failed_releases_lock(self):
+        kube = FakeKube()
+        kube.add_node(make_node())
+        nodelock.lock_node(kube, "node-a")
+        kube.create_pod(self.two_container_pod())
+        pod = protocol.get_pending_pod(kube, "node-a")
+        protocol.pod_allocation_failed(kube, pod)
+        refreshed = kube.get_pod("default", "p1")
+        assert (
+            refreshed["metadata"]["annotations"][BIND_PHASE_ANNOTATION] == BIND_FAILED
+        )
+        assert not nodelock.is_locked(kube, "node-a")
+
+
+class TestLockContention:
+    def test_cas_loser_gets_conflict_and_retries_out(self):
+        """Two writers observe the lock free at the same resourceVersion; only
+        one patch may win (the reference's Update-with-resourceVersion CAS,
+        nodelock.go:59)."""
+        from k8s_vgpu_scheduler_tpu.k8s.client import Conflict
+
+        kube = FakeKube()
+        kube.add_node(make_node())
+        node = kube.get_node("node-a")
+        rv = node["metadata"]["resourceVersion"]
+        kube.patch_node_annotations(
+            "node-a", {NODE_LOCK_ANNOTATION: "2026-01-01T00:00:00Z"},
+            resource_version=rv,
+        )
+        with pytest.raises(Conflict):
+            kube.patch_node_annotations(
+                "node-a", {NODE_LOCK_ANNOTATION: "2026-01-01T00:00:01Z"},
+                resource_version=rv,
+            )
+
+    def test_pod_vanishing_midhandshake_still_releases_lock(self):
+        kube = FakeKube()
+        kube.add_node(make_node())
+        nodelock.lock_node(kube, "node-a")
+        pod = make_pod(to_allocate="")
+        kube.create_pod(pod)
+        kube.delete_pod("default", "p1")
+        protocol.pod_allocation_try_success(kube, pod)
+        assert not nodelock.is_locked(kube, "node-a")
+
+    def test_pod_vanishing_before_failure_mark_still_releases_lock(self):
+        kube = FakeKube()
+        kube.add_node(make_node())
+        nodelock.lock_node(kube, "node-a")
+        pod = make_pod(to_allocate="")
+        kube.create_pod(pod)
+        kube.delete_pod("default", "p1")
+        protocol.pod_allocation_failed(kube, pod)
+        assert not nodelock.is_locked(kube, "node-a")

@@ -1,0 +1,88 @@
+"""Pod resource-limit decoding tests (reference pkg/k8sutil/pod.go:121–208)."""
+
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.resources import container_requests, pod_requests_any
+
+
+def pod_with(limits_list):
+    return {
+        "spec": {
+            "containers": [
+                {"name": f"c{i}", "resources": {"limits": limits}}
+                for i, limits in enumerate(limits_list)
+            ]
+        }
+    }
+
+
+CFG = Config()
+
+
+class TestContainerRequests:
+    def test_plain_count_defaults_to_full_chip_memory(self):
+        reqs = container_requests(pod_with([{"google.com/tpu": "2"}]), CFG)
+        assert len(reqs) == 1
+        r = reqs[0]
+        assert (r.nums, r.memreq, r.mem_percentage_req, r.coresreq) == (2, 0, 100, 0)
+
+    def test_absolute_memory(self):
+        reqs = container_requests(
+            pod_with([{"google.com/tpu": 1, "google.com/tpumem": "3000"}]), CFG
+        )
+        assert reqs[0].memreq == 3000
+        assert reqs[0].mem_percentage_req == 0
+
+    def test_percentage_memory_and_cores(self):
+        reqs = container_requests(
+            pod_with(
+                [
+                    {
+                        "google.com/tpu": 1,
+                        "google.com/tpumem-percentage": "50",
+                        "google.com/tpucores": "30",
+                    }
+                ]
+            ),
+            CFG,
+        )
+        assert reqs[0].mem_percentage_req == 50
+        assert reqs[0].coresreq == 30
+
+    def test_default_mem_config(self):
+        cfg = Config(default_mem=5000, default_cores=10)
+        reqs = container_requests(pod_with([{"google.com/tpu": 1}]), cfg)
+        assert reqs[0].memreq == 5000
+        assert reqs[0].coresreq == 10
+
+    def test_non_tpu_container_gets_zero(self):
+        reqs = container_requests(pod_with([{"cpu": "2"}, {"google.com/tpu": 1}]), CFG)
+        assert reqs[0].nums == 0
+        assert reqs[1].nums == 1
+        assert pod_requests_any(pod_with([{"cpu": "2"}]), CFG) is False
+
+    def test_requests_fallback(self):
+        pod = {
+            "spec": {
+                "containers": [
+                    {"resources": {"requests": {"google.com/tpu": "1"}}}
+                ]
+            }
+        }
+        assert container_requests(pod, CFG)[0].nums == 1
+
+
+class TestQuantities:
+    def test_large_suffixes(self):
+        from k8s_vgpu_scheduler_tpu.util.resources import _quantity_to_int
+
+        assert _quantity_to_int("1Ti") == 1024 ** 4
+        assert _quantity_to_int("2T") == 2 * 1000 ** 4
+        assert _quantity_to_int("1Gi") == 1024 ** 3
+
+    def test_garbage_raises_quantity_error(self):
+        import pytest as _pytest
+
+        from k8s_vgpu_scheduler_tpu.util.resources import QuantityError, _quantity_to_int
+
+        with _pytest.raises(QuantityError):
+            _quantity_to_int("banana")
