@@ -44,7 +44,10 @@ class FakeKube(KubeClient):
 
     # -- test setup helpers ---------------------------------------------------
     def add_node(self, node: dict) -> None:
+        # Store a copy: the real apiserver never shares memory with callers,
+        # so later local mutation of the argument must not change server state.
         with self._lock:
+            node = copy.deepcopy(node)
             node.setdefault("metadata", {}).setdefault(
                 "resourceVersion", self._next_rv()
             )
@@ -52,6 +55,7 @@ class FakeKube(KubeClient):
 
     def create_pod(self, pod: dict) -> dict:
         with self._lock:
+            pod = copy.deepcopy(pod)
             key = f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
             self._pods[key] = pod
             watchers = list(self._pod_watchers)

@@ -23,26 +23,40 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 def load_incluster() -> "RestKube":
     host = os.environ["KUBERNETES_SERVICE_HOST"]
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-    with open(os.path.join(SA_DIR, "token")) as f:
-        token = f.read().strip()
     return RestKube(
         base_url=f"https://{host}:{port}",
-        token=token,
+        # Bound SA tokens rotate on disk (~hourly since k8s 1.21); pass the
+        # path so each request re-reads the current token like client-go does.
+        token_file=os.path.join(SA_DIR, "token"),
         ca_file=os.path.join(SA_DIR, "ca.crt"),
     )
 
 
 class RestKube(KubeClient):
     def __init__(self, base_url: str, token: str = "", ca_file: Optional[str] = None,
-                 insecure: bool = False) -> None:
+                 insecure: bool = False, token_file: Optional[str] = None) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
+        self.token_file = token_file
+        self._token_cache = ("", 0.0)  # (token, mtime)
         if insecure:
             self._ctx = ssl._create_unverified_context()
         elif ca_file:
             self._ctx = ssl.create_default_context(cafile=ca_file)
         else:
             self._ctx = ssl.create_default_context()
+
+    def _current_token(self) -> str:
+        if not self.token_file:
+            return self.token
+        try:
+            mtime = os.path.getmtime(self.token_file)
+            if mtime != self._token_cache[1]:
+                with open(self.token_file) as f:
+                    self._token_cache = (f.read().strip(), mtime)
+        except OSError:
+            pass
+        return self._token_cache[0] or self.token
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  content_type: str = "application/json") -> dict:
@@ -52,8 +66,9 @@ class RestKube(KubeClient):
         req.add_header("Accept", "application/json")
         if data is not None:
             req.add_header("Content-Type", content_type)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
+        token = self._current_token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
         try:
             with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
                 payload = resp.read()

@@ -1,0 +1,15 @@
+from .torus import (
+    box_coords,
+    factor_shapes,
+    find_slice,
+    is_contiguous,
+    link_groups,
+)
+
+__all__ = [
+    "box_coords",
+    "factor_shapes",
+    "find_slice",
+    "is_contiguous",
+    "link_groups",
+]

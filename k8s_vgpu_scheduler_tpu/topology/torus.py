@@ -1,0 +1,187 @@
+"""Closed-form ICI slice placement on TPU meshes/tori.
+
+This replaces the reference's topology machinery — the external brute-force
+ring solver (``cntopo find -R 1000000``, pkg/device-plugin/mlu/cntopo/
+cntopo.go:194–234) and the per-model ring allocators (allocator/{spider,
+board}.go) — with exact math: TPU ICI fabrics are regular meshes/tori, so
+"devices that must communicate fast" are *axis-aligned sub-boxes* (slices),
+enumerable in closed form.  SURVEY.md N4 calls this out as a library problem.
+
+Policies (reference types.go:44–46 semantics mapped to slices):
+- ``guaranteed``  — the grant must be a contiguous slice, else fail;
+- ``restricted``  — contiguous required whenever the chip count *can* form a
+  slice on this mesh; only impossible counts may scatter;
+- ``best-effort`` — prefer contiguous, fall back to scattered.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..tpulib.types import Coord, TopologyDesc
+from ..util.types import BEST_EFFORT, GUARANTEED, RESTRICTED
+
+
+def factor_shapes(n: int, mesh: Sequence[int]) -> List[Tuple[int, ...]]:
+    """All axis-aligned box shapes with volume ``n`` fitting inside ``mesh``,
+    most compact first (minimal surface area ⇒ best ICI bisection)."""
+    dims = len(mesh)
+    shapes: Set[Tuple[int, ...]] = set()
+
+    def rec(prefix: Tuple[int, ...], remaining: int, axis: int):
+        if axis == dims - 1:
+            if remaining <= mesh[axis]:
+                shapes.add(prefix + (remaining,))
+            return
+        for d in range(1, min(remaining, mesh[axis]) + 1):
+            if remaining % d == 0:
+                rec(prefix + (d,), remaining // d, axis + 1)
+
+    if n >= 1:
+        rec((), n, 0)
+    return sorted(shapes, key=_surface_area)
+
+
+def _surface_area(shape: Tuple[int, ...]) -> int:
+    vol = 1
+    for d in shape:
+        vol *= d
+    area = 0
+    for d in shape:
+        area += 2 * (vol // d)
+    return area
+
+
+def box_coords(origin: Coord, shape: Tuple[int, ...], topo: TopologyDesc
+               ) -> Optional[List[Coord]]:
+    """Cells of the box at ``origin``; wraps on wraparound axes, else None if
+    the box sticks out of the mesh."""
+    wrap = topo.wrap()
+    axes: List[List[int]] = []
+    for ax, (o, s) in enumerate(zip(origin, shape)):
+        dim = topo.mesh[ax]
+        if o + s <= dim:
+            axes.append(list(range(o, o + s)))
+        elif wrap[ax] and s <= dim:
+            axes.append([(o + i) % dim for i in range(s)])
+        else:
+            return None
+    return [tuple(c) for c in itertools.product(*axes)]
+
+
+def _packing_score(cells: Iterable[Coord], free: FrozenSet[Coord],
+                   topo: TopologyDesc) -> int:
+    """How well a placement packs against occupied chips / mesh walls: count
+    neighbor cells outside the box that are NOT free.  Higher = less
+    fragmentation left behind (corner-seeking)."""
+    cellset = set(cells)
+    wrap = topo.wrap()
+    score = 0
+    for c in cellset:
+        for ax in range(len(topo.mesh)):
+            for delta in (-1, 1):
+                n = list(c)
+                n[ax] += delta
+                if wrap[ax]:
+                    n[ax] %= topo.mesh[ax]
+                elif not (0 <= n[ax] < topo.mesh[ax]):
+                    score += 1  # mesh wall
+                    continue
+                nt = tuple(n)
+                if nt not in cellset and nt not in free:
+                    score += 1  # occupied or unhealthy neighbor
+    return score
+
+
+def find_slice(topo: TopologyDesc, free: Iterable[Coord], n: int,
+               policy: str = BEST_EFFORT) -> Optional[List[Coord]]:
+    """Choose ``n`` chips from ``free``.
+
+    Returns the chosen coords (contiguous slice when possible), or None when
+    the request cannot be satisfied under ``policy``.  Placement prefers the
+    most compact shape, then the best-packed position, so large future
+    requests keep finding contiguous room — the fragmentation concern behind
+    the reference's "best ring by non-conflict count" heuristic
+    (allocator/default.go via SURVEY C23).
+    """
+    freeset = frozenset(free)
+    if n <= 0:
+        return []
+    if n > len(freeset):
+        return None
+
+    best: Optional[Tuple[int, List[Coord]]] = None
+    for shape in factor_shapes(n, topo.mesh):
+        for origin in itertools.product(*(range(d) for d in topo.mesh)):
+            cells = box_coords(origin, shape, topo)
+            if cells is None or not freeset.issuperset(cells):
+                continue
+            score = _packing_score(cells, freeset, topo)
+            if best is None or score > best[0]:
+                best = (score, cells)
+        if best is not None:
+            break  # shapes are ordered most-compact-first; take the first that fits
+
+    if best is not None:
+        return best[1]
+
+    if policy == GUARANTEED:
+        return None
+    if policy == RESTRICTED and factor_shapes(n, topo.mesh):
+        # A slice of this size exists on this mesh in principle — refusing to
+        # scatter lets the scheduler try another node with contiguous room.
+        return None
+    # Scattered fallback: pack around existing allocations.
+    ranked = sorted(
+        freeset,
+        key=lambda c: _packing_score([c], freeset - {c}, topo),
+        reverse=True,
+    )
+    return ranked[:n]
+
+
+def is_contiguous(coords: Sequence[Coord], topo: TopologyDesc) -> bool:
+    """True iff ``coords`` is exactly some axis-aligned (possibly wrapped) box."""
+    want = sorted(tuple(c) for c in coords)
+    n = len(want)
+    for shape in factor_shapes(n, topo.mesh):
+        for origin in itertools.product(*(range(d) for d in topo.mesh)):
+            cells = box_coords(origin, shape, topo)
+            if cells is not None and sorted(cells) == want:
+                return True
+    return False
+
+
+def link_groups(topo: TopologyDesc, healthy: Iterable[Coord]) -> List[Set[Coord]]:
+    """Connected components of the healthy-chip ICI graph — the analog of the
+    reference's MLULink neighbor BFS (cndev/bindings.go:70–119).  A dead chip
+    can partition a mesh; multi-chip grants must come from one component."""
+    healthyset = set(healthy)
+    wrap = topo.wrap()
+    seen: Set[Coord] = set()
+    groups: List[Set[Coord]] = []
+    for start in sorted(healthyset):
+        if start in seen:
+            continue
+        comp: Set[Coord] = set()
+        stack = [start]
+        while stack:
+            c = stack.pop()
+            if c in comp:
+                continue
+            comp.add(c)
+            for ax in range(len(topo.mesh)):
+                for delta in (-1, 1):
+                    nb = list(c)
+                    nb[ax] += delta
+                    if wrap[ax]:
+                        nb[ax] %= topo.mesh[ax]
+                    elif not (0 <= nb[ax] < topo.mesh[ax]):
+                        continue
+                    nbt = tuple(nb)
+                    if nbt in healthyset and nbt not in comp:
+                        stack.append(nbt)
+        seen |= comp
+        groups.append(comp)
+    return groups

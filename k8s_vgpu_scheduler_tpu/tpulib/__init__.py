@@ -1,0 +1,12 @@
+from .backend import Backend, JaxBackend, MockBackend, detect
+from .types import ChipInfo, NodeInventory, TopologyDesc
+
+__all__ = [
+    "Backend",
+    "JaxBackend",
+    "MockBackend",
+    "detect",
+    "ChipInfo",
+    "NodeInventory",
+    "TopologyDesc",
+]

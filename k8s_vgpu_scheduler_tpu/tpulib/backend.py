@@ -1,0 +1,210 @@
+"""Chip-enumeration backends.
+
+The reference's cornerstone test pattern is a *fake native backend driven by a
+JSON fixture* (mock/cndev.c reads ``$MOCK_JSON`` — SURVEY.md §4, N5): every
+layer above device discovery develops against it on CPU-only machines.  We
+replicate that exactly:
+
+- :class:`MockBackend` reads a JSON fixture (``$VTPU_MOCK_JSON`` or an inline
+  dict) describing chips, HBM sizes, ICI mesh shape and health.
+- :class:`JaxBackend` enumerates real hardware through JAX/libtpu
+  (``jax.devices()`` exposes chip coords and HBM stats on TPU).
+
+``detect()`` picks the real backend when TPU hardware is visible, else the
+mock (mirroring cndev_dl.go's lazy dlopen fallback).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+from .types import ChipInfo, NodeInventory, TopologyDesc
+
+log = logging.getLogger(__name__)
+
+MOCK_ENV = "VTPU_MOCK_JSON"
+
+_GENERATION_HBM_MIB = {
+    # Conservative per-chip HBM capacities by generation.
+    "v2": 8 * 1024,
+    "v3": 16 * 1024,
+    "v4": 32 * 1024,
+    "v5e": 16 * 1024,
+    "v5 lite": 16 * 1024,
+    "v5p": 95 * 1024,
+    "v6e": 32 * 1024,
+}
+
+
+class Backend:
+    """Device-discovery interface (reference ResourceManager, nvidia.go:46–49)."""
+
+    def inventory(self) -> NodeInventory:
+        raise NotImplementedError
+
+    def refresh_health(self, inv: NodeInventory) -> bool:
+        """Re-check health in place; return True if anything changed."""
+        return False
+
+
+class MockBackend(Backend):
+    """JSON-fixture backend (reference mock/cndev.c:22–220).
+
+    Fixture schema::
+
+        {
+          "generation": "v5e",
+          "mesh": [4, 2],
+          "wraparound": [false, false],
+          "hbm_mib": 16384,              # default per chip
+          "chips": [                      # optional; defaults to full mesh
+            {"coords": [0, 0], "uuid": "...", "healthy": true,
+             "hbm_mib": 16384, "type": "TPU-v5e"},
+            ...
+          ]
+        }
+    """
+
+    def __init__(self, fixture: Optional[dict] = None, path: Optional[str] = None):
+        if fixture is None:
+            path = path or os.environ.get(MOCK_ENV)
+            if not path:
+                raise ValueError(f"MockBackend needs a fixture dict or ${MOCK_ENV}")
+            with open(path) as f:
+                fixture = json.load(f)
+        self.fixture = fixture
+
+    def inventory(self) -> NodeInventory:
+        fx = self.fixture
+        gen = fx.get("generation", "v5e")
+        mesh = tuple(fx.get("mesh", [1]))
+        topo = TopologyDesc(
+            generation=gen,
+            mesh=mesh,
+            wraparound=tuple(fx.get("wraparound", [])) or (),
+        )
+        default_hbm = int(fx.get("hbm_mib", _GENERATION_HBM_MIB.get(gen, 16 * 1024)))
+        chips = []
+        if "chips" in fx:
+            for i, c in enumerate(fx["chips"]):
+                chips.append(
+                    ChipInfo(
+                        index=i,
+                        uuid=c.get("uuid", f"TPU-{gen}-mock-{i}"),
+                        type=c.get("type", f"TPU-{gen}"),
+                        hbm_mib=int(c.get("hbm_mib", default_hbm)),
+                        coords=tuple(c["coords"]),
+                        healthy=bool(c.get("healthy", True)),
+                        serial=c.get("serial", f"SN{i:04d}"),
+                        board=c.get("board", "mock-board"),
+                    )
+                )
+        else:
+            for i, coords in enumerate(_iter_coords(mesh)):
+                chips.append(
+                    ChipInfo(
+                        index=i,
+                        uuid=f"TPU-{gen}-mock-{i}",
+                        type=f"TPU-{gen}",
+                        hbm_mib=default_hbm,
+                        coords=coords,
+                        serial=f"SN{i:04d}",
+                        board="mock-board",
+                    )
+                )
+        return NodeInventory(chips=chips, topology=topo)
+
+    def refresh_health(self, inv: NodeInventory) -> bool:
+        """Re-read the fixture (tests mutate ``self.fixture``) and apply
+        health flags by coords."""
+        changed = False
+        by_coords = {tuple(c.get("coords", ())): c for c in self.fixture.get("chips", [])}
+        for chip in inv.chips:
+            want = bool(by_coords.get(chip.coords, {}).get("healthy", True))
+            if chip.healthy != want:
+                chip.healthy = want
+                changed = True
+        return changed
+
+
+class JaxBackend(Backend):
+    """Real-hardware enumeration via JAX/libtpu.
+
+    On TPU, ``jax.devices()`` entries expose ``coords`` (chip position in the
+    slice mesh) and ``memory_stats()['bytes_limit']`` (HBM).  This is the
+    N3 equivalent of the reference's NVML/cndev discovery.
+    """
+
+    def inventory(self) -> NodeInventory:
+        import jax  # deferred: the control plane must not require jax
+
+        devices = [d for d in jax.local_devices() if d.platform in ("tpu", "axon")]
+        if not devices:
+            raise RuntimeError("no TPU devices visible to JAX")
+        gen = _normalize_kind(devices[0].device_kind)
+        raw = []
+        for d in devices:
+            raw.append((d, tuple(getattr(d, "coords", (d.id, 0, 0)))))
+        # Global slice coords → host-local mesh coords: on a multi-host slice a
+        # worker's chips sit at a coordinate offset; shift per-axis minima to
+        # the origin so local topology math sees a (0..dim-1) box.
+        ndim = len(raw[0][1])
+        mins = tuple(min(c[i] for _, c in raw) for i in range(ndim))
+        maxs = tuple(max(c[i] for _, c in raw) for i in range(ndim))
+        mesh = tuple(maxs[i] - mins[i] + 1 for i in range(ndim))
+        chips = []
+        for d, coords in raw:
+            local = tuple(coords[i] - mins[i] for i in range(ndim))
+            try:
+                hbm = int(d.memory_stats().get("bytes_limit", 0) // (1 << 20))
+            except Exception:  # memory_stats unsupported on some platforms
+                hbm = 0
+            if hbm <= 0:
+                hbm = _GENERATION_HBM_MIB.get(gen, 16 * 1024)
+            chips.append(
+                ChipInfo(
+                    index=d.id,
+                    uuid=f"TPU-{gen}-{_hostname()}-{d.id}",
+                    type=f"TPU-{gen}",
+                    hbm_mib=hbm,
+                    coords=local,
+                )
+            )
+        topo = TopologyDesc(generation=gen, mesh=mesh)
+        return NodeInventory(chips=chips, topology=topo)
+
+
+def _normalize_kind(kind: str) -> str:
+    k = kind.lower()
+    for gen in ("v5p", "v5e", "v6e", "v4", "v3", "v2"):
+        if gen in k:
+            return gen
+    if "v5 lite" in k or "v5lite" in k:
+        return "v5e"
+    return k.replace(" ", "-")
+
+
+def _hostname() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+def _iter_coords(mesh):
+    if not mesh:
+        yield ()
+        return
+    from itertools import product
+
+    yield from product(*(range(d) for d in mesh))
+
+
+def detect() -> Backend:
+    """Mock if $VTPU_MOCK_JSON is set; else real hardware; else error."""
+    if os.environ.get(MOCK_ENV):
+        log.info("using MockBackend fixture %s", os.environ[MOCK_ENV])
+        return MockBackend()
+    return JaxBackend()

@@ -1,0 +1,83 @@
+"""Chip-inventory data model.
+
+TPU-native counterpart of the reference's device-enumeration layer (NVML in
+pkg/device-plugin/nvidia.go:84–171 and cndev cgo bindings in
+pkg/device-plugin/mlu/cndev).  A *chip* here is one TPU chip (the schedulable
+physical unit); its position on the ICI fabric is a coordinate in a regular
+mesh/torus, which is what makes TPU topology a closed-form library problem
+instead of the reference's external ring solver (SURVEY.md N4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Coord = Tuple[int, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyDesc:
+    """Shape of the node's ICI fabric.
+
+    ``mesh`` is the per-host chip grid (v5e: 2D, e.g. (4, 2) or (4, 4);
+    v4/v5p: 3D torus slices, e.g. (2, 2, 1)).  ``wraparound`` marks axes with
+    wrap links (full-size torus axes on v4/v5p).
+    """
+
+    generation: str  # e.g. "v5e", "v5p", "v4"
+    mesh: Tuple[int, ...]
+    wraparound: Tuple[bool, ...] = ()
+
+    def __post_init__(self):
+        if self.wraparound and len(self.wraparound) != len(self.mesh):
+            raise ValueError("wraparound arity must match mesh arity")
+
+    @property
+    def num_chips(self) -> int:
+        n = 1
+        for d in self.mesh:
+            n *= d
+        return n
+
+    def wrap(self) -> Tuple[bool, ...]:
+        return self.wraparound or tuple(False for _ in self.mesh)
+
+
+@dataclasses.dataclass
+class ChipInfo:
+    """One physical TPU chip as seen by the node agent."""
+
+    index: int
+    uuid: str
+    type: str  # device-type string used by type-affinity filters, e.g. "TPU-v5e"
+    hbm_mib: int
+    coords: Coord
+    healthy: bool = True
+    cores: int = 100  # compute capacity expressed as a percentage, like SM %
+    serial: str = ""
+    board: str = ""
+
+    @property
+    def typed_uuid(self) -> str:
+        return self.uuid
+
+
+@dataclasses.dataclass
+class NodeInventory:
+    """Everything the node agent reports: chips + fabric shape."""
+
+    chips: List[ChipInfo]
+    topology: TopologyDesc
+
+    def chip_by_uuid(self, uuid: str) -> Optional[ChipInfo]:
+        for c in self.chips:
+            if c.uuid == uuid:
+                return c
+        return None
+
+    def coord_map(self) -> Dict[Coord, ChipInfo]:
+        return {c.coords: c for c in self.chips}
+
+    def healthy_chips(self) -> List[ChipInfo]:
+        return [c for c in self.chips if c.healthy]

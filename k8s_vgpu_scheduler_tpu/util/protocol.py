@@ -121,7 +121,7 @@ def pod_allocation_try_success(client: KubeClient, pod: dict) -> None:
             log.info("pod %s still has pending allocations", pod_name(pod))
             return
         _finalize(client, pod, BIND_SUCCESS)
-        node = refreshed["metadata"]["annotations"].get(
+        node = refreshed.get("metadata", {}).get("annotations", {}).get(
             ASSIGNED_NODE_ANNOTATION, node
         )
     except NotFound:

@@ -1,0 +1,112 @@
+"""ICI slice-placement engine tests — replacement for the reference's
+allocator ring tests (spider_test.go/board_test.go, 906 LoC of table-driven
+cases against canned cntopo rings; SURVEY.md §4)."""
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.topology import (
+    factor_shapes,
+    find_slice,
+    is_contiguous,
+    link_groups,
+)
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util.types import BEST_EFFORT, GUARANTEED, RESTRICTED
+
+V5E = TopologyDesc(generation="v5e", mesh=(4, 4))
+V5P = TopologyDesc(
+    generation="v5p", mesh=(4, 4, 4), wraparound=(True, True, True)
+)
+
+
+def all_coords(topo):
+    from itertools import product
+
+    return [tuple(c) for c in product(*(range(d) for d in topo.mesh))]
+
+
+class TestFactorShapes:
+    def test_four_on_4x4(self):
+        shapes = factor_shapes(4, (4, 4))
+        assert (2, 2) in shapes and (1, 4) in shapes and (4, 1) in shapes
+        # Most compact first: 2x2 beats 1x4.
+        assert shapes[0] == (2, 2)
+
+    def test_impossible_count(self):
+        assert factor_shapes(5, (4, 4)) == []  # 5 = 1x5 or 5x1, neither fits
+        assert factor_shapes(32, (4, 4)) == []
+
+    def test_3d(self):
+        shapes = factor_shapes(8, (4, 4, 4))
+        assert shapes[0] == (2, 2, 2)
+
+
+class TestFindSlice:
+    def test_prefers_compact_slice(self):
+        got = find_slice(V5E, all_coords(V5E), 4)
+        assert got is not None and len(got) == 4
+        assert is_contiguous(got, V5E)
+        xs = {c[0] for c in got}
+        ys = {c[1] for c in got}
+        assert len(xs) == 2 and len(ys) == 2  # a 2x2, not a 1x4
+
+    def test_packs_into_corners(self):
+        # With the full mesh free, placement should hug a corner, leaving a
+        # contiguous complement.
+        got = find_slice(V5E, all_coords(V5E), 4)
+        touching_wall = sum(
+            1 for c in got if 0 in c or any(c[i] == V5E.mesh[i] - 1 for i in range(2))
+        )
+        assert touching_wall >= 3
+
+    def test_guaranteed_fails_when_fragmented(self):
+        # Free chips form a diagonal — no contiguous pair exists.
+        free = [(0, 0), (1, 1), (2, 2), (3, 3)]
+        assert find_slice(V5E, free, 2, GUARANTEED) is None
+        got = find_slice(V5E, free, 2, BEST_EFFORT)
+        assert got is not None and len(got) == 2
+
+    def test_restricted_scatters_only_impossible_counts(self):
+        free = [(0, 0), (1, 1), (2, 2), (3, 3), (3, 0)]
+        # 2 chips CAN form a slice on a 4x4 → restricted refuses to scatter.
+        assert find_slice(V5E, free, 2, RESTRICTED) is None
+        # 5 chips can never form a box on 4x4 → restricted may scatter.
+        got = find_slice(V5E, free, 5, RESTRICTED)
+        assert got is not None and len(got) == 5
+
+    def test_not_enough_chips(self):
+        assert find_slice(V5E, [(0, 0)], 2, BEST_EFFORT) is None
+
+    def test_zero(self):
+        assert find_slice(V5E, all_coords(V5E), 0) == []
+
+    def test_wraparound_box(self):
+        # On a torus, a box may wrap the seam: free cells at x=3 and x=0.
+        free = [(3, 0, 0), (0, 0, 0)]
+        got = find_slice(V5P, free, 2, GUARANTEED)
+        assert got is not None and sorted(got) == sorted(free)
+        assert is_contiguous(free, V5P)
+
+    def test_occupied_cells_avoided(self):
+        free = [c for c in all_coords(V5E) if c != (0, 0)]
+        got = find_slice(V5E, free, 4)
+        assert (0, 0) not in got
+        assert is_contiguous(got, V5E)
+
+
+class TestLinkGroups:
+    def test_healthy_mesh_is_one_group(self):
+        groups = link_groups(V5E, all_coords(V5E))
+        assert len(groups) == 1 and len(groups[0]) == 16
+
+    def test_dead_column_partitions_mesh(self):
+        line = TopologyDesc(generation="v5e", mesh=(4, 1))
+        healthy = [(0, 0), (2, 0), (3, 0)]  # chip (1,0) dead
+        groups = link_groups(line, healthy)
+        assert sorted(len(g) for g in groups) == [1, 2]
+
+    def test_wraparound_connects_seam(self):
+        ring = TopologyDesc(generation="v5p", mesh=(4, 1), wraparound=(True, False))
+        healthy = [(0, 0), (3, 0)]
+        groups = link_groups(ring, healthy)
+        assert len(groups) == 1

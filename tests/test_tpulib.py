@@ -1,0 +1,63 @@
+"""Mock chip backend tests (reference pattern: bindings_test.go against the
+JSON-fixture fake cndev, SURVEY.md §4)."""
+
+import json
+
+from k8s_vgpu_scheduler_tpu.tpulib import MockBackend, TopologyDesc
+
+V5E_4X2 = {
+    "generation": "v5e",
+    "mesh": [4, 2],
+    "hbm_mib": 16384,
+}
+
+
+class TestMockBackend:
+    def test_full_mesh_default_chips(self):
+        inv = MockBackend(V5E_4X2).inventory()
+        assert len(inv.chips) == 8
+        assert inv.topology == TopologyDesc(generation="v5e", mesh=(4, 2))
+        assert all(c.hbm_mib == 16384 for c in inv.chips)
+        assert all(c.type == "TPU-v5e" for c in inv.chips)
+        assert len({c.uuid for c in inv.chips}) == 8
+        assert len({c.coords for c in inv.chips}) == 8
+
+    def test_explicit_chips_and_health(self):
+        fx = {
+            "generation": "v5p",
+            "mesh": [2, 2, 1],
+            "wraparound": [False, False, False],
+            "chips": [
+                {"coords": [0, 0, 0], "uuid": "a", "hbm_mib": 95000},
+                {"coords": [1, 0, 0], "uuid": "b", "healthy": False},
+            ],
+        }
+        inv = MockBackend(fx).inventory()
+        assert inv.chip_by_uuid("a").hbm_mib == 95000
+        assert not inv.chip_by_uuid("b").healthy
+        assert len(inv.healthy_chips()) == 1
+
+    def test_refresh_health_applies_fixture_mutation(self):
+        fx = {
+            "generation": "v5e",
+            "mesh": [2, 1],
+            "chips": [
+                {"coords": [0, 0], "uuid": "a"},
+                {"coords": [1, 0], "uuid": "b"},
+            ],
+        }
+        backend = MockBackend(fx)
+        inv = backend.inventory()
+        assert backend.refresh_health(inv) is False
+        fx["chips"][1]["healthy"] = False
+        assert backend.refresh_health(inv) is True
+        assert not inv.chip_by_uuid("b").healthy
+
+    def test_file_fixture(self, tmp_path, monkeypatch):
+        p = tmp_path / "mock.json"
+        p.write_text(json.dumps(V5E_4X2))
+        monkeypatch.setenv("VTPU_MOCK_JSON", str(p))
+        from k8s_vgpu_scheduler_tpu.tpulib import detect
+
+        inv = detect().inventory()
+        assert len(inv.chips) == 8
