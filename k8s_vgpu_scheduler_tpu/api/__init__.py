@@ -1,0 +1,1 @@
+from . import device_register_pb2  # noqa: F401

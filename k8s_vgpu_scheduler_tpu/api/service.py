@@ -1,0 +1,40 @@
+"""gRPC service glue for DeviceService.
+
+grpc_tools is not available in the build image, so instead of generated
+``*_pb2_grpc.py`` stubs we register the handler via grpcio's generic-handler
+API — functionally identical wire behavior to the reference's generated gofast
+service (pkg/api/device_register.pb.go).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from . import device_register_pb2 as pb
+
+SERVICE_NAME = "vtpu.api.DeviceService"
+REGISTER_METHOD = f"/{SERVICE_NAME}/Register"
+
+
+def add_device_service(server: grpc.Server, register_handler) -> None:
+    """``register_handler(request_iterator, context) -> RegisterReply``."""
+    handler = grpc.method_handlers_generic_handler(
+        SERVICE_NAME,
+        {
+            "Register": grpc.stream_unary_rpc_method_handler(
+                register_handler,
+                request_deserializer=pb.RegisterRequest.FromString,
+                response_serializer=pb.RegisterReply.SerializeToString,
+            )
+        },
+    )
+    server.add_generic_rpc_handlers((handler,))
+
+
+def register_stub(channel: grpc.Channel):
+    """Client-side multicallable for the Register stream."""
+    return channel.stream_unary(
+        REGISTER_METHOD,
+        request_serializer=pb.RegisterRequest.SerializeToString,
+        response_deserializer=pb.RegisterReply.FromString,
+    )

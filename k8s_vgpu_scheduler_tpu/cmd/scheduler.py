@@ -1,0 +1,139 @@
+"""Scheduler extender entrypoint.
+
+Reference: cmd/scheduler/main.go:50–100 — flags for gRPC/HTTP binds, TLS
+certs, scheduler name and resource defaults; starts the gRPC Register
+service, the Prometheus collector and the HTTP(S) router.
+
+Run: ``python -m k8s_vgpu_scheduler_tpu.cmd.scheduler --http-bind :9443 ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from concurrent import futures
+
+import grpc
+
+from ..api.service import add_device_service
+from ..k8s import FakeKube, load_incluster
+from ..scheduler.core import Scheduler
+from ..scheduler.metrics import start_metrics_server
+from ..scheduler.routes import ExtenderServer
+from ..util.config import Config, ResourceNames
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("vtpu-scheduler")
+    p.add_argument("--grpc-bind", default="0.0.0.0:9090")
+    p.add_argument("--http-bind", default="0.0.0.0:9443")
+    p.add_argument("--metrics-port", type=int, default=9395)
+    p.add_argument("--cert-file", default="")
+    p.add_argument("--key-file", default="")
+    p.add_argument("--scheduler-name", default="vtpu-scheduler")
+    p.add_argument("--default-mem", type=int, default=0)
+    p.add_argument("--default-cores", type=int, default=0)
+    p.add_argument("--resource-name", default="google.com/tpu")
+    p.add_argument("--resource-mem", default="google.com/tpumem")
+    p.add_argument("--resource-mem-percentage", default="google.com/tpumem-percentage")
+    p.add_argument("--resource-cores", default="google.com/tpucores")
+    p.add_argument("--resource-priority", default="vtpu.dev/task-priority")
+    p.add_argument("--topology-policy", default="best-effort")
+    p.add_argument("--resync-seconds", type=float, default=30.0)
+    p.add_argument("--fake-kube", action="store_true",
+                   help="in-memory apiserver (dev/dry-run only)")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p.parse_args(argv)
+
+
+def build_config(args) -> Config:
+    return Config(
+        resources=ResourceNames(
+            count=args.resource_name,
+            memory=args.resource_mem,
+            memory_percentage=args.resource_mem_percentage,
+            cores=args.resource_cores,
+            priority=args.resource_priority,
+        ),
+        scheduler_name=args.scheduler_name,
+        default_mem=args.default_mem,
+        default_cores=args.default_cores,
+        topology_policy=args.topology_policy,
+    )
+
+
+class DryRunKube(FakeKube):
+    """FakeKube that upserts pods on patch, so `--fake-kube` dry-runs can
+    POST /filter with pods that were never created (BASELINE config #1)."""
+
+    def patch_pod_annotations(self, namespace, name, annotations):
+        from ..k8s.client import NotFound
+
+        try:
+            return super().patch_pod_annotations(namespace, name, annotations)
+        except NotFound:
+            self.create_pod(
+                {"metadata": {"name": name, "namespace": namespace,
+                              "uid": f"dryrun-{namespace}-{name}",
+                              "annotations": {}},
+                 "spec": {"containers": []}}
+            )
+            return super().patch_pod_annotations(namespace, name, annotations)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    client = DryRunKube() if args.fake_kube else load_incluster()
+    if args.fake_kube:
+        for n in ("node-a", "node-b"):
+            client.add_node({"metadata": {"name": n, "annotations": {}}})
+    scheduler = Scheduler(client, build_config(args))
+    scheduler.resync_from_apiserver()
+
+    grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))
+
+    def register(request_iterator, context):
+        from ..api import device_register_pb2 as pb
+
+        node = scheduler.handle_register_stream(request_iterator, context)
+        return pb.RegisterReply(message=f"bye {node}")
+
+    add_device_service(grpc_server, register)
+    grpc_server.add_insecure_port(args.grpc_bind)
+    grpc_server.start()
+
+    start_metrics_server(scheduler, args.metrics_port)
+
+    host, _, port = args.http_bind.rpartition(":")
+    http_server = ExtenderServer(
+        scheduler,
+        scheduler.cfg,
+        host=host or "0.0.0.0",
+        port=int(port),
+        certfile=args.cert_file or None,
+        keyfile=args.key_file or None,
+    )
+    http_server.start()
+    logging.info(
+        "vtpu-scheduler up: grpc=%s http=%s metrics=:%d",
+        args.grpc_bind, args.http_bind, args.metrics_port,
+    )
+    try:
+        while True:
+            time.sleep(args.resync_seconds)
+            try:
+                scheduler.resync_from_apiserver()
+            except Exception:  # noqa: BLE001 — transient apiserver loss
+                logging.exception("resync failed")
+    except KeyboardInterrupt:
+        http_server.stop()
+        grpc_server.stop(grace=2)
+
+
+if __name__ == "__main__":
+    main()

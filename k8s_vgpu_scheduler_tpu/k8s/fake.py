@@ -62,7 +62,7 @@ class FakeKube(KubeClient):
             snapshot = copy.deepcopy(pod)
         for w in watchers:
             w("ADDED", snapshot)
-        return pod
+        return snapshot
 
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:

@@ -9,6 +9,7 @@ apiserver is the sturdier choice for an offline-built image.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import ssl
 import urllib.error
@@ -16,6 +17,8 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from .client import Conflict, KubeClient, NotFound
+
+log = logging.getLogger(__name__)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -39,6 +42,7 @@ class RestKube(KubeClient):
         self.token = token
         self.token_file = token_file
         self._token_cache = ("", 0.0)  # (token, mtime)
+        self._token_warned = False
         if insecure:
             self._ctx = ssl._create_unverified_context()
         elif ca_file:
@@ -54,8 +58,10 @@ class RestKube(KubeClient):
             if mtime != self._token_cache[1]:
                 with open(self.token_file) as f:
                     self._token_cache = (f.read().strip(), mtime)
-        except OSError:
-            pass
+        except OSError as e:
+            if not self._token_warned:
+                log.error("cannot read token file %s: %s", self.token_file, e)
+                self._token_warned = True
         return self._token_cache[0] or self.token
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,

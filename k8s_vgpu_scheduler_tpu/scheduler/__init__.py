@@ -1,0 +1,13 @@
+from .core import FilterResult, Scheduler
+from .nodes import DeviceInfo, NodeInfo, NodeManager
+from .pods import PodInfo, PodManager
+
+__all__ = [
+    "FilterResult",
+    "Scheduler",
+    "DeviceInfo",
+    "NodeInfo",
+    "NodeManager",
+    "PodInfo",
+    "PodManager",
+]

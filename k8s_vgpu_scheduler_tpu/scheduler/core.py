@@ -1,0 +1,271 @@
+"""Scheduler core — orchestrates node + pod registries, Filter and Bind.
+
+Reference: pkg/scheduler/scheduler.go (Scheduler struct, Register stream
+handler 134–169, getNodesUsage 176–222, Filter 266–314, Bind 224–264).
+
+Filter is the extender's predicate: given a pod and candidate nodes, pick the
+single best node, write the device decision into pod annotations, and return
+only that node.  Bind then takes the node lock, marks the allocating phase and
+POSTs the Binding; the node agent completes the two-phase commit (SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..k8s.client import (
+    KubeClient,
+    is_pod_terminated,
+    pod_annotations,
+    pod_name,
+    pod_namespace,
+    pod_uid,
+)
+from ..tpulib.types import TopologyDesc
+from ..util import codec
+from ..util.config import Config
+from ..util.nodelock import NodeLockError, lock_node, release_node
+from ..util.protocol import bind_timestamp
+from ..util.resources import container_requests
+from ..util.types import (
+    ASSIGNED_IDS_ANNOTATION,
+    ASSIGNED_NODE_ANNOTATION,
+    ASSIGNED_TIME_ANNOTATION,
+    BIND_ALLOCATING,
+    BIND_PHASE_ANNOTATION,
+    BIND_TIME_ANNOTATION,
+    TO_ALLOCATE_ANNOTATION,
+)
+from . import score as score_mod
+from .nodes import DeviceInfo, NodeInfo, NodeManager
+from .pods import PodInfo, PodManager
+
+log = logging.getLogger(__name__)
+
+
+class FilterResult:
+    def __init__(self, node: Optional[str] = None,
+                 failed: Optional[Dict[str, str]] = None, error: str = ""):
+        self.node = node
+        self.failed = failed or {}
+        self.error = error
+
+
+class Scheduler:
+    def __init__(self, client: KubeClient, cfg: Optional[Config] = None) -> None:
+        self.client = client
+        self.cfg = cfg or Config()
+        self.nodes = NodeManager()
+        self.pods = PodManager()
+        self._filter_lock = threading.Lock()
+
+    # -- registration stream (gRPC DeviceService.Register) --------------------
+    def handle_register_stream(self, request_iterator, context=None) -> str:
+        """Consume one node agent's stream; on disconnect, drop the node
+        (reference Register, scheduler.go:134–169)."""
+        node_name = ""
+        try:
+            for req in request_iterator:
+                node_name = req.node
+                devices = [
+                    DeviceInfo(
+                        id=d.id,
+                        count=d.count,
+                        devmem=d.devmem,
+                        type=d.type,
+                        health=d.health,
+                        coords=tuple(d.coords),
+                        cores=d.cores or 100,
+                    )
+                    for d in req.devices
+                ]
+                topo = None
+                if req.topology.mesh:
+                    topo = TopologyDesc(
+                        generation=req.topology.generation,
+                        mesh=tuple(req.topology.mesh),
+                        wraparound=tuple(req.topology.wraparound) or (),
+                    )
+                self.nodes.add_node(
+                    node_name, NodeInfo(name=node_name, devices=devices, topology=topo)
+                )
+                log.info("registered node %s with %d chips", node_name, len(devices))
+        finally:
+            if node_name:
+                log.warning("register stream for %s closed; dropping node", node_name)
+                self.nodes.rm_node(node_name)
+        return node_name
+
+    # -- pod informer ----------------------------------------------------------
+    def on_pod_event(self, event: str, pod: dict) -> None:
+        """Rebuildable state: decode assigned-ids of every scheduled pod
+        (reference onAddPod, scheduler.go:66–86)."""
+        uid = pod_uid(pod)
+        if not uid:
+            return
+        anns = pod.get("metadata", {}).get("annotations", {})
+        node = anns.get(ASSIGNED_NODE_ANNOTATION, "")
+        if event == "DELETED" or is_pod_terminated(pod) or not node:
+            self.pods.del_pod(uid)
+            return
+        encoded = anns.get(ASSIGNED_IDS_ANNOTATION, "")
+        if not encoded:
+            return
+        try:
+            devices = codec.decode_pod_devices(encoded)
+        except codec.CodecError as e:
+            log.error("pod %s has malformed %s: %s", pod_name(pod),
+                      ASSIGNED_IDS_ANNOTATION, e)
+            return
+        self.pods.add_pod(
+            PodInfo(
+                uid=uid,
+                name=pod_name(pod),
+                namespace=pod_namespace(pod),
+                node=node,
+                devices=devices,
+            )
+        )
+
+    def resync_from_apiserver(self) -> None:
+        """Full reconcile: re-add every listed pod AND prune grants whose pod
+        no longer exists (there is no watch in the raw-REST deployment, so
+        this is also how deletions are observed)."""
+        pods = self.client.list_pods()
+        for pod in pods:
+            self.on_pod_event("ADDED", pod)
+        alive = {pod_uid(p) for p in pods}
+        for info in self.pods.list_pods():
+            if info.uid not in alive:
+                self.pods.del_pod(info.uid)
+
+    # -- usage snapshot --------------------------------------------------------
+    def get_nodes_usage(
+        self, node_names: Optional[List[str]] = None
+    ) -> Dict[str, Tuple[NodeInfo, Dict[str, score_mod.DeviceUsage]]]:
+        """Registered inventory minus scheduled grants, per node
+        (reference getNodesUsage, scheduler.go:176–222)."""
+        all_nodes = self.nodes.list_nodes()
+        pods_by_node: Dict[str, List[PodInfo]] = {}
+        for p in self.pods.list_pods():
+            pods_by_node.setdefault(p.node, []).append(p)
+        out = {}
+        for name, info in all_nodes.items():
+            if node_names is not None and name not in node_names:
+                continue
+            out[name] = (info, score_mod.build_usage(info, pods_by_node.get(name, [])))
+        return out
+
+    def inspect_all_nodes_usage(self):
+        """For the metrics collector (a consistent copy, not live maps)."""
+        with self._filter_lock:
+            return {
+                n: dict(usage) for n, (info, usage) in self.get_nodes_usage().items()
+            }
+
+    # -- Filter ----------------------------------------------------------------
+    def filter(self, pod: dict, node_names: List[str]) -> FilterResult:
+        """Decide under the in-memory lock; talk to the apiserver outside it
+        (a slow patch must not stall every concurrent Filter and /metrics
+        scrape).  The tentative grant is rolled back if the patch fails."""
+        with self._filter_lock:
+            result = self._decide_locked(pod, node_names)
+        if result.node is None:
+            return result
+        encoded = codec.encode_pod_devices(self.pods.get(pod_uid(pod)).devices)
+        try:
+            self.client.patch_pod_annotations(
+                pod_namespace(pod),
+                pod_name(pod),
+                {
+                    ASSIGNED_NODE_ANNOTATION: result.node,
+                    ASSIGNED_IDS_ANNOTATION: encoded,
+                    TO_ALLOCATE_ANNOTATION: encoded,
+                    ASSIGNED_TIME_ANNOTATION: str(int(time.time())),
+                },
+            )
+        except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
+            log.error("failed to write decision for %s: %s", pod_name(pod), e)
+            self.pods.del_pod(pod_uid(pod))
+            return FilterResult(error=f"writing decision failed: {e}")
+        return result
+
+    def _decide_locked(self, pod: dict, node_names: List[str]) -> FilterResult:
+        try:
+            requests = container_requests(pod, self.cfg)
+        except ValueError as e:
+            return FilterResult(error=f"bad resource request: {e}")
+        if not any(r.nums > 0 for r in requests):
+            # Not ours; admit everywhere (the vanilla scheduler handles it).
+            return FilterResult(node=None, failed={})
+
+        # Drop any stale decision for this pod before re-placing (reference
+        # Filter calls delPod first, scheduler.go:284).
+        self.pods.del_pod(pod_uid(pod))
+
+        anns = pod.get("metadata", {}).get("annotations", {})
+        usage_by_node = self.get_nodes_usage(node_names)
+        failed: Dict[str, str] = {}
+        best: Optional[Tuple[float, str, List]] = None
+        for name in node_names:
+            entry = usage_by_node.get(name)
+            if entry is None:
+                failed[name] = "no TPU inventory registered"
+                continue
+            info, usage = entry
+            placement = score_mod.fit_pod(
+                requests, usage, info.topology, anns, self.cfg.topology_policy
+            )
+            if placement is None:
+                failed[name] = "insufficient TPU capacity/topology"
+                continue
+            s = score_mod.node_score(usage)
+            if best is None or s > best[0]:
+                best = (s, name, placement)
+
+        if best is None:
+            return FilterResult(error="no node fits TPU request", failed=failed)
+
+        _, node, placement = best
+        # Account immediately so concurrent Filters see the tentative grant.
+        self.pods.add_pod(
+            PodInfo(
+                uid=pod_uid(pod),
+                name=pod_name(pod),
+                namespace=pod_namespace(pod),
+                node=node,
+                devices=placement,
+            )
+        )
+        return FilterResult(node=node, failed=failed)
+
+    # -- Bind ------------------------------------------------------------------
+    def bind(self, namespace: str, name: str, uid: str, node: str) -> Optional[str]:
+        """Returns error string or None (reference Bind, scheduler.go:224–264).
+        The node lock is NOT released here on success — the device plugin
+        releases it when allocation completes (two-phase commit)."""
+        try:
+            lock_node(self.client, node)
+        except NodeLockError as e:
+            return str(e)
+        try:
+            self.client.patch_pod_annotations(
+                namespace,
+                name,
+                {
+                    BIND_PHASE_ANNOTATION: BIND_ALLOCATING,
+                    BIND_TIME_ANNOTATION: bind_timestamp(),
+                },
+            )
+            self.client.bind_pod(namespace, name, node)
+        except Exception as e:  # noqa: BLE001 — any bind failure frees the node
+            log.error("bind %s/%s to %s failed: %s", namespace, name, node, e)
+            try:
+                release_node(self.client, node)
+            except Exception:
+                log.exception("failed to release lock on %s after bind error", node)
+            return str(e)
+        return None

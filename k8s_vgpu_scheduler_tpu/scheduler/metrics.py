@@ -1,0 +1,86 @@
+"""Cluster-level Prometheus metrics.
+
+Reference: cmd/scheduler/metrics.go:179–355 (ClusterManagerCollector over
+InspectAllNodesUsage + GetScheduledPods, served on :9395).  Same surface with
+TPU names: per-chip HBM limit/allocated, sharing count, core allocation, and
+per-pod grant gauges.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.registry import Collector
+
+from .core import Scheduler
+
+
+class ClusterCollector(Collector):
+    def __init__(self, scheduler: Scheduler) -> None:
+        self.scheduler = scheduler
+
+    def collect(self) -> Iterable[GaugeMetricFamily]:
+        mem_limit = GaugeMetricFamily(
+            "tpu_device_memory_limit_mib",
+            "Advertised HBM capacity of a TPU chip",
+            labels=["node", "deviceuuid"],
+        )
+        mem_alloc = GaugeMetricFamily(
+            "tpu_device_memory_allocated_mib",
+            "HBM granted to pods on a TPU chip",
+            labels=["node", "deviceuuid"],
+        )
+        shared_num = GaugeMetricFamily(
+            "tpu_device_shared_num",
+            "Number of pod grants sharing a TPU chip",
+            labels=["node", "deviceuuid"],
+        )
+        core_alloc = GaugeMetricFamily(
+            "tpu_device_core_allocated",
+            "Compute percentage granted on a TPU chip",
+            labels=["node", "deviceuuid"],
+        )
+        mem_pct = GaugeMetricFamily(
+            "node_tpu_memory_percentage",
+            "Fraction of node TPU HBM allocated",
+            labels=["node"],
+        )
+        for node, usage in self.scheduler.inspect_all_nodes_usage().items():
+            total = used = 0
+            for u in usage.values():
+                mem_limit.add_metric([node, u.id], u.total_mem)
+                mem_alloc.add_metric([node, u.id], u.used_mem)
+                shared_num.add_metric([node, u.id], u.used_slots)
+                core_alloc.add_metric([node, u.id], u.used_cores)
+                total += u.total_mem
+                used += u.used_mem
+            if total:
+                mem_pct.add_metric([node], used / total)
+
+        pod_mem = GaugeMetricFamily(
+            "vtpu_pod_device_allocated_mib",
+            "HBM granted to one pod on one chip",
+            labels=["podnamespace", "podname", "deviceuuid"],
+        )
+        pod_cores = GaugeMetricFamily(
+            "vtpu_pod_core_allocated",
+            "Compute percentage granted to one pod on one chip",
+            labels=["podnamespace", "podname", "deviceuuid"],
+        )
+        for pod in self.scheduler.pods.list_pods():
+            for container in pod.devices:
+                for g in container:
+                    pod_mem.add_metric([pod.namespace, pod.name, g.uuid], g.usedmem)
+                    pod_cores.add_metric([pod.namespace, pod.name, g.uuid], g.usedcores)
+
+        return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct, pod_mem, pod_cores]
+
+
+def start_metrics_server(scheduler: Scheduler, port: int = 9395):
+    """Serve /metrics with only our collector (no process defaults noise)."""
+    from prometheus_client import CollectorRegistry, start_http_server
+
+    registry = CollectorRegistry()
+    registry.register(ClusterCollector(scheduler))
+    return start_http_server(port, registry=registry)

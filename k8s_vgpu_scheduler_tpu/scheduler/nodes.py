@@ -1,0 +1,71 @@
+"""nodeManager — in-memory registry of node chip inventories.
+
+Reference: pkg/scheduler/nodes.go (addNode merges device lists, rmNodeDevice
+drops a node's devices when its registration stream breaks, nodes.go:269–305).
+Ours also tracks each node's ICI topology so the score engine can do slice
+placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..tpulib.types import TopologyDesc
+
+
+@dataclasses.dataclass
+class DeviceInfo:
+    """One physical chip as registered by a node agent (reference
+    DeviceInfo, nodes.go:230–240)."""
+
+    id: str
+    count: int        # virtual-device slots
+    devmem: int       # advertised HBM MiB
+    type: str
+    health: bool
+    coords: Tuple[int, ...]
+    cores: int = 100
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    name: str
+    devices: List[DeviceInfo]
+    topology: Optional[TopologyDesc] = None
+
+
+class NodeManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, NodeInfo] = {}
+
+    def add_node(self, name: str, info: NodeInfo) -> None:
+        """Each registration message carries the node's FULL inventory, so it
+        replaces the stored device list outright — a chip absent from a
+        re-registration is gone (died / un-enumerated) and must not linger as
+        schedulable.  (The reference merges by id, nodes.go:269–281, which
+        keeps stale chips alive; deliberate deviation.)"""
+        with self._lock:
+            existing = self._nodes.get(name)
+            if existing is None or not existing.devices:
+                self._nodes[name] = info
+                return
+            existing.devices = list(info.devices)
+            if info.topology is not None:
+                existing.topology = info.topology
+
+    def rm_node(self, name: str) -> None:
+        """Node agent stream broke → its inventory is no longer trustworthy
+        (reference rmNodeDevice, nodes.go:283–305)."""
+        with self._lock:
+            self._nodes.pop(name, None)
+
+    def get_node(self, name: str) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def list_nodes(self) -> Dict[str, NodeInfo]:
+        with self._lock:
+            return dict(self._nodes)

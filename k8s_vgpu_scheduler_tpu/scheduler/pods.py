@@ -1,0 +1,46 @@
+"""podManager — registry of scheduled pods and their device grants.
+
+Reference: pkg/scheduler/pods.go:357–378.  Fed by the pod informer; the
+decoded ``assigned-ids`` annotation is the durable record (annotation-as-WAL,
+SURVEY.md §5 checkpoint/resume), so scheduler restarts rebuild this map from
+the apiserver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from ..util.types import PodDevices
+
+
+@dataclasses.dataclass
+class PodInfo:
+    uid: str
+    name: str
+    namespace: str
+    node: str
+    devices: PodDevices
+
+
+class PodManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pods: Dict[str, PodInfo] = {}
+
+    def add_pod(self, info: PodInfo) -> None:
+        with self._lock:
+            self._pods[info.uid] = info
+
+    def del_pod(self, uid: str) -> None:
+        with self._lock:
+            self._pods.pop(uid, None)
+
+    def get(self, uid: str) -> Optional[PodInfo]:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def list_pods(self) -> List[PodInfo]:
+        with self._lock:
+            return list(self._pods.values())

@@ -1,0 +1,224 @@
+"""Device fit + node scoring.
+
+Reference: pkg/scheduler/score.go:109–203 (``calcScore``).  Per-chip rules are
+kept with their reference semantics:
+
+- type white/blacklist from pod annotations (checkGPUtype, score.go:67–87);
+- absolute vs percentage HBM requests resolved against the chip's advertised
+  size (score.go:146–148);
+- ``coresreq==100`` ⇒ the chip must be completely unused (exclusive,
+  score.go:155–157);
+- a chip whose cores are fully allocated accepts nothing more — including
+  cores==0 best-effort jobs (score.go:159–162);
+- virtual-slot capacity ``used_slots < total_slots`` (deviceSplitCount).
+
+What's new for TPU: multi-chip requests are placed through the closed-form
+ICI slice engine (topology/torus.py) instead of first-fit over a sorted list,
+honoring the pod's topology policy (guaranteed / restricted / best-effort).
+
+Node score follows the reference's "most remaining capacity wins" (spread)
+rule: score = Σ over chips of free fractions, computed after tentative
+placement; Filter picks the max.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..topology import find_slice
+from ..tpulib.types import TopologyDesc
+from ..util.types import (
+    BEST_EFFORT,
+    GUARANTEED,
+    TPU_NOUSE_TYPE_ANNOTATION,
+    TPU_USE_TYPE_ANNOTATION,
+    ContainerDevice,
+    ContainerDeviceRequest,
+    ContainerDevices,
+)
+from .nodes import NodeInfo
+from .pods import PodInfo
+
+log = logging.getLogger(__name__)
+
+# Pod annotation selecting the topology policy for its multi-chip grants.
+TOPOLOGY_POLICY_ANNOTATION = "vtpu.dev/topology-policy"
+
+
+@dataclasses.dataclass
+class DeviceUsage:
+    """Live usage of one physical chip (reference DeviceUsage, nodes.go:242–258)."""
+
+    id: str
+    type: str
+    health: bool
+    coords: Tuple[int, ...]
+    total_slots: int
+    used_slots: int
+    total_mem: int
+    used_mem: int
+    total_cores: int
+    used_cores: int
+
+    @property
+    def free_mem(self) -> int:
+        return self.total_mem - self.used_mem
+
+    @property
+    def free_cores(self) -> int:
+        return self.total_cores - self.used_cores
+
+    @property
+    def free_slots(self) -> int:
+        return self.total_slots - self.used_slots
+
+
+def build_usage(node: NodeInfo, pods_on_node: List[PodInfo]) -> Dict[str, DeviceUsage]:
+    """Registered inventory minus the grants of every scheduled pod
+    (reference getNodesUsage, scheduler.go:176–222)."""
+    usage: Dict[str, DeviceUsage] = {}
+    for d in node.devices:
+        usage[d.id] = DeviceUsage(
+            id=d.id,
+            type=d.type,
+            health=d.health,
+            coords=tuple(d.coords),
+            total_slots=d.count,
+            used_slots=0,
+            total_mem=d.devmem,
+            used_mem=0,
+            total_cores=d.cores,
+            used_cores=0,
+        )
+    for pod in pods_on_node:
+        for container in pod.devices:
+            for grant in container:
+                u = usage.get(grant.uuid)
+                if u is None:
+                    continue  # chip vanished (unhealthy → re-registered smaller)
+                u.used_slots += 1
+                u.used_mem += grant.usedmem
+                u.used_cores += grant.usedcores
+    return usage
+
+
+def check_type(annotations: Dict[str, str], dev_type: str) -> bool:
+    """Type affinity white/blacklist (reference checkGPUtype, score.go:67–87):
+    comma-separated case-insensitive substring match."""
+    use = annotations.get(TPU_USE_TYPE_ANNOTATION, "")
+    nouse = annotations.get(TPU_NOUSE_TYPE_ANNOTATION, "")
+    t = dev_type.lower()
+    if use:
+        if not any(tok.strip().lower() in t for tok in use.split(",") if tok.strip()):
+            return False
+    if nouse:
+        if any(tok.strip().lower() in t for tok in nouse.split(",") if tok.strip()):
+            return False
+    return True
+
+
+def _resolve_mem(req: ContainerDeviceRequest, chip: DeviceUsage) -> int:
+    if req.memreq > 0:
+        return req.memreq
+    pct = req.mem_percentage_req if req.mem_percentage_req > 0 else 100
+    return chip.total_mem * pct // 100
+
+
+def _chip_fits(req: ContainerDeviceRequest, chip: DeviceUsage,
+               annotations: Dict[str, str]) -> bool:
+    if not chip.health:
+        return False
+    if not check_type(annotations, chip.type):
+        return False
+    if chip.free_slots <= 0:
+        return False
+    if chip.used_cores >= chip.total_cores:
+        return False  # fully-committed compute accepts nothing (score.go:159–162)
+    if req.coresreq >= 100 and (chip.used_slots > 0 or chip.used_cores > 0):
+        return False  # exclusive wants a virgin chip (score.go:155–157)
+    if req.coresreq > chip.free_cores:
+        return False
+    if _resolve_mem(req, chip) > chip.free_mem:
+        return False
+    return True
+
+
+def fit_container(
+    req: ContainerDeviceRequest,
+    usage: Dict[str, DeviceUsage],
+    topo: Optional[TopologyDesc],
+    annotations: Dict[str, str],
+    policy: str = BEST_EFFORT,
+) -> Optional[ContainerDevices]:
+    """Place one container's request, mutating ``usage`` on success."""
+    if req.nums <= 0:
+        return []
+    eligible = [u for u in usage.values() if _chip_fits(req, u, annotations)]
+    if len(eligible) < req.nums:
+        return None
+
+    chosen: Optional[List[DeviceUsage]] = None
+    if topo is not None and req.nums > 1:
+        # Slice placement needs trustworthy coords: unique and present on
+        # every eligible chip.  Agents that don't report coords fall through
+        # to plain selection (and can't promise contiguity).
+        coord_map = {u.coords: u for u in eligible if u.coords != ()}
+        if len(coord_map) == len(eligible):
+            coords = find_slice(topo, coord_map.keys(), req.nums, policy)
+            if coords is None:
+                return None
+            chosen = [coord_map[c] for c in coords]
+        elif policy == GUARANTEED:
+            return None  # contiguity demanded but topology is unverifiable
+    if chosen is None:
+        # Bin-pack shared jobs onto already-shared chips so whole chips stay
+        # free for exclusive (cores=100) and multi-chip slice requests.
+        chosen = sorted(
+            eligible, key=lambda u: (u.used_slots, u.used_mem), reverse=True
+        )[: req.nums]
+
+    grants: ContainerDevices = []
+    for chip in chosen:
+        mem = _resolve_mem(req, chip)
+        chip.used_slots += 1
+        chip.used_mem += mem
+        chip.used_cores += req.coresreq
+        grants.append(
+            ContainerDevice(
+                uuid=chip.id, type=chip.type, usedmem=mem, usedcores=req.coresreq
+            )
+        )
+    return grants
+
+
+def fit_pod(
+    requests: List[ContainerDeviceRequest],
+    usage: Dict[str, DeviceUsage],
+    topo: Optional[TopologyDesc],
+    annotations: Dict[str, str],
+    default_policy: str = BEST_EFFORT,
+) -> Optional[List[ContainerDevices]]:
+    """All containers or nothing; mutates ``usage`` as it goes (callers pass a
+    throwaway snapshot per candidate node)."""
+    policy = annotations.get(TOPOLOGY_POLICY_ANNOTATION, default_policy)
+    out: List[ContainerDevices] = []
+    for req in requests:
+        got = fit_container(req, usage, topo, annotations, policy)
+        if got is None:
+            return None
+        out.append(got)
+    return out
+
+
+def node_score(usage: Dict[str, DeviceUsage]) -> float:
+    """Free capacity remaining after tentative placement; Filter picks the
+    max, spreading load like the reference (score.go:165–199)."""
+    score = 0.0
+    for u in usage.values():
+        if u.total_mem > 0:
+            score += u.free_mem / u.total_mem
+        if u.total_cores > 0:
+            score += u.free_cores / u.total_cores
+    return score

@@ -1,0 +1,99 @@
+"""Mutating admission webhook.
+
+Reference: pkg/scheduler/webhook.go:170–247.  On pod CREATE:
+
+- pods with privileged containers are left untouched (they see the host's
+  chips anyway — no point fencing them);
+- containers that carry a ``task-priority`` resource limit get the
+  ``TPU_TASK_PRIORITY`` env injected (consumed by the enforcement shim's
+  rate limiter);
+- if any container requests a managed TPU resource, ``spec.schedulerName``
+  is pointed at our extender-backed scheduler.
+
+Implemented as an AdmissionReview v1 handler returning a JSONPatch.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import List, Optional
+
+from ..util.config import Config
+from ..util.resources import container_requests
+from ..util.types import ENV_TASK_PRIORITY
+
+log = logging.getLogger(__name__)
+
+
+def _is_privileged(container: dict) -> bool:
+    return bool(
+        container.get("securityContext", {}).get("privileged", False)
+    )
+
+
+def mutate_pod(pod: dict, cfg: Config) -> List[dict]:
+    """Return JSONPatch ops for one pod (empty list = no mutation)."""
+    containers = pod.get("spec", {}).get("containers", [])
+    if any(_is_privileged(c) for c in containers):
+        log.info("pod %s has privileged container; skipping mutation",
+                 pod.get("metadata", {}).get("name", "?"))
+        return []
+    try:
+        requests = container_requests(pod, cfg)
+    except ValueError as e:
+        log.warning("webhook: unparseable resources: %s", e)
+        return []
+
+    patches: List[dict] = []
+    wants_tpu = False
+    for i, (ctr, req) in enumerate(zip(containers, requests)):
+        limits = dict(ctr.get("resources", {}).get("requests", {}))
+        limits.update(ctr.get("resources", {}).get("limits", {}))
+        if req.nums > 0:
+            wants_tpu = True
+        prio = limits.get(cfg.resources.priority)
+        if prio is not None:
+            env = list(ctr.get("env", []))
+            if not any(e.get("name") == ENV_TASK_PRIORITY for e in env):
+                entry = {"name": ENV_TASK_PRIORITY, "value": str(prio)}
+                if env:
+                    patches.append(
+                        {"op": "add", "path": f"/spec/containers/{i}/env/-",
+                         "value": entry}
+                    )
+                else:
+                    patches.append(
+                        {"op": "add", "path": f"/spec/containers/{i}/env",
+                         "value": [entry]}
+                    )
+    if wants_tpu:
+        current = pod.get("spec", {}).get("schedulerName", "")
+        if current != cfg.scheduler_name:
+            patches.append(
+                {"op": "add", "path": "/spec/schedulerName",
+                 "value": cfg.scheduler_name}
+            )
+    return patches
+
+
+def handle_admission_review(body: dict, cfg: Config) -> dict:
+    """AdmissionReview in → AdmissionReview out (always allowed; mutation is
+    advisory — failurePolicy decides what a webhook outage means)."""
+    req = body.get("request", {})
+    uid = req.get("uid", "")
+    response = {"uid": uid, "allowed": True}
+    pod = req.get("object")
+    if isinstance(pod, dict) and req.get("operation", "CREATE") == "CREATE":
+        patches = mutate_pod(pod, cfg)
+        if patches:
+            response["patchType"] = "JSONPatch"
+            response["patch"] = base64.b64encode(
+                json.dumps(patches).encode()
+            ).decode()
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": response,
+    }

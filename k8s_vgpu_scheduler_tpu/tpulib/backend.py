@@ -146,8 +146,15 @@ class JaxBackend(Backend):
             raise RuntimeError("no TPU devices visible to JAX")
         gen = _normalize_kind(devices[0].device_kind)
         raw = []
+        seen_coords = set()
         for d in devices:
-            raw.append((d, tuple(getattr(d, "coords", (d.id, 0, 0)))))
+            coords = tuple(getattr(d, "coords", (d.id, 0, 0)))
+            # v2/v3 expose one jax device per *core* (two per chip, same
+            # coords); the schedulable unit is the chip — dedup by coords.
+            if coords in seen_coords:
+                continue
+            seen_coords.add(coords)
+            raw.append((d, coords))
         # Global slice coords → host-local mesh coords: on a multi-host slice a
         # worker's chips sit at a coordinate offset; shift per-axis minima to
         # the origin so local topology math sees a (0..dim-1) box.

@@ -58,10 +58,6 @@ class ChipInfo:
     serial: str = ""
     board: str = ""
 
-    @property
-    def typed_uuid(self) -> str:
-        return self.uuid
-
 
 @dataclasses.dataclass
 class NodeInventory:

@@ -1,0 +1,302 @@
+"""Scheduler Filter/Bind integration tests over FakeKube + registered nodes."""
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import DeviceInfo, NodeInfo, Scheduler
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util import codec
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import (
+    ASSIGNED_IDS_ANNOTATION,
+    ASSIGNED_NODE_ANNOTATION,
+    BIND_ALLOCATING,
+    BIND_PHASE_ANNOTATION,
+    NODE_LOCK_ANNOTATION,
+    TO_ALLOCATE_ANNOTATION,
+)
+
+
+def register_node(s: Scheduler, name: str, chips=4, devmem=16384, mesh=(4, 1)):
+    devices = [
+        DeviceInfo(
+            id=f"{name}-chip-{i}", count=10, devmem=devmem, type="TPU-v5e",
+            health=True, coords=(i % mesh[0], i // mesh[0]),
+        )
+        for i in range(chips)
+    ]
+    s.nodes.add_node(
+        name,
+        NodeInfo(name=name, devices=devices,
+                 topology=TopologyDesc(generation="v5e", mesh=mesh)),
+    )
+
+
+def tpu_pod(name="p1", uid="u1", mem="3000", nums="1", cores=None):
+    limits = {"google.com/tpu": nums, "google.com/tpumem": mem}
+    if cores is not None:
+        limits["google.com/tpucores"] = cores
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": {}},
+        "spec": {"containers": [{"name": "main", "resources": {"limits": limits}}]},
+    }
+
+
+@pytest.fixture
+def env():
+    kube = FakeKube()
+    kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+    kube.add_node({"metadata": {"name": "node-b", "annotations": {}}})
+    s = Scheduler(kube, Config())
+    register_node(s, "node-a")
+    register_node(s, "node-b")
+    kube.watch_pods(s.on_pod_event)
+    return kube, s
+
+
+class TestFilter:
+    def test_picks_node_and_writes_decision(self, env):
+        kube, s = env
+        pod = tpu_pod()
+        kube.create_pod(pod)
+        res = s.filter(pod, ["node-a", "node-b"])
+        assert res.error == ""
+        assert res.node in ("node-a", "node-b")
+        stored = kube.get_pod("default", "p1")
+        anns = stored["metadata"]["annotations"]
+        assert anns[ASSIGNED_NODE_ANNOTATION] == res.node
+        decision = codec.decode_pod_devices(anns[ASSIGNED_IDS_ANNOTATION])
+        assert decision[0][0].usedmem == 3000
+        assert anns[TO_ALLOCATE_ANNOTATION] == anns[ASSIGNED_IDS_ANNOTATION]
+
+    def test_non_tpu_pod_passes_through(self, env):
+        kube, s = env
+        pod = {
+            "metadata": {"name": "web", "namespace": "default", "uid": "w1"},
+            "spec": {"containers": [{"name": "c",
+                                     "resources": {"limits": {"cpu": "1"}}}]},
+        }
+        res = s.filter(pod, ["node-a", "node-b"])
+        assert res.error == "" and res.node is None
+
+    def test_capacity_exhaustion_across_filters(self, env):
+        kube, s = env
+        # Each node has 4 chips x 16384 MiB. 8 pods x 16000 fill all chips.
+        for i in range(8):
+            pod = tpu_pod(name=f"p{i}", uid=f"u{i}", mem="16000")
+            kube.create_pod(pod)
+            res = s.filter(pod, ["node-a", "node-b"])
+            assert res.node is not None, f"pod {i} should fit"
+        pod = tpu_pod(name="p9", uid="u9", mem="16000")
+        kube.create_pod(pod)
+        res = s.filter(pod, ["node-a", "node-b"])
+        assert res.error != "" and res.node is None
+
+    def test_spread_across_nodes(self, env):
+        kube, s = env
+        placements = []
+        for i in range(2):
+            pod = tpu_pod(name=f"p{i}", uid=f"u{i}", mem="16000")
+            kube.create_pod(pod)
+            placements.append(s.filter(pod, ["node-a", "node-b"]).node)
+        assert placements[0] != placements[1]  # spread (reference max-score rule)
+
+    def test_unregistered_node_fails(self, env):
+        kube, s = env
+        pod = tpu_pod()
+        kube.create_pod(pod)
+        res = s.filter(pod, ["node-zzz"])
+        assert res.error != ""
+        assert "node-zzz" in res.failed
+
+    def test_pod_deletion_frees_capacity(self, env):
+        kube, s = env
+        pod = tpu_pod(mem="16000")
+        kube.create_pod(pod)
+        s.filter(pod, ["node-a"])
+        assert len(s.pods.list_pods()) == 1
+        kube.delete_pod("default", "p1")
+        assert len(s.pods.list_pods()) == 0
+
+    def test_multichip_guaranteed_slice(self, env):
+        kube, s = env
+        pod = tpu_pod(mem="1000", nums="4")
+        pod["metadata"]["annotations"]["vtpu.dev/topology-policy"] = "guaranteed"
+        kube.create_pod(pod)
+        res = s.filter(pod, ["node-a"])
+        assert res.error == ""
+        decision = codec.decode_pod_devices(
+            kube.get_pod("default", "p1")["metadata"]["annotations"][
+                ASSIGNED_IDS_ANNOTATION
+            ]
+        )
+        assert len(decision[0]) == 4
+
+
+class TestBind:
+    def test_bind_locks_and_phases(self, env):
+        kube, s = env
+        pod = tpu_pod()
+        kube.create_pod(pod)
+        res = s.filter(pod, ["node-a"])
+        err = s.bind("default", "p1", "u1", res.node)
+        assert err is None
+        stored = kube.get_pod("default", "p1")
+        assert stored["metadata"]["annotations"][BIND_PHASE_ANNOTATION] == BIND_ALLOCATING
+        assert stored["spec"]["nodeName"] == res.node
+        node = kube.get_node(res.node)
+        assert NODE_LOCK_ANNOTATION in node["metadata"]["annotations"]
+
+    def test_bind_missing_pod_releases_lock(self, env):
+        kube, s = env
+        err = s.bind("default", "ghost", "gu", "node-a")
+        assert err is not None
+        node = kube.get_node("node-a")
+        assert NODE_LOCK_ANNOTATION not in node["metadata"]["annotations"]
+
+
+class TestRegisterStream:
+    def test_stream_registration_and_disconnect(self):
+        from k8s_vgpu_scheduler_tpu.api import device_register_pb2 as pb
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        reqs = [
+            pb.RegisterRequest(
+                node="node-x",
+                devices=[
+                    pb.ChipDevice(id="c0", count=10, devmem=16384,
+                                  type="TPU-v5e", health=True, coords=[0, 0],
+                                  cores=100)
+                ],
+                topology=pb.Topology(generation="v5e", mesh=[1, 1]),
+            )
+        ]
+        s.handle_register_stream(iter(reqs))
+        # Stream ended → node dropped (reference rmNodeDevice on disconnect).
+        assert s.nodes.get_node("node-x") is None
+
+    def test_node_present_while_stream_alive(self):
+        from k8s_vgpu_scheduler_tpu.api import device_register_pb2 as pb
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+
+        def gen():
+            yield pb.RegisterRequest(
+                node="node-x",
+                devices=[pb.ChipDevice(id="c0", count=10, devmem=16384,
+                                       type="TPU-v5e", health=True,
+                                       coords=[0, 0], cores=100)],
+                topology=pb.Topology(generation="v5e", mesh=[1, 1]),
+            )
+            # While the stream is open the node must be queryable.
+            assert s.nodes.get_node("node-x") is not None
+            assert s.nodes.get_node("node-x").devices[0].devmem == 16384
+
+        s.handle_register_stream(gen())
+
+
+class TestReviewRegressions:
+    def test_coordless_chips_still_schedulable(self):
+        """Agents that report no coords must not collapse capacity (chips were
+        once keyed by coords)."""
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        devices = [
+            DeviceInfo(id=f"c{i}", count=10, devmem=16384, type="TPU-v5e",
+                       health=True, coords=())
+            for i in range(4)
+        ]
+        s.nodes.add_node("n", NodeInfo(name="n", devices=devices, topology=None))
+        pod = tpu_pod(mem="1000", nums="2")
+        kube.create_pod(pod)
+        res = s.filter(pod, ["n"])
+        assert res.error == "" and res.node == "n"
+        decision = codec.decode_pod_devices(
+            kube.get_pod("default", "p1")["metadata"]["annotations"][
+                ASSIGNED_IDS_ANNOTATION
+            ]
+        )
+        assert len(decision[0]) == 2
+        assert len({d.uuid for d in decision[0]}) == 2
+
+    def test_guaranteed_fails_without_coords(self):
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        devices = [
+            DeviceInfo(id=f"c{i}", count=10, devmem=16384, type="TPU-v5e",
+                       health=True, coords=())
+            for i in range(4)
+        ]
+        s.nodes.add_node(
+            "n",
+            NodeInfo(name="n", devices=devices,
+                     topology=TopologyDesc(generation="v5e", mesh=(4, 1))),
+        )
+        pod = tpu_pod(mem="1000", nums="2")
+        pod["metadata"]["annotations"]["vtpu.dev/topology-policy"] = "guaranteed"
+        kube.create_pod(pod)
+        res = s.filter(pod, ["n"])
+        assert res.error != ""
+
+    def test_resync_prunes_deleted_pods(self, env):
+        kube, s = env
+        pod = tpu_pod(mem="16000")
+        kube.create_pod(pod)
+        s.filter(pod, ["node-a"])
+        assert len(s.pods.list_pods()) == 1
+        # Simulate a deployment with no watch: delete behind the manager's back.
+        kube._pods.clear()
+        s.resync_from_apiserver()
+        assert len(s.pods.list_pods()) == 0
+
+    def test_reregistration_drops_missing_chips(self):
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        mk = lambda ids: NodeInfo(
+            name="n",
+            devices=[DeviceInfo(id=i, count=10, devmem=16384, type="TPU-v5e",
+                                health=True, coords=()) for i in ids],
+            topology=None,
+        )
+        s.nodes.add_node("n", mk(["a", "b"]))
+        s.nodes.add_node("n", mk(["a"]))  # chip b died
+        assert [d.id for d in s.nodes.get_node("n").devices] == ["a"]
+
+    def test_failed_decision_write_rolls_back(self):
+        class PatchlessKube(FakeKube):
+            def patch_pod_annotations(self, ns, name, anns):
+                raise RuntimeError("apiserver down")
+
+        kube = PatchlessKube()
+        s = Scheduler(kube, Config())
+        register_node(s, "node-a")
+        pod = tpu_pod()
+        kube.create_pod(pod)
+        res = s.filter(pod, ["node-a"])
+        assert res.error != ""
+        assert len(s.pods.list_pods()) == 0  # tentative grant rolled back
+
+
+class TestNodesFormExtender:
+    def test_nodes_form_gets_nodes_reply(self):
+        from k8s_vgpu_scheduler_tpu.scheduler.routes import filter_endpoint
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        register_node(s, "node-a")
+        pod = tpu_pod()
+        kube.create_pod(pod)
+        args = {
+            "Pod": pod,
+            "Nodes": {"items": [
+                {"metadata": {"name": "node-a"}},
+                {"metadata": {"name": "node-b"}},
+            ]},
+        }
+        out = filter_endpoint(s, args)
+        assert out["Error"] == ""
+        assert out["NodeNames"] == ["node-a"]
+        assert [n["metadata"]["name"] for n in out["Nodes"]["items"]] == ["node-a"]

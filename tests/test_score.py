@@ -1,0 +1,200 @@
+"""Score/fit engine table tests — the reference ships ZERO tests for
+calcScore (SURVEY.md §4 'do better'); these cover every fit rule."""
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.scheduler.nodes import DeviceInfo, NodeInfo
+from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+from k8s_vgpu_scheduler_tpu.scheduler.score import (
+    build_usage,
+    check_type,
+    fit_container,
+    fit_pod,
+    node_score,
+)
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util.types import (
+    GUARANTEED,
+    TPU_NOUSE_TYPE_ANNOTATION,
+    TPU_USE_TYPE_ANNOTATION,
+    ContainerDevice,
+    ContainerDeviceRequest,
+)
+
+
+def v5e_node(n=4, mesh=(4, 1), devmem=16384, count=10):
+    devices = [
+        DeviceInfo(
+            id=f"chip-{i}",
+            count=count,
+            devmem=devmem,
+            type="TPU-v5e",
+            health=True,
+            coords=(i % mesh[0], i // mesh[0]),
+        )
+        for i in range(n)
+    ]
+    return NodeInfo(
+        name="node-a",
+        devices=devices,
+        topology=TopologyDesc(generation="v5e", mesh=mesh),
+    )
+
+
+def req(nums=1, mem=0, pct=0, cores=0):
+    return ContainerDeviceRequest(
+        nums=nums, memreq=mem, mem_percentage_req=pct, coresreq=cores
+    )
+
+
+class TestBuildUsage:
+    def test_subtracts_scheduled_pods(self):
+        node = v5e_node()
+        pods = [
+            PodInfo(
+                uid="u1", name="p1", namespace="d", node="node-a",
+                devices=[[ContainerDevice("chip-0", "TPU-v5e", 3000, 30)]],
+            )
+        ]
+        usage = build_usage(node, pods)
+        assert usage["chip-0"].used_mem == 3000
+        assert usage["chip-0"].used_cores == 30
+        assert usage["chip-0"].used_slots == 1
+        assert usage["chip-1"].used_mem == 0
+
+    def test_unknown_grant_ignored(self):
+        node = v5e_node()
+        pods = [
+            PodInfo(
+                uid="u1", name="p1", namespace="d", node="node-a",
+                devices=[[ContainerDevice("ghost", "TPU-v5e", 3000, 0)]],
+            )
+        ]
+        build_usage(node, pods)  # must not raise
+
+
+class TestCheckType:
+    def test_whitelist(self):
+        assert check_type({TPU_USE_TYPE_ANNOTATION: "v5e"}, "TPU-v5e")
+        assert not check_type({TPU_USE_TYPE_ANNOTATION: "v5p"}, "TPU-v5e")
+
+    def test_blacklist(self):
+        assert not check_type({TPU_NOUSE_TYPE_ANNOTATION: "v5e"}, "TPU-v5e")
+        assert check_type({TPU_NOUSE_TYPE_ANNOTATION: "v5p"}, "TPU-v5e")
+
+    def test_empty_allows(self):
+        assert check_type({}, "TPU-v5e")
+
+
+class TestFitRules:
+    def test_absolute_mem_respected(self):
+        node = v5e_node(n=1)
+        usage = build_usage(node, [])
+        assert fit_container(req(mem=17000), usage, node.topology, {}) is None
+        got = fit_container(req(mem=16000), usage, node.topology, {})
+        assert got is not None and got[0].usedmem == 16000
+
+    def test_percentage_mem_resolved_against_chip(self):
+        node = v5e_node(n=1)
+        usage = build_usage(node, [])
+        got = fit_container(req(pct=50), usage, node.topology, {})
+        assert got[0].usedmem == 8192
+
+    def test_default_is_whole_chip(self):
+        node = v5e_node(n=1)
+        usage = build_usage(node, [])
+        got = fit_container(req(), usage, node.topology, {})
+        assert got[0].usedmem == 16384
+        # Chip is now memory-full: nothing else fits.
+        assert fit_container(req(mem=1), usage, node.topology, {}) is None
+
+    def test_exclusive_needs_virgin_chip(self):
+        node = v5e_node(n=1)
+        usage = build_usage(node, [])
+        assert fit_container(req(mem=100, cores=10), usage, node.topology, {})
+        # cores=100 on a touched chip fails...
+        assert fit_container(req(mem=100, cores=100), usage, node.topology, {}) is None
+        # ...but succeeds on a fresh one.
+        usage2 = build_usage(v5e_node(n=1), [])
+        assert fit_container(req(mem=100, cores=100), usage2, node.topology, {})
+
+    def test_full_cores_blocks_besteffort_jobs(self):
+        node = v5e_node(n=1)
+        usage = build_usage(node, [])
+        assert fit_container(req(mem=100, cores=100), usage, node.topology, {})
+        assert fit_container(req(mem=100, cores=0), usage, node.topology, {}) is None
+
+    def test_slot_exhaustion(self):
+        node = v5e_node(n=1, count=2)
+        usage = build_usage(node, [])
+        assert fit_container(req(mem=10), usage, node.topology, {})
+        assert fit_container(req(mem=10), usage, node.topology, {})
+        assert fit_container(req(mem=10), usage, node.topology, {}) is None
+
+    def test_binpack_prefers_shared_chip(self):
+        node = v5e_node(n=2)
+        usage = build_usage(node, [])
+        first = fit_container(req(mem=1000), usage, node.topology, {})
+        second = fit_container(req(mem=1000), usage, node.topology, {})
+        assert first[0].uuid == second[0].uuid  # same chip, not spread
+
+    def test_unhealthy_skipped(self):
+        node = v5e_node(n=2)
+        node.devices[0].health = False
+        usage = build_usage(node, [])
+        got = fit_container(req(mem=100), usage, node.topology, {})
+        assert got[0].uuid == "chip-1"
+
+
+class TestMultiChip:
+    def test_contiguous_slice_grant(self):
+        node = v5e_node(n=4, mesh=(4, 1))
+        usage = build_usage(node, [])
+        got = fit_container(req(nums=2, mem=1000), usage, node.topology, {}, GUARANTEED)
+        assert got is not None and len(got) == 2
+        ids = sorted(int(g.uuid.split("-")[1]) for g in got)
+        assert ids[1] - ids[0] == 1  # adjacent on the 4x1 line
+
+    def test_guaranteed_fails_on_fragmented_node(self):
+        node = v5e_node(n=4, mesh=(4, 1))
+        # chips 1 and 3 are memory-full: only 0 and 2 remain → not adjacent.
+        pods = [
+            PodInfo(
+                uid="u", name="p", namespace="d", node="node-a",
+                devices=[[
+                    ContainerDevice("chip-1", "TPU-v5e", 16384, 0),
+                    ContainerDevice("chip-3", "TPU-v5e", 16384, 0),
+                ]],
+            )
+        ]
+        usage = build_usage(node, pods)
+        assert (
+            fit_container(req(nums=2, mem=1000), usage, node.topology, {}, GUARANTEED)
+            is None
+        )
+        got = fit_container(req(nums=2, mem=1000), usage, node.topology, {}, "best-effort")
+        assert got is not None
+
+
+class TestFitPod:
+    def test_all_or_nothing(self):
+        node = v5e_node(n=1)
+        usage = build_usage(node, [])
+        got = fit_pod([req(mem=16000), req(mem=16000)], usage, node.topology, {})
+        assert got is None
+
+    def test_multi_container(self):
+        node = v5e_node(n=2)
+        usage = build_usage(node, [])
+        got = fit_pod([req(mem=8000), req(mem=8000)], usage, node.topology, {})
+        assert got is not None and len(got) == 2
+
+    def test_score_prefers_freer_node(self):
+        node = v5e_node(n=2)
+        empty = build_usage(node, [])
+        half = build_usage(
+            node,
+            [PodInfo(uid="u", name="p", namespace="d", node="node-a",
+                     devices=[[ContainerDevice("chip-0", "TPU-v5e", 8192, 50)]])],
+        )
+        assert node_score(empty) > node_score(half)
