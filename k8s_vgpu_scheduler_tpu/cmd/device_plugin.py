@@ -1,0 +1,139 @@
+"""TPU device-plugin entrypoint (DaemonSet per node).
+
+Reference: cmd/device-plugin/nvidia/main.go:56–241 — per-node config override
+from /config/config.json (devicememoryscaling, devicesplitcount), kubelet
+socket watch for restart, plugin + registration wiring.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import time
+
+from ..deviceplugin import DeviceCache, DeviceRegister, TpuDevicePlugin
+from ..k8s import make_client
+from ..tpulib import detect
+from ..util.config import Config
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("vtpu-device-plugin")
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument("--scheduler-endpoint",
+                   default=os.environ.get("SCHEDULER_ENDPOINT", "127.0.0.1:9090"))
+    p.add_argument("--device-split-count", type=int, default=10)
+    p.add_argument("--device-memory-scaling", type=float, default=1.0)
+    p.add_argument("--device-cores-scaling", type=float, default=1.0)
+    p.add_argument("--disable-core-limit", action="store_true")
+    p.add_argument("--socket-dir", default="/var/lib/kubelet/device-plugins")
+    p.add_argument("--config-file", default="/config/config.json")
+    p.add_argument("--shim-dir", default="/usr/local/vtpu")
+    p.add_argument("--cache-dir", default="/tmp/vtpu/containers")
+    p.add_argument("--fake-kube", action="store_true")
+    p.add_argument("--kube-url", default="",
+                   help="apiserver base URL (e.g. the apisim); empty = in-cluster")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p.parse_args(argv)
+
+
+def apply_node_config_overrides(cfg: Config, config_file: str) -> Config:
+    """Per-node ConfigMap overrides keyed by node name
+    (cmd/device-plugin/nvidia/main.go:87–110)."""
+    try:
+        with open(config_file) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return cfg
+    for entry in data.get("nodeconfig", []):
+        if entry.get("name") != cfg.node_name:
+            continue
+        updates = {}
+        if "devicememoryscaling" in entry:
+            updates["device_memory_scaling"] = float(entry["devicememoryscaling"])
+        if "devicesplitcount" in entry:
+            updates["device_split_count"] = int(entry["devicesplitcount"])
+        if "devicecorescaling" in entry:
+            updates["device_cores_scaling"] = float(entry["devicecorescaling"])
+        if updates:
+            log.info("node config override for %s: %s", cfg.node_name, updates)
+            cfg = dataclasses.replace(cfg, **updates)
+    return cfg
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    cfg = Config(
+        node_name=args.node_name or os.uname().nodename,
+        scheduler_endpoint=args.scheduler_endpoint,
+        device_split_count=args.device_split_count,
+        device_memory_scaling=args.device_memory_scaling,
+        device_cores_scaling=args.device_cores_scaling,
+        disable_core_limit=args.disable_core_limit,
+        shim_host_dir=args.shim_dir,
+        cache_host_dir=args.cache_dir,
+    )
+    cfg = apply_node_config_overrides(cfg, args.config_file)
+
+    client = make_client(fake=args.fake_kube, kube_url=args.kube_url)
+    backend = detect()
+    cache = DeviceCache(backend)
+    plugin = TpuDevicePlugin(client, cache.inventory, cfg,
+                             socket_dir=args.socket_dir)
+    register = DeviceRegister(backend, cfg)
+
+    cache.subscribe("plugin", lambda inv: plugin.notify_health_changed())
+    cache.subscribe("register", register.push_update)
+    cache.start()
+    register.start()
+    plugin.serve()
+
+    kubelet_sock = os.path.join(args.socket_dir, "kubelet.sock")
+
+    def try_register():
+        try:
+            plugin.register_with_kubelet(kubelet_sock)
+            return True
+        except Exception as e:  # noqa: BLE001
+            log.warning("kubelet registration failed: %s", e)
+            return False
+
+    registered = try_register()
+    # Kubelet restart detection: watch the socket inode; on recreation,
+    # re-register (reference uses fsnotify, main.go:213–217).  Seed with the
+    # current inode so the first tick doesn't spuriously re-register.
+    try:
+        last_ino = os.stat(kubelet_sock).st_ino
+    except OSError:
+        last_ino = None
+    try:
+        while True:
+            time.sleep(5)
+            try:
+                ino = os.stat(kubelet_sock).st_ino
+            except OSError:
+                ino = None
+            if ino != last_ino:
+                last_ino = ino
+                if ino is not None:
+                    log.info("kubelet socket changed; re-registering")
+                    registered = try_register()
+            elif not registered:
+                registered = try_register()
+    except KeyboardInterrupt:
+        plugin.stop()
+        register.stop()
+        cache.stop()
+
+
+if __name__ == "__main__":
+    main()

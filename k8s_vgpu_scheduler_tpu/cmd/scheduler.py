@@ -17,7 +17,7 @@ from concurrent import futures
 import grpc
 
 from ..api.service import add_device_service
-from ..k8s import FakeKube, load_incluster
+from ..k8s import FakeKube, make_client
 from ..scheduler.core import Scheduler
 from ..scheduler.metrics import start_metrics_server
 from ..scheduler.routes import ExtenderServer
@@ -43,6 +43,8 @@ def parse_args(argv=None):
     p.add_argument("--resync-seconds", type=float, default=30.0)
     p.add_argument("--fake-kube", action="store_true",
                    help="in-memory apiserver (dev/dry-run only)")
+    p.add_argument("--kube-url", default="",
+                   help="apiserver base URL (e.g. the apisim); empty = in-cluster")
     p.add_argument("-v", "--verbose", action="count", default=0)
     return p.parse_args(argv)
 
@@ -88,10 +90,12 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    client = DryRunKube() if args.fake_kube else load_incluster()
     if args.fake_kube:
+        client = DryRunKube()
         for n in ("node-a", "node-b"):
             client.add_node({"metadata": {"name": n, "annotations": {}}})
+    else:
+        client = make_client(kube_url=args.kube_url)
     scheduler = Scheduler(client, build_config(args))
     scheduler.resync_from_apiserver()
 

@@ -1,0 +1,5 @@
+from .cache import DeviceCache
+from .plugin import TpuDevicePlugin
+from .register import DeviceRegister, inventory_to_request
+
+__all__ = ["DeviceCache", "TpuDevicePlugin", "DeviceRegister", "inventory_to_request"]

@@ -1,0 +1,55 @@
+"""Device cache + health watch.
+
+Reference: pkg/device-plugin/cache.go (DeviceCache.Start/notify, 325–353) and
+the NVML XID health loop (nvidia.go:173–244).  TPU has no XID event stream;
+health is polled from the backend (the MLU plugin also polls, 1/s —
+cambricon.go:188–224) and fanned out to named subscribers (the kubelet
+ListAndWatch feed and the scheduler registration stream).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from ..tpulib.backend import Backend
+from ..tpulib.types import NodeInventory
+
+log = logging.getLogger(__name__)
+
+
+class DeviceCache:
+    def __init__(self, backend: Backend, poll_seconds: float = 5.0) -> None:
+        self.backend = backend
+        self.poll_seconds = poll_seconds
+        self.inventory: NodeInventory = backend.inventory()
+        self._subs: Dict[str, Callable[[NodeInventory], None]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def subscribe(self, name: str, fn: Callable[[NodeInventory], None]) -> None:
+        self._subs[name] = fn
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_seconds):
+            try:
+                changed = self.backend.refresh_health(self.inventory)
+            except Exception:  # noqa: BLE001 — keep polling through glitches
+                log.exception("health refresh failed")
+                continue
+            if changed:
+                unhealthy = [c.uuid for c in self.inventory.chips if not c.healthy]
+                log.warning("chip health changed; unhealthy=%s", unhealthy)
+                for name, fn in self._subs.items():
+                    try:
+                        fn(self.inventory)
+                    except Exception:
+                        log.exception("health notify to %s failed", name)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

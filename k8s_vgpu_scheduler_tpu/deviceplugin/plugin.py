@@ -1,0 +1,282 @@
+"""TPU device plugin — the kubelet-facing node agent.
+
+Reference: pkg/device-plugin/plugin.go (NvidiaDevicePlugin, 136–391).
+Responsibilities preserved:
+
+- advertise every physical chip as ``device_split_count`` virtual devices
+  ``<uuid>-<k>`` (apiDevices, plugin.go:479–488) so kubelet admits up to N
+  sharers per chip;
+- ``Allocate()`` IGNORES kubelet's device IDs: the real decision was made by
+  the scheduler extender and travels in pod annotations; Allocate pops it and
+  emits the enforcement env + shim mounts (plugin.go:318–386);
+- failures finalize the handshake as failed and release the node lock so the
+  pod can reschedule.
+
+Env/mount contract with the lib/tpu enforcement shim (the L3→L1 interface,
+SURVEY.md §1):
+
+- ``TPU_DEVICE_MEMORY_LIMIT_<i>``  HBM cap MiB for the i-th granted chip
+- ``TPU_DEVICE_CORE_LIMIT``        compute percentage (0 = uncapped)
+- ``TPU_DEVICE_MEMORY_SHARED_CACHE`` in-container path of the shared
+  accounting region (host side scanned by the monitor)
+- ``TPU_VISIBLE_CHIPS``            granted chip uuids (shim bookkeeping)
+- ``TPU_VISIBLE_DEVICES``          granted chip *indices* (libtpu visibility)
+- ``TPU_OVERSUBSCRIBE``            present when HBM>host-RAM swap is enabled
+- mounts: host shim dir → /usr/local/vtpu (libvtpu.so + sitecustomize),
+  /etc/ld.so.preload, and the per-pod shared-cache host dir
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from ..api import deviceplugin_pb2 as pb
+from ..api.kubelet import (
+    API_VERSION,
+    add_deviceplugin_service,
+    registration_stub,
+)
+from ..k8s.client import KubeClient, pod_name, pod_uid
+from ..tpulib.types import NodeInventory
+from ..util import protocol
+from ..util.config import Config
+from ..util.types import (
+    ENV_CORE_LIMIT,
+    ENV_MEMORY_LIMIT_PREFIX,
+    ENV_OVERSUBSCRIBE,
+    ENV_SHARED_CACHE,
+    ENV_VISIBLE_CHIPS,
+    ENV_VISIBLE_DEVICES,
+    TPU_DEVICE,
+)
+
+log = logging.getLogger(__name__)
+
+OVERSUBSCRIBE_ANNOTATION = "vtpu.dev/oversubscribe"
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+class TpuDevicePlugin:
+    """Serves the kubelet DevicePlugin API for the ``google.com/tpu`` resource."""
+
+    def __init__(
+        self,
+        client: KubeClient,
+        inventory: NodeInventory,
+        cfg: Config,
+        socket_dir: str = "/var/lib/kubelet/device-plugins",
+        socket_name: str = "vtpu.sock",
+    ) -> None:
+        self.client = client
+        self.inventory = inventory
+        self.cfg = cfg
+        self.socket_dir = socket_dir
+        self.socket_path = os.path.join(socket_dir, socket_name)
+        self.resource_name = cfg.resources.count
+        self._server: Optional[grpc.Server] = None
+        # One queue per live ListAndWatch stream: kubelet restarts open a new
+        # stream while the old generator may still be draining, and a shared
+        # queue would let the dead stream steal health events.
+        self._watch_qs: Dict[int, "queue.Queue"] = {}
+        self._watch_seq = 0
+        self._watch_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    # -- virtual device fan-out (apiDevices, plugin.go:479–488) ---------------
+    def api_devices(self) -> List[pb.Device]:
+        out = []
+        for chip in self.inventory.chips:
+            for k in range(self.cfg.device_split_count):
+                out.append(
+                    pb.Device(
+                        ID=f"{chip.uuid}-{k}",
+                        health=HEALTHY if chip.healthy else UNHEALTHY,
+                    )
+                )
+        return out
+
+    def notify_health_changed(self) -> None:
+        with self._watch_lock:
+            for q in self._watch_qs.values():
+                q.put(True)
+
+    # -- DevicePlugin service --------------------------------------------------
+    def GetDevicePluginOptions(self, request, context):  # noqa: N802
+        return pb.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=False,
+        )
+
+    def ListAndWatch(self, request, context):  # noqa: N802
+        with self._watch_lock:
+            self._watch_seq += 1
+            sid = self._watch_seq
+            q: "queue.Queue" = queue.Queue()
+            self._watch_qs[sid] = q
+        try:
+            yield pb.ListAndWatchResponse(devices=self.api_devices())
+            while not self._stop.is_set():
+                try:
+                    q.get(timeout=1.0)
+                except queue.Empty:
+                    if context is not None and not context.is_active():
+                        return  # kubelet hung up; stop draining
+                    continue
+                yield pb.ListAndWatchResponse(devices=self.api_devices())
+        finally:
+            with self._watch_lock:
+                self._watch_qs.pop(sid, None)
+
+    def GetPreferredAllocation(self, request, context):  # noqa: N802
+        # The extender already chose physical chips; kubelet's preference over
+        # virtual IDs is irrelevant (reference MLU uses this for topology —
+        # our topology decision lives in Filter).
+        return pb.PreferredAllocationResponse()
+
+    def PreStartContainer(self, request, context):  # noqa: N802
+        return pb.PreStartContainerResponse()
+
+    def Allocate(self, request, context):  # noqa: N802
+        """The node-agent half of the two-phase commit (plugin.go:318–386)."""
+        responses = pb.AllocateResponse()
+        pod = None
+        try:
+            pod = protocol.get_pending_pod(self.client, self.cfg.node_name)
+            if pod is None:
+                raise LookupError(
+                    f"no pod in allocating phase on node {self.cfg.node_name}"
+                )
+            for _ in request.container_requests:
+                grant = protocol.get_next_device_request(TPU_DEVICE, pod)
+                protocol.erase_next_device_type(self.client, TPU_DEVICE, pod)
+                responses.container_responses.append(
+                    self.build_container_response(pod, grant)
+                )
+            protocol.pod_allocation_try_success(self.client, pod)
+            return responses
+        except Exception as e:  # noqa: BLE001 — any failure must free the pod
+            log.exception("Allocate failed")
+            if pod is not None:
+                try:
+                    protocol.pod_allocation_failed(self.client, pod)
+                except Exception:
+                    log.exception("failed to mark pod allocation failed")
+            context.abort(grpc.StatusCode.INTERNAL, f"allocate failed: {e}")
+
+    # -- response assembly -----------------------------------------------------
+    def build_container_response(self, pod: dict, grant) -> pb.ContainerAllocateResponse:
+        resp = pb.ContainerAllocateResponse()
+        anns = pod.get("metadata", {}).get("annotations", {})
+        uuids = []
+        indices = []
+        for i, dev in enumerate(grant):
+            resp.envs[f"{ENV_MEMORY_LIMIT_PREFIX}{i}"] = str(dev.usedmem)
+            uuids.append(dev.uuid)
+            chip = self.inventory.chip_by_uuid(dev.uuid)
+            if chip is None:
+                # Granted chip is gone from local inventory (died between
+                # Filter and Allocate).  Fail the allocation so the caller
+                # marks bind-phase=failed and the pod reschedules — a silent
+                # skip would mis-align MEMORY_LIMIT_<i> with VISIBLE_DEVICES.
+                raise LookupError(f"granted chip {dev.uuid} not in inventory")
+            indices.append(str(chip.index))
+            dev_node = f"/dev/accel{chip.index}"
+            if os.path.exists(dev_node):
+                resp.devices.append(
+                    pb.DeviceSpec(
+                        container_path=dev_node,
+                        host_path=dev_node,
+                        permissions="rw",
+                    )
+                )
+        if grant and not self.cfg.disable_core_limit:
+            resp.envs[ENV_CORE_LIMIT] = str(grant[0].usedcores)
+        resp.envs[ENV_VISIBLE_CHIPS] = ",".join(uuids)
+        if indices:
+            resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
+        if anns.get(OVERSUBSCRIBE_ANNOTATION, "") in ("true", "1", "on"):
+            resp.envs[ENV_OVERSUBSCRIBE] = "true"
+
+        # Shared accounting region: hostPath dir per pod+container, a single
+        # .cache file inside, mounted into the container (reference
+        # CUDA_DEVICE_MEMORY_SHARED_CACHE + /tmp/vgpu/containers/<uid_ctr>,
+        # plugin.go:353–380, monitor pathmonitor.go:17).
+        cache_dir = os.path.join(
+            self.cfg.cache_host_dir, f"{pod_uid(pod)}_{pod_name(pod)}"
+        )
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+        except OSError as e:
+            log.warning("cannot create cache dir %s: %s", cache_dir, e)
+        container_cache = "/tmp/vtpu/vtpu.cache"
+        resp.envs[ENV_SHARED_CACHE] = container_cache
+        resp.mounts.append(
+            pb.Mount(
+                container_path=os.path.dirname(container_cache),
+                host_path=cache_dir,
+                read_only=False,
+            )
+        )
+        if self.cfg.shim_host_dir and os.path.isdir(self.cfg.shim_host_dir):
+            resp.mounts.append(
+                pb.Mount(
+                    container_path="/usr/local/vtpu",
+                    host_path=self.cfg.shim_host_dir,
+                    read_only=True,
+                )
+            )
+            preload = os.path.join(self.cfg.shim_host_dir, "ld.so.preload")
+            if os.path.exists(preload):
+                resp.mounts.append(
+                    pb.Mount(
+                        container_path="/etc/ld.so.preload",
+                        host_path=preload,
+                        read_only=True,
+                    )
+                )
+        return resp
+
+    # -- serving lifecycle (Serve/Register, plugin.go:181–253) ----------------
+    def serve(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        add_deviceplugin_service(self._server, self)
+        self._server.add_insecure_port(f"unix://{self.socket_path}")
+        self._server.start()
+        log.info("device plugin serving on %s", self.socket_path)
+
+    def register_with_kubelet(self, kubelet_socket: Optional[str] = None) -> None:
+        kubelet_socket = kubelet_socket or os.path.join(self.socket_dir, "kubelet.sock")
+        channel = grpc.insecure_channel(f"unix://{kubelet_socket}")
+        stub = registration_stub(channel)
+        stub(
+            pb.RegisterRequest(
+                version=API_VERSION,
+                endpoint=os.path.basename(self.socket_path),
+                resource_name=self.resource_name,
+                options=pb.DevicePluginOptions(),
+            ),
+            timeout=10,
+        )
+        log.info("registered %s with kubelet", self.resource_name)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=1)
+            self._server = None
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass

@@ -1,0 +1,175 @@
+"""Apiserver simulator: FakeKube behind real HTTP.
+
+Serves the exact REST slice RestKube consumes, so every control-plane process
+(scheduler extender, device plugin, monitor) can run as a real OS process
+against a shared fake apiserver — multi-node e2e without a cluster, the
+missing test capability called out in SURVEY.md §4.
+
+Paths:
+  GET    /api/v1/pods                               list all pods
+  GET    /api/v1/namespaces/{ns}/pods               list namespace pods
+  POST   /api/v1/namespaces/{ns}/pods               create pod
+  GET    /api/v1/namespaces/{ns}/pods/{name}        get pod
+  PATCH  /api/v1/namespaces/{ns}/pods/{name}        merge-patch annotations
+  DELETE /api/v1/namespaces/{ns}/pods/{name}        delete pod
+  POST   /api/v1/namespaces/{ns}/pods/{name}/binding
+  GET    /api/v1/nodes[/{name}]                     nodes
+  POST   /api/v1/nodes                              create node (seeding)
+  PATCH  /api/v1/nodes/{name}                       merge-patch (CAS via
+                                                    metadata.resourceVersion)
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .client import Conflict, NotFound
+from .fake import FakeKube
+
+log = logging.getLogger(__name__)
+
+_POD_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods(?:/([^/]+))?(/binding)?$")
+_NODE_RE = re.compile(r"^/api/v1/nodes(?:/([^/]+))?$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    kube: FakeKube
+
+    def log_message(self, fmt, *args):
+        log.debug("apisim: " + fmt, *args)
+
+    def _reply(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length)) if length else {}
+
+    def _dispatch(self):
+        try:
+            self._route()
+        except NotFound as e:
+            self._reply(404, {"kind": "Status", "message": str(e)})
+        except Conflict as e:
+            self._reply(409, {"kind": "Status", "message": str(e)})
+        except Exception as e:  # noqa: BLE001
+            log.exception("apisim error")
+            self._reply(500, {"kind": "Status", "message": str(e)})
+
+    do_GET = do_POST = do_PATCH = do_DELETE = _dispatch  # noqa: N815
+
+    def _route(self):
+        method = self.command
+        path = self.path.split("?", 1)[0]
+
+        if path == "/api/v1/pods" and method == "GET":
+            self._reply(200, {"kind": "PodList", "items": self.kube.list_pods()})
+            return
+
+        m = _POD_RE.match(path)
+        if m:
+            ns, name, binding = m.group(1), m.group(2), m.group(3)
+            if binding and method == "POST":
+                body = self._body()
+                self.kube.bind_pod(ns, name, body.get("target", {}).get("name", ""))
+                self._reply(201, {"kind": "Status", "status": "Success"})
+            elif name is None and method == "GET":
+                self._reply(200, {"kind": "PodList", "items": self.kube.list_pods(ns)})
+            elif name is None and method == "POST":
+                pod = self._body()
+                pod.setdefault("metadata", {}).setdefault("namespace", ns)
+                self._reply(201, self.kube.create_pod(pod))
+            elif method == "GET":
+                self._reply(200, self.kube.get_pod(ns, name))
+            elif method == "PATCH":
+                anns = self._body().get("metadata", {}).get("annotations", {})
+                self._reply(200, self.kube.patch_pod_annotations(ns, name, anns))
+            elif method == "DELETE":
+                self.kube.delete_pod(ns, name)
+                self._reply(200, {"kind": "Status", "status": "Success"})
+            else:
+                self._reply(405, {"message": "method not allowed"})
+            return
+
+        m = _NODE_RE.match(path)
+        if m:
+            name = m.group(1)
+            if name is None and method == "GET":
+                self._reply(200, {"kind": "NodeList", "items": self.kube.list_nodes()})
+            elif name is None and method == "POST":
+                node = self._body()
+                self.kube.add_node(node)
+                self._reply(201, node)
+            elif method == "GET":
+                self._reply(200, self.kube.get_node(name))
+            elif method == "PATCH":
+                body = self._body()
+                meta = body.get("metadata", {})
+                self._reply(
+                    200,
+                    self.kube.patch_node_annotations(
+                        name,
+                        meta.get("annotations", {}),
+                        resource_version=meta.get("resourceVersion"),
+                    ),
+                )
+            else:
+                self._reply(405, {"message": "method not allowed"})
+            return
+
+        self._reply(404, {"message": f"no route {method} {path}"})
+
+
+class KubeSimServer:
+    def __init__(self, kube: Optional[FakeKube] = None,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self.kube = kube or FakeKube()
+        handler = type("BoundHandler", (_Handler,), {"kube": self.kube})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "KubeSimServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def main(argv=None):  # pragma: no cover - dev convenience
+    import argparse
+
+    p = argparse.ArgumentParser("vtpu-apisim")
+    p.add_argument("--bind", default="127.0.0.1:8001")
+    p.add_argument("--nodes", default="node-a",
+                   help="comma-separated node names to pre-create")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    host, _, port = args.bind.rpartition(":")
+    srv = KubeSimServer(host=host or "127.0.0.1", port=int(port))
+    for n in args.nodes.split(","):
+        if n:
+            srv.kube.add_node({"metadata": {"name": n, "annotations": {}}})
+    log.info("apiserver sim on %s", srv.url)
+    srv.httpd.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -69,10 +69,12 @@ class MockBackend(Backend):
     """
 
     def __init__(self, fixture: Optional[dict] = None, path: Optional[str] = None):
+        self.path = None
         if fixture is None:
             path = path or os.environ.get(MOCK_ENV)
             if not path:
                 raise ValueError(f"MockBackend needs a fixture dict or ${MOCK_ENV}")
+            self.path = path
             with open(path) as f:
                 fixture = json.load(f)
         self.fixture = fixture
@@ -118,8 +120,15 @@ class MockBackend(Backend):
         return NodeInventory(chips=chips, topology=topo)
 
     def refresh_health(self, inv: NodeInventory) -> bool:
-        """Re-read the fixture (tests mutate ``self.fixture``) and apply
-        health flags by coords."""
+        """Re-read the fixture (tests mutate ``self.fixture``; multi-process
+        drives rewrite the fixture *file* — fault injection, reference
+        mock/cndev.c:52–64) and apply health flags by coords."""
+        if self.path:
+            try:
+                with open(self.path) as f:
+                    self.fixture = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass  # transient rewrite; keep last good fixture
         changed = False
         by_coords = {tuple(c.get("coords", ())): c for c in self.fixture.get("chips", [])}
         for chip in inv.chips:

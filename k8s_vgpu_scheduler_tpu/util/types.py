@@ -70,7 +70,8 @@ ENV_SHARED_CACHE = "TPU_DEVICE_MEMORY_SHARED_CACHE"
 ENV_OVERSUBSCRIBE = "TPU_OVERSUBSCRIBE"
 ENV_TASK_PRIORITY = "TPU_TASK_PRIORITY"
 ENV_CORE_POLICY = "TPU_CORE_UTILIZATION_POLICY"
-ENV_VISIBLE_DEVICES = "TPU_VISIBLE_CHIPS"
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"    # granted chip uuids (shim bookkeeping)
+ENV_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"  # granted chip indices (libtpu)
 
 
 @dataclasses.dataclass
