@@ -16,6 +16,7 @@ Env/mount contract with the lib/tpu enforcement shim (the L3→L1 interface,
 SURVEY.md §1):
 
 - ``TPU_DEVICE_MEMORY_LIMIT_<i>``  HBM cap MiB for the i-th granted chip
+- ``TPU_DEVICE_PHYSICAL_MEMORY_<i>`` true chip HBM MiB (shim ballast sizing)
 - ``TPU_DEVICE_CORE_LIMIT``        compute percentage (0 = uncapped)
 - ``TPU_DEVICE_MEMORY_SHARED_CACHE`` in-container path of the shared
   accounting region (host side scanned by the monitor)
@@ -52,6 +53,7 @@ from ..util.types import (
     ENV_CORE_LIMIT,
     ENV_MEMORY_LIMIT_PREFIX,
     ENV_OVERSUBSCRIBE,
+    ENV_PHYSICAL_MEMORY_PREFIX,
     ENV_SHARED_CACHE,
     ENV_VISIBLE_CHIPS,
     ENV_VISIBLE_DEVICES,
@@ -188,6 +190,9 @@ class TpuDevicePlugin:
                 # marks bind-phase=failed and the pod reschedules — a silent
                 # skip would mis-align MEMORY_LIMIT_<i> with VISIBLE_DEVICES.
                 raise LookupError(f"granted chip {dev.uuid} not in inventory")
+            # Physical capacity: the shim sizes its ballast from this when the
+            # platform exposes no memory_stats.
+            resp.envs[f"{ENV_PHYSICAL_MEMORY_PREFIX}{i}"] = str(chip.hbm_mib)
             indices.append(str(chip.index))
             dev_node = f"/dev/accel{chip.index}"
             if os.path.exists(dev_node):

@@ -1,0 +1,3 @@
+from .core import Native, Shim, autoinstall, install
+
+__all__ = ["Native", "Shim", "autoinstall", "install"]

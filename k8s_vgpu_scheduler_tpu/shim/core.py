@@ -1,0 +1,321 @@
+"""In-container enforcement shim (Python half).
+
+The TPU counterpart of the reference's LD_PRELOAD CUDA intercept
+(SURVEY.md N1).  The native half (lib/tpu/libvtpu.so) owns the shared
+accounting region, the oom check and the dispatch rate limiter; this module
+is the XLA-layer integration:
+
+- attaches the process to the region (ctypes onto libvtpu);
+- publishes the XLA client's actual HBM use (``memory_stats``) into the
+  region so the monitor and sharers see real consumption;
+- hard-caps HBM with a *ballast* allocation: at install time it reserves
+  ``physical_total − limit`` bytes on each granted chip, so XLA's own OOM
+  path enforces the cap exactly — the TPU-native answer to intercepting
+  cuMemAlloc (XLA plans allocations internally; there is no per-malloc hook);
+- throttles compute by gating jitted-callable dispatch through the native
+  duty-cycle limiter (the reference gates cuLaunchKernel; on TPU one XLA
+  executable execution is the natural dispatch unit);
+- virtualizes memory introspection: ``memory_info()`` reports the *limit* as
+  the total, like the reference's virtualized nvmlDeviceGetMemoryInfo
+  (nvidia-smi shows the vGPU, README.md:133);
+- optional active OOM watchdog (``VTPU_OOM_ACTION=kill``) mirroring
+  ACTIVE_OOM_KILLER.
+
+IMPORTANT: this file must stay dependency-free (stdlib + ctypes; jax strictly
+optional) — it is copied verbatim into the shim host dir as ``vtpu_shim.py``
+and imported by ``sitecustomize.py`` inside arbitrary user containers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger("vtpu.shim")
+
+MIB = 1024 * 1024
+
+
+def _find_library() -> Optional[str]:
+    candidates = [
+        os.environ.get("VTPU_LIBRARY", ""),
+        "/usr/local/vtpu/libvtpu.so",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "libvtpu.so"),
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "..", "..", "lib", "tpu", "build", "libvtpu.so",
+        ),
+    ]
+    for c in candidates:
+        if c and os.path.exists(c):
+            return os.path.abspath(c)
+    return None
+
+
+class Native:
+    """ctypes surface of libvtpu.so."""
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        path = path or _find_library()
+        if path is None:
+            raise FileNotFoundError("libvtpu.so not found (set VTPU_LIBRARY)")
+        self.lib = ctypes.CDLL(path)
+        L = self.lib
+        L.vtpu_init_path.argtypes = [ctypes.c_char_p]
+        L.vtpu_init_path.restype = ctypes.c_int
+        L.vtpu_shutdown.restype = None
+        L.vtpu_initialized.restype = ctypes.c_int
+        for fn in ("vtpu_get_limit", "vtpu_get_sm_limit", "vtpu_get_used"):
+            getattr(L, fn).argtypes = [ctypes.c_int]
+            getattr(L, fn).restype = ctypes.c_uint64
+        L.vtpu_try_alloc.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        L.vtpu_try_alloc.restype = ctypes.c_int
+        L.vtpu_set_used.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        L.vtpu_set_used.restype = None
+        L.vtpu_free.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        L.vtpu_free.restype = None
+        L.vtpu_proc_count.restype = ctypes.c_int
+        L.vtpu_rate_acquire.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        L.vtpu_rate_acquire.restype = None
+        L.vtpu_rate_feedback.argtypes = [ctypes.c_int, ctypes.c_uint64]
+        L.vtpu_rate_feedback.restype = None
+        L.vtpu_region_path.restype = ctypes.c_char_p
+
+    def init(self, path: Optional[str] = None) -> None:
+        rc = self.lib.vtpu_init_path(path.encode() if path else None)
+        if rc != 0:
+            raise OSError(-rc, f"vtpu_init failed: {os.strerror(-rc)}")
+
+    def shutdown(self) -> None:
+        self.lib.vtpu_shutdown()
+
+
+class Shim:
+    def __init__(self, native: Native) -> None:
+        self.native = native
+        self._ballast: List[Any] = []
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_cost_us: Dict[int, int] = {}
+
+    # -- introspection ---------------------------------------------------------
+    def memory_info(self, dev: int = 0) -> Dict[str, int]:
+        """Virtualized view: 'total' is the grant, not the physical chip."""
+        return {
+            "total": int(self.native.lib.vtpu_get_limit(dev)),
+            "used": int(self.native.lib.vtpu_get_used(dev)),
+        }
+
+    # -- compute throttling ----------------------------------------------------
+    def throttled(self, fn, dev: int = 0):
+        """Gate a callable through the native duty-cycle limiter, feeding the
+        measured wall time back as the next dispatch's cost estimate."""
+
+        @functools.wraps(fn)
+        def gated(*args, **kwargs):
+            cost = self._last_cost_us.get(dev, 0)
+            self.native.lib.vtpu_rate_acquire(dev, cost)
+            t0 = time.monotonic()
+            out = fn(*args, **kwargs)
+            busy = int((time.monotonic() - t0) * 1e6)
+            self._last_cost_us[dev] = busy
+            self.native.lib.vtpu_rate_feedback(dev, busy)
+            return out
+
+        return gated
+
+    def install_jax_hooks(self) -> bool:
+        """Wrap jax.jit so every jitted callable dispatch passes the limiter.
+        No-op when jax is absent."""
+        try:
+            import jax
+        except Exception:
+            return False
+        if getattr(jax.jit, "_vtpu_wrapped", False):
+            return True
+        orig_jit = jax.jit
+        shim = self
+
+        def vtpu_jit(fun=None, **kwargs):
+            if fun is None:
+                return lambda f: vtpu_jit(f, **kwargs)
+            compiled = orig_jit(fun, **kwargs)
+
+            class Gated:
+                """Callable proxy keeping the PjitFunction API (lower, etc.)."""
+
+                def __call__(self, *a, **k):
+                    cost = shim._last_cost_us.get(0, 0)
+                    shim.native.lib.vtpu_rate_acquire(0, cost)
+                    t0 = time.monotonic()
+                    out = compiled(*a, **k)
+                    busy = int((time.monotonic() - t0) * 1e6)
+                    shim._last_cost_us[0] = busy
+                    shim.native.lib.vtpu_rate_feedback(0, busy)
+                    return out
+
+                def __getattr__(self, name):
+                    return getattr(compiled, name)
+
+            return functools.wraps(fun)(Gated())
+
+        vtpu_jit._vtpu_wrapped = True  # type: ignore[attr-defined]
+        jax.jit = vtpu_jit
+        return True
+
+    # -- HBM hard cap ----------------------------------------------------------
+    def apply_ballast(self) -> int:
+        """Reserve (physical − limit) bytes on each granted chip so XLA's own
+        OOM enforces the grant.  Returns total ballast bytes reserved.
+        Requires jax; harmless when limits are 0 (uncapped)."""
+        try:
+            import jax
+            import jax.numpy as jnp
+        except Exception:
+            return 0
+        reserved = 0
+        for i, d in enumerate(jax.local_devices()):
+            limit = int(self.native.lib.vtpu_get_limit(i))
+            if limit <= 0:
+                continue
+            physical, in_use = self._physical_stats(d, i)
+            if physical <= 0:
+                log.warning("no physical HBM size for device %d; ballast skipped", i)
+                continue
+            ballast = physical - limit - in_use
+            if ballast <= 0:
+                continue
+            arr = jax.device_put(
+                jnp.zeros((ballast,), dtype=jnp.uint8), d
+            )
+            arr.block_until_ready()
+            self._ballast.append(arr)
+            reserved += ballast
+            log.info("ballast on device %d: %d MiB (limit %d MiB)",
+                     i, ballast // MIB, limit // MIB)
+        return reserved
+
+    def release_ballast(self) -> None:
+        self._ballast.clear()
+
+    @staticmethod
+    def _physical_stats(device, idx: int) -> "tuple[int, int]":
+        """(physical_bytes, in_use_bytes): memory_stats when the platform has
+        it, else the device plugin's TPU_DEVICE_PHYSICAL_MEMORY_<i> env."""
+        physical = in_use = 0
+        try:
+            stats = device.memory_stats() or {}
+            physical = int(stats.get("bytes_limit", 0))
+            in_use = int(stats.get("bytes_in_use", 0))
+        except Exception:
+            pass
+        if physical <= 0:
+            env = os.environ.get(f"TPU_DEVICE_PHYSICAL_MEMORY_{idx}", "")
+            if env.isdigit():
+                physical = int(env) * MIB
+        return physical, in_use
+
+    # -- accounting + watchdog -------------------------------------------------
+    def publish_usage_once(self) -> None:
+        """Sample the XLA client's bytes_in_use per device and publish it
+        into the shared region (minus our own ballast)."""
+        try:
+            import jax
+        except Exception:
+            return
+        ballast_by_dev: Dict[int, int] = {}
+        for arr in self._ballast:
+            try:
+                dev = list(arr.devices())[0]
+                idx = jax.local_devices().index(dev)
+                ballast_by_dev[idx] = ballast_by_dev.get(idx, 0) + arr.nbytes
+            except Exception:
+                continue
+        for i, d in enumerate(jax.local_devices()):
+            try:
+                stats = d.memory_stats() or {}
+                in_use = int(stats.get("bytes_in_use", 0))
+            except Exception:
+                continue
+            if "bytes_in_use" not in stats:
+                continue  # platform exposes no usage; keep delta accounting
+            in_use -= ballast_by_dev.get(i, 0)
+            self.native.lib.vtpu_set_used(i, max(0, in_use))
+
+    def start_watchdog(self, interval: float = 1.0) -> None:
+        action = os.environ.get("VTPU_OOM_ACTION", "warn")
+
+        def loop():
+            warned = False
+            while not self._stop.wait(interval):
+                self.publish_usage_once()
+                for i in range(16):
+                    limit = int(self.native.lib.vtpu_get_limit(i))
+                    if limit <= 0:
+                        continue
+                    used = int(self.native.lib.vtpu_get_used(i))
+                    if used > limit:
+                        if action == "kill":
+                            log.error(
+                                "HBM grant exceeded on dev %d (%d > %d MiB); "
+                                "killing process (VTPU_OOM_ACTION=kill)",
+                                i, used // MIB, limit // MIB)
+                            os.kill(os.getpid(), signal.SIGKILL)
+                        elif not warned:
+                            log.warning(
+                                "HBM grant exceeded on dev %d (%d > %d MiB)",
+                                i, used // MIB, limit // MIB)
+                            warned = True
+
+        self._watchdog = threading.Thread(target=loop, daemon=True)
+        self._watchdog.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+_GLOBAL: Optional[Shim] = None
+
+
+def install(region_path: Optional[str] = None, jax_hooks: bool = True,
+            ballast: Optional[bool] = None, watchdog: bool = True) -> Shim:
+    """Full shim bring-up; idempotent.  Called by sitecustomize inside
+    containers, or explicitly by test/bench code."""
+    global _GLOBAL
+    if _GLOBAL is not None:
+        return _GLOBAL
+    native = Native()
+    native.init(region_path)
+    shim = Shim(native)
+    if ballast is None:
+        ballast = os.environ.get("VTPU_BALLAST", "1") not in ("0", "false")
+    if jax_hooks:
+        shim.install_jax_hooks()
+    if ballast:
+        try:
+            shim.apply_ballast()
+        except Exception:
+            log.exception("ballast allocation failed; cap is advisory only")
+    if watchdog:
+        shim.start_watchdog()
+    _GLOBAL = shim
+    return shim
+
+
+def autoinstall() -> Optional[Shim]:
+    """Entry for sitecustomize: only act inside vtpu-managed containers."""
+    if os.environ.get("VTPU_DISABLE"):
+        return None
+    if not os.environ.get("TPU_DEVICE_MEMORY_SHARED_CACHE"):
+        return None
+    try:
+        return install()
+    except Exception:
+        log.exception("vtpu shim install failed; running unenforced")
+        return None

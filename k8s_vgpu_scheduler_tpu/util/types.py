@@ -65,6 +65,7 @@ DEVICE_LIMIT = 100
 # CUDA_DEVICE_MEMORY_SHARED_CACHE, CUDA_OVERSUBSCRIBE, CUDA_TASK_PRIORITY,
 # GPU_CORE_UTILIZATION_POLICY (plugin.go:353–371, api/types.go:19–22).
 ENV_MEMORY_LIMIT_PREFIX = "TPU_DEVICE_MEMORY_LIMIT_"
+ENV_PHYSICAL_MEMORY_PREFIX = "TPU_DEVICE_PHYSICAL_MEMORY_"  # true chip MiB (ballast sizing)
 ENV_CORE_LIMIT = "TPU_DEVICE_CORE_LIMIT"
 ENV_SHARED_CACHE = "TPU_DEVICE_MEMORY_SHARED_CACHE"
 ENV_OVERSUBSCRIBE = "TPU_OVERSUBSCRIBE"

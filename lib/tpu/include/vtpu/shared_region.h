@@ -1,0 +1,77 @@
+/* vtpu shared accounting region — the L1 <-> L2 ABI.
+ *
+ * TPU-native rebuild of the reference's sharedRegionT (binary libvgpu.so;
+ * layout documented by the monitor's reader, cmd/vGPUmonitor/cudevshr.go:48-80:
+ * magic 19920718, 16-device limit arrays, 1024 proc slots).  Differences are
+ * deliberate modernizations:
+ *   - the cross-process lock is a pthread robust mutex (dead-owner recovery is
+ *     handled by the kernel via EOWNERDEAD instead of the reference's
+ *     hand-rolled fix_lock_shrreg pid-liveness probe);
+ *   - all sizes are bytes, all fields fixed-width, explicit padding;
+ *   - a monotonically increasing generation counter lets readers detect
+ *     concurrent updates without taking the lock.
+ *
+ * One region file exists per pod-container (mounted by the device plugin at
+ * $TPU_DEVICE_MEMORY_SHARED_CACHE); every TPU process in the container mmaps
+ * it, the node monitor mmaps all of them from the host side.
+ */
+#ifndef VTPU_SHARED_REGION_H_
+#define VTPU_SHARED_REGION_H_
+
+#include <pthread.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define VTPU_MAGIC 0x56545055u /* "VTPU" */
+#define VTPU_ABI_VERSION 1
+#define VTPU_MAX_DEVICES 16
+#define VTPU_MAX_PROCS 1024
+#define VTPU_UUID_LEN 64
+
+/* Per-process accounting slot. */
+typedef struct {
+  int32_t pid;          /* in-container pid; 0 = slot free */
+  int32_t hostpid;      /* filled by the monitor (cgroup walk) */
+  int32_t status;       /* 1 = alive, 2 = exited-unclean (monitor GC) */
+  int32_t pad_;
+  uint64_t used[VTPU_MAX_DEVICES];         /* bytes, self-reported */
+  uint64_t monitor_used[VTPU_MAX_DEVICES]; /* bytes, monitor-measured */
+} vtpu_proc_slot_t;
+
+typedef struct {
+  uint32_t magic;
+  int32_t abi_version;
+  int32_t initialized; /* 1 once the creating process finished init */
+  int32_t num_devices;
+  int64_t owner_pid; /* creator, informational */
+  uint64_t generation;
+
+  pthread_mutex_t lock; /* PROCESS_SHARED | ROBUST */
+
+  char uuids[VTPU_MAX_DEVICES][VTPU_UUID_LEN];
+  uint64_t limit[VTPU_MAX_DEVICES];    /* HBM cap, bytes; 0 = uncapped */
+  uint64_t sm_limit[VTPU_MAX_DEVICES]; /* compute cap, percent (0/100 = uncapped) */
+
+  /* Monitor feedback plane (reference feedback.go:178-219): the monitor
+   * turns utilization_switch ON when a higher-priority sharer is active on
+   * the same physical chip; the rate limiter then throttles low-priority
+   * processes.  recent_kernel is bumped on every dispatch and aged by the
+   * monitor to detect activity. */
+  int32_t utilization_switch;
+  int32_t recent_kernel;
+  int32_t priority; /* 0 = high, 1 = low (reference vgputaskpriority) */
+  int32_t oversubscribe;
+
+  int32_t proc_num; /* high-water mark of used slots */
+  int32_t pad2_;
+  vtpu_proc_slot_t procs[VTPU_MAX_PROCS];
+} vtpu_region_t;
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* VTPU_SHARED_REGION_H_ */

@@ -1,0 +1,78 @@
+/* libvtpu public C API — consumed by the Python shim (ctypes), the node
+ * monitor, and (on real deployments) the PJRT-layer interposer.
+ *
+ * This is the TPU-native replacement for the reference's binary-only
+ * libvgpu.so enforcement library (SURVEY.md N1).  The compute path (XLA)
+ * calls into this library at dispatch/allocation boundaries instead of the
+ * reference's per-CUDA-call dlsym hooks.
+ */
+#ifndef VTPU_VTPU_H_
+#define VTPU_VTPU_H_
+
+#include <stdint.h>
+
+#include "vtpu/shared_region.h"
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* -- lifecycle ------------------------------------------------------------ */
+/* Attach to (creating if needed) the shared region at `path`, or at
+ * $TPU_DEVICE_MEMORY_SHARED_CACHE / the default path when NULL.  Registers
+ * the calling process in a proc slot.  Returns 0 or -errno. */
+int vtpu_init_path(const char* path);
+int vtpu_init(void);
+void vtpu_shutdown(void);
+int vtpu_initialized(void);
+
+/* -- HBM accounting (oom_check + usage, reference N1) --------------------- */
+uint64_t vtpu_get_limit(int dev);
+uint64_t vtpu_get_sm_limit(int dev);
+uint64_t vtpu_get_used(int dev);
+int vtpu_try_alloc(int dev, uint64_t bytes); /* 0 | -ENOMEM | -EINVAL */
+void vtpu_set_used(int dev, uint64_t bytes); /* absolute self-report */
+void vtpu_free(int dev, uint64_t bytes);
+void vtpu_memory_info(int dev, uint64_t* total, uint64_t* used);
+int vtpu_proc_count(void);
+const char* vtpu_region_path(void);
+vtpu_region_t* vtpu_region(void);
+
+/* -- dispatch rate limiter (reference rate_limiter/utilization_watcher) --- */
+/* Gate one executable dispatch on device `dev`.  Blocks (sleeps) until the
+ * duty-cycle budget implied by sm_limit[dev] admits the dispatch.  `cost_us`
+ * is the caller's estimate of the dispatch's device-busy time (use the
+ * previous execution's wall time; 0 = use a default).  Never blocks when
+ * sm_limit is 0/100, or when priority==0 (high) and utilization_switch says
+ * no higher-priority sharer is active. */
+void vtpu_rate_acquire(int dev, uint64_t cost_us);
+
+/* Tell the limiter how long the last dispatch actually kept the device busy
+ * (closes the loop the reference drives from utilization_watcher). */
+void vtpu_rate_feedback(int dev, uint64_t busy_us);
+
+/* -- external reader API (node monitor) ----------------------------------- */
+vtpu_region_t* vtpu_open_region(const char* path);
+void vtpu_close_region(vtpu_region_t* r);
+int vtpu_r_num_devices(vtpu_region_t* r);
+const char* vtpu_r_uuid(vtpu_region_t* r, int dev);
+uint64_t vtpu_r_limit(vtpu_region_t* r, int dev);
+uint64_t vtpu_r_sm_limit(vtpu_region_t* r, int dev);
+uint64_t vtpu_r_used(vtpu_region_t* r, int dev);
+int vtpu_r_priority(vtpu_region_t* r);
+int vtpu_r_recent_kernel(vtpu_region_t* r);
+int vtpu_r_age_kernel(vtpu_region_t* r);
+int vtpu_r_get_switch(vtpu_region_t* r);
+void vtpu_r_set_switch(vtpu_region_t* r, int on);
+int vtpu_r_proc_pids(vtpu_region_t* r, int32_t* out, int max);
+void vtpu_r_set_hostpid(vtpu_region_t* r, int32_t pid, int32_t hostpid);
+void vtpu_r_set_monitor_used(vtpu_region_t* r, int32_t pid, int dev,
+                             uint64_t bytes);
+int vtpu_r_gc(vtpu_region_t* r, const int32_t* live_pids, int n_live);
+uint64_t vtpu_r_generation(vtpu_region_t* r);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* VTPU_VTPU_H_ */

@@ -1,0 +1,21 @@
+// LD_PRELOAD entry: auto-attach every process in the container to the shared
+// accounting region (the reference injects libvgpu.so via /etc/ld.so.preload
+// so EVERY process is accounted; plugin.go:373-379).  Enforcement decisions
+// happen at the XLA dispatch layer (Python shim / PJRT interposer); this
+// constructor only guarantees the process is visible to the monitor.
+
+#include <stdlib.h>
+
+#include "vtpu/vtpu.h"
+
+__attribute__((constructor)) static void vtpu_preload_init(void) {
+  if (getenv("VTPU_DISABLE")) return;
+  // Only attach when the device plugin marked this container (env present);
+  // host processes must not create stray regions.
+  if (!getenv("TPU_DEVICE_MEMORY_SHARED_CACHE")) return;
+  vtpu_init();
+}
+
+__attribute__((destructor)) static void vtpu_preload_fini(void) {
+  vtpu_shutdown();
+}

@@ -1,0 +1,101 @@
+// Dispatch-rate limiter: duty-cycle enforcement of the tpucores grant.
+//
+// The reference throttles at CUDA kernel-launch granularity with a token
+// bucket fed by an SM-utilization watcher (libvgpu.so symbols rate_limiter /
+// utilization_watcher / get_used_gpu_utilization).  On TPU the natural
+// dispatch unit is one XLA executable execution, which is also where the
+// shim calls us.  Model: a chip granted `sm_limit` percent may be busy at
+// most sm_limit/100 of wall time; we maintain a token bucket of *device
+// microseconds* refilled at that fraction of real time and charge each
+// dispatch its measured busy time.
+//
+// Priority coupling (reference feedback.go:178-219): when the node monitor
+// sets utilization_switch (a higher-priority sharer is active on this chip),
+// low-priority processes are throttled to their grant; when the switch is
+// off and the process is high-priority, dispatches pass untrottled.
+
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdlib>
+#include <mutex>
+
+#include "vtpu/shared_region.h"
+#include "vtpu/vtpu.h"
+
+namespace {
+
+constexpr uint64_t kDefaultCostUs = 2000;  // assume ~2ms when unknown
+constexpr uint64_t kMaxBurstUs = 200000;   // bucket cap: 200ms of device time
+
+struct Bucket {
+  std::mutex mu;
+  double tokens_us = kMaxBurstUs;
+  uint64_t last_refill_ns = 0;
+  uint64_t last_busy_us = 0;  // feedback from the previous dispatch
+};
+
+Bucket g_buckets[VTPU_MAX_DEVICES];
+
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+}  // namespace
+
+extern "C" {
+
+void vtpu_rate_acquire(int dev, uint64_t cost_us) {
+  vtpu_region_t* r = vtpu_region();
+  if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+
+  uint64_t sm = r->sm_limit[dev];
+  // Mark activity for the monitor regardless of throttling.
+  __atomic_fetch_add(&r->recent_kernel, 1, __ATOMIC_RELAXED);
+
+  if (sm == 0 || sm >= 100) return;  // uncapped
+  // High-priority processes run free unless the monitor flipped the switch
+  // policy; low-priority processes are always confined to their grant when
+  // the switch is on, and run free when no high-priority sharer is active
+  // (oversubscription of idle compute, reference CheckPriority).
+  const char* policy = getenv("TPU_CORE_UTILIZATION_POLICY");
+  bool force = policy && !strcmp(policy, "force");
+  bool disable = policy && !strcmp(policy, "disable");
+  if (disable) return;
+  if (!force) {
+    if (r->priority == 0) return;                 // high priority: never throttled
+    if (!r->utilization_switch) return;           // no contention: borrow idle cores
+  }
+
+  Bucket& b = g_buckets[dev];
+  std::lock_guard<std::mutex> g(b.mu);
+  if (cost_us == 0) cost_us = b.last_busy_us ? b.last_busy_us : kDefaultCostUs;
+  double rate = (double)sm / 100.0;  // device-us earned per wall-us
+  for (;;) {
+    uint64_t now = now_ns();
+    if (b.last_refill_ns == 0) b.last_refill_ns = now;
+    double earned = (double)(now - b.last_refill_ns) / 1000.0 * rate;
+    b.tokens_us = std::min((double)kMaxBurstUs, b.tokens_us + earned);
+    b.last_refill_ns = now;
+    if (b.tokens_us >= (double)cost_us) {
+      b.tokens_us -= (double)cost_us;
+      return;
+    }
+    uint64_t deficit_us = (uint64_t)(((double)cost_us - b.tokens_us) / rate);
+    usleep(std::min<uint64_t>(deficit_us + 1, 50000));
+  }
+}
+
+void vtpu_rate_feedback(int dev, uint64_t busy_us) {
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+  Bucket& b = g_buckets[dev];
+  std::lock_guard<std::mutex> g(b.mu);
+  b.last_busy_us = busy_us;
+}
+
+}  // extern "C"

@@ -1,0 +1,146 @@
+// External-reader API: the node monitor attaches to every container's region
+// file from the host side (reference cmd/vGPUmonitor mmaps each
+// /tmp/vgpu/containers/<uid_ctr>/*.cache, cudevshr.go:134-148) and drives the
+// priority feedback plane.  Opaque-handle accessors keep the struct layout
+// private to this library, so Python never mirrors the ABI.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include "vtpu/shared_region.h"
+#include "vtpu/vtpu.h"
+
+extern "C" {
+
+vtpu_region_t* vtpu_open_region(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(vtpu_region_t)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, sizeof(vtpu_region_t), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  vtpu_region_t* r = (vtpu_region_t*)mem;
+  if (r->magic != VTPU_MAGIC ||
+      !__atomic_load_n(&r->initialized, __ATOMIC_ACQUIRE)) {
+    munmap(mem, sizeof(vtpu_region_t));
+    return nullptr;
+  }
+  return r;
+}
+
+void vtpu_close_region(vtpu_region_t* r) {
+  if (r) munmap(r, sizeof(vtpu_region_t));
+}
+
+int vtpu_r_num_devices(vtpu_region_t* r) { return r ? r->num_devices : 0; }
+
+const char* vtpu_r_uuid(vtpu_region_t* r, int dev) {
+  if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return "";
+  return r->uuids[dev];
+}
+
+uint64_t vtpu_r_limit(vtpu_region_t* r, int dev) {
+  if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return 0;
+  return r->limit[dev];
+}
+
+uint64_t vtpu_r_sm_limit(vtpu_region_t* r, int dev) {
+  if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return 0;
+  return r->sm_limit[dev];
+}
+
+uint64_t vtpu_r_used(vtpu_region_t* r, int dev) {
+  if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return 0;
+  uint64_t total = 0;
+  for (int i = 0; i < r->proc_num && i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].pid != 0) total += r->procs[i].used[dev];
+  }
+  return total;
+}
+
+int vtpu_r_priority(vtpu_region_t* r) { return r ? r->priority : 0; }
+
+int vtpu_r_recent_kernel(vtpu_region_t* r) { return r ? r->recent_kernel : 0; }
+
+/* Age the activity counter toward zero; returns the value BEFORE aging
+ * (reference Observe decrements recentKernel each tick, feedback.go:178). */
+int vtpu_r_age_kernel(vtpu_region_t* r) {
+  if (!r) return 0;
+  int v = __atomic_load_n(&r->recent_kernel, __ATOMIC_RELAXED);
+  if (v > 0) __atomic_store_n(&r->recent_kernel, v - 1, __ATOMIC_RELAXED);
+  return v;
+}
+
+int vtpu_r_get_switch(vtpu_region_t* r) { return r ? r->utilization_switch : 0; }
+
+void vtpu_r_set_switch(vtpu_region_t* r, int on) {
+  if (r) __atomic_store_n(&r->utilization_switch, on ? 1 : 0, __ATOMIC_RELAXED);
+}
+
+int vtpu_r_proc_pids(vtpu_region_t* r, int32_t* out, int max) {
+  if (!r || !out) return 0;
+  int n = 0;
+  for (int i = 0; i < r->proc_num && i < VTPU_MAX_PROCS && n < max; i++) {
+    if (r->procs[i].pid != 0) out[n++] = r->procs[i].pid;
+  }
+  return n;
+}
+
+void vtpu_r_set_hostpid(vtpu_region_t* r, int32_t pid, int32_t hostpid) {
+  if (!r) return;
+  for (int i = 0; i < r->proc_num && i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].pid == pid) {
+      r->procs[i].hostpid = hostpid;
+      return;
+    }
+  }
+}
+
+void vtpu_r_set_monitor_used(vtpu_region_t* r, int32_t pid, int dev,
+                             uint64_t bytes) {
+  if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+  for (int i = 0; i < r->proc_num && i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].pid == pid) {
+      r->procs[i].monitor_used[dev] = bytes;
+      return;
+    }
+  }
+}
+
+/* Clear slots whose in-container pid no longer exists in `live_pids`
+ * (monitor GC of crashed processes; the reference recovers these via
+ * fix_lock_shrreg + status flags).  Returns slots cleared. */
+int vtpu_r_gc(vtpu_region_t* r, const int32_t* live_pids, int n_live) {
+  if (!r) return 0;
+  int cleared = 0;
+  for (int i = 0; i < r->proc_num && i < VTPU_MAX_PROCS; i++) {
+    int32_t pid = r->procs[i].pid;
+    if (pid == 0) continue;
+    bool alive = false;
+    for (int j = 0; j < n_live; j++) {
+      if (live_pids[j] == pid) {
+        alive = true;
+        break;
+      }
+    }
+    if (!alive) {
+      memset(&r->procs[i], 0, sizeof(vtpu_proc_slot_t));
+      cleared++;
+    }
+  }
+  if (cleared) r->generation++;
+  return cleared;
+}
+
+uint64_t vtpu_r_generation(vtpu_region_t* r) { return r ? r->generation : 0; }
+
+}  // extern "C"

@@ -1,0 +1,304 @@
+// Shared-region lifecycle + HBM accounting.
+//
+// Rebuild of the reference intercept library's region management (binary-only
+// libvgpu.so symbols: try_create_shrreg / lock_shrreg / fix_lock_shrreg /
+// oom_check / add_gpu_device_memory_usage — see SURVEY.md N1) as portable
+// C++17 with a pthread robust mutex doing the dead-owner recovery.
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+
+#include "vtpu/shared_region.h"
+#include "vtpu/vtpu.h"
+
+namespace {
+
+vtpu_region_t* g_region = nullptr;
+int g_slot = -1;
+char g_path[4096] = {0};
+
+uint64_t env_mib(const char* name) {
+  const char* v = getenv(name);
+  if (!v || !*v) return 0;
+  char* end = nullptr;
+  double x = strtod(v, &end);
+  if (end == v || x < 0) return 0;
+  // Values may carry an 'm'/'M' suffix like the reference ("3000m"); the
+  // unit is MiB either way.
+  return (uint64_t)(x * 1024.0 * 1024.0);
+}
+
+long env_long(const char* name, long fallback) {
+  const char* v = getenv(name);
+  if (!v || !*v) return fallback;
+  char* end = nullptr;
+  long x = strtol(v, &end, 10);
+  return end == v ? fallback : x;
+}
+
+void region_lock(vtpu_region_t* r) {
+  int rc = pthread_mutex_lock(&r->lock);
+  if (rc == EOWNERDEAD) {
+    // Previous holder died mid-critical-section: the accounting may be
+    // slightly stale (their proc slot is GC'd by the monitor), but the
+    // mutex itself is recoverable.
+    pthread_mutex_consistent(&r->lock);
+  }
+}
+
+void region_unlock(vtpu_region_t* r) { pthread_mutex_unlock(&r->lock); }
+
+void init_mutex(vtpu_region_t* r) {
+  pthread_mutexattr_t a;
+  pthread_mutexattr_init(&a);
+  pthread_mutexattr_setpshared(&a, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&a, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&r->lock, &a);
+  pthread_mutexattr_destroy(&a);
+}
+
+void apply_env_limits(vtpu_region_t* r) {
+  char name[64];
+  int n = 0;
+  for (int i = 0; i < VTPU_MAX_DEVICES; i++) {
+    snprintf(name, sizeof(name), "TPU_DEVICE_MEMORY_LIMIT_%d", i);
+    uint64_t lim = env_mib(name);
+    if (lim == 0 && i == 0) lim = env_mib("TPU_DEVICE_MEMORY_LIMIT");
+    if (lim > 0) {
+      r->limit[i] = lim;
+      n = i + 1;
+    }
+  }
+  long cores = env_long("TPU_DEVICE_CORE_LIMIT", 0);
+  for (int i = 0; i < VTPU_MAX_DEVICES; i++) {
+    r->sm_limit[i] = (cores > 0 && cores < 100) ? (uint64_t)cores : 0;
+  }
+  const char* chips = getenv("TPU_VISIBLE_CHIPS");
+  if (chips && *chips) {
+    int idx = 0;
+    const char* p = chips;
+    while (*p && idx < VTPU_MAX_DEVICES) {
+      const char* comma = strchr(p, ',');
+      size_t len = comma ? (size_t)(comma - p) : strlen(p);
+      if (len >= VTPU_UUID_LEN) len = VTPU_UUID_LEN - 1;
+      memcpy(r->uuids[idx], p, len);
+      r->uuids[idx][len] = 0;
+      idx++;
+      if (!comma) break;
+      p = comma + 1;
+    }
+    if (idx > n) n = idx;
+  }
+  if (n == 0) n = 1;
+  r->num_devices = n;
+  r->priority = (int32_t)env_long("TPU_TASK_PRIORITY", 0);
+  const char* ov = getenv("TPU_OVERSUBSCRIBE");
+  r->oversubscribe = (ov && (!strcmp(ov, "true") || !strcmp(ov, "1"))) ? 1 : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int vtpu_init_path(const char* path) {
+  if (g_region) return 0;
+  if (!path || !*path) {
+    path = getenv("TPU_DEVICE_MEMORY_SHARED_CACHE");
+    if (!path || !*path) path = "/tmp/vtpu/vtpu.cache";
+  }
+  snprintf(g_path, sizeof(g_path), "%s", path);
+
+  // Ensure parent dir exists (container path is a fresh mount).
+  char dir[4096];
+  snprintf(dir, sizeof(dir), "%s", path);
+  char* slash = strrchr(dir, '/');
+  if (slash && slash != dir) {
+    *slash = 0;
+    mkdir(dir, 0777);
+  }
+
+  int fd = open(path, O_RDWR | O_CREAT, 0666);
+  if (fd < 0) return -errno;
+
+  // Creation race: first process to win the flock initializes.
+  if (flock(fd, LOCK_EX) != 0) {
+    close(fd);
+    return -errno;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return -errno;
+  }
+  bool fresh = (size_t)st.st_size < sizeof(vtpu_region_t);
+  if (fresh && ftruncate(fd, sizeof(vtpu_region_t)) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return -errno;
+  }
+  void* mem = mmap(nullptr, sizeof(vtpu_region_t), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return -errno;
+  }
+  vtpu_region_t* r = (vtpu_region_t*)mem;
+  if (fresh || r->magic != VTPU_MAGIC) {
+    memset(r, 0, sizeof(*r));
+    init_mutex(r);
+    r->magic = VTPU_MAGIC;
+    r->abi_version = VTPU_ABI_VERSION;
+    r->owner_pid = getpid();
+    apply_env_limits(r);
+    __atomic_store_n(&r->initialized, 1, __ATOMIC_RELEASE);
+  }
+  flock(fd, LOCK_UN);
+  close(fd);
+
+  // Register this process in a free slot.
+  region_lock(r);
+  int slot = -1;
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].pid == 0) {
+      slot = i;
+      break;
+    }
+  }
+  if (slot >= 0) {
+    memset(&r->procs[slot], 0, sizeof(vtpu_proc_slot_t));
+    r->procs[slot].pid = getpid();
+    r->procs[slot].status = 1;
+    if (slot + 1 > r->proc_num) r->proc_num = slot + 1;
+  }
+  r->generation++;
+  region_unlock(r);
+  if (slot < 0) {
+    munmap(mem, sizeof(vtpu_region_t));
+    return -EAGAIN;
+  }
+  g_region = r;
+  g_slot = slot;
+  return 0;
+}
+
+int vtpu_init(void) { return vtpu_init_path(nullptr); }
+
+void vtpu_shutdown(void) {
+  if (!g_region) return;
+  region_lock(g_region);
+  if (g_slot >= 0) memset(&g_region->procs[g_slot], 0, sizeof(vtpu_proc_slot_t));
+  g_region->generation++;
+  region_unlock(g_region);
+  munmap(g_region, sizeof(vtpu_region_t));
+  g_region = nullptr;
+  g_slot = -1;
+}
+
+int vtpu_initialized(void) { return g_region != nullptr; }
+
+uint64_t vtpu_get_limit(int dev) {
+  if (!g_region || dev < 0 || dev >= VTPU_MAX_DEVICES) return 0;
+  return g_region->limit[dev];
+}
+
+uint64_t vtpu_get_sm_limit(int dev) {
+  if (!g_region || dev < 0 || dev >= VTPU_MAX_DEVICES) return 0;
+  return g_region->sm_limit[dev];
+}
+
+uint64_t vtpu_get_used(int dev) {
+  if (!g_region || dev < 0 || dev >= VTPU_MAX_DEVICES) return 0;
+  uint64_t total = 0;
+  region_lock(g_region);
+  for (int i = 0; i < g_region->proc_num; i++) {
+    if (g_region->procs[i].pid != 0) total += g_region->procs[i].used[dev];
+  }
+  region_unlock(g_region);
+  return total;
+}
+
+/* oom_check + add in one atomic step (the reference does oom_check then
+ * add_gpu_device_memory_usage separately; that is a TOCTOU between sharers).
+ * Returns 0 on success, -ENOMEM when the cap would be exceeded. */
+int vtpu_try_alloc(int dev, uint64_t bytes) {
+  if (!g_region || g_slot < 0) return -EINVAL;
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return -EINVAL;
+  vtpu_region_t* r = g_region;
+  int rc = 0;
+  region_lock(r);
+  uint64_t lim = r->limit[dev];
+  if (lim > 0) {
+    uint64_t total = 0;
+    for (int i = 0; i < r->proc_num; i++) {
+      if (r->procs[i].pid != 0) total += r->procs[i].used[dev];
+    }
+    if (total + bytes > lim) rc = -ENOMEM;
+  }
+  if (rc == 0) {
+    r->procs[g_slot].used[dev] += bytes;
+    r->generation++;
+  }
+  region_unlock(r);
+  return rc;
+}
+
+/* Absolute self-report for poll-based accounting (the Python shim samples
+ * the XLA client's bytes_in_use and publishes it; delta tracking via
+ * try_alloc/free is for allocation-site interposers). */
+void vtpu_set_used(int dev, uint64_t bytes) {
+  if (!g_region || g_slot < 0) return;
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+  region_lock(g_region);
+  g_region->procs[g_slot].used[dev] = bytes;
+  g_region->generation++;
+  region_unlock(g_region);
+}
+
+void vtpu_free(int dev, uint64_t bytes) {
+  if (!g_region || g_slot < 0) return;
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+  region_lock(g_region);
+  uint64_t* u = &g_region->procs[g_slot].used[dev];
+  *u = (*u >= bytes) ? (*u - bytes) : 0;
+  g_region->generation++;
+  region_unlock(g_region);
+}
+
+/* Virtualized introspection: what "memory info" should report inside the
+ * container (reference virtualizes nvmlDeviceGetMemoryInfo so nvidia-smi
+ * shows the vGPU limit, README.md:133). */
+void vtpu_memory_info(int dev, uint64_t* total, uint64_t* used) {
+  uint64_t lim = vtpu_get_limit(dev);
+  uint64_t u = vtpu_get_used(dev);
+  if (total) *total = lim;
+  if (used) *used = u;
+}
+
+int vtpu_proc_count(void) {
+  if (!g_region) return 0;
+  int n = 0;
+  region_lock(g_region);
+  for (int i = 0; i < g_region->proc_num; i++) {
+    if (g_region->procs[i].pid != 0) n++;
+  }
+  region_unlock(g_region);
+  return n;
+}
+
+const char* vtpu_region_path(void) { return g_path; }
+
+vtpu_region_t* vtpu_region(void) { return g_region; }
+
+}  // extern "C"

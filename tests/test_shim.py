@@ -1,0 +1,346 @@
+"""Enforcement shim tests: the C library driven via ctypes, including REAL
+multi-process accounting through the mmap'd region (the reference never tests
+its intercept library at all — binary-only)."""
+
+import ctypes
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "lib", "tpu", "build", "libvtpu.so")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def build_lib():
+    subprocess.run(["make", "-C", os.path.join(REPO, "lib", "tpu")],
+                   check=True, capture_output=True)
+
+
+def run_child(code: str, env: dict) -> str:
+    """Run shim code in a REAL child process (fresh library state)."""
+    full_env = dict(os.environ)
+    full_env.update(env)
+    full_env["VTPU_LIBRARY"] = LIB
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=full_env, capture_output=True,
+        text=True, timeout=60,
+    )
+    assert out.returncode == 0, f"child failed: {out.stderr}"
+    return out.stdout
+
+
+CHILD_PRELUDE = f"""
+import ctypes, os, sys
+lib = ctypes.CDLL(os.environ["VTPU_LIBRARY"])
+lib.vtpu_init_path.argtypes = [ctypes.c_char_p]
+lib.vtpu_try_alloc.argtypes = [ctypes.c_int, ctypes.c_uint64]
+lib.vtpu_get_used.argtypes = [ctypes.c_int]
+lib.vtpu_get_used.restype = ctypes.c_uint64
+lib.vtpu_get_limit.argtypes = [ctypes.c_int]
+lib.vtpu_get_limit.restype = ctypes.c_uint64
+assert lib.vtpu_init_path(None) == 0
+"""
+
+
+class TestRegionLifecycle:
+    def test_env_init_and_limits(self, tmp_path):
+        cache = str(tmp_path / "r.cache")
+        out = run_child(
+            CHILD_PRELUDE + """
+print(lib.vtpu_get_limit(0), lib.vtpu_get_limit(1), lib.vtpu_get_sm_limit(0))
+""",
+            {
+                "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+                "TPU_DEVICE_MEMORY_LIMIT_0": "3000",
+                "TPU_DEVICE_MEMORY_LIMIT_1": "1000",
+                "TPU_DEVICE_CORE_LIMIT": "30",
+                "TPU_VISIBLE_CHIPS": "chip-a,chip-b",
+            },
+        )
+        l0, l1, sm = out.split()
+        assert int(l0) == 3000 * 1024 * 1024
+        assert int(l1) == 1000 * 1024 * 1024
+        assert int(sm) == 30
+        assert os.path.exists(cache)
+
+    def test_oom_check_enforced(self, tmp_path):
+        cache = str(tmp_path / "r.cache")
+        out = run_child(
+            CHILD_PRELUDE + """
+MIB = 1024*1024
+print(lib.vtpu_try_alloc(0, 50*MIB))   # fits
+print(lib.vtpu_try_alloc(0, 60*MIB))   # would exceed 100 MiB cap
+print(lib.vtpu_try_alloc(0, 50*MIB))   # exactly fills
+print(lib.vtpu_get_used(0)//MIB)
+lib.vtpu_free(0, 30*MIB)
+print(lib.vtpu_try_alloc(0, 20*MIB))   # fits again after free
+""",
+            {
+                "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+                "TPU_DEVICE_MEMORY_LIMIT_0": "100",
+            },
+        )
+        lines = out.split()
+        assert lines[0] == "0"
+        assert int(lines[1]) < 0  # -ENOMEM
+        assert lines[2] == "0"
+        assert lines[3] == "100"
+        assert lines[4] == "0"
+
+    def test_cross_process_accounting(self, tmp_path):
+        """Two real processes share one region: the second sees the first's
+        usage and is denied when the combined total would exceed the cap."""
+        cache = str(tmp_path / "r.cache")
+        env = {
+            "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+            "TPU_DEVICE_MEMORY_LIMIT_0": "100",
+        }
+        # Child A allocates 70 MiB and stays alive while child B runs.
+        code_a = CHILD_PRELUDE + """
+MIB = 1024*1024
+assert lib.vtpu_try_alloc(0, 70*MIB) == 0
+import pathlib, time
+pathlib.Path(os.environ["READY"]).write_text("go")
+t0 = time.time()
+while not os.path.exists(os.environ["DONE"]) and time.time() - t0 < 30:
+    time.sleep(0.05)
+"""
+        code_b = CHILD_PRELUDE + """
+MIB = 1024*1024
+print("used_seen", lib.vtpu_get_used(0)//MIB)
+print("alloc40", lib.vtpu_try_alloc(0, 40*MIB))
+print("alloc20", lib.vtpu_try_alloc(0, 20*MIB))
+"""
+        ready = str(tmp_path / "ready")
+        done = str(tmp_path / "done")
+        env_a = dict(os.environ, **env, READY=ready, DONE=done,
+                     VTPU_LIBRARY=LIB)
+        pa = subprocess.Popen([sys.executable, "-c", code_a], env=env_a)
+        try:
+            t0 = time.time()
+            while not os.path.exists(ready) and time.time() - t0 < 30:
+                time.sleep(0.05)
+            assert os.path.exists(ready), "child A never became ready"
+            out = run_child(code_b, env)
+            assert "used_seen 70" in out
+            # 70 + 40 > 100 → denied; 70 + 20 ≤ 100 → ok.
+            assert [l for l in out.splitlines() if l.startswith("alloc40")][0].endswith(str(-12))  # noqa: E501
+            assert "alloc20 0" in out
+        finally:
+            open(done, "w").close()
+            pa.wait(timeout=30)
+
+    def test_slot_released_on_shutdown(self, tmp_path):
+        cache = str(tmp_path / "r.cache")
+        env = {"TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+               "TPU_DEVICE_MEMORY_LIMIT_0": "100"}
+        run_child(
+            CHILD_PRELUDE + """
+MIB = 1024*1024
+assert lib.vtpu_try_alloc(0, 70*MIB) == 0
+lib.vtpu_shutdown()
+""",
+            env,
+        )
+        # Clean shutdown must free the slot AND its usage.
+        out = run_child(CHILD_PRELUDE + """
+print("used", lib.vtpu_get_used(0)//(1024*1024))
+print("procs", lib.vtpu_proc_count())
+""", env)
+        assert "used 0" in out
+        assert "procs 1" in out
+
+
+class TestRateLimiter:
+    def test_uncapped_never_sleeps(self, tmp_path):
+        cache = str(tmp_path / "r.cache")
+        out = run_child(
+            CHILD_PRELUDE + """
+import time
+lib.vtpu_rate_acquire.argtypes = [ctypes.c_int, ctypes.c_uint64]
+t0 = time.monotonic()
+for _ in range(100):
+    lib.vtpu_rate_acquire(0, 10000)
+print("elapsed_ms", int((time.monotonic()-t0)*1000))
+""",
+            {"TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+             "TPU_DEVICE_MEMORY_LIMIT_0": "100"},  # no core limit
+        )
+        assert int(out.split()[-1]) < 200
+
+    def test_low_priority_throttled_under_contention(self, tmp_path):
+        """sm_limit=20, low priority, switch forced on → 100 dispatches of
+        10ms device-time cost must take ≥ 5x the device time."""
+        cache = str(tmp_path / "r.cache")
+        out = run_child(
+            CHILD_PRELUDE + """
+import time
+lib.vtpu_rate_acquire.argtypes = [ctypes.c_int, ctypes.c_uint64]
+lib.vtpu_region.restype = ctypes.c_void_p
+# flip utilization_switch via the reader API on our own region
+lib.vtpu_r_set_switch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+lib.vtpu_r_set_switch(lib.vtpu_region(), 1)
+t0 = time.monotonic()
+total_cost_us = 0
+for _ in range(40):
+    lib.vtpu_rate_acquire(0, 10000)  # 10ms device-time per dispatch
+    total_cost_us += 10000
+wall_us = (time.monotonic()-t0)*1e6
+print("ratio", wall_us / total_cost_us)
+""",
+            {
+                "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+                "TPU_DEVICE_MEMORY_LIMIT_0": "100",
+                "TPU_DEVICE_CORE_LIMIT": "20",
+                "TPU_TASK_PRIORITY": "1",
+            },
+        )
+        ratio = float(out.split()[-1])
+        # 20% duty cycle ⇒ wall ≈ 5x device time (allow startup burst credit).
+        assert ratio > 2.5, f"throttle too weak: {ratio}"
+
+    def test_high_priority_never_throttled(self, tmp_path):
+        cache = str(tmp_path / "r.cache")
+        out = run_child(
+            CHILD_PRELUDE + """
+import time
+lib.vtpu_rate_acquire.argtypes = [ctypes.c_int, ctypes.c_uint64]
+lib.vtpu_region.restype = ctypes.c_void_p
+lib.vtpu_r_set_switch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+lib.vtpu_r_set_switch(lib.vtpu_region(), 1)
+t0 = time.monotonic()
+for _ in range(40):
+    lib.vtpu_rate_acquire(0, 10000)
+print("elapsed_ms", int((time.monotonic()-t0)*1000))
+""",
+            {
+                "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+                "TPU_DEVICE_MEMORY_LIMIT_0": "100",
+                "TPU_DEVICE_CORE_LIMIT": "20",
+                "TPU_TASK_PRIORITY": "0",  # high priority
+            },
+        )
+        assert int(out.split()[-1]) < 200
+
+
+class TestReaderAPI:
+    def test_monitor_reads_live_region(self, tmp_path):
+        """A 'monitor' process opens the region written by a 'workload'
+        process and reads limits/usage/uuids without the writer's help."""
+        cache = str(tmp_path / "r.cache")
+        env = {
+            "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+            "TPU_DEVICE_MEMORY_LIMIT_0": "200",
+            "TPU_DEVICE_CORE_LIMIT": "50",
+            "TPU_VISIBLE_CHIPS": "chipX,chipY",
+        }
+        run_child(CHILD_PRELUDE + """
+assert lib.vtpu_try_alloc(0, 150*1024*1024) == 0
+lib.vtpu_set_used.argtypes = [ctypes.c_int, ctypes.c_uint64]
+""", env)
+        # Reader side: no env, explicit open (like the host-side monitor).
+        lib = ctypes.CDLL(LIB)
+        lib.vtpu_open_region.argtypes = [ctypes.c_char_p]
+        lib.vtpu_open_region.restype = ctypes.c_void_p
+        lib.vtpu_r_limit.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_limit.restype = ctypes.c_uint64
+        lib.vtpu_r_sm_limit.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_sm_limit.restype = ctypes.c_uint64
+        lib.vtpu_r_uuid.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_uuid.restype = ctypes.c_char_p
+        lib.vtpu_r_num_devices.argtypes = [ctypes.c_void_p]
+        h = lib.vtpu_open_region(cache.encode())
+        assert h
+        assert lib.vtpu_r_num_devices(h) == 2
+        assert lib.vtpu_r_limit(h, 0) == 200 * 1024 * 1024
+        assert lib.vtpu_r_sm_limit(h, 0) == 50
+        assert lib.vtpu_r_uuid(h, 0) == b"chipX"
+        assert lib.vtpu_r_uuid(h, 1) == b"chipY"
+        lib.vtpu_close_region(h)
+
+    def test_gc_clears_dead_slots(self, tmp_path):
+        cache = str(tmp_path / "r.cache")
+        env = {"TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+               "TPU_DEVICE_MEMORY_LIMIT_0": "100"}
+        # Workload allocates then dies WITHOUT shutdown (kill -9 semantics:
+        # subprocess exits, destructor may run — so simulate hard crash by
+        # _exit).
+        run_child(CHILD_PRELUDE + """
+assert lib.vtpu_try_alloc(0, 70*1024*1024) == 0
+os._exit(0)  # no destructor: slot leaks like a SIGKILLed process
+""", env)
+        lib = ctypes.CDLL(LIB)
+        lib.vtpu_open_region.argtypes = [ctypes.c_char_p]
+        lib.vtpu_open_region.restype = ctypes.c_void_p
+        lib.vtpu_r_used.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_used.restype = ctypes.c_uint64
+        lib.vtpu_r_gc.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        h = lib.vtpu_open_region(cache.encode())
+        assert lib.vtpu_r_used(h, 0) == 70 * 1024 * 1024  # leaked
+        live = (ctypes.c_int32 * 1)(0)  # no live pids
+        cleared = lib.vtpu_r_gc(h, live, 0)
+        assert cleared == 1
+        assert lib.vtpu_r_used(h, 0) == 0
+        lib.vtpu_close_region(h)
+
+
+class TestPythonShim:
+    def test_install_and_memory_info(self, tmp_path, monkeypatch):
+        cache = str(tmp_path / "r.cache")
+        out = run_child(
+            """
+import os, sys
+sys.path.insert(0, os.environ["REPO"])
+from k8s_vgpu_scheduler_tpu.shim import core
+shim = core.install(jax_hooks=False, ballast=False, watchdog=False)
+info = shim.memory_info(0)
+print(info["total"] // (1024*1024), info["used"])
+shim.native.lib.vtpu_try_alloc(0, 10*1024*1024)
+print(shim.memory_info(0)["used"] // (1024*1024))
+""",
+            {
+                "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+                "TPU_DEVICE_MEMORY_LIMIT_0": "3000",
+                "REPO": REPO,
+            },
+        )
+        lines = out.split("\n")
+        assert lines[0] == "3000 0"
+        assert lines[1] == "10"
+
+    def test_jax_hook_gates_dispatch(self, tmp_path):
+        """jax.jit wrapping: functions still compute correctly on CPU and the
+        region sees dispatch activity (recent_kernel)."""
+        cache = str(tmp_path / "r.cache")
+        out = run_child(
+            """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.environ["REPO"])
+from k8s_vgpu_scheduler_tpu.shim import core
+shim = core.install(jax_hooks=True, ballast=False, watchdog=False)
+import jax, jax.numpy as jnp
+f = jax.jit(lambda x: (x * 2).sum())
+out = f(jnp.arange(1000.0))
+print("result", float(out))
+import ctypes
+shim.native.lib.vtpu_region.restype = ctypes.c_void_p
+shim.native.lib.vtpu_r_recent_kernel.argtypes = [ctypes.c_void_p]
+print("activity", shim.native.lib.vtpu_r_recent_kernel(shim.native.lib.vtpu_region()) > 0)
+print("haslower", hasattr(f, "lower"))
+""",
+            {
+                "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+                "TPU_DEVICE_MEMORY_LIMIT_0": "3000",
+                "REPO": REPO,
+            },
+        )
+        assert "result 999000.0" in out
+        assert "activity True" in out
+        assert "haslower True" in out
