@@ -1,0 +1,61 @@
+"""Node monitor entrypoint (sidecar in the device-plugin DaemonSet).
+
+Reference: cmd/vGPUmonitor/main.go — metrics goroutine + watchAndFeedback
+loop every 2 s over the hostPath-mounted container cache dirs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+
+from ..monitor.feedback import FeedbackLoop
+from ..monitor.metrics import start_metrics_server
+from ..tpulib import detect
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("vtpu-monitor")
+    p.add_argument("--container-root", default="/tmp/vtpu/containers")
+    p.add_argument("--metrics-port", type=int, default=9394)
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
+    p.add_argument("--no-backend", action="store_true",
+                   help="skip chip enumeration (metrics from regions only)")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    backend = None
+    if not args.no_backend:
+        try:
+            backend = detect()
+        except Exception:
+            logging.exception("chip backend unavailable; continuing without")
+    loop = FeedbackLoop(args.container_root)
+    start_metrics_server(loop, backend, args.node_name or os.uname().nodename,
+                         args.metrics_port)
+    logging.info("vtpu-monitor up: root=%s metrics=:%d",
+                 args.container_root, args.metrics_port)
+    try:
+        while True:
+            t0 = time.monotonic()
+            try:
+                loop.tick()
+            except Exception:
+                logging.exception("feedback tick failed")
+            time.sleep(max(0.1, args.interval - (time.monotonic() - t0)))
+    except KeyboardInterrupt:
+        loop.close()
+
+
+if __name__ == "__main__":
+    main()

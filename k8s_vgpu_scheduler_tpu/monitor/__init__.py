@@ -1,0 +1,4 @@
+from .feedback import FeedbackLoop
+from .reader import Region, RegionReader, scan_container_dirs
+
+__all__ = ["FeedbackLoop", "Region", "RegionReader", "scan_container_dirs"]

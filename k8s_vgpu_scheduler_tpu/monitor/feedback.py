@@ -1,0 +1,110 @@
+"""Priority feedback loop — the oversubscription mechanism.
+
+Reference: cmd/vGPUmonitor/feedback.go:161–248.  Every tick the monitor:
+
+1. rescans the container dirs and (re)opens regions;
+2. ages each region's ``recent_kernel`` activity counter (a process that
+   dispatched since the last tick reads >0 before aging);
+3. builds a per-chip census of which priorities are *active*;
+4. writes each region's ``utilization_switch``: ON iff a higher-priority
+   sharer is active on any chip this region holds — the in-container rate
+   limiter then confines low-priority processes to their core grant, and
+   lets them borrow idle compute otherwise (reference CheckPriority);
+5. GCs proc slots whose pid is gone (SIGKILLed workloads leak slots — the
+   reference recovers these via shared-region status flags).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Dict, List, Optional, Set
+
+from .reader import Region, RegionReader, scan_container_dirs
+
+log = logging.getLogger(__name__)
+
+HIGH_PRIORITY = 0
+
+
+@dataclasses.dataclass
+class ContainerState:
+    key: str  # "<podUID>_<podName>"
+    region: Region
+    active: bool = False
+
+
+class FeedbackLoop:
+    def __init__(self, container_root: str,
+                 reader: Optional[RegionReader] = None) -> None:
+        self.container_root = container_root
+        self.reader = reader or RegionReader()
+        self.containers: Dict[str, ContainerState] = {}
+
+    # -- region lifecycle -----------------------------------------------------
+    def rescan(self) -> None:
+        found = scan_container_dirs(self.container_root)
+        for key, path in found.items():
+            cur = self.containers.get(key)
+            if cur is not None and cur.region.path == path:
+                continue
+            region = self.reader.open(path)
+            if region is None:
+                continue  # not initialized yet
+            if cur is not None:
+                cur.region.close()
+            self.containers[key] = ContainerState(key=key, region=region)
+        for key in list(self.containers):
+            if key not in found:
+                self.containers.pop(key).region.close()
+
+    # -- one Observe tick -----------------------------------------------------
+    def observe(self) -> None:
+        # Activity census: chip uuid → set of priorities with recent dispatch.
+        active_by_chip: Dict[str, Set[int]] = {}
+        for c in self.containers.values():
+            c.active = c.region.age_kernel() > 0
+            if not c.active:
+                continue
+            prio = c.region.priority
+            for uuid in c.region.uuids():
+                if uuid:
+                    active_by_chip.setdefault(uuid, set()).add(prio)
+
+        for c in self.containers.values():
+            prio = c.region.priority
+            want_on = False
+            for uuid in c.region.uuids():
+                others = active_by_chip.get(uuid, set())
+                if any(p < prio for p in others):
+                    want_on = True  # a higher-priority sharer is active
+                    break
+            if bool(c.region.utilization_switch) != want_on:
+                log.info("container %s: utilization_switch -> %s", c.key, want_on)
+                c.region.set_switch(want_on)
+
+    def gc_dead_procs(self, pid_alive=None) -> int:
+        """Clear slots of dead processes.  ``pid_alive(pid)->bool`` is
+        injectable for tests; default probes /proc (works when the monitor
+        shares the host PID namespace, as the DaemonSet runs with
+        hostPID: true — the reference maps pids via cgroup files instead)."""
+        if pid_alive is None:
+            pid_alive = lambda pid: os.path.exists(f"/proc/{pid}")  # noqa: E731
+        cleared = 0
+        for c in self.containers.values():
+            pids = c.region.proc_pids()
+            live = [p for p in pids if pid_alive(p)]
+            if len(live) != len(pids):
+                cleared += c.region.gc(live)
+        return cleared
+
+    def tick(self) -> None:
+        self.rescan()
+        self.observe()
+        self.gc_dead_procs()
+
+    def close(self) -> None:
+        for c in self.containers.values():
+            c.region.close()
+        self.containers.clear()

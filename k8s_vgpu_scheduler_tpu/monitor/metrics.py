@@ -1,0 +1,85 @@
+"""Node-level Prometheus metrics.
+
+Reference: cmd/vGPUmonitor/metrics.go:62–271 served on :9394 — host chip
+capacity/utilization plus ACTUAL per-container usage read out of the shared
+regions (vs the scheduler's :9395 which reports *granted* amounts).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Optional
+
+from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.registry import Collector
+
+from ..tpulib.backend import Backend
+from .feedback import FeedbackLoop
+
+log = logging.getLogger(__name__)
+
+
+class NodeCollector(Collector):
+    def __init__(self, loop: FeedbackLoop, backend: Optional[Backend] = None,
+                 node_name: str = "") -> None:
+        self.loop = loop
+        self.backend = backend
+        self.node_name = node_name
+
+    def collect(self) -> Iterable[GaugeMetricFamily]:
+        host_mem = GaugeMetricFamily(
+            "host_tpu_memory_total_mib", "Physical HBM per chip",
+            labels=["node", "deviceuuid"],
+        )
+        if self.backend is not None:
+            try:
+                for chip in self.backend.inventory().chips:
+                    host_mem.add_metric([self.node_name, chip.uuid], chip.hbm_mib)
+            except Exception:
+                log.exception("host inventory scrape failed")
+
+        c_usage = GaugeMetricFamily(
+            "vtpu_device_memory_usage_bytes",
+            "Actual HBM use of one container on one chip (from shared region)",
+            labels=["container", "deviceuuid"],
+        )
+        c_limit = GaugeMetricFamily(
+            "vtpu_device_memory_limit_bytes",
+            "HBM cap of one container on one chip",
+            labels=["container", "deviceuuid"],
+        )
+        c_sm = GaugeMetricFamily(
+            "vtpu_device_core_limit_percent",
+            "Compute cap of one container on one chip",
+            labels=["container", "deviceuuid"],
+        )
+        c_switch = GaugeMetricFamily(
+            "vtpu_utilization_switch",
+            "1 when the priority throttle is engaged for this container",
+            labels=["container"],
+        )
+        c_procs = GaugeMetricFamily(
+            "vtpu_container_processes",
+            "TPU processes registered in this container's region",
+            labels=["container"],
+        )
+        for c in self.loop.containers.values():
+            r = c.region
+            for i in range(r.num_devices):
+                uuid = r.uuid(i) or str(i)
+                c_usage.add_metric([c.key, uuid], r.used(i))
+                c_limit.add_metric([c.key, uuid], r.limit(i))
+                c_sm.add_metric([c.key, uuid], r.sm_limit(i))
+            c_switch.add_metric([c.key], r.utilization_switch)
+            c_procs.add_metric([c.key], len(r.proc_pids()))
+
+        return [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs]
+
+
+def start_metrics_server(loop: FeedbackLoop, backend: Optional[Backend],
+                         node_name: str, port: int = 9394):
+    from prometheus_client import CollectorRegistry, start_http_server
+
+    registry = CollectorRegistry()
+    registry.register(NodeCollector(loop, backend, node_name))
+    return start_http_server(port, registry=registry)

@@ -1,0 +1,141 @@
+"""Host-side region access for the monitor.
+
+Wraps libvtpu's opaque-handle reader API (lib/tpu/src/reader.cc) — the
+counterpart of the reference monitor's mmap of each container's cache file
+(cmd/vGPUmonitor/cudevshr.go:134–158).  Keeping the ABI inside the C library
+means Python never mirrors the struct layout.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional
+
+from ..shim.core import _find_library
+
+
+class Region:
+    """One container's live shared region."""
+
+    def __init__(self, lib, handle, path: str) -> None:
+        self._lib = lib
+        self._h = handle
+        self.path = path
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.vtpu_close_region(self._h)
+            self._h = None
+
+    @property
+    def num_devices(self) -> int:
+        return self._lib.vtpu_r_num_devices(self._h)
+
+    def uuid(self, dev: int) -> str:
+        return self._lib.vtpu_r_uuid(self._h, dev).decode()
+
+    def limit(self, dev: int) -> int:
+        return self._lib.vtpu_r_limit(self._h, dev)
+
+    def sm_limit(self, dev: int) -> int:
+        return self._lib.vtpu_r_sm_limit(self._h, dev)
+
+    def used(self, dev: int) -> int:
+        return self._lib.vtpu_r_used(self._h, dev)
+
+    @property
+    def priority(self) -> int:
+        return self._lib.vtpu_r_priority(self._h)
+
+    def age_kernel(self) -> int:
+        """Return activity counter before decrementing it (Observe tick)."""
+        return self._lib.vtpu_r_age_kernel(self._h)
+
+    @property
+    def utilization_switch(self) -> int:
+        return self._lib.vtpu_r_get_switch(self._h)
+
+    def set_switch(self, on: bool) -> None:
+        self._lib.vtpu_r_set_switch(self._h, 1 if on else 0)
+
+    def proc_pids(self) -> List[int]:
+        buf = (ctypes.c_int32 * 1024)()
+        n = self._lib.vtpu_r_proc_pids(self._h, buf, 1024)
+        return list(buf[:n])
+
+    def set_hostpid(self, pid: int, hostpid: int) -> None:
+        self._lib.vtpu_r_set_hostpid(self._h, pid, hostpid)
+
+    def gc(self, live_pids: List[int]) -> int:
+        arr = (ctypes.c_int32 * max(1, len(live_pids)))(*live_pids)
+        return self._lib.vtpu_r_gc(self._h, arr, len(live_pids))
+
+    def uuids(self) -> List[str]:
+        return [self.uuid(i) for i in range(self.num_devices)]
+
+
+class RegionReader:
+    def __init__(self, library_path: Optional[str] = None) -> None:
+        path = library_path or _find_library()
+        if path is None:
+            raise FileNotFoundError("libvtpu.so not found (set VTPU_LIBRARY)")
+        lib = ctypes.CDLL(path)
+        lib.vtpu_open_region.argtypes = [ctypes.c_char_p]
+        lib.vtpu_open_region.restype = ctypes.c_void_p
+        lib.vtpu_close_region.argtypes = [ctypes.c_void_p]
+        for fn, res in (
+            ("vtpu_r_num_devices", ctypes.c_int),
+            ("vtpu_r_priority", ctypes.c_int),
+            ("vtpu_r_recent_kernel", ctypes.c_int),
+            ("vtpu_r_age_kernel", ctypes.c_int),
+            ("vtpu_r_get_switch", ctypes.c_int),
+        ):
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+            getattr(lib, fn).restype = res
+        for fn in ("vtpu_r_limit", "vtpu_r_sm_limit", "vtpu_r_used"):
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int]
+            getattr(lib, fn).restype = ctypes.c_uint64
+        lib.vtpu_r_uuid.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_uuid.restype = ctypes.c_char_p
+        lib.vtpu_r_set_switch.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_proc_pids.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.vtpu_r_proc_pids.restype = ctypes.c_int
+        lib.vtpu_r_set_hostpid.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.vtpu_r_gc.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+        lib.vtpu_r_gc.restype = ctypes.c_int
+        lib.vtpu_r_generation.argtypes = [ctypes.c_void_p]
+        lib.vtpu_r_generation.restype = ctypes.c_uint64
+        self.lib = lib
+
+    def open(self, path: str) -> Optional[Region]:
+        h = self.lib.vtpu_open_region(path.encode())
+        return Region(self.lib, h, path) if h else None
+
+
+def scan_container_dirs(root: str) -> Dict[str, str]:
+    """Map container key ('<podUID>_<podName>') → region file path.
+
+    Reference monitorpath(): readdir /tmp/vgpu/containers/<podUID_ctr>/
+    (pathmonitor.go:56–87).
+    """
+    out: Dict[str, str] = {}
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return out
+    for entry in entries:
+        d = os.path.join(root, entry)
+        if not os.path.isdir(d):
+            continue
+        for f in os.listdir(d):
+            if f.endswith(".cache"):
+                out[entry] = os.path.join(d, f)
+                break
+    return out

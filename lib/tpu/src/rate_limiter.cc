@@ -55,8 +55,12 @@ void vtpu_rate_acquire(int dev, uint64_t cost_us) {
   if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return;
 
   uint64_t sm = r->sm_limit[dev];
-  // Mark activity for the monitor regardless of throttling.
-  __atomic_fetch_add(&r->recent_kernel, 1, __ATOMIC_RELAXED);
+  // Mark activity for the monitor regardless of throttling.  SET (not
+  // increment): the monitor ages this by 1 per tick, so a saturating flag
+  // means "active within the last ~3 ticks" — an unbounded counter would
+  // keep the priority throttle engaged for minutes after the workload went
+  // idle (the reference's set_recent_kernel has the same semantics).
+  __atomic_store_n(&r->recent_kernel, 3, __ATOMIC_RELAXED);
 
   if (sm == 0 || sm >= 100) return;  // uncapped
   // High-priority processes run free unless the monitor flipped the switch

@@ -1,0 +1,181 @@
+"""Monitor tests: feedback loop + metrics over regions written by real
+workload subprocesses through libvtpu (reference has no monitor tests)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "lib", "tpu", "build", "libvtpu.so")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def build_lib():
+    subprocess.run(["make", "-C", os.path.join(REPO, "lib", "tpu")],
+                   check=True, capture_output=True)
+
+
+class Workload:
+    """A real child process holding a region open, optionally dispatching."""
+
+    def __init__(self, tmp_path, key, chips, priority=0, cores=30, mem=1000):
+        self.dir = tmp_path / key
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.cache = str(self.dir / "vtpu.cache")
+        self.ready = str(self.dir / "ready")
+        self.done = str(self.dir / "done")
+        code = f"""
+import ctypes, os, time, pathlib
+lib = ctypes.CDLL({LIB!r})
+lib.vtpu_init_path.argtypes = [ctypes.c_char_p]
+lib.vtpu_rate_acquire.argtypes = [ctypes.c_int, ctypes.c_uint64]
+assert lib.vtpu_init_path(None) == 0
+assert lib.vtpu_try_alloc(0, 100*1024*1024) == 0
+pathlib.Path({self.ready!r}).write_text("go")
+t0 = time.time()
+while not os.path.exists({self.done!r}) and time.time() - t0 < 60:
+    if os.path.exists({self.ready!r} + ".dispatch"):
+        lib.vtpu_rate_acquire(0, 0)   # bumps recent_kernel
+    time.sleep(0.02)
+"""
+        env = dict(
+            os.environ,
+            TPU_DEVICE_MEMORY_SHARED_CACHE=self.cache,
+            TPU_DEVICE_MEMORY_LIMIT_0=str(mem),
+            TPU_DEVICE_CORE_LIMIT=str(cores),
+            TPU_VISIBLE_CHIPS=",".join(chips),
+            TPU_TASK_PRIORITY=str(priority),
+        )
+        self.proc = subprocess.Popen([sys.executable, "-c", code], env=env)
+        t0 = time.time()
+        while not os.path.exists(self.ready) and time.time() - t0 < 30:
+            time.sleep(0.02)
+        assert os.path.exists(self.ready), "workload never became ready"
+
+    def start_dispatching(self):
+        open(self.ready + ".dispatch", "w").close()
+
+    def stop_dispatching(self):
+        try:
+            os.unlink(self.ready + ".dispatch")
+        except OSError:
+            pass
+
+    def stop(self):
+        open(self.done, "w").close()
+        self.proc.wait(timeout=30)
+
+    def kill(self):
+        self.proc.kill()
+        self.proc.wait(timeout=30)
+
+
+@pytest.fixture
+def loop_env(tmp_path):
+    from k8s_vgpu_scheduler_tpu.monitor import FeedbackLoop
+
+    os.environ.setdefault("VTPU_LIBRARY", LIB)
+    loop = FeedbackLoop(str(tmp_path))
+    yield tmp_path, loop
+    loop.close()
+
+
+class TestFeedback:
+    def test_scan_discovers_containers(self, loop_env):
+        tmp_path, loop = loop_env
+        w1 = Workload(tmp_path, "uid1_podA", ["chip-0"])
+        w2 = Workload(tmp_path, "uid2_podB", ["chip-1"])
+        try:
+            loop.rescan()
+            assert set(loop.containers) == {"uid1_podA", "uid2_podB"}
+            assert loop.containers["uid1_podA"].region.uuid(0) == "chip-0"
+            assert loop.containers["uid1_podA"].region.used(0) == 100 * 1024 * 1024
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_priority_contention_flips_switch(self, loop_env):
+        """High-priority activity on a shared chip throttles the low-priority
+        sharer; idle high-priority releases it (feedback.go:178–219)."""
+        tmp_path, loop = loop_env
+        hi = Workload(tmp_path, "uid1_hi", ["chip-0"], priority=0)
+        lo = Workload(tmp_path, "uid2_lo", ["chip-0"], priority=1)
+        other = Workload(tmp_path, "uid3_other", ["chip-1"], priority=1)
+        try:
+            hi.start_dispatching()
+            lo.start_dispatching()
+            time.sleep(0.3)
+            loop.tick()
+            time.sleep(0.1)
+            loop.tick()  # census sees activity from the last interval
+            assert loop.containers["uid2_lo"].region.utilization_switch == 1
+            # High-priority itself is never switched on...
+            assert loop.containers["uid1_hi"].region.utilization_switch == 0
+            # ...nor a low-priority pod alone on another chip.
+            assert loop.containers["uid3_other"].region.utilization_switch == 0
+
+            # High-priority goes idle → aging drains its counter → release.
+            hi.stop_dispatching()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                loop.tick()
+                if loop.containers["uid2_lo"].region.utilization_switch == 0:
+                    break
+                time.sleep(0.05)
+            assert loop.containers["uid2_lo"].region.utilization_switch == 0
+        finally:
+            hi.stop()
+            lo.stop()
+            other.stop()
+
+    def test_gc_after_sigkill(self, loop_env):
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid1_crash", ["chip-0"])
+        loop.rescan()
+        assert loop.containers["uid1_crash"].region.used(0) > 0
+        w.kill()  # SIGKILL: no destructor, slot leaks
+        loop.tick()  # gc probes /proc and clears the dead slot
+        assert loop.containers["uid1_crash"].region.used(0) == 0
+
+    def test_vanished_container_dir_closes_region(self, loop_env):
+        import shutil
+
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid1_gone", ["chip-0"])
+        loop.rescan()
+        assert "uid1_gone" in loop.containers
+        w.stop()
+        shutil.rmtree(tmp_path / "uid1_gone")
+        loop.rescan()
+        assert "uid1_gone" not in loop.containers
+
+
+class TestNodeMetrics:
+    def test_metrics_expose_actual_usage(self, loop_env):
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from k8s_vgpu_scheduler_tpu.monitor.metrics import NodeCollector
+        from k8s_vgpu_scheduler_tpu.tpulib import MockBackend
+
+        tmp_path, loop = loop_env
+        backend = MockBackend({"generation": "v5e", "mesh": [2, 1],
+                               "hbm_mib": 16384})
+        w = Workload(tmp_path, "uid1_podA", ["TPU-v5e-mock-0"], cores=30,
+                     mem=1000)
+        try:
+            loop.rescan()
+            registry = CollectorRegistry()
+            registry.register(NodeCollector(loop, backend, "node-a"))
+            text = generate_latest(registry).decode()
+            assert ('vtpu_device_memory_usage_bytes{container="uid1_podA",'
+                    'deviceuuid="TPU-v5e-mock-0"} 1.048576e+08') in text
+            assert ('vtpu_device_memory_limit_bytes{container="uid1_podA",'
+                    'deviceuuid="TPU-v5e-mock-0"} 1.048576e+09') in text
+            assert ('host_tpu_memory_total_mib{deviceuuid="TPU-v5e-mock-0",'
+                    'node="node-a"} 16384.0') in text
+            assert 'vtpu_container_processes{container="uid1_podA"} 1.0' in text
+        finally:
+            w.stop()
