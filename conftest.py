@@ -6,10 +6,22 @@ against 8 virtual CPU devices.  Must run before the first ``import jax``.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the environment pins JAX_PLATFORMS=axon (real TPU)
+# globally and its sitecustomize imports jax at interpreter start, so by the
+# time this conftest runs the env var alone is too late — flip the live jax
+# config too.  The test suite is CPU-only by design; bench.py and the graft
+# entry run outside pytest and keep the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass

@@ -1,0 +1,28 @@
+"""LSTM sequence model — benchmark model 5.x (BASELINE.md tests 5.1/5.2:
+batch 100, sequence 1024, hidden 300).
+
+TPU note: recurrence is a ``flax.linen.RNN`` (lax.scan under jit — static
+trip count, no Python-loop unrolling), bf16 cell matmuls.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LSTMClassifier(nn.Module):
+    hidden: int = 300
+    num_classes: int = 2
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        """x: [batch, seq, features] float."""
+        dtype = jnp.dtype(self.dtype)
+        x = x.astype(dtype)
+        rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=dtype),
+                     name="lstm")
+        y = rnn(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(y[:, -1, :])

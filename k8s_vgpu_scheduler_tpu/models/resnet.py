@@ -1,0 +1,82 @@
+"""ResNet-V2 (pre-activation) in flax — benchmark models 1.x/2.x.
+
+The reference's headline numbers are ai-benchmark TF graphs (BASELINE.md
+tests 1.1–2.2: Resnet-V2-50 / Resnet-V2-152); this is the TPU-native
+equivalent: bfloat16 convs (MXU), NHWC layout, static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+
+
+def resnet_v2_50() -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3))
+
+
+def resnet_v2_152() -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 8, 36, 3))
+
+
+class PreActBottleneck(nn.Module):
+    features: int
+    strides: Tuple[int, int]
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        needs_proj = x.shape[-1] != self.features * 4 or self.strides != (1, 1)
+        y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn1")(x)
+        y = nn.relu(y)
+        shortcut = x
+        if needs_proj:
+            shortcut = nn.Conv(self.features * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(y)
+        y = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv1")(y)
+        y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn3")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="conv3")(y)
+        return shortcut + y
+
+
+class ResNetV2(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.cfg.dtype)
+        x = x.astype(dtype)
+        x = nn.Conv(self.cfg.width, (7, 7), (2, 2), use_bias=False,
+                    dtype=dtype, name="stem")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.cfg.stage_sizes):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = PreActBottleneck(
+                    self.cfg.width * (2 ** stage), strides, dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, train)
+        x = nn.GroupNorm(num_groups=32, dtype=dtype, name="final_gn")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.cfg.num_classes, dtype=jnp.float32,
+                        name="classifier")(x)

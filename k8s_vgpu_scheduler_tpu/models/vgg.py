@@ -1,0 +1,28 @@
+"""VGG-16 in flax — benchmark model 3.x (BASELINE.md tests 3.1/3.2)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_VGG16 = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        dtype = jnp.dtype(self.dtype)
+        x = x.astype(dtype)
+        for si, (feats, n) in enumerate(_VGG16):
+            for ci in range(n):
+                x = nn.Conv(feats, (3, 3), dtype=dtype,
+                            name=f"conv{si}_{ci}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=dtype, name="fc2")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc3")(x)

@@ -19,13 +19,12 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
 from typing import Dict, List, Optional, Set
 
 from .reader import Region, RegionReader, scan_container_dirs
 
 log = logging.getLogger(__name__)
-
-HIGH_PRIORITY = 0
 
 
 @dataclasses.dataclass
@@ -35,68 +34,146 @@ class ContainerState:
     active: bool = False
 
 
+def find_host_pid(region_path: str, container_pid: int,
+                  proc_root: str = "/proc") -> Optional[int]:
+    """Map a container-namespace pid (as stored in the region by the shim) to
+    a host pid: candidate host processes are those whose NSpid chain ends in
+    ``container_pid``; the match is confirmed by the process actually mapping
+    this region file (inode comparison via /proc/<pid>/map_files, falling
+    back to a path-substring check in /proc/<pid>/maps).
+
+    The reference solves the same problem by walking cgroup tasks files
+    (feedback.go:80–159); NSpid + map-inode is the namespace-correct host-side
+    equivalent.  When monitor and workload share a PID namespace (tests),
+    NSpid has one entry equal to the pid and the check degenerates correctly.
+    """
+    try:
+        target = os.stat(region_path)
+    except OSError:
+        return None
+    try:
+        entries = os.listdir(proc_root)
+    except OSError:
+        return None
+    base = os.path.basename(region_path)
+    for entry in entries:
+        if not entry.isdigit():
+            continue
+        try:
+            with open(os.path.join(proc_root, entry, "status")) as f:
+                nspid: List[int] = []
+                for line in f:
+                    if line.startswith("NSpid:"):
+                        nspid = [int(tok) for tok in line.split()[1:]]
+                        break
+        except (OSError, ValueError):
+            continue
+        if not nspid or nspid[-1] != container_pid:
+            continue
+        # Confirm via mapped-file inode (needs privilege; monitor DaemonSet
+        # runs privileged), else path substring in maps.
+        mf_dir = os.path.join(proc_root, entry, "map_files")
+        try:
+            for mf in os.listdir(mf_dir):
+                try:
+                    st = os.stat(os.path.join(mf_dir, mf))
+                except OSError:
+                    continue
+                if st.st_ino == target.st_ino and st.st_dev == target.st_dev:
+                    return int(entry)
+        except OSError:
+            pass
+        try:
+            with open(os.path.join(proc_root, entry, "maps")) as f:
+                if base in f.read():
+                    return int(entry)
+        except OSError:
+            continue
+    return None
+
+
 class FeedbackLoop:
     def __init__(self, container_root: str,
                  reader: Optional[RegionReader] = None) -> None:
         self.container_root = container_root
         self.reader = reader or RegionReader()
         self.containers: Dict[str, ContainerState] = {}
+        # Serializes the tick (main thread) against the Prometheus collector
+        # (HTTP server thread): rescan munmaps regions a concurrent scrape
+        # could otherwise be reading.
+        self.lock = threading.RLock()
 
     # -- region lifecycle -----------------------------------------------------
     def rescan(self) -> None:
         found = scan_container_dirs(self.container_root)
-        for key, path in found.items():
-            cur = self.containers.get(key)
-            if cur is not None and cur.region.path == path:
-                continue
-            region = self.reader.open(path)
-            if region is None:
-                continue  # not initialized yet
-            if cur is not None:
-                cur.region.close()
-            self.containers[key] = ContainerState(key=key, region=region)
-        for key in list(self.containers):
-            if key not in found:
-                self.containers.pop(key).region.close()
+        with self.lock:
+            for key, path in found.items():
+                cur = self.containers.get(key)
+                if cur is not None and cur.region.path == path:
+                    continue
+                region = self.reader.open(path)
+                if region is None:
+                    continue  # not initialized yet
+                if cur is not None:
+                    cur.region.close()
+                self.containers[key] = ContainerState(key=key, region=region)
+            for key in list(self.containers):
+                if key not in found:
+                    self.containers.pop(key).region.close()
 
     # -- one Observe tick -----------------------------------------------------
     def observe(self) -> None:
-        # Activity census: chip uuid → set of priorities with recent dispatch.
-        active_by_chip: Dict[str, Set[int]] = {}
-        for c in self.containers.values():
-            c.active = c.region.age_kernel() > 0
-            if not c.active:
-                continue
-            prio = c.region.priority
-            for uuid in c.region.uuids():
-                if uuid:
-                    active_by_chip.setdefault(uuid, set()).add(prio)
+        with self.lock:
+            # Activity census: chip uuid → set of priorities with recent
+            # dispatch (lower number = higher priority).
+            active_by_chip: Dict[str, Set[int]] = {}
+            for c in self.containers.values():
+                c.active = c.region.age_kernel() > 0
+                if not c.active:
+                    continue
+                prio = c.region.priority
+                for uuid in c.region.uuids():
+                    if uuid:
+                        active_by_chip.setdefault(uuid, set()).add(prio)
 
-        for c in self.containers.values():
-            prio = c.region.priority
-            want_on = False
-            for uuid in c.region.uuids():
-                others = active_by_chip.get(uuid, set())
-                if any(p < prio for p in others):
-                    want_on = True  # a higher-priority sharer is active
-                    break
-            if bool(c.region.utilization_switch) != want_on:
-                log.info("container %s: utilization_switch -> %s", c.key, want_on)
-                c.region.set_switch(want_on)
+            for c in self.containers.values():
+                prio = c.region.priority
+                want_on = False
+                for uuid in c.region.uuids():
+                    others = active_by_chip.get(uuid, set())
+                    if any(p < prio for p in others):
+                        want_on = True  # a higher-priority sharer is active
+                        break
+                if bool(c.region.utilization_switch) != want_on:
+                    log.info("container %s: utilization_switch -> %s",
+                             c.key, want_on)
+                    c.region.set_switch(want_on)
 
     def gc_dead_procs(self, pid_alive=None) -> int:
-        """Clear slots of dead processes.  ``pid_alive(pid)->bool`` is
-        injectable for tests; default probes /proc (works when the monitor
-        shares the host PID namespace, as the DaemonSet runs with
-        hostPID: true — the reference maps pids via cgroup files instead)."""
-        if pid_alive is None:
-            pid_alive = lambda pid: os.path.exists(f"/proc/{pid}")  # noqa: E731
+        """Clear slots of dead processes and record host pids of live ones.
+
+        Region slots hold container-namespace pids; liveness must be probed
+        through the NSpid mapping (see find_host_pid) — a bare
+        ``/proc/<pid>`` check on the host would confuse container pids with
+        unrelated host processes.  ``pid_alive(pid)->bool`` stays injectable
+        for tests."""
         cleared = 0
-        for c in self.containers.values():
-            pids = c.region.proc_pids()
-            live = [p for p in pids if pid_alive(p)]
-            if len(live) != len(pids):
-                cleared += c.region.gc(live)
+        with self.lock:
+            for c in self.containers.values():
+                pids = c.region.proc_pids()
+                live = []
+                for p in pids:
+                    if pid_alive is not None:
+                        ok = pid_alive(p)
+                    else:
+                        host = find_host_pid(c.region.path, p)
+                        ok = host is not None
+                        if ok and host != p:
+                            c.region.set_hostpid(p, host)
+                    if ok:
+                        live.append(p)
+                if len(live) != len(pids):
+                    cleared += c.region.gc(live)
         return cleared
 
     def tick(self) -> None:
@@ -105,6 +182,7 @@ class FeedbackLoop:
         self.gc_dead_procs()
 
     def close(self) -> None:
-        for c in self.containers.values():
-            c.region.close()
-        self.containers.clear()
+        with self.lock:
+            for c in self.containers.values():
+                c.region.close()
+            self.containers.clear()

@@ -63,15 +63,18 @@ class NodeCollector(Collector):
             "TPU processes registered in this container's region",
             labels=["container"],
         )
-        for c in self.loop.containers.values():
-            r = c.region
-            for i in range(r.num_devices):
-                uuid = r.uuid(i) or str(i)
-                c_usage.add_metric([c.key, uuid], r.used(i))
-                c_limit.add_metric([c.key, uuid], r.limit(i))
-                c_sm.add_metric([c.key, uuid], r.sm_limit(i))
-            c_switch.add_metric([c.key], r.utilization_switch)
-            c_procs.add_metric([c.key], len(r.proc_pids()))
+        # Under the loop lock: rescan() munmaps regions, and reading a closed
+        # handle from the scrape thread would crash the monitor.
+        with self.loop.lock:
+            for c in self.loop.containers.values():
+                r = c.region
+                for i in range(r.num_devices):
+                    uuid = r.uuid(i) or str(i)
+                    c_usage.add_metric([c.key, uuid], r.used(i))
+                    c_limit.add_metric([c.key, uuid], r.limit(i))
+                    c_sm.add_metric([c.key, uuid], r.sm_limit(i))
+                c_switch.add_metric([c.key], r.utilization_switch)
+                c_procs.add_metric([c.key], len(r.proc_pids()))
 
         return [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs]
 

@@ -132,9 +132,11 @@ def scan_container_dirs(root: str) -> Dict[str, str]:
         return out
     for entry in entries:
         d = os.path.join(root, entry)
-        if not os.path.isdir(d):
-            continue
-        for f in os.listdir(d):
+        try:
+            files = os.listdir(d)
+        except OSError:
+            continue  # dir vanished mid-scan (pod terminated) — next tick
+        for f in files:
             if f.endswith(".cache"):
                 out[entry] = os.path.join(d, f)
                 break

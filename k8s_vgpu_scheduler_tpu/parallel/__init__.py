@@ -1,0 +1,7 @@
+from .mesh import MeshShape, choose_mesh_shape, make_mesh, param_shardings
+from .ring import full_attention_reference, ring_attention
+
+__all__ = [
+    "MeshShape", "choose_mesh_shape", "make_mesh", "param_shardings",
+    "full_attention_reference", "ring_attention",
+]

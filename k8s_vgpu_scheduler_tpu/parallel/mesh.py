@@ -1,0 +1,116 @@
+"""Device-mesh + sharding helpers for the benchmark/validation models.
+
+The reference ships no model code (SURVEY.md §2.3) — its "parallelism" is
+multi-device allocation.  This package is the TPU-native counterpart the
+scheduler exists to serve: JAX models that actually consume fractional and
+multi-chip grants, sharded SPMD-style over a ``jax.sharding.Mesh`` so the
+scheduler's ICI-slice placement translates into real ICI collectives.
+
+Axes: ``dp`` (data), ``sp`` (sequence), ``tp`` (tensor).  Shardings are
+expressed as PartitionSpecs; XLA inserts the collectives (all-gather /
+reduce-scatter along ``sp``, psum along ``tp``) — the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshShape:
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.sp * self.tp
+
+
+def choose_mesh_shape(n_devices: int) -> MeshShape:
+    """Reasonable default factorization: prefer tp (fast ICI) up to 4, then
+    sp, then dp."""
+    tp = 1
+    for cand in (4, 2):
+        if n_devices % cand == 0:
+            tp = cand
+            break
+    rest = n_devices // tp
+    sp = 2 if rest % 2 == 0 and rest >= 2 else 1
+    dp = rest // sp
+    return MeshShape(dp=dp, sp=sp, tp=tp)
+
+
+def make_mesh(shape: Optional[MeshShape] = None,
+              devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    shape = shape or choose_mesh_shape(len(devices))
+    if shape.total != len(devices):
+        raise ValueError(f"mesh {shape} wants {shape.total} devices, "
+                         f"got {len(devices)}")
+    arr = np.asarray(devices).reshape(shape.dp, shape.sp, shape.tp)
+    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+
+
+# --- parameter sharding rules (megatron-style tp) ----------------------------
+# Matched against the flax param path (joined with '/').  First hit wins.
+PARAM_RULES: Tuple[Tuple[str, P], ...] = (
+    ("embed/embedding", P("tp", None)),       # vocab-sharded embedding
+    ("attn/q_proj/kernel", P(None, "tp")),
+    ("attn/k_proj/kernel", P(None, "tp")),
+    ("attn/v_proj/kernel", P(None, "tp")),
+    ("attn/o_proj/kernel", P("tp", None)),
+    ("mlp/gate_proj/kernel", P(None, "tp")),
+    ("mlp/up_proj/kernel", P(None, "tp")),
+    ("mlp/down_proj/kernel", P("tp", None)),
+    ("lm_head/kernel", P(None, "tp")),
+    ("norm", P(None)),  # all norm scales replicated
+)
+
+
+def param_spec(path: str) -> P:
+    for pattern, spec in PARAM_RULES:
+        if pattern in path:
+            return spec
+    return P()  # replicated
+
+
+def _normalize_path(kp) -> str:
+    """KeyPath → 'a/b/c' regardless of dict/sequence/attr entry types."""
+    parts = []
+    for entry in kp:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def param_shardings(mesh: Mesh, params):
+    """PyTree of NamedShardings matching ``params`` via PARAM_RULES (also
+    correct for optimizer states, whose subtrees mirror the param paths)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda kp, leaf: NamedSharding(mesh, param_spec(_normalize_path(kp))),
+        params,
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Tokens: batch over dp, sequence over sp."""
+    return NamedSharding(mesh, P("dp", "sp"))
+
+
+def activation_spec() -> P:
+    """[batch, seq, hidden] between blocks: sequence-parallel residual
+    stream (Megatron-SP); XLA all-gathers seq for attention and
+    reduce-scatters back."""
+    return P("dp", "sp", None)

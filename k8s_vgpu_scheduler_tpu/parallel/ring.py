@@ -1,0 +1,112 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+Blockwise-parallel attention over a 1D ring of devices (shard_map +
+``jax.lax.ppermute`` over the ``sp`` mesh axis): every device holds a
+sequence shard of Q/K/V; K/V blocks rotate around the ring while each device
+accumulates its queries' attention with a running log-sum-exp, so no device
+ever materializes the full sequence.  Collectives ride the ICI neighbor
+links (ppermute = neighbor exchange), which is exactly the communication
+pattern the scheduler's contiguous-slice placement guarantees is fast.
+
+This is the long-context path; the jit-native sequence parallelism in
+mesh.activation_spec() covers moderate lengths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, q_offset, kv_offset, causal, sm_scale):
+    """One (q-shard x kv-block) partial attention.
+
+    Returns (unnormalized_out, row_max, row_sumexp) in f32.
+    q: [B, Tq, H, D]  k/v: [B, Tk, H, D]
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        kpos = kv_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        mask = qpos >= kpos
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)  # [B,H,Tq]
+    # Guard fully-masked rows (exp(-inf - -inf)).
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(logits), p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,H,Tq]
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return out, m_safe, l
+
+
+def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool,
+                            sm_scale: float):
+    """Runs on one device inside shard_map; shapes are per-shard."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    tq = q.shape[1]
+
+    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m = jnp.full(q.shape[:1] + (q.shape[2], tq), -jnp.inf, jnp.float32)  # [B,H,Tq]
+    l = jnp.zeros_like(m)
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        kv_idx = (my_idx - i) % axis_size  # whose block we now hold
+        blk_o, blk_m, blk_l = _block_attn(
+            q, k_blk, v_blk,
+            q_offset=my_idx * tq,
+            kv_offset=kv_idx * tq,
+            causal=causal,
+            sm_scale=sm_scale,
+        )
+        new_m = jnp.maximum(m, blk_m)
+        alpha = jnp.exp(m - new_m)      # rescale old accumulator
+        beta = jnp.exp(blk_m - new_m)   # rescale new block
+        l_new = l * alpha + blk_l * beta
+        o_new = o * alpha.transpose(0, 2, 1)[..., None] + \
+            blk_o * beta.transpose(0, 2, 1)[..., None]
+        # Rotate K/V to the next device (neighbor exchange on the ring).
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o_new, new_m, l_new, k_nxt, v_nxt
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, axis_size, body, (o, m, l, k, v))
+    l = jnp.maximum(l, 1e-20)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
+                   causal: bool = True, sm_scale: Optional[float] = None):
+    """[B, T, H, D] inputs sharded over ``axis_name`` on T; same layout out."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        _ring_attention_sharded,
+        axis_name=axis_name, causal=causal, sm_scale=sm_scale,
+    )
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
+
+
+def full_attention_reference(q, k, v, causal: bool = True,
+                             sm_scale: Optional[float] = None):
+    """Unsharded baseline for parity tests."""
+    if sm_scale is None:
+        sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, m, l = _block_attn(q, k, v, 0, 0, causal, sm_scale)
+    l = jnp.maximum(l, 1e-20)
+    return (out / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

@@ -179,3 +179,46 @@ class TestNodeMetrics:
             assert 'vtpu_container_processes{container="uid1_podA"} 1.0' in text
         finally:
             w.stop()
+
+
+class TestHostPidMapping:
+    def test_find_host_pid_same_namespace(self, loop_env):
+        """In a shared PID namespace, find_host_pid returns the pid itself
+        (NSpid chain has one entry) via the map-inode confirmation."""
+        from k8s_vgpu_scheduler_tpu.monitor.feedback import find_host_pid
+
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid1_ns", ["chip-0"])
+        try:
+            loop.rescan()
+            region = loop.containers["uid1_ns"].region
+            pids = region.proc_pids()
+            assert pids
+            host = find_host_pid(region.path, pids[0])
+            assert host == pids[0]
+        finally:
+            w.stop()
+
+    def test_find_host_pid_rejects_wrong_pid(self, loop_env):
+        from k8s_vgpu_scheduler_tpu.monitor.feedback import find_host_pid
+
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid1_ns2", ["chip-0"])
+        try:
+            loop.rescan()
+            region = loop.containers["uid1_ns2"].region
+            # A pid that exists on the host but does not map this region
+            # (pid 1) must NOT be treated as this workload's process.
+            assert find_host_pid(region.path, 1) is None
+        finally:
+            w.stop()
+
+    def test_default_gc_uses_namespace_probe(self, loop_env):
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid1_nsgc", ["chip-0"])
+        loop.rescan()
+        assert loop.containers["uid1_nsgc"].region.used(0) > 0
+        w.kill()
+        # Default (no injected pid_alive): NSpid+map probe sees it dead.
+        loop.gc_dead_procs()
+        assert loop.containers["uid1_nsgc"].region.used(0) == 0
