@@ -208,7 +208,7 @@ class TpuDevicePlugin:
         resp.envs[ENV_VISIBLE_CHIPS] = ",".join(uuids)
         if indices:
             resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
-        if anns.get(OVERSUBSCRIBE_ANNOTATION, "") in ("true", "1", "on"):
+        if anns.get(OVERSUBSCRIBE_ANNOTATION, "") in ("true", "1"):
             resp.envs[ENV_OVERSUBSCRIBE] = "true"
 
         # Shared accounting region: hostPath dir per pod+container, a single

@@ -10,6 +10,7 @@ psum over dp) — nothing is hand-scheduled.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
@@ -19,6 +20,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import param_shardings
 from .llama import Llama, LlamaConfig
+
+log = logging.getLogger("vtpu.train")
 
 
 class TrainState(NamedTuple):
@@ -40,17 +43,93 @@ def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
     return -jnp.mean(ll)
 
 
-def make_train_step(model: Llama, optimizer):
+def make_train_step(model: Llama, optimizer, opt_shardings=None):
+    """``opt_shardings`` (a pytree of device-kind NamedShardings matching the
+    optimizer state) switches on oversubscription: the state arrives in
+    pinned host memory, is staged into HBM for the update, and the new state
+    is emitted back to host.  Memory space is part of the traced type in this
+    jax, so the moves are explicit device_puts — with full shardings so the
+    SPMD partitioner can place the transfer on every mesh device."""
+
+    def stage(tree, kind: str):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s.with_memory_kind(kind)),
+            tree, opt_shardings,
+        )
+
     def train_step(state: TrainState, tokens) -> Tuple[TrainState, jnp.ndarray]:
         loss, grads = jax.value_and_grad(
             lambda p: loss_fn(model, p, tokens)
         )(state.params)
-        updates, opt_state = optimizer.update(grads, state.opt_state,
-                                              state.params)
+        opt_state = state.opt_state
+        if opt_shardings is not None:
+            opt_state = stage(opt_state, "device")
+        updates, opt_state = optimizer.update(grads, opt_state, state.params)
+        if opt_shardings is not None:
+            opt_state = stage(opt_state, "pinned_host")
         params = optax.apply_updates(state.params, updates)
         return TrainState(params, opt_state, state.step + 1), loss
 
     return train_step
+
+
+class OffloadedTrainStep:
+    """Train step with host-resident optimizer state (oversubscription mode,
+    reference "virtual device memory").
+
+    Two mechanisms, tried in order:
+
+    - **in-jit** (preferred, TPU): optimizer state crosses the jit boundary
+      in pinned_host shardings and is staged through HBM inside the step —
+      XLA overlaps the PCIe transfers with compute.
+    - **staged** (fallback): the same jitted on-device step, with the
+      host<->HBM moves done by explicit ``jax.device_put`` around the call.
+      Needed where the SPMD partitioner rejects memory-space annotations on
+      partially-replicated values ("Side-effect ops cannot be replicated" —
+      current CPU backend); identical math and identical between-step HBM
+      footprint, just without transfer/compute overlap.
+
+    Either way the caller holds opt_state in host RAM between steps, which is
+    the point: co-resident pods see that HBM as free.
+    """
+
+    def __init__(self, injit_step, device_step, opt_shardings):
+        self._injit = injit_step
+        self._compiled = None
+        self._device = device_step
+        self._opt_shardings = opt_shardings
+        self.mode = None  # decided on first call, permanent after
+
+    def _decide_mode(self, state: TrainState, tokens) -> None:
+        # AOT lower+compile: surfaces the partitioner rejection WITHOUT
+        # executing, so no donated buffer is consumed before we know the
+        # mode.  Execution-time errors after a successful compile (real
+        # OOMs etc.) propagate to the caller — they must not silently
+        # switch mechanisms mid-training.
+        try:
+            self._compiled = self._injit.lower(state, tokens).compile()
+            self.mode = "in-jit"
+        except Exception:
+            log.info("in-jit opt-state offload not supported by this "
+                     "backend; using staged host swap")
+            self.mode = "staged"
+
+    def __call__(self, state: TrainState, tokens):
+        if self.mode is None:
+            self._decide_mode(state, tokens)
+        if self.mode == "in-jit":
+            return self._compiled(state, tokens)
+        opt_dev = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), state.opt_state,
+            self._opt_shardings,
+        )
+        new_state, loss = self._device(state._replace(opt_state=opt_dev),
+                                       tokens)
+        opt_host = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s.with_memory_kind("pinned_host")),
+            new_state.opt_state, self._opt_shardings,
+        )
+        return new_state._replace(opt_state=opt_host), loss
 
 
 def init_sharded_state(cfg: LlamaConfig, mesh: Mesh, rng,
@@ -66,17 +145,67 @@ def init_sharded_state(cfg: LlamaConfig, mesh: Mesh, rng,
     optimizer = make_optimizer()
     opt_state = optimizer.init(params)
     opt_state = jax.device_put(opt_state, param_shardings(mesh, opt_state))
-    state = TrainState(params=params, opt_state=opt_state,
-                       step=jnp.zeros((), jnp.int32))
+    step0 = jax.device_put(jnp.zeros((), jnp.int32),
+                           NamedSharding(mesh, P()))
+    state = TrainState(params=params, opt_state=opt_state, step=step0)
     return model, optimizer, state, shardings
 
 
-def jit_train_step(model: Llama, optimizer, mesh: Mesh, state: TrainState):
+def jit_train_step(model: Llama, optimizer, mesh: Mesh, state: TrainState,
+                   offload_opt_state: bool = False):
     """jit with explicit data sharding; state shardings are inherited from
-    the live state layout."""
-    step = make_train_step(model, optimizer)
+    the live state layout.
+
+    ``offload_opt_state=True`` is the oversubscription mode (reference
+    "virtual device memory", README.md:185–189): the optimizer state — 2x
+    params for adamw, the dominant non-activation HBM cost — lives in
+    pinned host RAM between steps.  XLA stages it through the update and
+    writes it back out, so peak HBM holds params + grads + activations
+    only; the state the caller passes must already be host-resident
+    (:func:`offload_state`)."""
     # Tokens shard over dp only (the +1-shifted length is rarely divisible by
     # sp); the sequence dimension becomes sp-sharded inside the model via the
     # residual-stream constraints.
     data_sharding = NamedSharding(mesh, P("dp", None))
-    return jax.jit(step, in_shardings=(None, data_sharding), donate_argnums=(0,))
+    if not offload_opt_state:
+        step = make_train_step(model, optimizer)
+        return jax.jit(step, in_shardings=(None, data_sharding),
+                       donate_argnums=(0,))
+    opt_shardings = jax.tree_util.tree_map(
+        lambda x: x.sharding.with_memory_kind("device"), state.opt_state
+    )
+    state_shardings = _state_shardings(state, host_opt=True)
+    injit = jax.jit(
+        make_train_step(model, optimizer, opt_shardings=opt_shardings),
+        in_shardings=(state_shardings, data_sharding),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,),
+    )
+    device_step = jax.jit(
+        make_train_step(model, optimizer),
+        in_shardings=(None, data_sharding),
+        donate_argnums=(0,),
+    )
+    return OffloadedTrainStep(injit, device_step, opt_shardings)
+
+
+def _state_shardings(state: TrainState, host_opt: bool) -> TrainState:
+    """Pytree of shardings mirroring ``state``; optionally the opt_state
+    half is moved to the pinned_host memory kind."""
+    shardings = jax.tree_util.tree_map(lambda x: x.sharding, state)
+    if not host_opt:
+        return shardings
+    return shardings._replace(
+        opt_state=jax.tree_util.tree_map(
+            lambda s: s.with_memory_kind("pinned_host"), shardings.opt_state
+        )
+    )
+
+
+def offload_state(state: TrainState) -> TrainState:
+    """Move the optimizer state to pinned host memory (HBM -> host RAM)."""
+    opt_host = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, x.sharding.with_memory_kind("pinned_host")),
+        state.opt_state,
+    )
+    return state._replace(opt_state=opt_host)

@@ -34,61 +34,79 @@ class ContainerState:
     active: bool = False
 
 
-def find_host_pid(region_path: str, container_pid: int,
-                  proc_root: str = "/proc") -> Optional[int]:
-    """Map a container-namespace pid (as stored in the region by the shim) to
-    a host pid: candidate host processes are those whose NSpid chain ends in
-    ``container_pid``; the match is confirmed by the process actually mapping
-    this region file (inode comparison via /proc/<pid>/map_files, falling
-    back to a path-substring check in /proc/<pid>/maps).
-
-    The reference solves the same problem by walking cgroup tasks files
-    (feedback.go:80–159); NSpid + map-inode is the namespace-correct host-side
-    equivalent.  When monitor and workload share a PID namespace (tests),
-    NSpid has one entry equal to the pid and the check degenerates correctly.
-    """
-    try:
-        target = os.stat(region_path)
-    except OSError:
-        return None
+def build_nspid_index(proc_root: str = "/proc") -> Dict[int, List[int]]:
+    """One walk over /proc: NSpid-tail (the pid as seen inside the innermost
+    namespace) → candidate host pids.  Built once per gc pass so resolving N
+    region pids costs one scan, not N (each confirmation below then touches
+    only the few candidates)."""
+    index: Dict[int, List[int]] = {}
     try:
         entries = os.listdir(proc_root)
     except OSError:
-        return None
-    base = os.path.basename(region_path)
+        return index
     for entry in entries:
         if not entry.isdigit():
             continue
         try:
             with open(os.path.join(proc_root, entry, "status")) as f:
-                nspid: List[int] = []
                 for line in f:
                     if line.startswith("NSpid:"):
-                        nspid = [int(tok) for tok in line.split()[1:]]
+                        tail = int(line.split()[-1])
+                        index.setdefault(tail, []).append(int(entry))
                         break
-        except (OSError, ValueError):
+        except (OSError, ValueError, IndexError):
             continue
-        if not nspid or nspid[-1] != container_pid:
-            continue
-        # Confirm via mapped-file inode (needs privilege; monitor DaemonSet
-        # runs privileged), else path substring in maps.
-        mf_dir = os.path.join(proc_root, entry, "map_files")
-        try:
-            for mf in os.listdir(mf_dir):
-                try:
-                    st = os.stat(os.path.join(mf_dir, mf))
-                except OSError:
-                    continue
-                if st.st_ino == target.st_ino and st.st_dev == target.st_dev:
-                    return int(entry)
-        except OSError:
-            pass
-        try:
-            with open(os.path.join(proc_root, entry, "maps")) as f:
-                if base in f.read():
-                    return int(entry)
-        except OSError:
-            continue
+    return index
+
+
+def _maps_region(region_path: str, host_pid: int,
+                 proc_root: str = "/proc") -> bool:
+    """Does host process ``host_pid`` actually mmap this region file?
+    Confirmed by mapped-file inode (/proc/<pid>/map_files — needs privilege;
+    the monitor DaemonSet runs privileged), else path substring in maps."""
+    try:
+        target = os.stat(region_path)
+    except OSError:
+        return False
+    mf_dir = os.path.join(proc_root, str(host_pid), "map_files")
+    try:
+        for mf in os.listdir(mf_dir):
+            try:
+                st = os.stat(os.path.join(mf_dir, mf))
+            except OSError:
+                continue
+            if st.st_ino == target.st_ino and st.st_dev == target.st_dev:
+                return True
+    except OSError:
+        pass
+    try:
+        with open(os.path.join(proc_root, str(host_pid), "maps")) as f:
+            return os.path.basename(region_path) in f.read()
+    except OSError:
+        return False
+
+
+def find_host_pid(region_path: str, container_pid: int,
+                  proc_root: str = "/proc",
+                  index: Optional[Dict[int, List[int]]] = None
+                  ) -> Optional[int]:
+    """Map a container-namespace pid (as stored in the region by the shim) to
+    a host pid: candidate host processes are those whose NSpid chain ends in
+    ``container_pid``; the match is confirmed by the process actually mapping
+    this region file.
+
+    The reference solves the same problem by walking cgroup tasks files
+    (feedback.go:80–159); NSpid + map-inode is the namespace-correct host-side
+    equivalent.  When monitor and workload share a PID namespace (tests),
+    NSpid has one entry equal to the pid and the check degenerates correctly.
+    Pass a prebuilt ``index`` (build_nspid_index) to amortize the /proc walk
+    over many lookups.
+    """
+    if index is None:
+        index = build_nspid_index(proc_root)
+    for host_pid in index.get(container_pid, []):
+        if _maps_region(region_path, host_pid, proc_root):
+            return host_pid
     return None
 
 
@@ -98,6 +116,8 @@ class FeedbackLoop:
         self.container_root = container_root
         self.reader = reader or RegionReader()
         self.containers: Dict[str, ContainerState] = {}
+        # (container key, container pid) -> confirmed host pid
+        self._hostpid_cache: Dict[tuple, int] = {}
         # Serializes the tick (main thread) against the Prometheus collector
         # (HTTP server thread): rescan munmaps regions a concurrent scrape
         # could otherwise be reading.
@@ -120,6 +140,9 @@ class FeedbackLoop:
             for key in list(self.containers):
                 if key not in found:
                     self.containers.pop(key).region.close()
+                    for ck in [ck for ck in self._hostpid_cache
+                               if ck[0] == key]:
+                        del self._hostpid_cache[ck]
 
     # -- one Observe tick -----------------------------------------------------
     def observe(self) -> None:
@@ -159,6 +182,7 @@ class FeedbackLoop:
         for tests."""
         cleared = 0
         with self.lock:
+            index = None if pid_alive is not None else build_nspid_index()
             for c in self.containers.values():
                 pids = c.region.proc_pids()
                 live = []
@@ -166,10 +190,22 @@ class FeedbackLoop:
                     if pid_alive is not None:
                         ok = pid_alive(p)
                     else:
-                        host = find_host_pid(c.region.path, p)
+                        # Cross-tick cache: a previously confirmed mapping
+                        # stays valid while that host pid still resolves to
+                        # this container pid in the index (one dict probe vs
+                        # re-reading map_files every tick).
+                        cached = self._hostpid_cache.get((c.key, p))
+                        if cached is not None and cached in index.get(p, []):
+                            live.append(p)
+                            continue
+                        host = find_host_pid(c.region.path, p, index=index)
                         ok = host is not None
-                        if ok and host != p:
-                            c.region.set_hostpid(p, host)
+                        if ok:
+                            self._hostpid_cache[(c.key, p)] = host
+                            if host != p:
+                                c.region.set_hostpid(p, host)
+                        else:
+                            self._hostpid_cache.pop((c.key, p), None)
                     if ok:
                         live.append(p)
                 if len(live) != len(pids):

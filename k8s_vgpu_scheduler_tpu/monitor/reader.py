@@ -45,6 +45,10 @@ class Region:
         return self._lib.vtpu_r_used(self._h, dev)
 
     @property
+    def oversubscribe(self) -> int:
+        return self._lib.vtpu_r_oversubscribe(self._h)
+
+    @property
     def priority(self) -> int:
         return self._lib.vtpu_r_priority(self._h)
 
@@ -90,6 +94,7 @@ class RegionReader:
             ("vtpu_r_recent_kernel", ctypes.c_int),
             ("vtpu_r_age_kernel", ctypes.c_int),
             ("vtpu_r_get_switch", ctypes.c_int),
+            ("vtpu_r_oversubscribe", ctypes.c_int),
         ):
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
             getattr(lib, fn).restype = res

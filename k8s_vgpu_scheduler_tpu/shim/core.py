@@ -276,8 +276,37 @@ class Shim:
         self._watchdog = threading.Thread(target=loop, daemon=True)
         self._watchdog.start()
 
+    # -- oversubscription (virtual device memory) ------------------------------
+    def start_pressure_spiller(self) -> Optional[Any]:
+        """Bring up HBM->host swap for oversubscribed grants (reference
+        CUDA_OVERSUBSCRIBE / suspend_all / resume_all; SURVEY.md N1).
+        Registered pytrees (shim.oversub.global_store()) are spilled LRU to
+        pinned host memory when bytes_in_use nears the physical ceiling."""
+        try:
+            # In the repo this is shim.oversub; in a deployed container both
+            # files sit top-level in /usr/local/vtpu as vtpu_shim.py +
+            # vtpu_oversub.py (lib/tpu/Makefile), so no package exists.
+            from . import oversub
+        except ImportError:
+            import vtpu_oversub as oversub  # type: ignore[no-redef]
+
+        physical = 0
+        try:
+            import jax
+
+            physical, _ = self._physical_stats(jax.local_devices()[0], 0)
+        except Exception:
+            pass
+        store = oversub.global_store()
+        self._spiller = oversub.PressureSpiller(store, physical)
+        self._spiller.start()
+        return self._spiller
+
     def stop(self) -> None:
         self._stop.set()
+        spiller = getattr(self, "_spiller", None)
+        if spiller is not None:
+            spiller.stop()
 
 
 _GLOBAL: Optional[Shim] = None
@@ -293,8 +322,17 @@ def install(region_path: Optional[str] = None, jax_hooks: bool = True,
     native = Native()
     native.init(region_path)
     shim = Shim(native)
+    # Same accepted values as the native parser (region.cc apply_env_limits);
+    # inlined rather than imported because this file ships standalone.
+    oversub = os.environ.get("TPU_OVERSUBSCRIBE", "") in ("true", "1")
     if ballast is None:
         ballast = os.environ.get("VTPU_BALLAST", "1") not in ("0", "false")
+    if oversub:
+        # The grant may legitimately exceed physical HBM (virtual device
+        # memory, reference CUDA_OVERSUBSCRIBE): a ballast sized from
+        # physical−limit would be negative/meaningless, and enforcement
+        # flips from "cap below physical" to "spill to host under pressure".
+        ballast = False
     if jax_hooks:
         shim.install_jax_hooks()
     if ballast:
@@ -302,6 +340,11 @@ def install(region_path: Optional[str] = None, jax_hooks: bool = True,
             shim.apply_ballast()
         except Exception:
             log.exception("ballast allocation failed; cap is advisory only")
+    if oversub:
+        try:
+            shim.start_pressure_spiller()
+        except Exception:
+            log.exception("oversubscription spiller unavailable")
     if watchdog:
         shim.start_watchdog()
     _GLOBAL = shim

@@ -1,0 +1,329 @@
+"""Virtual device memory: HBM oversubscription into host RAM.
+
+TPU-native rebuild of the reference's "virtual device memory" mode
+(``CUDA_OVERSUBSCRIBE``; binary symbols ``allocate_raw`` / ``handle_remap`` /
+``suspend_all`` / ``resume_all`` in lib/nvidia/libvgpu.so — SURVEY.md N1).
+The reference remaps CUDA allocations to host RAM when a pod's grant exceeds
+physical device memory, letting larger-batch jobs run at all — the source of
+the "+virtual device memory" wins in the benchmark table (README.md:185–189).
+
+There is no per-malloc hook at the PJRT/XLA layer (XLA plans its own
+allocations), so the TPU-native mechanism is *buffer-granular* swap built on
+JAX memory kinds: every tracked array can live either in ``device`` (HBM) or
+``pinned_host`` (host RAM, DMA-reachable over PCIe) memory, and moves between
+them with ``jax.device_put`` — which on TPU is a real HBM<->host transfer that
+does not touch the Python heap.  Three layers:
+
+- :class:`HostSwapStore` — registry of swappable arrays/pytrees with LRU
+  accounting; ``suspend``/``resume`` mirror the reference's suspend_all /
+  resume_all, ``spill_until`` evicts least-recently-used buffers until a
+  target number of HBM bytes is free.
+- :class:`PressureSpiller` — background watcher (monitor feedback-loop
+  analog) that spills automatically when the XLA client's ``bytes_in_use``
+  approaches the physical HBM ceiling.
+- :func:`offloaded_update` / :func:`host_sharding` — the *planned* form of
+  oversubscription: keep a model's optimizer state permanently in host RAM
+  inside a jitted train step (device_put with memory kinds under jit), so
+  peak HBM is params+activations only.  This is the idiomatic XLA answer to
+  "train a model bigger than the chip" and what bench's oversub case uses.
+
+jax is imported lazily; the module stays importable in containers without it
+(the store just refuses to register).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional
+
+log = logging.getLogger("vtpu.oversub")
+
+MIB = 1024 * 1024
+
+DEVICE_KIND = "device"
+HOST_KIND = "pinned_host"
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def supports_host_memory(device=None) -> bool:
+    """True when the backend exposes a pinned_host memory space."""
+    try:
+        jax = _jax()
+        device = device or jax.local_devices()[0]
+        return HOST_KIND in {m.kind for m in device.addressable_memories()}
+    except Exception:
+        return False
+
+
+def host_sharding(x_or_sharding):
+    """The same sharding moved to pinned host memory."""
+    sharding = getattr(x_or_sharding, "sharding", x_or_sharding)
+    return sharding.with_memory_kind(HOST_KIND)
+
+
+def device_sharding(x_or_sharding):
+    sharding = getattr(x_or_sharding, "sharding", x_or_sharding)
+    return sharding.with_memory_kind(DEVICE_KIND)
+
+
+def tree_bytes(tree) -> int:
+    jax = _jax()
+    return sum(
+        getattr(leaf, "nbytes", 0) for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+class _Entry:
+    __slots__ = ("name", "tree", "shardings", "nbytes", "on_device", "last_use")
+
+    def __init__(self, name: str, tree, shardings, nbytes: int):
+        self.name = name
+        self.tree = tree
+        self.shardings = shardings  # original (device-kind) shardings pytree
+        self.nbytes = nbytes
+        self.on_device = True
+        self.last_use = 0.0
+
+
+class HostSwapStore:
+    """Registry of arrays that may be transparently spilled to host RAM.
+
+    The reference tracks raw CUDA allocations in a handle table and remaps
+    them wholesale (suspend_all/resume_all around cuMemAlloc failures); here
+    the unit is a named pytree of jax Arrays.  Thread-safe.
+
+    CONTRACT: after ``register(name, tree)``, the caller must drop its own
+    references and access the data exclusively through ``get(name)``.  There
+    is no allocation intercept at the XLA layer, so a caller-held reference
+    to a registered Array keeps its HBM buffer alive — a spill would then
+    free nothing even though the store reports the bytes as moved.
+    """
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.RLock()
+        self._clock = 0.0
+
+    # -- registration ----------------------------------------------------------
+    def register(self, name: str, tree) -> None:
+        """Track ``tree`` (device-resident) as swappable under ``name``."""
+        jax = _jax()
+        with self._lock:
+            shardings = jax.tree_util.tree_map(
+                lambda leaf: device_sharding(leaf.sharding), tree
+            )
+            e = _Entry(name, tree, shardings, tree_bytes(tree))
+            e.last_use = self._tick()
+            self._entries[name] = e
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def _tick(self) -> float:
+        self._clock += 1.0
+        return self._clock
+
+    # -- swap primitives -------------------------------------------------------
+    def suspend(self, name: str) -> int:
+        """Move ``name`` to host RAM; returns bytes freed from HBM."""
+        jax = _jax()
+        with self._lock:
+            e = self._entries[name]
+            if not e.on_device:
+                return 0
+            e.tree = jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(leaf, host_sharding(leaf)), e.tree
+            )
+            jax.block_until_ready(e.tree)
+            e.on_device = False
+            log.info("oversub: suspended %s (%d MiB -> host)", name,
+                     e.nbytes // MIB)
+            return e.nbytes
+
+    def resume(self, name: str):
+        """Bring ``name`` back to HBM (spilling others if needed upstream);
+        returns the device-resident tree."""
+        jax = _jax()
+        with self._lock:
+            e = self._entries[name]
+            e.last_use = self._tick()
+            if e.on_device:
+                return e.tree
+            e.tree = jax.tree_util.tree_map(
+                jax.device_put, e.tree, e.shardings
+            )
+            jax.block_until_ready(e.tree)
+            e.on_device = True
+            log.info("oversub: resumed %s (%d MiB -> device)", name,
+                     e.nbytes // MIB)
+            return e.tree
+
+    def get(self, name: str):
+        """Access the tree, restoring to device if spilled (handle_remap)."""
+        return self.resume(name)
+
+    def suspend_all(self) -> int:
+        with self._lock:
+            return sum(self.suspend(n) for n in list(self._entries))
+
+    def resume_all(self) -> None:
+        with self._lock:
+            for n in list(self._entries):
+                self.resume(n)
+
+    # -- pressure-driven eviction ---------------------------------------------
+    def spill_until(self, bytes_needed: int) -> int:
+        """Evict least-recently-used device-resident entries until at least
+        ``bytes_needed`` HBM bytes have been freed (or nothing left)."""
+        freed = 0
+        with self._lock:
+            order = sorted(
+                (e for e in self._entries.values() if e.on_device),
+                key=lambda e: e.last_use,
+            )
+            for e in order:
+                if freed >= bytes_needed:
+                    break
+                freed += self.suspend(e.name)
+        return freed
+
+    # -- accounting ------------------------------------------------------------
+    def device_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values() if e.on_device)
+
+    def host_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                e.nbytes for e in self._entries.values() if not e.on_device
+            )
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "entries": len(self._entries),
+            "device_bytes": self.device_bytes(),
+            "host_bytes": self.host_bytes(),
+        }
+
+
+class PressureSpiller:
+    """Background HBM-pressure watcher.
+
+    The reference's libvgpu reacts to cuMemAlloc ENOMEM inline; XLA gives no
+    such hook, so we watch the client's ``bytes_in_use`` against the physical
+    ceiling and spill *before* XLA's allocator OOMs.  ``headroom_bytes`` is
+    the cushion kept free for XLA scratch/fragmentation.
+    """
+
+    def __init__(self, store: HostSwapStore, physical_bytes: int,
+                 headroom_bytes: int = 512 * MIB,
+                 interval: float = 0.5) -> None:
+        self.store = store
+        self.physical = physical_bytes
+        self.headroom = headroom_bytes
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self, in_use: Optional[int] = None) -> int:
+        """One pressure check; returns bytes spilled."""
+        if self.physical <= 0:
+            return 0
+        if in_use is None:
+            in_use = _client_bytes_in_use()
+        over = in_use + self.headroom - self.physical
+        if over > 0:
+            spilled = self.store.spill_until(over)
+            if spilled:
+                log.warning(
+                    "oversub: HBM pressure (%d MiB in use / %d MiB phys); "
+                    "spilled %d MiB to host", in_use // MIB,
+                    self.physical // MIB, spilled // MIB)
+            return spilled
+        return 0
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.check_once()
+                except Exception:
+                    log.exception("oversub pressure check failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _client_bytes_in_use(dev_index: int = 0) -> int:
+    try:
+        jax = _jax()
+        stats = jax.local_devices()[dev_index].memory_stats() or {}
+        return int(stats.get("bytes_in_use", 0))
+    except Exception:
+        return 0
+
+
+# -- planned oversubscription: host-resident optimizer state ------------------
+#
+# The biggest reference win ("vGPU + virtual device memory" column) is jobs
+# whose *working set* exceeds HBM.  The XLA-idiomatic equivalent keeps the
+# optimizer state (2x params for Adam) permanently in pinned host memory and
+# streams it through the update inside one jitted step: peak HBM holds params
+# + activations + one params-sized gradient only.
+
+def offload_tree(tree):
+    """Move a pytree to pinned host memory (outside jit)."""
+    jax = _jax()
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, host_sharding(leaf)), tree
+    )
+
+
+def fetch_tree(tree):
+    """Move a host-resident pytree back to device memory (outside jit)."""
+    jax = _jax()
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, device_sharding(leaf)), tree
+    )
+
+
+def host_shardings(tree):
+    """Pytree of each leaf's sharding moved to the pinned_host kind — feed
+    to ``jax.jit``'s in_shardings/out_shardings so a jitted step keeps that
+    argument host-resident across calls (XLA stages it through HBM during
+    the step and overlaps the transfers with compute).  This is how
+    ``models.train.jit_train_step(offload_opt_state=True)`` keeps optimizer
+    state out of HBM; transfers *inside* a traced function are not
+    expressible in this jax version, boundary shardings are."""
+    jax = _jax()
+    return jax.tree_util.tree_map(
+        lambda leaf: host_sharding(leaf.sharding), tree
+    )
+
+
+def enabled_from_env() -> bool:
+    # Accepted values must match the native parser exactly
+    # (lib/tpu/src/region.cc apply_env_limits), or the in-process shim and
+    # the region/monitor would disagree about whether a pod oversubscribes.
+    return os.environ.get("TPU_OVERSUBSCRIBE", "") in ("true", "1")
+
+
+_GLOBAL_STORE: Optional[HostSwapStore] = None
+
+
+def global_store() -> HostSwapStore:
+    global _GLOBAL_STORE
+    if _GLOBAL_STORE is None:
+        _GLOBAL_STORE = HostSwapStore()
+    return _GLOBAL_STORE

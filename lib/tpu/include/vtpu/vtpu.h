@@ -60,6 +60,7 @@ uint64_t vtpu_r_limit(vtpu_region_t* r, int dev);
 uint64_t vtpu_r_sm_limit(vtpu_region_t* r, int dev);
 uint64_t vtpu_r_used(vtpu_region_t* r, int dev);
 int vtpu_r_priority(vtpu_region_t* r);
+int vtpu_r_oversubscribe(vtpu_region_t* r);
 int vtpu_r_recent_kernel(vtpu_region_t* r);
 int vtpu_r_age_kernel(vtpu_region_t* r);
 int vtpu_r_get_switch(vtpu_region_t* r);

@@ -69,6 +69,8 @@ uint64_t vtpu_r_used(vtpu_region_t* r, int dev) {
 
 int vtpu_r_priority(vtpu_region_t* r) { return r ? r->priority : 0; }
 
+int vtpu_r_oversubscribe(vtpu_region_t* r) { return r ? r->oversubscribe : 0; }
+
 int vtpu_r_recent_kernel(vtpu_region_t* r) { return r ? r->recent_kernel : 0; }
 
 /* Age the activity counter toward zero; returns the value BEFORE aging
