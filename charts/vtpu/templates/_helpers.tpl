@@ -1,0 +1,50 @@
+{{/* Common naming helpers (reference charts/vgpu/templates/_helpers.tpl). */}}
+
+{{- define "vtpu.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "vtpu.fullname" -}}
+{{- if .Values.fullnameOverride -}}
+{{- .Values.fullnameOverride | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- $name := default .Chart.Name .Values.nameOverride -}}
+{{- if contains $name .Release.Name -}}
+{{- .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- printf "%s-%s" .Release.Name $name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+{{- end -}}
+{{- end -}}
+
+{{- define "vtpu.labels" -}}
+helm.sh/chart: {{ printf "%s-%s" .Chart.Name .Chart.Version | replace "+" "_" | trunc 63 | trimSuffix "-" }}
+app.kubernetes.io/name: {{ include "vtpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- with .Values.global.labels }}
+{{ toYaml . }}
+{{- end }}
+{{- end -}}
+
+{{- define "vtpu.scheduler" -}}
+{{- printf "%s-scheduler" (include "vtpu.fullname" .) -}}
+{{- end -}}
+
+{{- define "vtpu.device-plugin" -}}
+{{- printf "%s-device-plugin" (include "vtpu.fullname" .) -}}
+{{- end -}}
+
+{{- define "vtpu.scheduler.tls" -}}
+{{- printf "%s-scheduler-tls" (include "vtpu.fullname" .) -}}
+{{- end -}}
+
+{{/* Resource-name flags shared by scheduler and device plugin. */}}
+{{- define "vtpu.resourceFlags" -}}
+- --resource-name={{ .Values.resourceName }}
+- --resource-mem={{ .Values.resourceMem }}
+- --resource-mem-percentage={{ .Values.resourceMemPercentage }}
+- --resource-cores={{ .Values.resourceCores }}
+- --resource-priority={{ .Values.resourcePriority }}
+{{- end -}}

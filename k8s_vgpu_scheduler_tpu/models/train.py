@@ -111,7 +111,7 @@ class OffloadedTrainStep:
             self.mode = "in-jit"
         except Exception:
             log.info("in-jit opt-state offload not supported by this "
-                     "backend; using staged host swap")
+                     "backend; using staged host swap", exc_info=True)
             self.mode = "staged"
 
     def __call__(self, state: TrainState, tokens):

@@ -136,6 +136,12 @@ class FeedbackLoop:
                     continue  # not initialized yet
                 if cur is not None:
                     cur.region.close()
+                    # New region file under the same key (container restarted
+                    # in place): cached host-pid mappings are for the old
+                    # region's processes.
+                    for ck in [ck for ck in self._hostpid_cache
+                               if ck[0] == key]:
+                        del self._hostpid_cache[ck]
                 self.containers[key] = ContainerState(key=key, region=region)
             for key in list(self.containers):
                 if key not in found:
@@ -190,12 +196,16 @@ class FeedbackLoop:
                     if pid_alive is not None:
                         ok = pid_alive(p)
                     else:
-                        # Cross-tick cache: a previously confirmed mapping
-                        # stays valid while that host pid still resolves to
-                        # this container pid in the index (one dict probe vs
-                        # re-reading map_files every tick).
+                        # Cross-tick cache: re-confirm the cached host pid
+                        # directly (one map_files listdir for one process)
+                        # instead of walking /proc again.  The NSpid index
+                        # alone is NOT sufficient — a recycled host pid in
+                        # another container can share the NSpid tail — so
+                        # the region mapping is always re-checked.
                         cached = self._hostpid_cache.get((c.key, p))
-                        if cached is not None and cached in index.get(p, []):
+                        if (cached is not None
+                                and cached in index.get(p, [])
+                                and _maps_region(c.region.path, cached)):
                             live.append(p)
                             continue
                         host = find_host_pid(c.region.path, p, index=index)

@@ -63,6 +63,12 @@ class NodeCollector(Collector):
             "TPU processes registered in this container's region",
             labels=["container"],
         )
+        c_oversub = GaugeMetricFamily(
+            "vtpu_oversubscribe",
+            "1 when this container's grant may exceed physical HBM "
+            "(virtual device memory; spills to host RAM under pressure)",
+            labels=["container"],
+        )
         # Under the loop lock: rescan() munmaps regions, and reading a closed
         # handle from the scrape thread would crash the monitor.
         with self.loop.lock:
@@ -75,8 +81,10 @@ class NodeCollector(Collector):
                     c_sm.add_metric([c.key, uuid], r.sm_limit(i))
                 c_switch.add_metric([c.key], r.utilization_switch)
                 c_procs.add_metric([c.key], len(r.proc_pids()))
+                c_oversub.add_metric([c.key], r.oversubscribe)
 
-        return [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs]
+        return [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs,
+                c_oversub]
 
 
 def start_metrics_server(loop: FeedbackLoop, backend: Optional[Backend],

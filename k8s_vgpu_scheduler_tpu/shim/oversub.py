@@ -19,13 +19,12 @@ does not touch the Python heap.  Three layers:
   resume_all, ``spill_until`` evicts least-recently-used buffers until a
   target number of HBM bytes is free.
 - :class:`PressureSpiller` — background watcher (monitor feedback-loop
-  analog) that spills automatically when the XLA client's ``bytes_in_use``
+  analog) that spills automatically when any local chip's ``bytes_in_use``
   approaches the physical HBM ceiling.
-- :func:`offloaded_update` / :func:`host_sharding` — the *planned* form of
-  oversubscription: keep a model's optimizer state permanently in host RAM
-  inside a jitted train step (device_put with memory kinds under jit), so
-  peak HBM is params+activations only.  This is the idiomatic XLA answer to
-  "train a model bigger than the chip" and what bench's oversub case uses.
+- the *planned* form — optimizer state permanently host-resident inside a
+  jitted train step, so peak HBM is params+activations only — lives in
+  ``models.train`` (``offload_state`` / ``jit_train_step``); it is the
+  idiomatic XLA answer to "train a model bigger than the chip".
 
 jax is imported lazily; the module stays importable in containers without it
 (the store just refuses to register).
@@ -234,19 +233,27 @@ class PressureSpiller:
         self._thread: Optional[threading.Thread] = None
 
     def check_once(self, in_use: Optional[int] = None) -> int:
-        """One pressure check; returns bytes spilled."""
+        """One pressure check; returns bytes spilled.  Without an explicit
+        ``in_use`` sample, every local chip is checked and the worst
+        per-chip overshoot drives the spill (a multi-chip grant can OOM on
+        any of its chips)."""
         if self.physical <= 0:
             return 0
-        if in_use is None:
-            in_use = _client_bytes_in_use()
-        over = in_use + self.headroom - self.physical
+        if in_use is not None:
+            over = in_use + self.headroom - self.physical
+        else:
+            over = max(
+                (b + self.headroom - self.physical
+                 for b in _all_devices_bytes_in_use()),
+                default=0,
+            )
         if over > 0:
             spilled = self.store.spill_until(over)
             if spilled:
                 log.warning(
-                    "oversub: HBM pressure (%d MiB in use / %d MiB phys); "
-                    "spilled %d MiB to host", in_use // MIB,
-                    self.physical // MIB, spilled // MIB)
+                    "oversub: HBM pressure (worst chip %d MiB over); "
+                    "spilled %d MiB to host",
+                    over // MIB, spilled // MIB)
             return spilled
         return 0
 
@@ -265,51 +272,24 @@ class PressureSpiller:
         self._stop.set()
 
 
-def _client_bytes_in_use(dev_index: int = 0) -> int:
+def _all_devices_bytes_in_use() -> "list[int]":
     try:
         jax = _jax()
-        stats = jax.local_devices()[dev_index].memory_stats() or {}
-        return int(stats.get("bytes_in_use", 0))
+        out = []
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            out.append(int(stats.get("bytes_in_use", 0)))
+        return out
     except Exception:
-        return 0
+        return []
 
 
-# -- planned oversubscription: host-resident optimizer state ------------------
-#
-# The biggest reference win ("vGPU + virtual device memory" column) is jobs
-# whose *working set* exceeds HBM.  The XLA-idiomatic equivalent keeps the
-# optimizer state (2x params for Adam) permanently in pinned host memory and
-# streams it through the update inside one jitted step: peak HBM holds params
-# + activations + one params-sized gradient only.
-
-def offload_tree(tree):
-    """Move a pytree to pinned host memory (outside jit)."""
-    jax = _jax()
-    return jax.tree_util.tree_map(
-        lambda leaf: jax.device_put(leaf, host_sharding(leaf)), tree
-    )
-
-
-def fetch_tree(tree):
-    """Move a host-resident pytree back to device memory (outside jit)."""
-    jax = _jax()
-    return jax.tree_util.tree_map(
-        lambda leaf: jax.device_put(leaf, device_sharding(leaf)), tree
-    )
-
-
-def host_shardings(tree):
-    """Pytree of each leaf's sharding moved to the pinned_host kind — feed
-    to ``jax.jit``'s in_shardings/out_shardings so a jitted step keeps that
-    argument host-resident across calls (XLA stages it through HBM during
-    the step and overlaps the transfers with compute).  This is how
-    ``models.train.jit_train_step(offload_opt_state=True)`` keeps optimizer
-    state out of HBM; transfers *inside* a traced function are not
-    expressible in this jax version, boundary shardings are."""
-    jax = _jax()
-    return jax.tree_util.tree_map(
-        lambda leaf: host_sharding(leaf.sharding), tree
-    )
+# NOTE: *planned* oversubscription — keeping a training job's optimizer
+# state permanently in pinned host memory so peak HBM holds params +
+# activations only (the biggest reference win in the "+virtual device
+# memory" benchmark column) — lives in models.train: ``offload_state`` +
+# ``jit_train_step(offload_opt_state=True)``.  This module provides the
+# *reactive* mechanism (pressure-driven swap of registered working sets).
 
 
 def enabled_from_env() -> bool:

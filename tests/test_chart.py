@@ -1,0 +1,126 @@
+"""Packaging sanity: the Helm chart must stay in sync with the code.
+
+No helm binary exists in CI, so instead of rendering we check the invariants
+that actually rot: every CLI flag a template passes must exist in the
+corresponding argparse entrypoint, referenced helpers must be defined, and
+the values/Chart files must parse.  (The reference shipped a chart whose
+tests never ran — SURVEY.md §4; this is the cheap guard against that.)
+"""
+
+import os
+import re
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "charts", "vtpu")
+
+
+def read(path):
+    with open(path) as f:
+        return f.read()
+
+
+def template_files():
+    out = []
+    for root, _, files in os.walk(os.path.join(CHART, "templates")):
+        for f in files:
+            if f.endswith((".yaml", ".tpl", ".txt")):
+                out.append(os.path.join(root, f))
+    return out
+
+
+def argparse_flags(module_path):
+    src = read(os.path.join(REPO, module_path))
+    return set(re.findall(r"add_argument\(\s*\"(--[a-z-]+)\"", src))
+
+
+def template_flags(path, command_marker):
+    """--flag tokens passed in the container args of the template that
+    invokes ``command_marker`` (a python -m module name)."""
+    src = read(path)
+    if command_marker not in src:
+        return set()
+    flags = set()
+    block = src[src.index(command_marker):]
+    for line in block.splitlines():
+        m = re.search(r"-\s+(--[a-z-]+)", line)
+        if m:
+            flags.add(m.group(1))
+        if line.strip().startswith(("ports:", "env:", "volumeMounts:")):
+            break
+    return flags
+
+
+class TestChartParses:
+    def test_chart_yaml(self):
+        meta = yaml.safe_load(read(os.path.join(CHART, "Chart.yaml")))
+        assert meta["name"] == "vtpu"
+        assert meta["apiVersion"] == "v2"
+
+    def test_values_yaml(self):
+        vals = yaml.safe_load(read(os.path.join(CHART, "values.yaml")))
+        assert vals["resourceName"] == "google.com/tpu"
+        assert vals["devicePlugin"]["deviceSplitCount"] == 10
+        assert vals["schedulerName"] == "vtpu-scheduler"
+
+    def test_all_templates_exist(self):
+        names = {os.path.basename(p) for p in template_files()}
+        for expected in (
+            "_helpers.tpl", "NOTES.txt", "configmap.yaml",
+            "deployment.yaml", "service.yaml", "webhook.yaml",
+            "daemonset.yaml", "monitorservice.yaml", "rbac.yaml",
+            "job-createSecret.yaml", "job-patchWebhook.yaml",
+        ):
+            assert expected in names, f"missing template {expected}"
+
+
+class TestHelperReferences:
+    def test_every_included_helper_is_defined(self):
+        helpers = read(os.path.join(CHART, "templates", "_helpers.tpl"))
+        defined = set(re.findall(r'define\s+"([^"]+)"', helpers))
+        for path in template_files():
+            for name in re.findall(r'include\s+"([^"]+)"', read(path)):
+                assert name in defined, f"{path} includes undefined {name}"
+
+
+class TestFlagDrift:
+    """Template args must exist in the argparse CLIs (catches renames)."""
+
+    def test_scheduler_flags(self):
+        known = argparse_flags("k8s_vgpu_scheduler_tpu/cmd/scheduler.py")
+        path = os.path.join(CHART, "templates", "scheduler",
+                            "deployment.yaml")
+        used = template_flags(path, "k8s_vgpu_scheduler_tpu.cmd.scheduler")
+        assert used, "no flags parsed from scheduler deployment"
+        # resource flags come via the helper; include them
+        helpers = read(os.path.join(CHART, "templates", "_helpers.tpl"))
+        used |= set(re.findall(r"-\s+(--resource-[a-z-]+)", helpers))
+        unknown = {f for f in used if f not in known}
+        assert not unknown, f"template passes unknown scheduler flags: {unknown}"
+
+    def test_device_plugin_flags(self):
+        known = argparse_flags("k8s_vgpu_scheduler_tpu/cmd/device_plugin.py")
+        path = os.path.join(CHART, "templates", "device-plugin",
+                            "daemonset.yaml")
+        used = template_flags(path, "k8s_vgpu_scheduler_tpu.cmd.device_plugin")
+        assert used, "no flags parsed from device-plugin daemonset"
+        unknown = {f for f in used if f not in known}
+        assert not unknown, f"template passes unknown plugin flags: {unknown}"
+
+    def test_monitor_flags(self):
+        known = argparse_flags("k8s_vgpu_scheduler_tpu/cmd/monitor.py")
+        path = os.path.join(CHART, "templates", "device-plugin",
+                            "daemonset.yaml")
+        used = template_flags(path, "k8s_vgpu_scheduler_tpu.cmd.monitor")
+        assert used, "no flags parsed from monitor container"
+        unknown = {f for f in used if f not in known}
+        assert not unknown, f"template passes unknown monitor flags: {unknown}"
+
+
+class TestWorkflowRunsTests:
+    def test_ci_runs_pytest(self):
+        wf = read(os.path.join(REPO, ".github", "workflows", "main.yml"))
+        assert "pytest" in wf, "CI must run the tests (reference never did)"
