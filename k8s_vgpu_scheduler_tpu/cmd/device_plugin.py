@@ -15,6 +15,7 @@ import os
 import time
 
 from ..deviceplugin import DeviceCache, DeviceRegister, TpuDevicePlugin
+from ..deviceplugin.allocator import publish_unsatisfiable
 from ..k8s import make_client
 from ..tpulib import detect
 from ..util.config import Config
@@ -31,6 +32,8 @@ def parse_args(argv=None):
     p.add_argument("--device-memory-scaling", type=float, default=1.0)
     p.add_argument("--device-cores-scaling", type=float, default=1.0)
     p.add_argument("--disable-core-limit", action="store_true")
+    p.add_argument("--topology-policy", default="best-effort",
+                   choices=["best-effort", "restricted", "guaranteed"])
     p.add_argument("--socket-dir", default="/var/lib/kubelet/device-plugins")
     p.add_argument("--config-file", default="/config/config.json")
     p.add_argument("--shim-dir", default="/usr/local/vtpu")
@@ -79,6 +82,7 @@ def main(argv=None):
         device_memory_scaling=args.device_memory_scaling,
         device_cores_scaling=args.device_cores_scaling,
         disable_core_limit=args.disable_core_limit,
+        topology_policy=args.topology_policy,
         shim_host_dir=args.shim_dir,
         cache_host_dir=args.cache_dir,
     )
@@ -91,8 +95,17 @@ def main(argv=None):
                              socket_dir=args.socket_dir)
     register = DeviceRegister(backend, cfg)
 
-    cache.subscribe("plugin", lambda inv: plugin.notify_health_changed())
+    def on_health_change(inv):
+        plugin.notify_health_changed()
+        # Health changes alter which slice sizes remain placeable; keep the
+        # advisory unsatisfiable-sizes node annotation in sync
+        # (reference server.go:493–522).
+        publish_unsatisfiable(client, cfg.node_name, inv, cfg.topology_policy)
+
+    cache.subscribe("plugin", on_health_change)
     cache.subscribe("register", register.push_update)
+    publish_unsatisfiable(client, cfg.node_name, cache.inventory,
+                          cfg.topology_policy)
     cache.start()
     register.start()
     plugin.serve()

@@ -1,0 +1,171 @@
+"""Topology-aware preferred allocation — the kubelet-path placement engine.
+
+TPU-native counterpart of the reference's MLU topology allocators
+(pkg/device-plugin/mlu/allocator/{allocator,default,spider,board}.go) and the
+``GetPreferredAllocation`` server path (pkg/device-plugin/mlu/server.go:441–491).
+The reference shells out to a brute-force ring solver (cntopo) and carries one
+allocator per MLU model; on TPU the ICI fabric is a regular mesh/torus, so the
+whole family collapses into the closed-form slice search in topology/torus.py
+(SURVEY.md N4).
+
+Two placement paths exist in this framework, mirroring the reference:
+
+- the **extender path** (scheduler Filter picks physical chips, Allocate obeys
+  annotations) — used for fractional/managed requests;
+- this **kubelet path**: pods that request whole chips via the plain device-
+  plugin resource get topology-packed by kubelet's GetPreferredAllocation
+  call, without the extender in the loop.
+
+When the node's policy is ``restricted``/``guaranteed``, chip counts that
+cannot currently form a contiguous slice are published as a node annotation —
+the analog of the reference's "MLULink policy unsatisfiable" node annotation
+(server.go:493–522).  Like the reference's, it is an advisory signal for
+kubelet-path consumers (operators, autoscalers, external schedulers): the
+extender path doesn't need it because Filter re-runs the same slice search
+per node with live usage (scheduler/score.py fit_pod).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from ..topology import torus
+from ..tpulib.types import ChipInfo, Coord, NodeInventory
+from ..util.types import BEST_EFFORT, GUARANTEED, RESTRICTED
+
+log = logging.getLogger(__name__)
+
+# Node annotation listing chip counts this node could not place contiguously
+# under a restricted/guaranteed policy (reference server.go:493–522).
+UNSATISFIABLE_ANNOTATION = "vtpu.dev/ici-unsatisfiable-sizes"
+
+
+class SliceAllocator:
+    """Chooses virtual device IDs whose chips form an ICI slice.
+
+    Virtual IDs are ``<chip-uuid>-<k>`` (apiDevices fan-out); the allocator
+    packs a request onto as few chips as possible, with those chips forming a
+    contiguous axis-aligned slice whenever the policy or capacity allows.
+    """
+
+    def __init__(self, inventory: NodeInventory, policy: str = BEST_EFFORT):
+        self.inventory = inventory
+        self.policy = policy
+
+    # -- virtual-id plumbing ---------------------------------------------------
+    def _chips_by_vid(self, vids: Sequence[str]) -> Dict[str, List[str]]:
+        """uuid → its available virtual IDs (input order preserved)."""
+        by_chip: Dict[str, List[str]] = {}
+        for vid in vids:
+            uuid = vid.rsplit("-", 1)[0]
+            by_chip.setdefault(uuid, []).append(vid)
+        return by_chip
+
+    def preferred(
+        self,
+        available: Sequence[str],
+        must_include: Sequence[str],
+        size: int,
+    ) -> List[str]:
+        """Pick ``size`` IDs from ``available`` ⊇ ``must_include``.
+
+        Returns [] when no valid preference exists (kubelet then falls back
+        to its own selection), matching the reference's empty-response error
+        path (server.go:455–466).
+        """
+        if size <= 0:
+            return []
+        avail_by_chip = self._chips_by_vid(available)
+        must_by_chip = self._chips_by_vid(must_include)
+        if len(must_include) > size:
+            return []
+
+        coord_map = self.inventory.coord_map()
+        chip_by_uuid = {c.uuid: c for c in self.inventory.chips}
+
+        # Free = chips offering at least one available vid and healthy.  A
+        # chip present in `available` but locally unhealthy (health flipped
+        # since kubelet's last ListAndWatch sync) is excluded.
+        free_coords: Dict[Coord, ChipInfo] = {}
+        for uuid in avail_by_chip:
+            chip = chip_by_uuid.get(uuid)
+            if chip is not None and chip.healthy:
+                free_coords[chip.coords] = chip
+        must_coords = []
+        for uuid in must_by_chip:
+            chip = chip_by_uuid.get(uuid)
+            if chip is None or chip.coords not in free_coords:
+                return []  # must-include chip unknown/unhealthy: no preference
+            must_coords.append(chip.coords)
+
+        cap = {
+            c: len(avail_by_chip.get(chip.uuid, ()))
+            for c, chip in free_coords.items()
+        }
+        cells = torus.find_capacitated_slice(
+            self.inventory.topology, cap, size, must_coords, self.policy
+        )
+        if cells is None:
+            return []
+
+        # Fill round-robin across the chosen cells (must-include vids first):
+        # every cell contributes, so when the engine returned a box the
+        # chip-level grant IS that box — contiguous, as guaranteed demands.
+        chosen: List[str] = list(must_include)
+        taken = set(chosen)
+        queues = []
+        for coord in cells:
+            vids = [
+                v
+                for v in avail_by_chip.get(free_coords[coord].uuid, [])
+                if v not in taken
+            ]
+            if vids:
+                queues.append(vids)
+        while len(chosen) < size and queues:
+            next_round = []
+            for q in queues:
+                if len(chosen) >= size:
+                    break
+                chosen.append(q.pop(0))
+                if q:
+                    next_round.append(q)
+            queues = next_round
+        return chosen if len(chosen) >= size else []
+
+
+def unsatisfiable_sizes(inventory: NodeInventory, policy: str = GUARANTEED,
+                        max_size: Optional[int] = None) -> List[int]:
+    """Chip counts (1..num healthy chips) this node cannot currently place
+    under ``policy`` — published as an advisory node annotation for
+    kubelet-path consumers (reference server.go:493–522).  Restricted
+    tolerates counts that cannot form a box on this mesh even when empty
+    (they may scatter); guaranteed does not."""
+    topo = inventory.topology
+    healthy = [c.coords for c in inventory.healthy_chips()]
+    limit = max_size or len(healthy)
+    out = []
+    for n in range(1, limit + 1):
+        if torus.exists_slice(topo, healthy, n):
+            continue
+        if policy == RESTRICTED and not torus.factor_shapes(n, topo.mesh):
+            continue  # mesh-impossible count: restricted scatters it
+        out.append(n)
+    return out
+
+
+def publish_unsatisfiable(client, node_name: str, inventory: NodeInventory,
+                          policy: str) -> None:
+    """Sync the unsatisfiable-sizes node annotation (empty ⇒ removed)."""
+    if policy not in (GUARANTEED, RESTRICTED):
+        sizes: List[int] = []
+    else:
+        sizes = unsatisfiable_sizes(inventory, policy)
+    value = ",".join(str(s) for s in sizes)
+    try:
+        client.patch_node_annotations(
+            node_name, {UNSATISFIABLE_ANNOTATION: value or None}
+        )
+    except Exception:  # noqa: BLE001 — annotation sync is advisory
+        log.exception("failed to publish unsatisfiable sizes on %s", node_name)

@@ -45,6 +45,7 @@ from ..api.kubelet import (
     add_deviceplugin_service,
     registration_stub,
 )
+from .allocator import SliceAllocator
 from ..k8s.client import KubeClient, pod_name, pod_uid
 from ..tpulib.types import NodeInventory
 from ..util import protocol
@@ -92,6 +93,9 @@ class TpuDevicePlugin:
         self._watch_seq = 0
         self._watch_lock = threading.Lock()
         self._stop = threading.Event()
+        # Kubelet-path topology packing (reference server.go:441–491): used
+        # when pods request whole chips without the extender in the loop.
+        self.allocator = SliceAllocator(inventory, cfg.topology_policy)
 
     # -- virtual device fan-out (apiDevices, plugin.go:479–488) ---------------
     def api_devices(self) -> List[pb.Device]:
@@ -115,7 +119,7 @@ class TpuDevicePlugin:
     def GetDevicePluginOptions(self, request, context):  # noqa: N802
         return pb.DevicePluginOptions(
             pre_start_required=False,
-            get_preferred_allocation_available=False,
+            get_preferred_allocation_available=True,
         )
 
     def ListAndWatch(self, request, context):  # noqa: N802
@@ -139,10 +143,24 @@ class TpuDevicePlugin:
                 self._watch_qs.pop(sid, None)
 
     def GetPreferredAllocation(self, request, context):  # noqa: N802
-        # The extender already chose physical chips; kubelet's preference over
-        # virtual IDs is irrelevant (reference MLU uses this for topology —
-        # our topology decision lives in Filter).
-        return pb.PreferredAllocationResponse()
+        """Topology-pack kubelet's choice of virtual devices.
+
+        Extender-managed pods ignore this (Allocate obeys annotations), but
+        whole-chip pods scheduled by the vanilla scheduler get ICI-contiguous
+        chips here — the reference's MLU topology-aware mode
+        (server.go:441–491) rebuilt on closed-form slice search.
+        """
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            ids = self.allocator.preferred(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                creq.allocation_size,
+            )
+            resp.container_responses.append(
+                pb.ContainerPreferredAllocationResponse(deviceIDs=ids)
+            )
+        return resp
 
     def PreStartContainer(self, request, context):  # noqa: N802
         return pb.PreStartContainerResponse()
@@ -269,7 +287,12 @@ class TpuDevicePlugin:
                 version=API_VERSION,
                 endpoint=os.path.basename(self.socket_path),
                 resource_name=self.resource_name,
-                options=pb.DevicePluginOptions(),
+                # Kubelet gates GetPreferredAllocation on the options carried
+                # HERE (device manager stores r.Options per endpoint), not on
+                # a later GetDevicePluginOptions call.
+                options=pb.DevicePluginOptions(
+                    get_preferred_allocation_available=True,
+                ),
             ),
             timeout=10,
         )

@@ -179,9 +179,25 @@ class HostSwapStore:
                 self.resume(n)
 
     # -- pressure-driven eviction ---------------------------------------------
-    def spill_until(self, bytes_needed: int) -> int:
+    def _entry_bytes_on(self, e: "_Entry", device) -> int:
+        """HBM bytes ``e`` holds on one specific chip (sharded entries place
+        only a fraction of nbytes per chip)."""
+        jax = _jax()
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(e.tree):
+            for sh in getattr(leaf, "addressable_shards", ()):
+                if sh.device == device:
+                    total += getattr(sh.data, "nbytes", 0)
+        return total
+
+    def spill_until(self, bytes_needed: int, device=None) -> int:
         """Evict least-recently-used device-resident entries until at least
-        ``bytes_needed`` HBM bytes have been freed (or nothing left)."""
+        ``bytes_needed`` HBM bytes have been freed (or nothing left).
+
+        With ``device`` set, only bytes freed on THAT chip count toward the
+        target (a sharded entry frees just its local fraction there), and
+        entries resident elsewhere are skipped — pressure is per-chip.
+        """
         freed = 0
         with self._lock:
             order = sorted(
@@ -191,7 +207,14 @@ class HostSwapStore:
             for e in order:
                 if freed >= bytes_needed:
                     break
-                freed += self.suspend(e.name)
+                if device is None:
+                    freed += self.suspend(e.name)
+                else:
+                    local = self._entry_bytes_on(e, device)
+                    if local <= 0:
+                        continue  # suspending this entry relieves nothing here
+                    self.suspend(e.name)
+                    freed += local
         return freed
 
     # -- accounting ------------------------------------------------------------
@@ -239,16 +262,19 @@ class PressureSpiller:
         any of its chips)."""
         if self.physical <= 0:
             return 0
+        worst_dev = None
         if in_use is not None:
             over = in_use + self.headroom - self.physical
         else:
-            over = max(
-                (b + self.headroom - self.physical
-                 for b in _all_devices_bytes_in_use()),
-                default=0,
-            )
+            over = 0
+            for dev, b in _devices_bytes_in_use():
+                dev_over = b + self.headroom - self.physical
+                if dev_over > over:
+                    over, worst_dev = dev_over, dev
         if over > 0:
-            spilled = self.store.spill_until(over)
+            # Spill against the pressured chip specifically: counting bytes
+            # freed on OTHER chips would under-relieve it by the shard factor.
+            spilled = self.store.spill_until(over, device=worst_dev)
             if spilled:
                 log.warning(
                     "oversub: HBM pressure (worst chip %d MiB over); "
@@ -272,13 +298,14 @@ class PressureSpiller:
         self._stop.set()
 
 
-def _all_devices_bytes_in_use() -> "list[int]":
+def _devices_bytes_in_use() -> "list[tuple]":
+    """(device, bytes_in_use) per local chip."""
     try:
         jax = _jax()
         out = []
         for d in jax.local_devices():
             stats = d.memory_stats() or {}
-            out.append(int(stats.get("bytes_in_use", 0)))
+            out.append((d, int(stats.get("bytes_in_use", 0))))
         return out
     except Exception:
         return []

@@ -70,6 +70,11 @@ def box_coords(origin: Coord, shape: Tuple[int, ...], topo: TopologyDesc
     return [tuple(c) for c in itertools.product(*axes)]
 
 
+def box_coords_origins(topo: TopologyDesc):
+    """All candidate box origins on the mesh."""
+    return itertools.product(*(range(d) for d in topo.mesh))
+
+
 def _packing_score(cells: Iterable[Coord], free: FrozenSet[Coord],
                    topo: TopologyDesc) -> int:
     """How well a placement packs against occupied chips / mesh walls: count
@@ -95,7 +100,8 @@ def _packing_score(cells: Iterable[Coord], free: FrozenSet[Coord],
 
 
 def find_slice(topo: TopologyDesc, free: Iterable[Coord], n: int,
-               policy: str = BEST_EFFORT) -> Optional[List[Coord]]:
+               policy: str = BEST_EFFORT,
+               must: Iterable[Coord] = ()) -> Optional[List[Coord]]:
     """Choose ``n`` chips from ``free``.
 
     Returns the chosen coords (contiguous slice when possible), or None when
@@ -104,18 +110,24 @@ def find_slice(topo: TopologyDesc, free: Iterable[Coord], n: int,
     requests keep finding contiguous room — the fragmentation concern behind
     the reference's "best ring by non-conflict count" heuristic
     (allocator/default.go via SURVEY C23).
+
+    ``must`` constrains the choice to boxes containing every listed coord —
+    the analog of kubelet's must_include_deviceIDs in GetPreferredAllocation.
     """
     freeset = frozenset(free)
+    mustset = frozenset(must)
     if n <= 0:
         return []
-    if n > len(freeset):
+    if n > len(freeset) or len(mustset) > n or not freeset >= mustset:
         return None
 
     best: Optional[Tuple[int, List[Coord]]] = None
     for shape in factor_shapes(n, topo.mesh):
-        for origin in itertools.product(*(range(d) for d in topo.mesh)):
+        for origin in box_coords_origins(topo):
             cells = box_coords(origin, shape, topo)
             if cells is None or not freeset.issuperset(cells):
+                continue
+            if mustset and not mustset.issubset(cells):
                 continue
             score = _packing_score(cells, freeset, topo)
             if best is None or score > best[0]:
@@ -134,11 +146,126 @@ def find_slice(topo: TopologyDesc, free: Iterable[Coord], n: int,
         return None
     # Scattered fallback: pack around existing allocations.
     ranked = sorted(
-        freeset,
+        freeset - mustset,
         key=lambda c: _packing_score([c], freeset - {c}, topo),
         reverse=True,
     )
-    return ranked[:n]
+    return sorted(mustset) + ranked[: n - len(mustset)]
+
+
+def find_capacitated_slice(
+    topo: TopologyDesc,
+    cap: "dict[Coord, int]",
+    size: int,
+    must: Iterable[Coord] = (),
+    policy: str = BEST_EFFORT,
+) -> Optional[List[Coord]]:
+    """Smallest contiguous chip box carrying ``size`` capacity units.
+
+    Generalizes :func:`find_slice` to chips with varying capacity (virtual
+    devices left per chip): the box volume grows from the theoretical minimum
+    until one box both fits in the free set (``cap``'s keys) and carries
+    enough units.  Under guaranteed/restricted the box volume may not exceed
+    ``size`` — every cell must be able to contribute, so a round-robin fill
+    uses the WHOLE box and the chip-level grant stays contiguous; a larger
+    box would leave unused cells and an L-shaped grant.
+
+    Scatter fallback (best-effort, plus restricted for counts that cannot
+    form a box on this mesh even when empty) prefers a single ICI component —
+    a grant spanning a partitioned fabric cannot communicate at all.
+    """
+    free = frozenset(cap)
+    mustset = frozenset(must)
+    if size <= 0:
+        return []
+    if sum(cap.values()) < size or not free >= mustset:
+        return None
+    max_cap = max(cap.values())
+    n_min = max(len(mustset), -(-size // max_cap))  # ceil division
+    n_max = len(free)
+    if policy in (GUARANTEED, RESTRICTED):
+        n_max = min(n_max, size)
+
+    for n in range(n_min, n_max + 1):
+        for shape in factor_shapes(n, topo.mesh):
+            best = None
+            for origin in box_coords_origins(topo):
+                cells = box_coords(origin, shape, topo)
+                if cells is None:
+                    continue
+                cellset = set(cells)
+                if not cellset.issubset(free):
+                    continue
+                if not mustset.issubset(cellset):
+                    continue
+                if sum(cap[c] for c in cells) < size:
+                    continue
+                score = _packing_score(cells, free, topo)
+                if best is None or score > best[0]:
+                    best = (score, cells)
+            # Shapes are ordered most-compact-first: the first shape with any
+            # fit wins (compactness beats wall-packing, like find_slice),
+            # position chosen by packing score within it.
+            if best is not None:
+                return best[1]
+
+    # No usable box.  Restricted keeps find_slice's mesh-impossible escape
+    # hatch: when NO candidate volume can form a box on this mesh even empty,
+    # the count is structurally slice-less and may scatter; otherwise refuse
+    # so the pod can try a less fragmented node.
+    if policy == GUARANTEED:
+        return None
+    if policy == RESTRICTED and any(
+        factor_shapes(n, topo.mesh) for n in range(n_min, n_max + 1)
+    ):
+        return None
+    groups = link_groups(topo, free)
+    groups.sort(key=lambda g: sum(cap[c] for c in g), reverse=True)
+    for g in groups:
+        if not mustset.issubset(g):
+            continue
+        if sum(cap[c] for c in g) < size:
+            continue
+        ranked = sorted(
+            (c for c in g if c not in mustset),
+            key=lambda c: _packing_score([c], free - {c}, topo),
+            reverse=True,
+        )
+        out = sorted(mustset)
+        for c in ranked:
+            if sum(cap[x] for x in out) >= size:
+                break
+            out.append(c)
+        return out
+    # Last resort: span components (still better than no preference).
+    ranked = sorted(
+        (c for c in free if c not in mustset), key=lambda c: cap[c], reverse=True
+    )
+    out = sorted(mustset)
+    for c in ranked:
+        if sum(cap[x] for x in out) >= size:
+            break
+        out.append(c)
+    return out if sum(cap[x] for x in out) >= size else None
+
+
+def exists_slice(topo: TopologyDesc, free: Iterable[Coord], n: int) -> bool:
+    """Existence-only contiguity check: is there ANY free box of volume ``n``?
+
+    Early-exits on the first fit with no placement scoring — cheap enough for
+    per-health-change sweeps over every slice size (publish_unsatisfiable).
+    """
+    freeset = frozenset(free)
+    if n <= 0:
+        return True
+    if n > len(freeset):
+        return False
+    for shape in factor_shapes(n, topo.mesh):
+        for origin in box_coords_origins(topo):
+            cells = box_coords(origin, shape, topo)
+            if cells is not None and freeset.issuperset(cells):
+                return True
+    return False
 
 
 def is_contiguous(coords: Sequence[Coord], topo: TopologyDesc) -> bool:
@@ -146,7 +273,7 @@ def is_contiguous(coords: Sequence[Coord], topo: TopologyDesc) -> bool:
     want = sorted(tuple(c) for c in coords)
     n = len(want)
     for shape in factor_shapes(n, topo.mesh):
-        for origin in itertools.product(*(range(d) for d in topo.mesh)):
+        for origin in box_coords_origins(topo):
             cells = box_coords(origin, shape, topo)
             if cells is not None and sorted(cells) == want:
                 return True

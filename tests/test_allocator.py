@@ -1,0 +1,236 @@
+"""Kubelet-path topology allocator tests.
+
+Table-driven in the style of the reference's allocator suite
+(pkg/device-plugin/mlu/allocator/{spider,board}_test.go — fabricated device
+maps + canned rings per policy).  Here the "rings" are closed-form slices on
+a mesh, so the tables fabricate chip grids, availability, health and policy
+and assert the chosen chip sets.
+"""
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.deviceplugin.allocator import (
+    SliceAllocator,
+    UNSATISFIABLE_ANNOTATION,
+    publish_unsatisfiable,
+    unsatisfiable_sizes,
+)
+from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+from k8s_vgpu_scheduler_tpu.tpulib.types import (
+    ChipInfo,
+    NodeInventory,
+    TopologyDesc,
+)
+from k8s_vgpu_scheduler_tpu.util.types import (
+    BEST_EFFORT,
+    GUARANTEED,
+    RESTRICTED,
+)
+
+
+def make_inventory(mesh=(4, 2), split=1, unhealthy=(), generation="v5e"):
+    """Grid of chips named by coordinate: chip-x-y at (x, y)."""
+    topo = TopologyDesc(generation=generation, mesh=mesh)
+    chips = []
+    idx = 0
+    import itertools
+
+    for coords in itertools.product(*(range(d) for d in mesh)):
+        name = "chip-" + "-".join(str(c) for c in coords)
+        chips.append(
+            ChipInfo(
+                index=idx,
+                uuid=name,
+                type=f"TPU-{generation}",
+                hbm_mib=16384,
+                coords=coords,
+                healthy=coords not in set(unhealthy),
+            )
+        )
+        idx += 1
+    return NodeInventory(chips=chips, topology=topo)
+
+
+def vids(inv, split=1, skip=()):
+    """All virtual IDs, one chip at a time: <uuid>-<k>."""
+    out = []
+    for chip in inv.chips:
+        if chip.coords in set(skip):
+            continue
+        for k in range(split):
+            out.append(f"{chip.uuid}-{k}")
+    return out
+
+
+def chips_of(ids):
+    return {i.rsplit("-", 1)[0] for i in ids}
+
+
+class TestWholeChipSelection:
+    """split=1: virtual id count == chip count (reference topology-aware
+    mode never splits devices — server.go:441–491)."""
+
+    def test_picks_contiguous_pair(self):
+        inv = make_inventory((4, 2))
+        alloc = SliceAllocator(inv, BEST_EFFORT)
+        got = alloc.preferred(vids(inv), [], 2)
+        assert len(got) == 2
+        coords = sorted(inv.chip_by_uuid(u).coords for u in chips_of(got))
+        # Any 2 adjacent cells form a 1x2/2x1 box.
+        (x0, y0), (x1, y1) = coords
+        assert abs(x0 - x1) + abs(y0 - y1) == 1
+
+    def test_four_forms_square_not_line(self):
+        inv = make_inventory((4, 4))
+        alloc = SliceAllocator(inv, BEST_EFFORT)
+        got = alloc.preferred(vids(inv), [], 4)
+        coords = sorted(inv.chip_by_uuid(u).coords for u in chips_of(got))
+        xs = {c[0] for c in coords}
+        ys = {c[1] for c in coords}
+        assert len(xs) == 2 and len(ys) == 2  # 2x2, the compact shape
+
+    def test_must_include_respected(self):
+        inv = make_inventory((4, 2))
+        alloc = SliceAllocator(inv, BEST_EFFORT)
+        got = alloc.preferred(vids(inv), ["chip-3-1-0"], 2)
+        assert "chip-3-1-0" in got
+        other = (chips_of(got) - {"chip-3-1"}).pop()
+        oc = inv.chip_by_uuid(other).coords
+        assert abs(oc[0] - 3) + abs(oc[1] - 1) == 1  # adjacent to (3,1)
+
+    def test_avoids_occupied_cells(self):
+        # Column x=1 fully taken: a 2x2 must come from x∈{2,3}.
+        inv = make_inventory((4, 2))
+        avail = vids(inv, skip=[(1, 0), (1, 1)])
+        alloc = SliceAllocator(inv, BEST_EFFORT)
+        got = alloc.preferred(avail, [], 4)
+        coords = {inv.chip_by_uuid(u).coords for u in chips_of(got)}
+        assert coords == {(2, 0), (2, 1), (3, 0), (3, 1)}
+
+    def test_unhealthy_chip_excluded(self):
+        inv = make_inventory((2, 2), unhealthy=[(0, 0)])
+        alloc = SliceAllocator(inv, BEST_EFFORT)
+        got = alloc.preferred(vids(inv), [], 2)
+        assert "chip-0-0" not in chips_of(got)
+
+    def test_size_zero(self):
+        inv = make_inventory((2, 2))
+        assert SliceAllocator(inv, BEST_EFFORT).preferred(vids(inv), [], 0) == []
+
+
+class TestPolicies:
+    """Policy gating per reference types.go:44–46 semantics."""
+
+    def fragmented(self):
+        # 4x1 line with the middle free cells split by an occupied one:
+        # free = (0,0),(2,0),(3,0) — 2 contiguous exists ((2,0),(3,0)),
+        # 3 contiguous does not.
+        inv = make_inventory((4, 1))
+        avail = vids(inv, skip=[(1, 0)])
+        return inv, avail
+
+    def test_best_effort_scatters(self):
+        inv, avail = self.fragmented()
+        got = SliceAllocator(inv, BEST_EFFORT).preferred(avail, [], 3)
+        assert chips_of(got) == {"chip-0-0", "chip-2-0", "chip-3-0"}
+
+    def test_guaranteed_refuses(self):
+        inv, avail = self.fragmented()
+        assert SliceAllocator(inv, GUARANTEED).preferred(avail, [], 3) == []
+
+    def test_restricted_refuses_when_possible_in_principle(self):
+        inv, avail = self.fragmented()
+        # 3-slice (3x1) fits on a 4x1 mesh in principle ⇒ restricted refuses
+        # to scatter (lets the pod land on a less fragmented node).
+        assert SliceAllocator(inv, RESTRICTED).preferred(avail, [], 3) == []
+
+    def test_guaranteed_takes_existing_slice(self):
+        inv, avail = self.fragmented()
+        got = SliceAllocator(inv, GUARANTEED).preferred(avail, [], 2)
+        assert chips_of(got) == {"chip-2-0", "chip-3-0"}
+
+    def test_guaranteed_never_grants_l_shape(self):
+        # 3 whole chips on an empty 2x2: no 3-volume box exists; growing to
+        # the full 2x2 and using 3 of its cells would be an L-shape, which
+        # violates the guaranteed contract — must refuse (consistent with
+        # the unsatisfiable-sizes annotation listing 3).
+        inv = make_inventory((2, 2))
+        assert SliceAllocator(inv, GUARANTEED).preferred(vids(inv), [], 3) == []
+
+    def test_restricted_scatters_mesh_impossible_count(self):
+        # Same request under restricted: 3 can never form a box on a 2x2
+        # mesh, so the mesh-impossible escape hatch allows scatter.
+        inv = make_inventory((2, 2))
+        got = SliceAllocator(inv, RESTRICTED).preferred(vids(inv), [], 3)
+        assert len(got) == 3
+
+    def test_best_effort_prefers_full_box_over_l_shape(self):
+        # best-effort may grow the box: 3 whole chips on 2x2 yields 3 cells
+        # of the full square — ICI-local even if not a box.
+        inv = make_inventory((2, 2))
+        got = SliceAllocator(inv, BEST_EFFORT).preferred(vids(inv), [], 3)
+        assert len(got) == 3
+
+
+class TestSplitChips:
+    """split>1: preference packs sharers onto few, contiguous chips."""
+
+    def test_packs_onto_single_chip(self):
+        inv = make_inventory((2, 2))
+        got = SliceAllocator(inv, BEST_EFFORT).preferred(
+            vids(inv, split=4), [], 3
+        )
+        assert len(chips_of(got)) == 1
+
+    def test_spills_to_adjacent_chip(self):
+        inv = make_inventory((2, 2))
+        got = SliceAllocator(inv, BEST_EFFORT).preferred(
+            vids(inv, split=4), [], 6
+        )
+        cs = sorted(inv.chip_by_uuid(u).coords for u in chips_of(got))
+        assert len(cs) == 2
+        assert abs(cs[0][0] - cs[1][0]) + abs(cs[0][1] - cs[1][1]) == 1
+
+    def test_partial_availability(self):
+        # chip-0-0 has 1 vid left, others 2: asking 4 needs 2+ chips.
+        inv = make_inventory((2, 1))
+        avail = ["chip-0-0-0", "chip-1-0-0", "chip-1-0-1"]
+        got = SliceAllocator(inv, BEST_EFFORT).preferred(avail, [], 3)
+        assert sorted(got) == sorted(avail)
+
+
+class TestPartitionedFabric:
+    def test_scatter_stays_in_one_component(self):
+        # 5x1 line; dead chip at (2,0) splits fabric into {0,1} and {3,4}.
+        inv = make_inventory((5, 1), unhealthy=[(2, 0)])
+        got = SliceAllocator(inv, BEST_EFFORT).preferred(
+            vids(inv, skip=[(2, 0)]), [], 2
+        )
+        coords = {inv.chip_by_uuid(u).coords for u in chips_of(got)}
+        assert coords in ({(0, 0), (1, 0)}, {(3, 0), (4, 0)})
+
+
+class TestUnsatisfiableAnnotation:
+    def test_sizes_on_partitioned_mesh(self):
+        inv = make_inventory((4, 1), unhealthy=[(1, 0)])
+        # healthy: (0,0),(2,0),(3,0) — sizes 2 ok ((2,0),(3,0)), 3 not.
+        assert unsatisfiable_sizes(inv) == [3]
+
+    def test_restricted_tolerates_mesh_impossible_counts(self):
+        inv = make_inventory((2, 2))
+        # 3 cannot form a box on a 2x2 mesh even empty: guaranteed lists it,
+        # restricted scatters it (find_slice's mesh-impossible escape hatch).
+        assert unsatisfiable_sizes(inv, GUARANTEED) == [3]
+        assert unsatisfiable_sizes(inv, RESTRICTED) == []
+
+    def test_publish_and_clear(self):
+        client = FakeKube()
+        client.add_node({"metadata": {"name": "node-a"}})
+        inv = make_inventory((4, 1), unhealthy=[(1, 0)])
+        publish_unsatisfiable(client, "node-a", inv, RESTRICTED)
+        anns = client.get_node("node-a")["metadata"].get("annotations", {})
+        assert anns.get(UNSATISFIABLE_ANNOTATION) == "3"
+        # best-effort policy clears the marker
+        publish_unsatisfiable(client, "node-a", inv, BEST_EFFORT)
+        anns = client.get_node("node-a")["metadata"].get("annotations", {})
+        assert not anns.get(UNSATISFIABLE_ANNOTATION)

@@ -111,6 +111,38 @@ class TestPressureSpiller:
                                      headroom_bytes=0)
         assert sp.check_once(in_use=1024) == 0
 
+    def test_per_device_spill_counts_local_fraction_only(self):
+        # An entry sharded over all 8 devices frees only 1/8 of its bytes on
+        # the pressured chip: spill_until(target, device=d) must keep
+        # evicting until the LOCAL fraction reaches the target, not stop
+        # after one entry whose global size covers it.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        devs = jax.devices()
+        mesh = jax.sharding.Mesh(np.array(devs), ("d",))
+        sharded = NamedSharding(mesh, P("d"))
+        store = oversub.HostSwapStore()
+        n_entries = 4
+        per_entry = jnp.zeros((8 * 1024,), jnp.float32)  # 32 KiB, 4 KiB/chip
+        for i in range(n_entries):
+            store.register(f"e{i}", jax.device_put(per_entry, sharded))
+        local = per_entry.nbytes // len(devs)
+        target = 3 * local  # needs 3 entries' local fractions
+        freed = store.spill_until(target, device=devs[0])
+        assert freed >= target
+        suspended = sum(1 for e in store._entries.values() if not e.on_device)
+        assert suspended == 3  # global counting would have stopped at 1
+
+    def test_per_device_spill_skips_entries_elsewhere(self):
+        devs = jax.devices()
+        store = oversub.HostSwapStore()
+        store.register("far", jax.device_put(jnp.zeros((64,)), devs[1]))
+        store.register("near", jax.device_put(jnp.zeros((64,)), devs[0]))
+        freed = store.spill_until(1, device=devs[0])
+        assert freed > 0
+        assert store._entries["far"].on_device  # untouched
+        assert not store._entries["near"].on_device
+
     def test_disabled_without_physical_size(self):
         sp = oversub.PressureSpiller(oversub.HostSwapStore(), 0)
         assert sp.check_once(in_use=1 << 40) == 0
