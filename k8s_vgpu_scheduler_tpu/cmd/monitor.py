@@ -20,6 +20,8 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser("vtpu-monitor")
     p.add_argument("--container-root", default="/tmp/vtpu/containers")
     p.add_argument("--metrics-port", type=int, default=9394)
+    p.add_argument("--grpc-port", type=int, default=9395,
+                   help="NodeTPUInfo gRPC port (0 = disabled)")
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     p.add_argument("--no-backend", action="store_true",
@@ -41,10 +43,16 @@ def main(argv=None):
         except Exception:
             logging.exception("chip backend unavailable; continuing without")
     loop = FeedbackLoop(args.container_root)
-    start_metrics_server(loop, backend, args.node_name or os.uname().nodename,
-                         args.metrics_port)
-    logging.info("vtpu-monitor up: root=%s metrics=:%d",
-                 args.container_root, args.metrics_port)
+    node = args.node_name or os.uname().nodename
+    start_metrics_server(loop, backend, node, args.metrics_port)
+    rpc = None
+    if args.grpc_port:
+        from ..monitor.noderpc import NodeTPUInfoServer
+
+        rpc = NodeTPUInfoServer(loop, node)
+        rpc.serve(args.grpc_port)
+    logging.info("vtpu-monitor up: root=%s metrics=:%d grpc=:%d",
+                 args.container_root, args.metrics_port, args.grpc_port)
     try:
         while True:
             t0 = time.monotonic()
@@ -54,6 +62,8 @@ def main(argv=None):
                 logging.exception("feedback tick failed")
             time.sleep(max(0.1, args.interval - (time.monotonic() - t0)))
     except KeyboardInterrupt:
+        if rpc is not None:
+            rpc.stop()
         loop.close()
 
 

@@ -1,0 +1,62 @@
+"""vtpu OCI runtime shim entrypoint.
+
+Drop-in runc wrapper for non-kubelet container launches (plain containerd /
+nerdctl): configure containerd with this as the runtime binary and every
+``create`` gets the vtpu enforcement env/mounts injected into its bundle
+spec before the real runtime runs.  The reference scaffolds this interposer
+but never wires it (pkg/oci, SURVEY.md C26); here it is a working binary.
+
+Grant configuration comes from a JSON file (default /etc/vtpu/oci.json):
+
+    {"chip_limits_mib": {"0": 3000}, "physical_mib": {"0": 16384},
+     "core_limit": 30, "visible_chips": "uuid-a", "visible_devices": "0",
+     "shim_host_dir": "/usr/local/vtpu"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from ..oci import ModifyingRuntimeWrapper, SyscallExecRuntime, inject_vtpu
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CONFIG = "/etc/vtpu/oci.json"
+
+
+def load_modifier(config_path: str):
+    with open(config_path) as f:
+        cfg = json.load(f)
+    return inject_vtpu(
+        chip_limits_mib={int(k): int(v)
+                         for k, v in cfg.get("chip_limits_mib", {}).items()},
+        core_limit=int(cfg.get("core_limit", 0)),
+        visible_chips=cfg.get("visible_chips", ""),
+        visible_devices=cfg.get("visible_devices", ""),
+        physical_mib={int(k): int(v)
+                      for k, v in cfg.get("physical_mib", {}).items()},
+        cache_path=cfg.get("cache_path", "/tmp/vtpu/vtpu.cache"),
+        shim_host_dir=cfg.get("shim_host_dir", "/usr/local/vtpu"),
+        cache_host_dir=cfg.get("cache_host_dir"),
+    )
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv if argv is None else argv)
+    # Our own flags come from env (argv belongs to the OCI runtime CLI).
+    runtime_path = os.environ.get("VTPU_OCI_RUNTIME", "/usr/bin/runc")
+    config_path = os.environ.get("VTPU_OCI_CONFIG", DEFAULT_CONFIG)
+    logging.basicConfig(level=logging.INFO)
+    modifier = load_modifier(config_path)
+    wrapper = ModifyingRuntimeWrapper(
+        SyscallExecRuntime(runtime_path), modifier
+    )
+    wrapper.exec(argv)
+
+
+if __name__ == "__main__":
+    main()

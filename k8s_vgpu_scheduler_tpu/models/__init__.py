@@ -1,9 +1,11 @@
+from .deeplab import DeepLabV3, deeplab_v3
 from .llama import Llama, LlamaConfig, llama_7b, llama_tiny
 from .lstm import LSTMClassifier
 from .resnet import ResNetV2, resnet_v2_50, resnet_v2_152
 from .vgg import VGG16
 
 __all__ = [
+    "DeepLabV3", "deeplab_v3",
     "Llama", "LlamaConfig", "llama_7b", "llama_tiny",
     "LSTMClassifier", "ResNetV2", "resnet_v2_50", "resnet_v2_152", "VGG16",
 ]

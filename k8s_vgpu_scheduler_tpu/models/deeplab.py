@@ -1,0 +1,111 @@
+"""DeepLab-v3 semantic segmentation in flax — benchmark model 4.x.
+
+The reference benchmarks DeepLab via ai-benchmark (BASELINE.md tests 4.1
+inference b2 512² / 4.2 train b1 384²); this is the TPU-native equivalent:
+a ResNet-V2 backbone with output-stride 16 (stride→atrous conversion in the
+last stage), an ASPP head (parallel atrous convs + global pooling branch),
+and bilinear upsampling to input resolution.  bfloat16 convs (MXU), NHWC
+layout, static shapes throughout — atrous (dilated) convolution lowers to
+regular XLA conv with ``rhs_dilation``, which the TPU conv emitter tiles
+onto the MXU like any other conv.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .resnet import PreActBottleneck
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepLabConfig:
+    backbone_stages: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-V2-50
+    num_classes: int = 21  # PASCAL VOC
+    width: int = 64
+    aspp_features: int = 256
+    atrous_rates: Tuple[int, ...] = (6, 12, 18)
+    dtype: str = "bfloat16"
+
+
+def deeplab_v3() -> DeepLabConfig:
+    return DeepLabConfig()
+
+
+class ASPP(nn.Module):
+    """Atrous Spatial Pyramid Pooling: 1x1 + three dilated 3x3 branches +
+    image-level pooling, concatenated and projected."""
+
+    features: int
+    rates: Tuple[int, ...]
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        branches = [
+            nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="b0")(x)
+        ]
+        for i, rate in enumerate(self.rates):
+            branches.append(
+                nn.Conv(self.features, (3, 3), use_bias=False,
+                        kernel_dilation=(rate, rate), dtype=self.dtype,
+                        name=f"b{i + 1}")(x)
+            )
+        # Image-level branch: global average pool -> 1x1 conv -> broadcast
+        # back (static shapes: upsample by broadcast, not resize).
+        pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
+        pooled = nn.Conv(self.features, (1, 1), use_bias=False,
+                         dtype=self.dtype, name="pool_proj")(pooled)
+        pooled = jnp.broadcast_to(
+            pooled, (x.shape[0], x.shape[1], x.shape[2], self.features)
+        )
+        branches.append(pooled)
+        y = jnp.concatenate(branches, axis=-1)
+        y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="proj_gn")(y)
+        y = nn.relu(y)
+        return nn.Conv(self.features, (1, 1), use_bias=False,
+                       dtype=self.dtype, name="proj")(y)
+
+
+class DeepLabV3(nn.Module):
+    cfg: DeepLabConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        in_h, in_w = x.shape[1], x.shape[2]
+        x = x.astype(dtype)
+        x = nn.Conv(cfg.width, (7, 7), (2, 2), use_bias=False, dtype=dtype,
+                    name="stem")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        # Stages 0-2 stride as usual (output stride 16 after stage 2); the
+        # last stage switches to atrous blocks at rate 2.
+        for stage, n_blocks in enumerate(cfg.backbone_stages[:-1]):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = PreActBottleneck(
+                    cfg.width * (2 ** stage), strides, dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, train)
+        last = len(cfg.backbone_stages) - 1
+        for block in range(cfg.backbone_stages[-1]):
+            x = PreActBottleneck(
+                cfg.width * (2 ** last), (1, 1), dtype, dilation=2,
+                name=f"stage{last}_block{block}",
+            )(x, train)
+        x = nn.GroupNorm(num_groups=32, dtype=dtype, name="backbone_gn")(x)
+        x = nn.relu(x)
+
+        x = ASPP(cfg.aspp_features, cfg.atrous_rates, dtype, name="aspp")(x)
+        logits = nn.Conv(cfg.num_classes, (1, 1), dtype=jnp.float32,
+                         name="classifier")(x)
+        # Bilinear upsample to input resolution (static target shape).
+        return jax.image.resize(
+            logits, (logits.shape[0], in_h, in_w, cfg.num_classes), "bilinear"
+        )

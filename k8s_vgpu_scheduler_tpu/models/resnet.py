@@ -34,6 +34,10 @@ class PreActBottleneck(nn.Module):
     features: int
     strides: Tuple[int, int]
     dtype: jnp.dtype
+    # Atrous mode (DeepLab output-stride trick): dilate the 3x3 conv instead
+    # of striding, so the stage keeps resolution while the receptive field
+    # still grows.  dilation > 1 requires strides == (1, 1).
+    dilation: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -50,6 +54,7 @@ class PreActBottleneck(nn.Module):
         y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn2")(y)
         y = nn.relu(y)
         y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False,
+                    kernel_dilation=(self.dilation, self.dilation),
                     dtype=self.dtype, name="conv2")(y)
         y = nn.GroupNorm(num_groups=32, dtype=self.dtype, name="gn3")(y)
         y = nn.relu(y)

@@ -1,0 +1,96 @@
+"""NodeTPUInfo gRPC server — per-container usage introspection.
+
+Reference: the monitor's NodeVGPUInfo service (cmd/vGPUmonitor/
+pathmonitor.go:89–113, serving noderpc.proto on :9395).  The reference's
+implementation is a stub (GetNodeVGPU returns an empty reply); here it is
+functional: each request snapshots the live shared regions the feedback loop
+has mapped.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ..api import noderpc_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "vtpu.noderpc.NodeTPUInfo"
+GET_METHOD = f"/{SERVICE_NAME}/GetNodeTPU"
+
+
+def snapshot_region(region) -> pb.RegionInfo:
+    info = pb.RegionInfo(
+        priority=region.priority,
+        utilization_switch=region.utilization_switch,
+        oversubscribe=region.oversubscribe,
+    )
+    for dev in range(region.num_devices):
+        info.uuids.append(region.uuid(dev))
+        info.limit.append(region.limit(dev))
+        info.sm_limit.append(region.sm_limit(dev))
+        info.used.append(region.used(dev))
+    for pid in region.proc_pids():
+        info.procs.append(pb.ProcSlot(pid=pid))
+    return info
+
+
+class NodeTPUInfoServer:
+    def __init__(self, loop, node_name: str) -> None:
+        self.loop = loop  # FeedbackLoop
+        self.node_name = node_name
+        self._server: Optional[grpc.Server] = None
+
+    # -- handler ---------------------------------------------------------------
+    def get_node_tpu(self, request: pb.GetNodeTPURequest, context
+                     ) -> pb.GetNodeTPUReply:
+        reply = pb.GetNodeTPUReply(nodeid=self.node_name)
+        with self.loop.lock:
+            for key, state in self.loop.containers.items():
+                if request.ctrkey and key != request.ctrkey:
+                    continue
+                try:
+                    usage = pb.PodUsage(
+                        ctrkey=key, info=snapshot_region(state.region)
+                    )
+                except Exception:  # region unmapped mid-read — skip this one
+                    log.exception("snapshot failed for %s", key)
+                    continue
+                reply.usages.append(usage)
+        return reply
+
+    # -- serving ---------------------------------------------------------------
+    def serve(self, port: int) -> int:
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        handler = grpc.method_handlers_generic_handler(
+            SERVICE_NAME,
+            {
+                "GetNodeTPU": grpc.unary_unary_rpc_method_handler(
+                    self.get_node_tpu,
+                    request_deserializer=pb.GetNodeTPURequest.FromString,
+                    response_serializer=pb.GetNodeTPUReply.SerializeToString,
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        bound = self._server.add_insecure_port(f"[::]:{port}")
+        self._server.start()
+        log.info("NodeTPUInfo serving on :%d", bound)
+        return bound
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1)
+            self._server = None
+
+
+def node_tpu_stub(channel: grpc.Channel):
+    return channel.unary_unary(
+        GET_METHOD,
+        request_serializer=pb.GetNodeTPURequest.SerializeToString,
+        response_deserializer=pb.GetNodeTPUReply.FromString,
+    )

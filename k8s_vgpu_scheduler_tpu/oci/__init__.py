@@ -1,0 +1,19 @@
+"""OCI runtime-shim scaffolding (reference pkg/oci — C26 in SURVEY.md §2).
+
+A container-runtime interposer: wrap the real OCI runtime binary (runc),
+rewrite the container spec on `create` to inject the vtpu enforcement
+environment, then exec the wrapped runtime.  The reference ships this as
+unwired scaffolding; here it is additionally wired to the vtpu env/mount
+contract so non-kubelet container launches (plain containerd/runc) can get
+the same enforcement as device-plugin-allocated pods.
+"""
+
+from .runtime import ModifyingRuntimeWrapper, SyscallExecRuntime
+from .spec import FileSpec, inject_vtpu
+
+__all__ = [
+    "FileSpec",
+    "ModifyingRuntimeWrapper",
+    "SyscallExecRuntime",
+    "inject_vtpu",
+]

@@ -1,0 +1,119 @@
+"""File-backed OCI spec with vtpu injection.
+
+Reference: pkg/oci/spec.go:131–204 (fileSpec Load/Modify/Flush).  The spec
+is kept as a plain dict (the OCI schema is JSON); ``inject_vtpu`` is the
+modifier the reference leaves unwired — it grafts the same env/mount
+contract the device plugin emits (deviceplugin/plugin.py
+build_container_response) onto a raw runtime bundle.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+from ..util.types import (
+    ENV_CORE_LIMIT,
+    ENV_MEMORY_LIMIT_PREFIX,
+    ENV_PHYSICAL_MEMORY_PREFIX,
+    ENV_SHARED_CACHE,
+    ENV_VISIBLE_CHIPS,
+    ENV_VISIBLE_DEVICES,
+)
+
+
+class FileSpec:
+    """Load/Modify/Flush over a bundle's ``config.json``."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.spec: Optional[dict] = None
+
+    def load(self) -> None:
+        with open(self.path) as f:
+            self.spec = json.load(f)
+
+    def modify(self, fn: Callable[[dict], dict]) -> None:
+        if self.spec is None:
+            raise ValueError("no spec loaded for modification")
+        self.spec = fn(self.spec)
+
+    def flush(self) -> None:
+        if self.spec is None:
+            raise ValueError("no spec loaded to flush")
+        with open(self.path, "w") as f:
+            json.dump(self.spec, f)
+
+
+def _set_env(env: List[str], key: str, value: str) -> List[str]:
+    out = [e for e in env if not e.startswith(key + "=")]
+    out.append(f"{key}={value}")
+    return out
+
+
+def inject_vtpu(
+    chip_limits_mib: Dict[int, int],
+    core_limit: int = 0,
+    visible_chips: str = "",
+    visible_devices: str = "",
+    physical_mib: Optional[Dict[int, int]] = None,
+    cache_path: str = "/tmp/vtpu/vtpu.cache",
+    shim_host_dir: str = "/usr/local/vtpu",
+    cache_host_dir: Optional[str] = None,
+) -> Callable[[dict], dict]:
+    """Build a SpecModifier injecting the vtpu enforcement contract.
+
+    Mirrors the FULL Allocate() response (plugin.go:353–380 semantics and
+    deviceplugin/plugin.py build_container_response): HBM-limit AND physical
+    HBM env per granted chip (the shim sizes its enforcement ballast from the
+    physical value when the platform exposes no memory_stats — omitting it
+    silently disables enforcement), chip visibility, core limit, shared-cache
+    path, the shim library mount and the ld.so.preload activation.
+    """
+
+    def modifier(spec: dict) -> dict:
+        proc = spec.setdefault("process", {})
+        env = list(proc.get("env", []))
+        for idx, mib in sorted(chip_limits_mib.items()):
+            env = _set_env(env, f"{ENV_MEMORY_LIMIT_PREFIX}{idx}", str(mib))
+        for idx, mib in sorted((physical_mib or {}).items()):
+            env = _set_env(env, f"{ENV_PHYSICAL_MEMORY_PREFIX}{idx}", str(mib))
+        if core_limit:
+            env = _set_env(env, ENV_CORE_LIMIT, str(core_limit))
+        if visible_chips:
+            env = _set_env(env, ENV_VISIBLE_CHIPS, visible_chips)
+        if visible_devices:
+            env = _set_env(env, ENV_VISIBLE_DEVICES, visible_devices)
+        env = _set_env(env, ENV_SHARED_CACHE, cache_path)
+        proc["env"] = env
+
+        mounts = list(spec.get("mounts", []))
+
+        def add_mount(dest: str, src: str, read_only: bool) -> None:
+            mounts[:] = [m for m in mounts if m.get("destination") != dest]
+            opts = ["rbind", "ro" if read_only else "rw"]
+            mounts.append(
+                {
+                    "destination": dest,
+                    "source": src,
+                    "type": "bind",
+                    "options": opts,
+                }
+            )
+
+        add_mount("/usr/local/vtpu", shim_host_dir, read_only=True)
+        add_mount(
+            "/etc/ld.so.preload",
+            f"{shim_host_dir}/ld.so.preload",
+            read_only=True,
+        )
+        if cache_host_dir:
+            import os
+
+            add_mount(
+                os.path.dirname(cache_path), cache_host_dir, read_only=False
+            )
+        spec["mounts"] = mounts
+        return spec
+
+    return modifier

@@ -222,3 +222,41 @@ class TestHostPidMapping:
         # Default (no injected pid_alive): NSpid+map probe sees it dead.
         loop.gc_dead_procs()
         assert loop.containers["uid1_nsgc"].region.used(0) == 0
+
+
+class TestNodeRPC:
+    """NodeTPUInfo gRPC over live regions (reference ships only a stub —
+    pathmonitor.go:89–113; ours answers with real snapshots)."""
+
+    def test_get_node_tpu_snapshots_regions(self, loop_env):
+        import grpc
+
+        from k8s_vgpu_scheduler_tpu.api import noderpc_pb2 as pb
+        from k8s_vgpu_scheduler_tpu.monitor.noderpc import (
+            NodeTPUInfoServer,
+            node_tpu_stub,
+        )
+
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid9_podZ", ["chip-7"], mem=1000)
+        server = NodeTPUInfoServer(loop, "node-test")
+        try:
+            loop.rescan()
+            port = server.serve(0)
+            stub = node_tpu_stub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+            reply = stub(pb.GetNodeTPURequest(), timeout=10)
+            assert reply.nodeid == "node-test"
+            assert len(reply.usages) == 1
+            u = reply.usages[0]
+            assert u.ctrkey == "uid9_podZ"
+            assert list(u.info.uuids) == ["chip-7"]
+            assert u.info.limit[0] == 1000 * 1024 * 1024
+            assert u.info.used[0] == 100 * 1024 * 1024
+            assert len(u.info.procs) == 1  # the workload process slot
+
+            # key filter
+            reply = stub(pb.GetNodeTPURequest(ctrkey="nope"), timeout=10)
+            assert len(reply.usages) == 0
+        finally:
+            server.stop()
+            w.stop()

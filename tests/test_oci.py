@@ -1,0 +1,219 @@
+"""OCI interposer tests — swappable-exec pattern from the reference
+(pkg/oci/runtime_exec_test.go: ``exec`` is a function field so Exec is
+testable without exec'ing; SURVEY.md §4)."""
+
+import json
+import os
+import stat
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.oci import (
+    FileSpec,
+    ModifyingRuntimeWrapper,
+    SyscallExecRuntime,
+    inject_vtpu,
+)
+from k8s_vgpu_scheduler_tpu.oci.runtime import RuntimeError_, bundle_spec_path
+from k8s_vgpu_scheduler_tpu.util.types import (
+    ENV_MEMORY_LIMIT_PREFIX,
+    ENV_SHARED_CACHE,
+)
+
+
+@pytest.fixture
+def runc(tmp_path):
+    path = tmp_path / "runc"
+    path.write_text("#!/bin/sh\n")
+    path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    return str(path)
+
+
+class TestSyscallExecRuntime:
+    def test_rejects_non_executable(self, tmp_path):
+        p = tmp_path / "notexec"
+        p.write_text("")
+        with pytest.raises(RuntimeError_):
+            SyscallExecRuntime(str(p))
+
+    def test_rejects_missing(self):
+        with pytest.raises(RuntimeError_):
+            SyscallExecRuntime("/does/not/exist")
+
+    def test_argv0_forced_to_runtime_path(self, runc):
+        calls = []
+
+        def fake_exec(path, argv, env):
+            calls.append((path, argv))
+
+        rt = SyscallExecRuntime(runc, exec_fn=fake_exec)
+        with pytest.raises(RuntimeError_, match="unexpected return"):
+            rt.exec(["vtpu-runtime", "create", "--bundle", "/b", "id"])
+        path, argv = calls[0]
+        assert path == runc
+        assert argv == [runc, "create", "--bundle", "/b", "id"]
+
+
+class TestModifyingWrapper:
+    def make_bundle(self, tmp_path):
+        bundle = tmp_path / "bundle"
+        bundle.mkdir(parents=True)
+        spec = {
+            "ociVersion": "1.0.2",
+            "process": {"env": ["PATH=/usr/bin"], "args": ["sleep", "1"]},
+            "mounts": [
+                {"destination": "/proc", "source": "proc", "type": "proc"}
+            ],
+        }
+        (bundle / "config.json").write_text(json.dumps(spec))
+        return bundle
+
+    def wrapper(self, runc, bundle=None):
+        mod = inject_vtpu(
+            {0: 3000}, core_limit=30, visible_chips="chip-a",
+            visible_devices="0", physical_mib={0: 16384},
+            cache_host_dir="/tmp/vtpu/containers/x",
+        )
+        rt = SyscallExecRuntime(runc, exec_fn=lambda *a: None)
+        spec = FileSpec(str(bundle / "config.json")) if bundle else None
+        return ModifyingRuntimeWrapper(rt, mod, spec=spec)
+
+    def test_create_injects_env_and_mounts(self, tmp_path, runc):
+        bundle = self.make_bundle(tmp_path)
+        w = self.wrapper(runc)  # no pinned spec: path comes from --bundle
+        with pytest.raises(RuntimeError_):
+            w.exec(["rt", "create", "--bundle", str(bundle), "c1"])
+        spec = json.loads((bundle / "config.json").read_text())
+        env = spec["process"]["env"]
+        assert f"{ENV_MEMORY_LIMIT_PREFIX}0=3000" in env
+        # Physical HBM env must travel too: the shim sizes its enforcement
+        # ballast from it when the platform exposes no memory_stats.
+        assert "TPU_DEVICE_PHYSICAL_MEMORY_0=16384" in env
+        assert "TPU_VISIBLE_DEVICES=0" in env
+        assert any(e.startswith(ENV_SHARED_CACHE + "=") for e in env)
+        assert "PATH=/usr/bin" in env  # original preserved
+        dests = {m["destination"] for m in spec["mounts"]}
+        assert {"/usr/local/vtpu", "/etc/ld.so.preload", "/tmp/vtpu"} <= dests
+        assert "/proc" in dests
+
+    def test_each_create_uses_its_own_bundle(self, tmp_path, runc):
+        # One long-lived wrapper, two containers: each create must rewrite
+        # ITS bundle, not the first one seen.
+        b1 = self.make_bundle(tmp_path / "one")
+        b2 = self.make_bundle(tmp_path / "two")
+        w = self.wrapper(runc)
+        for b in (b1, b2):
+            with pytest.raises(RuntimeError_):
+                w.exec(["rt", "create", "--bundle", str(b), "c"])
+        for b in (b1, b2):
+            spec = json.loads((b / "config.json").read_text())
+            assert any(e.startswith(ENV_MEMORY_LIMIT_PREFIX)
+                       for e in spec["process"]["env"])
+
+    def test_create_without_bundle_uses_pinned_spec(self, tmp_path, runc):
+        bundle = self.make_bundle(tmp_path)
+        w = self.wrapper(runc, bundle)
+        with pytest.raises(RuntimeError_):
+            w.exec(["rt", "create", "c1"])
+        spec = json.loads((bundle / "config.json").read_text())
+        assert any(e.startswith(ENV_MEMORY_LIMIT_PREFIX)
+                   for e in spec["process"]["env"])
+
+    def test_create_without_bundle_or_spec_fails_loud(self, runc):
+        w = self.wrapper(runc)
+        with pytest.raises(RuntimeError_, match="no pinned spec"):
+            w.exec(["rt", "create", "c1"])
+
+    def test_non_create_passthrough(self, tmp_path, runc):
+        bundle = self.make_bundle(tmp_path)
+        before = (bundle / "config.json").read_text()
+        w = self.wrapper(runc, bundle)
+        with pytest.raises(RuntimeError_):
+            w.exec(["rt", "delete", "c1"])
+        assert (bundle / "config.json").read_text() == before
+
+    def test_create_after_global_flags(self, tmp_path, runc):
+        bundle = self.make_bundle(tmp_path)
+        w = self.wrapper(runc, bundle)
+        with pytest.raises(RuntimeError_):
+            w.exec(["rt", "--root", "/run/runc", "create",
+                    "--bundle", str(bundle), "c1"])
+        spec = json.loads((bundle / "config.json").read_text())
+        assert any(
+            e.startswith(ENV_MEMORY_LIMIT_PREFIX) for e in spec["process"]["env"]
+        )
+
+    def test_idempotent_reinjection(self, tmp_path, runc):
+        bundle = self.make_bundle(tmp_path)
+        w = self.wrapper(runc, bundle)
+        for _ in range(2):
+            with pytest.raises(RuntimeError_):
+                w.exec(["rt", "create", "--bundle", str(bundle), "c1"])
+        spec = json.loads((bundle / "config.json").read_text())
+        env = spec["process"]["env"]
+        assert sum(1 for e in env if e.startswith(ENV_MEMORY_LIMIT_PREFIX)) == 1
+        assert sum(1 for m in spec["mounts"]
+                   if m["destination"] == "/usr/local/vtpu") == 1
+
+
+class TestFileSpec:
+    def test_modify_without_load_raises(self, tmp_path):
+        s = FileSpec(str(tmp_path / "config.json"))
+        with pytest.raises(ValueError):
+            s.modify(lambda x: x)
+
+    def test_roundtrip(self, tmp_path):
+        p = tmp_path / "config.json"
+        p.write_text(json.dumps({"ociVersion": "1.0.2"}))
+        s = FileSpec(str(p))
+        s.load()
+        s.modify(lambda spec: {**spec, "hostname": "h"})
+        s.flush()
+        assert json.loads(p.read_text())["hostname"] == "h"
+
+
+class TestEntrypoint:
+    def test_config_to_modifier_to_exec(self, tmp_path, runc, monkeypatch):
+        import json as _json
+
+        from k8s_vgpu_scheduler_tpu.cmd import oci_runtime
+
+        cfg = tmp_path / "oci.json"
+        cfg.write_text(_json.dumps({
+            "chip_limits_mib": {"0": 2000},
+            "physical_mib": {"0": 16384},
+            "core_limit": 50,
+            "visible_chips": "u1",
+            "visible_devices": "0",
+        }))
+        bundle = tmp_path / "b"
+        bundle.mkdir()
+        (bundle / "config.json").write_text(_json.dumps(
+            {"process": {"env": []}, "mounts": []}))
+        monkeypatch.setenv("VTPU_OCI_RUNTIME", runc)
+        monkeypatch.setenv("VTPU_OCI_CONFIG", str(cfg))
+        execs = []
+        monkeypatch.setattr(os, "execve",
+                            lambda p, a, e: execs.append((p, a)))
+        from k8s_vgpu_scheduler_tpu.oci.runtime import RuntimeError_ as RE
+        with pytest.raises(RE):
+            oci_runtime.main(["vtpu-runc", "create",
+                              "--bundle", str(bundle), "c1"])
+        spec = _json.loads((bundle / "config.json").read_text())
+        env = spec["process"]["env"]
+        assert f"{ENV_MEMORY_LIMIT_PREFIX}0=2000" in env
+        assert "TPU_DEVICE_PHYSICAL_MEMORY_0=16384" in env
+        assert execs and execs[0][0] == runc
+
+
+class TestBundlePath:
+    def test_long_flag(self):
+        assert bundle_spec_path(["rt", "create", "--bundle", "/b", "c"]) == \
+            "/b/config.json"
+
+    def test_eq_form(self):
+        assert bundle_spec_path(["rt", "create", "--bundle=/b", "c"]) == \
+            "/b/config.json"
+
+    def test_absent(self):
+        assert bundle_spec_path(["rt", "state", "c"]) is None
