@@ -16,6 +16,7 @@ import time
 
 from ..deviceplugin import DeviceCache, DeviceRegister, TpuDevicePlugin
 from ..deviceplugin.allocator import publish_unsatisfiable
+from ..deviceplugin.partition import get_partition_plugins
 from ..k8s import make_client
 from ..tpulib import detect
 from ..util.config import Config
@@ -34,6 +35,9 @@ def parse_args(argv=None):
     p.add_argument("--disable-core-limit", action="store_true")
     p.add_argument("--topology-policy", default="best-effort",
                    choices=["best-effort", "restricted", "guaranteed"])
+    p.add_argument("--partition-strategy", default="none",
+                   choices=["none", "single", "mixed"],
+                   help="TensorCore partitioning (MIG-strategy analog)")
     p.add_argument("--socket-dir", default="/var/lib/kubelet/device-plugins")
     p.add_argument("--config-file", default="/config/config.json")
     p.add_argument("--shim-dir", default="/usr/local/vtpu")
@@ -83,6 +87,7 @@ def main(argv=None):
         device_cores_scaling=args.device_cores_scaling,
         disable_core_limit=args.disable_core_limit,
         topology_policy=args.topology_policy,
+        partition_strategy=args.partition_strategy,
         shim_host_dir=args.shim_dir,
         cache_host_dir=args.cache_dir,
     )
@@ -102,19 +107,44 @@ def main(argv=None):
         # (reference server.go:493–522).
         publish_unsatisfiable(client, cfg.node_name, inv, cfg.topology_policy)
 
-    cache.subscribe("plugin", on_health_change)
-    cache.subscribe("register", register.push_update)
-    publish_unsatisfiable(client, cfg.node_name, cache.inventory,
-                          cfg.topology_policy)
+    # Partition plugins (MIG-strategy analog, mig-strategy.go:169–210):
+    # `single` REPLACES the whole-chip plugin under the main resource name;
+    # `mixed` runs one extra plugin per partition flavor alongside it.
+    part_plugins = get_partition_plugins(
+        cfg.partition_strategy, client, cache.inventory, cfg, args.socket_dir
+    )
+    serve_main = not (cfg.partition_strategy == "single" and part_plugins)
+
+    def on_health_change2(inv):
+        for pp in part_plugins:
+            pp.notify_health_changed()
+
+    cache.subscribe("partition", on_health_change2)
+    if serve_main:
+        # Extender registration + the whole-chip fractional path only exist
+        # when the whole-chip plugin serves: under `single`, kubelet
+        # allocates partitions by passthrough, so streaming whole-chip
+        # inventory to the extender would double-book chips it doesn't
+        # actually manage.
+        cache.subscribe("plugin", on_health_change)
+        cache.subscribe("register", register.push_update)
+        publish_unsatisfiable(client, cfg.node_name, cache.inventory,
+                              cfg.topology_policy)
     cache.start()
-    register.start()
-    plugin.serve()
+    if serve_main:
+        register.start()
+        plugin.serve()
+    for pp in part_plugins:
+        pp.serve()
 
     kubelet_sock = os.path.join(args.socket_dir, "kubelet.sock")
 
     def try_register():
         try:
-            plugin.register_with_kubelet(kubelet_sock)
+            if serve_main:
+                plugin.register_with_kubelet(kubelet_sock)
+            for pp in part_plugins:
+                pp.register_with_kubelet(kubelet_sock)
             return True
         except Exception as e:  # noqa: BLE001
             log.warning("kubelet registration failed: %s", e)
@@ -143,6 +173,8 @@ def main(argv=None):
             elif not registered:
                 registered = try_register()
     except KeyboardInterrupt:
+        for pp in part_plugins:
+            pp.stop()
         plugin.stop()
         register.stop()
         cache.stop()

@@ -22,6 +22,10 @@ def parse_args(argv=None):
     p.add_argument("--metrics-port", type=int, default=9394)
     p.add_argument("--grpc-port", type=int, default=9395,
                    help="NodeTPUInfo gRPC port (0 = disabled)")
+    p.add_argument("--grpc-bind", default="[::]",
+                   help="NodeTPUInfo bind address; the endpoint is "
+                        "unauthenticated — use 127.0.0.1 for node-local "
+                        "tooling or restrict with a NetworkPolicy")
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     p.add_argument("--no-backend", action="store_true",
@@ -50,7 +54,7 @@ def main(argv=None):
         from ..monitor.noderpc import NodeTPUInfoServer
 
         rpc = NodeTPUInfoServer(loop, node)
-        rpc.serve(args.grpc_port)
+        rpc.serve(args.grpc_port, args.grpc_bind)
     logging.info("vtpu-monitor up: root=%s metrics=:%d grpc=:%d",
                  args.container_root, args.metrics_port, args.grpc_port)
     try:

@@ -51,9 +51,15 @@ def main(argv=None) -> None:
     runtime_path = os.environ.get("VTPU_OCI_RUNTIME", "/usr/bin/runc")
     config_path = os.environ.get("VTPU_OCI_CONFIG", DEFAULT_CONFIG)
     logging.basicConfig(level=logging.INFO)
-    modifier = load_modifier(config_path)
+
+    def lazy_modifier(spec: dict) -> dict:
+        # Loaded only on the create path: delete/state/kill of existing
+        # containers must keep working even with a missing/broken grant
+        # config, or stuck containers could never be cleaned up.
+        return load_modifier(config_path)(spec)
+
     wrapper = ModifyingRuntimeWrapper(
-        SyscallExecRuntime(runtime_path), modifier
+        SyscallExecRuntime(runtime_path), lazy_modifier
     )
     wrapper.exec(argv)
 

@@ -1,0 +1,272 @@
+"""Chip-partition strategies — the MIG analog for TPU.
+
+Reference: pkg/device-plugin/mig-strategy.go (none/single/mixed, 46–210) and
+the MIG passthrough allocation path (MIGAllocate, plugin.go:285–315).
+
+On NVIDIA the sub-device unit is a MIG slice (``nvidia.com/mig-<g>g.<mem>gb``);
+the TPU-native equivalent is the **TensorCore partition**: v4/v5p chips carry
+two TensorCores that can run independent programs when megacore fusion is off
+(each with half the HBM), so a chip splits into core-granular partitions
+``google.com/tpu-1c.<mem>gb``.  v5e/v6e chips are single-core and do not
+partition (the analog of a GPU without MIG support).
+
+Strategies:
+- ``none``   — whole chips only (partitioning ignored);
+- ``single`` — every chip partitioned identically; partitions are advertised
+  under the MAIN resource name (homogeneous cluster nodes);
+- ``mixed``  — partitions advertised as their own resource names, one extra
+  kubelet plugin per partition flavor on its own socket.
+
+Partition allocation is kubelet-passthrough (reference MIGAllocate): the
+scheduler extender is not in the loop; kubelet's chosen device IDs map
+directly to partitions, and the response env pins the partition's chip,
+core share and HBM slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+from ..tpulib.types import ChipInfo, NodeInventory, TopologyDesc
+from ..util.config import Config
+from ..util.types import (
+    ENV_CORE_LIMIT,
+    ENV_MEMORY_LIMIT_PREFIX,
+    ENV_PHYSICAL_MEMORY_PREFIX,
+    ENV_VISIBLE_CHIPS,
+    ENV_VISIBLE_DEVICES,
+)
+
+log = logging.getLogger(__name__)
+
+STRATEGY_NONE = "none"
+STRATEGY_SINGLE = "single"
+STRATEGY_MIXED = "mixed"
+
+# TensorCores per chip by generation: v4/v5p are dual-core (megacore pairs),
+# v5e/v6e single-core.
+CORES_PER_CHIP = {"v4": 2, "v5p": 2, "v5e": 1, "v6e": 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """One TensorCore partition of a physical chip."""
+
+    uuid: str          # "<chip-uuid>/core<k>"
+    chip_uuid: str
+    chip_index: int
+    core: int          # core ordinal on the chip
+    hbm_mib: int       # this partition's HBM slice
+    healthy: bool
+
+    @property
+    def resource_suffix(self) -> str:
+        """``1c.<mem>gb`` — flavor key, the mig-<g>g.<mem>gb analog."""
+        return f"1c.{max(1, self.hbm_mib // 1024)}gb"
+
+
+def cores_per_chip(topo: TopologyDesc) -> int:
+    return CORES_PER_CHIP.get(topo.generation, 1)
+
+
+def enumerate_partitions(inv: NodeInventory) -> List[Partition]:
+    """Split every chip into its TensorCore partitions (1 core + an equal
+    HBM share each).  Single-core generations yield no partitions — like a
+    non-MIG GPU, the whole chip is the only unit."""
+    n = cores_per_chip(inv.topology)
+    if n < 2:
+        return []
+    out = []
+    for chip in inv.chips:
+        share = chip.hbm_mib // n
+        for k in range(n):
+            out.append(
+                Partition(
+                    uuid=f"{chip.uuid}/core{k}",
+                    chip_uuid=chip.uuid,
+                    chip_index=chip.index,
+                    core=k,
+                    hbm_mib=share,
+                    healthy=chip.healthy,
+                )
+            )
+    return out
+
+
+class PartitionDevicePlugin:
+    """Kubelet plugin serving one partition flavor by passthrough allocation
+    (reference MIGAllocate, plugin.go:285–315): no extender handshake — the
+    device IDs kubelet picked ARE the grant."""
+
+    def __init__(self, resource_name: str, inventory: NodeInventory,
+                 cfg: Config, socket_dir: str, socket_name: str,
+                 flavor: Optional[str] = None) -> None:
+        # Import here to avoid a cycle (plugin.py does not know partitions).
+        from .plugin import TpuDevicePlugin  # noqa: PLC0415
+
+        self.resource_name = resource_name
+        # Live inventory reference: DeviceCache.refresh_health mutates
+        # ChipInfo in place, so partitions must be re-derived per use —
+        # a frozen startup snapshot would advertise stale health forever.
+        self.inventory = inventory
+        self.flavor = flavor  # restrict to one resource_suffix (mixed mode)
+        self.cfg = cfg
+        # Reuse the serving shell (socket lifecycle, ListAndWatch queues) and
+        # override the allocation + device surface.
+        self._shell = TpuDevicePlugin(
+            client=None, inventory=NodeInventory(chips=[], topology=None),
+            cfg=cfg, socket_dir=socket_dir, socket_name=socket_name,
+        )
+        self._shell.resource_name = resource_name
+        self._shell.api_devices = self.api_devices
+        self._shell.Allocate = self.Allocate
+        self._shell.GetPreferredAllocation = self.GetPreferredAllocation
+
+    # -- device surface --------------------------------------------------------
+    @property
+    def partitions(self) -> Dict[str, Partition]:
+        """Current partitions (health re-derived from live chip state)."""
+        return {
+            p.uuid: p
+            for p in enumerate_partitions(self.inventory)
+            if self.flavor is None or p.resource_suffix == self.flavor
+        }
+
+    def api_devices(self):
+        from ..api import deviceplugin_pb2 as pb  # noqa: PLC0415
+
+        return [
+            pb.Device(ID=p.uuid, health="Healthy" if p.healthy else "Unhealthy")
+            for p in self.partitions.values()
+        ]
+
+    def GetPreferredAllocation(self, request, context):  # noqa: N802
+        from ..api import deviceplugin_pb2 as pb  # noqa: PLC0415
+
+        # Prefer partitions packed onto the fewest chips.
+        resp = pb.PreferredAllocationResponse()
+        parts = self.partitions
+        for creq in request.container_requests:
+            by_chip: Dict[str, List[str]] = {}
+            for vid in creq.available_deviceIDs:
+                p = parts.get(vid)
+                if p is not None:
+                    by_chip.setdefault(p.chip_uuid, []).append(vid)
+            chosen = list(creq.must_include_deviceIDs)
+            for chip_vids in sorted(by_chip.values(), key=len, reverse=True):
+                for vid in chip_vids:
+                    if len(chosen) >= creq.allocation_size:
+                        break
+                    if vid not in chosen:
+                        chosen.append(vid)
+            resp.container_responses.append(
+                pb.ContainerPreferredAllocationResponse(
+                    deviceIDs=chosen[: creq.allocation_size]
+                )
+            )
+        return resp
+
+    # -- passthrough allocation (MIGAllocate analog) ---------------------------
+    def Allocate(self, request, context):  # noqa: N802
+        from ..api import deviceplugin_pb2 as pb  # noqa: PLC0415
+
+        responses = pb.AllocateResponse()
+        parts = self.partitions
+        for creq in request.container_requests:
+            resp = pb.ContainerAllocateResponse()
+            chips: List[str] = []
+            indices: List[str] = []
+            cores_by_chip: Dict[str, int] = {}
+            for i, vid in enumerate(creq.devicesIDs):
+                p = parts.get(vid)
+                if p is None:
+                    import grpc  # noqa: PLC0415
+
+                    context.abort(
+                        grpc.StatusCode.INVALID_ARGUMENT,
+                        f"unknown partition {vid}",
+                    )
+                resp.envs[f"{ENV_MEMORY_LIMIT_PREFIX}{i}"] = str(p.hbm_mib)
+                resp.envs[f"{ENV_PHYSICAL_MEMORY_PREFIX}{i}"] = str(p.hbm_mib)
+                if p.chip_uuid not in chips:
+                    chips.append(p.chip_uuid)
+                    indices.append(str(p.chip_index))
+                cores_by_chip[p.chip_uuid] = (
+                    cores_by_chip.get(p.chip_uuid, 0) + 1
+                )
+            # Core share: partitions-per-chip granted / cores on the chip,
+            # as a percentage — one core of a dual-core chip = 50.
+            if chips:
+                total = cores_per_chip_for(parts, chips[0])
+                share = max(cores_by_chip.values())
+                resp.envs[ENV_CORE_LIMIT] = str(100 * share // total)
+            resp.envs[ENV_VISIBLE_CHIPS] = ",".join(chips)
+            resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
+            responses.container_responses.append(resp)
+        return responses
+
+    # -- lifecycle passthrough -------------------------------------------------
+    def serve(self) -> None:
+        self._shell.serve()
+
+    def register_with_kubelet(self, kubelet_socket: Optional[str] = None):
+        return self._shell.register_with_kubelet(kubelet_socket)
+
+    def notify_health_changed(self) -> None:
+        self._shell.notify_health_changed()
+
+    def stop(self) -> None:
+        self._shell.stop()
+
+    @property
+    def socket_path(self) -> str:
+        return self._shell.socket_path
+
+
+def cores_per_chip_for(partitions: Dict[str, Partition], chip_uuid: str) -> int:
+    return sum(1 for p in partitions.values() if p.chip_uuid == chip_uuid)
+
+
+def get_partition_plugins(
+    strategy: str,
+    client,
+    inventory: NodeInventory,
+    cfg: Config,
+    socket_dir: str,
+) -> List[object]:
+    """Build the plugin set for a strategy (NewMigStrategy→GetPlugins analog).
+
+    Returns extra plugins to run ALONGSIDE the main whole-chip plugin for
+    ``mixed``; for ``single`` the caller swaps the main plugin's device list;
+    ``none`` (and non-partitionable generations) yields nothing.
+    """
+    if strategy == STRATEGY_NONE:
+        return []
+    parts = enumerate_partitions(inventory)
+    if not parts:
+        if strategy != STRATEGY_NONE:
+            log.info(
+                "partition strategy %s: generation %s is single-core; "
+                "no partitions", strategy, inventory.topology.generation,
+            )
+        return []
+    if strategy == STRATEGY_SINGLE:
+        # Homogeneous: advertise partitions under the main resource name.
+        return [
+            PartitionDevicePlugin(
+                cfg.resources.count, inventory, cfg, socket_dir,
+                socket_name="vtpu-single.sock",
+            )
+        ]
+    if strategy == STRATEGY_MIXED:
+        suffixes = sorted({p.resource_suffix for p in parts})
+        return [
+            PartitionDevicePlugin(
+                f"google.com/tpu-{suffix}", inventory, cfg, socket_dir,
+                socket_name=f"vtpu-{suffix}.sock", flavor=suffix,
+            )
+            for suffix in suffixes
+        ]
+    raise ValueError(f"unknown partition strategy: {strategy}")

@@ -64,7 +64,11 @@ class NodeTPUInfoServer:
         return reply
 
     # -- serving ---------------------------------------------------------------
-    def serve(self, port: int) -> int:
+    def serve(self, port: int, bind_addr: str = "[::]") -> int:
+        """``bind_addr`` matters on hostNetwork DaemonSets: the default
+        listens on every node interface (the endpoint is unauthenticated —
+        restrict with a NetworkPolicy or bind 127.0.0.1 for node-local-only
+        tooling)."""
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
         handler = grpc.method_handlers_generic_handler(
             SERVICE_NAME,
@@ -77,9 +81,14 @@ class NodeTPUInfoServer:
             },
         )
         self._server.add_generic_rpc_handlers((handler,))
-        bound = self._server.add_insecure_port(f"[::]:{port}")
+        bound = self._server.add_insecure_port(f"{bind_addr}:{port}")
+        if bound == 0:
+            # grpc reports a failed bind as port 0 with no exception; a
+            # silently dead RPC would strand every consumer of the
+            # advertised service.
+            raise OSError(f"NodeTPUInfo cannot bind {bind_addr}:{port}")
         self._server.start()
-        log.info("NodeTPUInfo serving on :%d", bound)
+        log.info("NodeTPUInfo serving on %s:%d", bind_addr, bound)
         return bound
 
     def stop(self) -> None:

@@ -53,5 +53,8 @@ class Config:
     # Topology placement policy default for multi-chip requests.
     topology_policy: str = "best-effort"
 
+    # Chip-partition strategy (MIG analog): none | single | mixed.
+    partition_strategy: str = "none"
+
 
 DEFAULT_CONFIG = Config()

@@ -71,10 +71,15 @@ class TestModels:
         model = DeepLabV3(cfg)
         x = jnp.zeros((1, 64, 64, 3), jnp.float32)
         params = model.init(jax.random.PRNGKey(0), x)
-        # Output stride 16: the ASPP input (classifier conv input) is 64/16.
-        flat = jax.tree_util.tree_leaves_with_path(params)
-        clf = [l for p, l in flat if "classifier" in str(p) and l.ndim == 4]
-        assert clf and clf[0].shape[-2:] == (256, 3)  # aspp_features -> classes
+        # Output stride 16, not 32: the atrous last stage must keep the
+        # stage-2 resolution, so the ASPP output activation is 64/16 = 4.
+        _, inter = model.apply(
+            params, x, capture_intermediates=lambda mdl, _: mdl.name == "aspp"
+        )
+        aspp_out = jax.tree_util.tree_leaves(
+            inter["intermediates"]["aspp"]["__call__"]
+        )[0]
+        assert aspp_out.shape[1:3] == (4, 4)
 
     def test_deeplab_train_step(self):
         import optax

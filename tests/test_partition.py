@@ -1,0 +1,178 @@
+"""Chip-partition strategy tests (MIG-strategy analog, reference
+mig-strategy.go none/single/mixed + MIGAllocate passthrough)."""
+
+import itertools
+
+import grpc
+import pytest
+
+from k8s_vgpu_scheduler_tpu.api import deviceplugin_pb2 as pb
+from k8s_vgpu_scheduler_tpu.deviceplugin.partition import (
+    PartitionDevicePlugin,
+    enumerate_partitions,
+    get_partition_plugins,
+)
+from k8s_vgpu_scheduler_tpu.tpulib.types import (
+    ChipInfo,
+    NodeInventory,
+    TopologyDesc,
+)
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import (
+    ENV_CORE_LIMIT,
+    ENV_MEMORY_LIMIT_PREFIX,
+    ENV_VISIBLE_CHIPS,
+)
+
+
+def make_inventory(generation="v5p", mesh=(2, 2, 1), hbm=95 * 1024,
+                   unhealthy=()):
+    chips = []
+    for i, c in enumerate(itertools.product(*(range(d) for d in mesh))):
+        chips.append(
+            ChipInfo(index=i, uuid=f"chip{i}", type=f"TPU-{generation}",
+                     hbm_mib=hbm, coords=c,
+                     healthy=c not in set(unhealthy)))
+    return NodeInventory(
+        chips=chips, topology=TopologyDesc(generation=generation, mesh=mesh)
+    )
+
+
+class TestEnumeration:
+    def test_v5p_dual_core_split(self):
+        inv = make_inventory("v5p", hbm=95 * 1024)
+        parts = enumerate_partitions(inv)
+        assert len(parts) == 8  # 4 chips x 2 cores
+        p = parts[0]
+        assert p.uuid == "chip0/core0"
+        assert p.hbm_mib == 95 * 1024 // 2
+        assert p.resource_suffix == "1c.47gb"
+
+    def test_v5e_single_core_no_partitions(self):
+        inv = make_inventory("v5e", mesh=(2, 2))
+        assert enumerate_partitions(inv) == []
+
+    def test_unhealthy_chip_propagates(self):
+        inv = make_inventory("v5p", unhealthy=[(0, 1, 0)])
+        parts = enumerate_partitions(inv)
+        sick = [p for p in parts if not p.healthy]
+        assert len(sick) == 2  # both cores of the dead chip
+
+
+class TestStrategies:
+    def test_none_yields_nothing(self):
+        inv = make_inventory("v5p")
+        assert get_partition_plugins("none", None, inv, Config(), "/tmp") == []
+
+    def test_single_replaces_main_resource(self, tmp_path):
+        inv = make_inventory("v5p")
+        plugins = get_partition_plugins(
+            "single", None, inv, Config(), str(tmp_path))
+        assert len(plugins) == 1
+        assert plugins[0].resource_name == "google.com/tpu"
+        assert len(plugins[0].partitions) == 8
+
+    def test_mixed_one_plugin_per_flavor(self, tmp_path):
+        inv = make_inventory("v5p")
+        plugins = get_partition_plugins(
+            "mixed", None, inv, Config(), str(tmp_path))
+        assert [p.resource_name for p in plugins] == ["google.com/tpu-1c.47gb"]
+
+    def test_single_core_generation_yields_nothing(self, tmp_path):
+        inv = make_inventory("v5e", mesh=(2, 2))
+        assert get_partition_plugins(
+            "mixed", None, inv, Config(), str(tmp_path)) == []
+
+    def test_health_flip_reflected_live(self, tmp_path):
+        # DeviceCache mutates ChipInfo in place; partition advertising must
+        # follow, not freeze the startup snapshot.
+        inv = make_inventory("v5p")
+        plugin = get_partition_plugins(
+            "mixed", None, inv, Config(), str(tmp_path))[0]
+        assert all(d.health == "Healthy" for d in plugin.api_devices())
+        inv.chips[0].healthy = False
+        sick = [d for d in plugin.api_devices() if d.health == "Unhealthy"]
+        assert {d.ID for d in sick} == {"chip0/core0", "chip0/core1"}
+
+    def test_unknown_strategy_raises(self):
+        with pytest.raises(ValueError):
+            get_partition_plugins("bogus", None, make_inventory(), Config(),
+                                  "/tmp")
+
+
+@pytest.fixture
+def served(tmp_path):
+    inv = make_inventory("v5p", hbm=32 * 1024)
+    plugin = get_partition_plugins(
+        "mixed", None, inv, Config(), str(tmp_path))[0]
+    plugin.serve()
+    ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+    yield plugin, ch
+    plugin.stop()
+
+
+def call(ch, method, req_cls, resp_cls, req):
+    fn = ch.unary_unary(
+        f"/v1beta1.DevicePlugin/{method}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString,
+    )
+    return fn(req, timeout=10)
+
+
+class TestPassthroughAllocate:
+    def test_allocate_pins_partition_env(self, served):
+        plugin, ch = served
+        resp = call(ch, "Allocate", pb.AllocateRequest, pb.AllocateResponse,
+                    pb.AllocateRequest(container_requests=[
+                        pb.ContainerAllocateRequest(
+                            devicesIDs=["chip1/core0"])]))
+        envs = resp.container_responses[0].envs
+        assert envs[f"{ENV_MEMORY_LIMIT_PREFIX}0"] == str(16 * 1024)
+        assert envs[ENV_VISIBLE_CHIPS] == "chip1"
+        assert envs[ENV_CORE_LIMIT] == "50"  # 1 of 2 cores
+
+    def test_allocate_both_cores_full_chip(self, served):
+        plugin, ch = served
+        resp = call(ch, "Allocate", pb.AllocateRequest, pb.AllocateResponse,
+                    pb.AllocateRequest(container_requests=[
+                        pb.ContainerAllocateRequest(
+                            devicesIDs=["chip2/core0", "chip2/core1"])]))
+        envs = resp.container_responses[0].envs
+        assert envs[ENV_CORE_LIMIT] == "100"
+        assert envs[ENV_VISIBLE_CHIPS] == "chip2"
+
+    def test_allocate_unknown_partition_fails(self, served):
+        plugin, ch = served
+        with pytest.raises(grpc.RpcError) as e:
+            call(ch, "Allocate", pb.AllocateRequest, pb.AllocateResponse,
+                 pb.AllocateRequest(container_requests=[
+                     pb.ContainerAllocateRequest(devicesIDs=["nope/core9"])]))
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_list_and_watch_serves_partitions(self, served):
+        plugin, ch = served
+        fn = ch.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        first = next(iter(fn(pb.Empty(), timeout=10)))
+        ids = {d.ID for d in first.devices}
+        assert "chip0/core0" in ids and len(ids) == 8
+
+    def test_preferred_packs_same_chip(self, served):
+        plugin, ch = served
+        resp = call(ch, "GetPreferredAllocation",
+                    pb.PreferredAllocationRequest,
+                    pb.PreferredAllocationResponse,
+                    pb.PreferredAllocationRequest(container_requests=[
+                        pb.ContainerPreferredAllocationRequest(
+                            available_deviceIDs=[
+                                "chip0/core0", "chip1/core0", "chip1/core1",
+                                "chip3/core1",
+                            ],
+                            allocation_size=2,
+                        )]))
+        ids = list(resp.container_responses[0].deviceIDs)
+        assert ids == ["chip1/core0", "chip1/core1"]

@@ -322,10 +322,17 @@ print(shim.memory_info(0)["used"] // (1024*1024))
             """
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The env sitecustomize imports jax at interpreter start with
+# JAX_PLATFORMS=axon, so the env var alone is too late here (same trap
+# conftest.py documents): flip the live config or the first dispatch
+# initializes the real-TPU backend and hangs the child when the tunnel
+# is busy/unavailable.
+import jax
+jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.environ["REPO"])
 from k8s_vgpu_scheduler_tpu.shim import core
 shim = core.install(jax_hooks=True, ballast=False, watchdog=False)
-import jax, jax.numpy as jnp
+import jax.numpy as jnp
 f = jax.jit(lambda x: (x * 2).sum())
 out = f(jnp.arange(1000.0))
 print("result", float(out))
