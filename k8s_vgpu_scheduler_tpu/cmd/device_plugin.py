@@ -16,7 +16,7 @@ import time
 
 from ..deviceplugin import DeviceCache, DeviceRegister, TpuDevicePlugin
 from ..deviceplugin.allocator import publish_unsatisfiable
-from ..deviceplugin.partition import get_partition_plugins
+from ..deviceplugin.partition import get_partition_plugins, whole_chip_view
 from ..k8s import make_client
 from ..tpulib import detect
 from ..util.config import Config
@@ -38,6 +38,16 @@ def parse_args(argv=None):
     p.add_argument("--partition-strategy", default="none",
                    choices=["none", "single", "mixed"],
                    help="TensorCore partitioning (MIG-strategy analog)")
+    p.add_argument("--partition-chips", default="",
+                   help="comma-separated chip uuids to partition (empty = "
+                        "all chips when --partition-strategy is set); "
+                        "designated chips are hidden from the whole-chip "
+                        "fractional path")
+    p.add_argument("--mode", default="mem-share",
+                   choices=["default", "mem-share", "env-share"],
+                   help="sharing mode (reference MLU modes): mem-share = "
+                        "fractional HBM caps, env-share = time-slice with "
+                        "no caps, default = exclusive whole chips")
     p.add_argument("--socket-dir", default="/var/lib/kubelet/device-plugins")
     p.add_argument("--config-file", default="/config/config.json")
     p.add_argument("--shim-dir", default="/usr/local/vtpu")
@@ -88,6 +98,10 @@ def main(argv=None):
         disable_core_limit=args.disable_core_limit,
         topology_policy=args.topology_policy,
         partition_strategy=args.partition_strategy,
+        partition_chips=tuple(
+            c for c in args.partition_chips.split(",") if c
+        ),
+        sharing_mode=args.mode,
         shim_host_dir=args.shim_dir,
         cache_host_dir=args.cache_dir,
     )
@@ -96,7 +110,11 @@ def main(argv=None):
     client = make_client(fake=args.fake_kube, kube_url=args.kube_url)
     backend = detect()
     cache = DeviceCache(backend)
-    plugin = TpuDevicePlugin(client, cache.inventory, cfg,
+    # Whole-chip surfaces (kubelet fan-out, extender stream, annotations)
+    # exclude partition-designated chips; ChipInfo objects are shared with
+    # the cache inventory so health refreshes still propagate.
+    whole_inv = whole_chip_view(cache.inventory, cfg)
+    plugin = TpuDevicePlugin(client, whole_inv, cfg,
                              socket_dir=args.socket_dir)
     register = DeviceRegister(backend, cfg)
 
@@ -105,7 +123,9 @@ def main(argv=None):
         # Health changes alter which slice sizes remain placeable; keep the
         # advisory unsatisfiable-sizes node annotation in sync
         # (reference server.go:493–522).
-        publish_unsatisfiable(client, cfg.node_name, inv, cfg.topology_policy)
+        publish_unsatisfiable(client, cfg.node_name,
+                              whole_chip_view(inv, cfg),
+                              cfg.topology_policy)
 
     # Partition plugins (MIG-strategy analog, mig-strategy.go:169–210):
     # `single` REPLACES the whole-chip plugin under the main resource name;
@@ -128,7 +148,7 @@ def main(argv=None):
         # actually manage.
         cache.subscribe("plugin", on_health_change)
         cache.subscribe("register", register.push_update)
-        publish_unsatisfiable(client, cfg.node_name, cache.inventory,
+        publish_unsatisfiable(client, cfg.node_name, whole_inv,
                               cfg.topology_policy)
     cache.start()
     if serve_main:

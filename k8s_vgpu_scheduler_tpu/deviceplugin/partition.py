@@ -71,15 +71,44 @@ def cores_per_chip(topo: TopologyDesc) -> int:
     return CORES_PER_CHIP.get(topo.generation, 1)
 
 
-def enumerate_partitions(inv: NodeInventory) -> List[Partition]:
-    """Split every chip into its TensorCore partitions (1 core + an equal
+def designated_chips(inv: NodeInventory, cfg: Config) -> List[ChipInfo]:
+    """Chips designated for partitioning (cfg.partition_chips uuids; empty =
+    all) — the analog of the reference's 'MIG-enabled' GPU set."""
+    if not cfg.partition_chips:
+        return list(inv.chips)
+    wanted = set(cfg.partition_chips)
+    return [c for c in inv.chips if c.uuid in wanted]
+
+
+def whole_chip_view(inv: NodeInventory, cfg: Config) -> NodeInventory:
+    """Inventory for the whole-chip plugin/extender: EXCLUDES designated
+    partition chips (nvidia.go:84–107 skips MIG-enabled GPUs) so the
+    extender path and the partition passthrough path can never double-book
+    the same chip's HBM.  Shares ChipInfo objects with ``inv`` so in-place
+    health refreshes propagate."""
+    if cfg.partition_strategy == STRATEGY_NONE:
+        return inv
+    excluded = {c.uuid for c in designated_chips(inv, cfg)
+                if cores_per_chip(inv.topology) >= 2}
+    if not excluded:
+        return inv
+    return NodeInventory(
+        chips=[c for c in inv.chips if c.uuid not in excluded],
+        topology=inv.topology,
+    )
+
+
+def enumerate_partitions(inv: NodeInventory,
+                         cfg: Optional[Config] = None) -> List[Partition]:
+    """Split designated chips into TensorCore partitions (1 core + an equal
     HBM share each).  Single-core generations yield no partitions — like a
     non-MIG GPU, the whole chip is the only unit."""
     n = cores_per_chip(inv.topology)
     if n < 2:
         return []
     out = []
-    for chip in inv.chips:
+    chips = designated_chips(inv, cfg) if cfg is not None else inv.chips
+    for chip in chips:
         share = chip.hbm_mib // n
         for k in range(n):
             out.append(
@@ -130,7 +159,7 @@ class PartitionDevicePlugin:
         """Current partitions (health re-derived from live chip state)."""
         return {
             p.uuid: p
-            for p in enumerate_partitions(self.inventory)
+            for p in enumerate_partitions(self.inventory, self.cfg)
             if self.flavor is None or p.resource_suffix == self.flavor
         }
 
@@ -170,7 +199,13 @@ class PartitionDevicePlugin:
 
     # -- passthrough allocation (MIGAllocate analog) ---------------------------
     def Allocate(self, request, context):  # noqa: N802
+        import uuid as uuidlib  # noqa: PLC0415
+
         from ..api import deviceplugin_pb2 as pb  # noqa: PLC0415
+        from .plugin import (  # noqa: PLC0415
+            attach_device_node,
+            attach_enforcement,
+        )
 
         responses = pb.AllocateResponse()
         parts = self.partitions
@@ -178,8 +213,9 @@ class PartitionDevicePlugin:
             resp = pb.ContainerAllocateResponse()
             chips: List[str] = []
             indices: List[str] = []
+            mib_by_chip: Dict[str, int] = {}
             cores_by_chip: Dict[str, int] = {}
-            for i, vid in enumerate(creq.devicesIDs):
+            for vid in creq.devicesIDs:
                 p = parts.get(vid)
                 if p is None:
                     import grpc  # noqa: PLC0415
@@ -188,22 +224,46 @@ class PartitionDevicePlugin:
                         grpc.StatusCode.INVALID_ARGUMENT,
                         f"unknown partition {vid}",
                     )
-                resp.envs[f"{ENV_MEMORY_LIMIT_PREFIX}{i}"] = str(p.hbm_mib)
-                resp.envs[f"{ENV_PHYSICAL_MEMORY_PREFIX}{i}"] = str(p.hbm_mib)
                 if p.chip_uuid not in chips:
                     chips.append(p.chip_uuid)
                     indices.append(str(p.chip_index))
+                    attach_device_node(resp, p.chip_index)
+                mib_by_chip[p.chip_uuid] = (
+                    mib_by_chip.get(p.chip_uuid, 0) + p.hbm_mib
+                )
                 cores_by_chip[p.chip_uuid] = (
                     cores_by_chip.get(p.chip_uuid, 0) + 1
                 )
+            # The shim maps MEMORY_LIMIT_<i> to the i-th entry of
+            # TPU_VISIBLE_CHIPS (region.cc apply_env_limits): index by chip,
+            # aggregating the shares of every granted partition on it — both
+            # cores of a chip = the whole chip's HBM.  PHYSICAL stays the
+            # FULL chip size: the shim's ballast is physical − limit, so
+            # reporting the share as physical would zero the ballast and
+            # silently disable enforcement.
+            for i, chip_uuid in enumerate(chips):
+                chip = self.inventory.chip_by_uuid(chip_uuid)
+                resp.envs[f"{ENV_MEMORY_LIMIT_PREFIX}{i}"] = str(
+                    mib_by_chip[chip_uuid]
+                )
+                resp.envs[f"{ENV_PHYSICAL_MEMORY_PREFIX}{i}"] = str(
+                    chip.hbm_mib if chip else mib_by_chip[chip_uuid]
+                )
             # Core share: partitions-per-chip granted / cores on the chip,
             # as a percentage — one core of a dual-core chip = 50.
-            if chips:
+            if chips and not self.cfg.disable_core_limit:
                 total = cores_per_chip_for(parts, chips[0])
                 share = max(cores_by_chip.values())
                 resp.envs[ENV_CORE_LIMIT] = str(100 * share // total)
             resp.envs[ENV_VISIBLE_CHIPS] = ",".join(chips)
             resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
+            # No pod identity on the passthrough path (no annotation
+            # handshake), so the region dir is keyed by a fresh token; the
+            # monitor still scans and enforces it, it just can't attribute
+            # it to a pod name in metrics.
+            attach_enforcement(
+                resp, self.cfg, f"part-{uuidlib.uuid4().hex[:12]}"
+            )
             responses.container_responses.append(resp)
         return responses
 
@@ -244,13 +304,13 @@ def get_partition_plugins(
     """
     if strategy == STRATEGY_NONE:
         return []
-    parts = enumerate_partitions(inventory)
+    parts = enumerate_partitions(inventory, cfg)
     if not parts:
-        if strategy != STRATEGY_NONE:
-            log.info(
-                "partition strategy %s: generation %s is single-core; "
-                "no partitions", strategy, inventory.topology.generation,
-            )
+        log.info(
+            "partition strategy %s: generation %s is single-core or no "
+            "chips designated; no partitions",
+            strategy, inventory.topology.generation,
+        )
         return []
     if strategy == STRATEGY_SINGLE:
         # Homogeneous: advertise partitions under the main resource name.

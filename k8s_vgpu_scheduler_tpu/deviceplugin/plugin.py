@@ -68,6 +68,57 @@ HEALTHY = "Healthy"
 UNHEALTHY = "Unhealthy"
 
 
+def attach_enforcement(resp, cfg: Config, cache_key: str) -> None:
+    """Attach the L1 enforcement contract to an allocate response: the
+    per-container shared accounting region (hostPath dir, scanned by the
+    monitor — reference CUDA_DEVICE_MEMORY_SHARED_CACHE +
+    /tmp/vgpu/containers/<uid_ctr>, plugin.go:353–380, pathmonitor.go:17)
+    and the shim library + ld.so.preload mounts.  Shared by the extender
+    path and the partition passthrough path."""
+    cache_dir = os.path.join(cfg.cache_host_dir, cache_key)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as e:
+        log.warning("cannot create cache dir %s: %s", cache_dir, e)
+    container_cache = "/tmp/vtpu/vtpu.cache"
+    resp.envs[ENV_SHARED_CACHE] = container_cache
+    resp.mounts.append(
+        pb.Mount(
+            container_path=os.path.dirname(container_cache),
+            host_path=cache_dir,
+            read_only=False,
+        )
+    )
+    if cfg.shim_host_dir and os.path.isdir(cfg.shim_host_dir):
+        resp.mounts.append(
+            pb.Mount(
+                container_path="/usr/local/vtpu",
+                host_path=cfg.shim_host_dir,
+                read_only=True,
+            )
+        )
+        preload = os.path.join(cfg.shim_host_dir, "ld.so.preload")
+        if os.path.exists(preload):
+            resp.mounts.append(
+                pb.Mount(
+                    container_path="/etc/ld.so.preload",
+                    host_path=preload,
+                    read_only=True,
+                )
+            )
+
+
+def attach_device_node(resp, chip_index: int) -> None:
+    """Mount the chip's device node when the platform exposes one."""
+    dev_node = f"/dev/accel{chip_index}"
+    if os.path.exists(dev_node):
+        resp.devices.append(
+            pb.DeviceSpec(
+                container_path=dev_node, host_path=dev_node, permissions="rw"
+            )
+        )
+
+
 class TpuDevicePlugin:
     """Serves the kubelet DevicePlugin API for the ``google.com/tpu`` resource."""
 
@@ -101,7 +152,7 @@ class TpuDevicePlugin:
     def api_devices(self) -> List[pb.Device]:
         out = []
         for chip in self.inventory.chips:
-            for k in range(self.cfg.device_split_count):
+            for k in range(self.cfg.effective_split_count()):
                 out.append(
                     pb.Device(
                         ID=f"{chip.uuid}-{k}",
@@ -198,8 +249,12 @@ class TpuDevicePlugin:
         anns = pod.get("metadata", {}).get("annotations", {})
         uuids = []
         indices = []
+        # env-share time-slices the whole chip: sharers get no HBM caps
+        # (reference env-share mode emits only visibility env).
+        enforce_mem = self.cfg.sharing_mode != "env-share"
         for i, dev in enumerate(grant):
-            resp.envs[f"{ENV_MEMORY_LIMIT_PREFIX}{i}"] = str(dev.usedmem)
+            if enforce_mem:
+                resp.envs[f"{ENV_MEMORY_LIMIT_PREFIX}{i}"] = str(dev.usedmem)
             uuids.append(dev.uuid)
             chip = self.inventory.chip_by_uuid(dev.uuid)
             if chip is None:
@@ -212,15 +267,7 @@ class TpuDevicePlugin:
             # platform exposes no memory_stats.
             resp.envs[f"{ENV_PHYSICAL_MEMORY_PREFIX}{i}"] = str(chip.hbm_mib)
             indices.append(str(chip.index))
-            dev_node = f"/dev/accel{chip.index}"
-            if os.path.exists(dev_node):
-                resp.devices.append(
-                    pb.DeviceSpec(
-                        container_path=dev_node,
-                        host_path=dev_node,
-                        permissions="rw",
-                    )
-                )
+            attach_device_node(resp, chip.index)
         if grant and not self.cfg.disable_core_limit:
             resp.envs[ENV_CORE_LIMIT] = str(grant[0].usedcores)
         resp.envs[ENV_VISIBLE_CHIPS] = ",".join(uuids)
@@ -228,44 +275,7 @@ class TpuDevicePlugin:
             resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
         if anns.get(OVERSUBSCRIBE_ANNOTATION, "") in ("true", "1"):
             resp.envs[ENV_OVERSUBSCRIBE] = "true"
-
-        # Shared accounting region: hostPath dir per pod+container, a single
-        # .cache file inside, mounted into the container (reference
-        # CUDA_DEVICE_MEMORY_SHARED_CACHE + /tmp/vgpu/containers/<uid_ctr>,
-        # plugin.go:353–380, monitor pathmonitor.go:17).
-        cache_dir = os.path.join(
-            self.cfg.cache_host_dir, f"{pod_uid(pod)}_{pod_name(pod)}"
-        )
-        try:
-            os.makedirs(cache_dir, exist_ok=True)
-        except OSError as e:
-            log.warning("cannot create cache dir %s: %s", cache_dir, e)
-        container_cache = "/tmp/vtpu/vtpu.cache"
-        resp.envs[ENV_SHARED_CACHE] = container_cache
-        resp.mounts.append(
-            pb.Mount(
-                container_path=os.path.dirname(container_cache),
-                host_path=cache_dir,
-                read_only=False,
-            )
-        )
-        if self.cfg.shim_host_dir and os.path.isdir(self.cfg.shim_host_dir):
-            resp.mounts.append(
-                pb.Mount(
-                    container_path="/usr/local/vtpu",
-                    host_path=self.cfg.shim_host_dir,
-                    read_only=True,
-                )
-            )
-            preload = os.path.join(self.cfg.shim_host_dir, "ld.so.preload")
-            if os.path.exists(preload):
-                resp.mounts.append(
-                    pb.Mount(
-                        container_path="/etc/ld.so.preload",
-                        host_path=preload,
-                        read_only=True,
-                    )
-                )
+        attach_enforcement(resp, self.cfg, f"{pod_uid(pod)}_{pod_name(pod)}")
         return resp
 
     # -- serving lifecycle (Serve/Register, plugin.go:181–253) ----------------

@@ -26,11 +26,19 @@ log = logging.getLogger(__name__)
 def inventory_to_request(node_name: str, inv: NodeInventory, cfg: Config
                          ) -> pb.RegisterRequest:
     """Advertise scaled capacity: deviceMemoryScaling>1 oversubscribes HBM,
-    deviceCoresScaling>1 oversubscribes compute (register.go:422–426)."""
+    deviceCoresScaling>1 oversubscribes compute (register.go:422–426).
+
+    Chips designated for partitioning are excluded — they are allocated by
+    kubelet passthrough, so advertising them to the extender would let the
+    two paths double-book HBM (the reference likewise hides MIG-enabled
+    GPUs from the whole-GPU plugin, nvidia.go:84–107)."""
+    from .partition import whole_chip_view  # noqa: PLC0415 — avoid cycle
+
+    inv = whole_chip_view(inv, cfg)
     devices = [
         pb.ChipDevice(
             id=chip.uuid,
-            count=cfg.device_split_count,
+            count=cfg.effective_split_count(),
             devmem=int(chip.hbm_mib * cfg.device_memory_scaling),
             type=chip.type,
             health=chip.healthy,

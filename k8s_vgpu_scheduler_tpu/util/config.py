@@ -56,5 +56,26 @@ class Config:
     # Chip-partition strategy (MIG analog): none | single | mixed.
     partition_strategy: str = "none"
 
+    # Sharing mode (reference MLU modes, cambricon.go:92–139):
+    # - "mem-share":  split chips into virtual devices with hard HBM caps
+    #                 (mlu-share analog; the default fractional path);
+    # - "env-share":  split chips WITHOUT memory caps — sharers time-slice
+    #                 the whole chip (reference env-share);
+    # - "default":    exclusive whole chips (split count forced to 1).
+    sharing_mode: str = "mem-share"
+
+    # Chips designated for partitioning (uuids) when partition_strategy is
+    # single/mixed; empty = all chips.  Mirrors the reference's "MIG-enabled
+    # GPUs" designation: designated chips are EXCLUDED from the whole-chip
+    # plugin/extender inventory (nvidia.go:84–107 skips MIG-enabled GPUs)
+    # so the two allocation paths can never double-book HBM.
+    partition_chips: tuple = ()
+
+    def effective_split_count(self) -> int:
+        """Virtual devices per chip — the single source of truth for both
+        kubelet fan-out and extender advertisement (sharing mode `default`
+        means exclusive whole chips regardless of the split knob)."""
+        return 1 if self.sharing_mode == "default" else self.device_split_count
+
 
 DEFAULT_CONFIG = Config()

@@ -306,3 +306,58 @@ class TestNodeConfigOverride:
 
         cfg = Config(node_name="node-a")
         assert apply_node_config_overrides(cfg, "/nonexistent.json") is cfg
+
+
+class TestSharingModes:
+    """Reference MLU sharing modes (cambricon.go:92–139) mapped to TPU."""
+
+    def test_default_mode_exclusive_whole_chips(self, tmp_path):
+        import dataclasses
+
+        backend = MockBackend(dict(V5E_FIXTURE))
+        inv = backend.inventory()
+        cfg = dataclasses.replace(make_cfg(tmp_path), sharing_mode="default")
+        plugin = TpuDevicePlugin(FakeKube(), inv, cfg,
+                                 socket_dir=str(tmp_path))
+        # One virtual device per chip: kubelet can never co-schedule.
+        assert len(plugin.api_devices()) == len(inv.chips)
+        # Extender advertisement matches.
+        from k8s_vgpu_scheduler_tpu.deviceplugin.register import (
+            inventory_to_request,
+        )
+        req = inventory_to_request("n", inv, cfg)
+        assert all(d.count == 1 for d in req.devices)
+
+    def test_env_share_omits_memory_caps(self, tmp_path):
+        import dataclasses
+
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        backend = MockBackend(dict(V5E_FIXTURE))
+        inv = backend.inventory()
+        cfg = dataclasses.replace(make_cfg(tmp_path), sharing_mode="env-share")
+        plugin = TpuDevicePlugin(kube, inv, cfg, socket_dir=str(tmp_path))
+        pod = allocating_pod(inv)
+        resp = plugin.build_container_response(
+            pod, codec.decode_pod_devices(
+                pod["metadata"]["annotations"][TO_ALLOCATE_ANNOTATION])[0])
+        envs = dict(resp.envs)
+        # Time-slice mode: visibility + core limit yes, HBM caps no.
+        assert "TPU_DEVICE_MEMORY_LIMIT_0" not in envs
+        assert envs["TPU_VISIBLE_CHIPS"] == inv.chips[0].uuid
+        assert envs["TPU_DEVICE_CORE_LIMIT"] == "30"
+        # Split fan-out still applies (sharers time-slice).
+        assert len(plugin.api_devices()) == len(inv.chips) * 10
+
+    def test_mem_share_keeps_caps(self, tmp_path):
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        backend = MockBackend(dict(V5E_FIXTURE))
+        inv = backend.inventory()
+        plugin = TpuDevicePlugin(kube, inv, make_cfg(tmp_path),
+                                 socket_dir=str(tmp_path))
+        pod = allocating_pod(inv)
+        resp = plugin.build_container_response(
+            pod, codec.decode_pod_devices(
+                pod["metadata"]["annotations"][TO_ALLOCATE_ANNOTATION])[0])
+        assert dict(resp.envs)["TPU_DEVICE_MEMORY_LIMIT_0"] == "3000"

@@ -129,8 +129,16 @@ class TestPassthroughAllocate:
                             devicesIDs=["chip1/core0"])]))
         envs = resp.container_responses[0].envs
         assert envs[f"{ENV_MEMORY_LIMIT_PREFIX}0"] == str(16 * 1024)
+        # Physical stays the FULL chip so the shim's ballast
+        # (physical - limit) actually enforces the half-chip cap.
+        assert envs["TPU_DEVICE_PHYSICAL_MEMORY_0"] == str(32 * 1024)
         assert envs[ENV_VISIBLE_CHIPS] == "chip1"
         assert envs[ENV_CORE_LIMIT] == "50"  # 1 of 2 cores
+        # Enforcement contract travels like the whole-chip path: shared
+        # accounting region env + mount.
+        assert envs["TPU_DEVICE_MEMORY_SHARED_CACHE"]
+        mounts = {m.container_path for m in resp.container_responses[0].mounts}
+        assert "/tmp/vtpu" in mounts
 
     def test_allocate_both_cores_full_chip(self, served):
         plugin, ch = served
@@ -141,6 +149,98 @@ class TestPassthroughAllocate:
         envs = resp.container_responses[0].envs
         assert envs[ENV_CORE_LIMIT] == "100"
         assert envs[ENV_VISIBLE_CHIPS] == "chip2"
+        # Limits index by VISIBLE_CHIPS entry (shim ABI), aggregated per
+        # chip: both cores = the whole chip's HBM under LIMIT_0, no LIMIT_1.
+        assert envs[f"{ENV_MEMORY_LIMIT_PREFIX}0"] == str(32 * 1024)
+        assert f"{ENV_MEMORY_LIMIT_PREFIX}1" not in envs
+
+    def test_disable_core_limit_respected(self, tmp_path):
+        import dataclasses
+
+        inv = make_inventory("v5p", hbm=32 * 1024)
+        cfg = dataclasses.replace(Config(), disable_core_limit=True)
+        plugin = get_partition_plugins("mixed", None, inv, cfg,
+                                       str(tmp_path))[0]
+        plugin.serve()
+        try:
+            ch = grpc.insecure_channel(f"unix://{plugin.socket_path}")
+            resp = call(ch, "Allocate", pb.AllocateRequest,
+                        pb.AllocateResponse,
+                        pb.AllocateRequest(container_requests=[
+                            pb.ContainerAllocateRequest(
+                                devicesIDs=["chip0/core0"])]))
+            assert ENV_CORE_LIMIT not in resp.container_responses[0].envs
+        finally:
+            plugin.stop()
+
+
+class TestDoubleBookingExclusion:
+    """Designated partition chips are hidden from the whole-chip path
+    (reference skips MIG-enabled GPUs, nvidia.go:84–107)."""
+
+    def test_whole_chip_view_excludes_designated(self):
+        import dataclasses
+
+        from k8s_vgpu_scheduler_tpu.deviceplugin.partition import (
+            whole_chip_view,
+        )
+
+        inv = make_inventory("v5p")
+        cfg = dataclasses.replace(
+            Config(), partition_strategy="mixed",
+            partition_chips=("chip0", "chip2"))
+        view = whole_chip_view(inv, cfg)
+        assert {c.uuid for c in view.chips} == {"chip1", "chip3"}
+        # Shared refs: health flip propagates into the view.
+        inv.chips[1].healthy = False
+        assert not [c for c in view.chips if c.uuid == "chip1"][0].healthy
+
+    def test_view_excludes_all_by_default(self):
+        import dataclasses
+
+        from k8s_vgpu_scheduler_tpu.deviceplugin.partition import (
+            whole_chip_view,
+        )
+
+        inv = make_inventory("v5p")
+        cfg = dataclasses.replace(Config(), partition_strategy="mixed")
+        assert whole_chip_view(inv, cfg).chips == []
+
+    def test_view_noop_for_single_core_gen(self):
+        import dataclasses
+
+        from k8s_vgpu_scheduler_tpu.deviceplugin.partition import (
+            whole_chip_view,
+        )
+
+        inv = make_inventory("v5e", mesh=(2, 2))
+        cfg = dataclasses.replace(Config(), partition_strategy="mixed")
+        assert len(whole_chip_view(inv, cfg).chips) == 4
+
+    def test_register_stream_excludes_designated(self):
+        import dataclasses
+
+        from k8s_vgpu_scheduler_tpu.deviceplugin.register import (
+            inventory_to_request,
+        )
+
+        inv = make_inventory("v5p")
+        cfg = dataclasses.replace(
+            Config(), partition_strategy="mixed",
+            partition_chips=("chip0",))
+        req = inventory_to_request("n", inv, cfg)
+        assert {d.id for d in req.devices} == {"chip1", "chip2", "chip3"}
+
+    def test_partition_plugin_respects_designation(self, tmp_path):
+        import dataclasses
+
+        inv = make_inventory("v5p")
+        cfg = dataclasses.replace(
+            Config(), partition_strategy="mixed",
+            partition_chips=("chip0",))
+        plugin = get_partition_plugins("mixed", None, inv, cfg,
+                                       str(tmp_path))[0]
+        assert set(plugin.partitions) == {"chip0/core0", "chip0/core1"}
 
     def test_allocate_unknown_partition_fails(self, served):
         plugin, ch = served
