@@ -199,7 +199,7 @@ class PartitionDevicePlugin:
 
     # -- passthrough allocation (MIGAllocate analog) ---------------------------
     def Allocate(self, request, context):  # noqa: N802
-        import uuid as uuidlib  # noqa: PLC0415
+        import hashlib  # noqa: PLC0415
 
         from ..api import deviceplugin_pb2 as pb  # noqa: PLC0415
         from .plugin import (  # noqa: PLC0415
@@ -258,12 +258,15 @@ class PartitionDevicePlugin:
             resp.envs[ENV_VISIBLE_CHIPS] = ",".join(chips)
             resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
             # No pod identity on the passthrough path (no annotation
-            # handshake), so the region dir is keyed by a fresh token; the
-            # monitor still scans and enforces it, it just can't attribute
-            # it to a pod name in metrics.
-            attach_enforcement(
-                resp, self.cfg, f"part-{uuidlib.uuid4().hex[:12]}"
-            )
+            # handshake), so the region dir is keyed by the granted
+            # partition set — deterministic, so container restarts REUSE
+            # the same dir instead of leaking a fresh one per Allocate.
+            # The monitor still scans and enforces it; it just can't
+            # attribute it to a pod name in metrics.
+            grant_key = hashlib.sha1(
+                ",".join(sorted(creq.devicesIDs)).encode()
+            ).hexdigest()[:12]
+            attach_enforcement(resp, self.cfg, f"part-{grant_key}")
             responses.container_responses.append(resp)
         return responses
 

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..k8s.client import (
     KubeClient,
+    NotFound,
     is_pod_terminated,
     pod_annotations,
     pod_name,
@@ -40,6 +41,7 @@ from ..util.types import (
     TO_ALLOCATE_ANNOTATION,
 )
 from . import score as score_mod
+from .gang import GangManager, GangMember, gang_of, place_gang
 from .nodes import DeviceInfo, NodeInfo, NodeManager
 from .pods import PodInfo, PodManager
 
@@ -60,6 +62,7 @@ class Scheduler:
         self.cfg = cfg or Config()
         self.nodes = NodeManager()
         self.pods = PodManager()
+        self.gangs = GangManager()
         self._filter_lock = threading.Lock()
 
     # -- registration stream (gRPC DeviceService.Register) --------------------
@@ -109,6 +112,15 @@ class Scheduler:
         anns = pod.get("metadata", {}).get("annotations", {})
         node = anns.get(ASSIGNED_NODE_ANNOTATION, "")
         if event == "DELETED" or is_pod_terminated(pod) or not node:
+            # A gang member between atomic admission and its own annotation
+            # write has a tentative grant but no assigned-node annotation
+            # yet: a MODIFIED event or resync must not wipe the reservation
+            # (other pods would steal the gang's chips).  Deletion still
+            # releases it, via the gang registry too.
+            if event == "DELETED" or is_pod_terminated(pod):
+                self.gangs.drop_member(uid)
+            elif self.gangs.is_reserved(uid):
+                return
             self.pods.del_pod(uid)
             return
         encoded = anns.get(ASSIGNED_IDS_ANNOTATION, "")
@@ -140,6 +152,7 @@ class Scheduler:
         alive = {pod_uid(p) for p in pods}
         for info in self.pods.list_pods():
             if info.uid not in alive:
+                self.gangs.drop_member(info.uid)
                 self.pods.del_pod(info.uid)
 
     # -- usage snapshot --------------------------------------------------------
@@ -171,6 +184,9 @@ class Scheduler:
         """Decide under the in-memory lock; talk to the apiserver outside it
         (a slow patch must not stall every concurrent Filter and /metrics
         scrape).  The tentative grant is rolled back if the patch fails."""
+        # Expiry sweep first, outside the lock (it may talk to the apiserver).
+        if self.gangs.groups():
+            self._release_expired_gangs()
         with self._filter_lock:
             result = self._decide_locked(pod, node_names)
         if result.node is None:
@@ -201,6 +217,10 @@ class Scheduler:
         if not any(r.nums > 0 for r in requests):
             # Not ours; admit everywhere (the vanilla scheduler handles it).
             return FilterResult(node=None, failed={})
+
+        gang = gang_of(pod)
+        if gang is not None:
+            return self._decide_gang_locked(pod, requests, node_names, gang)
 
         # Drop any stale decision for this pod before re-placing (reference
         # Filter calls delPod first, scheduler.go:284).
@@ -241,6 +261,108 @@ class Scheduler:
             )
         )
         return FilterResult(node=node, failed=failed)
+
+    # -- gang scheduling (BASELINE config #5; see gang.py) ---------------------
+    def _decide_gang_locked(self, pod: dict, requests, node_names: List[str],
+                            gang_key) -> FilterResult:
+        group, total = gang_key
+        uid = pod_uid(pod)
+        g = self.gangs.observe(
+            pod_namespace(pod), group, total,
+            GangMember(uid=uid, name=pod_name(pod),
+                       namespace=pod_namespace(pod), requests=requests,
+                       annotations=pod.get("metadata", {}).get(
+                           "annotations", {})),
+        )
+
+        if uid in g.placements:
+            # Group already atomically admitted: hand back the reservation
+            # (tentative grant is already accounted in the pod registry).
+            node, devices = g.placements[uid]
+            if node_names and node not in node_names:
+                return FilterResult(
+                    error=f"gang {group}: reserved node {node} not offered"
+                )
+            if self.pods.get(uid) is None:
+                # Grant lost (failed annotation patch rolled it back, or an
+                # informer event raced): restore it from the placement so
+                # the caller's encode step never dereferences None.
+                self.pods.add_pod(
+                    PodInfo(uid=uid, name=pod_name(pod),
+                            namespace=pod_namespace(pod), node=node,
+                            devices=devices)
+                )
+            return FilterResult(node=node)
+
+        if len(g.members) < g.total:
+            # Co-scheduling barrier: fail until all members have shown up
+            # (kube-scheduler retries unschedulable pods).
+            return FilterResult(
+                error=f"gang {group} waiting ({len(g.members)}/{g.total})"
+            )
+
+        usage = self.get_nodes_usage(node_names or None)
+        placements = place_gang(
+            g, usage, score_mod.fit_pod, score_mod.node_score,
+            self.cfg.topology_policy,
+        )
+        if placements is None:
+            return FilterResult(
+                error=f"gang {group}: no atomic placement for "
+                      f"{g.total} members"
+            )
+        g.placements.update(placements)
+        # Account EVERY member's grant now, so concurrent non-gang Filters
+        # can't steal reserved capacity while the members' retries arrive.
+        for member_uid, (node, devices) in placements.items():
+            m = g.members[member_uid]
+            self.pods.add_pod(
+                PodInfo(uid=member_uid, name=m.name, namespace=m.namespace,
+                        node=node, devices=devices)
+            )
+        log.info("gang %s admitted: %s", group,
+                 {u: n for u, (n, _) in placements.items()})
+        node, _ = g.placements[uid]
+        return FilterResult(node=node)
+
+    def _release_expired_gangs(self) -> None:
+        """Free tentative grants of groups that stopped making progress —
+        but never those of members that already BOUND (their grants would
+        be re-learned from annotations anyway, releasing them mid-flight
+        would let Filter double-book the chips).
+
+        Called OUTSIDE the filter lock: the per-member apiserver lookups
+        must not stall concurrent Filters (filter()'s locking contract);
+        PodManager/GangManager have their own locks."""
+        for g in self.gangs.expired():
+            unresolved = False
+            for member_uid in list(g.placements):
+                info = self.pods.get(member_uid)
+                if info is None:
+                    continue
+                try:
+                    p = self.client.get_pod(
+                        g.members[member_uid].namespace,
+                        g.members[member_uid].name,
+                    )
+                    anns = p.get("metadata", {}).get("annotations", {})
+                    release = not anns.get(BIND_PHASE_ANNOTATION)
+                except NotFound:
+                    release = True  # pod gone for sure
+                except Exception as e:  # noqa: BLE001
+                    # Transient apiserver failure: releasing on a guess
+                    # could free a RUNNING pod's chips.  Keep the grant and
+                    # the group — the next sweep retries this member.
+                    log.warning("gang expiry: cannot check %s (%s); keeping",
+                                member_uid, e)
+                    unresolved = True
+                    continue
+                if release:
+                    self.pods.del_pod(member_uid)
+                    log.warning("gang %s expired; released %s",
+                                g.key, member_uid)
+            if not unresolved:
+                self.gangs.forget(g.key)
 
     # -- Bind ------------------------------------------------------------------
     def bind(self, namespace: str, name: str, uid: str, node: str) -> Optional[str]:

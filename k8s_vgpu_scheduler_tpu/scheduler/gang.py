@@ -1,0 +1,203 @@
+"""Gang scheduling — atomic placement of multi-pod SPMD jobs.
+
+BASELINE.json config #5 ("v5p-256 multi-host: ICI-topology gang-schedule of
+a JAX SPMD job") is territory the reference never enters (SURVEY.md §7 hard
+part #5: the reference schedules pods one at a time).  A JAX multi-host job
+is N pods that must ALL start or none — a partial gang deadlocks the
+collective at the first `psum` while holding chips hostage.
+
+Mechanism (extender-compatible co-scheduling):
+
+- job pods carry ``vtpu.dev/pod-group: <name>`` and
+  ``vtpu.dev/pod-group-total: <N>``;
+- each member's Filter registers it with the group and FAILS with
+  "waiting (k/N)" until all N members have been seen (kube-scheduler
+  retries unschedulable pods, so early members come back);
+- when the N-th member arrives, the group is placed ATOMICALLY against one
+  usage snapshot: every member gets a node + chip grant or nobody does;
+- placements are recorded as tentative grants in the pod registry
+  immediately, so concurrent non-gang Filters can't steal the reserved
+  capacity while the other members' retries trickle in;
+- each member's (re-)Filter then just returns its reserved node.
+
+Placement prefers a homogeneous node set (same TPU generation/mesh — the
+DCN-slice analog: a multi-host slice is built from identical hosts) and
+otherwise follows the same slice-aware fit as single-pod placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..util.types import ContainerDeviceRequest
+
+log = logging.getLogger(__name__)
+
+GANG_GROUP_ANNOTATION = "vtpu.dev/pod-group"
+GANG_TOTAL_ANNOTATION = "vtpu.dev/pod-group-total"
+
+# A group whose members stop re-filtering (job deleted mid-admission) must
+# not hold tentative grants forever.
+GANG_EXPIRE_SECONDS = 600.0
+
+
+@dataclasses.dataclass
+class GangMember:
+    uid: str
+    name: str
+    namespace: str
+    requests: List[ContainerDeviceRequest]
+    # Pod annotations captured at observe time: type affinity + per-pod
+    # topology policy feed each member's fit at atomic-admission time.
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Gang:
+    key: str            # "<namespace>/<group>"
+    total: int
+    members: Dict[str, GangMember] = dataclasses.field(default_factory=dict)
+    # uid -> (node, PodDevices) once atomically admitted
+    placements: Dict[str, Tuple[str, list]] = dataclasses.field(
+        default_factory=dict
+    )
+    last_seen: float = 0.0
+
+    @property
+    def admitted(self) -> bool:
+        return bool(self.placements)
+
+
+def gang_of(pod: dict) -> Optional[Tuple[str, int]]:
+    """(group name, total) when the pod declares gang membership."""
+    anns = pod.get("metadata", {}).get("annotations", {})
+    group = anns.get(GANG_GROUP_ANNOTATION, "")
+    if not group:
+        return None
+    try:
+        total = int(anns.get(GANG_TOTAL_ANNOTATION, "0"))
+    except ValueError:
+        total = 0
+    if total <= 0:
+        return None
+    return group, total
+
+
+class GangManager:
+    """Group registry.  Internally locked: Filter holds the scheduler's
+    filter lock, but informer/resync threads also consult it."""
+
+    def __init__(self, now=time.time) -> None:
+        self._groups: Dict[str, Gang] = {}
+        self._now = now
+        self._lock = threading.RLock()
+
+    def observe(self, namespace: str, group: str, total: int,
+                member: GangMember) -> Gang:
+        with self._lock:
+            key = f"{namespace}/{group}"
+            g = self._groups.get(key)
+            if g is None or g.total != total:
+                g = Gang(key=key, total=total)
+                self._groups[key] = g
+            g.members[member.uid] = member
+            g.last_seen = self._now()
+            return g
+
+    def is_reserved(self, uid: str) -> bool:
+        """True while an admitted-but-unconfirmed placement exists for the
+        pod (its tentative grant must survive informer churn)."""
+        with self._lock:
+            return any(uid in g.placements for g in self._groups.values())
+
+    def drop_member(self, uid: str) -> None:
+        """Release one pod's membership + placement (pod deleted)."""
+        with self._lock:
+            for key in list(self._groups):
+                g = self._groups[key]
+                g.members.pop(uid, None)
+                g.placements.pop(uid, None)
+                if not g.members:
+                    self._groups.pop(key)
+
+    def expired(self) -> List[Gang]:
+        """Groups that stopped making progress.  NOT popped: the caller
+        releases what it can and calls :meth:`forget` only when every
+        member is resolved — a transient apiserver error mid-release must
+        leave the group for the next sweep."""
+        with self._lock:
+            now = self._now()
+            return [g for g in self._groups.values()
+                    if now - g.last_seen > GANG_EXPIRE_SECONDS]
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._groups.pop(key, None)
+
+    def groups(self) -> Dict[str, Gang]:
+        return self._groups
+
+
+def place_gang(
+    gang: Gang,
+    usage_by_node: dict,
+    fit_pod,
+    node_score,
+    default_policy: str,
+) -> Optional[Dict[str, Tuple[str, list]]]:
+    """Atomically place every member on the given usage snapshot.
+
+    Returns uid -> (node, devices) covering ALL members, or None.  The
+    snapshot's usage maps are mutated as members are placed, so later
+    members see earlier members' grants — the all-or-nothing simulation.
+
+    Node preference: homogeneous generation sets first (a DCN slice is
+    built from identical hosts), then the regular free-capacity score.
+    """
+    # Bucket candidate nodes by topology generation; try the largest
+    # homogeneous bucket first, fall back to "any node".
+    by_gen: Dict[str, List[str]] = {}
+    for name, (info, usage) in usage_by_node.items():
+        gen = info.topology.generation if info.topology else "?"
+        by_gen.setdefault(gen, []).append(name)
+    candidate_sets = sorted(by_gen.values(), key=len, reverse=True)
+    if len(candidate_sets) > 1:
+        candidate_sets.append(list(usage_by_node.keys()))
+
+    for candidates in candidate_sets:
+        # Work on a deep-ish copy of the snapshot per attempt: a failed
+        # homogeneous attempt must not leave partial grants behind.
+        trial = {
+            name: (info, {k: dataclasses.replace(u) for k, u in usage.items()})
+            for name, (info, usage) in usage_by_node.items()
+        }
+        placements: Dict[str, Tuple[str, list]] = {}
+        ok = True
+        for uid in sorted(gang.members):
+            m = gang.members[uid]
+            best: Optional[Tuple[float, str, list, dict]] = None
+            for name in candidates:
+                info, usage = trial[name]
+                probe = {k: dataclasses.replace(u) for k, u in usage.items()}
+                got = fit_pod(m.requests, probe, info.topology,
+                              m.annotations, default_policy)
+                if got is None:
+                    continue
+                s = node_score(probe)
+                if best is None or s > best[0]:
+                    best = (s, name, got, probe)
+            if best is None:
+                ok = False
+                break
+            _, name, got, probe = best
+            # Commit by swapping in the winning probe (it already holds this
+            # member's grant) — no second fit, no re-fit divergence risk.
+            trial[name] = (trial[name][0], probe)
+            placements[uid] = (name, got)
+        if ok:
+            return placements
+    return None

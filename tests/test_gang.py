@@ -1,0 +1,235 @@
+"""Gang (co-)scheduling tests — BASELINE config #5 territory the reference
+never enters: N pods of one SPMD job placed atomically or not at all."""
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.gang import (
+    GANG_GROUP_ANNOTATION,
+    GANG_TOTAL_ANNOTATION,
+)
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import ASSIGNED_NODE_ANNOTATION
+
+from test_scheduler_core import register_node, tpu_pod
+
+
+def gang_pod(name, uid, group="job1", total=3, nums="4", mem="1000"):
+    pod = tpu_pod(name=name, uid=uid, mem=mem, nums=nums)
+    pod["metadata"]["annotations"].update({
+        GANG_GROUP_ANNOTATION: group,
+        GANG_TOTAL_ANNOTATION: str(total),
+    })
+    return pod
+
+
+@pytest.fixture
+def env():
+    kube = FakeKube()
+    s = Scheduler(kube, Config())
+    for n in ("node-a", "node-b", "node-c"):
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        register_node(s, n)  # 4 chips x 10 slots each
+    kube.watch_pods(s.on_pod_event)
+    return kube, s
+
+
+NODES = ["node-a", "node-b", "node-c"]
+
+
+class TestGangAdmission:
+    def test_waits_for_quorum_then_places_all(self, env):
+        kube, s = env
+        pods = [gang_pod(f"w{i}", f"gu{i}") for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+
+        # Members 1 and 2 must wait.
+        r1 = s.filter(pods[0], NODES)
+        assert r1.node is None and "waiting (1/3)" in r1.error
+        r2 = s.filter(pods[1], NODES)
+        assert r2.node is None and "waiting (2/3)" in r2.error
+
+        # Third member completes the gang: atomic admission.
+        r3 = s.filter(pods[2], NODES)
+        assert r3.node in NODES
+
+        # Retried members now collect their reservations.
+        r1b = s.filter(pods[0], NODES)
+        r2b = s.filter(pods[1], NODES)
+        nodes = {r1b.node, r2b.node, r3.node}
+        # 4 chips per member on 4-chip nodes: one node each.
+        assert nodes == set(NODES)
+
+        # Decisions are written through to annotations.
+        for p in (pods[0], pods[1]):
+            anns = kube.get_pod("default", p["metadata"]["name"])[
+                "metadata"]["annotations"]
+            assert anns[ASSIGNED_NODE_ANNOTATION] in NODES
+
+    def test_infeasible_gang_admits_nobody(self, env):
+        kube, s = env
+        # 4 members x 4 full-memory chips > 3 nodes x 4 chips.
+        pods = [gang_pod(f"w{i}", f"gu{i}", total=4, mem="16384")
+                for i in range(4)]
+        for p in pods:
+            kube.create_pod(p)
+        results = [s.filter(p, NODES) for p in pods]
+        assert all(r.node is None for r in results)
+        assert "no atomic placement" in results[-1].error
+        # No tentative grants leak: a normal pod still fits everywhere.
+        solo = tpu_pod(name="solo", uid="solo", nums="4")
+        kube.create_pod(solo)
+        r = s.filter(solo, NODES)
+        assert r.node in NODES
+
+    def test_reserved_capacity_not_stolen(self, env):
+        kube, s = env
+        pods = [gang_pod(f"w{i}", f"gu{i}") for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods[:2]:
+            s.filter(p, NODES)
+        r3 = s.filter(pods[2], NODES)
+        assert r3.node is not None
+
+        # A greedy whole-node pod arriving BEFORE the other members retry
+        # must not squat on their reserved chips.
+        thief = tpu_pod(name="thief", uid="thief", nums="4", mem="16000")
+        kube.create_pod(thief)
+        rt = s.filter(thief, NODES)
+        # Every node's 4 chips carry a gang member's 1000 MiB/chip grant,
+        # so a 16000-MiB/chip pod fits nowhere.
+        assert rt.node is None
+
+        # Members still collect their reservations.
+        assert s.filter(pods[0], NODES).node is not None
+        assert s.filter(pods[1], NODES).node is not None
+
+    def test_prefers_homogeneous_generation(self, env):
+        kube, s = env
+        # Add two v5p nodes; a 2-member gang should land on the LARGER
+        # homogeneous set (3x v5e) rather than mixing generations.
+        for n in ("node-p1", "node-p2"):
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n)
+            s.nodes.list_nodes()[n].topology = None  # strip, then set v5p
+        from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+
+        for n in ("node-p1", "node-p2"):
+            s.nodes.list_nodes()[n].topology = TopologyDesc(
+                generation="v5p", mesh=(4, 1))
+        all_nodes = NODES + ["node-p1", "node-p2"]
+        pods = [gang_pod(f"w{i}", f"gu{i}", total=2) for i in range(2)]
+        for p in pods:
+            kube.create_pod(p)
+        s.filter(pods[0], all_nodes)
+        r = s.filter(pods[1], all_nodes)
+        assert r.node in NODES  # v5e bucket (3 nodes) beats v5p (2)
+        assert s.filter(pods[0], all_nodes).node in NODES
+
+    def test_expired_gang_releases_grants(self, env):
+        kube, s = env
+        clock = [0.0]
+        s.gangs._now = lambda: clock[0]
+        pods = [gang_pod(f"w{i}", f"gu{i}") for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods:
+            s.filter(p, NODES)
+        assert s.pods.get("gu0") is not None
+
+        # Members never bind; the job is deleted server-side.
+        for p in pods:
+            kube.delete_pod("default", p["metadata"]["name"])
+        clock[0] = 1000.0  # past GANG_EXPIRE_SECONDS
+        # Any gang-path filter triggers expiry sweeping.
+        other = gang_pod("x0", "xu0", group="job2", total=2)
+        kube.create_pod(other)
+        s.filter(other, NODES)
+        assert s.pods.get("gu0") is None
+        assert s.pods.get("gu1") is None
+
+    def test_resync_keeps_tentative_grants(self, env):
+        # Reserved members have grants but no annotations yet; a resync or
+        # informer MODIFIED event must not free their chips.
+        kube, s = env
+        pods = [gang_pod(f"w{i}", f"gu{i}") for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods[:2]:
+            s.filter(p, NODES)
+        assert s.filter(pods[2], NODES).node is not None
+
+        s.resync_from_apiserver()
+        s.on_pod_event("MODIFIED", kube.get_pod("default", "w0"))
+        assert s.pods.get("gu0") is not None
+        assert s.pods.get("gu1") is not None
+
+        # A thief still can't take the reserved chips after the resync.
+        thief = tpu_pod(name="thief", uid="thief", nums="4", mem="16000")
+        kube.create_pod(thief)
+        assert s.filter(thief, NODES).node is None
+
+    def test_reserved_retry_survives_lost_grant(self, env):
+        # A failed annotation patch rolls back the PodInfo while the gang
+        # placement remains: the member's retry must restore it, not crash.
+        kube, s = env
+        pods = [gang_pod(f"w{i}", f"gu{i}") for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods:
+            s.filter(p, NODES)
+        s.pods.del_pod("gu0")  # simulate the rollback path
+        r = s.filter(pods[0], NODES)
+        assert r.node in NODES
+        assert s.pods.get("gu0") is not None
+
+    def test_member_deletion_releases_immediately(self, env):
+        kube, s = env
+        pods = [gang_pod(f"w{i}", f"gu{i}") for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods:
+            s.filter(p, NODES)
+        kube.delete_pod("default", "w1")
+        assert not s.gangs.is_reserved("gu1")
+        assert s.pods.get("gu1") is None
+        # Other members' reservations stay.
+        assert s.pods.get("gu0") is not None
+
+    def test_expiry_keeps_grant_on_transient_apiserver_error(self, env):
+        kube, s = env
+        clock = [0.0]
+        s.gangs._now = lambda: clock[0]
+        pods = [gang_pod(f"w{i}", f"gu{i}") for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods:
+            s.filter(p, NODES)
+        clock[0] = 1000.0
+        orig = s.client.get_pod
+        s.client.get_pod = lambda ns, n: (_ for _ in ()).throw(
+            ConnectionError("apiserver hiccup"))
+        try:
+            s._release_expired_gangs()
+        finally:
+            s.client.get_pod = orig
+        # Transient failure: grants kept (only NotFound releases), and the
+        # group survives so a later sweep can retry.
+        assert s.pods.get("gu0") is not None
+        assert s.gangs.groups()
+        # Apiserver back (pods deleted server-side): retry releases all.
+        for p in pods:
+            kube._pods.pop(f"default/{p['metadata']['name']}", None)
+        s._release_expired_gangs()
+        assert s.pods.get("gu0") is None
+        assert not s.gangs.groups()
+
+    def test_single_member_gang_places_immediately(self, env):
+        kube, s = env
+        p = gang_pod("w0", "gu0", total=1, nums="2")
+        kube.create_pod(p)
+        r = s.filter(p, NODES)
+        assert r.node in NODES
