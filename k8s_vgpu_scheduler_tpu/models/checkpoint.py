@@ -1,0 +1,90 @@
+"""Checkpoint / resume for training state.
+
+SURVEY.md §5: the reference's durability story is "k8s objects as the only
+durable state" (annotations as WAL) — it has no model checkpointing because
+it has no models.  The TPU framework does, so the compute path gets real
+checkpoint/resume: orbax-backed, sharding-aware (each host writes its own
+shards of a distributed array, restore reapplies the target shardings), with
+an atomic step directory protocol and keep-last-N retention.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+class CheckpointManager:
+    """Thin orbax wrapper pinned to this framework's TrainState shape.
+
+    Saves are atomic (orbax writes to a tmp dir and renames) and pruned to
+    ``keep``.  ``restore`` reapplies the live state's shardings so a resumed
+    job lands exactly on the mesh layout the caller rebuilt.
+    """
+
+    def __init__(self, directory: str, keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        self._ocp = ocp
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep, create=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._mgr.save(
+            step, args=self._ocp.args.StandardSave(state)
+        )
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of ``state_like`` (a live or
+        abstract TrainState built for the current mesh)."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+            if hasattr(x, "shape")
+            else x,
+            state_like,
+        )
+        return self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract)
+        )
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def save_checkpoint(directory: str, step: int, state: Any) -> None:
+    """One-shot convenience save."""
+    mgr = CheckpointManager(directory)
+    try:
+        mgr.save(step, state, wait=True)
+    finally:
+        mgr.close()
+
+
+def restore_checkpoint(directory: str, state_like: Any,
+                       step: Optional[int] = None) -> Any:
+    mgr = CheckpointManager(directory)
+    try:
+        return mgr.restore(state_like, step)
+    finally:
+        mgr.close()

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.flash_attention import flash_attention
 from ..parallel.ring import full_attention_reference, ring_attention
 
 
@@ -104,6 +105,8 @@ class Attention(nn.Module):
         if cfg.attention == "ring" and self.mesh is not None and \
                 self.mesh.shape.get("sp", 1) > 1:
             out = ring_attention(q, k, v, self.mesh, causal=True)
+        elif cfg.attention == "flash":
+            out = flash_attention(q, k, v, causal=True)
         else:
             out = full_attention_reference(q, k, v, causal=True)
         out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's hot path lives in closed-source CUDA inside libvgpu.so; our
+compute-path analog is Pallas kernels tiled for the MXU/VMEM hierarchy
+(see /opt/skills/guides/pallas_guide.md for the constraints they follow).
+"""
+
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

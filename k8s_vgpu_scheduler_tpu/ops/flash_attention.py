@@ -1,0 +1,168 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The single hottest op of the flagship model (models/llama.py Attention).
+The naive path materializes the (T, T) score matrix in HBM — O(T²) bytes of
+HBM traffic, the canonical TPU bandwidth sin.  This kernel streams K/V
+blocks through VMEM with an online-softmax accumulator, so HBM traffic is
+O(T·d) per head and the (bq, bk) score tile lives entirely on-chip.
+
+Layout choices per the Pallas TPU guide:
+- grid = (batch·heads, T/bq): one program per query block per head;
+- q/o tiles (bq, d) and k/v whole-sequence refs per head in VMEM; the k-loop
+  walks (bk, d) slices with ``pl.ds`` — d=128 matches the lane width, bq/bk
+  are multiples of the bf16 sublane tile (16, 128);
+- scores/accumulators in f32 (``preferred_element_type``) — bf16 inputs,
+  f32 math, bf16 out, the MXU-native mix.
+
+Training support: ``jax.custom_vjp`` with a rematerializing backward (plain
+XLA ops).  Forward pass — the inference/serving hot path — runs the kernel;
+the backward recomputes blockwise like ``jax.checkpoint`` would.
+
+On CPU (tests, dry runs) the kernel runs in interpreter mode automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
+            block_k: int, seq_len: int):
+    bq = q_ref.shape[0]
+    d = q_ref.shape[1]
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * sm_scale
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :]
+        v = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        scale = jnp.exp(m - m_new)
+        l_new = l * scale + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * scale + jax.lax.dot_general(
+            p, v.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    num_kb = seq_len // block_k
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing; stop the
+        # walk at the query block's diagonal (saves ~half the FLOPs).
+        # bq % block_k == 0 is guaranteed by the caller's tiling guard.
+        num_kb_eff = jnp.minimum(num_kb, (qi + 1) * bq // block_k)
+    else:
+        num_kb_eff = num_kb
+    m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-20)
+    o_ref[...] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    """q/k/v: (B, T, H, d) — kernel runs per (B·H) with (T, d) refs."""
+    B, T, H, d = q.shape
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+
+    grid = (B * H, T // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, sm_scale=sm_scale, causal=causal,
+            block_k=block_k, seq_len=T,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+def _reference(q, k, v, sm_scale: float, causal: bool):
+    """Plain-XLA attention used for the rematerializing backward."""
+    B, T, H, d = q.shape
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    return _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k,
+                           interpret)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, sm_scale, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 256, block_k: int = 256,
+                    interpret: Optional[bool] = None):
+    """Fused attention over (B, T, H, d) tensors.
+
+    Falls back to the plain-XLA reference when the shape can't tile (T not
+    divisible by the blocks, or tiny head_dim) — callers never have to
+    special-case shapes.
+    """
+    B, T, H, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k or block_q % block_k:
+        return _reference(q, k, v, sm_scale, causal)
+    return _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret)
