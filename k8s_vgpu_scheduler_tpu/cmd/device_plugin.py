@@ -134,6 +134,21 @@ def main(argv=None):
         cfg.partition_strategy, client, cache.inventory, cfg, args.socket_dir
     )
     serve_main = not (cfg.partition_strategy == "single" and part_plugins)
+    if not serve_main and cfg.partition_chips:
+        # `single` replaces the whole-chip plugin entirely, so a
+        # partition-chips subset would leave the non-designated chips
+        # advertised by NO plugin — silently stranded.  Refuse, like the
+        # reference panics on single-mode mixed configs
+        # (mig-strategy.go:58–66); mixed is the strategy for subsets.
+        all_chips = {c.uuid for c in cache.inventory.chips}
+        stranded = all_chips - set(cfg.partition_chips)
+        if stranded:
+            raise SystemExit(
+                "--partition-strategy=single with a --partition-chips subset "
+                f"would strand chips {sorted(stranded)}: single partitions "
+                "every chip; use --partition-strategy=mixed to partition a "
+                "subset"
+            )
 
     def on_health_change2(inv):
         for pp in part_plugins:

@@ -22,10 +22,11 @@ def parse_args(argv=None):
     p.add_argument("--metrics-port", type=int, default=9394)
     p.add_argument("--grpc-port", type=int, default=9395,
                    help="NodeTPUInfo gRPC port (0 = disabled)")
-    p.add_argument("--grpc-bind", default="[::]",
+    p.add_argument("--grpc-bind", default="127.0.0.1",
                    help="NodeTPUInfo bind address; the endpoint is "
-                        "unauthenticated — use 127.0.0.1 for node-local "
-                        "tooling or restrict with a NetworkPolicy")
+                        "unauthenticated, so the default is loopback-only "
+                        "(node-local tooling) — widen to [::] explicitly "
+                        "and add a NetworkPolicy if peers need it")
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     p.add_argument("--no-backend", action="store_true",

@@ -250,11 +250,16 @@ class PartitionDevicePlugin:
                     chip.hbm_mib if chip else mib_by_chip[chip_uuid]
                 )
             # Core share: partitions-per-chip granted / cores on the chip,
-            # as a percentage — one core of a dual-core chip = 50.
+            # as a percentage — one core of a dual-core chip = 50.  The
+            # shim ABI carries ONE global core limit, so with unequal
+            # per-chip grants take the MIN share: the cap may under-use a
+            # chip but never overcommits the lesser one.
             if chips and not self.cfg.disable_core_limit:
-                total = cores_per_chip_for(parts, chips[0])
-                share = max(cores_by_chip.values())
-                resp.envs[ENV_CORE_LIMIT] = str(100 * share // total)
+                share_pct = min(
+                    100 * cores_by_chip[c] // cores_per_chip_for(parts, c)
+                    for c in chips
+                )
+                resp.envs[ENV_CORE_LIMIT] = str(share_pct)
             resp.envs[ENV_VISIBLE_CHIPS] = ",".join(chips)
             resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
             # No pod identity on the passthrough path (no annotation

@@ -101,15 +101,18 @@ def inject_vtpu(
                 }
             )
 
-        add_mount("/usr/local/vtpu", shim_host_dir, read_only=True)
-        add_mount(
-            "/etc/ld.so.preload",
-            f"{shim_host_dir}/ld.so.preload",
-            read_only=True,
-        )
-        if cache_host_dir:
-            import os
+        # Mirror attach_enforcement (deviceplugin/plugin.py:92–108): only
+        # bind-mount shim artifacts that exist on the host — an
+        # unconditional mount of a missing source makes runc fail EVERY
+        # create, which is strictly worse than running unenforced.
+        import os
 
+        if os.path.isdir(shim_host_dir):
+            add_mount("/usr/local/vtpu", shim_host_dir, read_only=True)
+            preload = os.path.join(shim_host_dir, "ld.so.preload")
+            if os.path.exists(preload):
+                add_mount("/etc/ld.so.preload", preload, read_only=True)
+        if cache_host_dir:
             add_mount(
                 os.path.dirname(cache_path), cache_host_dir, read_only=False
             )

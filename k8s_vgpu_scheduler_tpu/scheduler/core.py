@@ -41,7 +41,13 @@ from ..util.types import (
     TO_ALLOCATE_ANNOTATION,
 )
 from . import score as score_mod
-from .gang import GangManager, GangMember, gang_of, place_gang
+from .gang import (
+    GangConflictError,
+    GangManager,
+    GangMember,
+    gang_of,
+    place_gang,
+)
 from .nodes import DeviceInfo, NodeInfo, NodeManager
 from .pods import PodInfo, PodManager
 
@@ -267,13 +273,18 @@ class Scheduler:
                             gang_key) -> FilterResult:
         group, total = gang_key
         uid = pod_uid(pod)
-        g = self.gangs.observe(
-            pod_namespace(pod), group, total,
-            GangMember(uid=uid, name=pod_name(pod),
-                       namespace=pod_namespace(pod), requests=requests,
-                       annotations=pod.get("metadata", {}).get(
-                           "annotations", {})),
-        )
+        try:
+            g = self.gangs.observe(
+                pod_namespace(pod), group, total,
+                GangMember(uid=uid, name=pod_name(pod),
+                           namespace=pod_namespace(pod), requests=requests,
+                           annotations=pod.get("metadata", {}).get(
+                               "annotations", {})),
+            )
+        except GangConflictError as e:
+            # Misconfigured straggler: refusing keeps the admitted members'
+            # placements and accounting untouched.
+            return FilterResult(error=str(e))
 
         if uid in g.placements:
             # Group already atomically admitted: hand back the reservation

@@ -87,6 +87,10 @@ def gang_of(pod: dict) -> Optional[Tuple[str, int]]:
     return group, total
 
 
+class GangConflictError(ValueError):
+    """A new member's pod-group-total conflicts with an admitted gang."""
+
+
 class GangManager:
     """Group registry.  Internally locked: Filter holds the scheduler's
     filter lock, but informer/resync threads also consult it."""
@@ -101,7 +105,27 @@ class GangManager:
         with self._lock:
             key = f"{namespace}/{group}"
             g = self._groups.get(key)
-            if g is None or g.total != total:
+            if g is not None and g.placements:
+                # An admitted gang's reservations must survive informer
+                # churn: recreating the group would orphan the member
+                # grants while is_reserved() flips False.  Known members
+                # (stale resync of a placed pod) keep their reservation; a
+                # NEW member is rejected outright whatever its total says —
+                # registering it would push len(members) past total and
+                # re-run atomic placement over already-placed members,
+                # reassigning bound pods' nodes.
+                if member.uid not in g.members:
+                    raise GangConflictError(
+                        f"gang {key}: already admitted with "
+                        f"{g.total} members; late member {member.name} "
+                        "rejected")
+                if g.total != total:
+                    log.warning(
+                        "gang %s: ignoring conflicting total %d for "
+                        "admitted group (total=%d)", key, total, g.total)
+            elif g is not None and g.total != total:
+                g = None
+            if g is None:
                 g = Gang(key=key, total=total)
                 self._groups[key] = g
             g.members[member.uid] = member

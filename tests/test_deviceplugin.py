@@ -308,6 +308,29 @@ class TestNodeConfigOverride:
         assert apply_node_config_overrides(cfg, "/nonexistent.json") is cfg
 
 
+class TestSingleModeSubsetGuard:
+    """strategy=single replaces the whole-chip plugin entirely; designating
+    only a subset would leave the rest advertised by no plugin.  The
+    entrypoint must refuse (reference panics on single-mode mixed configs,
+    mig-strategy.go:58–66)."""
+
+    def test_single_with_subset_refuses(self, tmp_path, monkeypatch):
+        import json
+
+        from k8s_vgpu_scheduler_tpu.cmd.device_plugin import main
+
+        fix = tmp_path / "v5p.json"
+        fix.write_text(json.dumps({
+            "generation": "v5p", "mesh": [2, 2, 1],
+            "wraparound": [False, False, False], "hbm_mib": 98304,
+        }))
+        monkeypatch.setenv("VTPU_MOCK_JSON", str(fix))
+        with pytest.raises(SystemExit, match="strand"):
+            main(["--fake-kube", "--partition-strategy", "single",
+                  "--partition-chips", "TPU-v5p-mock-0",
+                  "--socket-dir", str(tmp_path)])
+
+
 class TestSharingModes:
     """Reference MLU sharing modes (cambricon.go:92–139) mapped to TPU."""
 

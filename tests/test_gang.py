@@ -68,6 +68,38 @@ class TestGangAdmission:
                 "metadata"]["annotations"]
             assert anns[ASSIGNED_NODE_ANNOTATION] in NODES
 
+    def test_conflicting_total_after_admission_rejected(self, env):
+        # A misconfigured straggler with a different pod-group-total must
+        # not disturb an admitted gang: previously the group was recreated
+        # (dropping placements) or the member registered (re-running
+        # placement over already-placed members).
+        kube, s = env
+        pods = [gang_pod(f"w{i}", f"cu{i}", group="jobc", total=2)
+                for i in range(2)]
+        for p in pods:
+            kube.create_pod(p)
+        s.filter(pods[0], NODES)
+        r = s.filter(pods[1], NODES)
+        assert r.node in NODES  # admitted
+
+        stray = gang_pod("w9", "cu9", group="jobc", total=3)
+        kube.create_pod(stray)
+        rs = s.filter(stray, NODES)
+        assert rs.node is None and "rejected" in rs.error
+
+        # Same-total stragglers are equally dangerous (they'd re-run atomic
+        # placement over the bound members) — also rejected.
+        stray2 = gang_pod("w8", "cu8", group="jobc", total=2)
+        kube.create_pod(stray2)
+        rs2 = s.filter(stray2, NODES)
+        assert rs2.node is None and "rejected" in rs2.error
+
+        # Admitted members keep their reservations and accounting.
+        r0 = s.filter(pods[0], NODES)
+        assert r0.node in NODES
+        assert s.pods.get("cu0") is not None and s.pods.get("cu1") is not None
+        assert s.pods.get("cu9") is None
+
     def test_infeasible_gang_admits_nobody(self, env):
         kube, s = env
         # 4 members x 4 full-memory chips > 3 nodes x 4 chips.

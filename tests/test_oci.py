@@ -68,11 +68,19 @@ class TestModifyingWrapper:
         (bundle / "config.json").write_text(json.dumps(spec))
         return bundle
 
-    def wrapper(self, runc, bundle=None):
+    def make_shim_dir(self, tmp_path):
+        """A host shim install: inject_vtpu only mounts what exists."""
+        shim = tmp_path / "shim"
+        shim.mkdir(parents=True, exist_ok=True)
+        (shim / "ld.so.preload").write_text("/usr/local/vtpu/libvtpu.so\n")
+        return str(shim)
+
+    def wrapper(self, runc, bundle=None, shim_host_dir="/usr/local/vtpu"):
         mod = inject_vtpu(
             {0: 3000}, core_limit=30, visible_chips="chip-a",
             visible_devices="0", physical_mib={0: 16384},
             cache_host_dir="/tmp/vtpu/containers/x",
+            shim_host_dir=shim_host_dir,
         )
         rt = SyscallExecRuntime(runc, exec_fn=lambda *a: None)
         spec = FileSpec(str(bundle / "config.json")) if bundle else None
@@ -80,7 +88,8 @@ class TestModifyingWrapper:
 
     def test_create_injects_env_and_mounts(self, tmp_path, runc):
         bundle = self.make_bundle(tmp_path)
-        w = self.wrapper(runc)  # no pinned spec: path comes from --bundle
+        # no pinned spec: path comes from --bundle
+        w = self.wrapper(runc, shim_host_dir=self.make_shim_dir(tmp_path))
         with pytest.raises(RuntimeError_):
             w.exec(["rt", "create", "--bundle", str(bundle), "c1"])
         spec = json.loads((bundle / "config.json").read_text())
@@ -95,6 +104,32 @@ class TestModifyingWrapper:
         dests = {m["destination"] for m in spec["mounts"]}
         assert {"/usr/local/vtpu", "/etc/ld.so.preload", "/tmp/vtpu"} <= dests
         assert "/proc" in dests
+
+    def test_missing_shim_dir_skips_mounts_but_keeps_env(self, tmp_path, runc):
+        # A host without the shim installed must not get bind mounts whose
+        # source is missing (runc would fail every create); env still
+        # travels so the pod runs unenforced rather than not at all.
+        bundle = self.make_bundle(tmp_path)
+        w = self.wrapper(runc, shim_host_dir=str(tmp_path / "nonexistent"))
+        with pytest.raises(RuntimeError_):
+            w.exec(["rt", "create", "--bundle", str(bundle), "c1"])
+        spec = json.loads((bundle / "config.json").read_text())
+        dests = {m["destination"] for m in spec["mounts"]}
+        assert "/usr/local/vtpu" not in dests
+        assert "/etc/ld.so.preload" not in dests
+        assert f"{ENV_MEMORY_LIMIT_PREFIX}0=3000" in spec["process"]["env"]
+
+    def test_shim_dir_without_preload_mounts_lib_only(self, tmp_path, runc):
+        bundle = self.make_bundle(tmp_path)
+        shim = tmp_path / "shim-nopreload"
+        shim.mkdir()
+        w = self.wrapper(runc, shim_host_dir=str(shim))
+        with pytest.raises(RuntimeError_):
+            w.exec(["rt", "create", "--bundle", str(bundle), "c1"])
+        spec = json.loads((bundle / "config.json").read_text())
+        dests = {m["destination"] for m in spec["mounts"]}
+        assert "/usr/local/vtpu" in dests
+        assert "/etc/ld.so.preload" not in dests
 
     def test_each_create_uses_its_own_bundle(self, tmp_path, runc):
         # One long-lived wrapper, two containers: each create must rewrite
@@ -145,7 +180,8 @@ class TestModifyingWrapper:
 
     def test_idempotent_reinjection(self, tmp_path, runc):
         bundle = self.make_bundle(tmp_path)
-        w = self.wrapper(runc, bundle)
+        w = self.wrapper(runc, bundle,
+                         shim_host_dir=self.make_shim_dir(tmp_path))
         for _ in range(2):
             with pytest.raises(RuntimeError_):
                 w.exec(["rt", "create", "--bundle", str(bundle), "c1"])
