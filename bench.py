@@ -216,7 +216,7 @@ def main() -> None:
                 matrix.append(run_case(name, env, tmpdir, degraded, timeout))
     except Exception as e:  # noqa: BLE001 — emission must survive anything
         if not emitted.get("value"):
-            emitted.setdefault("error", f"harness: {e!r}")
+            emitted["error"] = f"harness: {e!r}"
         log(f"harness exception: {e!r}")
     finally:
         try:

@@ -313,9 +313,16 @@ class Scheduler:
             )
 
         usage = self.get_nodes_usage(node_names or None)
+        # For an admitted gang a quorum here means replacement members
+        # filled freed slots: place ONLY them — the placed peers' grants
+        # are already charged in the snapshot, and re-placing bound
+        # members would reassign their nodes.
+        missing = ([uid for uid in sorted(g.members)
+                    if uid not in g.placements]
+                   if g.placements else None)
         placements = place_gang(
             g, usage, score_mod.fit_pod, score_mod.node_score,
-            self.cfg.topology_policy,
+            self.cfg.topology_policy, only_uids=missing,
         )
         if placements is None:
             return FilterResult(

@@ -109,12 +109,14 @@ class GangManager:
                 # An admitted gang's reservations must survive informer
                 # churn: recreating the group would orphan the member
                 # grants while is_reserved() flips False.  Known members
-                # (stale resync of a placed pod) keep their reservation; a
-                # NEW member is rejected outright whatever its total says —
+                # (stale resync of a placed pod) keep their reservation.
+                # A NEW member may only fill a freed slot (a crashed
+                # member's controller-recreated replacement after
+                # drop_member); into a FULL admitted gang it is rejected —
                 # registering it would push len(members) past total and
                 # re-run atomic placement over already-placed members,
                 # reassigning bound pods' nodes.
-                if member.uid not in g.members:
+                if member.uid not in g.members and len(g.members) >= g.total:
                     raise GangConflictError(
                         f"gang {key}: already admitted with "
                         f"{g.total} members; late member {member.name} "
@@ -172,10 +174,13 @@ def place_gang(
     fit_pod,
     node_score,
     default_policy: str,
+    only_uids=None,
 ) -> Optional[Dict[str, Tuple[str, list]]]:
     """Atomically place every member on the given usage snapshot.
 
-    Returns uid -> (node, devices) covering ALL members, or None.  The
+    Returns uid -> (node, devices) covering ALL members (or just
+    ``only_uids`` — replacement members joining an admitted gang whose
+    placed peers are already charged in the snapshot), or None.  The
     snapshot's usage maps are mutated as members are placed, so later
     members see earlier members' grants — the all-or-nothing simulation.
 
@@ -201,7 +206,8 @@ def place_gang(
         }
         placements: Dict[str, Tuple[str, list]] = {}
         ok = True
-        for uid in sorted(gang.members):
+        for uid in sorted(only_uids if only_uids is not None
+                          else gang.members):
             m = gang.members[uid]
             best: Optional[Tuple[float, str, list, dict]] = None
             for name in candidates:

@@ -96,13 +96,37 @@ class Native:
         self.lib.vtpu_shutdown()
 
 
+class _SlotHolder:
+    """Sticky per-callable record of the device slots it last ran on: the
+    slots a dispatch must charge are only known from its OUTPUT, so each
+    call acquires on the previous call's slots (first call: slot 0)."""
+
+    __slots__ = ("slots",)
+
+    def __init__(self, slots: Optional[List[int]] = None) -> None:
+        self.slots = slots
+
+
 class Shim:
-    def __init__(self, native: Native) -> None:
+    # Native bucket burst cap (rate_limiter.cc kMaxBurstUs): larger charges
+    # are clamped there anyway; clamp here too so estimates stay sane after
+    # a compile is measured as one dispatch.
+    MAX_COST_US = 200_000
+
+    def __init__(self, native: Native, clock=time.monotonic) -> None:
         self.native = native
+        self._clock = clock
         self._ballast: List[Any] = []
         self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._last_cost_us: Dict[int, int] = {}
+        # Dispatch-gate state: every VTPU_SYNC_EVERY-th gated dispatch
+        # blocks on its result so the measured time includes device
+        # execution, not just the (async) dispatch — the device-time signal
+        # the duty-cycle accounting needs.
+        self._sync_every = max(1, int(os.environ.get("VTPU_SYNC_EVERY", "16")))
+        self._dispatch_n = 0
+        self._slot_cache: Dict[int, int] = {}
 
     # -- introspection ---------------------------------------------------------
     def memory_info(self, dev: int = 0) -> Dict[str, int]:
@@ -114,59 +138,169 @@ class Shim:
 
     # -- compute throttling ----------------------------------------------------
     def throttled(self, fn, dev: int = 0):
-        """Gate a callable through the native duty-cycle limiter, feeding the
-        measured wall time back as the next dispatch's cost estimate."""
+        """Gate a plain callable through the native duty-cycle limiter on a
+        fixed device slot, feeding measured wall time back as cost."""
+
+        holder = _SlotHolder([dev])
 
         @functools.wraps(fn)
         def gated(*args, **kwargs):
-            cost = self._last_cost_us.get(dev, 0)
-            self.native.lib.vtpu_rate_acquire(dev, cost)
-            t0 = time.monotonic()
-            out = fn(*args, **kwargs)
-            busy = int((time.monotonic() - t0) * 1e6)
-            self._last_cost_us[dev] = busy
-            self.native.lib.vtpu_rate_feedback(dev, busy)
-            return out
+            return self._gated_call(fn, holder, args, kwargs,
+                                    track_devices=False)
 
         return gated
 
+    def _slots_of(self, out) -> List[int]:
+        """Region slots (local device indices) backing a dispatch result.
+        Slot i of the region corresponds to the i-th visible chip, which is
+        the i-th local device in-process (deviceplugin emits
+        TPU_DEVICE_MEMORY_LIMIT_<i> in TPU_VISIBLE_CHIPS order)."""
+        try:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(out)
+            if not leaves:
+                return [0]
+            devices = getattr(leaves[0], "devices", None)
+            devs = devices() if callable(devices) else None
+            if not devs:
+                return [0]
+            slots = []
+            for d in devs:
+                s = self._slot_cache.get(id(d))
+                if s is None:
+                    try:
+                        s = jax.local_devices().index(d)
+                    except (ValueError, RuntimeError):
+                        s = int(getattr(d, "local_hardware_id", 0) or 0)
+                    self._slot_cache[id(d)] = s
+                slots.append(s)
+            return slots or [0]
+        except Exception:
+            return [0]
+
+    def _gated_call(self, fn, holder: "_SlotHolder", args, kwargs,
+                    track_devices: bool = True):
+        """One gated dispatch: acquire on every slot the callable last ran
+        on, run, periodically sync for a device-time-accurate cost sample,
+        then feed estimates back.
+
+        Cost model: wall time around an async dispatch under-charges (the
+        call returns before the device finishes), so every Nth dispatch
+        blocks on the result and that synced sample becomes the estimate;
+        unsynced samples only ever raise it.  Error bound: between syncs the
+        estimate lags workload changes by at most N dispatches."""
+        slots = holder.slots or [0]
+        for s in slots:
+            self.native.lib.vtpu_rate_acquire(
+                s, min(self._last_cost_us.get(s, 0), self.MAX_COST_US))
+        t0 = self._clock()
+        out = fn(*args, **kwargs)
+        self._dispatch_n += 1
+        synced = False
+        if track_devices and self._dispatch_n % self._sync_every == 0:
+            try:
+                import jax
+
+                jax.block_until_ready(out)
+                synced = True
+            except Exception:
+                pass
+        busy = int((self._clock() - t0) * 1e6)
+        if track_devices:
+            slots = holder.slots = self._slots_of(out)
+        for s in slots:
+            if track_devices:
+                # Async dispatch: unsynced wall time is a lower bound, so it
+                # may only raise the last synced estimate, never lower it.
+                prev = self._last_cost_us.get(s, 0)
+                est = busy if (synced or not prev) else max(prev, busy)
+            else:
+                # Synchronous callable: wall time IS the cost; last sample
+                # wins so one slow cold-start can't ratchet the charge up
+                # permanently.
+                est = busy
+            self._last_cost_us[s] = min(est, self.MAX_COST_US)
+            self.native.lib.vtpu_rate_feedback(s, self._last_cost_us[s])
+        return out
+
+    def _wrap_compiled(self, compiled, fun=None):
+        """Callable proxy keeping the PjitFunction API (lower, etc.)."""
+        shim = self
+        holder = _SlotHolder()
+
+        class Gated:
+            def __call__(self, *a, **k):
+                return shim._gated_call(compiled, holder, a, k)
+
+            def __getattr__(self, name):
+                return getattr(compiled, name)
+
+        proxy = Gated()
+        if fun is not None:
+            try:
+                proxy = functools.wraps(fun)(proxy)
+            except Exception:
+                pass
+        return proxy
+
     def install_jax_hooks(self) -> bool:
-        """Wrap jax.jit so every jitted callable dispatch passes the limiter.
-        No-op when jax is absent."""
+        """Gate jitted-callable dispatch through the native limiter.  Covers
+        jax.jit, jax.pmap, and AOT ``.lower().compile()`` executables (the
+        reference gates cuLaunchKernel; one XLA executable execution is the
+        TPU dispatch unit).  Dispatches that bypass all three (eager ops,
+        callables jitted before install) are not throttled — each eager op
+        is tiny, and install runs at interpreter start via sitecustomize
+        before user code can capture the originals.  No-op without jax."""
         try:
             import jax
         except Exception:
             return False
         if getattr(jax.jit, "_vtpu_wrapped", False):
             return True
-        orig_jit = jax.jit
         shim = self
 
-        def vtpu_jit(fun=None, **kwargs):
-            if fun is None:
-                return lambda f: vtpu_jit(f, **kwargs)
-            compiled = orig_jit(fun, **kwargs)
+        def make_wrapper(orig):
+            # *args matters: jax.pmap(f, "batch") passes axis_name
+            # positionally; jit/pmap called with only keywords (decorator
+            # style) return a partial.
+            def vtpu_wrap(fun=None, *args, **kwargs):
+                if fun is None:
+                    return lambda f: vtpu_wrap(f, *args, **kwargs)
+                return shim._wrap_compiled(orig(fun, *args, **kwargs), fun)
 
-            class Gated:
-                """Callable proxy keeping the PjitFunction API (lower, etc.)."""
+            vtpu_wrap._vtpu_wrapped = True  # type: ignore[attr-defined]
+            return vtpu_wrap
 
-                def __call__(self, *a, **k):
-                    cost = shim._last_cost_us.get(0, 0)
-                    shim.native.lib.vtpu_rate_acquire(0, cost)
-                    t0 = time.monotonic()
-                    out = compiled(*a, **k)
-                    busy = int((time.monotonic() - t0) * 1e6)
-                    shim._last_cost_us[0] = busy
-                    shim.native.lib.vtpu_rate_feedback(0, busy)
-                    return out
+        jax.jit = make_wrapper(jax.jit)
+        try:
+            jax.pmap = make_wrapper(jax.pmap)
+        except Exception:
+            pass
+        # AOT path: jitted.lower(...).compile() returns a stages.Compiled
+        # whose __call__ never passes through the jax.jit wrapper — gate it
+        # at the class so AOT dispatch is throttled too.
+        try:
+            from jax import stages
 
-                def __getattr__(self, name):
-                    return getattr(compiled, name)
+            orig_call = stages.Compiled.__call__
+            if not getattr(orig_call, "_vtpu_wrapped", False):
+                def gated_call(self_c, *a, **k):
+                    holder = getattr(self_c, "_vtpu_slots", None)
+                    if holder is None:
+                        holder = _SlotHolder()
+                        try:
+                            object.__setattr__(self_c, "_vtpu_slots", holder)
+                        except Exception:
+                            pass
+                    return shim._gated_call(
+                        lambda *aa, **kk: orig_call(self_c, *aa, **kk),
+                        holder, a, k)
 
-            return functools.wraps(fun)(Gated())
-
-        vtpu_jit._vtpu_wrapped = True  # type: ignore[attr-defined]
-        jax.jit = vtpu_jit
+                gated_call._vtpu_wrapped = True  # type: ignore[attr-defined]
+                stages.Compiled.__call__ = gated_call
+        except Exception:
+            pass
         return True
 
     # -- HBM hard cap ----------------------------------------------------------

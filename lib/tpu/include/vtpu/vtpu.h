@@ -51,6 +51,13 @@ void vtpu_rate_acquire(int dev, uint64_t cost_us);
  * (closes the loop the reference drives from utilization_watcher). */
 void vtpu_rate_feedback(int dev, uint64_t busy_us);
 
+/* Deterministic test clock: when on, the limiter reads a manual clock and
+ * its wait loop advances it instead of sleeping, so duty-cycle math is
+ * exactly reproducible.  Enabling resets all buckets. */
+void vtpu_rate_test_mode(int on);
+void vtpu_rate_test_advance(uint64_t ns);
+uint64_t vtpu_rate_test_now(void);
+
 /* -- external reader API (node monitor) ----------------------------------- */
 vtpu_region_t* vtpu_open_region(const char* path);
 void vtpu_close_region(vtpu_region_t* r);

@@ -40,10 +40,25 @@ struct Bucket {
 
 Bucket g_buckets[VTPU_MAX_DEVICES];
 
+// Deterministic test clock (vtpu_rate_test_mode): when enabled, now_ns()
+// reads a manual counter and the wait loop advances it instead of sleeping,
+// making duty-cycle math exactly reproducible in tests.
+std::atomic<bool> g_test_mode{false};
+std::atomic<uint64_t> g_test_now_ns{0};
+
 uint64_t now_ns() {
+  if (g_test_mode.load(std::memory_order_relaxed))
+    return g_test_now_ns.load(std::memory_order_relaxed);
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
   return (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+void wait_us(uint64_t us) {
+  if (g_test_mode.load(std::memory_order_relaxed))
+    g_test_now_ns.fetch_add(us * 1000ull, std::memory_order_relaxed);
+  else
+    usleep(us);
 }
 
 }  // namespace
@@ -79,6 +94,9 @@ void vtpu_rate_acquire(int dev, uint64_t cost_us) {
   Bucket& b = g_buckets[dev];
   std::lock_guard<std::mutex> g(b.mu);
   if (cost_us == 0) cost_us = b.last_busy_us ? b.last_busy_us : kDefaultCostUs;
+  // The bucket can never hold more than kMaxBurstUs, so an unclamped larger
+  // cost (e.g. a compile measured as one dispatch) would wait forever.
+  if (cost_us > kMaxBurstUs) cost_us = kMaxBurstUs;
   double rate = (double)sm / 100.0;  // device-us earned per wall-us
   for (;;) {
     uint64_t now = now_ns();
@@ -91,7 +109,7 @@ void vtpu_rate_acquire(int dev, uint64_t cost_us) {
       return;
     }
     uint64_t deficit_us = (uint64_t)(((double)cost_us - b.tokens_us) / rate);
-    usleep(std::min<uint64_t>(deficit_us + 1, 50000));
+    wait_us(std::min<uint64_t>(deficit_us + 1, 50000));
   }
 }
 
@@ -100,6 +118,28 @@ void vtpu_rate_feedback(int dev, uint64_t busy_us) {
   Bucket& b = g_buckets[dev];
   std::lock_guard<std::mutex> g(b.mu);
   b.last_busy_us = busy_us;
+}
+
+// -- test hooks (deterministic duty-cycle verification) ----------------------
+
+void vtpu_rate_test_mode(int on) {
+  if (on) g_test_now_ns.store(1, std::memory_order_relaxed);
+  g_test_mode.store(on != 0, std::memory_order_relaxed);
+  if (!on) return;
+  for (int i = 0; i < VTPU_MAX_DEVICES; ++i) {
+    std::lock_guard<std::mutex> g(g_buckets[i].mu);
+    g_buckets[i].tokens_us = kMaxBurstUs;
+    g_buckets[i].last_refill_ns = 0;
+    g_buckets[i].last_busy_us = 0;
+  }
+}
+
+void vtpu_rate_test_advance(uint64_t ns) {
+  g_test_now_ns.fetch_add(ns, std::memory_order_relaxed);
+}
+
+uint64_t vtpu_rate_test_now(void) {
+  return g_test_now_ns.load(std::memory_order_relaxed);
 }
 
 }  // extern "C"

@@ -100,6 +100,31 @@ class TestGangAdmission:
         assert s.pods.get("cu0") is not None and s.pods.get("cu1") is not None
         assert s.pods.get("cu9") is None
 
+    def test_replacement_member_fills_freed_slot(self, env):
+        # A crashed member's controller-recreated pod (new uid, same group)
+        # must be able to join the admitted gang and get placed WITHOUT
+        # disturbing the surviving members' placements.
+        kube, s = env
+        pods = [gang_pod(f"r{i}", f"ru{i}", group="jobr", total=2)
+                for i in range(2)]
+        for p in pods:
+            kube.create_pod(p)
+        s.filter(pods[0], NODES)
+        r1 = s.filter(pods[1], NODES)
+        assert r1.node in NODES
+        survivor_node = s.filter(pods[0], NODES).node
+
+        # Member ru1 dies; controller recreates it with a new uid.
+        kube.delete_pod("default", "r1")
+        assert s.pods.get("ru1") is None
+        repl = gang_pod("r1-new", "ru9", group="jobr", total=2)
+        kube.create_pod(repl)
+        rr = s.filter(repl, NODES)
+        assert rr.node in NODES, rr.error
+        # Survivor untouched, replacement accounted.
+        assert s.filter(pods[0], NODES).node == survivor_node
+        assert s.pods.get("ru9") is not None
+
     def test_infeasible_gang_admits_nobody(self, env):
         kube, s = env
         # 4 members x 4 full-memory chips > 3 nodes x 4 chips.
