@@ -15,6 +15,7 @@ import os
 import time
 
 from ..deviceplugin import DeviceCache, DeviceRegister, TpuDevicePlugin
+from ..deviceplugin.plugin import CrashLoopBreaker
 from ..deviceplugin.allocator import publish_unsatisfiable
 from ..deviceplugin.partition import get_partition_plugins, whole_chip_view
 from ..k8s import make_client
@@ -193,6 +194,34 @@ def main(argv=None):
         last_ino = os.stat(kubelet_sock).st_ino
     except OSError:
         last_ino = None
+    # Serve supervision: a died/wedged gRPC server is restarted, but a
+    # flapping one trips the breaker (reference plugin.go:200–217).
+    breaker = CrashLoopBreaker()
+    supervised = ([plugin] if serve_main else []) + list(part_plugins)
+
+    def ensure_serving(count_crash: bool) -> bool:
+        """Restart any dead plugin server; True if one was restarted.
+
+        ``count_crash`` is False when the kubelet just restarted (it wipes
+        the whole plugin dir — an external event, not a server crash); the
+        breaker only counts genuine crashes, and at most one per tick even
+        with several partition plugins down at once."""
+        dead = [p for p in supervised if not p.serving()]
+        if not dead:
+            return False
+        if count_crash:
+            breaker.record("device-plugin server ("
+                           + ",".join(p.resource_name for p in dead) + ")")
+        restarted = False
+        for p in dead:
+            log.warning("server for %s down; restarting", p.resource_name)
+            try:
+                p.serve()
+                restarted = True
+            except Exception:  # noqa: BLE001 — retried next tick
+                log.exception("restart failed for %s", p.resource_name)
+        return restarted
+
     try:
         while True:
             time.sleep(5)
@@ -200,8 +229,11 @@ def main(argv=None):
                 ino = os.stat(kubelet_sock).st_ino
             except OSError:
                 ino = None
-            if ino != last_ino:
-                last_ino = ino
+            kubelet_restarted = ino != last_ino
+            last_ino = ino
+            if ensure_serving(count_crash=not kubelet_restarted):
+                registered = try_register()
+            if kubelet_restarted:
                 if ino is not None:
                     log.info("kubelet socket changed; re-registering")
                     registered = try_register()

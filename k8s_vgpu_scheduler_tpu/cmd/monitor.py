@@ -28,6 +28,8 @@ def parse_args(argv=None):
                         "(node-local tooling) — widen to [::] explicitly "
                         "and add a NetworkPolicy if peers need it")
     p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--debug-port", type=int, default=0,
+                   help="loopback /debug profiling endpoints (0 = off)")
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
     p.add_argument("--no-backend", action="store_true",
                    help="skip chip enumeration (metrics from regions only)")
@@ -50,6 +52,10 @@ def main(argv=None):
     loop = FeedbackLoop(args.container_root)
     node = args.node_name or os.uname().nodename
     start_metrics_server(loop, backend, node, args.metrics_port)
+    if args.debug_port:
+        from ..util.debugz import DebugServer
+
+        DebugServer(port=args.debug_port).start()
     rpc = None
     if args.grpc_port:
         from ..monitor.noderpc import NodeTPUInfoServer

@@ -41,6 +41,10 @@ def parse_args(argv=None):
     p.add_argument("--resource-priority", default="vtpu.dev/task-priority")
     p.add_argument("--topology-policy", default="best-effort")
     p.add_argument("--resync-seconds", type=float, default=30.0)
+    p.add_argument("--debug", action="store_true",
+                   help="enable the /debug profiling endpoints (stacks, "
+                        "wall-clock profile, vars); unauthenticated — keep "
+                        "off unless the port is restricted")
     p.add_argument("--fake-kube", action="store_true",
                    help="in-memory apiserver (dev/dry-run only)")
     p.add_argument("--kube-url", default="",
@@ -62,6 +66,7 @@ def build_config(args) -> Config:
         default_mem=args.default_mem,
         default_cores=args.default_cores,
         topology_policy=args.topology_policy,
+        enable_debug=args.debug,
     )
 
 

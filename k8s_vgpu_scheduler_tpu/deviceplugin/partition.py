@@ -279,6 +279,9 @@ class PartitionDevicePlugin:
     def serve(self) -> None:
         self._shell.serve()
 
+    def serving(self) -> bool:
+        return self._shell.serving()
+
     def register_with_kubelet(self, kubelet_socket: Optional[str] = None):
         return self._shell.register_with_kubelet(kubelet_socket)
 

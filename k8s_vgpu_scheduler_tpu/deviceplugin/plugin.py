@@ -68,6 +68,31 @@ HEALTHY = "Healthy"
 UNHEALTHY = "Unhealthy"
 
 
+class CrashLoopBreaker:
+    """Backstop against a flapping gRPC server: more than ``max_crashes``
+    restarts inside ``window_s`` is a persistent fault — die loudly and let
+    the DaemonSet controller surface CrashLoopBackOff instead of looping
+    forever (reference plugin.go:200–217: >5 crashes/hour → Fatal)."""
+
+    def __init__(self, max_crashes: int = 5, window_s: float = 3600.0,
+                 now=None) -> None:
+        import time as _time
+
+        self.max_crashes = max_crashes
+        self.window_s = window_s
+        self._now = now or _time.monotonic
+        self._crashes: list = []
+
+    def record(self, what: str = "server") -> None:
+        t = self._now()
+        self._crashes = [c for c in self._crashes
+                         if t - c <= self.window_s] + [t]
+        if len(self._crashes) > self.max_crashes:
+            raise SystemExit(
+                f"{what} crashed {len(self._crashes)} times within "
+                f"{int(self.window_s)}s; giving up (crash-loop breaker)")
+
+
 def attach_enforcement(resp, cfg: Config, cache_key: str) -> None:
     """Attach the L1 enforcement contract to an allocate response: the
     per-container shared accounting region (hostPath dir, scanned by the
@@ -279,7 +304,18 @@ class TpuDevicePlugin:
         return resp
 
     # -- serving lifecycle (Serve/Register, plugin.go:181–253) ----------------
+    def serving(self) -> bool:
+        """Liveness for the supervisor: server object present AND the unix
+        socket still on disk (kubelet wipes the plugin dir on restart; a
+        crashed server leaves a stale path)."""
+        return self._server is not None and os.path.exists(self.socket_path)
+
     def serve(self) -> None:
+        if self._server is not None:
+            # Supervised restart: release the old executor's threads and the
+            # fd on the unlinked socket inode before replacing it.
+            self._server.stop(grace=0)
+            self._server = None
         if os.path.exists(self.socket_path):
             os.unlink(self.socket_path)
         self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))

@@ -88,6 +88,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         if self.path == "/healthz":
             self._reply(200, {"ok": True})
+        elif self.path.startswith("/debug/") and self.cfg.enable_debug:
+            from urllib.parse import parse_qsl, urlsplit
+
+            from ..util import debugz
+
+            parts = urlsplit(self.path)
+            code, ctype, body = debugz.handle(
+                parts.path, dict(parse_qsl(parts.query)))
+            raw = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
         else:
             self._reply(404, {"error": "not found"})
 

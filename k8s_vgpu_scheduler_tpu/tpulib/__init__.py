@@ -1,10 +1,11 @@
-from .backend import Backend, JaxBackend, MockBackend, detect
+from .backend import Backend, JaxBackend, MockBackend, SysfsBackend, detect
 from .types import ChipInfo, NodeInventory, TopologyDesc
 
 __all__ = [
     "Backend",
     "JaxBackend",
     "MockBackend",
+    "SysfsBackend",
     "detect",
     "ChipInfo",
     "NodeInventory",

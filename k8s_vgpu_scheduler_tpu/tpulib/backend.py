@@ -218,9 +218,127 @@ def _iter_coords(mesh):
     yield from product(*(range(d) for d in mesh))
 
 
+class SysfsBackend(Backend):
+    """Jax-free enumeration from /dev/accel* + TPU VM environment.
+
+    The shipped control-plane image deliberately carries no jax (workload
+    containers bring their own), so on a real node the device plugin needs a
+    discovery path that doesn't import it — the analog of the reference
+    reading /proc/driver/nvidia-caps without CUDA (mig.go).  Sources:
+
+    - chip count: ``/dev/accel<N>`` device nodes (Google TPU ``accel``
+      driver; also ``/dev/vfio/<N>`` on vfio-bound v5p hosts)
+    - generation + HBM: ``TPU_ACCELERATOR_TYPE`` (e.g. ``v5litepod-8``,
+      set on TPU VMs / injected by GKE), falling back to
+      ``/sys/class/accel/accel0/device`` vendor probing
+    - per-host mesh shape: ``TPU_CHIPS_PER_HOST_BOUNDS`` ("2,2,1") when
+      present, else the standard host layout for the chip count
+    """
+
+    def __init__(self, dev_root: str = "/dev", sysfs_root: str = "/sys",
+                 env: Optional[dict] = None) -> None:
+        self.dev_root = dev_root
+        self.sysfs_root = sysfs_root
+        self.env = os.environ if env is None else env
+
+    def _chip_indices(self) -> "list[int]":
+        idx = []
+        try:
+            for name in sorted(os.listdir(self.dev_root)):
+                if name.startswith("accel") and name[5:].isdigit():
+                    idx.append(int(name[5:]))
+        except OSError:
+            pass
+        if not idx:
+            vfio = os.path.join(self.dev_root, "vfio")
+            try:
+                idx = sorted(int(n) for n in os.listdir(vfio) if n.isdigit())
+            except (OSError, ValueError):
+                idx = []
+        return idx
+
+    def _generation(self) -> str:
+        acc = self.env.get("TPU_ACCELERATOR_TYPE", "")
+        if acc:
+            head = acc.split("-")[0].lower()
+            if head in ("v5litepod", "v5lite", "v5e"):
+                return "v5e"
+            if head in _GENERATION_HBM_MIB:
+                return head
+        # sysfs fallback: the accel class symlinks to the PCI device whose
+        # vendor is Google (0x1ae0); the device-id→generation map is not
+        # public, so confirm it IS a TPU but report a generic generation —
+        # claiming a specific one would mis-size HBM and mesh on v4/v5p
+        # hosts (set TPU_ACCELERATOR_TYPE for exact inventory).
+        vendor_path = os.path.join(
+            self.sysfs_root, "class", "accel", "accel0", "device", "vendor")
+        try:
+            with open(vendor_path) as f:
+                if f.read().strip() in ("0x1ae0", "1ae0"):
+                    log.warning(
+                        "TPU vendor detected but TPU_ACCELERATOR_TYPE unset; "
+                        "generation unknown — HBM defaults conservative")
+                    return "unknown"
+        except OSError:
+            pass
+        return "unknown"
+
+    def _mesh(self, n: int, gen: str) -> "tuple[int, ...]":
+        bounds = self.env.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+        if bounds:
+            try:
+                dims = tuple(int(x) for x in bounds.split(","))
+                if dims and all(d > 0 for d in dims):
+                    return dims
+            except ValueError:
+                pass
+        if gen in ("v4", "v5p"):
+            # 3D-torus hosts carry 4 chips at 2x2x1.
+            return {1: (1, 1, 1), 2: (2, 1, 1), 4: (2, 2, 1)}.get(
+                n, (n, 1, 1))
+        return {1: (1, 1), 2: (2, 1), 4: (2, 2), 8: (2, 4)}.get(n, (n, 1))
+
+    def inventory(self) -> NodeInventory:
+        indices = self._chip_indices()
+        if not indices:
+            raise RuntimeError(
+                f"no TPU chips under {self.dev_root}/accel* or vfio")
+        gen = self._generation()
+        hbm = _GENERATION_HBM_MIB.get(gen, 16 * 1024)
+        mesh = self._mesh(len(indices), gen)
+        coords = list(_iter_coords(mesh))
+        chips = [
+            ChipInfo(
+                index=i,
+                uuid=f"TPU-{gen}-{_hostname()}-{i}",
+                type=f"TPU-{gen}",
+                hbm_mib=hbm,
+                coords=coords[k] if k < len(coords) else (i,) * len(mesh),
+            )
+            for k, i in enumerate(indices)
+        ]
+        return NodeInventory(chips=chips,
+                             topology=TopologyDesc(generation=gen, mesh=mesh))
+
+
 def detect() -> Backend:
-    """Mock if $VTPU_MOCK_JSON is set; else real hardware; else error."""
+    """Mock if $VTPU_MOCK_JSON is set; else real hardware.
+
+    ``VTPU_DISCOVERY`` picks the hardware path: ``jax`` (force),
+    ``sysfs`` (force, jax-free), or ``auto`` (default — jax when importable,
+    else sysfs, so the jax-less control-plane image still enumerates)."""
     if os.environ.get(MOCK_ENV):
         log.info("using MockBackend fixture %s", os.environ[MOCK_ENV])
         return MockBackend()
-    return JaxBackend()
+    mode = os.environ.get("VTPU_DISCOVERY", "auto")
+    if mode == "sysfs":
+        return SysfsBackend()
+    if mode == "jax":
+        return JaxBackend()
+    try:
+        import jax  # noqa: F401 — availability probe only
+
+        return JaxBackend()
+    except Exception:
+        log.info("jax unavailable; using sysfs chip discovery")
+        return SysfsBackend()

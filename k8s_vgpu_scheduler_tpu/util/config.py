@@ -53,6 +53,12 @@ class Config:
     # Topology placement policy default for multi-chip requests.
     topology_policy: str = "best-effort"
 
+    # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
+    # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
+    # Default OFF: the surface is unauthenticated and the HTTP port binds
+    # wide (same rationale as the monitor's loopback-only noderpc default).
+    enable_debug: bool = False
+
     # Chip-partition strategy (MIG analog): none | single | mixed.
     partition_strategy: str = "none"
 

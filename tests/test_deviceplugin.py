@@ -384,3 +384,34 @@ class TestSharingModes:
             pod, codec.decode_pod_devices(
                 pod["metadata"]["annotations"][TO_ALLOCATE_ANNOTATION])[0])
         assert dict(resp.envs)["TPU_DEVICE_MEMORY_LIMIT_0"] == "3000"
+
+
+class TestCrashLoopBreaker:
+    def test_trips_after_max_crashes_in_window(self):
+        from k8s_vgpu_scheduler_tpu.deviceplugin.plugin import CrashLoopBreaker
+
+        t = [0.0]
+        b = CrashLoopBreaker(max_crashes=5, window_s=3600, now=lambda: t[0])
+        for _ in range(5):
+            t[0] += 60
+            b.record()  # five within the hour: tolerated
+        t[0] += 60
+        with pytest.raises(SystemExit, match="crash-loop"):
+            b.record()
+
+    def test_old_crashes_age_out(self):
+        from k8s_vgpu_scheduler_tpu.deviceplugin.plugin import CrashLoopBreaker
+
+        t = [0.0]
+        b = CrashLoopBreaker(max_crashes=5, window_s=3600, now=lambda: t[0])
+        for _ in range(20):  # sparse crashes never trip it
+            t[0] += 1800
+            b.record()
+
+    def test_serving_liveness(self, plugin_env, tmp_path):
+        *_, plugin, _stub = plugin_env
+        assert plugin.serving()
+        os.unlink(plugin.socket_path)  # kubelet wiped the plugin dir
+        assert not plugin.serving()
+        plugin.serve()
+        assert plugin.serving()

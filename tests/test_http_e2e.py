@@ -4,6 +4,7 @@ SURVEY.md §4 says the reference lacks."""
 
 import base64
 import json
+import urllib.error
 import urllib.request
 from concurrent import futures
 
@@ -194,3 +195,54 @@ class TestGrpcRegister:
             assert s.nodes.get_node("grpc-node") is None
         finally:
             server.stop(grace=1)
+
+
+class TestDebugEndpoints:
+    """SURVEY §5 optional-profiling note: pprof-style /debug surface
+    (opt-in: the endpoints are unauthenticated)."""
+
+    @pytest.fixture
+    def debug_server(self):
+        kube = FakeKube()
+        s = Scheduler(kube, Config(enable_debug=True))
+        srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+        srv.start()
+        yield srv.port
+        srv.stop()
+
+    def get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, resp.read().decode()
+
+    def test_stacks_and_vars(self, debug_server):
+        port = debug_server
+        status, body = self.get(port, "/debug/stacks")
+        assert status == 200
+        assert "--- thread" in body and "serve_forever" in body
+        status, body = self.get(port, "/debug/vars")
+        assert status == 200
+        v = json.loads(body)
+        assert v["threads"] >= 1 and v["rss_mib"] > 0
+
+    def test_profile_samples(self, debug_server):
+        status, body = self.get(debug_server, "/debug/profile?seconds=0.2")
+        assert status == 200
+        assert "wall-clock samples" in body
+
+    def test_debug_off_by_default(self, server):
+        _, _, port = server  # default Config: unauthenticated surface off
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self.get(port, "/debug/vars")
+        assert ei.value.code == 404
+
+    def test_standalone_debug_server(self):
+        from k8s_vgpu_scheduler_tpu.util.debugz import DebugServer
+
+        d = DebugServer(port=0)
+        d.start()
+        try:
+            status, body = self.get(d.port, "/debug/vars")
+            assert status == 200 and json.loads(body)["pid"] > 0
+        finally:
+            d.stop()
