@@ -31,8 +31,14 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 
 # Total wall budget for everything (driver kills at 600s; stay well under).
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "420"))
-PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "90"))
-PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+# TPU cold init + first (possibly remote) compile can exceed 90s — round-2's
+# 90s probe timed out 3× on a healthy backend.  One long probe beats three
+# short ones: each retry restarts cold init from scratch.
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "210"))
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
+# Probe/worker stderr is persisted here so a failed round leaves diagnosable
+# evidence (VERDICT r2: "nothing captures diagnostics").
+DIAG_PATH = os.path.join(REPO, "bench_diag.txt")
 
 # Case table: (batch, size, iters, baseline images/s, train?).  Baselines are
 # the reference's vGPU-plugin column (BASELINE.md / README.md:191–204).
@@ -59,6 +65,15 @@ def remaining() -> float:
 def log(msg: str) -> None:
     print(f"bench[{time.monotonic() - _START:6.1f}s]: {msg}", file=sys.stderr,
           flush=True)
+
+
+def diag(msg: str) -> None:
+    """Append full diagnostics (probe/worker stderr) to bench_diag.txt."""
+    try:
+        with open(DIAG_PATH, "a") as f:
+            f.write(f"[{time.monotonic() - _START:6.1f}s] {msg}\n")
+    except OSError:
+        pass
 
 
 def build_native() -> None:
@@ -104,10 +119,16 @@ def probe_backend(env: dict, platform: str, timeout: float) -> bool:
     try:
         r = subprocess.run([sys.executable, "-c", code], env=penv,
                            capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as te:
         log(f"probe[{platform}]: timed out after {timeout:.0f}s")
+        diag(f"probe[{platform}] TIMEOUT after {timeout:.0f}s; partial "
+             f"stderr:\n{(te.stderr or b'')!r}\npartial stdout:\n"
+             f"{(te.output or b'')!r}")
         return False
     ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+    if not ok:
+        diag(f"probe[{platform}] rc={r.returncode}\nstderr:\n{r.stderr}\n"
+             f"stdout:\n{r.stdout}")
     if ok and platform == "native":
         # jax silently falls back to CPU when no accelerator plugin loads;
         # a "native" probe that landed on CPU must NOT pass, or the
@@ -170,8 +191,11 @@ def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
         if r.returncode != 0:
             tail = (r.stderr or "").strip().splitlines()[-4:]
             log(f"case {name}: worker rc={r.returncode}: " + " | ".join(tail))
-    except subprocess.TimeoutExpired:
+            diag(f"case {name} worker rc={r.returncode}\nstderr:\n{r.stderr}")
+    except subprocess.TimeoutExpired as te:
         log(f"case {name}: worker timed out after {timeout:.0f}s")
+        diag(f"case {name} worker TIMEOUT after {timeout:.0f}s; partial "
+             f"stderr:\n{(te.stderr or b'')!r}")
     result = None
     if os.path.exists(out):
         try:

@@ -304,11 +304,23 @@ class TpuDevicePlugin:
         return resp
 
     # -- serving lifecycle (Serve/Register, plugin.go:181–253) ----------------
-    def serving(self) -> bool:
-        """Liveness for the supervisor: server object present AND the unix
-        socket still on disk (kubelet wipes the plugin dir on restart; a
-        crashed server leaves a stale path)."""
-        return self._server is not None and os.path.exists(self.socket_path)
+    def serving(self, probe_timeout: float = 2.0) -> bool:
+        """Liveness for the supervisor: server object present, unix socket
+        still on disk (kubelet wipes the plugin dir on restart; a crashed
+        server leaves a stale path), AND a short-timeout local RPC answers —
+        a wedged-but-alive server (threads stuck, socket on disk) must fail
+        this check, not just a dead one."""
+        if self._server is None or not os.path.exists(self.socket_path):
+            return False
+        try:
+            from ..api.kubelet import DevicePluginStub
+
+            with grpc.insecure_channel(f"unix://{self.socket_path}") as ch:
+                DevicePluginStub(ch).GetDevicePluginOptions(
+                    pb.Empty(), timeout=probe_timeout)
+            return True
+        except grpc.RpcError:
+            return False
 
     def serve(self) -> None:
         if self._server is not None:

@@ -8,6 +8,7 @@ regions (vs the scheduler's :9395 which reports *granted* amounts).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Iterable, Optional
 
 from prometheus_client.core import GaugeMetricFamily
@@ -20,11 +21,28 @@ log = logging.getLogger(__name__)
 
 
 class NodeCollector(Collector):
+    # Chip capacities are static between hotplug events; re-enumerating on
+    # every Prometheus scrape would be a jax.local_devices() call per scrape
+    # with JaxBackend.  Cache with a TTL on the order of the health loop's
+    # own refresh.
+    INVENTORY_TTL_S = 30.0
+
     def __init__(self, loop: FeedbackLoop, backend: Optional[Backend] = None,
-                 node_name: str = "") -> None:
+                 node_name: str = "", now=time.monotonic) -> None:
         self.loop = loop
         self.backend = backend
         self.node_name = node_name
+        self._now = now
+        self._inv_cache: Optional[list] = None
+        self._inv_at = float("-inf")
+
+    def _chips(self) -> list:
+        now = self._now()
+        if (self._inv_cache is None
+                or now - self._inv_at > self.INVENTORY_TTL_S):
+            self._inv_cache = list(self.backend.inventory().chips)
+            self._inv_at = now
+        return self._inv_cache
 
     def collect(self) -> Iterable[GaugeMetricFamily]:
         host_mem = GaugeMetricFamily(
@@ -33,7 +51,7 @@ class NodeCollector(Collector):
         )
         if self.backend is not None:
             try:
-                for chip in self.backend.inventory().chips:
+                for chip in self._chips():
                     host_mem.add_metric([self.node_name, chip.uuid], chip.hbm_mib)
             except Exception:
                 log.exception("host inventory scrape failed")

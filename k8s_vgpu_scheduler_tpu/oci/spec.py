@@ -10,6 +10,8 @@ build_container_response) onto a raw runtime bundle.
 from __future__ import annotations
 
 import json
+import logging
+import os
 from typing import Callable, Dict, List, Optional
 
 from ..util.types import (
@@ -20,6 +22,8 @@ from ..util.types import (
     ENV_VISIBLE_CHIPS,
     ENV_VISIBLE_DEVICES,
 )
+
+log = logging.getLogger(__name__)
 
 
 class FileSpec:
@@ -60,6 +64,7 @@ def inject_vtpu(
     cache_path: str = "/tmp/vtpu/vtpu.cache",
     shim_host_dir: str = "/usr/local/vtpu",
     cache_host_dir: Optional[str] = None,
+    strict: Optional[bool] = None,
 ) -> Callable[[dict], dict]:
     """Build a SpecModifier injecting the vtpu enforcement contract.
 
@@ -104,14 +109,35 @@ def inject_vtpu(
         # Mirror attach_enforcement (deviceplugin/plugin.py:92–108): only
         # bind-mount shim artifacts that exist on the host — an
         # unconditional mount of a missing source makes runc fail EVERY
-        # create, which is strictly worse than running unenforced.
-        import os
-
+        # create, which is strictly worse than running unenforced.  NOT
+        # silently though: a node with a broken shim install loses isolation,
+        # so the skip is loud, and VTPU_STRICT_ENFORCEMENT=1 (or strict=True)
+        # fails the create instead for enforcement-mandatory clusters.
+        fail_closed = (strict if strict is not None else
+                       os.environ.get("VTPU_STRICT_ENFORCEMENT", "")
+                       in ("1", "true"))
         if os.path.isdir(shim_host_dir):
             add_mount("/usr/local/vtpu", shim_host_dir, read_only=True)
             preload = os.path.join(shim_host_dir, "ld.so.preload")
             if os.path.exists(preload):
                 add_mount("/etc/ld.so.preload", preload, read_only=True)
+            else:
+                if fail_closed:
+                    raise FileNotFoundError(
+                        f"{preload} missing and VTPU_STRICT_ENFORCEMENT set; "
+                        "refusing to create an unenforced container")
+                log.warning(
+                    "shim ld.so.preload missing at %s — container will run "
+                    "WITHOUT HBM/core enforcement", preload)
+        else:
+            if fail_closed:
+                raise FileNotFoundError(
+                    f"shim host dir {shim_host_dir} missing and "
+                    "VTPU_STRICT_ENFORCEMENT set; refusing to create an "
+                    "unenforced container")
+            log.warning(
+                "shim host dir %s missing — container will run WITHOUT "
+                "HBM/core enforcement", shim_host_dir)
         if cache_host_dir:
             add_mount(
                 os.path.dirname(cache_path), cache_host_dir, read_only=False

@@ -97,6 +97,12 @@ class GangManager:
 
     def __init__(self, now=time.time) -> None:
         self._groups: Dict[str, Gang] = {}
+        # uid -> drop time.  A deleted pod's uid never comes back (recreated
+        # pods get fresh uids), so a replayed informer add-event for a
+        # dropped uid is definitionally stale — without this it would
+        # re-join an admitted gang with a free slot and resurrect a dead
+        # pod's tentative grant until the expiry sweep.
+        self._dropped: Dict[str, float] = {}
         self._now = now
         self._lock = threading.RLock()
 
@@ -105,6 +111,15 @@ class GangManager:
         with self._lock:
             key = f"{namespace}/{group}"
             g = self._groups.get(key)
+            if g is not None and member.uid not in g.members \
+                    and member.uid in self._dropped:
+                # A deleted pod's uid never returns (recreations get fresh
+                # uids): this is a replayed informer event.  Pre-admission it
+                # would let a dead member trigger a false atomic admission;
+                # post-admission it would resurrect a dead pod's grant.
+                raise GangConflictError(
+                    f"gang {key}: stale event for dropped pod "
+                    f"{member.name} ({member.uid}) rejected")
             if g is not None and g.placements:
                 # An admitted gang's reservations must survive informer
                 # churn: recreating the group would orphan the member
@@ -143,12 +158,20 @@ class GangManager:
     def drop_member(self, uid: str) -> None:
         """Release one pod's membership + placement (pod deleted)."""
         with self._lock:
+            now = self._now()
             for key in list(self._groups):
                 g = self._groups[key]
+                if uid in g.members:
+                    self._dropped[uid] = now
                 g.members.pop(uid, None)
                 g.placements.pop(uid, None)
                 if not g.members:
                     self._groups.pop(key)
+            # Bound the tombstone set: informer replay windows are far
+            # shorter than a gang's own expiry horizon.
+            cutoff = now - GANG_EXPIRE_SECONDS
+            self._dropped = {u: t for u, t in self._dropped.items()
+                             if t >= cutoff}
 
     def expired(self) -> List[Gang]:
         """Groups that stopped making progress.  NOT popped: the caller
@@ -190,12 +213,26 @@ def place_gang(
     # Bucket candidate nodes by topology generation; try the largest
     # homogeneous bucket first, fall back to "any node".
     by_gen: Dict[str, List[str]] = {}
+    gen_of: Dict[str, str] = {}
     for name, (info, usage) in usage_by_node.items():
         gen = info.topology.generation if info.topology else "?"
+        gen_of[name] = gen
         by_gen.setdefault(gen, []).append(name)
-    candidate_sets = sorted(by_gen.values(), key=len, reverse=True)
-    if len(candidate_sets) > 1:
+    if only_uids is not None and gang.placements:
+        # Replacement members joining an admitted gang: keep the slice
+        # homogeneous with the peers already bound — restrict candidates to
+        # the generation(s) holding the gang's existing placements before
+        # falling back to any node.
+        placed_gens = {gen_of[node] for node, _ in gang.placements.values()
+                       if node in gen_of}
+        candidate_sets = sorted(
+            (nodes for gen, nodes in by_gen.items() if gen in placed_gens),
+            key=len, reverse=True)
         candidate_sets.append(list(usage_by_node.keys()))
+    else:
+        candidate_sets = sorted(by_gen.values(), key=len, reverse=True)
+        if len(candidate_sets) > 1:
+            candidate_sets.append(list(usage_by_node.keys()))
 
     for candidates in candidate_sets:
         # Work on a deep-ish copy of the snapshot per attempt: a failed

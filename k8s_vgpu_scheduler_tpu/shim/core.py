@@ -126,6 +126,11 @@ class Shim:
         # the duty-cycle accounting needs.
         self._sync_every = max(1, int(os.environ.get("VTPU_SYNC_EVERY", "16")))
         self._dispatch_n = 0
+        # Per-slot count of async dispatches since that slot's last synced
+        # sample: a synced block_until_ready drains the whole device queue,
+        # so the measured time covers the backlog too and must be divided by
+        # how many dispatches it covered.
+        self._since_sync: Dict[int, int] = {}
         self._slot_cache: Dict[int, int] = {}
 
     # -- introspection ---------------------------------------------------------
@@ -167,13 +172,18 @@ class Shim:
                 return [0]
             slots = []
             for d in devs:
-                s = self._slot_cache.get(id(d))
+                # Keyed by the stable global device id, not id(d): CPython
+                # id() reuse after GC could mis-charge a slot.
+                key = getattr(d, "id", None)
+                if key is None:
+                    key = id(d)
+                s = self._slot_cache.get(key)
                 if s is None:
                     try:
                         s = jax.local_devices().index(d)
                     except (ValueError, RuntimeError):
                         s = int(getattr(d, "local_hardware_id", 0) or 0)
-                    self._slot_cache[id(d)] = s
+                    self._slot_cache[key] = s
                 slots.append(s)
             return slots or [0]
         except Exception:
@@ -188,8 +198,13 @@ class Shim:
         Cost model: wall time around an async dispatch under-charges (the
         call returns before the device finishes), so every Nth dispatch
         blocks on the result and that synced sample becomes the estimate;
-        unsynced samples only ever raise it.  Error bound: between syncs the
-        estimate lags workload changes by at most N dispatches."""
+        unsynced samples only ever raise it.  A synced block_until_ready
+        also drains every *earlier* async dispatch still queued on the
+        device, so the synced sample is normalized by the number of
+        dispatches this slot saw since its last sync — otherwise the charge
+        inflates ~N× and the limiter over-throttles below the grant.  Error
+        bound: between syncs the estimate lags workload changes by at most
+        N dispatches."""
         slots = holder.slots or [0]
         for s in slots:
             self.native.lib.vtpu_rate_acquire(
@@ -211,10 +226,19 @@ class Shim:
             slots = holder.slots = self._slots_of(out)
         for s in slots:
             if track_devices:
-                # Async dispatch: unsynced wall time is a lower bound, so it
-                # may only raise the last synced estimate, never lower it.
-                prev = self._last_cost_us.get(s, 0)
-                est = busy if (synced or not prev) else max(prev, busy)
+                covered = self._since_sync.get(s, 0) + 1
+                if synced:
+                    # The sample covers this dispatch plus the drained
+                    # backlog; average to a per-dispatch device time.
+                    est = busy // covered
+                    self._since_sync[s] = 0
+                else:
+                    # Async dispatch: unsynced wall time is a lower bound,
+                    # so it may only raise the last synced estimate, never
+                    # lower it.
+                    prev = self._last_cost_us.get(s, 0)
+                    est = busy if not prev else max(prev, busy)
+                    self._since_sync[s] = covered
             else:
                 # Synchronous callable: wall time IS the cost; last sample
                 # wins so one slow cold-start can't ratchet the charge up

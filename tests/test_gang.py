@@ -125,6 +125,60 @@ class TestGangAdmission:
         assert s.filter(pods[0], NODES).node == survivor_node
         assert s.pods.get("ru9") is not None
 
+    def test_stale_event_for_dropped_uid_rejected(self, env):
+        # ADVICE r2: a replayed informer add-event for a deleted member's
+        # uid must not re-join the gang (pre-admission it could trigger a
+        # false admission; post-admission it resurrects a dead pod's grant).
+        kube, s = env
+        pods = [gang_pod(f"d{i}", f"du{i}", group="jobd", total=2)
+                for i in range(2)]
+        for p in pods:
+            kube.create_pod(p)
+        s.filter(pods[0], NODES)
+        r1 = s.filter(pods[1], NODES)
+        assert r1.node in NODES
+
+        kube.delete_pod("default", "d1")
+        assert s.pods.get("du1") is None
+        # Replay: the SAME uid comes back (stale informer add, not a
+        # controller recreation — those get fresh uids).
+        stale = gang_pod("d1", "du1", group="jobd", total=2)
+        rs = s.filter(stale, NODES)
+        assert rs.node is None and "stale" in rs.error
+        assert s.pods.get("du1") is None
+
+    def test_replacement_keeps_generation_homogeneity(self, env):
+        # ADVICE r2: a replacement member joining an admitted gang must stay
+        # on the generation of its already-placed peers even when another
+        # generation's bucket is larger.
+        kube, s = env
+        from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+
+        for n in ("node-p1", "node-p2", "node-p3"):
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n)
+            s.nodes.list_nodes()[n].topology = TopologyDesc(
+                generation="v5p", mesh=(4, 1))
+        all_nodes = NODES + ["node-p1", "node-p2", "node-p3"]
+
+        # Pin the gang onto the v5e bucket by offering only v5e nodes at
+        # admission time.
+        pods = [gang_pod(f"h{i}", f"hu{i}", group="jobh", total=2)
+                for i in range(2)]
+        for p in pods:
+            kube.create_pod(p)
+        s.filter(pods[0], NODES)
+        r1 = s.filter(pods[1], NODES)
+        assert r1.node in NODES
+
+        # Peer hu1 dies; the replacement is offered EVERY node, and the v5p
+        # bucket is now the bigger one — homogeneity must still win.
+        kube.delete_pod("default", "h1")
+        repl = gang_pod("h1-new", "hu9", group="jobh", total=2)
+        kube.create_pod(repl)
+        rr = s.filter(repl, all_nodes)
+        assert rr.node in NODES, f"replacement left the gang's generation: {rr.node}"
+
     def test_infeasible_gang_admits_nobody(self, env):
         kube, s = env
         # 4 members x 4 full-memory chips > 3 nodes x 4 chips.
