@@ -68,12 +68,18 @@ def log(msg: str) -> None:
 
 
 def diag(msg: str) -> None:
-    """Append full diagnostics (probe/worker stderr) to bench_diag.txt."""
+    """Append full diagnostics (probe/worker stderr) to bench_diag.txt.
+    Truncated once per harness run so entries never mix across rounds."""
+    global _DIAG_FRESH
     try:
-        with open(DIAG_PATH, "a") as f:
+        with open(DIAG_PATH, "w" if _DIAG_FRESH else "a") as f:
             f.write(f"[{time.monotonic() - _START:6.1f}s] {msg}\n")
+        _DIAG_FRESH = False
     except OSError:
         pass
+
+
+_DIAG_FRESH = True
 
 
 def build_native() -> None:

@@ -114,6 +114,12 @@ def attach_enforcement(resp, cfg: Config, cache_key: str) -> None:
             read_only=False,
         )
     )
+    # Only mount shim artifacts that exist on the host (a mount with a
+    # missing source fails EVERY container create) — but never silently: a
+    # node with a broken shim install loses isolation, so the skip is loud
+    # and VTPU_STRICT_ENFORCEMENT=1 fails the allocation instead (the caller
+    # finalizes bind-phase=failed and the pod reschedules elsewhere).
+    strict = os.environ.get("VTPU_STRICT_ENFORCEMENT", "") in ("1", "true")
     if cfg.shim_host_dir and os.path.isdir(cfg.shim_host_dir):
         resp.mounts.append(
             pb.Mount(
@@ -131,6 +137,23 @@ def attach_enforcement(resp, cfg: Config, cache_key: str) -> None:
                     read_only=True,
                 )
             )
+        else:
+            if strict:
+                raise FileNotFoundError(
+                    f"{preload} missing and VTPU_STRICT_ENFORCEMENT set; "
+                    "refusing to allocate an unenforced container")
+            log.warning(
+                "shim ld.so.preload missing at %s — container will run "
+                "WITHOUT HBM/core enforcement", preload)
+    elif cfg.shim_host_dir:
+        if strict:
+            raise FileNotFoundError(
+                f"shim host dir {cfg.shim_host_dir} missing and "
+                "VTPU_STRICT_ENFORCEMENT set; refusing to allocate an "
+                "unenforced container")
+        log.warning(
+            "shim host dir %s missing — container will run WITHOUT "
+            "HBM/core enforcement", cfg.shim_host_dir)
 
 
 def attach_device_node(resp, chip_index: int) -> None:

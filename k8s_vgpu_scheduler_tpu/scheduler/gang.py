@@ -111,12 +111,14 @@ class GangManager:
         with self._lock:
             key = f"{namespace}/{group}"
             g = self._groups.get(key)
-            if g is not None and member.uid not in g.members \
-                    and member.uid in self._dropped:
+            if member.uid in self._dropped and \
+                    (g is None or member.uid not in g.members):
                 # A deleted pod's uid never returns (recreations get fresh
                 # uids): this is a replayed informer event.  Pre-admission it
-                # would let a dead member trigger a false atomic admission;
-                # post-admission it would resurrect a dead pod's grant.
+                # would let a dead member trigger a false atomic admission —
+                # including when the drop emptied and popped the group
+                # (g is None) — post-admission it would resurrect a dead
+                # pod's grant.
                 raise GangConflictError(
                     f"gang {key}: stale event for dropped pod "
                     f"{member.name} ({member.uid}) rejected")

@@ -96,6 +96,15 @@ class Native:
         self.lib.vtpu_shutdown()
 
 
+def _tree_leaves(out) -> List[Any]:
+    try:
+        import jax
+
+        return jax.tree_util.tree_leaves(out)
+    except Exception:
+        return []
+
+
 class _SlotHolder:
     """Sticky per-callable record of the device slots it last ran on: the
     slots a dispatch must charge are only known from its OUTPUT, so each
@@ -126,11 +135,13 @@ class Shim:
         # the duty-cycle accounting needs.
         self._sync_every = max(1, int(os.environ.get("VTPU_SYNC_EVERY", "16")))
         self._dispatch_n = 0
-        # Per-slot count of async dispatches since that slot's last synced
-        # sample: a synced block_until_ready drains the whole device queue,
-        # so the measured time covers the backlog too and must be divided by
-        # how many dispatches it covered.
-        self._since_sync: Dict[int, int] = {}
+        # Weakref to the most recent gated dispatch's output, held only so a
+        # synced sample can DRAIN the device queue before timing (see
+        # _gated_call).  A weakref so the shim never pins the caller's HBM:
+        # if the caller already dropped the output, the drain is skipped
+        # (that sample may be slightly inflated — harmless, the next sync
+        # corrects it).
+        self._prev_out: Any = None
         self._slot_cache: Dict[int, int] = {}
 
     # -- introspection ---------------------------------------------------------
@@ -196,24 +207,41 @@ class Shim:
         then feed estimates back.
 
         Cost model: wall time around an async dispatch under-charges (the
-        call returns before the device finishes), so every Nth dispatch
-        blocks on the result and that synced sample becomes the estimate;
-        unsynced samples only ever raise it.  A synced block_until_ready
-        also drains every *earlier* async dispatch still queued on the
-        device, so the synced sample is normalized by the number of
-        dispatches this slot saw since its last sync — otherwise the charge
-        inflates ~N× and the limiter over-throttles below the grant.  Error
-        bound: between syncs the estimate lags workload changes by at most
-        N dispatches."""
+        call returns before the device finishes), so every Nth dispatch is
+        timed synced and that sample becomes the estimate; unsynced samples
+        only ever raise it.  The synced sample must cover exactly ONE
+        dispatch: blocking on the result alone would also drain every
+        earlier async dispatch still queued on the device and inflate the
+        charge ~N× (the limiter would then over-throttle below the grant,
+        ADVICE r2), so the queue is drained — block on the *previous*
+        dispatch's output — before the timed dispatch starts.  Error bound:
+        between syncs the estimate lags workload changes by at most N
+        dispatches."""
         slots = holder.slots or [0]
         for s in slots:
             self.native.lib.vtpu_rate_acquire(
                 s, min(self._last_cost_us.get(s, 0), self.MAX_COST_US))
+        self._dispatch_n += 1
+        sync_turn = track_devices and \
+            self._dispatch_n % self._sync_every == 0
+        if sync_turn and self._prev_out is not None:
+            prev = self._prev_out()
+            self._prev_out = None
+            if prev is not None:
+                try:
+                    import jax
+
+                    # Drain the queue so the timed window below covers only
+                    # this dispatch.  A donated/deleted previous output is
+                    # fine — the queue was drained by whatever consumed it.
+                    jax.block_until_ready(prev)
+                except Exception:
+                    pass
+            del prev
         t0 = self._clock()
         out = fn(*args, **kwargs)
-        self._dispatch_n += 1
         synced = False
-        if track_devices and self._dispatch_n % self._sync_every == 0:
+        if sync_turn:
             try:
                 import jax
 
@@ -224,21 +252,26 @@ class Shim:
         busy = int((self._clock() - t0) * 1e6)
         if track_devices:
             slots = holder.slots = self._slots_of(out)
+            # Weakly held so the next sync can drain up to here without
+            # pinning the caller's buffers.
+            try:
+                import weakref
+
+                leaves = [x for x in _tree_leaves(out)
+                          if hasattr(x, "block_until_ready")]
+                self._prev_out = weakref.ref(leaves[0]) if leaves else None
+            except TypeError:
+                self._prev_out = None
         for s in slots:
             if track_devices:
-                covered = self._since_sync.get(s, 0) + 1
                 if synced:
-                    # The sample covers this dispatch plus the drained
-                    # backlog; average to a per-dispatch device time.
-                    est = busy // covered
-                    self._since_sync[s] = 0
+                    est = busy
                 else:
                     # Async dispatch: unsynced wall time is a lower bound,
                     # so it may only raise the last synced estimate, never
                     # lower it.
                     prev = self._last_cost_us.get(s, 0)
                     est = busy if not prev else max(prev, busy)
-                    self._since_sync[s] = covered
             else:
                 # Synchronous callable: wall time IS the cost; last sample
                 # wins so one slow cold-start can't ratchet the charge up
@@ -485,6 +518,14 @@ def install(region_path: Optional[str] = None, jax_hooks: bool = True,
     oversub = os.environ.get("TPU_OVERSUBSCRIBE", "") in ("true", "1")
     if ballast is None:
         ballast = os.environ.get("VTPU_BALLAST", "1") not in ("0", "false")
+    if os.environ.get("VTPU_PJRT_INTERPOSER", "") in ("true", "1"):
+        # Allocation-level enforcement AND dispatch gating are active at the
+        # PJRT boundary: a ballast would pass through the interposer's
+        # accounting and double-charge the region, and the Python dispatch
+        # gate would stack a second token bucket on top of the interposer's
+        # (two sequential waits with conflicting cost feedback).
+        ballast = False
+        jax_hooks = False
     if oversub:
         # The grant may legitimately exceed physical HBM (virtual device
         # memory, reference CUDA_OVERSUBSCRIBE): a ballast sized from

@@ -31,6 +31,7 @@ uint64_t vtpu_get_limit(int dev);
 uint64_t vtpu_get_sm_limit(int dev);
 uint64_t vtpu_get_used(int dev);
 int vtpu_try_alloc(int dev, uint64_t bytes); /* 0 | -ENOMEM | -EINVAL */
+void vtpu_charge(int dev, uint64_t bytes);   /* unconditional add (post-hoc) */
 void vtpu_set_used(int dev, uint64_t bytes); /* absolute self-report */
 void vtpu_free(int dev, uint64_t bytes);
 void vtpu_memory_info(int dev, uint64_t* total, uint64_t* used);

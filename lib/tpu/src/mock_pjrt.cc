@@ -1,0 +1,182 @@
+// Mock PJRT plugin — the N5 "fake native backend" pattern applied to the
+// PJRT boundary (the reference tests cgo bindings against a fake
+// libcndev.so, mock/cndev.c; SURVEY.md §4).  Implements just enough of the
+// PJRT C API for the interposer's hooks and the test driver: two fake
+// devices, malloc-backed buffers, an Execute that burns MOCK_EXEC_US of
+// wall time, and a MemoryStats that (deliberately) fails UNIMPLEMENTED so
+// the interposer's stat-fabrication path is exercised.
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct MockError {
+  PJRT_Error_Code code;
+  char msg[128];
+};
+
+struct MockBuffer {
+  uint64_t size;
+};
+
+int g_devices[2];  // identity only; addresses serve as PJRT_Device*
+int g_client;
+int g_executable;
+
+PJRT_Error* err(PJRT_Error_Code code, const char* msg) {
+  MockError* e = new MockError;
+  e->code = code;
+  snprintf(e->msg, sizeof(e->msg), "%s", msg);
+  return reinterpret_cast<PJRT_Error*>(e);
+}
+
+void ErrorDestroy(PJRT_Error_Destroy_Args* a) {
+  delete reinterpret_cast<MockError*>(a->error);
+}
+
+void ErrorMessage(PJRT_Error_Message_Args* a) {
+  MockError* e = reinterpret_cast<MockError*>(const_cast<PJRT_Error*>(a->error));
+  a->message = e->msg;
+  a->message_size = strlen(e->msg);
+}
+
+PJRT_Error* ErrorGetCode(PJRT_Error_GetCode_Args* a) {
+  a->code = reinterpret_cast<const MockError*>(a->error)->code;
+  return nullptr;
+}
+
+PJRT_Error* ClientCreate(PJRT_Client_Create_Args* a) {
+  a->client = reinterpret_cast<PJRT_Client*>(&g_client);
+  return nullptr;
+}
+
+PJRT_Error* ClientAddressableDevices(
+    PJRT_Client_AddressableDevices_Args* a) {
+  static PJRT_Device* devs[2] = {
+      reinterpret_cast<PJRT_Device*>(&g_devices[0]),
+      reinterpret_cast<PJRT_Device*>(&g_devices[1]),
+  };
+  a->addressable_devices = devs;
+  a->num_addressable_devices = 2;
+  return nullptr;
+}
+
+uint64_t elem_bytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_F32:
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+      return 4;
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_S64:
+      return 8;
+    case PJRT_Buffer_Type_BF16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_S16:
+      return 2;
+    default:
+      return 1;
+  }
+}
+
+PJRT_Error* BufferFromHostBuffer(PJRT_Client_BufferFromHostBuffer_Args* a) {
+  uint64_t n = 1;
+  for (size_t i = 0; i < a->num_dims; ++i) n *= (uint64_t)a->dims[i];
+  MockBuffer* b = new MockBuffer{n * elem_bytes(a->type)};
+  a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  a->done_with_host_buffer = nullptr;
+  return nullptr;
+}
+
+PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* a) {
+  delete reinterpret_cast<MockBuffer*>(a->buffer);
+  return nullptr;
+}
+
+PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* a) {
+  a->on_device_size_in_bytes =
+      reinterpret_cast<MockBuffer*>(a->buffer)->size;
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableAddressableDevices(
+    PJRT_LoadedExecutable_AddressableDevices_Args* a) {
+  static PJRT_Device* devs[1] = {
+      reinterpret_cast<PJRT_Device*>(&g_devices[0])};
+  a->addressable_devices = devs;
+  a->num_addressable_devices = 1;
+  return nullptr;
+}
+
+PJRT_Error* LoadedExecutableGetExecutable(
+    PJRT_LoadedExecutable_GetExecutable_Args* a) {
+  a->executable = reinterpret_cast<PJRT_Executable*>(&g_executable);
+  return nullptr;
+}
+
+PJRT_Error* ExecutableNumOutputs(PJRT_Executable_NumOutputs_Args* a) {
+  a->num_outputs = 1;
+  return nullptr;
+}
+
+PJRT_Error* ExecutableDestroy(PJRT_Executable_Destroy_Args*) {
+  return nullptr;  // g_executable is static
+}
+
+PJRT_Error* LoadedExecutableExecute(PJRT_LoadedExecutable_Execute_Args* a) {
+  const char* us = getenv("MOCK_EXEC_US");
+  long burn = us ? strtol(us, nullptr, 10) : 1000;
+  if (burn > 0) usleep((useconds_t)burn);
+  // Fill outputs when the caller provided lists (one output per device of
+  // MOCK_OUT_BYTES bytes, default 1 MiB).
+  if (a->output_lists) {
+    const char* ob = getenv("MOCK_OUT_BYTES");
+    uint64_t sz = ob ? strtoull(ob, nullptr, 10) : (1 << 20);
+    for (size_t d = 0; d < a->num_devices; ++d) {
+      if (!a->output_lists[d]) continue;
+      a->output_lists[d][0] =
+          reinterpret_cast<PJRT_Buffer*>(new MockBuffer{sz});
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* DeviceMemoryStats(PJRT_Device_MemoryStats_Args*) {
+  return err(PJRT_Error_Code_UNIMPLEMENTED,
+             "mock: memory stats not implemented");
+}
+
+PJRT_Api g_mock_api;
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi(void) {
+  memset(&g_mock_api, 0, sizeof(g_mock_api));
+  g_mock_api.struct_size = sizeof(PJRT_Api);
+  g_mock_api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  g_mock_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  g_mock_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  g_mock_api.PJRT_Error_Destroy = ErrorDestroy;
+  g_mock_api.PJRT_Error_Message = ErrorMessage;
+  g_mock_api.PJRT_Error_GetCode = ErrorGetCode;
+  g_mock_api.PJRT_Client_Create = ClientCreate;
+  g_mock_api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
+  g_mock_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
+  g_mock_api.PJRT_Buffer_Destroy = BufferDestroy;
+  g_mock_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
+  g_mock_api.PJRT_LoadedExecutable_AddressableDevices =
+      LoadedExecutableAddressableDevices;
+  g_mock_api.PJRT_LoadedExecutable_GetExecutable =
+      LoadedExecutableGetExecutable;
+  g_mock_api.PJRT_Executable_NumOutputs = ExecutableNumOutputs;
+  g_mock_api.PJRT_Executable_Destroy = ExecutableDestroy;
+  g_mock_api.PJRT_LoadedExecutable_Execute = LoadedExecutableExecute;
+  g_mock_api.PJRT_Device_MemoryStats = DeviceMemoryStats;
+  return &g_mock_api;
+}

@@ -1,0 +1,462 @@
+// PJRT C-API interposer — framework-agnostic in-container enforcement.
+//
+// The reference's libvgpu.so interposes the CUDA Driver API itself (446
+// dlsym hooks via /etc/ld.so.preload, SURVEY.md N1) so EVERY process —
+// torch, TF, mxnet — is capped and throttled.  On TPU the equivalent choke
+// point is the PJRT C API: every framework (JAX, PyTorch/XLA, TF) drives the
+// chip through one `PJRT_Api` function table obtained from the platform
+// plugin's `GetPjrtApi()`.  This library exports its own `GetPjrtApi()`
+// which loads the REAL plugin ($VTPU_REAL_PJRT_PLUGIN), copies its table,
+// and replaces the entries where enforcement lives:
+//
+//   PJRT_Client_BufferFromHostBuffer  charge host->device allocations
+//       against the shared accounting region (vtpu_try_alloc) and REFUSE
+//       with RESOURCE_EXHAUSTED when the HBM grant would be exceeded — the
+//       cuMemAlloc/oom_check analog.  Works even where the backend itself
+//       virtualizes memory (e.g. tunneled chips) because the refusal
+//       happens here, not in XLA's allocator.
+//   PJRT_LoadedExecutable_Execute     gate dispatch through the native
+//       duty-cycle limiter (vtpu_rate_acquire, the cuLaunchKernel analog)
+//       and charge output buffers post-execution (vtpu_charge).
+//   PJRT_Buffer_Destroy               release the recorded charge.
+//   PJRT_Device_MemoryStats           virtualize: bytes_limit reports the
+//       grant and bytes_in_use the accounted usage (the reference
+//       virtualizes nvmlDeviceGetMemoryInfo so nvidia-smi shows the vGPU,
+//       README.md:133).  Also *fabricates* stats when the real plugin has
+//       none, which gives JAX's device.memory_stats() a signal on backends
+//       that expose nothing.
+//
+// Known v1 granularity limits (documented, not silent): buffers created via
+// CopyToDevice/CopyToMemory/CreateViewOfDeviceBuffer/AsyncHostToDevice are
+// accounted only at destroy time if ever seen; executable output charges
+// are post-hoc (can't refuse what already exists — the watchdog handles
+// over-limit).  Deliberately NOT hooked: PJRT_Buffer_Delete (jax frees via
+// Destroy; hooking both would double-free the account).
+//
+// ABI: the PJRT_Api struct is append-only (pjrt_c_api.h:2869), so replacing
+// early members is stable across plugin versions; the copied table is
+// truncated to min(real->struct_size, our header's) so we never advertise
+// entries the real plugin lacks.
+
+#include <dlfcn.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include <algorithm>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+#include "vtpu/vtpu.h"
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Tagged error objects.  PJRT_Error is opaque to callers; they hand it back
+// to PJRT_Error_Destroy/Message/GetCode, which we also interpose — so our
+// own errors just need a magic prefix to be recognized there, and anything
+// else forwards to the real plugin.
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kErrMagic = 0x56545055;  // "VTPU"
+
+struct VtpuError {
+  uint32_t magic;
+  PJRT_Error_Code code;
+  char msg[256];
+};
+
+PJRT_Error* make_error(PJRT_Error_Code code, const char* fmt, uint64_t a,
+                       uint64_t b) {
+  VtpuError* e = new VtpuError;
+  e->magic = kErrMagic;
+  e->code = code;
+  snprintf(e->msg, sizeof(e->msg), fmt, (unsigned long long)a,
+           (unsigned long long)b);
+  return reinterpret_cast<PJRT_Error*>(e);
+}
+
+bool is_ours(const PJRT_Error* err) {
+  return err && reinterpret_cast<const VtpuError*>(err)->magic == kErrMagic;
+}
+
+// ---------------------------------------------------------------------------
+// Global state
+// ---------------------------------------------------------------------------
+
+const PJRT_Api* g_real = nullptr;
+PJRT_Api g_api;
+bool g_enforce = false;  // region attached?
+
+std::mutex g_mu;
+// Buffer -> (bytes charged, region slot).
+std::unordered_map<PJRT_Buffer*, std::pair<uint64_t, int>> g_buffers;
+// Device -> region slot (position in the client's addressable-device list;
+// slot i of the region is the i-th visible chip — same contract as the
+// Python shim's _slots_of).
+std::unordered_map<PJRT_Device*, int> g_dev_slot;
+// LoadedExecutable -> cached output count.
+std::unordered_map<PJRT_LoadedExecutable*, size_t> g_num_outputs;
+
+uint64_t now_us() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000ull + (uint64_t)ts.tv_nsec / 1000ull;
+}
+
+int slot_of(PJRT_Device* dev) {
+  if (!dev) return 0;
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_dev_slot.find(dev);
+  return it == g_dev_slot.end() ? 0 : it->second;
+}
+
+void map_client_devices(PJRT_Client* client) {
+  PJRT_Client_AddressableDevices_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  a.client = client;
+  PJRT_Error* err = g_real->PJRT_Client_AddressableDevices(&a);
+  if (err) {  // enumeration failure -> everything charges slot 0
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_real->PJRT_Error_Destroy(&d);
+    return;
+  }
+  std::lock_guard<std::mutex> g(g_mu);
+  for (size_t i = 0; i < a.num_addressable_devices; ++i)
+    g_dev_slot[a.addressable_devices[i]] = (int)i;
+}
+
+uint64_t element_bytes_x8(PJRT_Buffer_Type t) {  // bits, to handle sub-byte
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+    case PJRT_Buffer_Type_F8E5M2:
+    case PJRT_Buffer_Type_F8E4M3FN:
+    case PJRT_Buffer_Type_F8E4M3B11FNUZ:
+    case PJRT_Buffer_Type_F8E5M2FNUZ:
+    case PJRT_Buffer_Type_F8E4M3FNUZ:
+      return 8;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 16;
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+    case PJRT_Buffer_Type_F32:
+      return 32;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 64;
+    case PJRT_Buffer_Type_C128:
+      return 128;
+    case PJRT_Buffer_Type_S4:
+    case PJRT_Buffer_Type_U4:
+      return 4;
+    default:
+      return 8;  // unknown/token: charge minimally
+  }
+}
+
+uint64_t logical_bytes(PJRT_Buffer_Type t, const int64_t* dims,
+                       size_t num_dims) {
+  uint64_t n = 1;
+  for (size_t i = 0; i < num_dims; ++i) n *= (uint64_t)dims[i];
+  return (n * element_bytes_x8(t) + 7) / 8;
+}
+
+uint64_t real_buffer_size(PJRT_Buffer* buf, uint64_t fallback) {
+  PJRT_Buffer_OnDeviceSizeInBytes_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  a.buffer = buf;
+  PJRT_Error* err = g_real->PJRT_Buffer_OnDeviceSizeInBytes(&a);
+  if (err) {
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_real->PJRT_Error_Destroy(&d);
+    return fallback;
+  }
+  return a.on_device_size_in_bytes;
+}
+
+void record_buffer(PJRT_Buffer* buf, uint64_t bytes, int slot) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_buffers[buf] = {bytes, slot};
+}
+
+// ---------------------------------------------------------------------------
+// Interposed entry points
+// ---------------------------------------------------------------------------
+
+void Error_Destroy(PJRT_Error_Destroy_Args* args) {
+  if (is_ours(args->error)) {
+    delete reinterpret_cast<VtpuError*>(args->error);
+    return;
+  }
+  g_real->PJRT_Error_Destroy(args);
+}
+
+void Error_Message(PJRT_Error_Message_Args* args) {
+  if (is_ours(args->error)) {
+    const VtpuError* e = reinterpret_cast<const VtpuError*>(args->error);
+    args->message = e->msg;
+    args->message_size = strlen(e->msg);
+    return;
+  }
+  g_real->PJRT_Error_Message(args);
+}
+
+PJRT_Error* Error_GetCode(PJRT_Error_GetCode_Args* args) {
+  if (is_ours(args->error)) {
+    args->code = reinterpret_cast<const VtpuError*>(args->error)->code;
+    return nullptr;
+  }
+  return g_real->PJRT_Error_GetCode(args);
+}
+
+PJRT_Error* Client_Create(PJRT_Client_Create_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Client_Create(args);
+  if (!err && args->client) map_client_devices(args->client);
+  return err;
+}
+
+PJRT_Error* Client_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  if (!g_enforce) return g_real->PJRT_Client_BufferFromHostBuffer(args);
+  // Device list may not be mapped yet (client created by a path we don't
+  // hook) — map lazily.
+  if (args->client) {
+    std::unique_lock<std::mutex> g(g_mu);
+    bool empty = g_dev_slot.empty();
+    g.unlock();
+    if (empty) map_client_devices(args->client);
+  }
+  int slot = slot_of(args->device);
+  uint64_t bytes = logical_bytes(args->type, args->dims, args->num_dims);
+  int rc = vtpu_try_alloc(slot, bytes);
+  if (rc == -ENOMEM) {
+    uint64_t total = 0, used = 0;
+    vtpu_memory_info(slot, &total, &used);
+    return make_error(
+        PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "vtpu: HBM grant exceeded on device slot: alloc would pass the "
+        "%llu MiB cap (container already accounts %llu MiB)",
+        total / (1024 * 1024), used / (1024 * 1024));
+  }
+  PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
+  if (err) {
+    if (rc == 0) vtpu_free(slot, bytes);
+    return err;
+  }
+  if (rc == 0) record_buffer(args->buffer, bytes, slot);
+  return nullptr;
+}
+
+PJRT_Error* Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
+  if (g_enforce) {
+    std::unique_lock<std::mutex> g(g_mu);
+    auto it = g_buffers.find(args->buffer);
+    if (it != g_buffers.end()) {
+      uint64_t bytes = it->second.first;
+      int slot = it->second.second;
+      g_buffers.erase(it);
+      g.unlock();
+      vtpu_free(slot, bytes);
+    }
+  }
+  return g_real->PJRT_Buffer_Destroy(args);
+}
+
+size_t num_outputs_of(PJRT_LoadedExecutable* lx) {
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_num_outputs.find(lx);
+    if (it != g_num_outputs.end()) return it->second;
+  }
+  size_t n = 0;
+  PJRT_LoadedExecutable_GetExecutable_Args ga;
+  memset(&ga, 0, sizeof(ga));
+  ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ga.loaded_executable = lx;
+  PJRT_Error* err = g_real->PJRT_LoadedExecutable_GetExecutable(&ga);
+  if (!err && ga.executable) {
+    PJRT_Executable_NumOutputs_Args na;
+    memset(&na, 0, sizeof(na));
+    na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    na.executable = ga.executable;
+    PJRT_Error* err2 = g_real->PJRT_Executable_NumOutputs(&na);
+    if (!err2) n = na.num_outputs;
+    else {
+      PJRT_Error_Destroy_Args d;
+      memset(&d, 0, sizeof(d));
+      d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      d.error = err2;
+      g_real->PJRT_Error_Destroy(&d);
+    }
+    PJRT_Executable_Destroy_Args xd;
+    memset(&xd, 0, sizeof(xd));
+    xd.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+    xd.executable = ga.executable;
+    g_real->PJRT_Executable_Destroy(&xd);
+  } else if (err) {
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_real->PJRT_Error_Destroy(&d);
+  }
+  std::lock_guard<std::mutex> g(g_mu);
+  g_num_outputs[lx] = n;
+  return n;
+}
+
+void exec_slots(PJRT_LoadedExecutable_Execute_Args* args,
+                std::vector<int>* out) {
+  if (args->execute_device) {
+    out->push_back(slot_of(args->execute_device));
+    return;
+  }
+  PJRT_LoadedExecutable_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
+  da.executable = args->executable;
+  PJRT_Error* err = g_real->PJRT_LoadedExecutable_AddressableDevices(&da);
+  if (err) {
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_real->PJRT_Error_Destroy(&d);
+    out->push_back(0);
+    return;
+  }
+  for (size_t i = 0; i < da.num_addressable_devices && i < args->num_devices;
+       ++i)
+    out->push_back(slot_of(da.addressable_devices[i]));
+  if (out->empty()) out->push_back(0);
+}
+
+PJRT_Error* LoadedExecutable_Execute(
+    PJRT_LoadedExecutable_Execute_Args* args) {
+  if (!g_enforce) return g_real->PJRT_LoadedExecutable_Execute(args);
+  std::vector<int> slots;
+  exec_slots(args, &slots);
+  for (int s : slots) vtpu_rate_acquire(s, 0);  // 0: limiter uses feedback
+  uint64_t t0 = now_us();
+  PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
+  uint64_t wall = now_us() - t0;
+  for (int s : slots) vtpu_rate_feedback(s, wall);
+  if (err) return err;
+  // Post-hoc output accounting (see file comment).
+  if (args->output_lists) {
+    size_t n_out = num_outputs_of(args->executable);
+    for (size_t d = 0; d < args->num_devices; ++d) {
+      int slot = d < slots.size() ? slots[d] : 0;
+      PJRT_Buffer** list = args->output_lists[d];
+      if (!list) continue;
+      for (size_t o = 0; o < n_out; ++o) {
+        PJRT_Buffer* buf = list[o];
+        if (!buf) continue;
+        uint64_t bytes = real_buffer_size(buf, 0);
+        if (!bytes) continue;
+        vtpu_charge(slot, bytes);
+        record_buffer(buf, bytes, slot);
+      }
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* Device_MemoryStats(PJRT_Device_MemoryStats_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Device_MemoryStats(args);
+  if (!g_enforce) return err;
+  int slot = slot_of(args->device);
+  uint64_t limit = 0, used = 0;
+  vtpu_memory_info(slot, &limit, &used);
+  if (err) {
+    // Real plugin has no stats (tunneled/virtual backends): fabricate from
+    // the accounting region so in-container introspection works at all.
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_real->PJRT_Error_Destroy(&d);
+    memset((char*)args + offsetof(PJRT_Device_MemoryStats_Args, bytes_in_use),
+           0,
+           args->struct_size -
+               offsetof(PJRT_Device_MemoryStats_Args, bytes_in_use));
+    args->bytes_in_use = (int64_t)used;
+  }
+  if (limit > 0) {
+    // Virtualized view: "total" is the grant, not the physical chip.
+    args->bytes_limit = (int64_t)limit;
+    args->bytes_limit_is_set = true;
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi(void) {
+  static std::once_flag once;
+  static bool ok = false;
+  std::call_once(once, [] {
+    const char* real_path = getenv("VTPU_REAL_PJRT_PLUGIN");
+    if (!real_path || !*real_path) {
+      fprintf(stderr,
+              "vtpu-interposer: VTPU_REAL_PJRT_PLUGIN not set; cannot load "
+              "real plugin\n");
+      return;
+    }
+    void* h = dlopen(real_path, RTLD_NOW | RTLD_GLOBAL);
+    if (!h) {
+      fprintf(stderr, "vtpu-interposer: dlopen(%s): %s\n", real_path,
+              dlerror());
+      return;
+    }
+    auto get = (const PJRT_Api* (*)(void))dlsym(h, "GetPjrtApi");
+    if (!get) {
+      fprintf(stderr, "vtpu-interposer: %s has no GetPjrtApi\n", real_path);
+      return;
+    }
+    g_real = get();
+    if (!g_real) return;
+
+    // Copy the real table, truncated to what both sides know about.
+    memset(&g_api, 0, sizeof(g_api));
+    size_t n = std::min(g_real->struct_size, sizeof(PJRT_Api));
+    memcpy(&g_api, g_real, n);
+    g_api.struct_size = n;
+
+    g_api.PJRT_Error_Destroy = Error_Destroy;
+    g_api.PJRT_Error_Message = Error_Message;
+    g_api.PJRT_Error_GetCode = Error_GetCode;
+    g_api.PJRT_Client_Create = Client_Create;
+    g_api.PJRT_Client_BufferFromHostBuffer = Client_BufferFromHostBuffer;
+    g_api.PJRT_Buffer_Destroy = Buffer_Destroy;
+    g_api.PJRT_LoadedExecutable_Execute = LoadedExecutable_Execute;
+    g_api.PJRT_Device_MemoryStats = Device_MemoryStats;
+
+    // Enforcement only inside vtpu-managed containers (same gate as
+    // preload.cc); otherwise pure passthrough of the patched table.
+    if (!getenv("VTPU_DISABLE") && getenv("TPU_DEVICE_MEMORY_SHARED_CACHE"))
+      g_enforce = vtpu_init() == 0;
+    ok = true;
+  });
+  return ok ? &g_api : nullptr;
+}

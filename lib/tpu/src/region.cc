@@ -254,6 +254,19 @@ int vtpu_try_alloc(int dev, uint64_t bytes) {
   return rc;
 }
 
+/* Unconditional add — for charging allocations that already exist (e.g. an
+ * executable's output buffers observed post-execution by the PJRT
+ * interposer).  Refusal is not possible for them; the OOM watchdog acts on
+ * the resulting over-limit state instead. */
+void vtpu_charge(int dev, uint64_t bytes) {
+  if (!g_region || g_slot < 0) return;
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+  region_lock(g_region);
+  g_region->procs[g_slot].used[dev] += bytes;
+  g_region->generation++;
+  region_unlock(g_region);
+}
+
 /* Absolute self-report for poll-based accounting (the Python shim samples
  * the XLA client's bytes_in_use and publishes it; delta tracking via
  * try_alloc/free is for allocation-site interposers). */

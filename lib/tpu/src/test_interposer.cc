@@ -1,0 +1,206 @@
+// Test driver for the PJRT interposer: a NON-JAX PJRT client (raw C API
+// calls, the way PyTorch/XLA or TF would drive the plugin) being capped and
+// throttled.  Run by tests/test_pjrt_interposer.py with:
+//
+//   VTPU_REAL_PJRT_PLUGIN=<mock_pjrt.so>
+//   TPU_DEVICE_MEMORY_SHARED_CACHE=<tmp>/vtpu.cache
+//   TPU_DEVICE_MEMORY_LIMIT_0=100          (MiB)
+//   TPU_DEVICE_CORE_LIMIT=30               (percent duty)
+//   TPU_TASK_PRIORITY=1  + the region's utilization switch forced on
+//
+// Prints PASS/FAIL lines; exits 0 only if everything passed.  Compiled
+// against the same pjrt_c_api.h as the interposer, so member offsets are
+// ABI-exact (no hand-maintained ctypes mirror).
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+static int g_failures = 0;
+
+#define CHECK(cond, what)                                   \
+  do {                                                      \
+    if (cond) {                                             \
+      printf("PASS %s\n", what);                            \
+    } else {                                                \
+      printf("FAIL %s\n", what);                            \
+      ++g_failures;                                         \
+    }                                                       \
+  } while (0)
+
+static std::string error_text(const PJRT_Api* api, PJRT_Error* e) {
+  PJRT_Error_Message_Args m;
+  memset(&m, 0, sizeof(m));
+  m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  m.error = e;
+  api->PJRT_Error_Message(&m);
+  return std::string(m.message, m.message_size);
+}
+
+static PJRT_Error_Code error_code(const PJRT_Api* api, PJRT_Error* e) {
+  PJRT_Error_GetCode_Args c;
+  memset(&c, 0, sizeof(c));
+  c.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  c.error = e;
+  api->PJRT_Error_GetCode(&c);
+  return c.code;
+}
+
+static void destroy_error(const PJRT_Api* api, PJRT_Error* e) {
+  PJRT_Error_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = e;
+  api->PJRT_Error_Destroy(&d);
+}
+
+static PJRT_Buffer* host_buffer(const PJRT_Api* api, PJRT_Client* client,
+                                PJRT_Device* dev, uint64_t mib,
+                                PJRT_Error** out_err) {
+  static char data[1];
+  int64_t dims[1] = {(int64_t)(mib * 1024 * 1024)};
+  PJRT_Client_BufferFromHostBuffer_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  a.client = client;
+  a.data = data;
+  a.type = PJRT_Buffer_Type_U8;
+  a.dims = dims;
+  a.num_dims = 1;
+  a.device = dev;
+  PJRT_Error* e = api->PJRT_Client_BufferFromHostBuffer(&a);
+  if (out_err) *out_err = e;
+  return e ? nullptr : a.buffer;
+}
+
+int main() {
+  void* h = dlopen(getenv("VTPU_INTERPOSER_SO"), RTLD_NOW);
+  if (!h) {
+    fprintf(stderr, "dlopen interposer: %s\n", dlerror());
+    return 2;
+  }
+  auto get = (const PJRT_Api* (*)(void))dlsym(h, "GetPjrtApi");
+  const PJRT_Api* api = get ? get() : nullptr;
+  CHECK(api != nullptr, "GetPjrtApi returns a table");
+  if (!api) return 2;
+
+  // Native test clock so the duty-cycle check is deterministic (waits
+  // advance a manual clock instead of sleeping).
+  auto rate_test_mode = (void (*)(int))dlsym(h, "vtpu_rate_test_mode");
+  auto rate_test_now = (uint64_t (*)(void))dlsym(h, "vtpu_rate_test_now");
+  auto region = (void* (*)(void))dlsym(h, "vtpu_region");
+  auto set_switch = (void (*)(void*, int))dlsym(h, "vtpu_r_set_switch");
+  CHECK(rate_test_mode && rate_test_now && region && set_switch,
+        "interposer exports the vtpu control surface");
+
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  PJRT_Error* e = api->PJRT_Client_Create(&ca);
+  CHECK(e == nullptr, "Client_Create");
+  PJRT_Client* client = ca.client;
+
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = client;
+  e = api->PJRT_Client_AddressableDevices(&da);
+  CHECK(e == nullptr && da.num_addressable_devices == 2,
+        "AddressableDevices passthrough");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+
+  // ---- HBM cap: 50 MiB fits the 100 MiB grant, +60 MiB must be refused --
+  PJRT_Buffer* b50 = host_buffer(api, client, dev0, 50, &e);
+  CHECK(b50 != nullptr && e == nullptr, "50 MiB alloc inside grant");
+
+  PJRT_Buffer* b60 = host_buffer(api, client, dev0, 60, &e);
+  CHECK(b60 == nullptr && e != nullptr, "60 MiB over-grant alloc refused");
+  if (e) {
+    CHECK(error_code(api, e) == PJRT_Error_Code_RESOURCE_EXHAUSTED,
+          "refusal is RESOURCE_EXHAUSTED");
+    CHECK(error_text(api, e).find("vtpu") != std::string::npos,
+          "refusal message names vtpu");
+    destroy_error(api, e);
+  }
+
+  // ---- Virtualized memory stats (real plugin reports UNIMPLEMENTED) -----
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = dev0;
+  e = api->PJRT_Device_MemoryStats(&ms);
+  CHECK(e == nullptr, "MemoryStats fabricated when real plugin has none");
+  CHECK(ms.bytes_limit_is_set &&
+            ms.bytes_limit == 100ll * 1024 * 1024,
+        "bytes_limit reports the grant (virtualized)");
+  CHECK(ms.bytes_in_use == 50ll * 1024 * 1024,
+        "bytes_in_use reports accounted usage");
+
+  // ---- Free releases the charge -----------------------------------------
+  PJRT_Buffer_Destroy_Args bd;
+  memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bd.buffer = b50;
+  e = api->PJRT_Buffer_Destroy(&bd);
+  CHECK(e == nullptr, "Buffer_Destroy");
+  PJRT_Buffer* b60b = host_buffer(api, client, dev0, 60, &e);
+  CHECK(b60b != nullptr, "60 MiB fits after free");
+
+  // ---- Execute: output accounting ---------------------------------------
+  setenv("MOCK_EXEC_US", "0", 1);
+  setenv("MOCK_OUT_BYTES", "1048576", 1);  // 1 MiB output
+  PJRT_Buffer* outs[1] = {nullptr};
+  PJRT_Buffer** out_lists[1] = {outs};
+  PJRT_LoadedExecutable_Execute_Args ea;
+  memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = reinterpret_cast<PJRT_LoadedExecutable*>(&ea);  // opaque
+  ea.num_devices = 1;
+  ea.num_args = 0;
+  ea.output_lists = out_lists;
+  e = api->PJRT_LoadedExecutable_Execute(&ea);
+  CHECK(e == nullptr && outs[0] != nullptr, "Execute passthrough");
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = dev0;
+  api->PJRT_Device_MemoryStats(&ms);
+  CHECK(ms.bytes_in_use == 61ll * 1024 * 1024,
+        "execute output charged post-hoc (60 + 1 MiB)");
+
+  // ---- Duty-cycle throttling of a non-JAX client ------------------------
+  // Low-priority proc + switch on => every Execute passes the limiter.
+  set_switch(region(), 1);
+  rate_test_mode(1);
+  setenv("MOCK_EXEC_US", "2000", 1);  // 2 ms device time per dispatch
+  const int kDispatches = 400;
+  PJRT_LoadedExecutable_Execute_Args ra;
+  memset(&ra, 0, sizeof(ra));
+  ra.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ra.executable = reinterpret_cast<PJRT_LoadedExecutable*>(&ra);
+  ra.num_devices = 1;
+  ra.num_args = 0;
+  ra.output_lists = nullptr;
+  for (int i = 0; i < kDispatches; ++i) {
+    e = api->PJRT_LoadedExecutable_Execute(&ra);
+    if (e) {
+      destroy_error(api, e);
+      break;
+    }
+  }
+  uint64_t waited_us = rate_test_now() / 1000;
+  // 400 x 2ms = 800 ms of charged device time at a 30% duty grant needs
+  // >= (800 - 200 burst)/0.3 = 2.0 s of throttle waiting.  The charge
+  // tracks measured wall (~2ms each), so accept a generous band.
+  CHECK(waited_us > 1200000, "non-JAX client throttled to duty cycle");
+  CHECK(waited_us < 10000000, "throttle wait bounded");
+  rate_test_mode(0);
+
+  printf(g_failures ? "RESULT FAIL %d\n" : "RESULT PASS\n", g_failures);
+  return g_failures ? 1 : 0;
+}

@@ -147,6 +147,26 @@ class TestGangAdmission:
         assert rs.node is None and "stale" in rs.error
         assert s.pods.get("du1") is None
 
+    def test_stale_event_rejected_even_after_group_popped(self, env):
+        # The drop that tombstones a uid may also empty and pop the group;
+        # a replayed add for that uid must NOT recreate the gang (it would
+        # later admit with a dead member holding capacity hostage).
+        kube, s = env
+        lone = gang_pod("e0", "eu0", group="jobe", total=2)
+        kube.create_pod(lone)
+        r = s.filter(lone, NODES)
+        assert "waiting" in r.error
+        kube.delete_pod("default", "e0")  # group now empty -> popped
+
+        stale = gang_pod("e0", "eu0", group="jobe", total=2)
+        rs = s.filter(stale, NODES)
+        assert rs.node is None and "stale" in rs.error
+        # A genuinely new member (fresh uid) still forms the group fine.
+        fresh = gang_pod("e1", "eu1", group="jobe", total=2)
+        kube.create_pod(fresh)
+        rf = s.filter(fresh, NODES)
+        assert "waiting (1/2)" in rf.error
+
     def test_replacement_keeps_generation_homogeneity(self, env):
         # ADVICE r2: a replacement member joining an admitted gang must stay
         # on the generation of its already-placed peers even when another
