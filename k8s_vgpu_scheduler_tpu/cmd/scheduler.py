@@ -16,9 +16,11 @@ from concurrent import futures
 
 import grpc
 
+import threading
+
 from ..api.service import add_device_service
 from ..k8s import FakeKube, make_client
-from ..scheduler.core import Scheduler
+from ..scheduler.core import Scheduler, run_watch_loop
 from ..scheduler.metrics import start_metrics_server
 from ..scheduler.routes import ExtenderServer
 from ..util.config import Config, ResourceNames
@@ -40,7 +42,11 @@ def parse_args(argv=None):
     p.add_argument("--resource-cores", default="google.com/tpucores")
     p.add_argument("--resource-priority", default="vtpu.dev/task-priority")
     p.add_argument("--topology-policy", default="best-effort")
-    p.add_argument("--resync-seconds", type=float, default=30.0)
+    # The watch loop (informer parity) is the primary event path; the
+    # periodic full resync is a safety net only, so its default is long.
+    p.add_argument("--resync-seconds", type=float, default=300.0)
+    p.add_argument("--no-watch", action="store_true",
+                   help="disable the pod watch stream; rely on resync only")
     p.add_argument("--debug", action="store_true",
                    help="enable the /debug profiling endpoints (stacks, "
                         "wall-clock profile, vars); unauthenticated — keep "
@@ -102,7 +108,16 @@ def main(argv=None):
     else:
         client = make_client(kube_url=args.kube_url)
     scheduler = Scheduler(client, build_config(args))
-    scheduler.resync_from_apiserver()
+
+    watch_stop = threading.Event()
+    if args.no_watch:
+        scheduler.resync_from_apiserver()
+    else:
+        # The watch loop's first iteration does the initial list+reconcile
+        # itself (rv=None) — no separate resync here, one list per boot.
+        threading.Thread(target=run_watch_loop,
+                         args=(scheduler, watch_stop),
+                         name="pod-watch", daemon=True).start()
 
     grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))
 
@@ -140,6 +155,7 @@ def main(argv=None):
             except Exception:  # noqa: BLE001 — transient apiserver loss
                 logging.exception("resync failed")
     except KeyboardInterrupt:
+        watch_stop.set()
         http_server.stop()
         grpc_server.stop(grace=2)
 

@@ -24,11 +24,29 @@ class NotFound(Exception):
     """404 from the apiserver."""
 
 
+class Gone(Exception):
+    """410 from the apiserver: the requested watch resourceVersion has been
+    compacted out of the event journal — the watcher must re-list."""
+
+
 class KubeClient:
     """The narrow apiserver surface this framework consumes."""
 
     # -- pods -----------------------------------------------------------------
     def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+        raise NotImplementedError
+
+    def list_pods_with_rv(self) -> "tuple[List[dict], str]":
+        """List all pods plus the list-level resourceVersion — the watch
+        bookmark (reference informer ListWatch, scheduler.go:66–86)."""
+        raise NotImplementedError
+
+    def watch_pods_events(self, resource_version: str,
+                          timeout_seconds: float = 50.0):
+        """Yield ``(event, pod, resource_version)`` tuples newer than
+        ``resource_version`` until ``timeout_seconds`` of quiet elapse
+        (the generator then ends; re-call with the last rv to resume).
+        Raises :class:`Gone` when the rv is too old — re-list then."""
         raise NotImplementedError
 
     def get_pod(self, namespace: str, name: str) -> dict:

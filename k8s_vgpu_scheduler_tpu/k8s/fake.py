@@ -13,9 +13,14 @@ from __future__ import annotations
 
 import copy
 import threading
-from typing import Callable, Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
-from .client import Conflict, KubeClient, NotFound
+from .client import Conflict, Gone, KubeClient, NotFound
+
+# Journal depth before old events are compacted away (watchers further back
+# get Gone and must re-list — apiserver etcd-compaction semantics).
+JOURNAL_LIMIT = 1024
 
 
 def _apply_annotation_patch(obj: dict, annotations: Dict[str, Optional[str]]) -> None:
@@ -37,10 +42,26 @@ class FakeKube(KubeClient):
         # Informer-style subscribers: fn(event, pod) with event in
         # {"ADDED", "MODIFIED", "DELETED"}.
         self._pod_watchers: List[Callable[[str, dict], None]] = []
+        # Watch journal: (rv int, event, pod snapshot), bounded; _cond wakes
+        # blocked watch_pods_events callers on every append.
+        self._journal: List[Tuple[int, str, dict]] = []
+        self._compacted_below = 0  # rv of the newest compacted-away event
+        self._cond = threading.Condition(self._lock)
 
     def _next_rv(self) -> str:
         self._rv += 1
         return str(self._rv)
+
+    def _journal_append(self, event: str, pod: dict) -> None:
+        """Under self._lock: stamp the pod's rv, journal the event, wake
+        watchers."""
+        rv = int(pod.setdefault("metadata", {}).get("resourceVersion", "0"))
+        self._journal.append((rv, event, copy.deepcopy(pod)))
+        if len(self._journal) > JOURNAL_LIMIT:
+            drop = len(self._journal) - JOURNAL_LIMIT
+            self._compacted_below = self._journal[drop - 1][0]
+            del self._journal[:drop]
+        self._cond.notify_all()
 
     # -- test setup helpers ---------------------------------------------------
     def add_node(self, node: dict) -> None:
@@ -57,9 +78,11 @@ class FakeKube(KubeClient):
         with self._lock:
             pod = copy.deepcopy(pod)
             key = f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
+            pod.setdefault("metadata", {})["resourceVersion"] = self._next_rv()
             self._pods[key] = pod
             watchers = list(self._pod_watchers)
             snapshot = copy.deepcopy(pod)
+            self._journal_append("ADDED", pod)
         for w in watchers:
             w("ADDED", snapshot)
         return snapshot
@@ -68,6 +91,9 @@ class FakeKube(KubeClient):
         with self._lock:
             pod = self._pods.pop(f"{namespace}/{name}", None)
             watchers = list(self._pod_watchers)
+            if pod is not None:
+                pod["metadata"]["resourceVersion"] = self._next_rv()
+                self._journal_append("DELETED", pod)
         if pod is not None:
             for w in watchers:
                 w("DELETED", copy.deepcopy(pod))
@@ -89,6 +115,38 @@ class FakeKube(KubeClient):
             ]
         return pods
 
+    def list_pods_with_rv(self) -> Tuple[List[dict], str]:
+        with self._lock:
+            return ([copy.deepcopy(p) for p in self._pods.values()],
+                    str(self._rv))
+
+    def watch_pods_events(self, resource_version: str,
+                          timeout_seconds: float = 50.0):
+        """Informer ListWatch semantics: yield journal events newer than
+        ``resource_version``; block (condition wait) when caught up; end
+        after ``timeout_seconds`` total.  Raises :class:`Gone` when the rv
+        predates the journal (compacted) — the caller must re-list."""
+        try:
+            since = int(resource_version or "0")
+        except ValueError:
+            since = 0
+        deadline = time.monotonic() + timeout_seconds
+        while True:
+            with self._cond:
+                if since < self._compacted_below:
+                    raise Gone(f"resourceVersion {since} compacted")
+                batch = [(ev, copy.deepcopy(p), rv)
+                         for rv, ev, p in self._journal if rv > since]
+                if not batch:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return
+                    self._cond.wait(timeout=min(remaining, 1.0))
+                    continue
+            for ev, pod, rv in batch:
+                yield ev, pod, str(rv)
+                since = rv
+
     def get_pod(self, namespace: str, name: str) -> dict:
         with self._lock:
             pod = self._pods.get(f"{namespace}/{name}")
@@ -104,8 +162,10 @@ class FakeKube(KubeClient):
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
             _apply_annotation_patch(pod, annotations)
+            pod["metadata"]["resourceVersion"] = self._next_rv()
             snapshot = copy.deepcopy(pod)
             watchers = list(self._pod_watchers)
+            self._journal_append("MODIFIED", pod)
         for w in watchers:
             w("MODIFIED", snapshot)
         return snapshot

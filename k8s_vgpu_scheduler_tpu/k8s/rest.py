@@ -16,7 +16,7 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
-from .client import Conflict, KubeClient, NotFound
+from .client import Conflict, Gone, KubeClient, NotFound
 
 log = logging.getLogger(__name__)
 
@@ -92,6 +92,53 @@ class RestKube(KubeClient):
             f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
         )
         return self._request("GET", path).get("items", [])
+
+    def list_pods_with_rv(self) -> "tuple[List[dict], str]":
+        body = self._request("GET", "/api/v1/pods")
+        return (body.get("items", []),
+                body.get("metadata", {}).get("resourceVersion", "0"))
+
+    def watch_pods_events(self, resource_version: str,
+                          timeout_seconds: float = 50.0):
+        """Streamed ``?watch=true`` (reference informer ListWatch,
+        scheduler.go:66–86): yields (event, pod, rv) lines until the server
+        closes the window.  Raises :class:`Gone` on 410 (re-list needed)."""
+        url = (f"{self.base_url}/api/v1/pods?watch=true"
+               f"&resourceVersion={resource_version}"
+               f"&timeoutSeconds={int(timeout_seconds)}")
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        token = self._current_token()
+        if token:
+            req.add_header("Authorization", f"Bearer {token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, context=self._ctx, timeout=timeout_seconds + 15)
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise Gone(f"watch rv {resource_version} expired") from e
+            raise
+        with resp:
+            for raw in resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                evt = json.loads(line)
+                obj = evt.get("object", {})
+                if evt.get("type") == "ERROR":
+                    # A real apiserver signals mid-stream rv expiry as a
+                    # 200-stream WatchEvent carrying a Status with code 410
+                    # (the HTTP 410 happens only at watch START).  Treating
+                    # it as a pod event would silently skip the compaction
+                    # gap's DELETEs.
+                    if obj.get("code") == 410 or \
+                            obj.get("reason") == "Expired":
+                        raise Gone(f"watch expired mid-stream: "
+                                   f"{obj.get('message', '')}")
+                    raise RuntimeError(
+                        f"watch ERROR event: {obj.get('message', obj)}")
+                yield (evt.get("type", ""), obj,
+                       obj.get("metadata", {}).get("resourceVersion", "0"))
 
     def get_pod(self, namespace: str, name: str) -> dict:
         return self._request("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")

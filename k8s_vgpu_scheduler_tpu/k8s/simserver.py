@@ -25,10 +25,11 @@ import json
 import logging
 import re
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .client import Conflict, NotFound
+from .client import Conflict, Gone, NotFound
 from .fake import FakeKube
 
 log = logging.getLogger(__name__)
@@ -62,18 +63,68 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(404, {"kind": "Status", "message": str(e)})
         except Conflict as e:
             self._reply(409, {"kind": "Status", "message": str(e)})
+        except Gone as e:
+            self._reply(410, {"kind": "Status", "reason": "Expired",
+                              "message": str(e)})
+        except BrokenPipeError:
+            pass  # watcher hung up mid-stream
         except Exception as e:  # noqa: BLE001
             log.exception("apisim error")
             self._reply(500, {"kind": "Status", "message": str(e)})
 
     do_GET = do_POST = do_PATCH = do_DELETE = _dispatch  # noqa: N815
 
+    def _watch_pods(self, query: dict) -> None:
+        """k8s watch semantics: stream one JSON WatchEvent per line until
+        timeoutSeconds elapse, then close (the client re-watches from its
+        last seen rv).  410 when the rv was compacted."""
+        rv = (query.get("resourceVersion") or ["0"])[0]
+        timeout = float((query.get("timeoutSeconds") or ["50"])[0])
+        # Probe for Gone BEFORE committing the streaming 200 header (it
+        # propagates to _dispatch -> 410).  A mid-stream Gone (watcher
+        # lagging behind compaction) just closes the stream; the client's
+        # next watch from its stale rv gets the clean 410.
+        gen = self.kube.watch_pods_events(rv, timeout_seconds=timeout)
+        try:
+            first = next(gen)
+        except StopIteration:
+            first = None
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        if first is None:
+            return
+
+        def send(ev: str, pod: dict) -> None:
+            self.wfile.write(
+                (json.dumps({"type": ev, "object": pod}) + "\n").encode())
+            self.wfile.flush()
+
+        send(first[0], first[1])
+        try:
+            for ev, pod, _new_rv in gen:
+                send(ev, pod)
+        except Gone as e:
+            # Mid-stream expiry: the real apiserver's shape — an ERROR
+            # WatchEvent carrying a 410 Status on the open 200 stream.
+            send("ERROR", {"kind": "Status", "code": 410,
+                           "reason": "Expired", "message": str(e)})
+            return
+
     def _route(self):
         method = self.command
-        path = self.path.split("?", 1)[0]
+        path, _, rawq = self.path.partition("?")
+        query = urllib.parse.parse_qs(rawq)
 
         if path == "/api/v1/pods" and method == "GET":
-            self._reply(200, {"kind": "PodList", "items": self.kube.list_pods()})
+            if (query.get("watch") or ["false"])[0] in ("true", "1"):
+                self._watch_pods(query)
+                return
+            items, rv = self.kube.list_pods_with_rv()
+            self._reply(200, {"kind": "PodList",
+                              "metadata": {"resourceVersion": rv},
+                              "items": items})
             return
 
         m = _POD_RE.match(path)

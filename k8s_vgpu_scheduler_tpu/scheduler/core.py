@@ -17,6 +17,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..k8s.client import (
+    Gone,
     KubeClient,
     NotFound,
     is_pod_terminated,
@@ -62,6 +63,31 @@ class FilterResult:
         self.error = error
 
 
+def decode_register_request(req) -> NodeInfo:
+    """RegisterRequest proto → NodeInfo (the one decode used by the stream
+    handler AND anything replaying advertisements, e.g. benchmarks)."""
+    devices = [
+        DeviceInfo(
+            id=d.id,
+            count=d.count,
+            devmem=d.devmem,
+            type=d.type,
+            health=d.health,
+            coords=tuple(d.coords),
+            cores=d.cores or 100,
+        )
+        for d in req.devices
+    ]
+    topo = None
+    if req.topology.mesh:
+        topo = TopologyDesc(
+            generation=req.topology.generation,
+            mesh=tuple(req.topology.mesh),
+            wraparound=tuple(req.topology.wraparound) or (),
+        )
+    return NodeInfo(name=req.node, devices=devices, topology=topo)
+
+
 class Scheduler:
     def __init__(self, client: KubeClient, cfg: Optional[Config] = None) -> None:
         self.client = client
@@ -79,29 +105,10 @@ class Scheduler:
         try:
             for req in request_iterator:
                 node_name = req.node
-                devices = [
-                    DeviceInfo(
-                        id=d.id,
-                        count=d.count,
-                        devmem=d.devmem,
-                        type=d.type,
-                        health=d.health,
-                        coords=tuple(d.coords),
-                        cores=d.cores or 100,
-                    )
-                    for d in req.devices
-                ]
-                topo = None
-                if req.topology.mesh:
-                    topo = TopologyDesc(
-                        generation=req.topology.generation,
-                        mesh=tuple(req.topology.mesh),
-                        wraparound=tuple(req.topology.wraparound) or (),
-                    )
-                self.nodes.add_node(
-                    node_name, NodeInfo(name=node_name, devices=devices, topology=topo)
-                )
-                log.info("registered node %s with %d chips", node_name, len(devices))
+                info = decode_register_request(req)
+                self.nodes.add_node(node_name, info)
+                log.info("registered node %s with %d chips", node_name,
+                         len(info.devices))
         finally:
             if node_name:
                 log.warning("register stream for %s closed; dropping node", node_name)
@@ -148,11 +155,15 @@ class Scheduler:
             )
         )
 
-    def resync_from_apiserver(self) -> None:
+    def resync_from_apiserver(self) -> str:
         """Full reconcile: re-add every listed pod AND prune grants whose pod
-        no longer exists (there is no watch in the raw-REST deployment, so
-        this is also how deletions are observed)."""
-        pods = self.client.list_pods()
+        no longer exists.  Returns the list's resourceVersion — the bookmark
+        :func:`run_watch_loop` resumes the event stream from.  With the
+        watch running this is a safety net, not the primary delete path."""
+        try:
+            pods, rv = self.client.list_pods_with_rv()
+        except NotImplementedError:
+            pods, rv = self.client.list_pods(), "0"
         for pod in pods:
             self.on_pod_event("ADDED", pod)
         alive = {pod_uid(p) for p in pods}
@@ -160,6 +171,7 @@ class Scheduler:
             if info.uid not in alive:
                 self.gangs.drop_member(info.uid)
                 self.pods.del_pod(info.uid)
+        return rv
 
     # -- usage snapshot --------------------------------------------------------
     def get_nodes_usage(
@@ -409,3 +421,44 @@ class Scheduler:
                 log.exception("failed to release lock on %s after bind error", node)
             return str(e)
         return None
+
+
+def run_watch_loop(scheduler: "Scheduler", stop: threading.Event,
+                   window_seconds: float = 50.0,
+                   error_backoff: float = 2.0) -> None:
+    """Informer-equivalent event loop (reference scheduler.go:66–86): list
+    once for the bookmark, then stream ``?watch=true`` windows, driving
+    :meth:`Scheduler.on_pod_event` within milliseconds of each apiserver
+    event — a deleted pod's grant is freed immediately instead of waiting
+    for the periodic resync (which stays on as the safety net).
+
+    Self-healing: a 410 Gone or any transport error falls back to re-list
+    (full reconcile) and resumes; runs until ``stop`` is set.  Call in a
+    daemon thread:  ``threading.Thread(target=run_watch_loop,
+    args=(scheduler, stop), daemon=True).start()``.
+    """
+    client = scheduler.client
+    rv: Optional[str] = None
+    while not stop.is_set():
+        try:
+            if rv is None:
+                rv = scheduler.resync_from_apiserver()
+            for ev, pod, new_rv in client.watch_pods_events(
+                    rv, timeout_seconds=window_seconds):
+                scheduler.on_pod_event(ev, pod)
+                rv = new_rv
+                if stop.is_set():
+                    return
+            # Quiet window elapsed: re-watch from the same bookmark.
+        except Gone:
+            log.info("watch bookmark expired; re-listing")
+            rv = None
+        except NotImplementedError:
+            log.info("client has no watch support; watch loop exiting "
+                     "(periodic resync remains)")
+            return
+        except Exception:
+            log.exception("watch stream failed; re-listing in %.1fs",
+                          error_backoff)
+            rv = None
+            stop.wait(error_backoff)

@@ -129,6 +129,20 @@ PJRT_Error* ExecutableDestroy(PJRT_Executable_Destroy_Args*) {
   return nullptr;  // g_executable is static
 }
 
+int g_event;  // identity-only ready event
+
+PJRT_Error* EventOnReady(PJRT_Event_OnReady_Args* a) {
+  // Mock executions are synchronous, so the event is already ready:
+  // invoke the callback inline (the way a real plugin fires it from its
+  // completion thread).
+  a->callback(nullptr, a->user_arg);
+  return nullptr;
+}
+
+PJRT_Error* EventDestroy(PJRT_Event_Destroy_Args*) {
+  return nullptr;  // static identity event
+}
+
 PJRT_Error* LoadedExecutableExecute(PJRT_LoadedExecutable_Execute_Args* a) {
   const char* us = getenv("MOCK_EXEC_US");
   long burn = us ? strtol(us, nullptr, 10) : 1000;
@@ -143,6 +157,12 @@ PJRT_Error* LoadedExecutableExecute(PJRT_LoadedExecutable_Execute_Args* a) {
       a->output_lists[d][0] =
           reinterpret_cast<PJRT_Buffer*>(new MockBuffer{sz});
     }
+  }
+  // Populate completion events when requested (the interposer requests
+  // them to measure true device-busy time).
+  if (a->device_complete_events) {
+    for (size_t d = 0; d < a->num_devices; ++d)
+      a->device_complete_events[d] = reinterpret_cast<PJRT_Event*>(&g_event);
   }
   return nullptr;
 }
@@ -177,6 +197,8 @@ extern "C" const PJRT_Api* GetPjrtApi(void) {
   g_mock_api.PJRT_Executable_NumOutputs = ExecutableNumOutputs;
   g_mock_api.PJRT_Executable_Destroy = ExecutableDestroy;
   g_mock_api.PJRT_LoadedExecutable_Execute = LoadedExecutableExecute;
+  g_mock_api.PJRT_Event_OnReady = EventOnReady;
+  g_mock_api.PJRT_Event_Destroy = EventDestroy;
   g_mock_api.PJRT_Device_MemoryStats = DeviceMemoryStats;
   return &g_mock_api;
 }

@@ -17,7 +17,11 @@
 //       happens here, not in XLA's allocator.
 //   PJRT_LoadedExecutable_Execute     gate dispatch through the native
 //       duty-cycle limiter (vtpu_rate_acquire, the cuLaunchKernel analog)
-//       and charge output buffers post-execution (vtpu_charge).
+//       and charge output buffers post-execution (vtpu_charge).  Execute is
+//       asynchronous, so the busy-time feedback comes from the per-device
+//       completion events (requested by us when the caller didn't);
+//       enqueue wall time is only the fallback when the plugin ignores the
+//       request or the caller owns the events.
 //   PJRT_Buffer_Destroy               release the recorded charge.
 //   PJRT_Device_MemoryStats           virtualize: bytes_limit reports the
 //       grant and bytes_in_use the accounted usage (the reference
@@ -46,6 +50,7 @@
 #include <time.h>
 
 #include <algorithm>
+#include <atomic>
 #include <mutex>
 #include <unordered_map>
 #include <vector>
@@ -351,16 +356,112 @@ void exec_slots(PJRT_LoadedExecutable_Execute_Args* args,
   if (out->empty()) out->push_back(0);
 }
 
+// Completion-timing context: PJRT Execute is ASYNCHRONOUS — the call
+// returns at enqueue time, so wall time around it measures ~nothing on a
+// real plugin.  True device-busy feedback needs the per-device completion
+// events: when the caller didn't request device_complete_events we request
+// them ourselves and feed back (completion − start) from the OnReady
+// callback.  The last callback frees the shared context.
+struct ExecTiming {
+  uint64_t start_us;
+  std::vector<int> slots;
+  std::vector<PJRT_Event*> events;
+  std::atomic<int> pending;
+};
+
+void on_exec_complete(PJRT_Error* error, void* user_arg) {
+  auto* pair = static_cast<std::pair<ExecTiming*, size_t>*>(user_arg);
+  ExecTiming* t = pair->first;
+  size_t i = pair->second;
+  if (error) {
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = error;
+    g_real->PJRT_Error_Destroy(&d);
+  } else {
+    int slot = i < t->slots.size() ? t->slots[i] : 0;
+    vtpu_rate_feedback(slot, now_us() - t->start_us);
+  }
+  PJRT_Event_Destroy_Args ed;
+  memset(&ed, 0, sizeof(ed));
+  ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  ed.event = t->events[i];
+  g_real->PJRT_Event_Destroy(&ed);
+  delete pair;
+  if (t->pending.fetch_sub(1) == 1) delete t;
+}
+
 PJRT_Error* LoadedExecutable_Execute(
     PJRT_LoadedExecutable_Execute_Args* args) {
   if (!g_enforce) return g_real->PJRT_LoadedExecutable_Execute(args);
   std::vector<int> slots;
   exec_slots(args, &slots);
   for (int s : slots) vtpu_rate_acquire(s, 0);  // 0: limiter uses feedback
+
+  // Request completion events when the caller didn't (see ExecTiming).
+  ExecTiming* timing = nullptr;
+  bool we_own_events = false;
+  if (!args->device_complete_events && args->num_devices > 0) {
+    timing = new ExecTiming;
+    timing->slots = slots;
+    timing->events.assign(args->num_devices, nullptr);
+    timing->pending.store((int)args->num_devices);
+    args->device_complete_events = timing->events.data();
+    we_own_events = true;
+  }
+
   uint64_t t0 = now_us();
   PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
   uint64_t wall = now_us() - t0;
-  for (int s : slots) vtpu_rate_feedback(s, wall);
+  if (we_own_events) {
+    args->device_complete_events = nullptr;  // caller never asked
+    if (err) {
+      delete timing;  // events not populated on error
+      timing = nullptr;
+    } else {
+      timing->start_us = t0;
+      int populated = 0;
+      for (PJRT_Event* e : timing->events)
+        if (e) ++populated;
+      if (populated == 0) {
+        // Plugin ignored the request: fall back to enqueue wall time — an
+        // under-estimate, but better than nothing.
+        for (int s : slots) vtpu_rate_feedback(s, wall);
+        delete timing;
+        timing = nullptr;
+      } else {
+        timing->pending.store(populated);
+        size_t n = timing->events.size();
+        for (size_t i = 0; i < n && timing; ++i) {
+          if (!timing->events[i]) continue;
+          PJRT_Event_OnReady_Args oa;
+          memset(&oa, 0, sizeof(oa));
+          oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+          oa.event = timing->events[i];
+          oa.user_arg = new std::pair<ExecTiming*, size_t>(timing, i);
+          oa.callback = on_exec_complete;
+          PJRT_Error* oe = g_real->PJRT_Event_OnReady(&oa);
+          if (oe) {
+            PJRT_Error_Destroy_Args d;
+            memset(&d, 0, sizeof(d));
+            d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+            d.error = oe;
+            g_real->PJRT_Error_Destroy(&d);
+            delete static_cast<std::pair<ExecTiming*, size_t>*>(oa.user_arg);
+            if (timing->pending.fetch_sub(1) == 1) {
+              delete timing;
+              timing = nullptr;  // ends the loop; callbacks all resolved
+            }
+          }
+        }
+      }
+    }
+  } else {
+    // Caller owns the completion events; we can't hook them without
+    // stealing ownership — charge enqueue wall time (under-estimate).
+    for (int s : slots) vtpu_rate_feedback(s, wall);
+  }
   if (err) return err;
   // Post-hoc output accounting (see file comment).
   if (args->output_lists) {

@@ -1,0 +1,194 @@
+"""Watch path (VERDICT r2 item 4): informer parity for the raw-REST client.
+
+The reference scheduler reacts to pod events via a client-go informer
+(pkg/scheduler/scheduler.go:66–86); our RestKube previously had only the
+30 s full-list resync, so deleted-pod grants lingered.  These tests drive
+the full real-transport chain — simserver ``?watch=true`` streaming →
+RestKube.watch_pods_events → run_watch_loop → Scheduler.on_pod_event —
+and pin the headline guarantee: a pod DELETE frees its grant in under a
+second with NO resync running.
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.k8s.client import Gone
+from k8s_vgpu_scheduler_tpu.k8s.rest import RestKube
+from k8s_vgpu_scheduler_tpu.k8s.simserver import KubeSimServer
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.core import run_watch_loop
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+from tests.test_scheduler_core import register_node, tpu_pod
+
+
+@pytest.fixture
+def sim():
+    srv = KubeSimServer()
+    srv.kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def wait_until(fn, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(interval)
+    return fn()
+
+
+class TestFakeKubeJournal:
+    def test_events_streamed_in_order_with_rvs(self):
+        kube = FakeKube()
+        kube.create_pod(tpu_pod(name="a", uid="ua"))
+        kube.create_pod(tpu_pod(name="b", uid="ub"))
+        kube.delete_pod("default", "a")
+        events = list(kube.watch_pods_events("0", timeout_seconds=0.1))
+        assert [(e, p["metadata"]["name"]) for e, p, _ in events] == [
+            ("ADDED", "a"), ("ADDED", "b"), ("DELETED", "a")]
+        rvs = [int(rv) for _, _, rv in events]
+        assert rvs == sorted(rvs)
+
+    def test_resume_from_rv_skips_seen(self):
+        kube = FakeKube()
+        kube.create_pod(tpu_pod(name="a", uid="ua"))
+        (_, _, rv1), = list(kube.watch_pods_events("0", timeout_seconds=0.1))
+        kube.create_pod(tpu_pod(name="b", uid="ub"))
+        events = list(kube.watch_pods_events(rv1, timeout_seconds=0.1))
+        assert [p["metadata"]["name"] for _, p, _ in events] == ["b"]
+
+    def test_compacted_rv_raises_gone(self):
+        from k8s_vgpu_scheduler_tpu.k8s import fake
+
+        kube = FakeKube()
+        old_limit = fake.JOURNAL_LIMIT
+        fake.JOURNAL_LIMIT = 4
+        try:
+            for i in range(10):
+                kube.create_pod(tpu_pod(name=f"p{i}", uid=f"u{i}"))
+            with pytest.raises(Gone):
+                list(kube.watch_pods_events("1", timeout_seconds=0.1))
+        finally:
+            fake.JOURNAL_LIMIT = old_limit
+
+    def test_blocks_until_event(self):
+        kube = FakeKube()
+        got = []
+
+        def watcher():
+            for ev in kube.watch_pods_events("0", timeout_seconds=3.0):
+                got.append(ev)
+                return
+
+        t = threading.Thread(target=watcher)
+        t.start()
+        time.sleep(0.1)
+        kube.create_pod(tpu_pod(name="late", uid="ul"))
+        t.join(timeout=3.0)
+        assert got and got[0][1]["metadata"]["name"] == "late"
+
+
+class TestRestWatch:
+    def test_stream_over_real_http(self, sim):
+        client = RestKube(sim.url)
+        items, rv = client.list_pods_with_rv()
+        assert items == []
+
+        got = []
+        done = threading.Event()
+
+        def watcher():
+            for ev, pod, new_rv in client.watch_pods_events(
+                    rv, timeout_seconds=5):
+                got.append((ev, pod["metadata"]["name"]))
+                if len(got) >= 2:
+                    break
+            done.set()
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        sim.kube.create_pod(tpu_pod(name="w1", uid="uw1"))
+        sim.kube.delete_pod("default", "w1")
+        assert done.wait(timeout=5)
+        assert got == [("ADDED", "w1"), ("DELETED", "w1")]
+
+    def test_watch_410_on_compacted_rv(self, sim):
+        from k8s_vgpu_scheduler_tpu.k8s import fake
+
+        old_limit = fake.JOURNAL_LIMIT
+        fake.JOURNAL_LIMIT = 2
+        try:
+            for i in range(8):
+                sim.kube.create_pod(tpu_pod(name=f"p{i}", uid=f"u{i}"))
+            client = RestKube(sim.url)
+            with pytest.raises(Gone):
+                list(client.watch_pods_events("1", timeout_seconds=2))
+        finally:
+            fake.JOURNAL_LIMIT = old_limit
+
+
+class TestWatchLoopE2E:
+    def test_delete_frees_grant_within_a_second_without_resync(self, sim):
+        """The VERDICT item's acceptance test, on real transports."""
+        client = RestKube(sim.url)
+        s = Scheduler(client, Config())
+        register_node(s, "node-a")
+
+        stop = threading.Event()
+        t = threading.Thread(target=run_watch_loop, args=(s, stop),
+                             daemon=True)
+        t.start()
+        try:
+            pod = tpu_pod(name="victim", uid="uvictim")
+            sim.kube.create_pod(pod)
+            r = s.filter(pod, ["node-a"])
+            assert r.node == "node-a"
+            # The filter patched annotations; the watch delivers the
+            # MODIFIED event and the grant is tracked.
+            assert wait_until(lambda: s.pods.get("uvictim") is not None)
+
+            t0 = time.monotonic()
+            sim.kube.delete_pod("default", "victim")
+            assert wait_until(lambda: s.pods.get("uvictim") is None,
+                              timeout=1.0), \
+                "grant not freed within 1s of DELETE (watch path broken)"
+            assert time.monotonic() - t0 <= 1.0
+        finally:
+            stop.set()
+
+    def test_watch_loop_survives_server_restart(self, sim):
+        client = RestKube(sim.url)
+        s = Scheduler(client, Config())
+        register_node(s, "node-a")
+        stop = threading.Event()
+        threading.Thread(target=run_watch_loop, args=(s, stop),
+                         daemon=True).start()
+        try:
+            pod = tpu_pod(name="a", uid="ua")
+            sim.kube.create_pod(pod)
+            s.filter(pod, ["node-a"])
+            assert wait_until(lambda: s.pods.get("ua") is not None)
+            # Simulated stream break: server restarts on a new port is not
+            # possible mid-fixture, but a journal compaction forces the
+            # Gone -> re-list path.
+            from k8s_vgpu_scheduler_tpu.k8s import fake
+
+            old_limit = fake.JOURNAL_LIMIT
+            fake.JOURNAL_LIMIT = 2
+            try:
+                for i in range(8):
+                    sim.kube.create_pod(tpu_pod(name=f"f{i}", uid=f"uf{i}"))
+                sim.kube.delete_pod("default", "a")
+                assert wait_until(lambda: s.pods.get("ua") is None,
+                                  timeout=5.0)
+            finally:
+                fake.JOURNAL_LIMIT = old_limit
+        finally:
+            stop.set()
