@@ -39,6 +39,7 @@ import subprocess
 import sys
 import tempfile
 import time
+from typing import List
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -522,14 +523,27 @@ SCENARIOS = {
 
 
 def main() -> None:
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    argv = [a for a in sys.argv[1:] if a != "--strict"]
+    strict = "--strict" in sys.argv[1:]
+    which = argv[0] if argv else "all"
     names = list(SCENARIOS) if which == "all" else [which]
+    failed: List[str] = []
     for n in names:
         try:
             SCENARIOS[n]()
         except Exception as e:  # noqa: BLE001 — always emit something
             log(f"{n} crashed: {e!r}")
             emit(n, {"passed": False, "error": repr(e)})
+        path = os.path.join(REPO, f"{n.upper()}_{ROUND}.json")
+        try:
+            with open(path) as f:
+                if not json.load(f).get("passed"):
+                    failed.append(n)
+        except (OSError, json.JSONDecodeError):
+            failed.append(n)
+    if strict and failed:
+        log(f"strict mode: failing scenarios: {failed}")
+        sys.exit(1)
 
 
 if __name__ == "__main__":
