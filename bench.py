@@ -54,6 +54,10 @@ CASES = {
         baseline=43.68, train=True),
 }
 PRIMARY = "resnet_v2_50_inference_bf16_b50_346"
+# Pallas flash-attention vs naive attention (VERDICT r2 item 5): compiled on
+# the real MXU, measured at long sequence.  Run after the model cases with
+# leftover budget; never in degraded (CPU) mode.
+FLASH_CASE = "flash_attention_microbench"
 
 _START = time.monotonic()
 
@@ -166,6 +170,30 @@ def pick_platform(env: dict):
     return None, True
 
 
+def collect_worker(name: str, argv: list, env: dict, out: str,
+                   timeout: float, fallback: dict):
+    """Spawn a worker, persist diagnostics on failure, read its JSON result
+    or return ``fallback`` — never raises."""
+    try:
+        r = subprocess.run(argv, env=env, timeout=timeout,
+                           capture_output=True, text=True)
+        if r.returncode != 0:
+            tail = (r.stderr or "").strip().splitlines()[-4:]
+            log(f"case {name}: worker rc={r.returncode}: " + " | ".join(tail))
+            diag(f"case {name} worker rc={r.returncode}\nstderr:\n{r.stderr}")
+    except subprocess.TimeoutExpired as te:
+        log(f"case {name}: worker timed out after {timeout:.0f}s")
+        diag(f"case {name} worker TIMEOUT after {timeout:.0f}s; partial "
+             f"stderr:\n{(te.stderr or b'')!r}")
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+    return fallback
+
+
 def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
              timeout: float):
     """Run one case in a worker subprocess; returns its result dict or an
@@ -191,27 +219,10 @@ def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
         wenv["VTPU_BALLAST"] = "0"
     log(f"case {name}: batch={spec['batch']} size={spec['size']} "
         f"iters={spec['iters']} timeout={timeout:.0f}s degraded={degraded}")
-    try:
-        r = subprocess.run(argv, env=wenv, timeout=timeout,
-                           capture_output=True, text=True)
-        if r.returncode != 0:
-            tail = (r.stderr or "").strip().splitlines()[-4:]
-            log(f"case {name}: worker rc={r.returncode}: " + " | ".join(tail))
-            diag(f"case {name} worker rc={r.returncode}\nstderr:\n{r.stderr}")
-    except subprocess.TimeoutExpired as te:
-        log(f"case {name}: worker timed out after {timeout:.0f}s")
-        diag(f"case {name} worker TIMEOUT after {timeout:.0f}s; partial "
-             f"stderr:\n{(te.stderr or b'')!r}")
-    result = None
-    if os.path.exists(out):
-        try:
-            with open(out) as f:
-                result = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            result = None
-    if result is None:
-        result = {"metric": name, "value": 0.0, "unit": "images/s",
-                  "vs_baseline": 0.0, "error": "worker failed or timed out"}
+    result = collect_worker(
+        name, argv, wenv, out, timeout,
+        {"metric": name, "value": 0.0, "unit": "images/s",
+         "vs_baseline": 0.0, "error": "worker failed or timed out"})
     result.setdefault("vs_baseline",
                       round(result.get("value", 0.0) / spec["baseline"], 3))
     if degraded:
@@ -244,6 +255,9 @@ def main() -> None:
                     continue
                 timeout = max(60.0, min(remaining() - 30, 180.0))
                 matrix.append(run_case(name, env, tmpdir, degraded, timeout))
+            if not degraded and remaining() > 120:
+                matrix.append(run_flash_case(env, tmpdir,
+                                             min(remaining() - 30, 180.0)))
     except Exception as e:  # noqa: BLE001 — emission must survive anything
         if not emitted.get("value"):
             emitted["error"] = f"harness: {e!r}"
@@ -255,6 +269,89 @@ def main() -> None:
         except OSError:
             pass
         print(json.dumps(emitted), flush=True)
+
+
+def run_flash_case(env: dict, tmpdir: str, timeout: float):
+    """Flash-vs-naive attention microbench in a worker subprocess."""
+    out = os.path.join(tmpdir, f"{FLASH_CASE}.json")
+    argv = [sys.executable, os.path.abspath(__file__), "--flash-worker",
+            "--out", out]
+    # No shim/ballast in this worker: the naive reference deliberately
+    # materializes the O(T²) score tensor, far beyond a 3000 MiB grant —
+    # the case measures kernel quality, not enforcement.
+    wenv = dict(env)
+    wenv["VTPU_BALLAST"] = "0"
+    log(f"case {FLASH_CASE}: timeout={timeout:.0f}s")
+    return collect_worker(
+        FLASH_CASE, argv, wenv, out, timeout,
+        {"metric": FLASH_CASE, "value": 0.0, "unit": "x-speedup",
+         "error": "worker failed or timed out"})
+
+
+def flash_worker(out_path: str) -> None:
+    """Measure the Pallas kernel against the naive O(T²)-HBM reference on
+    whatever accelerator is live (both jitted, causal bf16, d=128).
+
+    The result JSON is (re)written after EVERY sequence length, and a
+    failing length (e.g. the naive reference OOMing at long T — itself a
+    meaningful datum) records an error row instead of losing the run."""
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+
+    from k8s_vgpu_scheduler_tpu.ops import flash_attention as fa
+
+    platform = jax.devices()[0].platform
+    B, H, d = 4, 8, 128
+    rows = []
+
+    def write():
+        ok = [r for r in rows if "speedup" in r]
+        result = {
+            "metric": FLASH_CASE,
+            "unit": "x-speedup",
+            "platform": platform,
+            # Longest successfully-compared sequence is the headline.
+            "value": ok[-1]["speedup"] if ok else 0.0,
+            "rows": rows,
+            "config": {"batch": B, "heads": H, "head_dim": d,
+                       "dtype": "bfloat16", "causal": True},
+        }
+        with open(out_path, "w") as f:
+            json.dump(result, f)
+
+    for T in (2048, 4096, 8192):
+        try:
+            rng = jax.random.PRNGKey(T)
+            kq, kk, kv = jax.random.split(rng, 3)
+            q = jax.random.normal(kq, (B, T, H, d), jnp.bfloat16)
+            k = jax.random.normal(kk, (B, T, H, d), jnp.bfloat16)
+            v = jax.random.normal(kv, (B, T, H, d), jnp.bfloat16)
+
+            flash = jax.jit(lambda q, k, v: fa.flash_attention(
+                q, k, v, causal=True, interpret=False))
+            naive = jax.jit(lambda q, k, v: fa._reference(
+                q, k, v, 1.0 / d ** 0.5, True))
+
+            def timed(fn):
+                jax.block_until_ready(fn(q, k, v))  # compile
+                t0 = time.perf_counter()
+                n = 10
+                for _ in range(n):
+                    r = fn(q, k, v)
+                jax.block_until_ready(r)
+                return (time.perf_counter() - t0) / n
+
+            t_flash = timed(flash)
+            row = {"seq": T, "flash_ms": round(t_flash * 1e3, 3)}
+            rows.append(row)
+            write()
+            t_naive = timed(naive)
+            row.update(naive_ms=round(t_naive * 1e3, 3),
+                       speedup=round(t_naive / t_flash, 3))
+        except Exception as e:  # noqa: BLE001 — keep earlier rows
+            rows.append({"seq": T, "error": f"{type(e).__name__}: {e}"[:200]})
+        write()
 
 
 # ----------------------------------------------------------------------------
@@ -355,7 +452,15 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
 
 
 if __name__ == "__main__":
-    if "--worker" in sys.argv:
+    if "--flash-worker" in sys.argv:
+        import argparse
+
+        p = argparse.ArgumentParser()
+        p.add_argument("--flash-worker", action="store_true")
+        p.add_argument("--out", required=True)
+        a = p.parse_args()
+        flash_worker(a.out)
+    elif "--worker" in sys.argv:
         import argparse
 
         p = argparse.ArgumentParser()

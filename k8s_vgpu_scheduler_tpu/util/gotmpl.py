@@ -189,10 +189,15 @@ def _truthy(v: Any) -> bool:
     return True
 
 
-def _to_yaml(v: Any, indent_level: int = 0) -> str:
+def _to_yaml(v: Any) -> str:
     import yaml
 
     return yaml.safe_dump(v, default_flow_style=False, sort_keys=False).rstrip("\n")
+
+
+# Pipeline sentinel: "no value piped yet" must be distinct from a piped nil
+# (`.missing | default "x"` pipes None and default must see it).
+_NO_PIPE = object()
 
 
 def _go_printf(fmt: str, *args: Any) -> str:
@@ -258,19 +263,15 @@ class Engine:
                 out.append(self._render_block(n, frame))
         return "".join(out)
 
+    _ASSIGN_RE = re.compile(r"^\$[\w]+\s*:=")
+
     def _render_action(self, expr: str, frame: _Frame) -> str:
-        # variable assignment produces no output
-        if ":=" in expr:
+        # variable assignment produces no output (matched structurally, not
+        # by substring — a ':=' inside a string literal is not assignment)
+        if self._ASSIGN_RE.match(expr):
             name, _, rhs = expr.partition(":=")
-            name = name.strip()
-            if not name.startswith("$"):
-                raise TemplateError(f"bad assignment target {name!r}")
-            frame.vars[name] = self._eval_pipeline(rhs.strip(), frame)
+            frame.vars[name.strip()] = self._eval_pipeline(rhs.strip(), frame)
             return ""
-        m = _KEYWORD_RE.match(expr)
-        if m and m.group(1) in ("template", "include"):
-            # action form: {{ template "name" . }}
-            return _stringify(self._eval_pipeline(expr, frame))
         return _stringify(self._eval_pipeline(expr, frame))
 
     def _render_block(self, b: _Block, frame: _Frame) -> str:
@@ -340,13 +341,9 @@ class Engine:
                 segments.append([])
             else:
                 segments[-1].append(t)
-        value, first = None, True
+        value: Any = _NO_PIPE
         for seg in segments:
-            if first:
-                value = self._eval_command(seg, frame, piped=None)
-                first = False
-            else:
-                value = self._eval_command(seg, frame, piped=value)
+            value = self._eval_command(seg, frame, piped=value)
         return value
 
     def _eval_command(self, tokens: List[str], frame: _Frame,
@@ -354,17 +351,15 @@ class Engine:
         if not tokens:
             raise TemplateError("empty pipeline segment")
         head = tokens[0]
-        # bare term (no function application possible)
-        if len(tokens) == 1 and piped is None and not self._is_func(head):
-            return self._eval_term(iter([head]).__next__, head, frame)
         if self._is_func(head):
             args = self._eval_args(tokens[1:], frame)
-            if piped is not None:
+            if piped is not _NO_PIPE:
+                # Piped nil is still an argument: `.missing | default "x"`
+                # must reach default() as (default_value, None).
                 args.append(piped)
             return self._call(head, args, frame)
-        # term applied to nothing (e.g. parenthesized expr piped onward)
         if len(tokens) == 1:
-            return self._eval_term(None, head, frame)
+            return self._eval_term(head, frame)
         raise TemplateError(f"cannot evaluate {' '.join(tokens)!r}")
 
     def _eval_args(self, tokens: List[str], frame: _Frame) -> List[Any]:
@@ -384,11 +379,11 @@ class Engine:
                 args.append(self._eval_pipeline(inner, frame))
                 i = j
             else:
-                args.append(self._eval_term(None, t, frame))
+                args.append(self._eval_term(t, frame))
                 i += 1
         return args
 
-    def _eval_term(self, _next: Any, t: str, frame: _Frame) -> Any:
+    def _eval_term(self, t: str, frame: _Frame) -> Any:
         if t.startswith('"') or t.startswith("'"):
             return _unquote(t.replace("'", '"', 2)) if t.startswith("'") \
                 else _unquote(t)

@@ -215,3 +215,35 @@ class TestChartRenders:
             Engine().render('{{ include "no.such.helper" . }}', {})
         with pytest.raises(TemplateError):
             Engine().render("{{ if .x }}unterminated", {})
+
+
+class TestGoTemplateEngine:
+    """Pipeline edge cases the chart may grow into (pinned from review)."""
+
+    def eng(self):
+        from k8s_vgpu_scheduler_tpu.util.gotmpl import Engine
+
+        return Engine()
+
+    def test_piped_nil_reaches_default(self):
+        assert self.eng().render(
+            '{{ .missing | default "fallback" }}', {}) == "fallback"
+        assert self.eng().render('{{ .missing | quote }}', {}) == '""'
+
+    def test_assignment_not_detected_inside_string_literal(self):
+        assert self.eng().render('{{ printf "a := b" }}', {}) == "a := b"
+
+    def test_variable_assignment_and_use(self):
+        assert self.eng().render(
+            '{{- $x := default "d" .v -}}{{ $x }}', {"v": "set"}) == "set"
+
+    def test_range_with_loop_vars(self):
+        out = self.eng().render(
+            "{{- range $i, $v := .xs }}{{ $i }}={{ $v }};{{ end }}",
+            {"xs": ["a", "b"]})
+        assert out == "0=a;1=b;"
+
+    def test_nindent_and_toyaml(self):
+        out = self.eng().render(
+            "labels:{{ toYaml .l | nindent 2 }}", {"l": {"a": "1"}})
+        assert out == "labels:\n  a: '1'"
