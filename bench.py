@@ -52,6 +52,17 @@ CASES = {
     "resnet_v2_50_train_bf16_b20_346": dict(
         model="resnet50", batch=20, size=346, iters=10,
         baseline=43.68, train=True),
+    # Remaining reference inference rows (README.md:191–204 / BASELINE.md;
+    # baselines are the vGPU-plugin column).
+    "vgg16_inference_bf16_b20_224": dict(
+        model="vgg16", batch=20, size=224, iters=20,
+        baseline=134.2, train=False),
+    "deeplab_inference_bf16_b2_512": dict(
+        model="deeplab", batch=2, size=512, iters=10,
+        baseline=8.92, train=False),
+    "lstm_inference_bf16_b100_1024x300": dict(
+        model="lstm", batch=100, size=1024, iters=10,
+        baseline=22.32, train=False),
 }
 PRIMARY = "resnet_v2_50_inference_bf16_b50_346"
 # Pallas flash-attention vs naive attention (VERDICT r2 item 5): compiled on
@@ -382,14 +393,34 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
     import jax
     import jax.numpy as jnp
 
-    from k8s_vgpu_scheduler_tpu.models.resnet import (
-        ResNetV2, resnet_v2_50, resnet_v2_152)
-
-    builders = {"resnet50": resnet_v2_50, "resnet152": resnet_v2_152}
-    cfg = builders[CASES[name]["model"]]()
-    model = ResNetV2(cfg)
     rng = jax.random.PRNGKey(0)
-    x = jax.random.normal(rng, (batch, size, size, 3), jnp.bfloat16)
+    kind = CASES[name]["model"]
+    if kind in ("resnet50", "resnet152"):
+        from k8s_vgpu_scheduler_tpu.models.resnet import (
+            ResNetV2, resnet_v2_50, resnet_v2_152)
+
+        cfg = {"resnet50": resnet_v2_50, "resnet152": resnet_v2_152}[kind]()
+        model = ResNetV2(cfg)
+        x = jax.random.normal(rng, (batch, size, size, 3), jnp.bfloat16)
+    elif kind == "vgg16":
+        from k8s_vgpu_scheduler_tpu.models.vgg import VGG16
+
+        model = VGG16()
+        x = jax.random.normal(rng, (batch, size, size, 3), jnp.bfloat16)
+    elif kind == "deeplab":
+        from k8s_vgpu_scheduler_tpu.models.deeplab import (
+            DeepLabV3, deeplab_v3)
+
+        model = DeepLabV3(deeplab_v3())
+        x = jax.random.normal(rng, (batch, size, size, 3), jnp.bfloat16)
+    elif kind == "lstm":
+        from k8s_vgpu_scheduler_tpu.models.lstm import LSTMClassifier
+
+        model = LSTMClassifier()
+        # Reference 5.x: sequence 1024 x feature 300 ("size" = seq here).
+        x = jax.random.normal(rng, (batch, size, 300), jnp.bfloat16)
+    else:
+        raise ValueError(f"unknown model kind {kind}")
     params = jax.jit(model.init)(rng, x)
     result["platform"] = jax.devices()[0].platform
 
@@ -403,8 +434,10 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
         def chained(params, x0):
             def body(x, _):
                 logits = model.apply(params, x)
-                eps = (logits[0, 0] * 1e-6).astype(x.dtype)
-                return x + eps, logits[0, 0]
+                # Scalar regardless of output rank (lstm 2D, deeplab 4D).
+                scalar = logits.reshape(-1)[0]
+                eps = (scalar * 1e-6).astype(x.dtype)
+                return x + eps, scalar
             _, outs = jax.lax.scan(body, x0, None, length=iters)
             return outs[-1]
 

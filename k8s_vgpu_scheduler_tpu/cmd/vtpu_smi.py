@@ -1,0 +1,129 @@
+"""vtpu-smi — the nvidia-smi analog for fractional TPU shares.
+
+The reference's headline isolation claim is "nvidia-smi inside the container
+shows the vGPU memory limit" (/root/reference/README.md:133, via the
+intercept library's virtualized nvmlDeviceGetMemoryInfo).  This CLI is the
+TPU equivalent, reading the same shared accounting region the enforcement
+layers write:
+
+- inside a container (``TPU_DEVICE_MEMORY_SHARED_CACHE`` set): shows THIS
+  pod's virtualized view — per-chip grant as "total", accounted usage,
+  compute cap, throttle state;
+- on a node (``--containers-dir``): one section per vtpu container, the
+  monitor's-eye view (reference ``/tmp/vgpu/containers`` scan).
+
+Usage:
+  python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi [--json]
+  python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi --containers-dir /tmp/vtpu/containers
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..monitor.reader import RegionReader
+
+MIB = 1024 * 1024
+
+
+def region_info(region) -> dict:
+    devs = []
+    for i in range(region.num_devices):
+        limit = region.limit(i)
+        used = region.used(i)
+        devs.append({
+            "index": i,
+            "uuid": region.uuid(i) or str(i),
+            "memory_total_mib": limit // MIB,
+            "memory_used_mib": used // MIB,
+            "memory_used_pct": round(100.0 * used / limit, 1) if limit else 0.0,
+            "core_limit_pct": region.sm_limit(i) or 100,
+        })
+    return {
+        "devices": devs,
+        "priority": region.priority,
+        "throttled": bool(region.utilization_switch),
+        "oversubscribe": bool(region.oversubscribe),
+        "processes": region.proc_pids(),
+    }
+
+
+def format_info(info: dict, title: str) -> str:
+    lines = [
+        f"+ {title}",
+        "| idx  uuid                     HBM used / grant      cores  |",
+    ]
+    for d in info["devices"]:
+        lines.append(
+            "| {idx:<4d} {uuid:<24s} {used:>6d} / {total:<6d} MiB  {cores:>4d}%  |".format(
+                idx=d["index"], uuid=d["uuid"][:24], used=d["memory_used_mib"],
+                total=d["memory_total_mib"], cores=d["core_limit_pct"])
+        )
+    flags = []
+    if info["throttled"]:
+        flags.append("THROTTLED(priority sharer active)")
+    if info["oversubscribe"]:
+        flags.append("OVERSUBSCRIBED(host-RAM swap)")
+    lines.append(
+        f"| prio={info['priority']} procs={len(info['processes'])} "
+        + " ".join(flags)
+    )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("vtpu-smi")
+    p.add_argument("--region", default="",
+                   help="region path (default: $TPU_DEVICE_MEMORY_SHARED_CACHE)")
+    p.add_argument("--containers-dir", default="",
+                   help="host mode: scan per-container region dirs")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--library", default=os.environ.get("VTPU_LIBRARY", ""),
+                   help="libvtpu.so path override")
+    args = p.parse_args(argv)
+
+    reader = RegionReader(args.library or None)
+    targets: List[tuple] = []
+    if args.containers_dir:
+        for entry in sorted(os.listdir(args.containers_dir)):
+            d = os.path.join(args.containers_dir, entry)
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".cache"):
+                    targets.append((entry, os.path.join(d, fn)))
+    else:
+        path = args.region or os.environ.get(
+            "TPU_DEVICE_MEMORY_SHARED_CACHE", "")
+        if not path:
+            print("vtpu-smi: no region (not a vtpu container? set --region "
+                  "or --containers-dir)", file=sys.stderr)
+            return 2
+        targets.append(("this container", path))
+
+    out = {}
+    for title, path in targets:
+        region = reader.open(path)
+        if region is None:
+            print(f"vtpu-smi: cannot open region {path}", file=sys.stderr)
+            continue
+        try:
+            out[title] = region_info(region)
+        finally:
+            region.close()
+    if not out:
+        return 1
+    if args.as_json:
+        print(json.dumps(out, indent=1))
+    else:
+        for title, info in out.items():
+            print(format_info(info, title))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
 """Monitor tests: feedback loop + metrics over regions written by real
 workload subprocesses through libvtpu (reference has no monitor tests)."""
 
+import json
 import os
 import subprocess
 import sys
@@ -260,3 +261,89 @@ class TestNodeRPC:
         finally:
             server.stop()
             w.stop()
+
+
+class TestVtpuSmi:
+    """vtpu-smi: the reference's 'nvidia-smi shows the vGPU limit'
+    (README.md:133) made executable for TPU shares."""
+
+    def _make_region(self, tmp_path, name="podA_main"):
+        import ctypes
+        import subprocess
+        import sys
+
+        d = tmp_path / name
+        d.mkdir(parents=True)
+        cache = d / "vtpu.cache"
+        env = dict(os.environ)
+        env.update(
+            TPU_DEVICE_MEMORY_SHARED_CACHE=str(cache),
+            TPU_DEVICE_MEMORY_LIMIT_0="3000",
+            TPU_DEVICE_CORE_LIMIT="30",
+            TPU_VISIBLE_CHIPS="chip-xyz",
+            VTPU_LIBRARY=LIB,
+        )
+        code = (
+            "import ctypes, os\n"
+            "lib = ctypes.CDLL(os.environ['VTPU_LIBRARY'])\n"
+            "lib.vtpu_init_path.argtypes = [ctypes.c_char_p]\n"
+            "assert lib.vtpu_init_path(None) == 0\n"
+            "lib.vtpu_charge.argtypes = [ctypes.c_int, ctypes.c_uint64]\n"
+            "lib.vtpu_charge(0, 1536 * 1024 * 1024)\n"
+            "lib.vtpu_set_used.argtypes = [ctypes.c_int, ctypes.c_uint64]\n"
+        )
+        # Keep usage visible after exit: shutdown clears the proc slot, so
+        # write via set_used from a process that exits WITHOUT shutdown —
+        # os._exit skips the destructor path.
+        code += "import os as _o; _o._exit(0)\n"
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        return cache
+
+    def test_container_view_reports_grant_as_total(self, tmp_path):
+        from k8s_vgpu_scheduler_tpu.cmd import vtpu_smi
+
+        cache = self._make_region(tmp_path)
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = vtpu_smi.main(["--region", str(cache), "--json",
+                                "--library", LIB])
+        assert rc == 0
+        out = json.loads(buf.getvalue())
+        info = out["this container"]
+        dev = info["devices"][0]
+        assert dev["memory_total_mib"] == 3000  # the GRANT, not the chip
+        assert dev["memory_used_mib"] == 1536
+        assert dev["core_limit_pct"] == 30
+        assert dev["uuid"] == "chip-xyz"
+
+    def test_host_view_scans_container_dirs(self, tmp_path):
+        from k8s_vgpu_scheduler_tpu.cmd import vtpu_smi
+
+        self._make_region(tmp_path, "podA_main")
+        self._make_region(tmp_path, "podB_main")
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = vtpu_smi.main(["--containers-dir", str(tmp_path), "--json",
+                                "--library", LIB])
+        assert rc == 0
+        out = json.loads(buf.getvalue())
+        assert set(out) == {"podA_main", "podB_main"}
+
+    def test_no_region_is_a_loud_error(self, capsys):
+        from k8s_vgpu_scheduler_tpu.cmd import vtpu_smi
+
+        env_backup = os.environ.pop("TPU_DEVICE_MEMORY_SHARED_CACHE", None)
+        try:
+            rc = vtpu_smi.main(["--library", LIB])
+        finally:
+            if env_backup is not None:
+                os.environ["TPU_DEVICE_MEMORY_SHARED_CACHE"] = env_backup
+        assert rc == 2
