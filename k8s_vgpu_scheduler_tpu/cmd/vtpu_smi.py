@@ -25,7 +25,7 @@ import os
 import sys
 from typing import List, Optional
 
-from ..monitor.reader import RegionReader
+from ..monitor.reader import RegionReader, scan_container_dirs
 
 MIB = 1024 * 1024
 
@@ -89,13 +89,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     reader = RegionReader(args.library or None)
     targets: List[tuple] = []
     if args.containers_dir:
-        for entry in sorted(os.listdir(args.containers_dir)):
-            d = os.path.join(args.containers_dir, entry)
-            if not os.path.isdir(d):
-                continue
-            for fn in sorted(os.listdir(d)):
-                if fn.endswith(".cache"):
-                    targets.append((entry, os.path.join(d, fn)))
+        # Same scan the node monitor runs (tolerates dirs vanishing
+        # mid-scan, one region per container).
+        targets = sorted(scan_container_dirs(args.containers_dir).items())
     else:
         path = args.region or os.environ.get(
             "TPU_DEVICE_MEMORY_SHARED_CACHE", "")

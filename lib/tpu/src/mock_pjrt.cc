@@ -99,6 +99,12 @@ PJRT_Error* BufferDestroy(PJRT_Buffer_Destroy_Args* a) {
   return nullptr;
 }
 
+PJRT_Error* BufferCopyToDevice(PJRT_Buffer_CopyToDevice_Args* a) {
+  a->dst_buffer = reinterpret_cast<PJRT_Buffer*>(
+      new MockBuffer{reinterpret_cast<MockBuffer*>(a->buffer)->size});
+  return nullptr;
+}
+
 PJRT_Error* BufferOnDeviceSize(PJRT_Buffer_OnDeviceSizeInBytes_Args* a) {
   a->on_device_size_in_bytes =
       reinterpret_cast<MockBuffer*>(a->buffer)->size;
@@ -189,6 +195,7 @@ extern "C" const PJRT_Api* GetPjrtApi(void) {
   g_mock_api.PJRT_Client_AddressableDevices = ClientAddressableDevices;
   g_mock_api.PJRT_Client_BufferFromHostBuffer = BufferFromHostBuffer;
   g_mock_api.PJRT_Buffer_Destroy = BufferDestroy;
+  g_mock_api.PJRT_Buffer_CopyToDevice = BufferCopyToDevice;
   g_mock_api.PJRT_Buffer_OnDeviceSizeInBytes = BufferOnDeviceSize;
   g_mock_api.PJRT_LoadedExecutable_AddressableDevices =
       LoadedExecutableAddressableDevices;

@@ -30,12 +30,17 @@
 //       none, which gives JAX's device.memory_stats() a signal on backends
 //       that expose nothing.
 //
-// Known v1 granularity limits (documented, not silent): buffers created via
-// CopyToDevice/CopyToMemory/CreateViewOfDeviceBuffer/AsyncHostToDevice are
-// accounted only at destroy time if ever seen; executable output charges
-// are post-hoc (can't refuse what already exists — the watchdog handles
-// over-limit).  Deliberately NOT hooked: PJRT_Buffer_Delete (jax frees via
-// Destroy; hooking both would double-free the account).
+// Also enforced: PJRT_Buffer_CopyToDevice (refused over grant, like
+// BufferFromHostBuffer) and PJRT_Buffer_CopyToMemory (charged when the
+// destination memory is device-kind; host-kind copies are free — that's
+// the oversubscription path).  Known v1 granularity limits (documented,
+// not silent): AsyncHostToDeviceTransferManager buffers are accounted only
+// at destroy time if ever seen; executable output charges are post-hoc
+// (can't refuse what already exists — the watchdog handles over-limit).
+// Deliberately NOT hooked: PJRT_Buffer_Delete (jax frees via Destroy;
+// hooking both would double-free the account) and
+// PJRT_Client_CreateViewOfDeviceBuffer (a view allocates nothing; charging
+// it would double-count the underlying buffer).
 //
 // ABI: the PJRT_Api struct is append-only (pjrt_c_api.h:2869), so replacing
 // early members is stable across plugin versions; the copied table is
@@ -52,6 +57,7 @@
 #include <algorithm>
 #include <atomic>
 #include <mutex>
+#include <string>
 #include <unordered_map>
 #include <vector>
 
@@ -76,16 +82,6 @@ struct VtpuError {
   char msg[256];
 };
 
-PJRT_Error* make_error(PJRT_Error_Code code, const char* fmt, uint64_t a,
-                       uint64_t b) {
-  VtpuError* e = new VtpuError;
-  e->magic = kErrMagic;
-  e->code = code;
-  snprintf(e->msg, sizeof(e->msg), fmt, (unsigned long long)a,
-           (unsigned long long)b);
-  return reinterpret_cast<PJRT_Error*>(e);
-}
-
 bool is_ours(const PJRT_Error* err) {
   return err && reinterpret_cast<const VtpuError*>(err)->magic == kErrMagic;
 }
@@ -108,6 +104,29 @@ std::unordered_map<PJRT_Device*, int> g_dev_slot;
 // LoadedExecutable -> cached output count.
 std::unordered_map<PJRT_LoadedExecutable*, size_t> g_num_outputs;
 
+void destroy_real_error(PJRT_Error* err) {
+  if (!err) return;
+  PJRT_Error_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = err;
+  g_real->PJRT_Error_Destroy(&d);
+}
+
+PJRT_Error* refuse_over_grant(int slot, const char* what) {
+  uint64_t total = 0, used = 0;
+  vtpu_memory_info(slot, &total, &used);
+  VtpuError* e = new VtpuError;
+  e->magic = kErrMagic;
+  e->code = PJRT_Error_Code_RESOURCE_EXHAUSTED;
+  snprintf(e->msg, sizeof(e->msg),
+           "vtpu: HBM grant exceeded on device slot: %s would pass the "
+           "%llu MiB cap (container already accounts %llu MiB)",
+           what, (unsigned long long)(total / (1024 * 1024)),
+           (unsigned long long)(used / (1024 * 1024)));
+  return reinterpret_cast<PJRT_Error*>(e);
+}
+
 uint64_t now_us() {
   struct timespec ts;
   clock_gettime(CLOCK_MONOTONIC, &ts);
@@ -128,11 +147,7 @@ void map_client_devices(PJRT_Client* client) {
   a.client = client;
   PJRT_Error* err = g_real->PJRT_Client_AddressableDevices(&a);
   if (err) {  // enumeration failure -> everything charges slot 0
-    PJRT_Error_Destroy_Args d;
-    memset(&d, 0, sizeof(d));
-    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-    d.error = err;
-    g_real->PJRT_Error_Destroy(&d);
+    destroy_real_error(err);
     return;
   }
   std::lock_guard<std::mutex> g(g_mu);
@@ -189,11 +204,7 @@ uint64_t real_buffer_size(PJRT_Buffer* buf, uint64_t fallback) {
   a.buffer = buf;
   PJRT_Error* err = g_real->PJRT_Buffer_OnDeviceSizeInBytes(&a);
   if (err) {
-    PJRT_Error_Destroy_Args d;
-    memset(&d, 0, sizeof(d));
-    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-    d.error = err;
-    g_real->PJRT_Error_Destroy(&d);
+    destroy_real_error(err);
     return fallback;
   }
   return a.on_device_size_in_bytes;
@@ -254,21 +265,77 @@ PJRT_Error* Client_BufferFromHostBuffer(
   int slot = slot_of(args->device);
   uint64_t bytes = logical_bytes(args->type, args->dims, args->num_dims);
   int rc = vtpu_try_alloc(slot, bytes);
-  if (rc == -ENOMEM) {
-    uint64_t total = 0, used = 0;
-    vtpu_memory_info(slot, &total, &used);
-    return make_error(
-        PJRT_Error_Code_RESOURCE_EXHAUSTED,
-        "vtpu: HBM grant exceeded on device slot: alloc would pass the "
-        "%llu MiB cap (container already accounts %llu MiB)",
-        total / (1024 * 1024), used / (1024 * 1024));
-  }
+  if (rc == -ENOMEM) return refuse_over_grant(slot, "alloc");
   PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
   if (err) {
     if (rc == 0) vtpu_free(slot, bytes);
     return err;
   }
   if (rc == 0) record_buffer(args->buffer, bytes, slot);
+  return nullptr;
+}
+
+PJRT_Error* Buffer_CopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  if (!g_enforce) return g_real->PJRT_Buffer_CopyToDevice(args);
+  int slot = slot_of(args->dst_device);
+  uint64_t bytes = real_buffer_size(args->buffer, 0);
+  int rc = bytes ? vtpu_try_alloc(slot, bytes) : -1;
+  if (rc == -ENOMEM) return refuse_over_grant(slot, "copy");
+  PJRT_Error* err = g_real->PJRT_Buffer_CopyToDevice(args);
+  if (err) {
+    if (rc == 0) vtpu_free(slot, bytes);
+    return err;
+  }
+  if (rc == 0) record_buffer(args->dst_buffer, bytes, slot);
+  return nullptr;
+}
+
+bool memory_is_device_kind(PJRT_Memory* mem) {
+  if (!g_real->PJRT_Memory_Kind) return true;  // unknown: assume HBM
+  PJRT_Memory_Kind_Args ka;
+  memset(&ka, 0, sizeof(ka));
+  ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+  ka.memory = mem;
+  PJRT_Error* err = g_real->PJRT_Memory_Kind(&ka);
+  if (err) {
+    destroy_real_error(err);
+    return true;  // unknown: assume HBM (conservative)
+  }
+  std::string kind(ka.kind, ka.kind_size);
+  return kind.find("host") == std::string::npos;
+}
+
+PJRT_Error* Buffer_CopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
+  if (!g_enforce) return g_real->PJRT_Buffer_CopyToMemory(args);
+  // Copies into host-kind memory (pinned_host — the oversubscription path)
+  // don't consume HBM and are never charged or refused.
+  bool device_kind = args->dst_memory
+      ? memory_is_device_kind(args->dst_memory) : true;
+  int slot = 0;
+  uint64_t bytes = 0;
+  int rc = -1;
+  if (device_kind) {
+    if (args->dst_memory && g_real->PJRT_Memory_AddressableByDevices) {
+      PJRT_Memory_AddressableByDevices_Args da;
+      memset(&da, 0, sizeof(da));
+      da.struct_size = PJRT_Memory_AddressableByDevices_Args_STRUCT_SIZE;
+      da.memory = args->dst_memory;
+      PJRT_Error* err = g_real->PJRT_Memory_AddressableByDevices(&da);
+      if (!err && da.num_devices > 0) slot = slot_of(da.devices[0]);
+      else if (err) {
+        destroy_real_error(err);
+      }
+    }
+    bytes = real_buffer_size(args->buffer, 0);
+    rc = bytes ? vtpu_try_alloc(slot, bytes) : -1;
+    if (rc == -ENOMEM) return refuse_over_grant(slot, "copy");
+  }
+  PJRT_Error* err = g_real->PJRT_Buffer_CopyToMemory(args);
+  if (err) {
+    if (rc == 0) vtpu_free(slot, bytes);
+    return err;
+  }
+  if (rc == 0) record_buffer(args->dst_buffer, bytes, slot);
   return nullptr;
 }
 
@@ -307,11 +374,7 @@ size_t num_outputs_of(PJRT_LoadedExecutable* lx) {
     PJRT_Error* err2 = g_real->PJRT_Executable_NumOutputs(&na);
     if (!err2) n = na.num_outputs;
     else {
-      PJRT_Error_Destroy_Args d;
-      memset(&d, 0, sizeof(d));
-      d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      d.error = err2;
-      g_real->PJRT_Error_Destroy(&d);
+      destroy_real_error(err2);
     }
     PJRT_Executable_Destroy_Args xd;
     memset(&xd, 0, sizeof(xd));
@@ -319,11 +382,7 @@ size_t num_outputs_of(PJRT_LoadedExecutable* lx) {
     xd.executable = ga.executable;
     g_real->PJRT_Executable_Destroy(&xd);
   } else if (err) {
-    PJRT_Error_Destroy_Args d;
-    memset(&d, 0, sizeof(d));
-    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-    d.error = err;
-    g_real->PJRT_Error_Destroy(&d);
+    destroy_real_error(err);
   }
   std::lock_guard<std::mutex> g(g_mu);
   g_num_outputs[lx] = n;
@@ -342,11 +401,7 @@ void exec_slots(PJRT_LoadedExecutable_Execute_Args* args,
   da.executable = args->executable;
   PJRT_Error* err = g_real->PJRT_LoadedExecutable_AddressableDevices(&da);
   if (err) {
-    PJRT_Error_Destroy_Args d;
-    memset(&d, 0, sizeof(d));
-    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-    d.error = err;
-    g_real->PJRT_Error_Destroy(&d);
+    destroy_real_error(err);
     out->push_back(0);
     return;
   }
@@ -374,11 +429,7 @@ void on_exec_complete(PJRT_Error* error, void* user_arg) {
   ExecTiming* t = pair->first;
   size_t i = pair->second;
   if (error) {
-    PJRT_Error_Destroy_Args d;
-    memset(&d, 0, sizeof(d));
-    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-    d.error = error;
-    g_real->PJRT_Error_Destroy(&d);
+    destroy_real_error(error);
   } else {
     int slot = i < t->slots.size() ? t->slots[i] : 0;
     vtpu_rate_feedback(slot, now_us() - t->start_us);
@@ -443,11 +494,7 @@ PJRT_Error* LoadedExecutable_Execute(
           oa.callback = on_exec_complete;
           PJRT_Error* oe = g_real->PJRT_Event_OnReady(&oa);
           if (oe) {
-            PJRT_Error_Destroy_Args d;
-            memset(&d, 0, sizeof(d));
-            d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-            d.error = oe;
-            g_real->PJRT_Error_Destroy(&d);
+            destroy_real_error(oe);
             delete static_cast<std::pair<ExecTiming*, size_t>*>(oa.user_arg);
             if (timing->pending.fetch_sub(1) == 1) {
               delete timing;
@@ -549,6 +596,13 @@ extern "C" const PJRT_Api* GetPjrtApi(void) {
     g_api.PJRT_Error_GetCode = Error_GetCode;
     g_api.PJRT_Client_Create = Client_Create;
     g_api.PJRT_Client_BufferFromHostBuffer = Client_BufferFromHostBuffer;
+    // Only hook copy entry points the real plugin implements — installing
+    // a hook over a null real member would advertise (and then call) a
+    // function the plugin doesn't have.
+    if (g_real->PJRT_Buffer_CopyToDevice)
+      g_api.PJRT_Buffer_CopyToDevice = Buffer_CopyToDevice;
+    if (g_real->PJRT_Buffer_CopyToMemory)
+      g_api.PJRT_Buffer_CopyToMemory = Buffer_CopyToMemory;
     g_api.PJRT_Buffer_Destroy = Buffer_Destroy;
     g_api.PJRT_LoadedExecutable_Execute = LoadedExecutable_Execute;
     g_api.PJRT_Device_MemoryStats = Device_MemoryStats;

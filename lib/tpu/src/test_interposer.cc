@@ -152,6 +152,28 @@ int main() {
   PJRT_Buffer* b60b = host_buffer(api, client, dev0, 60, &e);
   CHECK(b60b != nullptr, "60 MiB fits after free");
 
+  // ---- CopyToDevice is capped like BufferFromHostBuffer -----------------
+  // 60 MiB already held; copying it to dev1 would need another 60 (the
+  // region caps per-slot, dev1's slot is empty, so copy succeeds) — but a
+  // second copy to dev0 (60 + 60 > 100) must be refused.
+  PJRT_Buffer_CopyToDevice_Args cd;
+  memset(&cd, 0, sizeof(cd));
+  cd.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+  cd.buffer = b60b;
+  cd.dst_device = da.addressable_devices[1];
+  e = api->PJRT_Buffer_CopyToDevice(&cd);
+  CHECK(e == nullptr && cd.dst_buffer != nullptr,
+        "copy to empty dev1 inside its grant");
+  memset(&cd, 0, sizeof(cd));
+  cd.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+  cd.buffer = b60b;
+  cd.dst_device = dev0;
+  e = api->PJRT_Buffer_CopyToDevice(&cd);
+  CHECK(e != nullptr &&
+            error_code(api, e) == PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "over-grant copy to dev0 refused");
+  if (e) destroy_error(api, e);
+
   // ---- Execute: output accounting ---------------------------------------
   setenv("MOCK_EXEC_US", "0", 1);
   setenv("MOCK_OUT_BYTES", "1048576", 1);  // 1 MiB output

@@ -268,10 +268,6 @@ class TestVtpuSmi:
     (README.md:133) made executable for TPU shares."""
 
     def _make_region(self, tmp_path, name="podA_main"):
-        import ctypes
-        import subprocess
-        import sys
-
         d = tmp_path / name
         d.mkdir(parents=True)
         cache = d / "vtpu.cache"
@@ -283,6 +279,9 @@ class TestVtpuSmi:
             TPU_VISIBLE_CHIPS="chip-xyz",
             VTPU_LIBRARY=LIB,
         )
+        # vtpu_charge writes usage into this process's proc slot; exiting
+        # via os._exit skips vtpu_shutdown (which would clear the slot), so
+        # the usage stays visible to the CLI like a live workload's would.
         code = (
             "import ctypes, os\n"
             "lib = ctypes.CDLL(os.environ['VTPU_LIBRARY'])\n"
@@ -290,12 +289,8 @@ class TestVtpuSmi:
             "assert lib.vtpu_init_path(None) == 0\n"
             "lib.vtpu_charge.argtypes = [ctypes.c_int, ctypes.c_uint64]\n"
             "lib.vtpu_charge(0, 1536 * 1024 * 1024)\n"
-            "lib.vtpu_set_used.argtypes = [ctypes.c_int, ctypes.c_uint64]\n"
+            "os._exit(0)\n"
         )
-        # Keep usage visible after exit: shutdown clears the proc slot, so
-        # write via set_used from a process that exits WITHOUT shutdown —
-        # os._exit skips the destructor path.
-        code += "import os as _o; _o._exit(0)\n"
         r = subprocess.run([sys.executable, "-c", code], env=env,
                            capture_output=True, text=True, timeout=60)
         assert r.returncode == 0, r.stderr
