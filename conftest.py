@@ -25,3 +25,8 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "e2e: multi-process end-to-end tests (real transports)")
