@@ -415,7 +415,14 @@ class Shim:
     # -- accounting + watchdog -------------------------------------------------
     def publish_usage_once(self) -> None:
         """Sample the XLA client's bytes_in_use per device and publish it
-        into the shared region (minus our own ballast)."""
+        into the shared region (minus our own ballast).
+
+        No-op under the PJRT interposer: there memory_stats is FABRICATED
+        from the region (container-wide total), so publishing it back into
+        this process's slot would double-count every sharer — and the
+        interposer already delta-accounts this process's buffers."""
+        if os.environ.get("VTPU_PJRT_INTERPOSER", "") in ("true", "1"):
+            return
         try:
             import jax
         except Exception:
