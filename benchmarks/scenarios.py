@@ -545,11 +545,95 @@ def scenario_oversub() -> None:
     emit("oversub", result)
 
 
+# ---------------------------------------------------------------------------
+# gang (BASELINE #5: v5p-256 multi-host gang schedule)
+# ---------------------------------------------------------------------------
+
+def scenario_gang() -> None:
+    """32 hosts x 8 v5p chips = a 256-chip slice; one 32-member JAX SPMD
+    job (8 whole chips per member) must be admitted ATOMICALLY: members
+    wait until the whole gang fits, then every member gets its node in one
+    placement pass.  Control-plane only — no accelerator involved — so this
+    artifact is never degraded."""
+    import time as _time
+
+    from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+    from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+    from k8s_vgpu_scheduler_tpu.scheduler.nodes import DeviceInfo, NodeInfo
+    from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+    from k8s_vgpu_scheduler_tpu.util.config import Config
+
+    n_nodes, chips_per_node, members = 32, 8, 32
+    kube = FakeKube()
+    s = Scheduler(kube, Config())
+    for n in range(n_nodes):
+        name = f"host-{n:02d}"
+        kube.add_node({"metadata": {"name": name, "annotations": {}}})
+        s.nodes.add_node(name, NodeInfo(
+            name=name,
+            devices=[DeviceInfo(id=f"{name}-chip-{i}", count=10,
+                                devmem=95 * 1024, type="TPU-v5p",
+                                health=True,
+                                coords=(i % 2, (i // 2) % 2, i // 4))
+                     for i in range(chips_per_node)],
+            topology=TopologyDesc(generation="v5p", mesh=(2, 2, 2)),
+        ))
+    kube.watch_pods(s.on_pod_event)
+    nodes = [f"host-{n:02d}" for n in range(n_nodes)]
+
+    pods = []
+    for m in range(members):
+        pod = {
+            "metadata": {"name": f"llama-{m:02d}", "namespace": "default",
+                         "uid": f"guid-{m:02d}",
+                         "annotations": {
+                             "vtpu.dev/pod-group": "llama7b",
+                             "vtpu.dev/pod-group-total": str(members),
+                         }},
+            "spec": {"containers": [{
+                "name": "train",
+                "resources": {"limits": {"google.com/tpu": "8"}},
+            }]},
+        }
+        kube.create_pod(pod)
+        pods.append(pod)
+
+    # Members 1..N-1 must WAIT (no partial gang holds chips hostage).
+    waited = 0
+    t0 = _time.monotonic()
+    for pod in pods[:-1]:
+        r = s.filter(pod, nodes)
+        waited += int(r.node is None and "waiting" in (r.error or ""))
+    # The N-th member triggers atomic admission of the whole gang.
+    last = s.filter(pods[-1], nodes)
+    placements = {pods[-1]["metadata"]["name"]: last.node}
+    for pod in pods[:-1]:
+        r = s.filter(pod, nodes)
+        placements[pod["metadata"]["name"]] = r.node
+    elapsed = _time.monotonic() - t0
+
+    placed_nodes = [n for n in placements.values() if n]
+    emit("gang", {
+        "hosts": n_nodes,
+        "chips_per_host": chips_per_node,
+        "total_chips": n_nodes * chips_per_node,
+        "gang_members": members,
+        "members_waited_before_quorum": waited,
+        "members_placed": len(placed_nodes),
+        "distinct_hosts": len(set(placed_nodes)),
+        "admission_wall_s": round(elapsed, 3),
+        "passed": (waited == members - 1
+                   and len(placed_nodes) == members
+                   and len(set(placed_nodes)) == members),
+    })
+
+
 SCENARIOS = {
     "enforce": scenario_enforce,
     "cosched": scenario_cosched,
     "throttle": scenario_throttle,
     "oversub": scenario_oversub,
+    "gang": scenario_gang,
 }
 
 
