@@ -11,6 +11,12 @@ strict parsing (the reference silently swallows malformed fields):
 
 UUIDs therefore must not contain ``,``, ``:`` or ``;`` — enforced at encode
 time here, unchecked in the reference.
+
+Canonicalization corner (grammar limitation, same in the reference): a pod
+whose ONLY container has no devices encodes as ``""``, which decodes as "no
+containers" — ``[[]]`` → ``[]``.  Harmless in practice: a pod with no device
+grants never gets the annotation at all; multi-container pods with SOME
+empty containers round-trip exactly (``[[], [d]]`` ↔ ``";d..."``).
 """
 
 from __future__ import annotations
