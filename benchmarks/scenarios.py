@@ -422,8 +422,12 @@ def scenario_throttle() -> None:
     duty = result.get("duty_measured")
     # The capped pass must take ~1/0.30 of the uncapped time; accept a wide
     # band (dispatch overhead counts toward wall but not toward the charge,
-    # and the burst bucket forgives the first 200 ms).
-    result["passed"] = duty is not None and 0.15 <= duty <= 0.45
+    # and the burst bucket forgives the first 200 ms).  Degraded runs land
+    # on shared CI runners where a noisy neighbor can skew either pass, so
+    # their band is wider still — the check stays meaningful (throttling
+    # clearly engaged) without being flaky by construction.
+    lo, hi = (0.08, 0.60) if degraded else (0.15, 0.45)
+    result["passed"] = duty is not None and lo <= duty <= hi
     if rc != 0:
         result["error"] = (err or "worker failed").strip().splitlines()[-3:]
         result["passed"] = False

@@ -109,14 +109,16 @@ def main(argv=None):
         client = make_client(kube_url=args.kube_url)
     scheduler = Scheduler(client, build_config(args))
 
+    # SYNCHRONOUS boot reconcile, before any server accepts traffic: a
+    # restarted scheduler that serves /filter with an empty pod registry
+    # would double-book chips already granted to running pods.
+    initial_rv = scheduler.resync_from_apiserver()
+
     watch_stop = threading.Event()
-    if args.no_watch:
-        scheduler.resync_from_apiserver()
-    else:
-        # The watch loop's first iteration does the initial list+reconcile
-        # itself (rv=None) — no separate resync here, one list per boot.
+    if not args.no_watch:
         threading.Thread(target=run_watch_loop,
                          args=(scheduler, watch_stop),
+                         kwargs={"initial_rv": initial_rv},
                          name="pod-watch", daemon=True).start()
 
     grpc_server = grpc.server(futures.ThreadPoolExecutor(max_workers=64))

@@ -49,6 +49,7 @@ from .allocator import SliceAllocator
 from ..k8s.client import KubeClient, pod_name, pod_uid
 from ..tpulib.types import NodeInventory
 from ..util import protocol
+from ..util.enforcement import check_shim_install
 from ..util.config import Config
 from ..util.types import (
     ENV_CORE_LIMIT,
@@ -115,12 +116,13 @@ def attach_enforcement(resp, cfg: Config, cache_key: str) -> None:
         )
     )
     # Only mount shim artifacts that exist on the host (a mount with a
-    # missing source fails EVERY container create) — but never silently: a
-    # node with a broken shim install loses isolation, so the skip is loud
-    # and VTPU_STRICT_ENFORCEMENT=1 fails the allocation instead (the caller
-    # finalizes bind-phase=failed and the pod reschedules elsewhere).
-    strict = os.environ.get("VTPU_STRICT_ENFORCEMENT", "") in ("1", "true")
-    if cfg.shim_host_dir and os.path.isdir(cfg.shim_host_dir):
+    # missing source fails EVERY container create) — but never silently: the
+    # shared policy (util/enforcement.py) warns loudly on fail-open, and
+    # VTPU_STRICT_ENFORCEMENT=1 raises instead (the caller finalizes
+    # bind-phase=failed and the pod reschedules elsewhere).
+    mount_dir, mount_preload = check_shim_install(
+        cfg.shim_host_dir, what="allocation")
+    if mount_dir:
         resp.mounts.append(
             pb.Mount(
                 container_path="/usr/local/vtpu",
@@ -128,32 +130,14 @@ def attach_enforcement(resp, cfg: Config, cache_key: str) -> None:
                 read_only=True,
             )
         )
-        preload = os.path.join(cfg.shim_host_dir, "ld.so.preload")
-        if os.path.exists(preload):
-            resp.mounts.append(
-                pb.Mount(
-                    container_path="/etc/ld.so.preload",
-                    host_path=preload,
-                    read_only=True,
-                )
+    if mount_preload:
+        resp.mounts.append(
+            pb.Mount(
+                container_path="/etc/ld.so.preload",
+                host_path=os.path.join(cfg.shim_host_dir, "ld.so.preload"),
+                read_only=True,
             )
-        else:
-            if strict:
-                raise FileNotFoundError(
-                    f"{preload} missing and VTPU_STRICT_ENFORCEMENT set; "
-                    "refusing to allocate an unenforced container")
-            log.warning(
-                "shim ld.so.preload missing at %s — container will run "
-                "WITHOUT HBM/core enforcement", preload)
-    elif cfg.shim_host_dir:
-        if strict:
-            raise FileNotFoundError(
-                f"shim host dir {cfg.shim_host_dir} missing and "
-                "VTPU_STRICT_ENFORCEMENT set; refusing to allocate an "
-                "unenforced container")
-        log.warning(
-            "shim host dir %s missing — container will run WITHOUT "
-            "HBM/core enforcement", cfg.shim_host_dir)
+        )
 
 
 def attach_device_node(resp, chip_index: int) -> None:
@@ -327,13 +311,20 @@ class TpuDevicePlugin:
         return resp
 
     # -- serving lifecycle (Serve/Register, plugin.go:181–253) ----------------
-    def serving(self, probe_timeout: float = 2.0) -> bool:
+    # A restart aborts in-flight Allocates mid two-phase commit, so a single
+    # slow probe (CPU-starved node, long GC pause) must NOT look like death:
+    # the RPC probe only reports dead after this many CONSECUTIVE failures.
+    PROBE_FAILURE_THRESHOLD = 2
+
+    def serving(self, probe_timeout: float = 5.0) -> bool:
         """Liveness for the supervisor: server object present, unix socket
         still on disk (kubelet wipes the plugin dir on restart; a crashed
-        server leaves a stale path), AND a short-timeout local RPC answers —
-        a wedged-but-alive server (threads stuck, socket on disk) must fail
-        this check, not just a dead one."""
+        server leaves a stale path), AND a local RPC answers — a
+        wedged-but-alive server (threads stuck, socket on disk) must fail
+        this check, not just a dead one.  Hard evidence (no server object /
+        no socket) is immediate; the probe needs consecutive failures."""
         if self._server is None or not os.path.exists(self.socket_path):
+            self._probe_failures = 0
             return False
         try:
             from ..api.kubelet import DevicePluginStub
@@ -341,9 +332,17 @@ class TpuDevicePlugin:
             with grpc.insecure_channel(f"unix://{self.socket_path}") as ch:
                 DevicePluginStub(ch).GetDevicePluginOptions(
                     pb.Empty(), timeout=probe_timeout)
+            self._probe_failures = 0
             return True
         except grpc.RpcError:
-            return False
+            self._probe_failures = getattr(self, "_probe_failures", 0) + 1
+            if self._probe_failures >= self.PROBE_FAILURE_THRESHOLD:
+                self._probe_failures = 0
+                return False
+            log.warning(
+                "plugin liveness probe failed (%d/%d); tolerating",
+                self._probe_failures, self.PROBE_FAILURE_THRESHOLD)
+            return True
 
     def serve(self) -> None:
         if self._server is not None:

@@ -14,6 +14,7 @@ import logging
 import os
 from typing import Callable, Dict, List, Optional
 
+from ..util.enforcement import check_shim_install
 from ..util.types import (
     ENV_CORE_LIMIT,
     ENV_MEMORY_LIMIT_PREFIX,
@@ -106,38 +107,20 @@ def inject_vtpu(
                 }
             )
 
-        # Mirror attach_enforcement (deviceplugin/plugin.py:92–108): only
-        # bind-mount shim artifacts that exist on the host — an
+        # Only bind-mount shim artifacts that exist on the host — an
         # unconditional mount of a missing source makes runc fail EVERY
-        # create, which is strictly worse than running unenforced.  NOT
-        # silently though: a node with a broken shim install loses isolation,
-        # so the skip is loud, and VTPU_STRICT_ENFORCEMENT=1 (or strict=True)
-        # fails the create instead for enforcement-mandatory clusters.
-        fail_closed = (strict if strict is not None else
-                       os.environ.get("VTPU_STRICT_ENFORCEMENT", "")
-                       in ("1", "true"))
-        if os.path.isdir(shim_host_dir):
+        # create, which is strictly worse than running unenforced.  The
+        # shared policy (util/enforcement.py, same as the device plugin's
+        # Allocate path) warns loudly on fail-open; strict/
+        # VTPU_STRICT_ENFORCEMENT raises instead.
+        mount_dir, mount_preload = check_shim_install(
+            shim_host_dir, strict=strict, what="container")
+        if mount_dir:
             add_mount("/usr/local/vtpu", shim_host_dir, read_only=True)
-            preload = os.path.join(shim_host_dir, "ld.so.preload")
-            if os.path.exists(preload):
-                add_mount("/etc/ld.so.preload", preload, read_only=True)
-            else:
-                if fail_closed:
-                    raise FileNotFoundError(
-                        f"{preload} missing and VTPU_STRICT_ENFORCEMENT set; "
-                        "refusing to create an unenforced container")
-                log.warning(
-                    "shim ld.so.preload missing at %s — container will run "
-                    "WITHOUT HBM/core enforcement", preload)
-        else:
-            if fail_closed:
-                raise FileNotFoundError(
-                    f"shim host dir {shim_host_dir} missing and "
-                    "VTPU_STRICT_ENFORCEMENT set; refusing to create an "
-                    "unenforced container")
-            log.warning(
-                "shim host dir %s missing — container will run WITHOUT "
-                "HBM/core enforcement", shim_host_dir)
+        if mount_preload:
+            add_mount("/etc/ld.so.preload",
+                      os.path.join(shim_host_dir, "ld.so.preload"),
+                      read_only=True)
         if cache_host_dir:
             add_mount(
                 os.path.dirname(cache_path), cache_host_dir, read_only=False

@@ -159,7 +159,16 @@ class Scheduler:
         """Full reconcile: re-add every listed pod AND prune grants whose pod
         no longer exists.  Returns the list's resourceVersion — the bookmark
         :func:`run_watch_loop` resumes the event stream from.  With the
-        watch running this is a safety net, not the primary delete path."""
+        watch running this is a safety net, not the primary delete path.
+
+        Prune discipline (the resync runs CONCURRENTLY with the watch and
+        filter threads): a grant recorded after the list snapshot began
+        belongs to a pod the stale list simply doesn't contain — pruning it
+        would drop a LIVE pod's grant (double-booking its chips) and, for a
+        gang member, tombstone a live uid.  Hence the ``touched_at`` guard,
+        and no tombstone from this path (tombstones are for real informer
+        DELETEs, where the uid can never return)."""
+        list_started = time.monotonic()
         try:
             pods, rv = self.client.list_pods_with_rv()
         except NotImplementedError:
@@ -168,8 +177,8 @@ class Scheduler:
             self.on_pod_event("ADDED", pod)
         alive = {pod_uid(p) for p in pods}
         for info in self.pods.list_pods():
-            if info.uid not in alive:
-                self.gangs.drop_member(info.uid)
+            if info.uid not in alive and info.touched_at < list_started:
+                self.gangs.drop_member(info.uid, tombstone=False)
                 self.pods.del_pod(info.uid)
         return rv
 
@@ -425,7 +434,8 @@ class Scheduler:
 
 def run_watch_loop(scheduler: "Scheduler", stop: threading.Event,
                    window_seconds: float = 50.0,
-                   error_backoff: float = 2.0) -> None:
+                   error_backoff: float = 2.0,
+                   initial_rv: Optional[str] = None) -> None:
     """Informer-equivalent event loop (reference scheduler.go:66–86): list
     once for the bookmark, then stream ``?watch=true`` windows, driving
     :meth:`Scheduler.on_pod_event` within milliseconds of each apiserver
@@ -438,7 +448,11 @@ def run_watch_loop(scheduler: "Scheduler", stop: threading.Event,
     args=(scheduler, stop), daemon=True).start()``.
     """
     client = scheduler.client
-    rv: Optional[str] = None
+    # The caller may have already done the boot list+reconcile (it must run
+    # BEFORE the extender starts serving, or a restarted scheduler filters
+    # against an empty registry and double-books granted chips); its rv
+    # seeds the stream so boot performs exactly one list.
+    rv: Optional[str] = initial_rv
     while not stop.is_set():
         try:
             if rv is None:

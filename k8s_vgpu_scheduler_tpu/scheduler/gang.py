@@ -112,6 +112,8 @@ class GangManager:
             key = f"{namespace}/{group}"
             g = self._groups.get(key)
             if member.uid in self._dropped and \
+                    self._now() - self._dropped[member.uid] \
+                    <= GANG_EXPIRE_SECONDS and \
                     (g is None or member.uid not in g.members):
                 # A deleted pod's uid never returns (recreations get fresh
                 # uids): this is a replayed informer event.  Pre-admission it
@@ -157,13 +159,18 @@ class GangManager:
         with self._lock:
             return any(uid in g.placements for g in self._groups.values())
 
-    def drop_member(self, uid: str) -> None:
-        """Release one pod's membership + placement (pod deleted)."""
+    def drop_member(self, uid: str, tombstone: bool = True) -> None:
+        """Release one pod's membership + placement.
+
+        ``tombstone=True`` (informer DELETE — the uid can never return)
+        additionally records the uid so replayed add-events are rejected;
+        a resync prune passes False because its list snapshot may simply be
+        stale about a live pod."""
         with self._lock:
             now = self._now()
             for key in list(self._groups):
                 g = self._groups[key]
-                if uid in g.members:
+                if tombstone and uid in g.members:
                     self._dropped[uid] = now
                 g.members.pop(uid, None)
                 g.placements.pop(uid, None)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ..util.types import PodDevices
@@ -22,6 +23,10 @@ class PodInfo:
     namespace: str
     node: str
     devices: PodDevices
+    # Monotonic time of the most recent add/refresh: a full-list resync
+    # must not prune a grant recorded AFTER its list snapshot was taken
+    # (the pod simply didn't exist yet in that stale list).
+    touched_at: float = dataclasses.field(default_factory=time.monotonic)
 
 
 class PodManager:

@@ -215,6 +215,35 @@ void record_buffer(PJRT_Buffer* buf, uint64_t bytes, int slot) {
   g_buffers[buf] = {bytes, slot};
 }
 
+bool memory_is_device_kind(PJRT_Memory* mem) {
+  if (!g_real->PJRT_Memory_Kind) return true;  // unknown: assume HBM
+  PJRT_Memory_Kind_Args ka;
+  memset(&ka, 0, sizeof(ka));
+  ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+  ka.memory = mem;
+  PJRT_Error* err = g_real->PJRT_Memory_Kind(&ka);
+  if (err) {
+    destroy_real_error(err);
+    return true;  // unknown: assume HBM (conservative)
+  }
+  std::string kind(ka.kind, ka.kind_size);
+  return kind.find("host") == std::string::npos;
+}
+
+int slot_for_memory(PJRT_Memory* mem) {
+  if (!mem || !g_real->PJRT_Memory_AddressableByDevices) return 0;
+  PJRT_Memory_AddressableByDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Memory_AddressableByDevices_Args_STRUCT_SIZE;
+  da.memory = mem;
+  PJRT_Error* err = g_real->PJRT_Memory_AddressableByDevices(&da);
+  if (err) {
+    destroy_real_error(err);
+    return 0;
+  }
+  return da.num_devices > 0 ? slot_of(da.devices[0]) : 0;
+}
+
 // ---------------------------------------------------------------------------
 // Interposed entry points
 // ---------------------------------------------------------------------------
@@ -262,9 +291,20 @@ PJRT_Error* Client_BufferFromHostBuffer(
     g.unlock();
     if (empty) map_client_devices(args->client);
   }
-  int slot = slot_of(args->device);
+  bool charge = true;
+  int slot = 0;
+  if (args->memory) {
+    // Memory-based placement (how jax targets non-default memories,
+    // including pinned_host — the oversubscription path): host-kind
+    // destinations consume no HBM; device-kind ones charge the slot of
+    // the memory's device, NOT slot 0.
+    charge = memory_is_device_kind(args->memory);
+    if (charge) slot = slot_for_memory(args->memory);
+  } else {
+    slot = slot_of(args->device);
+  }
   uint64_t bytes = logical_bytes(args->type, args->dims, args->num_dims);
-  int rc = vtpu_try_alloc(slot, bytes);
+  int rc = charge ? vtpu_try_alloc(slot, bytes) : -1;
   if (rc == -ENOMEM) return refuse_over_grant(slot, "alloc");
   PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
   if (err) {
@@ -290,21 +330,6 @@ PJRT_Error* Buffer_CopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
   return nullptr;
 }
 
-bool memory_is_device_kind(PJRT_Memory* mem) {
-  if (!g_real->PJRT_Memory_Kind) return true;  // unknown: assume HBM
-  PJRT_Memory_Kind_Args ka;
-  memset(&ka, 0, sizeof(ka));
-  ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
-  ka.memory = mem;
-  PJRT_Error* err = g_real->PJRT_Memory_Kind(&ka);
-  if (err) {
-    destroy_real_error(err);
-    return true;  // unknown: assume HBM (conservative)
-  }
-  std::string kind(ka.kind, ka.kind_size);
-  return kind.find("host") == std::string::npos;
-}
-
 PJRT_Error* Buffer_CopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
   if (!g_enforce) return g_real->PJRT_Buffer_CopyToMemory(args);
   // Copies into host-kind memory (pinned_host — the oversubscription path)
@@ -315,17 +340,7 @@ PJRT_Error* Buffer_CopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
   uint64_t bytes = 0;
   int rc = -1;
   if (device_kind) {
-    if (args->dst_memory && g_real->PJRT_Memory_AddressableByDevices) {
-      PJRT_Memory_AddressableByDevices_Args da;
-      memset(&da, 0, sizeof(da));
-      da.struct_size = PJRT_Memory_AddressableByDevices_Args_STRUCT_SIZE;
-      da.memory = args->dst_memory;
-      PJRT_Error* err = g_real->PJRT_Memory_AddressableByDevices(&da);
-      if (!err && da.num_devices > 0) slot = slot_of(da.devices[0]);
-      else if (err) {
-        destroy_real_error(err);
-      }
-    }
+    if (args->dst_memory) slot = slot_for_memory(args->dst_memory);
     bytes = real_buffer_size(args->buffer, 0);
     rc = bytes ? vtpu_try_alloc(slot, bytes) : -1;
     if (rc == -ENOMEM) return refuse_over_grant(slot, "copy");
@@ -415,14 +430,23 @@ void exec_slots(PJRT_LoadedExecutable_Execute_Args* args,
 // returns at enqueue time, so wall time around it measures ~nothing on a
 // real plugin.  True device-busy feedback needs the per-device completion
 // events: when the caller didn't request device_complete_events we request
-// them ourselves and feed back (completion − start) from the OnReady
-// callback.  The last callback frees the shared context.
+// them ourselves and feed back from the OnReady callback.  The last
+// callback frees the shared context.
+//
+// Busy-time model: (completion − enqueue) would include the queue wait of
+// earlier pipelined dispatches — the same N× cost inflation the Python
+// shim's drain-before-timing avoids — so the charge is the EXCLUSIVE busy
+// interval: completion − max(enqueue, previous completion on this slot).
+// For a serially-executing device queue that is exactly this dispatch's
+// device time.
 struct ExecTiming {
   uint64_t start_us;
   std::vector<int> slots;
   std::vector<PJRT_Event*> events;
   std::atomic<int> pending;
 };
+
+std::atomic<uint64_t> g_last_completion_us[VTPU_MAX_DEVICES];
 
 void on_exec_complete(PJRT_Error* error, void* user_arg) {
   auto* pair = static_cast<std::pair<ExecTiming*, size_t>*>(user_arg);
@@ -432,7 +456,10 @@ void on_exec_complete(PJRT_Error* error, void* user_arg) {
     destroy_real_error(error);
   } else {
     int slot = i < t->slots.size() ? t->slots[i] : 0;
-    vtpu_rate_feedback(slot, now_us() - t->start_us);
+    uint64_t now = now_us();
+    uint64_t prev = g_last_completion_us[slot].exchange(now);
+    uint64_t busy_from = t->start_us > prev ? t->start_us : prev;
+    vtpu_rate_feedback(slot, now > busy_from ? now - busy_from : 0);
   }
   PJRT_Event_Destroy_Args ed;
   memset(&ed, 0, sizeof(ed));
@@ -483,25 +510,30 @@ PJRT_Error* LoadedExecutable_Execute(
         timing = nullptr;
       } else {
         timing->pending.store(populated);
-        size_t n = timing->events.size();
-        for (size_t i = 0; i < n && timing; ++i) {
-          if (!timing->events[i]) continue;
+        // Iterate over a SNAPSHOT: an already-ready event may invoke the
+        // callback inline from OnReady, and if it is the last pending one
+        // it deletes `timing` while this loop is still walking trailing
+        // null slots — `timing` must not be dereferenced after the first
+        // registration.  (Each event decrements pending exactly once —
+        // via callback or via the registration-failure branch — so the
+        // context is alive whenever a decrement it owns hasn't fired.)
+        std::vector<PJRT_Event*> events = timing->events;
+        for (size_t i = 0; i < events.size(); ++i) {
+          if (!events[i]) continue;
           PJRT_Event_OnReady_Args oa;
           memset(&oa, 0, sizeof(oa));
           oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
-          oa.event = timing->events[i];
+          oa.event = events[i];
           oa.user_arg = new std::pair<ExecTiming*, size_t>(timing, i);
           oa.callback = on_exec_complete;
           PJRT_Error* oe = g_real->PJRT_Event_OnReady(&oa);
           if (oe) {
             destroy_real_error(oe);
             delete static_cast<std::pair<ExecTiming*, size_t>*>(oa.user_arg);
-            if (timing->pending.fetch_sub(1) == 1) {
-              delete timing;
-              timing = nullptr;  // ends the loop; callbacks all resolved
-            }
+            if (timing->pending.fetch_sub(1) == 1) delete timing;
           }
         }
+        timing = nullptr;  // ownership fully transferred to callbacks
       }
     }
   } else {

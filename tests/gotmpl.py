@@ -1,4 +1,5 @@
-"""Minimal Go-template renderer for Helm charts — enough of the language to
+"""Minimal Go-template renderer for Helm charts (TEST tooling: lives
+under tests/ so the production image ships no template interpreter) — enough of the language to
 render charts/vtpu for real (VERDICT r2 item 8: string-matching tests can't
 catch YAML/values breakage; this renders the actual manifests so tests can
 yaml-parse and assert on them without a helm binary, which offline CI lacks).

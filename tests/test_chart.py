@@ -136,7 +136,7 @@ class TestChartRenders:
 
     @pytest.fixture(scope="class")
     def rendered(self):
-        from k8s_vgpu_scheduler_tpu.util.gotmpl import render_chart
+        from tests.gotmpl import render_chart
 
         return render_chart(CHART)
 
@@ -173,7 +173,7 @@ class TestChartRenders:
         assert any(str(a).startswith("--scheduler-name=") for a in args)
 
     def test_value_overrides_change_output(self):
-        from k8s_vgpu_scheduler_tpu.util.gotmpl import render_chart
+        from tests.gotmpl import render_chart
 
         out = render_chart(CHART, values_override={
             "resourceName": "example.com/fraction-tpu",
@@ -185,7 +185,7 @@ class TestChartRenders:
         assert "--resource-name=google.com/tpu" not in all_text
 
     def test_disablecorelimit_flag_is_conditional(self):
-        from k8s_vgpu_scheduler_tpu.util.gotmpl import render_chart
+        from tests.gotmpl import render_chart
 
         base = "\n".join(render_chart(CHART).values())
         assert "--disable-core-limit" not in base
@@ -209,7 +209,7 @@ class TestChartRenders:
         assert any("vtpu" in p or "lib" in p for p in host_paths), host_paths
 
     def test_broken_template_fails_loudly(self):
-        from k8s_vgpu_scheduler_tpu.util.gotmpl import Engine, TemplateError
+        from tests.gotmpl import Engine, TemplateError
 
         with pytest.raises(TemplateError):
             Engine().render('{{ include "no.such.helper" . }}', {})
@@ -221,7 +221,7 @@ class TestGoTemplateEngine:
     """Pipeline edge cases the chart may grow into (pinned from review)."""
 
     def eng(self):
-        from k8s_vgpu_scheduler_tpu.util.gotmpl import Engine
+        from tests.gotmpl import Engine
 
         return Engine()
 

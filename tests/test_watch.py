@@ -192,3 +192,61 @@ class TestWatchLoopE2E:
                 fake.JOURNAL_LIMIT = old_limit
         finally:
             stop.set()
+
+
+class TestResyncRaceGuards:
+    """High-review findings: the periodic resync runs concurrently with the
+    watch/filter threads, so its stale list snapshot must never prune (or
+    tombstone) state recorded after the snapshot began."""
+
+    def _sched(self):
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        register_node(s, "node-a")
+        return kube, s
+
+    def test_prune_spares_grants_recorded_during_the_list(self):
+        kube, s = self._sched()
+
+        # The apiserver list is slow; while it runs, a filter thread
+        # grants pod P.  The returned (stale) list does not contain P.
+        real_list = kube.list_pods_with_rv
+
+        def slow_stale_list():
+            items, rv = real_list()
+            pod = tpu_pod(name="raced", uid="uraced")
+            kube.create_pod(pod)
+            r = s.filter(pod, ["node-a"])
+            assert r.node == "node-a"
+            return items, rv  # snapshot from BEFORE the filter
+
+        s.client = kube
+        kube.list_pods_with_rv = slow_stale_list
+        s.resync_from_apiserver()
+        assert s.pods.get("uraced") is not None, \
+            "resync pruned a grant recorded after its list snapshot"
+
+    def test_resync_prune_does_not_tombstone_live_gang_uids(self):
+        kube, s = self._sched()
+        from k8s_vgpu_scheduler_tpu.scheduler.gang import (
+            GANG_GROUP_ANNOTATION, GANG_TOTAL_ANNOTATION)
+
+        pod = tpu_pod(name="g0", uid="ug0")
+        pod["metadata"]["annotations"].update({
+            GANG_GROUP_ANNOTATION: "j", GANG_TOTAL_ANNOTATION: "2"})
+        kube.create_pod(pod)
+        r = s.filter(pod, ["node-a"])
+        assert "waiting" in r.error
+
+        # A resync with an empty stale list drops the member (old behavior)
+        # but must NOT tombstone it: the pod is alive and will re-filter.
+        import time as _t
+        _t.sleep(0.01)
+        kube.list_pods_with_rv = lambda: ([], "0")
+        s.resync_from_apiserver()
+
+        kube.list_pods_with_rv = FakeKube.list_pods_with_rv.__get__(kube)
+        r2 = s.filter(pod, ["node-a"])
+        assert "stale" not in (r2.error or ""), \
+            "resync prune tombstoned a live gang member"
+        assert "waiting" in r2.error
