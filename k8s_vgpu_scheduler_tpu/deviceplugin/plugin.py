@@ -307,6 +307,22 @@ class TpuDevicePlugin:
             resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
         if anns.get(OVERSUBSCRIBE_ANNOTATION, "") in ("true", "1"):
             resp.envs[ENV_OVERSUBSCRIBE] = "true"
+        # Multi-host gang wiring: surface the scheduler-assigned process
+        # rank + group size so parallel/multihost.py can call
+        # jax.distributed.initialize without any in-container discovery
+        # (the NCCL/MPI-launcher analog; ranks are stable across member
+        # replacement).  The coordinator address is user-provided (a
+        # headless-service DNS name) and passed through verbatim.
+        rank = anns.get("vtpu.dev/pod-group-rank", "")
+        if rank:
+            resp.envs["VTPU_GANG_RANK"] = rank
+            resp.envs["VTPU_GANG_SIZE"] = anns.get(
+                "vtpu.dev/pod-group-total", "")
+            resp.envs["VTPU_GANG_GROUP"] = anns.get(
+                "vtpu.dev/pod-group", "")
+            coord = anns.get("vtpu.dev/pod-group-coordinator", "")
+            if coord:
+                resp.envs["VTPU_GANG_COORDINATOR"] = coord
         attach_enforcement(resp, self.cfg, f"{pod_uid(pod)}_{pod_name(pod)}")
         return resp
 

@@ -1,0 +1,83 @@
+"""Multi-host process-group bootstrap from the gang-scheduling contract.
+
+The reference's multi-device story stops at single-host ring topology
+(cntopo, SURVEY C23/C24); multi-host SPMD is this framework's extension
+(BASELINE config #5).  The control plane already places a gang atomically
+and assigns each member a STABLE process rank
+(scheduler/gang.py Gang.ranks → ``vtpu.dev/pod-group-rank`` annotation →
+``VTPU_GANG_RANK`` env at Allocate); this module is the last hop — the
+in-container analog of an mpirun/NCCL launcher wiring
+``jax.distributed.initialize`` from that contract:
+
+    # pod spec: vtpu.dev/pod-group: llama7b, vtpu.dev/pod-group-total: "32",
+    #           vtpu.dev/pod-group-coordinator: llama7b-0.llama7b-svc:8476
+    from k8s_vgpu_scheduler_tpu.parallel import multihost
+    multihost.initialize_from_env()        # before any jax device use
+    mesh = make_mesh(...)                  # global devices now visible
+
+Ranks survive member replacement: a controller-recreated pod inherits the
+dead peer's rank (gang.py assign_ranks), so the restarted process rejoins
+the same slot in the collective.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+ENV_RANK = "VTPU_GANG_RANK"
+ENV_SIZE = "VTPU_GANG_SIZE"
+ENV_COORDINATOR = "VTPU_GANG_COORDINATOR"
+DEFAULT_PORT = 8476
+
+
+class GangEnvError(RuntimeError):
+    pass
+
+
+def gang_env() -> Optional[dict]:
+    """The gang contract from the container env, or None outside a gang."""
+    rank = os.environ.get(ENV_RANK, "")
+    if rank == "":
+        return None
+    size = os.environ.get(ENV_SIZE, "")
+    coord = os.environ.get(ENV_COORDINATOR, "")
+    if not size:
+        raise GangEnvError(f"{ENV_RANK} set but {ENV_SIZE} missing")
+    if not coord:
+        raise GangEnvError(
+            f"{ENV_RANK} set but {ENV_COORDINATOR} missing — set the "
+            "vtpu.dev/pod-group-coordinator annotation to the rank-0 "
+            "member's stable address (headless-service DNS)")
+    if ":" not in coord:
+        coord = f"{coord}:{DEFAULT_PORT}"
+    return {
+        "process_id": int(rank),
+        "num_processes": int(size),
+        "coordinator_address": coord,
+    }
+
+
+def initialize_from_env(timeout_s: Optional[int] = None) -> bool:
+    """``jax.distributed.initialize`` from the gang env.
+
+    Returns True when a multi-host group was initialized, False when the
+    pod is not a gang member (single-host: nothing to do — callers can
+    invoke unconditionally).  Must run before the first jax device use.
+    """
+    cfg = gang_env()
+    if cfg is None:
+        return False
+    import jax
+
+    kwargs = dict(cfg)
+    if timeout_s is not None:
+        kwargs["initialization_timeout"] = timeout_s
+    log.info(
+        "joining gang process group: rank %d/%d via %s",
+        cfg["process_id"], cfg["num_processes"], cfg["coordinator_address"])
+    jax.distributed.initialize(**kwargs)
+    return True

@@ -43,6 +43,7 @@ from ..util.types import (
 )
 from . import score as score_mod
 from .gang import (
+    GANG_RANK_ANNOTATION,
     GangConflictError,
     GangManager,
     GangMember,
@@ -219,17 +220,20 @@ class Scheduler:
         if result.node is None:
             return result
         encoded = codec.encode_pod_devices(self.pods.get(pod_uid(pod)).devices)
+        patch = {
+            ASSIGNED_NODE_ANNOTATION: result.node,
+            ASSIGNED_IDS_ANNOTATION: encoded,
+            TO_ALLOCATE_ANNOTATION: encoded,
+            ASSIGNED_TIME_ANNOTATION: str(int(time.time())),
+        }
+        rank = self.gangs.rank_of(pod_uid(pod))
+        if rank is not None:
+            # The member's jax.distributed process rank (stable across
+            # replacements) — surfaced to the container as VTPU_GANG_RANK.
+            patch[GANG_RANK_ANNOTATION] = str(rank)
         try:
             self.client.patch_pod_annotations(
-                pod_namespace(pod),
-                pod_name(pod),
-                {
-                    ASSIGNED_NODE_ANNOTATION: result.node,
-                    ASSIGNED_IDS_ANNOTATION: encoded,
-                    TO_ALLOCATE_ANNOTATION: encoded,
-                    ASSIGNED_TIME_ANNOTATION: str(int(time.time())),
-                },
-            )
+                pod_namespace(pod), pod_name(pod), patch)
         except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
             log.error("failed to write decision for %s: %s", pod_name(pod), e)
             self.pods.del_pod(pod_uid(pod))
@@ -351,6 +355,7 @@ class Scheduler:
                       f"{g.total} members"
             )
         g.placements.update(placements)
+        g.assign_ranks(placements)
         # Account EVERY member's grant now, so concurrent non-gang Filters
         # can't steal reserved capacity while the members' retries arrive.
         for member_uid, (node, devices) in placements.items():

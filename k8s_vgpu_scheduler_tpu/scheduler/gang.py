@@ -39,6 +39,10 @@ log = logging.getLogger(__name__)
 
 GANG_GROUP_ANNOTATION = "vtpu.dev/pod-group"
 GANG_TOTAL_ANNOTATION = "vtpu.dev/pod-group-total"
+# Written back by the scheduler at atomic admission: this member's process
+# rank in [0, total) — the device plugin exposes it as VTPU_GANG_RANK and
+# parallel/multihost.py feeds it to jax.distributed.initialize.
+GANG_RANK_ANNOTATION = "vtpu.dev/pod-group-rank"
 
 # A group whose members stop re-filtering (job deleted mid-admission) must
 # not hold tentative grants forever.
@@ -65,11 +69,25 @@ class Gang:
     placements: Dict[str, Tuple[str, list]] = dataclasses.field(
         default_factory=dict
     )
+    # uid -> process rank in [0, total): the jax.distributed process_id the
+    # device plugin exposes to the container (VTPU_GANG_RANK).  Assigned at
+    # admission; a replacement member inherits its dead peer's freed rank
+    # (surviving peers' ranks must never reshuffle — their processes hold
+    # them for the collective).
+    ranks: Dict[str, int] = dataclasses.field(default_factory=dict)
     last_seen: float = 0.0
 
     @property
     def admitted(self) -> bool:
         return bool(self.placements)
+
+    def assign_ranks(self, uids) -> None:
+        """Give each uid the lowest unused rank (deterministic: sorted)."""
+        used = set(self.ranks.values())
+        free = iter(r for r in range(self.total) if r not in used)
+        for uid in sorted(uids):
+            if uid not in self.ranks:
+                self.ranks[uid] = next(free)
 
 
 def gang_of(pod: dict) -> Optional[Tuple[str, int]]:
@@ -153,6 +171,14 @@ class GangManager:
             g.last_seen = self._now()
             return g
 
+    def rank_of(self, uid: str) -> Optional[int]:
+        """The uid's admitted process rank, or None when not a gang member."""
+        with self._lock:
+            for g in self._groups.values():
+                if uid in g.ranks:
+                    return g.ranks[uid]
+        return None
+
     def is_reserved(self, uid: str) -> bool:
         """True while an admitted-but-unconfirmed placement exists for the
         pod (its tentative grant must survive informer churn)."""
@@ -174,6 +200,7 @@ class GangManager:
                     self._dropped[uid] = now
                 g.members.pop(uid, None)
                 g.placements.pop(uid, None)
+                g.ranks.pop(uid, None)  # freed rank goes to the replacement
                 if not g.members:
                     self._groups.pop(key)
             # Bound the tombstone set: informer replay windows are far

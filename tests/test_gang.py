@@ -364,3 +364,57 @@ class TestGangAdmission:
         kube.create_pod(p)
         r = s.filter(p, NODES)
         assert r.node in NODES
+
+
+class TestGangRanks:
+    """Multi-host process ranks: assigned at atomic admission, written to
+    the pod annotation, STABLE across member replacement (a restarted
+    process must rejoin its slot in the collective)."""
+
+    def test_ranks_assigned_and_written_through(self, env):
+        kube, s = env
+        pods = [gang_pod(f"rk{i}", f"rku{i}", group="jobrk", total=3)
+                for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods:
+            s.filter(p, NODES)
+        for p in pods:  # retry pass: reservations collected + patched
+            s.filter(p, NODES)
+        ranks = set()
+        for p in pods:
+            anns = kube.get_pod("default", p["metadata"]["name"])[
+                "metadata"]["annotations"]
+            ranks.add(int(anns["vtpu.dev/pod-group-rank"]))
+        assert ranks == {0, 1, 2}
+
+    def test_replacement_inherits_freed_rank(self, env):
+        kube, s = env
+        pods = [gang_pod(f"rr{i}", f"rru{i}", group="jobrr", total=2)
+                for i in range(2)]
+        for p in pods:
+            kube.create_pod(p)
+        for p in pods:
+            s.filter(p, NODES)
+        for p in pods:  # retry pass: reservations collected + patched
+            s.filter(p, NODES)
+        rank_of = {}
+        for p in pods:
+            anns = kube.get_pod("default", p["metadata"]["name"])[
+                "metadata"]["annotations"]
+            rank_of[p["metadata"]["uid"]] = int(
+                anns["vtpu.dev/pod-group-rank"])
+        dead_uid = "rru0"
+        dead_rank = rank_of[dead_uid]
+        survivor_rank = rank_of["rru1"]
+
+        kube.delete_pod("default", "rr0")
+        repl = gang_pod("rr0-new", "rru9", group="jobrr", total=2)
+        kube.create_pod(repl)
+        r = s.filter(repl, NODES)
+        assert r.node in NODES, r.error
+        anns = kube.get_pod("default", "rr0-new")["metadata"]["annotations"]
+        assert int(anns["vtpu.dev/pod-group-rank"]) == dead_rank
+        # Survivor untouched.
+        anns1 = kube.get_pod("default", "rr1")["metadata"]["annotations"]
+        assert int(anns1["vtpu.dev/pod-group-rank"]) == survivor_rank

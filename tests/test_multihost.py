@@ -1,0 +1,92 @@
+"""Multi-host bootstrap: gang rank -> Allocate env -> jax.distributed
+wiring (parallel/multihost.py) — the mpirun/NCCL-launcher analog."""
+
+import os
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.parallel import multihost
+
+
+class TestGangEnv:
+    def _env(self, monkeypatch, **kv):
+        for k in (multihost.ENV_RANK, multihost.ENV_SIZE,
+                  multihost.ENV_COORDINATOR):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in kv.items():
+            monkeypatch.setenv(k, v)
+
+    def test_not_a_gang_member(self, monkeypatch):
+        self._env(monkeypatch)
+        assert multihost.gang_env() is None
+        assert multihost.initialize_from_env() is False
+
+    def test_full_contract(self, monkeypatch):
+        self._env(monkeypatch, VTPU_GANG_RANK="3", VTPU_GANG_SIZE="32",
+                  VTPU_GANG_COORDINATOR="llama7b-0.llama7b-svc")
+        cfg = multihost.gang_env()
+        assert cfg == {
+            "process_id": 3,
+            "num_processes": 32,
+            # default port appended when the user gave only a host
+            "coordinator_address": "llama7b-0.llama7b-svc:8476",
+        }
+
+    def test_explicit_port_kept(self, monkeypatch):
+        self._env(monkeypatch, VTPU_GANG_RANK="0", VTPU_GANG_SIZE="2",
+                  VTPU_GANG_COORDINATOR="10.0.0.5:9999")
+        assert multihost.gang_env()["coordinator_address"] == "10.0.0.5:9999"
+
+    def test_missing_coordinator_is_loud(self, monkeypatch):
+        self._env(monkeypatch, VTPU_GANG_RANK="0", VTPU_GANG_SIZE="2")
+        with pytest.raises(multihost.GangEnvError):
+            multihost.gang_env()
+
+    def test_initialize_wires_jax_distributed(self, monkeypatch):
+        self._env(monkeypatch, VTPU_GANG_RANK="1", VTPU_GANG_SIZE="4",
+                  VTPU_GANG_COORDINATOR="coord:8476")
+        calls = []
+        import jax
+
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.append(kw))
+        assert multihost.initialize_from_env(timeout_s=30) is True
+        assert calls == [{
+            "process_id": 1, "num_processes": 4,
+            "coordinator_address": "coord:8476",
+            "initialization_timeout": 30,
+        }]
+
+
+class TestAllocateInjectsGangEnv:
+    def test_rank_env_travels_from_annotations(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from test_deviceplugin import make_cfg, V5E_FIXTURE
+        from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+        from k8s_vgpu_scheduler_tpu.tpulib.backend import MockBackend
+        from k8s_vgpu_scheduler_tpu.deviceplugin.plugin import TpuDevicePlugin
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+        inv = MockBackend(dict(V5E_FIXTURE)).inventory()
+        plugin = TpuDevicePlugin(FakeKube(), inv, make_cfg(tmp_path),
+                                 socket_dir=str(tmp_path))
+        chip = inv.chips[0]
+        pod = {
+            "metadata": {"name": "m0", "namespace": "default", "uid": "u0",
+                         "annotations": {
+                             "vtpu.dev/pod-group": "llama7b",
+                             "vtpu.dev/pod-group-total": "32",
+                             "vtpu.dev/pod-group-rank": "7",
+                             "vtpu.dev/pod-group-coordinator":
+                                 "llama7b-0.svc:8476",
+                         }},
+            "spec": {"containers": [{"name": "main"}]},
+        }
+        grant = [ContainerDevice(uuid=chip.uuid, type="TPU-v5e",
+                                 usedmem=1000, usedcores=100)]
+        resp = plugin.build_container_response(pod, grant)
+        assert resp.envs["VTPU_GANG_RANK"] == "7"
+        assert resp.envs["VTPU_GANG_SIZE"] == "32"
+        assert resp.envs["VTPU_GANG_GROUP"] == "llama7b"
+        assert resp.envs["VTPU_GANG_COORDINATOR"] == "llama7b-0.svc:8476"
