@@ -1,0 +1,112 @@
+"""Control-plane churn stress: random create/filter/bind/delete interleaving
+with the capacity invariant checked after every step.
+
+The reference has nothing like this (its scheduler core is untested,
+SURVEY.md §4); the invariant under test is the one that matters for a
+fractional-accelerator scheduler — the sum of granted HBM on a chip NEVER
+exceeds its advertised capacity, through any event ordering, including
+deletions racing re-filters and gangs interleaving with singles."""
+
+import random
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.gang import (
+    GANG_GROUP_ANNOTATION,
+    GANG_TOTAL_ANNOTATION,
+)
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+from tests.test_scheduler_core import register_node, tpu_pod
+
+NODES = ["node-a", "node-b"]
+CHIP_MIB = 16384
+CHIPS_PER_NODE = 4
+
+
+@pytest.fixture
+def env():
+    kube = FakeKube()
+    s = Scheduler(kube, Config())
+    for n in NODES:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        register_node(s, n, chips=CHIPS_PER_NODE, devmem=CHIP_MIB)
+    kube.watch_pods(s.on_pod_event)
+    return kube, s
+
+
+def granted_per_chip(s):
+    """chip id -> total granted MiB across all tracked pods."""
+    out = {}
+    for info in s.pods.list_pods():
+        for container in info.devices:
+            for dev in container:
+                out[dev.uuid] = out.get(dev.uuid, 0) + dev.usedmem
+    return out
+
+
+def assert_capacity_invariant(s, when: str):
+    for chip, granted in granted_per_chip(s).items():
+        assert granted <= CHIP_MIB, (
+            f"{when}: chip {chip} over-booked: {granted} > {CHIP_MIB} MiB")
+
+
+class TestChurn:
+    def test_500_random_ops_never_overbook(self, env):
+        kube, s = env
+        rng = random.Random(0xC0FFEE)
+        live = {}     # name -> pod dict
+        counter = 0
+
+        for step in range(500):
+            op = rng.random()
+            if op < 0.45 or not live:
+                # create + filter (maybe a gang member)
+                counter += 1
+                name, uid = f"p{counter}", f"u{counter}"
+                mem = rng.choice(["1000", "3000", "8000", "16384"])
+                nums = rng.choice(["1", "1", "2", "4"])
+                pod = tpu_pod(name=name, uid=uid, mem=mem, nums=nums)
+                if rng.random() < 0.2:
+                    pod["metadata"]["annotations"].update({
+                        GANG_GROUP_ANNOTATION: f"g{counter % 5}",
+                        GANG_TOTAL_ANNOTATION: "2",
+                    })
+                kube.create_pod(pod)
+                live[name] = pod
+                s.filter(pod, NODES)
+            elif op < 0.65:
+                # re-filter an existing pod (kube-scheduler retry)
+                name = rng.choice(sorted(live))
+                s.filter(live[name], NODES)
+            elif op < 0.85:
+                # bind a placed pod, then complete the handshake the way
+                # the device plugin's Allocate would (phase + lock release)
+                from k8s_vgpu_scheduler_tpu.util.nodelock import release_node
+
+                name = rng.choice(sorted(live))
+                pod = live[name]
+                anns = kube.get_pod("default", name)["metadata"]["annotations"]
+                node = anns.get("vtpu.dev/assigned-node", "")
+                if node:
+                    err = s.bind("default", name, pod["metadata"]["uid"], node)
+                    if err is None:
+                        release_node(kube, node)
+            else:
+                # delete
+                name = rng.choice(sorted(live))
+                kube.delete_pod("default", name)
+                del live[name]
+            assert_capacity_invariant(s, f"step {step}")
+
+        # Steady state: resync must agree with the event-driven state.
+        s.resync_from_apiserver()
+        assert_capacity_invariant(s, "after final resync")
+        tracked = {i.uid for i in s.pods.list_pods()}
+        live_uids = {p["metadata"]["uid"] for p in live.values()}
+        # Tracked grants may be a subset (waiting gang members have none),
+        # but nothing deleted may linger.
+        assert tracked <= live_uids | {
+            u for u in tracked if s.gangs.is_reserved(u)}
