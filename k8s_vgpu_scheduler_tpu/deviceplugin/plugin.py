@@ -48,6 +48,12 @@ from ..api.kubelet import (
 from .allocator import SliceAllocator
 from ..k8s.client import KubeClient, pod_name, pod_uid
 from ..tpulib.types import NodeInventory
+from ..scheduler.gang import (
+    GANG_COORDINATOR_ANNOTATION,
+    GANG_GROUP_ANNOTATION,
+    GANG_RANK_ANNOTATION,
+    GANG_TOTAL_ANNOTATION,
+)
 from ..util import protocol
 from ..util.enforcement import check_shim_install
 from ..util.config import Config
@@ -313,14 +319,12 @@ class TpuDevicePlugin:
         # (the NCCL/MPI-launcher analog; ranks are stable across member
         # replacement).  The coordinator address is user-provided (a
         # headless-service DNS name) and passed through verbatim.
-        rank = anns.get("vtpu.dev/pod-group-rank", "")
+        rank = anns.get(GANG_RANK_ANNOTATION, "")
         if rank:
             resp.envs["VTPU_GANG_RANK"] = rank
-            resp.envs["VTPU_GANG_SIZE"] = anns.get(
-                "vtpu.dev/pod-group-total", "")
-            resp.envs["VTPU_GANG_GROUP"] = anns.get(
-                "vtpu.dev/pod-group", "")
-            coord = anns.get("vtpu.dev/pod-group-coordinator", "")
+            resp.envs["VTPU_GANG_SIZE"] = anns.get(GANG_TOTAL_ANNOTATION, "")
+            resp.envs["VTPU_GANG_GROUP"] = anns.get(GANG_GROUP_ANNOTATION, "")
+            coord = anns.get(GANG_COORDINATOR_ANNOTATION, "")
             if coord:
                 resp.envs["VTPU_GANG_COORDINATOR"] = coord
         attach_enforcement(resp, self.cfg, f"{pod_uid(pod)}_{pod_name(pod)}")

@@ -43,6 +43,9 @@ GANG_TOTAL_ANNOTATION = "vtpu.dev/pod-group-total"
 # rank in [0, total) — the device plugin exposes it as VTPU_GANG_RANK and
 # parallel/multihost.py feeds it to jax.distributed.initialize.
 GANG_RANK_ANNOTATION = "vtpu.dev/pod-group-rank"
+# User-set: the rank-0 member's stable address (headless-service DNS),
+# passed through to the container as VTPU_GANG_COORDINATOR.
+GANG_COORDINATOR_ANNOTATION = "vtpu.dev/pod-group-coordinator"
 
 # A group whose members stop re-filtering (job deleted mid-admission) must
 # not hold tentative grants forever.
@@ -82,12 +85,48 @@ class Gang:
         return bool(self.placements)
 
     def assign_ranks(self, uids) -> None:
-        """Give each uid the lowest unused rank (deterministic: sorted)."""
+        """Assign process ranks.
+
+        Rank 0 must be the pod the user's ``pod-group-coordinator`` DNS
+        points at, so members named with a trailing ordinal (indexed Jobs /
+        StatefulSets: ``job-0``, ``job-1`` …) get rank = ordinal.  Members
+        without usable ordinals take the lowest unused rank in NAME order
+        (names are stable and user-visible; uids are random).  Never
+        raises: a member beyond ``total`` (misconfigured controller) is
+        left unranked rather than crashing Filter."""
+        import re
+
         used = set(self.ranks.values())
-        free = iter(r for r in range(self.total) if r not in used)
-        for uid in sorted(uids):
-            if uid not in self.ranks:
-                self.ranks[uid] = next(free)
+        pending = [u for u in uids if u not in self.ranks]
+
+        def ordinal(uid: str):
+            m = re.search(r"-(\d+)$", self.members[uid].name) \
+                if uid in self.members else None
+            return int(m.group(1)) if m else None
+
+        by_ordinal = {u: ordinal(u) for u in pending}
+        # First pass: honor valid, distinct, unused ordinals.
+        taken = set(used)
+        for u in sorted(pending, key=lambda u: self.members[u].name
+                        if u in self.members else u):
+            o = by_ordinal[u]
+            if o is not None and 0 <= o < self.total and o not in taken:
+                self.ranks[u] = o
+                taken.add(o)
+        # Second pass: everyone else gets the lowest unused rank.
+        free = iter(r for r in range(self.total) if r not in taken)
+        for u in sorted(pending, key=lambda u: self.members[u].name
+                        if u in self.members else u):
+            if u in self.ranks:
+                continue
+            r = next(free, None)
+            if r is None:
+                log.warning("gang %s: no free rank for member %s "
+                            "(more members than total=%d)", self.key, u,
+                            self.total)
+                continue
+            self.ranks[u] = r
+            taken.add(r)
 
 
 def gang_of(pod: dict) -> Optional[Tuple[str, int]]:
@@ -164,6 +203,17 @@ class GangManager:
                         "admitted group (total=%d)", key, total, g.total)
             elif g is not None and g.total != total:
                 g = None
+            if g is not None and not g.placements \
+                    and member.uid not in g.members \
+                    and len(g.members) >= g.total:
+                # Pre-admission overflow (controller parallelism exceeds
+                # pod-group-total): letting it in would give the gang more
+                # members than ranks/placements.  Reject like a late member;
+                # if an existing member dies, kube-scheduler's retry of
+                # this pod joins the freed slot.
+                raise GangConflictError(
+                    f"gang {key}: already has {g.total} pending members; "
+                    f"extra member {member.name} rejected")
             if g is None:
                 g = Gang(key=key, total=total)
                 self._groups[key] = g

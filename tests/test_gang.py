@@ -418,3 +418,39 @@ class TestGangRanks:
         # Survivor untouched.
         anns1 = kube.get_pod("default", "rr1")["metadata"]["annotations"]
         assert int(anns1["vtpu.dev/pod-group-rank"]) == survivor_rank
+
+    def test_rank_zero_follows_pod_name_ordinal_not_uid(self, env):
+        # The coordinator annotation points at the ordinal-0 pod; rank 0
+        # must land there even when uids sort in the OPPOSITE order.
+        kube, s = env
+        pods = []
+        for i in range(3):
+            # uid "zz-..." for job-0, "aa-..." for job-2: uid order inverts
+            # name order.
+            uid = f"{'zyx'[i]}{'zyx'[i]}-uid-{i}"
+            p = gang_pod(f"job-{i}", uid, group="jobord", total=3)
+            kube.create_pod(p)
+            pods.append(p)
+        for p in pods:
+            s.filter(p, NODES)
+        for p in pods:
+            s.filter(p, NODES)
+        for i, p in enumerate(pods):
+            anns = kube.get_pod("default", p["metadata"]["name"])[
+                "metadata"]["annotations"]
+            assert int(anns["vtpu.dev/pod-group-rank"]) == i, \
+                f"job-{i} got rank {anns['vtpu.dev/pod-group-rank']}"
+
+    def test_pre_admission_overflow_member_rejected(self, env):
+        # Controller parallelism > pod-group-total: the extra pending member
+        # must be refused, not crash admission (rank exhaustion).
+        kube, s = env
+        pods = [gang_pod(f"o{i}", f"ou{i}", group="jobo", total=2)
+                for i in range(3)]
+        for p in pods:
+            kube.create_pod(p)
+        s.filter(pods[0], NODES)
+        r1 = s.filter(pods[1], NODES)  # admission at quorum 2
+        r2 = s.filter(pods[2], NODES)
+        assert r1.node in NODES
+        assert r2.node is None and "rejected" in r2.error
