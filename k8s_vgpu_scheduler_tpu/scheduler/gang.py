@@ -100,9 +100,17 @@ class Gang:
         pending = [u for u in uids if u not in self.ranks]
 
         def ordinal(uid: str):
-            m = re.search(r"-(\d+)$", self.members[uid].name) \
-                if uid in self.members else None
-            return int(m.group(1)) if m else None
+            m = self.members.get(uid)
+            if m is None:
+                return None
+            # Authoritative for indexed Jobs (their pod NAMES end in a
+            # random suffix): the completion-index annotation.
+            idx = m.annotations.get("batch.kubernetes.io/job-completion-index")
+            if idx is not None and idx.isdigit():
+                return int(idx)
+            # StatefulSet-style exact trailing ordinal.
+            match = re.search(r"-(\d+)$", m.name)
+            return int(match.group(1)) if match else None
 
         by_ordinal = {u: ordinal(u) for u in pending}
         # First pass: honor valid, distinct, unused ordinals.

@@ -454,3 +454,24 @@ class TestGangRanks:
         r2 = s.filter(pods[2], NODES)
         assert r1.node in NODES
         assert r2.node is None and "rejected" in r2.error
+
+    def test_rank_prefers_job_completion_index_annotation(self, env):
+        # Indexed-Job pods are named job-N-<random>; the completion-index
+        # annotation is authoritative when a random suffix would mislead.
+        kube, s = env
+        pods = []
+        for i in range(2):
+            p = gang_pod(f"ij-{i}-x7{9 - i}", f"iju{i}", group="jobij",
+                         total=2)
+            p["metadata"]["annotations"][
+                "batch.kubernetes.io/job-completion-index"] = str(i)
+            kube.create_pod(p)
+            pods.append(p)
+        for p in pods:
+            s.filter(p, NODES)
+        for p in pods:
+            s.filter(p, NODES)
+        for i, p in enumerate(pods):
+            anns = kube.get_pod("default", p["metadata"]["name"])[
+                "metadata"]["annotations"]
+            assert int(anns["vtpu.dev/pod-group-rank"]) == i
