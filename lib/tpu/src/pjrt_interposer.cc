@@ -647,3 +647,10 @@ extern "C" const PJRT_Api* GetPjrtApi(void) {
   });
   return ok ? &g_api : nullptr;
 }
+
+// Clear this process's proc slot (and its charges) at exit — the region
+// outlives the process, and a leaked slot would keep counting against the
+// container's grant until the monitor GCs dead pids.
+__attribute__((destructor)) static void vtpu_interposer_fini(void) {
+  if (g_enforce) vtpu_shutdown();
+}
