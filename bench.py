@@ -28,6 +28,9 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from benchmarks.procutil import run_no_kill  # noqa: E402 — needs REPO path
 
 # Total wall budget for everything (driver kills at 600s; stay well under).
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "420"))
@@ -108,6 +111,10 @@ def diag(msg: str) -> None:
 
 
 _DIAG_FRESH = True
+# Set when a worker overran its timeout: it is left RUNNING (see
+# procutil.run_no_kill) and may hold the pool session, so no further
+# native-platform cases are attempted this run.
+_WORKER_OVERRAN = False
 
 
 def build_native() -> None:
@@ -153,33 +160,33 @@ def probe_backend(env: dict, platform: str, timeout: float) -> bool:
     penv = dict(env)
     if platform == "cpu":
         penv["JAX_PLATFORMS"] = "cpu"
-    try:
-        r = subprocess.run([sys.executable, "-c", code], env=penv,
-                           capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired as te:
-        log(f"probe[{platform}]: timed out after {timeout:.0f}s")
-        diag(f"probe[{platform}] TIMEOUT after {timeout:.0f}s; partial "
-             f"stderr:\n{(te.stderr or b'')!r}\npartial stdout:\n"
-             f"{(te.output or b'')!r}")
+    rc, p_out, p_err = run_no_kill([sys.executable, "-c", code], penv,
+                                   timeout)
+    if rc is None:
+        log(f"probe[{platform}]: still running after {timeout:.0f}s; "
+            "left to finish detached (never kill a pool claim)")
+        diag(f"probe[{platform}] OVERRAN {timeout:.0f}s (left running); "
+             f"partial stderr:\n{p_err}\npartial stdout:\n{p_out}")
         return False
-    ok = r.returncode == 0 and "PROBE_OK" in r.stdout
+
+    ok = rc == 0 and "PROBE_OK" in p_out
     if not ok:
-        diag(f"probe[{platform}] rc={r.returncode}\nstderr:\n{r.stderr}\n"
-             f"stdout:\n{r.stdout}")
+        diag(f"probe[{platform}] rc={rc}\nstderr:\n{p_err}\n"
+             f"stdout:\n{p_out}")
     if ok and platform == "native":
         # jax silently falls back to CPU when no accelerator plugin loads;
         # a "native" probe that landed on CPU must NOT pass, or the
         # full-size cases would run un-degraded on CPU and eat the budget.
-        marker = [ln for ln in r.stdout.splitlines() if "PROBE_OK" in ln]
+        marker = [ln for ln in p_out.splitlines() if "PROBE_OK" in ln]
         probed = marker[-1].split()[-1] if marker else "?"
         if probed == "cpu":
             log("probe[native]: backend is CPU fallback, rejecting")
             ok = False
     if not ok:
-        tail = (r.stderr or r.stdout).strip().splitlines()[-3:]
-        log(f"probe[{platform}]: rc={r.returncode} " + " | ".join(tail))
+        tail = (p_err or p_out).strip().splitlines()[-3:]
+        log(f"probe[{platform}]: rc={rc} " + " | ".join(tail))
     else:
-        log(f"probe[{platform}]: {r.stdout.strip()}")
+        log(f"probe[{platform}]: {p_out.strip()}")
     return ok
 
 
@@ -201,17 +208,21 @@ def collect_worker(name: str, argv: list, env: dict, out: str,
                    timeout: float, fallback: dict):
     """Spawn a worker, persist diagnostics on failure, read its JSON result
     or return ``fallback`` — never raises."""
-    try:
-        r = subprocess.run(argv, env=env, timeout=timeout,
-                           capture_output=True, text=True)
-        if r.returncode != 0:
-            tail = (r.stderr or "").strip().splitlines()[-4:]
-            log(f"case {name}: worker rc={r.returncode}: " + " | ".join(tail))
-            diag(f"case {name} worker rc={r.returncode}\nstderr:\n{r.stderr}")
-    except subprocess.TimeoutExpired as te:
-        log(f"case {name}: worker timed out after {timeout:.0f}s")
-        diag(f"case {name} worker TIMEOUT after {timeout:.0f}s; partial "
-             f"stderr:\n{(te.stderr or b'')!r}")
+    global _WORKER_OVERRAN
+    rc, w_out, w_err = run_no_kill(argv, env, timeout)
+    if rc is None:
+        # Killing it would leave a stale pool lease that wedges every later
+        # session (DIAG_r03.txt); instead it runs on detached and may still
+        # hold the session — stop spawning native cases into that.
+        _WORKER_OVERRAN = True
+        log(f"case {name}: worker overran {timeout:.0f}s; left to finish "
+            "detached (never kill a pool claim)")
+        diag(f"case {name} worker OVERRAN {timeout:.0f}s (left running); "
+             f"partial stderr:\n{w_err}")
+    elif rc != 0:
+        tail = (w_err or "").strip().splitlines()[-4:]
+        log(f"case {name}: worker rc={rc}: " + " | ".join(tail))
+        diag(f"case {name} worker rc={rc}\nstderr:\n{w_err}")
     if os.path.exists(out):
         try:
             with open(out) as f:
@@ -258,6 +269,37 @@ def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
     return result
 
 
+def _onchip(r: dict) -> bool:
+    return bool(r.get("platform") not in (None, "cpu")
+                and not r.get("error") and r.get("value"))
+
+
+def _rank(r: dict) -> int:
+    """Evidence quality: on-chip measurement > any measurement > error."""
+    if _onchip(r):
+        return 2
+    if r.get("value") and not r.get("error"):
+        return 1
+    return 0
+
+
+def merge_matrix(prior: list, new: list):
+    """Per-metric merge of a run's results into the existing matrix.  A new
+    entry replaces the prior one only when its evidence rank is at least
+    the prior's (so a failed or degraded rerun can never destroy a real
+    measurement; equal rank → latest wins).  Displaced new entries are
+    returned as ``lost`` for the transparency side file."""
+    merged = {r.get("metric"): r for r in prior if r.get("metric")}
+    lost = []
+    for r in new:
+        old = merged.get(r.get("metric"))
+        if old is None or _rank(r) >= _rank(old):
+            merged[r.get("metric")] = r
+        else:
+            lost.append(r)
+    return merged, lost
+
+
 def main() -> None:
     emitted = {"metric": PRIMARY, "value": 0.0, "unit": "images/s",
                "vs_baseline": 0.0, "error": "did not run"}
@@ -277,12 +319,20 @@ def main() -> None:
             for name in CASES:
                 if name == PRIMARY or degraded:
                     continue
+                if _WORKER_OVERRAN:
+                    log(f"skipping {name}: an earlier worker overran and "
+                        "still runs detached; it may hold the pool session "
+                        "(DIAG_r03.txt)")
+                    continue
                 if remaining() < 100:
                     log(f"skipping {name}: only {remaining():.0f}s left")
                     continue
-                timeout = max(60.0, min(remaining() - 30, 180.0))
+                # Train cases compile the full backward pass — remote
+                # compile alone can exceed an inference case's budget.
+                floor = 300.0 if CASES[name]["train"] else 180.0
+                timeout = max(60.0, min(remaining() - 30, floor))
                 matrix.append(run_case(name, env, tmpdir, degraded, timeout))
-            if not degraded and remaining() > 120:
+            if not degraded and remaining() > 120 and not _WORKER_OVERRAN:
                 matrix.append(run_flash_case(env, tmpdir,
                                              min(remaining() - 30, 180.0)))
     except Exception as e:  # noqa: BLE001 — emission must survive anything
@@ -290,12 +340,13 @@ def main() -> None:
             emitted["error"] = f"harness: {e!r}"
         log(f"harness exception: {e!r}")
     finally:
-        # Never clobber on-chip evidence with a strictly-worse run: when
-        # every new result is degraded but an existing bench_matrix.json
-        # holds platform=tpu results (e.g. the backend wedged later in the
-        # round — see DIAG_r03.txt), the degraded matrix goes to a side
-        # file and the primary emission references the prior on-chip
-        # number explicitly.
+        # Never lose on-chip evidence to a strictly-worse run: merge the
+        # new results into bench_matrix.json PER METRIC.  A new entry
+        # replaces the prior one only when it is on-chip itself or the
+        # prior one wasn't (a degraded/failed rerun cannot clobber a
+        # measured TPU number — the backend wedging mid-round is normal,
+        # see DIAG_r03.txt).  Losing entries go to a side file for
+        # transparency.
         matrix_path = os.path.join(REPO, "bench_matrix.json")
         prior = []
         try:
@@ -303,29 +354,24 @@ def main() -> None:
                 prior = json.load(f)
         except (OSError, json.JSONDecodeError):
             prior = []
-        new_has_tpu = any(r.get("platform") not in (None, "cpu")
-                          for r in matrix)
-        prior_tpu = [r for r in prior if r.get("platform")
-                     not in (None, "cpu")]
+
+        merged, lost = merge_matrix(prior, matrix)
         try:
-            if new_has_tpu or not prior_tpu:
-                with open(matrix_path, "w") as f:
-                    json.dump(matrix, f, indent=1)
-            else:
+            with open(matrix_path, "w") as f:
+                json.dump(list(merged.values()), f, indent=1)
+            if lost:
                 with open(os.path.join(REPO, "bench_matrix_degraded.json"),
                           "w") as f:
-                    json.dump(matrix, f, indent=1)
-                primary_prior = next(
-                    (r for r in prior_tpu if r.get("metric") == PRIMARY),
-                    None)
-                if primary_prior and emitted.get("platform") != "tpu":
-                    emitted["prior_onchip_result"] = primary_prior
-                    emitted["note"] = (
-                        "backend unavailable at run time; "
-                        "prior_onchip_result is this round's earlier "
-                        "measured on-chip number (bench_matrix.json)")
+                    json.dump(lost, f, indent=1)
         except OSError:
             pass
+        primary_best = merged.get(PRIMARY)
+        if (primary_best is not None and _onchip(primary_best)
+                and emitted.get("platform") != "tpu"):
+            emitted["prior_onchip_result"] = primary_best
+            emitted["note"] = (
+                "backend unavailable at run time; prior_onchip_result is "
+                "the best measured on-chip number (bench_matrix.json)")
         # In-cluster Jobs have no way to fetch bench_matrix.json after the
         # pod terminates; BENCH_EMIT_MATRIX=1 streams every case to stdout
         # (one JSON line each) BEFORE the driver-contract primary line.

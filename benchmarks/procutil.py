@@ -1,0 +1,57 @@
+"""Subprocess helper shared by the bench and scenario harnesses.
+
+Kept free of jax and of any repo package import: bench.py's contract is
+that the parent harness process never touches a device backend, and both
+harnesses must keep working when the package itself is mid-refactor.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Tuple
+
+
+def run_no_kill(argv: List[str], env: dict,
+                timeout: float) -> Tuple[Optional[int], str, str]:
+    """Run a child with a timeout but WITHOUT killing it on overrun.
+
+    Returns (rc, stdout, stderr); rc is None when the child is still
+    running at the deadline.  On the tunneled TPU pool, SIGKILLing a jax
+    client mid-claim leaves a stale server-side lease that wedges every
+    later session for the rest of the round (DIAG_r03.txt) — whereas an
+    overrunning child's work is finite: left alone it completes, releases
+    the claim cleanly, and merely wastes one orphan process.  Output goes
+    via temp files (a PIPE would SIGPIPE the orphan once the parent
+    exits); children get their own session so a harness-level kill of the
+    parent's process group doesn't reach them either.
+    """
+    out_f = tempfile.NamedTemporaryFile(mode="w+", delete=False,
+                                        suffix=".out")
+    err_f = tempfile.NamedTemporaryFile(mode="w+", delete=False,
+                                        suffix=".err")
+    p = subprocess.Popen(argv, env=env, stdout=out_f, stderr=err_f,
+                         text=True, start_new_session=True)
+    rc = None
+    try:
+        rc = p.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        pass
+    out_f.close()
+    err_f.close()
+    try:
+        with open(out_f.name) as f:
+            out = f.read()
+        with open(err_f.name) as f:
+            err = f.read()
+    except OSError:
+        out, err = "", ""
+    # Unlinking is safe while the child runs: its fds keep the inodes
+    # alive and the kernel reclaims them at its exit.
+    for pth in (out_f.name, err_f.name):
+        try:
+            os.unlink(pth)
+        except OSError:
+            pass
+    return rc, out, err

@@ -134,6 +134,14 @@ class Shim:
         # execution, not just the (async) dispatch — the device-time signal
         # the duty-cycle accounting needs.
         self._sync_every = max(1, int(os.environ.get("VTPU_SYNC_EVERY", "16")))
+        # Tunneled PJRT proxies (dev pools) can return from
+        # block_until_ready before the device finishes, silently gutting the
+        # synced cost sample.  VTPU_SYNC_FETCH=1 hardens sync turns with a
+        # D2H copy of a small output leaf — data cannot be fetched before it
+        # exists, so the sample is honest even there.  Off by default: real
+        # chips have a truthful block_until_ready and the copy is pure
+        # overhead.
+        self._sync_fetch = os.environ.get("VTPU_SYNC_FETCH") == "1"
         self._dispatch_n = 0
         # Weakref to the most recent gated dispatch's output, held only so a
         # synced sample can DRAIN the device queue before timing (see
@@ -165,6 +173,24 @@ class Shim:
                                     track_devices=False)
 
         return gated
+
+    @staticmethod
+    def _fetch_small(leaves, cap_bytes: int = 65536) -> None:
+        """Force true device completion via a D2H copy of the smallest
+        output leaf.  Skipped when every leaf is large — the copy itself
+        would then distort the timed sample; such dispatches fall back to
+        block_until_ready, which is only wrong on tunneled dev proxies."""
+        try:
+            import numpy as np
+
+            small = min((x for x in leaves if x is not None),
+                        key=lambda a: getattr(a, "nbytes", 1 << 62),
+                        default=None)
+            if small is not None and \
+                    getattr(small, "nbytes", 1 << 62) <= cap_bytes:
+                np.asarray(small)
+        except Exception:
+            pass
 
     def _slots_of(self, out) -> List[int]:
         """Region slots (local device indices) backing a dispatch result.
@@ -235,6 +261,8 @@ class Shim:
                     # this dispatch.  A donated/deleted previous output is
                     # fine — the queue was drained by whatever consumed it.
                     jax.block_until_ready(prev)
+                    if self._sync_fetch:
+                        self._fetch_small([prev])
                 except Exception:
                     pass
             del prev
@@ -246,6 +274,10 @@ class Shim:
                 import jax
 
                 jax.block_until_ready(out)
+                if self._sync_fetch:
+                    self._fetch_small(
+                        [x for x in _tree_leaves(out)
+                         if hasattr(x, "block_until_ready")])
                 synced = True
             except Exception:
                 pass

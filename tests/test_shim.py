@@ -318,6 +318,56 @@ class TestDispatchGate:
         # And clamped at the native burst cap.
         assert max(costs) <= shim.MAX_COST_US
 
+    def test_sync_fetch_hardens_synced_samples(self):
+        """VTPU_SYNC_FETCH=1: every sync turn adds a D2H fetch of a small
+        output leaf — tunneled PJRT proxies can return from
+        block_until_ready before the device finishes, but data cannot be
+        fetched before it exists (DIAG_r03.txt platform)."""
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_vgpu_scheduler_tpu.shim.core import _SlotHolder
+
+        os.environ["VTPU_SYNC_FETCH"] = "1"
+        try:
+            shim = self._fake_shim(sync_every=1)
+        finally:
+            del os.environ["VTPU_SYNC_FETCH"]
+        assert shim._sync_fetch
+        calls = []
+        shim._fetch_small = lambda leaves, cap_bytes=65536: \
+            calls.append(list(leaves))
+        f = jax.jit(lambda v: v + 1)
+        x = jnp.arange(8.0)
+        holder = _SlotHolder()
+        r1 = shim._gated_call(f, holder, (x,), {})
+        # Sync turn 1: no previous output yet — one fetch (the output).
+        assert len(calls) == 1
+        r2 = shim._gated_call(f, holder, (x,), {})
+        # Sync turn 2: drain-fetch of r1, then fetch of r2.
+        assert len(calls) == 3
+        del r1, r2
+
+    def test_fetch_small_picks_smallest_and_skips_large(self, monkeypatch):
+        import numpy as np
+
+        from k8s_vgpu_scheduler_tpu.shim.core import Shim
+
+        seen = []
+        monkeypatch.setattr(np, "asarray", lambda a: seen.append(a))
+
+        class Leaf:
+            def __init__(self, nbytes):
+                self.nbytes = nbytes
+
+        big, small = Leaf(1 << 20), Leaf(16)
+        Shim._fetch_small([big, small, None])
+        assert seen == [small]
+        seen.clear()
+        # Large-only outputs: the copy would distort the timed sample.
+        Shim._fetch_small([big])
+        assert seen == []
+
 
 class TestAotAndPmapGating:
     def test_aot_compiled_and_pmap_pass_the_gate(self, tmp_path):
