@@ -30,3 +30,13 @@ except Exception:
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "e2e: multi-process end-to-end tests (real transports)")
+
+
+def free_port() -> int:
+    """An OS-assigned localhost port (small TOCTOU window is acceptable
+    for tests).  Shared by every multi-process test harness."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

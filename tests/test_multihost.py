@@ -58,6 +58,72 @@ class TestGangEnv:
         }]
 
 
+class TestTwoProcessGroupForReal:
+    def test_two_processes_form_a_group_and_reduce(self, tmp_path):
+        """Not a mock: two OS processes bootstrap through the gang env
+        contract (VTPU_GANG_RANK/SIZE/COORDINATOR, exactly what Allocate
+        injects), form a jax.distributed group over the CPU backend, and
+        jointly reduce a global array sharded across both processes —
+        the full BASELINE-#5 in-container path minus the chips."""
+        import subprocess
+        import sys
+
+        code = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from k8s_vgpu_scheduler_tpu.parallel import multihost
+assert multihost.initialize_from_env(timeout_s=60) is True
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+devs = jax.devices()
+assert len(devs) == 4, devs  # 2 procs x 2 forced host devices
+mesh = Mesh(np.array(devs), ("dp",))
+x = jax.device_put(jnp.ones((len(devs), 8)), NamedSharding(mesh, P("dp")))
+total = float(jnp.sum(x))
+assert total == len(devs) * 8, total
+print("GROUP_OK", os.environ["VTPU_GANG_RANK"], total, flush=True)
+"""
+        from conftest import free_port
+
+        port = free_port()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = []
+        try:
+            for rank in range(2):
+                env = dict(os.environ)
+                env.update({
+                    "VTPU_GANG_RANK": str(rank),
+                    "VTPU_GANG_SIZE": "2",
+                    "VTPU_GANG_COORDINATOR": f"127.0.0.1:{port}",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH":
+                        repo + os.pathsep + env.get("PYTHONPATH", ""),
+                })
+                procs.append(subprocess.Popen(
+                    [sys.executable, "-c", code], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True))
+            outs = []
+            for p in procs:
+                out, err = p.communicate(timeout=180)
+                assert p.returncode == 0, (out, err[-2000:])
+                outs.append(out)
+        finally:
+            # CPU-only children — the pool's never-kill rule doesn't apply.
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+        assert "GROUP_OK 0 32.0" in outs[0]
+        assert "GROUP_OK 1 32.0" in outs[1]
+
+
 class TestAllocateInjectsGangEnv:
     def test_rank_env_travels_from_annotations(self, tmp_path):
         import sys

@@ -23,7 +23,6 @@ capacity for the next pod.
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
@@ -47,10 +46,7 @@ from k8s_vgpu_scheduler_tpu.util.types import (
 )
 
 
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from conftest import free_port  # noqa: E402 — shared test helper
 
 
 def http_json(method, url, body=None, timeout=10):
