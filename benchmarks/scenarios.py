@@ -677,6 +677,7 @@ def scenario_priority() -> None:
 _OVERSUB = """
 import json, os, time
 FORCE_CPU = os.environ.get("SCEN_CPU") == "1"
+MODE = os.environ.get("SCEN_OVERSUB_MODE", "both")  # baseline|offload|both
 import jax
 if FORCE_CPU:
     jax.config.update("jax_platforms", "cpu")
@@ -686,15 +687,15 @@ from k8s_vgpu_scheduler_tpu.models.train import (
     init_sharded_state, jit_train_step, offload_state)
 from k8s_vgpu_scheduler_tpu.parallel.mesh import MeshShape, make_mesh
 
-GRANT_MIB = int(os.environ.get("SCEN_GRANT_MIB", "1024"))
 if FORCE_CPU:
     cfg = LlamaConfig(vocab=256, dim=128, n_layers=2, n_heads=4,
                       n_kv_heads=4, ffn_hidden=384)
     batch, seq, steps = 2, 64, 2
 else:
-    # Sized so optimizer state alone (~2x params) EXCEEDS the 1024 MiB
-    # grant: dim=2048 x 8 layers ~= 445M params ~= 890 MiB bf16, opt state
-    # ~= 1780 MiB.
+    # Sized so the FULL in-HBM working set (params ~890 MiB bf16 + grads
+    # + f32 adam state ~3.5 GiB) EXCEEDS a 4096 MiB grant while the
+    # offloaded leg's device-resident set (params + grads + activations)
+    # fits under it: dim=2048 x 8 layers ~= 445M params.
     cfg = LlamaConfig(vocab=8192, dim=2048, n_layers=8, n_heads=16,
                       n_kv_heads=16, ffn_hidden=5632)
     batch, seq, steps = 4, 512, 4
@@ -711,74 +712,168 @@ def bench(step, state, tokens, steps):
     for _ in range(steps):
         state2, loss = step(state2, tokens)
         jax.block_until_ready(loss)
+    # Host fetch: honest wall time on tunneled backends.
+    lossf = float(loss)
     dt = time.monotonic() - t0
-    return state2, float(loss), steps * batch * seq / dt
+    return state2, lossf, steps * batch * seq / dt
 
 tokens = jax.random.randint(rng, (batch, seq + 1), 0, cfg.vocab)
 
-# In-HBM baseline.
-model, optimizer, state, _ = init_sharded_state(cfg, mesh, rng,
-                                                batch=batch, seq=seq)
-opt_mib = tree_mib(state.opt_state)
-base_step = jit_train_step(model, optimizer, mesh, state)
-_, base_loss, base_tps = bench(base_step, state, tokens, steps)
+if MODE in ("baseline", "both"):
+    # In-HBM run.  Under the PJRT interposer with an undersized grant this
+    # is EXPECTED to be refused — report that as data, not a crash.
+    try:
+        model, optimizer, state, _ = init_sharded_state(
+            cfg, mesh, rng, batch=batch, seq=seq)
+        opt_mib = tree_mib(state.opt_state)
+        base_step = jit_train_step(model, optimizer, mesh, state)
+        _, base_loss, base_tps = bench(base_step, state, tokens, steps)
+        print("BASELINE", json.dumps({
+            "opt_state_mib": opt_mib, "loss": base_loss,
+            "tokens_per_s": round(base_tps, 1),
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+        del model, optimizer, state, base_step
+    except Exception as e:
+        print("BASELINE_REFUSED", json.dumps({
+            "error": f"{type(e).__name__}: {e}"[:240].replace(chr(10), " "),
+        }), flush=True)
+        if MODE == "baseline":
+            raise SystemExit(0)
 
-# Offloaded (oversubscribed) run.
-model2, optimizer2, state2, _ = init_sharded_state(cfg, mesh, rng,
-                                                   batch=batch, seq=seq)
-host_state = offload_state(state2)
-off_step = jit_train_step(model2, optimizer2, mesh, host_state,
-                          offload_opt_state=True)
-off_state, off_loss, off_tps = bench(off_step, host_state, tokens, steps)
-kinds = {getattr(l.sharding, "memory_kind", None)
-         for l in jax.tree_util.tree_leaves(off_state.opt_state)}
-print("OVERSUB", json.dumps({
-    "grant_mib": GRANT_MIB,
-    "opt_state_mib": opt_mib,
-    "opt_exceeds_grant": opt_mib > GRANT_MIB,
-    "in_hbm_tokens_per_s": round(base_tps, 1),
-    "offloaded_tokens_per_s": round(off_tps, 1),
-    "offload_overhead": round(base_tps / off_tps, 3) if off_tps else None,
-    "loss_match": abs(base_loss - off_loss) < 1e-2,
-    "opt_state_memory_kinds": sorted(str(k) for k in kinds),
-    "platform": jax.devices()[0].platform,
-}))
+if MODE in ("offload", "both"):
+    model2, optimizer2, state2, _ = init_sharded_state(cfg, mesh, rng,
+                                                       batch=batch, seq=seq)
+    opt_mib = tree_mib(state2.opt_state)
+    host_state = offload_state(state2)
+    off_step = jit_train_step(model2, optimizer2, mesh, host_state,
+                              offload_opt_state=True)
+    off_state, off_loss, off_tps = bench(off_step, host_state, tokens, steps)
+    kinds = {getattr(l.sharding, "memory_kind", None)
+             for l in jax.tree_util.tree_leaves(off_state.opt_state)}
+    print("OFFLOAD", json.dumps({
+        "opt_state_mib": opt_mib, "loss": off_loss,
+        "tokens_per_s": round(off_tps, 1),
+        "opt_state_memory_kinds": sorted(str(k) for k in kinds),
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
 """
 
 
-def scenario_oversub() -> None:
-    on_tpu = tpu_available()
-    env = {"SCEN_GRANT_MIB": "1024"}
-    if not on_tpu:
-        env["SCEN_CPU"] = "1"
-    rc, out, err = run_child(_OVERSUB, env, timeout=540)
-    degraded = not on_tpu
-    tpu_error = None
-    if on_tpu and rc != 0:
-        # On-chip worker failed (e.g. the backend rejects pinned_host
-        # memory kinds): fall back to the honest degraded run rather than
-        # emitting nothing — keep the on-chip error for the artifact.
-        tpu_error = (err or "worker failed").strip().splitlines()[-3:]
-        rc, out, err = run_child(_OVERSUB, {**env, "SCEN_CPU": "1"},
-                                 timeout=540)
-        degraded = True
-    result = {"platform": "cpu" if degraded else "tpu",
-              "mechanism": "optimizer-state pinned-host offload "
-                           "(models/train.py offload_opt_state)"}
+def _oversub_marker(out: str, marker: str):
     for ln in out.splitlines():
-        if ln.startswith("OVERSUB"):
-            result.update(json.loads(ln.split(" ", 1)[1]))
-    result["passed"] = (rc == 0
-                        and result.get("loss_match") is True
-                        and result.get("offloaded_tokens_per_s", 0) > 0
-                        and (degraded or result.get("opt_exceeds_grant")))
-    if rc != 0:
-        result["error"] = (err or "worker failed").strip().splitlines()[-3:]
-    if tpu_error:
-        result["tpu_error"] = tpu_error
-    if degraded:
-        result["degraded"] = True
+        if ln.startswith(marker + " "):
+            return json.loads(ln.split(" ", 1)[1])
+    return None
+
+
+def scenario_oversub() -> None:
+    """BASELINE #4 with the enforcement loop closed (on-chip): the SAME
+    model whose in-HBM working set is refused by the PJRT interposer under
+    a 4096 MiB grant trains successfully under that grant once the
+    optimizer state is offloaded to pinned host memory (the interposer
+    charges device-kind buffers only) — throughput measured for both the
+    unenforced in-HBM step and the enforced offloaded step."""
+    build_native()
+    on_tpu = tpu_available()
+    result = {"mechanism": "optimizer-state pinned-host offload "
+                           "(models/train.py offload_opt_state)"}
+    if not on_tpu:
+        _oversub_degraded(result)
+        emit("oversub", result)
+        return
+
+    grant = "4096"
+    tmp = tempfile.mkdtemp(prefix="vtpu-oversub-")
+    enforce_env = {
+        "TPU_DEVICE_MEMORY_SHARED_CACHE": os.path.join(tmp, "vtpu.cache"),
+        "TPU_DEVICE_MEMORY_LIMIT_0": grant,
+        "TPU_VISIBLE_CHIPS": "oversub-chip-0",
+    }
+    # Leg A — unenforced in-HBM baseline (the throughput yardstick; needs
+    # the physical chip, working set ~5.5 GiB of 16 GiB).
+    rcA, outA, errA = run_child(_OVERSUB,
+                                {"SCEN_OVERSUB_MODE": "baseline"},
+                                timeout=540)
+    base = _oversub_marker(outA, "BASELINE")
+    # Leg B — the SAME in-HBM run under the interposer: must be refused.
+    rcB, outB, errB = run_child(_OVERSUB,
+                                {**enforce_env,
+                                 "SCEN_OVERSUB_MODE": "baseline"},
+                                timeout=540, interposer=True)
+    refused = _oversub_marker(outB, "BASELINE_REFUSED")
+    # Leg C — offloaded run under the SAME enforcement: must fit + train.
+    rcC, outC, errC = run_child(_OVERSUB,
+                                {**enforce_env,
+                                 "SCEN_OVERSUB_MODE": "offload"},
+                                timeout=540, interposer=True)
+    off = _oversub_marker(outC, "OFFLOAD")
+
+    refusal_ok = bool(refused) and "RESOURCE_EXHAUSTED" in \
+        (refused or {}).get("error", "")
+    result.update({
+        "platform": "tpu",
+        "grant_mib": int(grant),
+        "opt_state_mib": (off or base or {}).get("opt_state_mib"),
+        "in_hbm_tokens_per_s": (base or {}).get("tokens_per_s"),
+        "in_hbm_refused_under_grant": bool(refused),
+        "refusal": (refused or {}).get("error"),
+        "offloaded_tokens_per_s": (off or {}).get("tokens_per_s"),
+        # Leg C boots through the same interposer config leg B just proved
+        # enforcing — refusal_ok is the evidence, not an assumption.
+        "offloaded_enforced": refusal_ok,
+        "opt_state_memory_kinds": (off or {}).get("opt_state_memory_kinds"),
+        "loss_match": bool(base and off
+                           and abs(base["loss"] - off["loss"]) < 1e-2),
+    })
+    if base and off and off["tokens_per_s"]:
+        result["offload_overhead"] = round(
+            base["tokens_per_s"] / off["tokens_per_s"], 3)
+    result["passed"] = bool(base and off and refusal_ok
+                            and result["loss_match"]
+                            and off["tokens_per_s"] > 0)
+    for leg, rc, err in (("baseline", rcA, errA), ("refusal", rcB, errB),
+                         ("offload", rcC, errC)):
+        if rc != 0:
+            result.setdefault("errors", {})[leg] = \
+                (err or "").strip().splitlines()[-3:]
+    if not (base and off):
+        # On-chip legs failed outright (e.g. the backend rejects
+        # pinned_host memory kinds): keep the on-chip evidence gathered so
+        # far and still demonstrate the mechanism degraded, honoring the
+        # module contract that every scenario has an honest degraded mode.
+        result["tpu_errors"] = result.pop("errors", None)
+        _oversub_degraded(result)
     emit("oversub", result)
+
+
+def _oversub_degraded(result: dict) -> None:
+    """CPU run of both legs (unenforced): mechanism + loss parity."""
+    rc, out, err = run_child(_OVERSUB, {"SCEN_CPU": "1"}, timeout=540)
+    base = _oversub_marker(out, "BASELINE")
+    off = _oversub_marker(out, "OFFLOAD")
+    refused = _oversub_marker(out, "BASELINE_REFUSED")
+    result.update({
+        "platform": "cpu", "degraded": True,
+        "grant_mib": 1024,
+        "opt_state_mib": (off or {}).get("opt_state_mib"),
+        "in_hbm_tokens_per_s": (base or {}).get("tokens_per_s"),
+        "offloaded_tokens_per_s": (off or {}).get("tokens_per_s"),
+        "opt_state_memory_kinds": (off or {}).get("opt_state_memory_kinds"),
+        "loss_match": bool(base and off
+                           and abs(base["loss"] - off["loss"]) < 1e-2),
+    })
+    if base and off and off["tokens_per_s"]:
+        result["offload_overhead"] = round(
+            base["tokens_per_s"] / off["tokens_per_s"], 3)
+    result["passed"] = bool(rc == 0 and result["loss_match"]
+                            and (off or {}).get("tokens_per_s"))
+    if rc != 0:
+        result["error"] = (err or "").strip().splitlines()[-3:]
+    elif refused is not None:
+        # The child caught a baseline-leg exception and went on (MODE
+        # 'both' exits 0): surface it or the artifact hides the failure.
+        result["error"] = refused.get("error")
 
 
 # ---------------------------------------------------------------------------
