@@ -855,7 +855,10 @@ def _oversub_degraded(result: dict) -> None:
     refused = _oversub_marker(out, "BASELINE_REFUSED")
     result.update({
         "platform": "cpu", "degraded": True,
-        "grant_mib": 1024,
+        # No grant is enforced in the degraded run — never fabricate one
+        # (a TPU-fallback caller keeps its attempted grant_mib for
+        # context; the 'enforced' flag is what says nothing held it).
+        "enforced": False,
         "opt_state_mib": (off or {}).get("opt_state_mib"),
         "in_hbm_tokens_per_s": (base or {}).get("tokens_per_s"),
         "offloaded_tokens_per_s": (off or {}).get("tokens_per_s"),

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention
+from ..parallel.moe import MoEConfig, MoELayer
 from ..parallel.ring import full_attention_reference, ring_attention
 
 
@@ -36,6 +37,10 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     # "full" | "ring"; ring shards the sequence over the mesh's sp axis.
     attention: str = "full"
+    # >0 switches the FFN to a top-1-routed MoE (Mixtral-style family);
+    # the stacked expert tensors shard over the mesh's ep axis.
+    n_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -139,9 +144,16 @@ class Block(nn.Module):
             RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions
         )
         x = self._seq_shard(x)
-        x = x + MLP(self.cfg, name="mlp")(
-            RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x)
-        )
+        h = RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x)
+        if self.cfg.n_experts > 0:
+            moe_cfg = MoEConfig(
+                dim=self.cfg.dim, ffn_hidden=self.cfg.ffn_hidden,
+                n_experts=self.cfg.n_experts,
+                capacity_factor=self.cfg.moe_capacity_factor,
+                dtype=self.cfg.dtype)
+            x = x + MoELayer(moe_cfg, self.mesh, name="moe")(h)
+        else:
+            x = x + MLP(self.cfg, name="mlp")(h)
         return self._seq_shard(x)
 
     def _seq_shard(self, x):

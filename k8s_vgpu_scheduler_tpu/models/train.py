@@ -35,12 +35,21 @@ def make_optimizer(lr: float = 3e-4):
 
 
 def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
-    """Next-token CE; logits in f32 for the reduction."""
-    logits = model.apply(params, tokens[:, :-1]).astype(jnp.float32)
+    """Next-token CE; logits in f32 for the reduction.  MoE configs add
+    the routers' sown load-balance losses (parallel/moe.py)."""
+    aux = jnp.float32(0)
+    if getattr(model.cfg, "n_experts", 0) > 0:
+        logits, sown = model.apply(params, tokens[:, :-1],
+                                   mutable=["losses"])
+        for leaf in jax.tree_util.tree_leaves(sown.get("losses", {})):
+            aux = aux + leaf
+    else:
+        logits = model.apply(params, tokens[:, :-1])
+    logits = logits.astype(jnp.float32)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + aux
 
 
 def make_train_step(model: Llama, optimizer, opt_shardings=None):
@@ -140,6 +149,11 @@ def init_sharded_state(cfg: LlamaConfig, mesh: Mesh, rng,
     model = Llama(cfg, mesh)
     tokens = jnp.zeros((batch, seq), jnp.int32)
     params = jax.jit(model.init)(rng, tokens)
+    # MoE configs sow a 'losses' collection during init; keep ONLY real
+    # parameters in the train state — threading sown scalars through would
+    # both seed stale aux values into every apply and hand them to adamw
+    # as if they were weights.
+    params = {"params": params["params"]}
     shardings = param_shardings(mesh, params)
     params = jax.device_put(params, shardings)
     optimizer = make_optimizer()

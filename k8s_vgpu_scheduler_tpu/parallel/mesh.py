@@ -20,16 +20,21 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .moe import MOE_PARAM_RULES
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshShape:
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    # Expert parallelism (MoE stacked expert tensors; models/llama.py
+    # n_experts > 0).  Defaults to 1 so dense configs are unaffected.
+    ep: int = 1
 
     @property
     def total(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.dp * self.sp * self.tp * self.ep
 
 
 def choose_mesh_shape(n_devices: int) -> MeshShape:
@@ -53,13 +58,18 @@ def make_mesh(shape: Optional[MeshShape] = None,
     if shape.total != len(devices):
         raise ValueError(f"mesh {shape} wants {shape.total} devices, "
                          f"got {len(devices)}")
-    arr = np.asarray(devices).reshape(shape.dp, shape.sp, shape.tp)
-    return Mesh(arr, axis_names=("dp", "sp", "tp"))
+    arr = np.asarray(devices).reshape(shape.dp, shape.sp, shape.tp,
+                                      shape.ep)
+    return Mesh(arr, axis_names=("dp", "sp", "tp", "ep"))
 
 
 # --- parameter sharding rules (megatron-style tp) ----------------------------
 # Matched against the flax param path (joined with '/').  First hit wins.
 PARAM_RULES: Tuple[Tuple[str, P], ...] = (
+    # MoE FFN (models/llama.py n_experts>0): the layer owns its rules
+    # (moe.MOE_PARAM_RULES); prefixed here with its module name so they
+    # match the flax param paths first.
+    *(("moe/" + pat, spec) for pat, spec in MOE_PARAM_RULES),
     ("embed/embedding", P("tp", None)),       # vocab-sharded embedding
     ("attn/q_proj/kernel", P(None, "tp")),
     ("attn/k_proj/kernel", P(None, "tp")),

@@ -1,0 +1,126 @@
+"""Mixture-of-Experts FFN with expert parallelism (the ``ep`` mesh axis).
+
+The reference has no model code at all (SURVEY.md §2.3) — this is part of
+the beyond-parity compute path the scheduler's multi-chip grants exist to
+serve.  Switch-Transformer-style top-1 routing with a fixed expert
+capacity, dispatched DENSELY through one-hot einsums: no dynamic shapes,
+no sorting — the whole layer is three einsums and a batched expert FFN,
+which is exactly what XLA tiles well onto the MXU.  Experts live in one
+stacked parameter tensor ``[E, ...]`` sharded over ``ep``; with the
+dispatch tensors sharded over tokens (dp/sp) and the expert tensors over
+``ep``, XLA inserts the token all-to-all between the two layouts on its
+own (the scaling-book recipe: annotate shardings, let the compiler place
+the collectives on ICI).
+
+Degenerate config (n_experts=1, capacity ≥ tokens) reduces exactly to the
+dense MLP — the numerical anchor the tests pin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    dim: int
+    ffn_hidden: int
+    n_experts: int = 8
+    # Per-expert token slots per batch: ceil(tokens/E * capacity_factor).
+    capacity_factor: float = 1.25
+    dtype: str = "bfloat16"
+    # Load-balancing auxiliary loss weight (Switch Transformer eq. 4).
+    aux_loss_weight: float = 0.01
+
+
+def expert_capacity(tokens: int, cfg: MoEConfig) -> int:
+    cap = math.ceil(tokens / cfg.n_experts * cfg.capacity_factor)
+    return max(1, min(tokens, cap))
+
+
+class MoELayer(nn.Module):
+    """Top-1 routed FFN: ``[B, S, d] -> [B, S, d]`` plus a scalar aux loss
+    (stored via ``self.sow('losses', 'moe_aux', ...)``)."""
+
+    cfg: MoEConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, d = x.shape
+        E = cfg.n_experts
+        tokens = B * S
+        C = expert_capacity(tokens, cfg)
+        xt = x.reshape(tokens, d)
+
+        # -- router (f32 for a stable softmax) --------------------------------
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          name="router")(xt.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # [T, E]
+        expert_idx = jnp.argmax(probs, axis=-1)            # [T]
+        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+        # -- capacity assignment (position of each token in its expert) ------
+        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [T, E]
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [T, E]
+        pos = jnp.sum(pos_in_expert, axis=-1)              # [T]
+        keep = pos < C                                     # overflow dropped
+        # Dispatch/combine tensors (dense one-hots; [T, E, C]).
+        dispatch = (jax.nn.one_hot(expert_idx, E, dtype=dtype)[:, :, None]
+                    * jax.nn.one_hot(pos, C, dtype=dtype)[:, None, :]
+                    * keep[:, None, None].astype(dtype))
+        combine = dispatch * gate[:, None, None].astype(dtype)
+
+        # -- expert FFNs over the stacked [E, ...] params ---------------------
+        expert_in = jnp.einsum("td,tec->ecd", xt.astype(dtype), dispatch)
+        expert_in = self._ep_shard(expert_in)
+        w_gate = self.param("gate_proj",
+                            nn.initializers.lecun_normal(),
+                            (E, d, cfg.ffn_hidden), dtype)
+        w_up = self.param("up_proj", nn.initializers.lecun_normal(),
+                          (E, d, cfg.ffn_hidden), dtype)
+        w_down = self.param("down_proj", nn.initializers.lecun_normal(),
+                            (E, cfg.ffn_hidden, d), dtype)
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, w_gate)) \
+            * jnp.einsum("ecd,edf->ecf", expert_in, w_up)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)
+        expert_out = self._ep_shard(expert_out)
+
+        out = jnp.einsum("ecd,tec->td", expert_out, combine)
+
+        # -- load-balance aux loss (Switch eq. 4: E * Σ_e f_e · P_e) ---------
+        frac_tokens = jnp.mean(onehot.astype(jnp.float32), axis=0)   # f_e
+        frac_probs = jnp.mean(probs, axis=0)                         # P_e
+        aux = cfg.aux_loss_weight * E * jnp.sum(frac_tokens * frac_probs)
+        self.sow("losses", "moe_aux", aux)
+
+        return out.reshape(B, S, d).astype(x.dtype)
+
+    def _ep_shard(self, t: jnp.ndarray) -> jnp.ndarray:
+        """Pin the expert-major tensors to the ep axis; the layout change
+        from token-major (dp/sp) to expert-major (ep) is where XLA places
+        the all-to-all."""
+        if self.mesh is None or self.mesh.shape.get("ep", 1) <= 1:
+            return t
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(self.mesh, P("ep", None, None)))
+
+
+# Parameter sharding rules for mesh.param_shardings-style matching: the
+# stacked expert tensors shard over ep on the expert dim; the router is
+# tiny and replicated.
+MOE_PARAM_RULES = (
+    ("router/kernel", P()),
+    ("gate_proj", P("ep", None, None)),
+    ("up_proj", P("ep", None, None)),
+    ("down_proj", P("ep", None, None)),
+)

@@ -1,0 +1,95 @@
+"""Pipeline parallelism (the ``pp`` mesh axis) — GPipe schedule via
+``shard_map`` + neighbor ``ppermute``.
+
+The reference has no model code (SURVEY.md §2.3); this is the beyond-parity
+inter-host axis: each device (or host group) owns one STAGE of the network,
+activations flow stage→stage over the ICI/DCN neighbor link, and
+microbatches keep every stage busy after the fill ramp.  The schedule is a
+single ``lax.scan`` over ``n_micro + n_stages - 1`` ticks — static shapes,
+no data-dependent control flow, exactly what XLA wants:
+
+    tick t: stage 0 ingests microbatch t (zeros after the last one),
+            every stage applies its layer to what arrived last tick,
+            results ppermute one hop down the ring,
+            stage P-1's outputs for ticks ≥ P-1 are the model outputs.
+
+``pipeline_apply`` is generic over the per-stage function; stage params are
+stacked on axis 0 (``[P, ...]``, sharded over ``pp``) the same way scan
+layers stack, so a pipeline stage can hold any pytree of weights.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
+                   n_micro: int, axis_name: str = "pp"):
+    """Run ``x`` through ``n_stages`` pipelined stages.
+
+    stage_fn:     (params_for_one_stage, activation) -> activation
+    stage_params: pytree with a leading stacked stage axis on every leaf
+                  (``[P, ...]``); sharded over ``axis_name``.
+    x:            [batch, ...] global input; split into ``n_micro``
+                  microbatches on axis 0 (batch must divide evenly).
+    Returns [batch, ...] outputs (replicated across the pp axis).
+    """
+    n_stages = mesh.shape[axis_name]
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"n_micro {n_micro}")
+    mb = x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
+
+    def worker(params, mb):
+        # Inside shard_map: params carry ONE stage (leading axis length 1
+        # after sharding) — drop that axis; mb is replicated.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage = jax.lax.axis_index(axis_name)
+        n_ticks = n_micro + n_stages - 1
+        zero = jnp.zeros_like(mb[0])
+
+        def tick(recv, t):
+            # Stage 0 ingests microbatch t (zeros once drained); everyone
+            # else consumes what arrived from upstream last tick.
+            idx = jnp.minimum(t, n_micro - 1)
+            feed = jnp.where(t < n_micro, mb[idx], zero)
+            x_in = jnp.where(stage == 0, feed, recv)
+            y = stage_fn(params, x_in)
+            # One hop down the ring; the wrap edge (P-1 → 0) carries only
+            # values stage 0 ignores.
+            send = jax.lax.ppermute(
+                y, axis_name,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            out_t = jnp.where(stage == n_stages - 1, y, zero)
+            return send, out_t
+        _, outs = jax.lax.scan(tick, zero, jnp.arange(n_ticks))
+        # outs: [n_ticks, micro, ...] — microbatch m leaves the last stage
+        # at tick m + n_stages - 1.  Replicate the last stage's outputs so
+        # every shard returns the same tensor (psum over the pp axis: all
+        # other stages contributed zeros).
+        outs = jax.lax.psum(outs, axis_name)
+        return outs[n_stages - 1:]
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(axis_name), stage_params),
+                P())
+    outs = jax.shard_map(worker, mesh=mesh, in_specs=in_specs,
+                         out_specs=P(), check_vma=False)(stage_params, mb)
+    return outs.reshape(x.shape[0], *outs.shape[2:])
+
+
+def stack_stage_params(per_stage_params):
+    """[{stage0 pytree}, {stage1 pytree}, ...] -> stacked pytree with a
+    leading [P, ...] axis on every leaf (the layout pipeline_apply
+    shards over pp)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def stage_sharding(mesh: Mesh, stage_params, axis_name: str = "pp"):
+    """NamedShardings placing each stage's weights on its pp coordinate."""
+    return jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P(axis_name)), stage_params)
