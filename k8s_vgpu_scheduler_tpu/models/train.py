@@ -34,9 +34,16 @@ def make_optimizer(lr: float = 3e-4):
     return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
 
 
+def ce_from_logits(logits, targets) -> jnp.ndarray:
+    """Next-token CE; logits reduced in f32 (shared with the pp path)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
 def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
-    """Next-token CE; logits in f32 for the reduction.  MoE configs add
-    the routers' sown load-balance losses (parallel/moe.py)."""
+    """Next-token CE; MoE configs add the routers' sown load-balance
+    losses (parallel/moe.py)."""
     aux = jnp.float32(0)
     if getattr(model.cfg, "n_experts", 0) > 0:
         logits, sown = model.apply(params, tokens[:, :-1],
@@ -45,11 +52,7 @@ def loss_fn(model: Llama, params, tokens) -> jnp.ndarray:
             aux = aux + leaf
     else:
         logits = model.apply(params, tokens[:, :-1])
-    logits = logits.astype(jnp.float32)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll) + aux
+    return ce_from_logits(logits, tokens[:, 1:]) + aux
 
 
 def make_train_step(model: Llama, optimizer, opt_shardings=None):

@@ -1,0 +1,89 @@
+"""Pipeline-parallel Llama: the flagship decoder's blocks distributed over
+the ``pp`` mesh axis with GPipe microbatching (parallel/pipeline.py).
+
+Layout: embedding, final norm and LM head are small and replicated; the
+``n_layers`` transformer blocks are grouped into ``n_stages`` equal stages,
+each stage's per-layer parameter trees stacked on a leading axis.  A stage
+applies its layers with one ``lax.scan`` over that axis (the standard
+stacked-layers trick), and stages hand activations down the ring inside
+the pipeline schedule.  The whole forward is differentiable — the pp train
+test takes real gradients through two nested scans and a ppermute.
+
+Intra-stage sharding constraints are deliberately absent: inside
+``shard_map`` over ``pp`` the global-view constraints of Block(mesh=...)
+do not apply, so this path uses attention="full" blocks un-annotated.
+Composing pp with dp/tp inside the stages (shard_map over a 2D
+('pp','dp') mesh) is a straightforward extension of the same schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..models.llama import Block, LlamaConfig, Llama, RMSNorm
+from .pipeline import pipeline_apply, stack_stage_params, stage_sharding
+
+
+def split_llama_params(cfg: LlamaConfig, params, n_stages: int):
+    """Full flax param tree -> (outer, stacked stage tree).
+
+    outer:  embed / final_norm / lm_head subtrees (replicated).
+    stages: every Block's params stacked twice — [n_stages, layers_per
+    _stage, ...] on each leaf — the layout pipeline_apply shards over pp.
+    """
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"{cfg.n_layers} layers not divisible into "
+                         f"{n_stages} stages")
+    per = cfg.n_layers // n_stages
+    p = params["params"]
+    outer = {k: p[k] for k in p if not k.startswith("layer_")}
+    layers = [p[f"layer_{i}"] for i in range(cfg.n_layers)]
+    stages = []
+    for s in range(n_stages):
+        group = layers[s * per:(s + 1) * per]
+        stages.append(jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *group))
+    return outer, stack_stage_params(stages)
+
+
+def llama_pp_forward(cfg: LlamaConfig, outer, stage_params, tokens,
+                     *, mesh: Mesh, n_micro: int):
+    """[B, T] tokens -> [B, T, vocab] logits through the pipelined blocks."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (1, T))
+    block = Block(cfg)  # mesh=None: no global constraints inside shard_map
+
+    def stage_fn(stacked_layers, x):
+        def one_layer(h, layer_params):
+            pos = jnp.broadcast_to(positions, h.shape[:2])
+            return block.apply({"params": layer_params}, h, pos), None
+        x, _ = jax.lax.scan(one_layer, x, stacked_layers)
+        return x
+
+    x = jnp.take(outer["embed"]["embedding"], tokens, axis=0).astype(
+        jnp.dtype(cfg.dtype))
+    x = pipeline_apply(stage_fn, stage_params, x, mesh=mesh,
+                       n_micro=n_micro)
+    x = RMSNorm(cfg.norm_eps).apply({"params": outer["final_norm"]}, x)
+    # Cast BOTH operands like nn.Dense(dtype=...) does — without the
+    # kernel cast the bf16 config diverges from the plain forward.
+    dtype = jnp.dtype(cfg.dtype)
+    logits = x.astype(dtype) @ outer["lm_head"]["kernel"].astype(dtype)
+    return logits
+
+
+def llama_pp_loss(cfg: LlamaConfig, outer, stage_params, tokens, *,
+                  mesh: Mesh, n_micro: int):
+    from ..models.train import ce_from_logits
+
+    logits = llama_pp_forward(cfg, outer, stage_params, tokens[:, :-1],
+                              mesh=mesh, n_micro=n_micro)
+    return ce_from_logits(logits, tokens[:, 1:])
+
+
+def place_stage_params(mesh: Mesh, stage_params):
+    return jax.device_put(stage_params, stage_sharding(mesh, stage_params))
