@@ -28,7 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
-                   n_micro: int, axis_name: str = "pp"):
+                   n_micro: int, axis_name: str = "pp",
+                   batch_axis: str = None):
     """Run ``x`` through ``n_stages`` pipelined stages.
 
     stage_fn:     (params_for_one_stage, activation) -> activation
@@ -36,13 +37,27 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
                   (``[P, ...]``); sharded over ``axis_name``.
     x:            [batch, ...] global input; split into ``n_micro``
                   microbatches on axis 0 (batch must divide evenly).
-    Returns [batch, ...] outputs (replicated across the pp axis).
+    batch_axis:   optional second mesh axis (e.g. ``dp``): microbatches
+                  are additionally sharded over it, composing pipeline
+                  and data parallelism on a 2D ('pp', 'dp') mesh — each
+                  dp rank runs the same schedule on its batch shard, so
+                  stage compute and in-flight activations are dp-sharded.
+    Returns [batch, ...] outputs in the input's row order, REPLICATED
+    across the mesh (the final microbatch merge all-gathers the dp
+    shards; a training loop that must stay sharded end-to-end should
+    fold its loss inside ``stage_fn`` on the last stage instead of
+    consuming these gathered outputs).
     """
     n_stages = mesh.shape[axis_name]
     if x.shape[0] % n_micro:
         raise ValueError(f"batch {x.shape[0]} not divisible by "
                          f"n_micro {n_micro}")
-    mb = x.reshape(n_micro, x.shape[0] // n_micro, *x.shape[1:])
+    per_micro = x.shape[0] // n_micro
+    if batch_axis is not None and per_micro % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"microbatch size {per_micro} not divisible by mesh axis "
+            f"'{batch_axis}' ({mesh.shape[batch_axis]})")
+    mb = x.reshape(n_micro, per_micro, *x.shape[1:])
 
     def worker(params, mb):
         # Inside shard_map: params carry ONE stage (leading axis length 1
@@ -74,10 +89,12 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
         outs = jax.lax.psum(outs, axis_name)
         return outs[n_stages - 1:]
 
+    data_spec = P(None, batch_axis) if batch_axis else P()
     in_specs = (jax.tree_util.tree_map(lambda _: P(axis_name), stage_params),
-                P())
+                data_spec)
     outs = jax.shard_map(worker, mesh=mesh, in_specs=in_specs,
-                         out_specs=P(), check_vma=False)(stage_params, mb)
+                         out_specs=data_spec,
+                         check_vma=False)(stage_params, mb)
     return outs.reshape(x.shape[0], *outs.shape[2:])
 
 

@@ -74,6 +74,25 @@ def test_pipeline_is_jittable_and_differentiable():
         assert float(jnp.abs(g).sum()) > 0
 
 
+def test_pp_composes_with_dp():
+    """2D ('pp','dp') mesh: each dp rank pipelines its batch shard; the
+    result equals sequential application of the stages on the full
+    batch."""
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(4, 2), ("pp", "dp"))
+    dim, batch = 8, 8
+    per_stage = make_stages(4, dim, jax.random.PRNGKey(5))
+    stacked = stack_stage_params(per_stage)
+    stacked = jax.device_put(stacked, stage_sharding(mesh, stacked))
+    x = jax.random.normal(jax.random.PRNGKey(6), (batch, dim))
+
+    got = pipeline_apply(stage_fn, stacked, x, mesh=mesh, n_micro=2,
+                         batch_axis="dp")
+    want = sequential(per_stage, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_batch_not_divisible_raises():
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("pp",))
     per_stage = make_stages(4, 4, jax.random.PRNGKey(4))
