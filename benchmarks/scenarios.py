@@ -22,13 +22,21 @@ artifact) —
   dispatch must track the 30% grant.  The workload is sized so total
   charged device-time is many times the limiter's 200 ms burst bucket
   (a too-small pass rides the initial burst and measures nothing).
+- ``priority``  reference C20 end-to-end: the node monitor's FeedbackLoop
+  flips a low-priority pod's utilizationSwitch while a high-priority
+  sharer is active on the chip; the low pod's measured dispatch rate
+  drops to ~its core grant and recovers after the sharer stops.
 - ``oversub``   BASELINE #4: virtual device memory — optimizer state
   LARGER than the HBM grant trains anyway via pinned-host offload
-  (models/train.py offload_opt_state), with measured throughput for both
-  the in-HBM and offloaded step (the reference's "+virtual devmem" column,
-  README.md:185–204).
+  (models/train.py offload_opt_state).  On-chip this is a 3-leg enforced
+  proof: the in-HBM working set is REFUSED by the PJRT interposer under
+  the grant, the offloaded run fits and trains under the SAME
+  enforcement, with throughput measured for both (the reference's
+  "+virtual devmem" column, README.md:185–204).
+- ``gang``      BASELINE #5 scale: a 32-member SPMD gang over 256 chips
+  (32 hosts) admitted atomically through the real protocol.
 
-Usage: ``python benchmarks/scenarios.py all|enforce|cosched|throttle|oversub``
+Usage: ``python benchmarks/scenarios.py all|<scenario-name> [--strict]``
 """
 
 from __future__ import annotations
