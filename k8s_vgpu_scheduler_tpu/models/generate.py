@@ -1,0 +1,89 @@
+"""Autoregressive generation for the flagship decoder (the serving path).
+
+One prefill pass writes the prompt's keys/values into the per-layer KV
+cache (flax ``cache`` collection, static ``decode_cache_len`` slots), then
+a single ``lax.scan`` emits tokens one at a time — the whole generate is
+ONE jittable function with static shapes: no Python loop per token, no
+recompilation per step, cache updates via ``dynamic_update_slice`` (the
+XLA-friendly decode layout).
+
+Sampling: greedy (temperature=0) or temperature sampling with a PRNG key.
+Prompts in a batch must share one length (ragged batches need bucketing
+or per-row generation; padding-aware positions are not implemented).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .llama import Llama, LlamaConfig
+
+
+def _sample(logits, temperature: float, rng):
+    if temperature == 0.0 or rng is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, logits.astype(jnp.float32) / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """prompt: [B, P] int32 -> [B, P + max_new_tokens] tokens.
+
+    Jit-compatible end to end; wrap in ``jax.jit(..., static_argnums=0)``
+    via :func:`jit_generate` for the compiled form.
+    """
+    B, P = prompt.shape
+    total = P + max_new_tokens
+    dcfg = dataclasses.replace(
+        cfg, decode_cache_len=total,
+        # Decode attends through the explicit cache mask; sp-ring/flash
+        # paths are prefill/training layouts.
+        attention="full")
+    model = Llama(dcfg, decode=True)
+
+    if max_new_tokens <= 0:
+        return prompt
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    logits, state = model.apply({"params": params["params"]}, prompt,
+                                positions, mutable=["cache"])
+    cache = state["cache"]
+    first = _sample(logits[:, -1], temperature,
+                    None if rng is None else jax.random.fold_in(rng, 0))
+
+    def step(carry, i):
+        cache, tok = carry
+        pos = jnp.broadcast_to(P + i, (B, 1)).astype(jnp.int32)
+        logits, st = model.apply(
+            {"params": params["params"], "cache": cache},
+            tok[:, None], pos, mutable=["cache"])
+        key = None if rng is None else jax.random.fold_in(rng, i + 1)
+        nxt = _sample(logits[:, -1], temperature, key)
+        return (st["cache"], nxt), nxt
+
+    # n-1 steps: the prefill already produced token 1, each step emits
+    # the next — no forward is ever run whose sample gets discarded.
+    _, rest = jax.lax.scan(
+        step, (cache, first),
+        jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+    new_tokens = jnp.concatenate(
+        [first[:, None], rest.transpose(1, 0)], axis=1)
+    return jnp.concatenate([prompt, new_tokens], axis=1)
+
+
+def jit_generate(cfg: LlamaConfig, max_new_tokens: int,
+                 temperature: float = 0.0):
+    """Compiled generate: returns fn(params, prompt[, rng]) -> tokens."""
+
+    @jax.jit
+    def run(params, prompt, rng=None):
+        return generate(cfg, params, prompt, max_new_tokens,
+                        temperature=temperature, rng=rng)
+
+    return run

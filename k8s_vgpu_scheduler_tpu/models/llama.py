@@ -41,6 +41,9 @@ class LlamaConfig:
     # the stacked expert tensors shard over the mesh's ep axis.
     n_experts: int = 0
     moe_capacity_factor: float = 1.25
+    # KV-cache length for decode-mode modules (models/generate.py);
+    # prompt length + max new tokens must fit.
+    decode_cache_len: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -82,9 +85,31 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
+def _cached_attention(q, k_all, v_all, q_pos):
+    """q: [B,T,H,D] against the UNREPEATED cache [B,L,KV,D] — GQA query
+    groups attend their kv head via a grouped einsum (no head-repeated
+    cache copy per decode step).  Key l attends iff l <= the query's
+    absolute position; unwritten cache slots sit beyond every valid
+    position, so the same mask excludes them."""
+    B, T, H, D = q.shape
+    KV = k_all.shape[2]
+    qg = q.reshape(B, T, KV, H // KV, D)
+    scale = 1.0 / (D ** 0.5)
+    logits = jnp.einsum("btkrd,blkd->bkrtl", qg, k_all).astype(jnp.float32)
+    logits = logits * scale
+    L = k_all.shape[1]
+    key_pos = jnp.arange(L, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= q_pos[:, :, None]       # [B,T,L]
+    logits = jnp.where(mask[:, None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkrtl,blkd->btkrd", probs.astype(v_all.dtype), v_all)
+    return out.reshape(B, T, H, D)
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -102,18 +127,42 @@ class Attention(nn.Module):
         v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        # GQA: repeat kv heads up to the query head count.
         rep = cfg.n_heads // cfg.n_kv_heads
-        if rep > 1:
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        if cfg.attention == "ring" and self.mesh is not None and \
-                self.mesh.shape.get("sp", 1) > 1:
-            out = ring_attention(q, k, v, self.mesh, causal=True)
-        elif cfg.attention == "flash":
-            out = flash_attention(q, k, v, causal=True)
+        if self.decode:
+            # Autoregressive KV cache: append this call's keys/values at
+            # the running index (prefill writes T at once, steps write 1),
+            # then attend the queries against the whole cache.
+            L = cfg.decode_cache_len
+            if L < T:
+                raise ValueError(f"decode_cache_len {L} < input length {T}")
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (B, L, cfg.n_kv_heads, cfg.head_dim), dtype)
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (B, L, cfg.n_kv_heads, cfg.head_dim), dtype)
+            idx = self.variable(
+                "cache", "idx", lambda: jnp.zeros((), jnp.int32))
+            cur = idx.value
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(dtype), (0, cur, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(dtype), (0, cur, 0, 0))
+            idx.value = cur + T
+            out = _cached_attention(q, ck.value, cv.value, positions)
+            out = out.astype(dtype)
         else:
-            out = full_attention_reference(q, k, v, causal=True)
+            # GQA: repeat kv heads up to the query head count.
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            if cfg.attention == "ring" and self.mesh is not None and \
+                    self.mesh.shape.get("sp", 1) > 1:
+                out = ring_attention(q, k, v, self.mesh, causal=True)
+            elif cfg.attention == "flash":
+                out = flash_attention(q, k, v, causal=True)
+            else:
+                out = full_attention_reference(q, k, v, causal=True)
         out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)
         return dense(cfg.dim, "o_proj")(out)
 
@@ -137,10 +186,11 @@ class MLP(nn.Module):
 class Block(nn.Module):
     cfg: LlamaConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
-        x = x + Attention(self.cfg, self.mesh, name="attn")(
+        x = x + Attention(self.cfg, self.mesh, self.decode, name="attn")(
             RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions
         )
         x = self._seq_shard(x)
@@ -170,16 +220,20 @@ class Block(nn.Module):
 class Llama(nn.Module):
     cfg: LlamaConfig
     mesh: Optional[Mesh] = None
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, positions=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, T = tokens.shape
-        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32),
+                                         (B, T))
         x = nn.Embed(cfg.vocab, cfg.dim, dtype=dtype, name="embed")(tokens)
         for i in range(cfg.n_layers):
-            x = Block(cfg, self.mesh, name=f"layer_{i}")(x, positions)
+            x = Block(cfg, self.mesh, self.decode,
+                      name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(cfg.vocab, use_bias=False, dtype=dtype,
                           name="lm_head")(x)

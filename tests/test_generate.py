@@ -1,0 +1,87 @@
+"""KV-cache autoregressive generation (models/generate.py).
+
+Anchor: greedy decode through the cache must emit EXACTLY the tokens of
+the naive oracle that re-runs the full forward on the growing sequence
+each step — the cache is an execution optimization, not a different
+model.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_vgpu_scheduler_tpu.models.generate import generate, jit_generate
+from k8s_vgpu_scheduler_tpu.models.llama import Llama, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(llama_tiny(), dtype="float32")
+    model = Llama(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    return cfg, model, params, prompt
+
+
+def oracle_greedy(model, params, prompt, n):
+    """Full forward on the growing sequence each step (no cache)."""
+    toks = prompt
+    for _ in range(n):
+        logits = model.apply(params, toks)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    return toks
+
+
+class TestGreedyParity:
+    def test_cache_decode_matches_full_recompute(self, setup):
+        cfg, model, params, prompt = setup
+        n = 8
+        want = oracle_greedy(model, params, prompt, n)
+        got = generate(cfg, params, prompt, n)
+        assert got.shape == (2, 5 + n)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_jit_generate_compiles_once_and_matches(self, setup):
+        cfg, model, params, prompt = setup
+        run = jit_generate(cfg, max_new_tokens=6)
+        got = run(params, prompt)
+        want = oracle_greedy(model, params, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # Second call with different data reuses the compilation.
+        prompt2 = (prompt + 1) % cfg.vocab
+        got2 = run(params, prompt2)
+        assert got2.shape == got.shape
+
+    def test_gqa_config_decodes(self, setup):
+        # n_heads=8, n_kv_heads=4 in llama_tiny: the cache stores
+        # unrepeated kv heads; parity proves the repetition logic.
+        cfg, model, params, prompt = setup
+        assert cfg.n_heads != cfg.n_kv_heads  # the fixture IS GQA
+        want = oracle_greedy(model, params, prompt, 4)
+        got = generate(cfg, params, prompt, 4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSampling:
+    def test_temperature_sampling_reproducible_and_in_range(self, setup):
+        cfg, model, params, prompt = setup
+        rng = jax.random.PRNGKey(7)
+        a = generate(cfg, params, prompt, 6, temperature=0.8, rng=rng)
+        b = generate(cfg, params, prompt, 6, temperature=0.8, rng=rng)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert int(jnp.max(a)) < cfg.vocab and int(jnp.min(a)) >= 0
+        c = generate(cfg, params, prompt, 6, temperature=0.8,
+                     rng=jax.random.PRNGKey(8))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_cache_too_small_raises(self, setup):
+        cfg, model, params, prompt = setup
+        small = dataclasses.replace(cfg, decode_cache_len=3)
+        dec = Llama(small, decode=True)
+        with pytest.raises(ValueError, match="decode_cache_len"):
+            dec.apply({"params": params["params"]}, prompt,
+                      mutable=["cache"])
