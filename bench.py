@@ -85,6 +85,9 @@ PRIMARY = "resnet_v2_50_inference_bf16_b50_346"
 # the real MXU, measured at long sequence.  Run after the model cases with
 # leftover budget; never in degraded (CPU) mode.
 FLASH_CASE = "flash_attention_microbench"
+# Flagship serving: KV-cache autoregressive decode, tokens/s (no reference
+# analog — the reference has no LLM; extra on-chip-only metric).
+DECODE_CASE = "llama_decode_microbench"
 
 _START = time.monotonic()
 
@@ -335,6 +338,10 @@ def main() -> None:
             if not degraded and remaining() > 120 and not _WORKER_OVERRAN:
                 matrix.append(run_flash_case(env, tmpdir,
                                              min(remaining() - 30, 180.0)))
+            if not degraded and remaining() > 120 and not _WORKER_OVERRAN:
+                matrix.append(run_worker_case(
+                    DECODE_CASE, "--decode-worker", env, tmpdir,
+                    min(remaining() - 30, 180.0), unit="tokens/s"))
     except Exception as e:  # noqa: BLE001 — emission must survive anything
         if not emitted.get("value"):
             emitted["error"] = f"harness: {e!r}"
@@ -384,18 +391,23 @@ def main() -> None:
 
 def run_flash_case(env: dict, tmpdir: str, timeout: float):
     """Flash-vs-naive attention microbench in a worker subprocess."""
-    out = os.path.join(tmpdir, f"{FLASH_CASE}.json")
-    argv = [sys.executable, os.path.abspath(__file__), "--flash-worker",
-            "--out", out]
     # No shim/ballast in this worker: the naive reference deliberately
     # materializes the O(T²) score tensor, far beyond a 3000 MiB grant —
     # the case measures kernel quality, not enforcement.
+    return run_worker_case(FLASH_CASE, "--flash-worker", env, tmpdir,
+                           timeout, unit="x-speedup")
+
+
+def run_worker_case(name: str, flag: str, env: dict, tmpdir: str,
+                    timeout: float, unit: str):
+    out = os.path.join(tmpdir, f"{name}.json")
+    argv = [sys.executable, os.path.abspath(__file__), flag, "--out", out]
     wenv = dict(env)
     wenv["VTPU_BALLAST"] = "0"
-    log(f"case {FLASH_CASE}: timeout={timeout:.0f}s")
+    log(f"case {name}: timeout={timeout:.0f}s")
     return collect_worker(
-        FLASH_CASE, argv, wenv, out, timeout,
-        {"metric": FLASH_CASE, "value": 0.0, "unit": "x-speedup",
+        name, argv, wenv, out, timeout,
+        {"metric": name, "value": 0.0, "unit": unit,
          "error": "worker failed or timed out"})
 
 
@@ -408,6 +420,9 @@ def flash_worker(out_path: str) -> None:
     meaningful datum) records an error row instead of losing the run."""
     sys.path.insert(0, REPO)
     import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from k8s_vgpu_scheduler_tpu.ops import flash_attention as fa
@@ -463,6 +478,56 @@ def flash_worker(out_path: str) -> None:
         except Exception as e:  # noqa: BLE001 — keep earlier rows
             rows.append({"seq": T, "error": f"{type(e).__name__}: {e}"[:200]})
         write()
+
+
+def decode_worker(out_path: str) -> None:
+    """Flagship KV-cache decode throughput (models/generate.py): batch 8,
+    prompt 128, 128 new tokens on a ~110M-param decoder, bf16."""
+    sys.path.insert(0, REPO)
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # Env var alone does not stop a sitecustomize-registered TPU
+        # plugin from initializing (see probe_backend).
+        jax.config.update("jax_platforms", "cpu")
+
+    from k8s_vgpu_scheduler_tpu.models.generate import jit_generate
+    from k8s_vgpu_scheduler_tpu.models.llama import Llama, LlamaConfig
+
+    if os.environ.get("BENCH_DECODE_TINY") == "1":
+        # Smoke-test sizing (1-core CPU boxes); the real case never runs
+        # degraded so this is test-only.
+        cfg = LlamaConfig(vocab=256, dim=128, n_layers=2, n_heads=8,
+                          n_kv_heads=4, ffn_hidden=256)
+        B, P, N = 2, 16, 16
+    else:
+        cfg = LlamaConfig(vocab=8192, dim=768, n_layers=12, n_heads=12,
+                          n_kv_heads=4, ffn_hidden=2048)
+        B, P, N = 8, 128, 128
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, P), 0, cfg.vocab)
+    params = jax.jit(Llama(cfg).init)(jax.random.PRNGKey(0), prompt)
+    run = jit_generate(cfg, max_new_tokens=N)
+    # Compile + warmup; the host fetch of the token array makes wall time
+    # honest on tunneled backends.
+    toks = run(params, prompt)
+    first = toks[0, -1].item()
+    t0 = time.perf_counter()
+    reps = 3
+    for i in range(reps):
+        toks = run(params, (prompt + i) % cfg.vocab)
+        toks[0, -1].item()
+    dt = (time.perf_counter() - t0) / reps
+    result = {
+        "metric": DECODE_CASE, "unit": "tokens/s",
+        "value": round(B * N / dt, 1),
+        "platform": jax.devices()[0].platform,
+        "config": {"params_m": round(sum(
+            x.size for x in jax.tree_util.tree_leaves(params)) / 1e6, 1),
+            "batch": B, "prompt": P, "new_tokens": N,
+            "dtype": cfg.dtype, "warmup_token": first},
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f)
 
 
 # ----------------------------------------------------------------------------
@@ -590,14 +655,18 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
 
 
 if __name__ == "__main__":
-    if "--flash-worker" in sys.argv:
+    if "--flash-worker" in sys.argv or "--decode-worker" in sys.argv:
         import argparse
 
         p = argparse.ArgumentParser()
         p.add_argument("--flash-worker", action="store_true")
+        p.add_argument("--decode-worker", action="store_true")
         p.add_argument("--out", required=True)
         a = p.parse_args()
-        flash_worker(a.out)
+        if a.decode_worker:
+            decode_worker(a.out)
+        else:
+            flash_worker(a.out)
     elif "--worker" in sys.argv:
         import argparse
 
