@@ -8,8 +8,10 @@ recompilation per step, cache updates via ``dynamic_update_slice`` (the
 XLA-friendly decode layout).
 
 Sampling: greedy (temperature=0) or temperature sampling with a PRNG key.
-Prompts in a batch must share one length (ragged batches need bucketing
-or per-row generation; padding-aware positions are not implemented).
+Ragged batches: LEFT-pad prompts to a common length and pass
+``prompt_lens`` — pad slots get the cache-position sentinel so no real
+query ever attends them, and each row's logical positions start at 0 at
+its first real token.
 """
 
 from __future__ import annotations
@@ -20,7 +22,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .llama import Llama, LlamaConfig
+from .llama import Llama, LlamaConfig, PAD_POSITION
 
 
 def _sample(logits, temperature: float, rng):
@@ -33,11 +35,13 @@ def _sample(logits, temperature: float, rng):
 
 def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
              temperature: float = 0.0,
-             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+             rng: Optional[jax.Array] = None,
+             prompt_lens: Optional[jax.Array] = None) -> jnp.ndarray:
     """prompt: [B, P] int32 -> [B, P + max_new_tokens] tokens.
 
-    Jit-compatible end to end; wrap in ``jax.jit(..., static_argnums=0)``
-    via :func:`jit_generate` for the compiled form.
+    ``prompt_lens`` [B]: real length of each LEFT-padded row (defaults to
+    P for all rows).  Jit-compatible end to end; wrap via
+    :func:`jit_generate` for the compiled form.
     """
     B, P = prompt.shape
     total = P + max_new_tokens
@@ -50,27 +54,42 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
 
     if max_new_tokens <= 0:
         return prompt
-    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    if prompt_lens is None:
+        prompt_lens = jnp.full((B,), P, jnp.int32)
+    # Out-of-range lengths would silently shift every RoPE phase.
+    prompt_lens = jnp.clip(prompt_lens.astype(jnp.int32), 1, P)
+    pad = P - prompt_lens                                    # [B]
+    slots = jnp.arange(P, dtype=jnp.int32)
+    # Row b's first real token sits at slot pad_b with logical position 0;
+    # pad slots carry the sentinel so no real query ever attends them.
+    positions = jnp.where(slots[None, :] >= pad[:, None],
+                          slots[None, :] - pad[:, None], PAD_POSITION)
+    # One slot->position map shared by every layer (Attention requires it
+    # instead of duplicating the array per layer in its cache).
+    key_pos = jnp.full((B, total), PAD_POSITION, jnp.int32)
+    key_pos = key_pos.at[:, :P].set(positions)
     logits, state = model.apply({"params": params["params"]}, prompt,
-                                positions, mutable=["cache"])
+                                positions, key_pos, mutable=["cache"])
     cache = state["cache"]
     first = _sample(logits[:, -1], temperature,
                     None if rng is None else jax.random.fold_in(rng, 0))
 
     def step(carry, i):
-        cache, tok = carry
-        pos = jnp.broadcast_to(P + i, (B, 1)).astype(jnp.int32)
+        cache, key_pos, tok = carry
+        # Logical position continues each row's own sequence.
+        pos = (prompt_lens + i)[:, None]
+        key_pos = jax.lax.dynamic_update_slice(key_pos, pos, (0, P + i))
         logits, st = model.apply(
             {"params": params["params"], "cache": cache},
-            tok[:, None], pos, mutable=["cache"])
+            tok[:, None], pos, key_pos, mutable=["cache"])
         key = None if rng is None else jax.random.fold_in(rng, i + 1)
         nxt = _sample(logits[:, -1], temperature, key)
-        return (st["cache"], nxt), nxt
+        return (st["cache"], key_pos, nxt), nxt
 
     # n-1 steps: the prefill already produced token 1, each step emits
     # the next — no forward is ever run whose sample gets discarded.
     _, rest = jax.lax.scan(
-        step, (cache, first),
+        step, (cache, key_pos, first),
         jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
     new_tokens = jnp.concatenate(
         [first[:, None], rest.transpose(1, 0)], axis=1)
@@ -79,11 +98,12 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
 
 def jit_generate(cfg: LlamaConfig, max_new_tokens: int,
                  temperature: float = 0.0):
-    """Compiled generate: returns fn(params, prompt[, rng]) -> tokens."""
+    """Compiled generate: fn(params, prompt[, rng, prompt_lens])."""
 
     @jax.jit
-    def run(params, prompt, rng=None):
+    def run(params, prompt, rng=None, prompt_lens=None):
         return generate(cfg, params, prompt, max_new_tokens,
-                        temperature=temperature, rng=rng)
+                        temperature=temperature, rng=rng,
+                        prompt_lens=prompt_lens)
 
     return run

@@ -85,21 +85,29 @@ class RMSNorm(nn.Module):
         return (normed * scale).astype(x.dtype)
 
 
-def _cached_attention(q, k_all, v_all, q_pos):
+# Cache-position sentinel for slots that must never be attended (unwritten
+# slots and left-padding): larger than any real position, so the causal
+# mask "key_pos <= query_pos" excludes them for every query.  A plain int
+# — a jnp scalar here would initialize the jax backend at import time,
+# breaking the import-before-jax.distributed.initialize contract
+# (parallel/multihost.py).
+PAD_POSITION = 2 ** 30
+
+
+def _cached_attention(q, k_all, v_all, q_pos, key_pos):
     """q: [B,T,H,D] against the UNREPEATED cache [B,L,KV,D] — GQA query
     groups attend their kv head via a grouped einsum (no head-repeated
-    cache copy per decode step).  Key l attends iff l <= the query's
-    absolute position; unwritten cache slots sit beyond every valid
-    position, so the same mask excludes them."""
+    cache copy per decode step).  ``key_pos`` [B,L] holds each cache
+    slot's LOGICAL position (PAD_POSITION when invalid); key slot l
+    attends iff key_pos[l] <= the query's logical position, which covers
+    causality, unwritten slots and left-padding in one comparison."""
     B, T, H, D = q.shape
     KV = k_all.shape[2]
     qg = q.reshape(B, T, KV, H // KV, D)
     scale = 1.0 / (D ** 0.5)
     logits = jnp.einsum("btkrd,blkd->bkrtl", qg, k_all).astype(jnp.float32)
     logits = logits * scale
-    L = k_all.shape[1]
-    key_pos = jnp.arange(L, dtype=jnp.int32)
-    mask = key_pos[None, None, :] <= q_pos[:, :, None]       # [B,T,L]
+    mask = key_pos[:, None, :] <= q_pos[:, :, None]          # [B,T,L]
     logits = jnp.where(mask[:, None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkrtl,blkd->btkrd", probs.astype(v_all.dtype), v_all)
@@ -112,7 +120,7 @@ class Attention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, key_positions=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, T, _ = x.shape
@@ -135,6 +143,13 @@ class Attention(nn.Module):
             L = cfg.decode_cache_len
             if L < T:
                 raise ValueError(f"decode_cache_len {L} < input length {T}")
+            if key_positions is None:
+                # The slot->position map is shared by every layer; the
+                # caller (models/generate.py) maintains ONE copy rather
+                # than n_layers identical cache arrays.
+                raise ValueError("decode mode requires key_positions "
+                                 "([B, decode_cache_len] logical "
+                                 "positions, PAD_POSITION for invalid)")
             ck = self.variable(
                 "cache", "k", jnp.zeros,
                 (B, L, cfg.n_kv_heads, cfg.head_dim), dtype)
@@ -149,7 +164,8 @@ class Attention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(dtype), (0, cur, 0, 0))
             idx.value = cur + T
-            out = _cached_attention(q, ck.value, cv.value, positions)
+            out = _cached_attention(q, ck.value, cv.value, positions,
+                                    key_positions)
             out = out.astype(dtype)
         else:
             # GQA: repeat kv heads up to the query head count.
@@ -189,9 +205,10 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, key_positions=None):
         x = x + Attention(self.cfg, self.mesh, self.decode, name="attn")(
-            RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions
+            RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions,
+            key_positions
         )
         x = self._seq_shard(x)
         h = RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x)
@@ -223,7 +240,7 @@ class Llama(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, positions=None):
+    def __call__(self, tokens, positions=None, key_positions=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, T = tokens.shape
@@ -233,7 +250,7 @@ class Llama(nn.Module):
         x = nn.Embed(cfg.vocab, cfg.dim, dtype=dtype, name="embed")(tokens)
         for i in range(cfg.n_layers):
             x = Block(cfg, self.mesh, self.decode,
-                      name=f"layer_{i}")(x, positions)
+                      name=f"layer_{i}")(x, positions, key_positions)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(cfg.vocab, use_bias=False, dtype=dtype,
                           name="lm_head")(x)

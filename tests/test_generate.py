@@ -66,6 +66,49 @@ class TestGreedyParity:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+class TestRaggedPrompts:
+    def test_left_padded_rows_match_their_unpadded_decode(self, setup):
+        """Two rows with real lengths 3 and 5 left-padded to 5: each row's
+        greedy continuation must equal generating that row alone,
+        unpadded — pad slots are invisible to real queries and logical
+        positions start at each row's own 0."""
+        cfg, model, params, _ = setup
+        n = 6
+        short = jax.random.randint(jax.random.PRNGKey(11), (1, 3),
+                                   0, cfg.vocab)
+        full = jax.random.randint(jax.random.PRNGKey(12), (1, 5),
+                                  0, cfg.vocab)
+        pad = jnp.zeros((1, 2), jnp.int32)
+        batch = jnp.concatenate([
+            jnp.concatenate([pad, short], axis=1),   # left-padded row
+            full,
+        ], axis=0)
+        lens = jnp.array([3, 5], jnp.int32)
+
+        got = generate(cfg, params, batch, n, prompt_lens=lens)
+        want_short = generate(cfg, params, short, n)
+        want_full = generate(cfg, params, full, n)
+        np.testing.assert_array_equal(np.asarray(got[0, -n:]),
+                                      np.asarray(want_short[0, -n:]))
+        np.testing.assert_array_equal(np.asarray(got[1, -n:]),
+                                      np.asarray(want_full[0, -n:]))
+
+    def test_pad_content_is_irrelevant(self, setup):
+        """Garbage in the pad slots must not change any output token."""
+        cfg, model, params, _ = setup
+        short = jax.random.randint(jax.random.PRNGKey(13), (1, 4),
+                                   0, cfg.vocab)
+        lens = jnp.array([4], jnp.int32)
+        a = generate(cfg, params, jnp.concatenate(
+            [jnp.zeros((1, 3), jnp.int32), short], axis=1), 5,
+            prompt_lens=lens)
+        b = generate(cfg, params, jnp.concatenate(
+            [jnp.full((1, 3), 7, jnp.int32), short], axis=1), 5,
+            prompt_lens=lens)
+        np.testing.assert_array_equal(np.asarray(a[:, -5:]),
+                                      np.asarray(b[:, -5:]))
+
+
 class TestSampling:
     def test_temperature_sampling_reproducible_and_in_range(self, setup):
         cfg, model, params, prompt = setup
