@@ -56,6 +56,20 @@ class TestGreedyParity:
         got2 = run(params, prompt2)
         assert got2.shape == got.shape
 
+    def test_moe_config_decodes(self):
+        """The MoE flagship variant generates through the same cache path
+        (router sow is a no-op outside mutable 'losses')."""
+        cfg = dataclasses.replace(llama_tiny(), dtype="float32",
+                                  n_experts=2, moe_capacity_factor=2.0)
+        model = Llama(cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0,
+                                    cfg.vocab)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        params = {"params": params["params"]}
+        want = oracle_greedy(model, params, prompt, 5)
+        got = generate(cfg, params, prompt, 5)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_gqa_config_decodes(self, setup):
         # n_heads=8, n_kv_heads=4 in llama_tiny: the cache stores
         # unrepeated kv heads; parity proves the repetition logic.

@@ -1,0 +1,67 @@
+"""Scenario artifact emit policy (benchmarks/scenarios.py).
+
+Same evidence monotonicity as bench.merge_matrix: a degraded or failed
+rerun must never destroy this round's on-chip pass (the backend wedging
+between scenario invocations is a normal mid-round event, DIAG_r03.txt).
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "scenarios", os.path.join(REPO, "benchmarks", "scenarios.py"))
+scenarios = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(scenarios)
+
+
+@pytest.fixture
+def sandbox(tmp_path, monkeypatch):
+    monkeypatch.setattr(scenarios, "REPO", str(tmp_path))
+    monkeypatch.setattr(scenarios, "ROUND", "rtest")
+    return tmp_path
+
+
+def read(tmp_path, name):
+    with open(tmp_path / f"{name.upper()}_rtest.json") as f:
+        return json.load(f)
+
+
+class TestEmitRanking:
+    def test_degraded_cannot_displace_onchip_pass(self, sandbox):
+        scenarios.emit("demo", {"passed": True, "platform": "tpu"})
+        scenarios.emit("demo", {"passed": True, "degraded": True,
+                                "platform": "cpu"})
+        art = read(sandbox, "demo")
+        assert "degraded" not in art and art["platform"] == "tpu"
+        with open(sandbox / "DEMO_rtest.displaced.json") as f:
+            assert json.load(f)["degraded"] is True
+
+    def test_failed_cannot_displace_degraded_pass(self, sandbox):
+        scenarios.emit("demo", {"passed": True, "degraded": True})
+        scenarios.emit("demo", {"passed": False})
+        assert read(sandbox, "demo")["passed"] is True
+
+    def test_upgrades_and_equal_rank_latest_wins(self, sandbox):
+        scenarios.emit("demo", {"passed": True, "degraded": True, "v": 1})
+        scenarios.emit("demo", {"passed": True, "v": 2})     # upgrade
+        assert read(sandbox, "demo")["v"] == 2
+        scenarios.emit("demo", {"passed": True, "v": 3})     # equal rank
+        assert read(sandbox, "demo")["v"] == 3
+
+    def test_fresh_write_any_rank(self, sandbox):
+        scenarios.emit("demo", {"passed": False, "error": "x"})
+        assert read(sandbox, "demo")["passed"] is False
+
+    def test_strict_judges_current_run_not_kept_artifact(self, sandbox):
+        """A failing rerun displaced by a prior pass must still count as
+        failed for --strict (emit records this run's outcome)."""
+        scenarios.emit("demo", {"passed": True, "platform": "tpu"})
+        assert scenarios.LAST_RESULTS["demo"] is True
+        scenarios.emit("demo", {"passed": False, "error": "regressed"})
+        assert read(sandbox, "demo")["passed"] is True   # file keeps pass
+        assert scenarios.LAST_RESULTS["demo"] is False   # strict sees fail
