@@ -91,6 +91,8 @@ def emit(name: str, payload: dict) -> None:
             prior = json.load(f)
     except (OSError, json.JSONDecodeError):
         prior = None
+    if not isinstance(prior, dict):  # corrupt artifact must not break emit
+        prior = None
     if prior is not None and _artifact_rank(payload) < _artifact_rank(prior):
         side = os.path.join(REPO, f"{name.upper()}_{ROUND}.displaced.json")
         with open(side, "w") as f:

@@ -22,6 +22,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import optax
 from jax.sharding import Mesh
 
 from ..models.llama import Block, LlamaConfig, Llama, RMSNorm
@@ -87,3 +88,33 @@ def llama_pp_loss(cfg: LlamaConfig, outer, stage_params, tokens, *,
 
 def place_stage_params(mesh: Mesh, stage_params):
     return jax.device_put(stage_params, stage_sharding(mesh, stage_params))
+
+
+def pp_train_step(cfg: LlamaConfig, optimizer, mesh: Mesh, n_micro: int):
+    """Jitted pipeline-parallel training step.
+
+    Returns ``step((outer, stages, opt_state), tokens) -> (new_state,
+    loss)`` — gradients flow through the GPipe schedule, the optimizer
+    update applies to the replicated outer params and the pp-sharded
+    stage stacks alike (optax is shape-blind; shardings are preserved by
+    the update arithmetic).  The input state is DONATED: XLA reuses its
+    buffers for the new state (holding both would halve the largest
+    trainable model — the very thing pipeline parallelism exists for)."""
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(state, tokens):
+        outer, stages, opt_state = state
+
+        def loss(outer, stages):
+            return llama_pp_loss(cfg, outer, stages, tokens, mesh=mesh,
+                                 n_micro=n_micro)
+
+        lval, grads = jax.value_and_grad(loss, argnums=(0, 1))(outer,
+                                                               stages)
+        updates, opt_state = optimizer.update(
+            grads, opt_state, (outer, stages))
+        outer, stages = optax.apply_updates((outer, stages), updates)
+        return (outer, stages, opt_state), lval
+
+    return step
