@@ -42,9 +42,13 @@ def parse_args(argv=None):
     p.add_argument("--resource-cores", default="google.com/tpucores")
     p.add_argument("--resource-priority", default="vtpu.dev/task-priority")
     p.add_argument("--topology-policy", default="best-effort")
-    # The watch loop (informer parity) is the primary event path; the
-    # periodic full resync is a safety net only, so its default is long.
-    p.add_argument("--resync-seconds", type=float, default=300.0)
+    # With the watch loop (informer parity) as the primary event path the
+    # periodic full resync is a safety net only, so its default is long;
+    # in resync-only mode (--no-watch, or a client without watch support)
+    # it IS the delete path and defaults back to the tight 30s.
+    p.add_argument("--resync-seconds", type=float, default=None,
+                   help="full reconcile interval (default: 300 with the "
+                        "watch, 30 without)")
     p.add_argument("--no-watch", action="store_true",
                    help="disable the pod watch stream; rely on resync only")
     p.add_argument("--debug", action="store_true",
@@ -114,8 +118,17 @@ def main(argv=None):
     # would double-book chips already granted to running pods.
     initial_rv = scheduler.resync_from_apiserver()
 
+    from ..k8s.client import KubeClient
+
+    # Clients that never overrode the abstract watch fall to resync-only.
+    watch_enabled = (not args.no_watch
+                     and type(client).watch_pods_events
+                     is not KubeClient.watch_pods_events)
+    if args.resync_seconds is None:
+        args.resync_seconds = 300.0 if watch_enabled else 30.0
+
     watch_stop = threading.Event()
-    if not args.no_watch:
+    if watch_enabled:
         threading.Thread(target=run_watch_loop,
                          args=(scheduler, watch_stop),
                          kwargs={"initial_rv": initial_rv},

@@ -85,9 +85,10 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
         # outs: [n_ticks, micro, ...] — microbatch m leaves the last stage
         # at tick m + n_stages - 1.  Replicate the last stage's outputs so
         # every shard returns the same tensor (psum over the pp axis: all
-        # other stages contributed zeros).
-        outs = jax.lax.psum(outs, axis_name)
-        return outs[n_stages - 1:]
+        # other stages contributed zeros).  Slice BEFORE the collective:
+        # the fill-ramp ticks are all zeros and all-reducing them would be
+        # pure wasted ICI/DCN bandwidth.
+        return jax.lax.psum(outs[n_stages - 1:], axis_name)
 
     data_spec = P(None, batch_axis) if batch_axis else P()
     in_specs = (jax.tree_util.tree_map(lambda _: P(axis_name), stage_params),

@@ -97,6 +97,28 @@ class Scheduler:
         self.pods = PodManager()
         self.gangs = GangManager()
         self._filter_lock = threading.Lock()
+        # uid -> monotonic time of its DELETE.  k8s uids never return, so
+        # a replayed ADDED for one of these (a resync list older than the
+        # delete) must be ignored or it re-books a dead pod's chips.
+        # Entries older than the horizon are pruned — no resync list can
+        # be that stale.
+        self._deleted_uids: Dict[str, float] = {}
+        self._deleted_horizon_s = 900.0
+
+    def _note_deleted(self, uid: str) -> None:
+        now = time.monotonic()
+        cutoff = now - self._deleted_horizon_s
+        if len(self._deleted_uids) > 4096:
+            self._deleted_uids = {u: t for u, t in
+                                  self._deleted_uids.items() if t >= cutoff}
+        self._deleted_uids[uid] = now
+
+    def _deleted_since(self, uid: str):
+        t = self._deleted_uids.get(uid)
+        if t is not None and t < time.monotonic() - self._deleted_horizon_s:
+            del self._deleted_uids[uid]
+            return None
+        return t
 
     # -- registration stream (gRPC DeviceService.Register) --------------------
     def handle_register_stream(self, request_iterator, context=None) -> str:
@@ -133,9 +155,15 @@ class Scheduler:
             # releases it, via the gang registry too.
             if event == "DELETED" or is_pod_terminated(pod):
                 self.gangs.drop_member(uid)
+                self._note_deleted(uid)
             elif self.gangs.is_reserved(uid):
                 return
             self.pods.del_pod(uid)
+            return
+        if event == "ADDED" and self._deleted_since(uid) is not None:
+            # Stale replay (a resync list taken before the watch processed
+            # this pod's DELETE): re-adding would re-book a dead pod's
+            # chips for a full resync period.
             return
         encoded = anns.get(ASSIGNED_IDS_ANNOTATION, "")
         if not encoded:

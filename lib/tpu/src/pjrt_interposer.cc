@@ -152,7 +152,12 @@ void map_client_devices(PJRT_Client* client) {
   }
   std::lock_guard<std::mutex> g(g_mu);
   for (size_t i = 0; i < a.num_addressable_devices; ++i)
-    g_dev_slot[a.addressable_devices[i]] = (int)i;
+    // Region/limiter state (incl. g_last_completion_us) is sized
+    // VTPU_MAX_DEVICES; clients exposing more devices (e.g. a CPU plugin
+    // forced to 32 host devices) fold the overflow onto the last slot
+    // rather than indexing out of bounds.
+    g_dev_slot[a.addressable_devices[i]] =
+        (int)(i < VTPU_MAX_DEVICES ? i : VTPU_MAX_DEVICES - 1);
 }
 
 uint64_t element_bytes_x8(PJRT_Buffer_Type t) {  // bits, to handle sub-byte
@@ -293,7 +298,14 @@ PJRT_Error* Client_BufferFromHostBuffer(
   }
   bool charge = true;
   int slot = 0;
-  if (args->memory) {
+  // `memory` is a late-appended args member: callers compiled against an
+  // older PJRT header allocate a smaller struct, so reading it must be
+  // gated on their struct_size (the args-struct analog of the table's
+  // append-only ABI rule).
+  bool has_memory_member =
+      args->struct_size > offsetof(PJRT_Client_BufferFromHostBuffer_Args,
+                                   memory);
+  if (has_memory_member && args->memory) {
     // Memory-based placement (how jax targets non-default memories,
     // including pinned_host — the oversubscription path): host-kind
     // destinations consume no HBM; device-kind ones charge the slot of
@@ -404,6 +416,19 @@ size_t num_outputs_of(PJRT_LoadedExecutable* lx) {
   return n;
 }
 
+PJRT_Error* LoadedExecutable_Destroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  // Invalidate the output-count cache: the allocator can reuse this
+  // address for a new executable with a different output arity, and a
+  // stale count would walk output_lists past its real end.  Also bounds
+  // the map's growth in long-lived processes.
+  if (args && args->executable) {
+    std::lock_guard<std::mutex> g(g_mu);
+    g_num_outputs.erase(args->executable);
+  }
+  return g_real->PJRT_LoadedExecutable_Destroy(args);
+}
+
 void exec_slots(PJRT_LoadedExecutable_Execute_Args* args,
                 std::vector<int>* out) {
   if (args->execute_device) {
@@ -456,6 +481,7 @@ void on_exec_complete(PJRT_Error* error, void* user_arg) {
     destroy_real_error(error);
   } else {
     int slot = i < t->slots.size() ? t->slots[i] : 0;
+    if (slot < 0 || slot >= VTPU_MAX_DEVICES) slot = 0;  // never index OOB
     uint64_t now = now_us();
     uint64_t prev = g_last_completion_us[slot].exchange(now);
     uint64_t busy_from = t->start_us > prev ? t->start_us : prev;
@@ -637,6 +663,7 @@ extern "C" const PJRT_Api* GetPjrtApi(void) {
       g_api.PJRT_Buffer_CopyToMemory = Buffer_CopyToMemory;
     g_api.PJRT_Buffer_Destroy = Buffer_Destroy;
     g_api.PJRT_LoadedExecutable_Execute = LoadedExecutable_Execute;
+    g_api.PJRT_LoadedExecutable_Destroy = LoadedExecutable_Destroy;
     g_api.PJRT_Device_MemoryStats = Device_MemoryStats;
 
     // Enforcement only inside vtpu-managed containers (same gate as

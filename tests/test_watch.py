@@ -226,6 +226,27 @@ class TestResyncRaceGuards:
         assert s.pods.get("uraced") is not None, \
             "resync pruned a grant recorded after its list snapshot"
 
+    def test_stale_list_replay_cannot_resurrect_deleted_pod(self):
+        """A resync list snapshotted BEFORE a pod's DELETE must not re-add
+        its grant when the replay loop reaches it after the watch already
+        freed it — a resurrected dead pod would re-book its chips for a
+        full resync period."""
+        kube, s = self._sched()
+        pod = tpu_pod(name="victim", uid="uvictim")
+        kube.create_pod(pod)
+        r = s.filter(pod, ["node-a"])
+        assert r.node == "node-a"
+        assert s.pods.get("uvictim") is not None
+        granted = kube.get_pod("default", "victim")  # with assigned ids
+
+        # Watch thread processes the DELETE...
+        s.on_pod_event("DELETED", granted)
+        assert s.pods.get("uvictim") is None
+        # ...then the concurrent resync replays its stale list entry.
+        s.on_pod_event("ADDED", granted)
+        assert s.pods.get("uvictim") is None, \
+            "stale ADDED replay resurrected a deleted pod's grant"
+
     def test_resync_prune_does_not_tombstone_live_gang_uids(self):
         kube, s = self._sched()
         from k8s_vgpu_scheduler_tpu.scheduler.gang import (
