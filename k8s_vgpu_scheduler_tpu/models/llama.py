@@ -37,9 +37,11 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     # "full" | "ring"; ring shards the sequence over the mesh's sp axis.
     attention: str = "full"
-    # >0 switches the FFN to a top-1-routed MoE (Mixtral-style family);
-    # the stacked expert tensors shard over the mesh's ep axis.
+    # >0 switches the FFN to a top-k-routed MoE (top_k=1 Switch-style,
+    # top_k=2 Mixtral-style); stacked expert tensors shard over the
+    # mesh's ep axis.
     n_experts: int = 0
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     # KV-cache length for decode-mode modules (models/generate.py);
     # prompt length + max new tokens must fit.
@@ -216,6 +218,7 @@ class Block(nn.Module):
             moe_cfg = MoEConfig(
                 dim=self.cfg.dim, ffn_hidden=self.cfg.ffn_hidden,
                 n_experts=self.cfg.n_experts,
+                top_k=self.cfg.moe_top_k,
                 capacity_factor=self.cfg.moe_capacity_factor,
                 dtype=self.cfg.dtype)
             x = x + MoELayer(moe_cfg, self.mesh, name="moe")(h)

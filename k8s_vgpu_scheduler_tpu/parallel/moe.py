@@ -2,8 +2,9 @@
 
 The reference has no model code at all (SURVEY.md §2.3) — this is part of
 the beyond-parity compute path the scheduler's multi-chip grants exist to
-serve.  Switch-Transformer-style top-1 routing with a fixed expert
-capacity, dispatched DENSELY through one-hot einsums: no dynamic shapes,
+serve.  Top-k routing with a fixed expert capacity (top_k=1 is Switch
+Transformer, top_k=2 is Mixtral with gates renormalized over the selected
+experts), dispatched DENSELY through one-hot einsums: no dynamic shapes,
 no sorting — the whole layer is three einsums and a batched expert FFN,
 which is exactly what XLA tiles well onto the MXU.  Experts live in one
 stacked parameter tensor ``[E, ...]`` sharded over ``ep``; with the
@@ -33,6 +34,9 @@ class MoEConfig:
     dim: int
     ffn_hidden: int
     n_experts: int = 8
+    # Experts consulted per token: 1 = Switch Transformer, 2 = Mixtral
+    # (gates renormalized over the selected experts).
+    top_k: int = 1
     # Per-expert token slots per batch: ceil(tokens/E * capacity_factor).
     capacity_factor: float = 1.25
     dtype: str = "bfloat16"
@@ -41,7 +45,10 @@ class MoEConfig:
 
 
 def expert_capacity(tokens: int, cfg: MoEConfig) -> int:
-    cap = math.ceil(tokens / cfg.n_experts * cfg.capacity_factor)
+    """Slots per expert: ceil(k * tokens / E * capacity_factor) — each
+    token consumes top_k expert slots in total."""
+    k = min(cfg.top_k, cfg.n_experts)
+    cap = math.ceil(k * tokens / cfg.n_experts * cfg.capacity_factor)
     return max(1, min(tokens, cap))
 
 
@@ -66,19 +73,40 @@ class MoELayer(nn.Module):
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                           name="router")(xt.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)            # [T, E]
-        expert_idx = jnp.argmax(probs, axis=-1)            # [T]
-        gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+        k = min(cfg.top_k, E)
+        topk_prob, topk_idx = jax.lax.top_k(probs, k)      # [T, k]
+        if k > 1:
+            # Mixtral-style renormalization over the selected experts.
+            topk_gate = topk_prob / jnp.maximum(
+                jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+        else:
+            # Switch eq. 2: y = p_i(x)·E_i(x).  Renormalizing here would
+            # make the gate identically 1.0 — no router gradient from the
+            # task loss and unscaled outputs.
+            topk_gate = topk_prob
 
-        # -- capacity assignment (position of each token in its expert) ------
-        onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [T, E]
-        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [T, E]
-        pos = jnp.sum(pos_in_expert, axis=-1)              # [T]
-        keep = pos < C                                     # overflow dropped
-        # Dispatch/combine tensors (dense one-hots; [T, E, C]).
-        dispatch = (jax.nn.one_hot(expert_idx, E, dtype=dtype)[:, :, None]
-                    * jax.nn.one_hot(pos, C, dtype=dtype)[:, None, :]
-                    * keep[:, None, None].astype(dtype))
-        combine = dispatch * gate[:, None, None].astype(dtype)
+        # -- capacity assignment, rank by rank (classic top-k gating): every
+        # rank's tokens are placed after the slots earlier ranks consumed in
+        # each expert, so no two (token, rank) choices share a slot.
+        counts = jnp.zeros((E,), jnp.int32)
+        dispatch = jnp.zeros((tokens, E, C), dtype)
+        combine = jnp.zeros((tokens, E, C), dtype)
+        top1_onehot = None
+        for r in range(k):
+            oh = jax.nn.one_hot(topk_idx[:, r], E, dtype=jnp.int32)  # [T,E]
+            if r == 0:
+                top1_onehot = oh
+            pos_in_expert = (jnp.cumsum(oh, axis=0) - 1) * oh + \
+                counts[None, :] * oh                       # [T, E]
+            pos = jnp.sum(pos_in_expert, axis=-1)          # [T]
+            keep = pos < C
+            d_r = (oh.astype(dtype)[:, :, None]
+                   * jax.nn.one_hot(pos, C, dtype=dtype)[:, None, :]
+                   * keep[:, None, None].astype(dtype))
+            dispatch = dispatch + d_r
+            combine = combine + d_r * topk_gate[:, r, None, None].astype(
+                dtype)
+            counts = counts + jnp.sum(oh, axis=0)
 
         # -- expert FFNs over the stacked [E, ...] params ---------------------
         expert_in = jnp.einsum("td,tec->ecd", xt.astype(dtype), dispatch)
@@ -97,8 +125,9 @@ class MoELayer(nn.Module):
 
         out = jnp.einsum("ecd,tec->td", expert_out, combine)
 
-        # -- load-balance aux loss (Switch eq. 4: E * Σ_e f_e · P_e) ---------
-        frac_tokens = jnp.mean(onehot.astype(jnp.float32), axis=0)   # f_e
+        # -- load-balance aux loss (Switch eq. 4: E * Σ_e f_e · P_e, with
+        # f_e from the top-1 choice as in the original formulation) ----------
+        frac_tokens = jnp.mean(top1_onehot.astype(jnp.float32), axis=0)
         frac_probs = jnp.mean(probs, axis=0)                         # P_e
         aux = cfg.aux_loss_weight * E * jnp.sum(frac_tokens * frac_probs)
         self.sow("losses", "moe_aux", aux)

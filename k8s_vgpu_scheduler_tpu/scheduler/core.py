@@ -101,24 +101,30 @@ class Scheduler:
         # a replayed ADDED for one of these (a resync list older than the
         # delete) must be ignored or it re-books a dead pod's chips.
         # Entries older than the horizon are pruned — no resync list can
-        # be that stale.
+        # be that stale.  Own lock: the watch and resync threads both call
+        # on_pod_event concurrently.
         self._deleted_uids: Dict[str, float] = {}
+        self._deleted_lock = threading.Lock()
         self._deleted_horizon_s = 900.0
 
     def _note_deleted(self, uid: str) -> None:
         now = time.monotonic()
         cutoff = now - self._deleted_horizon_s
-        if len(self._deleted_uids) > 4096:
-            self._deleted_uids = {u: t for u, t in
-                                  self._deleted_uids.items() if t >= cutoff}
-        self._deleted_uids[uid] = now
+        with self._deleted_lock:
+            if len(self._deleted_uids) > 4096:
+                for u in [u for u, t in self._deleted_uids.items()
+                          if t < cutoff]:
+                    del self._deleted_uids[u]
+            self._deleted_uids[uid] = now
 
     def _deleted_since(self, uid: str):
-        t = self._deleted_uids.get(uid)
-        if t is not None and t < time.monotonic() - self._deleted_horizon_s:
-            del self._deleted_uids[uid]
-            return None
-        return t
+        with self._deleted_lock:
+            t = self._deleted_uids.get(uid)
+            if t is not None and \
+                    t < time.monotonic() - self._deleted_horizon_s:
+                self._deleted_uids.pop(uid, None)
+                return None
+            return t
 
     # -- registration stream (gRPC DeviceService.Register) --------------------
     def handle_register_stream(self, request_iterator, context=None) -> str:

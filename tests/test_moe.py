@@ -58,6 +58,59 @@ class TestRoutingMath:
         served = jnp.sum(jnp.any(jnp.abs(out[0]) > 0, axis=-1))
         assert int(served) == expert_capacity(6, cfg) == 1
 
+    def test_top2_matches_direct_mixture(self):
+        """top_k=2 with 2 experts and ample capacity: every token uses
+        both experts; output must equal the explicitly-computed
+        softmax-weighted mixture of the two expert FFNs (Mixtral gating
+        renormalizes over the selected pair — with E=2 that is the full
+        softmax)."""
+        cfg = MoEConfig(dim=16, ffn_hidden=32, n_experts=2, top_k=2,
+                        capacity_factor=2.0, dtype="float32")
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, 16))
+        params, out, _ = init_and_apply(cfg, x)
+        p = params["params"]
+        logits = x.astype(jnp.float32) @ p["router"]["kernel"]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        def ffn(e, v):
+            h = jax.nn.silu(v @ p["gate_proj"][e]) * (v @ p["up_proj"][e])
+            return h @ p["down_proj"][e]
+
+        want = (probs[..., 0:1] * ffn(0, x) + probs[..., 1:2] * ffn(1, x))
+        np.testing.assert_allclose(out, np.asarray(want), rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_top1_output_scaled_by_router_prob(self):
+        """Switch eq. 2: y = p_i(x)·E_i(x) — the top-1 gate is the
+        router's probability, NOT renormalized to 1.0 (that would cut the
+        router's task-loss gradient)."""
+        cfg = MoEConfig(dim=16, ffn_hidden=32, n_experts=2, top_k=1,
+                        capacity_factor=2.0, dtype="float32")
+        x = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 16))
+        params, out, _ = init_and_apply(cfg, x)
+        p = params["params"]
+        logits = x.astype(jnp.float32) @ p["router"]["kernel"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top = jnp.argmax(probs, axis=-1)
+
+        def ffn(e, v):
+            h = jax.nn.silu(v @ p["gate_proj"][e]) * (v @ p["up_proj"][e])
+            return h @ p["down_proj"][e]
+
+        both = jnp.stack([ffn(0, x), ffn(1, x)], axis=-1)  # [B,S,d,2]
+        chosen = jnp.take_along_axis(
+            both, top[..., None, None], axis=-1)[..., 0]
+        gate = jnp.take_along_axis(probs, top[..., None], axis=-1)
+        np.testing.assert_allclose(out, np.asarray(chosen * gate),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_top2_capacity_counts_both_ranks(self):
+        cfg1 = MoEConfig(dim=4, ffn_hidden=8, n_experts=4, top_k=1,
+                         capacity_factor=1.0)
+        cfg2 = MoEConfig(dim=4, ffn_hidden=8, n_experts=4, top_k=2,
+                         capacity_factor=1.0)
+        assert expert_capacity(16, cfg2) == 2 * expert_capacity(16, cfg1)
+
     def test_aux_loss_sown_and_near_optimal_when_balanced(self):
         cfg = MoEConfig(dim=8, ffn_hidden=16, n_experts=4,
                         capacity_factor=2.0, dtype="float32",

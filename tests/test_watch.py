@@ -174,7 +174,11 @@ class TestWatchLoopE2E:
             pod = tpu_pod(name="a", uid="ua")
             sim.kube.create_pod(pod)
             s.filter(pod, ["node-a"])
-            assert wait_until(lambda: s.pods.get("ua") is not None)
+            # Generous timeouts: this file shares a 1-core CI box with
+            # compile-heavy suites; the behavior, not the latency, is
+            # under test here.
+            assert wait_until(lambda: s.pods.get("ua") is not None,
+                              timeout=15.0)
             # Simulated stream break: server restarts on a new port is not
             # possible mid-fixture, but a journal compaction forces the
             # Gone -> re-list path.
@@ -187,7 +191,7 @@ class TestWatchLoopE2E:
                     sim.kube.create_pod(tpu_pod(name=f"f{i}", uid=f"uf{i}"))
                 sim.kube.delete_pod("default", "a")
                 assert wait_until(lambda: s.pods.get("ua") is None,
-                                  timeout=5.0)
+                                  timeout=15.0)
             finally:
                 fake.JOURNAL_LIMIT = old_limit
         finally:
