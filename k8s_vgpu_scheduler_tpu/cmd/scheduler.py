@@ -63,6 +63,21 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def resolve_watch_and_resync(no_watch: bool, client, resync_seconds):
+    """(watch_enabled, resync_seconds): the watch runs unless disabled or
+    the client never overrode the abstract watch method; with the watch
+    as the primary delete path the resync safety net defaults to 300s,
+    in resync-only mode it IS the delete path and defaults to 30s."""
+    from ..k8s.client import KubeClient
+
+    watch_enabled = (not no_watch
+                     and type(client).watch_pods_events
+                     is not KubeClient.watch_pods_events)
+    if resync_seconds is None:
+        resync_seconds = 300.0 if watch_enabled else 30.0
+    return watch_enabled, resync_seconds
+
+
 def build_config(args) -> Config:
     return Config(
         resources=ResourceNames(
@@ -118,14 +133,8 @@ def main(argv=None):
     # would double-book chips already granted to running pods.
     initial_rv = scheduler.resync_from_apiserver()
 
-    from ..k8s.client import KubeClient
-
-    # Clients that never overrode the abstract watch fall to resync-only.
-    watch_enabled = (not args.no_watch
-                     and type(client).watch_pods_events
-                     is not KubeClient.watch_pods_events)
-    if args.resync_seconds is None:
-        args.resync_seconds = 300.0 if watch_enabled else 30.0
+    watch_enabled, args.resync_seconds = resolve_watch_and_resync(
+        args.no_watch, client, args.resync_seconds)
 
     watch_stop = threading.Event()
     if watch_enabled:

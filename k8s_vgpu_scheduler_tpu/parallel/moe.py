@@ -37,7 +37,7 @@ class MoEConfig:
     # Experts consulted per token: 1 = Switch Transformer, 2 = Mixtral
     # (gates renormalized over the selected experts).
     top_k: int = 1
-    # Per-expert token slots per batch: ceil(tokens/E * capacity_factor).
+    # Per-expert token slots: ceil(top_k * tokens / E * capacity_factor).
     capacity_factor: float = 1.25
     dtype: str = "bfloat16"
     # Load-balancing auxiliary loss weight (Switch Transformer eq. 4).
@@ -53,7 +53,7 @@ def expert_capacity(tokens: int, cfg: MoEConfig) -> int:
 
 
 class MoELayer(nn.Module):
-    """Top-1 routed FFN: ``[B, S, d] -> [B, S, d]`` plus a scalar aux loss
+    """Top-k routed FFN: ``[B, S, d] -> [B, S, d]`` plus a scalar aux loss
     (stored via ``self.sow('losses', 'moe_aux', ...)``)."""
 
     cfg: MoEConfig
@@ -73,6 +73,8 @@ class MoELayer(nn.Module):
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                           name="router")(xt.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)            # [T, E]
+        if cfg.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {cfg.top_k}")
         k = min(cfg.top_k, E)
         topk_prob, topk_idx = jax.lax.top_k(probs, k)      # [T, k]
         if k > 1:

@@ -198,6 +198,24 @@ class TestWatchLoopE2E:
             stop.set()
 
 
+class TestResyncDefaults:
+    def test_resync_default_follows_watch_capability(self):
+        """30s when resync is the delete path, 300s when the watch is
+        (high-review: --no-watch silently inherited the long default)."""
+        from k8s_vgpu_scheduler_tpu.cmd.scheduler import (
+            resolve_watch_and_resync)
+        from k8s_vgpu_scheduler_tpu.k8s.client import KubeClient
+
+        kube = FakeKube()
+        assert resolve_watch_and_resync(False, kube, None) == (True, 300.0)
+        assert resolve_watch_and_resync(True, kube, None) == (False, 30.0)
+        # A client that never overrode the abstract watch: resync-only.
+        assert resolve_watch_and_resync(False, KubeClient(), None) == \
+            (False, 30.0)
+        # An explicit flag always wins.
+        assert resolve_watch_and_resync(True, kube, 7.0) == (False, 7.0)
+
+
 class TestResyncRaceGuards:
     """High-review findings: the periodic resync runs concurrently with the
     watch/filter threads, so its stale list snapshot must never prune (or
