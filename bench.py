@@ -245,6 +245,13 @@ def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
         # ResNet on CPU would blow the budget.
         spec.update(batch=4, size=64, iters=2)
     out = os.path.join(tmpdir, f"{name}.json")
+    # A stale result from an earlier run of the same case (e.g. the
+    # enforced leg before the bare leg) must never be read back as this
+    # run's output.
+    try:
+        os.unlink(out)
+    except OSError:
+        pass
     argv = [sys.executable, os.path.abspath(__file__), "--worker", name,
             "--out", out,
             "--batch", str(spec["batch"]), "--size", str(spec["size"]),
@@ -318,6 +325,30 @@ def main() -> None:
             timeout = max(60.0, min(remaining() - 30, 240.0))
             emitted = run_case(PRIMARY, env, tmpdir, degraded, timeout)
             matrix.append(emitted)
+            # Enforcement overhead: the same case bare-metal (no shim).
+            # The north-star target is enforced within 5% of bare-metal —
+            # the reference's stock-plugin vs vGPU columns made
+            # measurable (README.md:185-189).
+            if not degraded and emitted.get("value") and \
+                    emitted.get("shim") and \
+                    not _WORKER_OVERRAN and remaining() > 150:
+                bare_env = dict(env)
+                bare_env["BENCH_NOSHIM"] = "1"
+                bare = run_case(PRIMARY, bare_env, tmpdir, degraded,
+                                max(60.0, min(remaining() - 30, 240.0)))
+                if bare.get("value"):
+                    matrix.append({
+                        "metric": "enforcement_overhead_resnet50_inf",
+                        "unit": "enforced/bare ratio",
+                        "platform": bare.get("platform"),
+                        "enforced_images_s": emitted["value"],
+                        "bare_images_s": bare["value"],
+                        "value": round(emitted["value"] / bare["value"],
+                                       4),
+                        "overhead_pct": round(
+                            (1 - emitted["value"] / bare["value"]) * 100,
+                            2),
+                    })
             # Extra matrix cases with leftover budget (smallest risk first).
             for name in CASES:
                 if name == PRIMARY or degraded:
@@ -547,13 +578,17 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
         jax.config.update("jax_platforms", "cpu")
 
     shim = None
-    try:
-        from k8s_vgpu_scheduler_tpu.shim import core as shim_core
-        shim = shim_core.install(jax_hooks=False, ballast=None, watchdog=True)
-        result["shim"] = True
-    except Exception as e:  # noqa: BLE001 — run unenforced rather than not at all
-        print(f"worker: shim unavailable ({e!r}); running unenforced",
-              file=sys.stderr)
+    # BENCH_NOSHIM=1 is the bare-metal leg of the enforcement-overhead
+    # comparison (reference README.md:185-189: stock plugin vs vGPU).
+    if os.environ.get("BENCH_NOSHIM") != "1":
+        try:
+            from k8s_vgpu_scheduler_tpu.shim import core as shim_core
+            shim = shim_core.install(jax_hooks=False, ballast=None,
+                                     watchdog=True)
+            result["shim"] = True
+        except Exception as e:  # noqa: BLE001 — run unenforced, not not at all
+            print(f"worker: shim unavailable ({e!r}); running unenforced",
+                  file=sys.stderr)
 
     import jax
     import jax.numpy as jnp
