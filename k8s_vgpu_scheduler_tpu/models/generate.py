@@ -52,6 +52,10 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
         attention="full")
     model = Llama(dcfg, decode=True)
 
+    if temperature != 0.0 and rng is None:
+        # Silently degrading to greedy would make "temperature sampling"
+        # deterministically repeat one completion per prompt.
+        raise ValueError("temperature sampling requires an rng key")
     if max_new_tokens <= 0:
         return prompt
     if prompt_lens is None:

