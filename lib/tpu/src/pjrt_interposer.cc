@@ -426,7 +426,11 @@ PJRT_Error* LoadedExecutable_Destroy(
     std::lock_guard<std::mutex> g(g_mu);
     g_num_outputs.erase(args->executable);
   }
-  return g_real->PJRT_LoadedExecutable_Destroy(args);
+  // Minimal plugins may not implement Destroy; the invalidation above is
+  // still required (WE cached by this address), the passthrough is not.
+  return g_real->PJRT_LoadedExecutable_Destroy
+             ? g_real->PJRT_LoadedExecutable_Destroy(args)
+             : nullptr;
 }
 
 void exec_slots(PJRT_LoadedExecutable_Execute_Args* args,

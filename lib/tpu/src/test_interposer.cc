@@ -223,6 +223,55 @@ int main() {
   CHECK(waited_us < 10000000, "throttle wait bounded");
   rate_test_mode(0);
 
+  // ---- struct_size ABI gate: an old caller's smaller args struct --------
+  // A caller compiled before the `memory` member was appended sets a
+  // smaller struct_size; the interposer must not read (garbage) memory.
+  {
+    PJRT_Client_BufferFromHostBuffer_Args ba;
+    memset(&ba, 0, sizeof(ba));
+    ba.struct_size = offsetof(PJRT_Client_BufferFromHostBuffer_Args,
+                              memory);  // pre-`memory` ABI
+    ba.memory = reinterpret_cast<PJRT_Memory*>(0xdeadbeef);  // garbage
+    ba.client = client;
+    ba.device = dev0;
+    static char data[1024 * 1024];
+    ba.data = data;
+    ba.type = PJRT_Buffer_Type_U8;
+    const int64_t dims[1] = {1024 * 1024};
+    ba.dims = dims;
+    ba.num_dims = 1;
+    ba.host_buffer_semantics =
+        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+    e = api->PJRT_Client_BufferFromHostBuffer(&ba);
+    CHECK(e == nullptr && ba.buffer != nullptr,
+          "old-ABI caller (small struct_size) charged via device path, "
+          "garbage memory member never read");
+  }
+
+  // ---- LoadedExecutable_Destroy: cache invalidation + null passthrough --
+  {
+    PJRT_LoadedExecutable_Destroy_Args xd;
+    memset(&xd, 0, sizeof(xd));
+    xd.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;
+    xd.executable = ea.executable;  // cached by the Execute above
+    e = api->PJRT_LoadedExecutable_Destroy(&xd);
+    CHECK(e == nullptr, "Destroy invalidates the output-count cache and "
+                        "tolerates a plugin without Destroy");
+    // Re-executing after Destroy re-resolves the output count.
+    setenv("MOCK_EXEC_US", "0", 1);
+    PJRT_Buffer* outs2[1] = {nullptr};
+    PJRT_Buffer** out_lists2[1] = {outs2};
+    memset(&ea, 0, sizeof(ea));
+    ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ea.executable = reinterpret_cast<PJRT_LoadedExecutable*>(&ea);
+    ea.num_devices = 1;
+    ea.num_args = 0;
+    ea.output_lists = out_lists2;
+    e = api->PJRT_LoadedExecutable_Execute(&ea);
+    CHECK(e == nullptr && outs2[0] != nullptr,
+          "Execute after Destroy re-resolves output count");
+  }
+
   printf(g_failures ? "RESULT FAIL %d\n" : "RESULT PASS\n", g_failures);
   return g_failures ? 1 : 0;
 }
