@@ -123,6 +123,51 @@ class TestRoutingMath:
         assert 0.9 < val < 2.5
 
 
+class TestRoutingProperty:
+    def test_topk_equals_direct_mixture_for_any_config(self):
+        """Property: with ample capacity, for ANY (E, k, seed) the layer
+        output equals the directly-computed sum of renormalized-gated
+        expert FFNs over each token's top-k experts — the dense one-hot
+        dispatch is pure routing plumbing."""
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        @settings(max_examples=8, deadline=None)
+        @given(E=st.integers(2, 4), k=st.integers(1, 4),
+               seed=st.integers(0, 2 ** 16))
+        def check(E, k, seed):
+            k = min(k, E)
+            cfg = MoEConfig(dim=8, ffn_hidden=16, n_experts=E, top_k=k,
+                            capacity_factor=4.0, dtype="float32")
+            x = jax.random.normal(jax.random.PRNGKey(seed), (2, 6, 8))
+            params, out, _ = init_and_apply(cfg, x)
+            p = params["params"]
+            logits = x.astype(jnp.float32) @ p["router"]["kernel"]
+            probs = jax.nn.softmax(logits, axis=-1)
+            topk_p, topk_i = jax.lax.top_k(probs, k)
+            gates = topk_p / jnp.sum(topk_p, -1, keepdims=True) \
+                if k > 1 else topk_p
+
+            def ffn(e, v):
+                h = jax.nn.silu(v @ p["gate_proj"][e]) * \
+                    (v @ p["up_proj"][e])
+                return h @ p["down_proj"][e]
+
+            stacked = jnp.moveaxis(
+                jnp.stack([ffn(e, x) for e in range(E)]), 0, -1)  # [B,S,d,E]
+            B, S, d = x.shape
+            want = jnp.zeros_like(x)
+            for r in range(k):
+                idx = jnp.broadcast_to(topk_i[..., r][..., None, None],
+                                       (B, S, d, 1))
+                chosen = jnp.take_along_axis(stacked, idx, axis=-1)[..., 0]
+                want = want + gates[..., r][..., None] * chosen
+            np.testing.assert_allclose(out, np.asarray(want),
+                                       rtol=3e-4, atol=3e-4)
+
+        check()
+
+
 class TestExpertParallel:
     def test_ep_sharded_matches_unsharded(self):
         """8 virtual devices as ('ep',): same params, same input, sharded
