@@ -90,6 +90,24 @@ def test_pp_loss_differentiable_through_stages(setup):
     assert per_stage.shape[0] == 4 and bool(jnp.all(per_stage > 0))
 
 
+def test_pp_forward_with_moe_blocks(setup):
+    """pp composes with the MoE family: pipelined MoE blocks match the
+    plain MoE forward (router sow is a no-op outside mutable 'losses',
+    identically on both paths)."""
+    cfg_f32, _, _, tokens = setup
+    cfg = dataclasses.replace(cfg_f32, n_experts=2, moe_capacity_factor=2.0)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(4), tokens[:, :-1])
+    mesh = pp_mesh(4)
+    outer, stages = split_llama_params(cfg, params, 4)
+    stages = place_stage_params(mesh, stages)
+    got = llama_pp_forward(cfg, outer, stages, tokens[:, :-1],
+                           mesh=mesh, n_micro=2)
+    want = model.apply(params, tokens[:, :-1])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_pp_train_step_learns(setup):
     """Three optimizer steps through the pipeline must reduce the loss
     (end-to-end training viability, not just gradient existence)."""
