@@ -122,8 +122,8 @@ _WORKER_OVERRAN = False
 
 def build_native() -> None:
     try:
-        subprocess.run(["make", "-C", os.path.join(REPO, "lib", "tpu")],
-                       check=False, capture_output=True, timeout=90)
+        from k8s_vgpu_scheduler_tpu.util.nativebuild import build_native as nb
+        nb(check=False, timeout=180)
     except subprocess.TimeoutExpired:
         log("native build timed out; continuing (shim may be unavailable)")
     except OSError as e:

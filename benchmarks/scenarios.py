@@ -107,8 +107,8 @@ def emit(name: str, payload: dict) -> None:
 
 
 def build_native() -> None:
-    subprocess.run(["make", "-C", os.path.join(REPO, "lib", "tpu")],
-                   check=False, capture_output=True, timeout=180)
+    from k8s_vgpu_scheduler_tpu.util.nativebuild import build_native as nb
+    nb(check=False, timeout=180)
 
 
 def tpu_available(timeout: float = 210.0) -> bool:
@@ -727,7 +727,7 @@ if FORCE_CPU:
 import jax.numpy as jnp
 from k8s_vgpu_scheduler_tpu.models.llama import LlamaConfig
 from k8s_vgpu_scheduler_tpu.models.train import (
-    init_sharded_state, jit_train_step, offload_state)
+    init_sharded_state, jit_train_step)
 from k8s_vgpu_scheduler_tpu.parallel.mesh import MeshShape, make_mesh
 
 if FORCE_CPU:
@@ -785,10 +785,13 @@ if MODE in ("baseline", "both"):
             raise SystemExit(0)
 
 if MODE in ("offload", "both"):
-    model2, optimizer2, state2, _ = init_sharded_state(cfg, mesh, rng,
-                                                       batch=batch, seq=seq)
-    opt_mib = tree_mib(state2.opt_state)
-    host_state = offload_state(state2)
+    # Host-side opt-state init: under an enforced grant SMALLER than the
+    # optimizer state, init-then-offload would be refused during init
+    # (the state would transit HBM); opt_memory_kind builds it straight
+    # into pinned host memory.
+    model2, optimizer2, host_state, _ = init_sharded_state(
+        cfg, mesh, rng, batch=batch, seq=seq, opt_memory_kind="pinned_host")
+    opt_mib = tree_mib(host_state.opt_state)
     off_step = jit_train_step(model2, optimizer2, mesh, host_state,
                               offload_opt_state=True)
     off_state, off_loss, off_tps = bench(off_step, host_state, tokens, steps)

@@ -15,6 +15,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -145,10 +146,20 @@ class OffloadedTrainStep:
 
 
 def init_sharded_state(cfg: LlamaConfig, mesh: Mesh, rng,
-                       batch: int, seq: int):
+                       batch: int, seq: int,
+                       opt_memory_kind: str = "device"):
     """Initialize params already laid out on the mesh (init on one device,
     then device_put with the rule shardings — fine at validation scale;
-    real checkpoints arrive via orbax restore with the same shardings)."""
+    real checkpoints arrive via orbax restore with the same shardings).
+
+    ``opt_memory_kind="pinned_host"`` is for oversubscription pods whose
+    HBM grant is SMALLER than the optimizer state (reference "virtual
+    device memory"): the state must never exist in device memory, not
+    even transiently during init, or the enforcement layer refuses the
+    init itself.  The leaves are built on the host and placed straight
+    into the target memory kind — exact for adamw, whose init is zeros
+    plus a zero step count (pinned against ``optimizer.init`` in
+    tests/test_train.py)."""
     model = Llama(cfg, mesh)
     tokens = jnp.zeros((batch, seq), jnp.int32)
     params = jax.jit(model.init)(rng, tokens)
@@ -160,8 +171,29 @@ def init_sharded_state(cfg: LlamaConfig, mesh: Mesh, rng,
     shardings = param_shardings(mesh, params)
     params = jax.device_put(params, shardings)
     optimizer = make_optimizer()
-    opt_state = optimizer.init(params)
-    opt_state = jax.device_put(opt_state, param_shardings(mesh, opt_state))
+    if opt_memory_kind == "device":
+        opt_state = optimizer.init(params)
+        opt_state = jax.device_put(opt_state, param_shardings(mesh, opt_state))
+    else:
+        # Validate the zeros assumption against the LIVE optimizer: init it
+        # on a single-scalar pytree with the params' treedef (bytes of HBM)
+        # and require every state leaf to be zero.  inject_hyperparams-style
+        # wrappers with non-zero state then fail loudly here instead of
+        # silently training from a wrong state.
+        tiny = jax.tree_util.tree_map(
+            lambda _: jnp.zeros((1,), jnp.float32), params)
+        for leaf in jax.tree_util.tree_leaves(optimizer.init(tiny)):
+            if np.asarray(leaf).any():
+                raise ValueError(
+                    "opt_memory_kind host init requires a zeros-init "
+                    "optimizer state; this optimizer has non-zero init "
+                    "leaves — init on device or extend init_sharded_state")
+        spec = jax.eval_shape(optimizer.init, params)
+        opt_state = jax.tree_util.tree_map(
+            lambda sd, s: jax.device_put(
+                np.zeros(sd.shape, sd.dtype),
+                s.with_memory_kind(opt_memory_kind)),
+            spec, param_shardings(mesh, spec))
     step0 = jax.device_put(jnp.zeros((), jnp.int32),
                            NamedSharding(mesh, P()))
     state = TrainState(params=params, opt_state=opt_state, step=step0)

@@ -1,0 +1,64 @@
+"""Serialized native-library build.
+
+Everything that needs ``libvtpu.so`` (the test fixtures, ``bench.py``,
+``benchmarks/scenarios.py``) shells out to ``make -C lib/tpu``.  Those
+callers legitimately run concurrently — the driver's bench alongside a
+pytest session, two scenario harnesses — and two ``make`` processes in
+one build directory race on the ``.o`` files and fail spuriously.  A
+file lock around the build makes every caller safe; ``make`` itself
+keeps the no-op rebuild fast.
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import os
+import subprocess
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_native(check: bool = True,
+                 timeout: float = 300.0) -> "subprocess.CompletedProcess":
+    """Run ``make -C lib/tpu`` serialized against concurrent callers.
+
+    ``timeout`` bounds the WHOLE call: time spent waiting for the build
+    lock counts against it (raising ``subprocess.TimeoutExpired`` like a
+    slow make would, so callers keep one failure path), and the make
+    subprocess gets whatever remains.  If the lock file cannot be created
+    (read-only checkout shipping a prebuilt ``build/``), fall back to an
+    unserialized make — exactly the old behavior for those environments.
+    """
+    libdir = os.path.join(REPO, "lib", "tpu")
+    # NOT inside build/: `make clean` removes that directory, which would
+    # unlink a held lock file and let a second builder slip past it.
+    lockpath = os.path.join(libdir, ".build.lock")
+    deadline = time.monotonic() + timeout
+    cmd = ["make", "-C", libdir]
+
+    def run_make() -> "subprocess.CompletedProcess":
+        left = max(1.0, deadline - time.monotonic())
+        return subprocess.run(cmd, check=check, capture_output=True,
+                              text=True, timeout=left)
+
+    try:
+        lock = open(lockpath, "w")
+    except OSError:
+        return run_make()
+    try:
+        while True:
+            try:
+                fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    return run_make()  # exotic flock failure: don't deadlock
+                if time.monotonic() >= deadline:
+                    raise subprocess.TimeoutExpired(cmd, timeout)
+                time.sleep(0.2)
+        return run_make()
+    finally:
+        lock.close()  # releases the flock if held

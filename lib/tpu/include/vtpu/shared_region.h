@@ -36,7 +36,13 @@ typedef struct {
   int32_t pid;          /* in-container pid; 0 = slot free */
   int32_t hostpid;      /* filled by the monitor (cgroup walk) */
   int32_t status;       /* 1 = alive, 2 = exited-unclean (monitor GC) */
-  int32_t pad_;
+  int32_t pidns;        /* truncated /proc/self/ns/pid inode of the writer;
+                         * 0 = unknown.  Lets an in-container attacher reap
+                         * dead same-namespace slots (kill(pid,0)==ESRCH is
+                         * only meaningful inside the writer's pid ns);
+                         * foreign-ns slots stay until the host monitor's
+                         * NSpid GC.  Same size/offset as the old padding —
+                         * ABI v1 readers simply ignore it. */
   uint64_t used[VTPU_MAX_DEVICES];         /* bytes, self-reported */
   uint64_t monitor_used[VTPU_MAX_DEVICES]; /* bytes, monitor-measured */
 } vtpu_proc_slot_t;

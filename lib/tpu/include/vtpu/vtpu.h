@@ -35,6 +35,10 @@ void vtpu_charge(int dev, uint64_t bytes);   /* unconditional add (post-hoc) */
 void vtpu_set_used(int dev, uint64_t bytes); /* absolute self-report */
 void vtpu_free(int dev, uint64_t bytes);
 void vtpu_memory_info(int dev, uint64_t* total, uint64_t* used);
+/* Reap charges of same-pid-namespace slot owners that died without
+ * vtpu_shutdown.  Runs automatically at attach and before any -ENOMEM
+ * refusal; exposed for explicit sweeps.  Returns slots reaped. */
+int vtpu_gc_dead(void);
 int vtpu_proc_count(void);
 const char* vtpu_region_path(void);
 vtpu_region_t* vtpu_region(void);

@@ -8,6 +8,7 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -57,6 +58,37 @@ void region_lock(vtpu_region_t* r) {
 }
 
 void region_unlock(vtpu_region_t* r) { pthread_mutex_unlock(&r->lock); }
+
+/* Truncated nsfs inode of this process's pid namespace (0 = unknown). */
+uint32_t self_pidns(void) {
+  struct stat st;
+  if (stat("/proc/self/ns/pid", &st) != 0) return 0;
+  return (uint32_t)st.st_ino;
+}
+
+/* Clear slots whose owner died without vtpu_shutdown (SIGKILLed worker,
+ * aborted runtime).  Probe only slots written from OUR pid namespace —
+ * kill(pid, 0) against a foreign namespace's pid numbers would report
+ * ESRCH (or hit an unrelated process) for a perfectly alive sharer in
+ * another container; those slots belong to the host monitor's NSpid GC.
+ * Caller holds the region lock.  Returns slots reaped. */
+int reap_dead_locked(vtpu_region_t* r) {
+  uint32_t ns = self_pidns();
+  if (ns == 0) return 0;
+  int me = (int)getpid();
+  int reaped = 0;
+  for (int i = 0; i < r->proc_num; i++) {
+    vtpu_proc_slot_t* s = &r->procs[i];
+    if (s->pid == 0 || s->pid == me) continue;
+    if ((uint32_t)s->pidns != ns) continue;
+    if (kill(s->pid, 0) != 0 && errno == ESRCH) {
+      memset(s, 0, sizeof(*s));
+      reaped++;
+    }
+  }
+  if (reaped) r->generation++;
+  return reaped;
+}
 
 void init_mutex(vtpu_region_t* r) {
   pthread_mutexattr_t a;
@@ -167,8 +199,11 @@ int vtpu_init_path(const char* path) {
   flock(fd, LOCK_UN);
   close(fd);
 
-  // Register this process in a free slot.
+  // Register this process in a free slot.  Reap same-namespace dead
+  // owners first: a sharer that crashed mid-allocation must not pin its
+  // charges against the cap forever (nor exhaust the slot table).
   region_lock(r);
+  reap_dead_locked(r);
   int slot = -1;
   for (int i = 0; i < VTPU_MAX_PROCS; i++) {
     if (r->procs[i].pid == 0) {
@@ -180,6 +215,7 @@ int vtpu_init_path(const char* path) {
     memset(&r->procs[slot], 0, sizeof(vtpu_proc_slot_t));
     r->procs[slot].pid = getpid();
     r->procs[slot].status = 1;
+    r->procs[slot].pidns = (int32_t)self_pidns();
     if (slot + 1 > r->proc_num) r->proc_num = slot + 1;
   }
   r->generation++;
@@ -244,6 +280,14 @@ int vtpu_try_alloc(int dev, uint64_t bytes) {
     for (int i = 0; i < r->proc_num; i++) {
       if (r->procs[i].pid != 0) total += r->procs[i].used[dev];
     }
+    if (total + bytes > lim && reap_dead_locked(r) > 0) {
+      // About to refuse: make sure the refusal isn't caused by a crashed
+      // sharer's stale charges (cold path, so the pid probes are cheap).
+      total = 0;
+      for (int i = 0; i < r->proc_num; i++) {
+        if (r->procs[i].pid != 0) total += r->procs[i].used[dev];
+      }
+    }
     if (total + bytes > lim) rc = -ENOMEM;
   }
   if (rc == 0) {
@@ -297,6 +341,15 @@ void vtpu_memory_info(int dev, uint64_t* total, uint64_t* used) {
   uint64_t u = vtpu_get_used(dev);
   if (total) *total = lim;
   if (used) *used = u;
+}
+
+/* Explicit same-namespace dead-slot sweep; returns slots reaped. */
+int vtpu_gc_dead(void) {
+  if (!g_region) return 0;
+  region_lock(g_region);
+  int n = reap_dead_locked(g_region);
+  region_unlock(g_region);
+  return n;
 }
 
 int vtpu_proc_count(void) {

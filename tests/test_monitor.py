@@ -15,8 +15,8 @@ LIB = os.path.join(REPO, "lib", "tpu", "build", "libvtpu.so")
 
 @pytest.fixture(scope="session", autouse=True)
 def build_lib():
-    subprocess.run(["make", "-C", os.path.join(REPO, "lib", "tpu")],
-                   check=True, capture_output=True)
+    from k8s_vgpu_scheduler_tpu.util.nativebuild import build_native
+    build_native(check=True)
 
 
 class Workload:

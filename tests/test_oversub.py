@@ -198,6 +198,58 @@ class TestOffloadedTrainStep:
                 rtol=2e-5, atol=2e-6,
             )
 
+    def test_host_init_matches_optimizer_init(self, setup):
+        """opt_memory_kind='pinned_host' builds the optimizer state on the
+        host WITHOUT ever staging it through device memory (the grant of an
+        oversubscribed pod can be smaller than the state, so a transient
+        device copy during init would be refused by the enforcement layer).
+        The result must be indistinguishable from optimizer.init: same
+        treedef, same shapes/dtypes, same values, host memory kind."""
+        model, optimizer, mesh, state, tokens = setup
+        # Fresh device-side reference — setup's state was donated by the
+        # earlier step tests.
+        _, _, dev_state, _ = init_sharded_state(
+            model.cfg, mesh, jax.random.PRNGKey(0),
+            batch=tokens.shape[0], seq=tokens.shape[1] - 1,
+        )
+        _, _, host_init_state, _ = init_sharded_state(
+            model.cfg, mesh, jax.random.PRNGKey(0),
+            batch=tokens.shape[0], seq=tokens.shape[1] - 1,
+            opt_memory_kind="pinned_host",
+        )
+        ref = dev_state.opt_state  # optimizer.init, device-resident
+        got = host_init_state.opt_state
+        assert (jax.tree_util.tree_structure(ref)
+                == jax.tree_util.tree_structure(got))
+        for a, b in zip(jax.tree_util.tree_leaves(ref),
+                        jax.tree_util.tree_leaves(got)):
+            assert a.shape == b.shape and a.dtype == b.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert b.sharding.memory_kind == "pinned_host"
+
+    def test_host_init_trains_like_offload_state(self, setup):
+        """The host-initialized state is a drop-in for offload_state(init):
+        one offloaded step from each produces the same loss."""
+        model, optimizer, mesh, state, tokens = setup
+        model2, optimizer2, state2, _ = init_sharded_state(
+            model.cfg, mesh, jax.random.PRNGKey(0),
+            batch=tokens.shape[0], seq=tokens.shape[1] - 1,
+        )
+        via_offload = offload_state(state2)
+        step_a = jit_train_step(model2, optimizer2, mesh, via_offload,
+                                offload_opt_state=True)
+        _, loss_a = step_a(via_offload, tokens)
+
+        model3, optimizer3, state3, _ = init_sharded_state(
+            model.cfg, mesh, jax.random.PRNGKey(0),
+            batch=tokens.shape[0], seq=tokens.shape[1] - 1,
+            opt_memory_kind="pinned_host",
+        )
+        step_b = jit_train_step(model3, optimizer3, mesh, state3,
+                                offload_opt_state=True)
+        _, loss_b = step_b(state3, tokens)
+        assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-6)
+
     def test_second_step_runs_from_offloaded_output(self, setup):
         model, optimizer, mesh, state, tokens = setup
         model2, optimizer2, state2, _ = init_sharded_state(

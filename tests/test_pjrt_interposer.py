@@ -40,8 +40,8 @@ def _built() -> bool:
 @pytest.fixture(scope="module")
 def artifacts():
     if not _built():
-        r = subprocess.run(["make", "-C", LIBDIR], capture_output=True,
-                           text=True, timeout=300)
+        from k8s_vgpu_scheduler_tpu.util.nativebuild import build_native
+        r = build_native(check=False)
         if not _built():
             pytest.skip(
                 "interposer targets unavailable (no pjrt_c_api.h?): "

@@ -17,8 +17,8 @@ LIB = os.path.join(REPO, "lib", "tpu", "build", "libvtpu.so")
 
 @pytest.fixture(scope="session", autouse=True)
 def build_lib():
-    subprocess.run(["make", "-C", os.path.join(REPO, "lib", "tpu")],
-                   check=True, capture_output=True)
+    from k8s_vgpu_scheduler_tpu.util.nativebuild import build_native
+    build_native(check=True)
 
 
 def run_child(code: str, env: dict) -> str:
@@ -497,6 +497,57 @@ lib.vtpu_set_used.argtypes = [ctypes.c_int, ctypes.c_uint64]
         assert lib.vtpu_r_uuid(h, 0) == b"chipX"
         assert lib.vtpu_r_uuid(h, 1) == b"chipY"
         lib.vtpu_close_region(h)
+
+    def test_attach_reaps_same_ns_dead_slots(self, tmp_path):
+        """A sharer that died without shutdown must not pin its charges
+        against the cap: the next same-namespace attacher reaps the slot
+        (region.cc reap_dead_locked) and its allocation succeeds where a
+        stale-charge refusal would have been wrong.  This is the crashed
+        -pod-restart path: reference fix_lock_shrreg's pid-liveness probe,
+        done eagerly at attach instead of on lock contention."""
+        cache = str(tmp_path / "r.cache")
+        env = {"TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+               "TPU_DEVICE_MEMORY_LIMIT_0": "100"}
+        run_child(CHILD_PRELUDE + """
+assert lib.vtpu_try_alloc(0, 70*1024*1024) == 0
+os._exit(0)  # hard crash: destructor skipped, slot leaks
+""", env)
+        out = run_child(CHILD_PRELUDE + """
+# Attach already reaped the dead slot: the region is empty again and a
+# 70 MiB allocation under the 100 MiB cap succeeds.
+print(lib.vtpu_get_used(0))
+print(lib.vtpu_try_alloc(0, 70*1024*1024))
+""", env)
+        used, rc = out.split()
+        assert used == "0" and rc == "0"
+
+    def test_refusal_path_reaps_dead_slots(self, tmp_path):
+        """Same stale-charge situation, but discovered by an ALREADY
+        -attached process at refusal time (vtpu_try_alloc's cold-path
+        sweep), not by a fresh attach."""
+        cache = str(tmp_path / "r.cache")
+        env = {"TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+               "TPU_DEVICE_MEMORY_LIMIT_0": "100"}
+        out = run_child(CHILD_PRELUDE + """
+import subprocess, sys
+# Attach FIRST, so the later reap must happen on the refusal path.
+assert lib.vtpu_try_alloc(0, 20*1024*1024) == 0
+child = (
+    "import ctypes, os;"
+    "lib = ctypes.CDLL(os.environ['VTPU_LIBRARY']);"
+    "lib.vtpu_try_alloc.argtypes = [ctypes.c_int, ctypes.c_uint64];"
+    "assert lib.vtpu_init_path(None) == 0;"
+    "assert lib.vtpu_try_alloc(0, 70*1024*1024) == 0;"
+    "os._exit(0)"
+)
+subprocess.run([sys.executable, "-c", child], check=True)
+# 20 (ours) + 70 (dead child) charged; a 50 MiB ask exceeds 100 only
+# because of the dead charges -> the refusal path reaps and admits.
+print(lib.vtpu_try_alloc(0, 50*1024*1024))
+print(lib.vtpu_get_used(0) // (1024*1024))
+""", env)
+        rc, used = out.split()
+        assert rc == "0" and used == "70"  # 20 + 50, dead 70 reaped
 
     def test_gc_clears_dead_slots(self, tmp_path):
         cache = str(tmp_path / "r.cache")
