@@ -26,6 +26,7 @@ import subprocess
 import sys
 import tempfile
 import time
+import uuid
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
@@ -210,8 +211,12 @@ def pick_platform(env: dict):
 def collect_worker(name: str, argv: list, env: dict, out: str,
                    timeout: float, fallback: dict):
     """Spawn a worker, persist diagnostics on failure, read its JSON result
-    or return ``fallback`` — never raises."""
+    or return ``fallback`` — never raises.  The worker echoes
+    BENCH_RUN_TOKEN into its result so a late write by an earlier run's
+    detached worker can't be mistaken for this run's."""
     global _WORKER_OVERRAN
+    token = uuid.uuid4().hex
+    env = dict(env, BENCH_RUN_TOKEN=token)
     rc, w_out, w_err = run_no_kill(argv, env, timeout)
     if rc is None:
         # Killing it would leave a stale pool lease that wedges every later
@@ -229,9 +234,25 @@ def collect_worker(name: str, argv: list, env: dict, out: str,
     if os.path.exists(out):
         try:
             with open(out) as f:
-                return json.load(f)
+                r = json.load(f)
         except (OSError, json.JSONDecodeError):
+            return fallback
+        # The spool path is stable across runs: a DETACHED worker from an
+        # earlier run (left alive, never killed) can finish and write this
+        # path after our unlink.  The run token separates "ours" from
+        # "theirs": a foreign result is left in the spool — it is a real
+        # late measurement that harvest_spool merges with honest ranking —
+        # but must not impersonate THIS run's case.
+        if token and r.get("run_token") not in (token, None):
+            log(f"case {name}: spool result is from another run; "
+                "leaving it for harvest")
+            return fallback
+        r.pop("run_token", None)
+        try:
+            os.unlink(out)  # consumed; only abandoned results get harvested
+        except OSError:
             pass
+        return r
     return fallback
 
 
@@ -244,7 +265,7 @@ def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
         # CPU fallback: prove the pipeline, honestly flagged; full-size
         # ResNet on CPU would blow the budget.
         spec.update(batch=4, size=64, iters=2)
-    out = os.path.join(tmpdir, f"{name}.json")
+    out = spool_path(name)
     # A stale result from an earlier run of the same case (e.g. the
     # enforced leg before the bare leg) must never be read back as this
     # run's output.
@@ -277,6 +298,62 @@ def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
         result["degraded"] = True
         result["platform"] = "cpu"
     return result
+
+
+# Worker results land in a STABLE spool (not the per-run tmpdir): a worker
+# that overruns its collector's patience keeps running detached (never kill
+# a pool claim, DIAG_r03.txt) and often finishes minutes later — its result
+# file is then harvested by this run's merge step, or the next run's,
+# instead of dying with a tmpdir.
+SPOOL = os.path.join(REPO, ".bench_spool")
+
+
+def spool_path(name: str) -> str:
+    os.makedirs(SPOOL, exist_ok=True)
+    return os.path.join(SPOOL, f"{name}.json")
+
+
+def write_result(path: str, result: dict) -> None:
+    """Worker-side result write: stamps the collector's run token (late
+    writes by detached workers from other runs are then distinguishable)
+    and renames atomically so no reader ever sees half a JSON."""
+    token = os.environ.get("BENCH_RUN_TOKEN")
+    if token:
+        result = dict(result, run_token=token)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, path)
+
+
+def harvest_spool(matrix: list) -> None:
+    """Fold completed spool files into ``matrix`` (merge dedups by metric).
+    Parsed files are deleted; a half-written file (worker mid-write) fails
+    to parse and is left for the next harvest."""
+    try:
+        names = os.listdir(SPOOL)
+    except OSError:
+        return
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(SPOOL, fn)
+        try:
+            with open(path) as f:
+                r = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        r.pop("run_token", None)
+        # shim=False marks the bare-metal comparison leg of the
+        # enforcement-overhead metric: it shares the PRIMARY case name, so
+        # merging it would relabel an UNENFORCED number as the enforced
+        # flagship result.  It only ever feeds the overhead ratio.
+        if r.get("metric") and r.get("shim") is not False:
+            matrix.append(r)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
 
 def _onchip(r: dict) -> bool:
@@ -398,6 +475,7 @@ def main() -> None:
         except (OSError, json.JSONDecodeError):
             prior = []
 
+        harvest_spool(matrix)
         merged, lost = merge_matrix(prior, matrix)
         try:
             with open(matrix_path, "w") as f:
@@ -436,7 +514,11 @@ def run_flash_case(env: dict, tmpdir: str, timeout: float):
 
 def run_worker_case(name: str, flag: str, env: dict, tmpdir: str,
                     timeout: float, unit: str):
-    out = os.path.join(tmpdir, f"{name}.json")
+    out = spool_path(name)
+    try:
+        os.unlink(out)  # a prior run's late result must not be read as ours
+    except OSError:
+        pass
     argv = [sys.executable, os.path.abspath(__file__), flag, "--out", out]
     wenv = dict(env)
     wenv["VTPU_BALLAST"] = "0"
@@ -479,8 +561,7 @@ def flash_worker(out_path: str) -> None:
             "config": {"batch": B, "heads": H, "head_dim": d,
                        "dtype": "bfloat16", "causal": True},
         }
-        with open(out_path, "w") as f:
-            json.dump(result, f)
+        write_result(out_path, result)
 
     for T in (2048, 4096, 8192):
         try:
@@ -573,8 +654,7 @@ def decode_worker(out_path: str) -> None:
             "batch": B, "prompt": P, "new_tokens": N,
             "dtype": cfg.dtype},
     }
-    with open(out_path, "w") as f:
-        json.dump(result, f)
+    write_result(out_path, result)
 
 
 # ----------------------------------------------------------------------------
@@ -701,8 +781,7 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
         shim.publish_usage_once()
         result["memory_info_mib"] = {
             k: v // (1024 * 1024) for k, v in shim.memory_info(0).items()}
-    with open(out, "w") as f:
-        json.dump(result, f)
+    write_result(out, result)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,40 @@
+"""The training-loop side of checkpointed preemption (docs/preemption.md).
+
+Runs as the container entrypoint of examples/preemptible-train.yaml.
+First launch and every post-eviction resume are the same code path:
+``run_preemptible`` restores the newest checkpoint when one exists.
+"""
+
+import jax
+
+from k8s_vgpu_scheduler_tpu.models.checkpoint import CheckpointManager
+from k8s_vgpu_scheduler_tpu.models.llama import LlamaConfig
+from k8s_vgpu_scheduler_tpu.models.train import (
+    init_sharded_state, jit_train_step, run_preemptible)
+from k8s_vgpu_scheduler_tpu.parallel.mesh import MeshShape, make_mesh
+from k8s_vgpu_scheduler_tpu.shim.preempt import PreemptionWatch
+
+N_STEPS = 10_000
+BATCH, SEQ = 8, 512
+
+
+def main() -> int:
+    cfg = LlamaConfig(vocab=32000, dim=1024, n_layers=8, n_heads=16,
+                      n_kv_heads=16, ffn_hidden=2816)
+    mesh = make_mesh(MeshShape(1, 1, 1), devices=jax.devices()[:1])
+    rng = jax.random.PRNGKey(0)
+    model, optimizer, state, _ = init_sharded_state(
+        cfg, mesh, rng, batch=BATCH, seq=SEQ)
+    step = jit_train_step(model, optimizer, mesh, state)
+    tokens = jax.random.randint(rng, (BATCH, SEQ + 1), 0, cfg.vocab)
+
+    ckpt = CheckpointManager("/data/ckpt")
+    state, done, preempted = run_preemptible(
+        step, state, tokens, N_STEPS, ckpt, PreemptionWatch().requested)
+    ckpt.close()
+    print(f"{'preempted' if preempted else 'finished'} at step {done}")
+    return 0  # clean exit either way; the Job controller handles the rest
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,6 +42,11 @@ def parse_args(argv=None):
     p.add_argument("--resource-cores", default="google.com/tpucores")
     p.add_argument("--resource-priority", default="vtpu.dev/task-priority")
     p.add_argument("--topology-policy", default="best-effort")
+    p.add_argument("--enable-preemption", action="store_true",
+                   help="let a high-priority pod that fits nowhere request "
+                        "checkpointed eviction of lower-priority pods "
+                        "(vtpu.dev/preempt-requested annotation; see "
+                        "docs/preemption.md)")
     # With the watch loop (informer parity) as the primary event path the
     # periodic full resync is a safety net only, so its default is long;
     # in resync-only mode (--no-watch, or a client without watch support)
@@ -91,6 +96,7 @@ def build_config(args) -> Config:
         default_mem=args.default_mem,
         default_cores=args.default_cores,
         topology_policy=args.topology_policy,
+        enable_preemption=args.enable_preemption,
         enable_debug=args.debug,
     )
 

@@ -251,6 +251,38 @@ def _state_shardings(state: TrainState, host_opt: bool) -> TrainState:
     )
 
 
+def run_preemptible(step, state: TrainState, tokens, n_steps: int,
+                    ckpt, should_stop) -> Tuple[TrainState, int, bool]:
+    """Drive ``step`` for ``n_steps``, honoring a preemption request at
+    every step boundary (scheduler/preempt.py's contract: the victim
+    checkpoints and exits; the grant frees; the pod resumes later with an
+    IDENTICAL trajectory — pinned by tests/test_preempt.py).
+
+    ``ckpt`` is a ``models.checkpoint.CheckpointManager``; ``should_stop``
+    is any zero-arg callable — in a pod, ``PreemptionWatch().requested``.
+    Resumes automatically from the manager's latest step.  Returns
+    ``(state, steps_done_this_call, preempted)``; the caller exits 0 on
+    ``preempted`` (k8s restarts the pod wherever it is next scheduled, and
+    this function picks up from the checkpoint).
+    """
+    latest = ckpt.latest_step()
+    done = int(state.step)
+    if latest is not None and latest > done:
+        state = ckpt.restore(state, step=latest)
+        done = int(state.step)
+    saved = latest if latest is not None else -1
+    while done < n_steps:
+        if should_stop():
+            if done > saved:
+                ckpt.save(done, state, wait=True)
+            return state, done, True
+        state, _loss = step(state, tokens)
+        done = int(state.step)
+    if done > saved:
+        ckpt.save(done, state, wait=True)
+    return state, done, False
+
+
 def offload_state(state: TrainState) -> TrainState:
     """Move the optimizer state to pinned host memory (HBM -> host RAM)."""
     opt_host = jax.tree_util.tree_map(

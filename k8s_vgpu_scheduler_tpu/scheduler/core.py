@@ -31,7 +31,7 @@ from ..util import codec
 from ..util.config import Config
 from ..util.nodelock import NodeLockError, lock_node, release_node
 from ..util.protocol import bind_timestamp
-from ..util.resources import container_requests
+from ..util.resources import container_requests, pod_priority
 from ..util.types import (
     ASSIGNED_IDS_ANNOTATION,
     ASSIGNED_NODE_ANNOTATION,
@@ -52,16 +52,22 @@ from .gang import (
 )
 from .nodes import DeviceInfo, NodeInfo, NodeManager
 from .pods import PodInfo, PodManager
+from .preempt import PREEMPT_ANNOTATION, PreemptionPlan, plan_preemption
 
 log = logging.getLogger(__name__)
 
 
 class FilterResult:
     def __init__(self, node: Optional[str] = None,
-                 failed: Optional[Dict[str, str]] = None, error: str = ""):
+                 failed: Optional[Dict[str, str]] = None, error: str = "",
+                 preempt: Optional["PreemptionPlan"] = None):
         self.node = node
         self.failed = failed or {}
         self.error = error
+        # A no-fit decision may carry an eviction plan; filter() executes
+        # the annotation writes outside the lock and the pod pends until
+        # the victims checkpoint and release.
+        self.preempt = preempt
 
 
 def decode_register_request(req) -> NodeInfo:
@@ -106,6 +112,10 @@ class Scheduler:
         self._deleted_uids: Dict[str, float] = {}
         self._deleted_lock = threading.Lock()
         self._deleted_horizon_s = 900.0
+        # victim uid -> monotonic time of the last preempt annotation
+        # (throttles re-patching while the victim checkpoints).
+        self._preempt_requested: Dict[str, float] = {}
+        self._preempt_lock = threading.Lock()
 
     def _note_deleted(self, uid: str) -> None:
         now = time.monotonic()
@@ -180,6 +190,10 @@ class Scheduler:
             log.error("pod %s has malformed %s: %s", pod_name(pod),
                       ASSIGNED_IDS_ANNOTATION, e)
             return
+        try:
+            prio = pod_priority(pod, self.cfg)
+        except Exception:  # noqa: BLE001 — priority never blocks rebuild
+            prio = 0
         self.pods.add_pod(
             PodInfo(
                 uid=uid,
@@ -187,6 +201,7 @@ class Scheduler:
                 namespace=pod_namespace(pod),
                 node=node,
                 devices=devices,
+                priority=prio,
             )
         )
         if event == "ADDED" and self._deleted_since(uid) is not None:
@@ -260,6 +275,8 @@ class Scheduler:
         with self._filter_lock:
             result = self._decide_locked(pod, node_names)
         if result.node is None:
+            if result.preempt is not None:
+                self._request_preemptions(pod, result.preempt)
             return result
         encoded = codec.encode_pod_devices(self.pods.get(pod_uid(pod)).devices)
         patch = {
@@ -281,6 +298,35 @@ class Scheduler:
             self.pods.del_pod(pod_uid(pod))
             return FilterResult(error=f"writing decision failed: {e}")
         return result
+
+    def _request_preemptions(self, pod: dict, plan: "PreemptionPlan") -> None:
+        """Annotate the plan's victims (apiserver writes, so outside the
+        filter lock).  Re-annotation is throttled: the pending pod is
+        re-Filtered every scheduling cycle and the victims need minutes to
+        checkpoint — repeated identical patches would only load the
+        apiserver."""
+        now = time.monotonic()
+        for v in plan.victims:
+            with self._preempt_lock:
+                last = self._preempt_requested.get(v.uid, 0.0)
+                if now - last < 30.0:
+                    continue
+                self._preempt_requested[v.uid] = now
+                if len(self._preempt_requested) > 4096:
+                    for u in [u for u, t in self._preempt_requested.items()
+                              if now - t > 300.0]:
+                        del self._preempt_requested[u]
+            try:
+                self.client.patch_pod_annotations(
+                    v.namespace, v.name, {PREEMPT_ANNOTATION: pod_uid(pod)})
+                log.warning(
+                    "preemption: asked %s/%s (prio %d) to checkpoint and "
+                    "release %s for pod %s", v.namespace, v.name, v.priority,
+                    plan.node, pod_name(pod))
+            except Exception as e:  # noqa: BLE001 — next cycle retries
+                log.error("preemption request for %s failed: %s", v.name, e)
+                with self._preempt_lock:
+                    self._preempt_requested.pop(v.uid, None)
 
     def _decide_locked(self, pod: dict, node_names: List[str]) -> FilterResult:
         try:
@@ -320,7 +366,24 @@ class Scheduler:
                 best = (s, name, placement)
 
         if best is None:
-            return FilterResult(error="no node fits TPU request", failed=failed)
+            plan = None
+            if self.cfg.enable_preemption:
+                pods_by_node: Dict[str, List[PodInfo]] = {}
+                for p in self.pods.list_pods():
+                    pods_by_node.setdefault(p.node, []).append(p)
+                # Gang members are never victims: evicting one would hang
+                # the surviving collective while freeing a fraction of the
+                # gang's footprint.
+                gang_uids = {
+                    u for g in self.gangs.groups().values()
+                    for u in (*g.members, *g.placements)
+                }
+                plan = plan_preemption(
+                    requests, pod_priority(pod, self.cfg), usage_by_node,
+                    pods_by_node, anns, self.cfg.topology_policy,
+                    protected_uids=gang_uids)
+            return FilterResult(error="no node fits TPU request",
+                                failed=failed, preempt=plan)
 
         _, node, placement = best
         # Account immediately so concurrent Filters see the tentative grant.
@@ -331,6 +394,7 @@ class Scheduler:
                 namespace=pod_namespace(pod),
                 node=node,
                 devices=placement,
+                priority=pod_priority(pod, self.cfg),
             )
         )
         return FilterResult(node=node, failed=failed)
@@ -368,7 +432,8 @@ class Scheduler:
                 self.pods.add_pod(
                     PodInfo(uid=uid, name=pod_name(pod),
                             namespace=pod_namespace(pod), node=node,
-                            devices=devices)
+                            devices=devices,
+                            priority=pod_priority(pod, self.cfg))
                 )
             return FilterResult(node=node)
 
@@ -402,6 +467,9 @@ class Scheduler:
         # can't steal reserved capacity while the members' retries arrive.
         for member_uid, (node, devices) in placements.items():
             m = g.members[member_uid]
+            # priority stays at the protected default here (the member's
+            # pod spec isn't at hand); immaterial for preemption — gang
+            # uids are excluded from victim candidates wholesale.
             self.pods.add_pod(
                 PodInfo(uid=member_uid, name=m.name, namespace=m.namespace,
                         node=node, devices=devices)

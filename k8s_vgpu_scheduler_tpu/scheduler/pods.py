@@ -23,6 +23,10 @@ class PodInfo:
     namespace: str
     node: str
     devices: PodDevices
+    # vtpu.dev/task-priority (0 = highest, reference vgputaskpriority
+    # convention) — read by the preemption planner when a higher-priority
+    # pod fits nowhere.
+    priority: int = 0
     # Monotonic time of the most recent add/refresh: a full-list resync
     # must not prune a grant recorded AFTER its list snapshot was taken
     # (the pod simply didn't exist yet in that stale list).

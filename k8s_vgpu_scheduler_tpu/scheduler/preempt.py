@@ -1,0 +1,123 @@
+"""Priority preemption with checkpointed resume (beyond the reference).
+
+The reference's priority story stops at throttling: an active
+higher-priority sharer flips ``utilization_switch`` and low-priority
+processes are confined to their core grant (cmd/vGPUmonitor/feedback.go
+CheckPriority).  A high-priority pod that fits NOWHERE simply pends.
+
+On TPUs we can do strictly better, because training state is an explicit
+pytree (``models/train.TrainState``) rather than opaque driver state:
+eviction is lossless.  The flow:
+
+1. Filter finds no node (``_decide_locked`` returns no fit) and the
+   requester carries a strictly-higher priority (numerically lower
+   ``vtpu.dev/task-priority``) than some placed pods.
+2. :func:`plan_preemption` picks the cheapest node/victim set whose
+   release makes the pod fit.
+3. The scheduler annotates each victim ``vtpu.dev/preempt-requested``
+   (outside the filter lock, like every apiserver write).  The
+   annotation reaches the container through the standard downward-API
+   annotations file — no new agent, kubelet live-updates the mount.
+4. In-container, :class:`..shim.preempt.PreemptionWatch` sees the flag;
+   the training loop (``models/train.run_preemptible``) checkpoints at
+   the next step boundary and exits; the pod terminates, its grant frees
+   (the normal delete path), and the pending high-priority pod places on
+   the next scheduling cycle.
+5. The victim reschedules later and resumes from its checkpoint with an
+   identical trajectory (pinned by tests/test_preempt.py).
+
+The planner is pure (no I/O, no locks): it works on the same
+``build_usage`` snapshots the filter already holds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from . import score as score_mod
+from .nodes import NodeInfo
+from .pods import PodInfo
+
+#: Set on a victim pod; the value is the requesting pod's uid (observable
+#: provenance: `kubectl describe` answers "who evicted me").
+PREEMPT_ANNOTATION = "vtpu.dev/preempt-requested"
+
+
+@dataclasses.dataclass
+class PreemptionPlan:
+    node: str
+    victims: List[PodInfo]
+    placement: object  # the fit that becomes valid once victims release
+
+
+def _fits_without(requests, info: NodeInfo, pods: List[PodInfo],
+                  excluded: set, anns: Dict[str, str], policy: str):
+    remaining = [p for p in pods if p.uid not in excluded]
+    usage = score_mod.build_usage(info, remaining)
+    return score_mod.fit_pod(requests, usage, info.topology, anns, policy)
+
+
+def plan_preemption(
+    requests,
+    requester_priority: int,
+    entries: Dict[str, Tuple[NodeInfo, object]],
+    pods_by_node: Dict[str, List[PodInfo]],
+    anns: Dict[str, str],
+    policy: str,
+    protected_uids: Optional[set] = None,
+) -> Optional[PreemptionPlan]:
+    """Cheapest (node, victims) whose eviction admits ``requests``.
+
+    Victim eligibility: strictly lower priority than the requester
+    (numerically greater — 0 is highest, reference vgputaskpriority
+    convention) and not in ``protected_uids`` — the scheduler passes every
+    gang member there, because evicting ONE member of an atomically-placed
+    SPMD gang would hang the collective while freeing only a fraction of
+    its footprint.  Preference order inside a node: lowest priority first,
+    then youngest grant first (evicting the pod with the least sunk work
+    loses the least progress).  Across nodes: fewest victims, then the
+    filter's own node score.  Returns None when nothing helps — the pod
+    pends exactly as without this module.
+    """
+    protected = protected_uids or set()
+    best: Optional[Tuple[int, float, str, List[PodInfo], object]] = None
+    for node, (info, _usage) in entries.items():
+        pods = pods_by_node.get(node, [])
+        candidates = [p for p in pods
+                      if p.priority > requester_priority
+                      and p.uid not in protected]
+        if not candidates:
+            continue
+        candidates.sort(key=lambda p: (-p.priority, -p.touched_at))
+        chosen: Optional[List[PodInfo]] = None
+        placement = None
+        # Single-victim pass first (cheapest possible plan on this node).
+        for c in candidates:
+            placement = _fits_without(
+                requests, info, pods, {c.uid}, anns, policy)
+            if placement is not None:
+                chosen = [c]
+                break
+        if chosen is None:
+            # Greedy accumulation in preference order.
+            acc: List[PodInfo] = []
+            excluded: set = set()
+            for c in candidates:
+                acc.append(c)
+                excluded.add(c.uid)
+                placement = _fits_without(
+                    requests, info, pods, excluded, anns, policy)
+                if placement is not None:
+                    chosen = list(acc)
+                    break
+        if chosen is None:
+            continue  # even evicting every lower-priority pod won't fit
+        usage_after = score_mod.build_usage(
+            info, [p for p in pods if p.uid not in {v.uid for v in chosen}])
+        key = (len(chosen), -score_mod.node_score(usage_after))
+        if best is None or key < (best[0], best[1]):
+            best = (key[0], key[1], node, chosen, placement)
+    if best is None:
+        return None
+    return PreemptionPlan(node=best[2], victims=best[3], placement=best[4])

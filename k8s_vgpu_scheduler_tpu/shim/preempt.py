@@ -1,0 +1,67 @@
+"""In-container preemption watch.
+
+The scheduler's eviction request (``vtpu.dev/preempt-requested``, written
+by scheduler/preempt.py) reaches the container through the standard
+kubernetes downward API: the pod mounts its own annotations as a file
+that kubelet live-updates (examples/preemptible-train.yaml).  No agent,
+no connection to the apiserver from inside the pod — the file appears
+within kubelet's sync period (~seconds).
+
+Downward-API file format: one ``key="escaped value"`` line per
+annotation (Go strconv.Quote escaping; we only need key detection, so a
+conservative parse suffices).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+PREEMPT_ANNOTATION = "vtpu.dev/preempt-requested"
+DEFAULT_PATH = "/etc/podinfo/annotations"
+PATH_ENV = "VTPU_PODINFO_ANNOTATIONS"
+
+
+class PreemptionWatch:
+    """Cheap per-step poll of the downward-API annotations file.
+
+    ``requested()`` is designed to sit in a training loop's step boundary:
+    it stats the file and re-reads only when the mtime moved (kubelet
+    updates the mount atomically via symlink swap, which changes mtime).
+    A missing file (no downward-API volume) simply means "never
+    preempted" — opting in is the operator's choice.
+    """
+
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path or os.environ.get(PATH_ENV, DEFAULT_PATH)
+        self._mtime: float = -1.0
+        self._cached = False
+
+    def requested(self) -> bool:
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return False
+        if mtime != self._mtime:
+            self._mtime = mtime
+            self._cached = self._parse()
+        return self._cached
+
+    def requester(self) -> Optional[str]:
+        """Uid of the pod this eviction makes room for (observability)."""
+        val = self._read_value()
+        return val if val else None
+
+    def _parse(self) -> bool:
+        return self._read_value() is not None
+
+    def _read_value(self) -> Optional[str]:
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    key, sep, val = line.partition("=")
+                    if sep and key.strip() == PREEMPT_ANNOTATION:
+                        return val.strip().strip('"')
+        except OSError:
+            return None
+        return None

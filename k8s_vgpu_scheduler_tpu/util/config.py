@@ -53,6 +53,12 @@ class Config:
     # Topology placement policy default for multi-chip requests.
     topology_policy: str = "best-effort"
 
+    # Priority preemption (scheduler/preempt.py): a high-priority pod that
+    # fits nowhere may request checkpointed eviction of strictly-lower-
+    # priority pods.  Off by default — eviction is a policy decision the
+    # operator must opt into (--enable-preemption).
+    enable_preemption: bool = False
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

@@ -48,6 +48,32 @@ def _quantity_to_int(q) -> int:
         raise QuantityError(f"unparseable resource quantity {q!r}") from e
 
 
+def pod_priority(pod: dict, cfg: Config) -> int:
+    """The pod's task priority: the ``vtpu.dev/task-priority`` resource
+    limit of its TPU-requesting container(s) (0 = highest; the webhook
+    turns the same limit into the container's TPU_TASK_PRIORITY env).
+
+    The pod-level value is the MOST-PROTECTED (numerically lowest) across
+    containers that actually request TPUs, with absent/malformed counting
+    as 0: a pod whose TPU container never opted into low priority must
+    never be preemptible, no matter what a sidecar declares."""
+    prios = []
+    for ctr in pod.get("spec", {}).get("containers", []):
+        limits = dict(ctr.get("resources", {}).get("requests", {}))
+        limits.update(ctr.get("resources", {}).get("limits", {}))
+        try:
+            if _quantity_to_int(limits.get(cfg.resources.count, 0)) <= 0:
+                continue
+        except QuantityError:
+            continue
+        try:
+            prios.append(_quantity_to_int(
+                limits.get(cfg.resources.priority, 0)))
+        except QuantityError:
+            prios.append(0)
+    return min(prios) if prios else 0
+
+
 def container_requests(pod: dict, cfg: Config) -> List[ContainerDeviceRequest]:
     """One ContainerDeviceRequest per container (nums==0 when the container
     requests no TPU)."""

@@ -73,6 +73,79 @@ class TestMergeMatrix:
         assert merged["a"]["error"] == "x" and not lost
 
 
+class TestSpool:
+    def _patch_spool(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "SPOOL", str(tmp_path))
+
+    def test_harvest_merges_and_consumes(self, monkeypatch, tmp_path):
+        self._patch_spool(monkeypatch, tmp_path)
+        import json
+        with open(tmp_path / "x.json", "w") as f:
+            json.dump(dict(tpu("m1", 5.0), run_token="old-run"), f)
+        matrix = []
+        bench.harvest_spool(matrix)
+        assert matrix == [tpu("m1", 5.0)]  # token stripped
+        assert not list(tmp_path.glob("*.json"))  # consumed
+
+    def test_harvest_skips_bare_leg_records(self, monkeypatch, tmp_path):
+        """shim=False is the bare-metal comparison leg of the overhead
+        metric; merging it would relabel an UNENFORCED number as the
+        enforced flagship result (it shares the PRIMARY metric name)."""
+        self._patch_spool(monkeypatch, tmp_path)
+        import json
+        with open(tmp_path / "p.json", "w") as f:
+            json.dump(dict(tpu(bench.PRIMARY, 9.9), shim=False), f)
+        matrix = []
+        bench.harvest_spool(matrix)
+        assert matrix == []
+        assert not list(tmp_path.glob("*.json"))
+
+    def test_harvest_leaves_half_written_files(self, monkeypatch, tmp_path):
+        self._patch_spool(monkeypatch, tmp_path)
+        (tmp_path / "w.json").write_text('{"metric": "tru')  # mid-write
+        matrix = []
+        bench.harvest_spool(matrix)
+        assert matrix == [] and (tmp_path / "w.json").exists()
+
+    def test_collector_rejects_foreign_run_token(self, monkeypatch,
+                                                 tmp_path):
+        """A detached worker from an EARLIER run finishing late must not
+        impersonate this run's case: its record stays in the spool for
+        honest rank-merged harvesting instead."""
+        self._patch_spool(monkeypatch, tmp_path)
+        import json
+        out = str(tmp_path / "c.json")
+
+        def fake_run(argv, env, timeout):
+            # The "old" worker wrote before our worker produced anything.
+            with open(out, "w") as f:
+                json.dump(dict(tpu("c", 1.0), run_token="other"), f)
+            return 0, "", ""
+
+        monkeypatch.setattr(bench, "run_no_kill", fake_run)
+        fallback = {"metric": "c", "value": 0.0, "error": "x"}
+        got = bench.collect_worker("c", [], {}, out, 5.0, fallback)
+        assert got is fallback
+        assert os.path.exists(out)  # left for harvest
+
+    def test_collector_accepts_own_token_and_consumes(self, monkeypatch,
+                                                      tmp_path):
+        self._patch_spool(monkeypatch, tmp_path)
+        import json
+        out = str(tmp_path / "c.json")
+
+        def fake_run(argv, env, timeout):
+            with open(out, "w") as f:
+                json.dump(dict(tpu("c", 2.0),
+                               run_token=env["BENCH_RUN_TOKEN"]), f)
+            return 0, "", ""
+
+        monkeypatch.setattr(bench, "run_no_kill", fake_run)
+        got = bench.collect_worker("c", [], {}, out, 5.0, {"error": "x"})
+        assert got == tpu("c", 2.0)  # token stripped
+        assert not os.path.exists(out)  # consumed
+
+
 class TestCaseTable:
     def test_full_reference_matrix_covered(self):
         """All 10 reference rows (README.md:191-204 / BASELINE.md): 5 model

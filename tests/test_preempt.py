@@ -1,0 +1,288 @@
+"""Priority preemption with checkpointed resume.
+
+Covers the full contract of scheduler/preempt.py + shim/preempt.py +
+models/train.run_preemptible:
+
+- planner: victim eligibility (strict priority), preference order
+  (lowest priority, youngest), single-victim minimality;
+- scheduler e2e: high-priority no-fit annotates the victim, victim
+  deletion frees the grant, the pending pod then places;
+- downward-API watch: annotation-file parsing and mtime-based re-read;
+- resume: a preempted-then-resumed training run lands on the EXACT same
+  trajectory as an uninterrupted one.
+"""
+
+import dataclasses
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import DeviceInfo, NodeInfo, Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.preempt import PREEMPT_ANNOTATION
+from k8s_vgpu_scheduler_tpu.shim.preempt import PreemptionWatch
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+
+def register_node(s: Scheduler, name: str, chips=1, devmem=16384):
+    devices = [
+        DeviceInfo(id=f"{name}-chip-{i}", count=10, devmem=devmem,
+                   type="TPU-v5e", health=True, coords=(i, 0))
+        for i in range(chips)
+    ]
+    s.nodes.add_node(
+        name,
+        NodeInfo(name=name, devices=devices,
+                 topology=TopologyDesc(generation="v5e", mesh=(chips, 1))),
+    )
+
+
+def tpu_pod(name, uid, mem, priority=None):
+    limits = {"google.com/tpu": "1", "google.com/tpumem": mem}
+    if priority is not None:
+        limits["vtpu.dev/task-priority"] = str(priority)
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": {}},
+        "spec": {"containers": [
+            {"name": "main", "resources": {"limits": limits}}]},
+    }
+
+
+@pytest.fixture
+def env():
+    kube = FakeKube()
+    kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+    s = Scheduler(kube, Config(enable_preemption=True))
+    register_node(s, "node-a")
+    kube.watch_pods(s.on_pod_event)
+    return kube, s
+
+
+def place(kube, s, pod):
+    kube.create_pod(pod)
+    res = s.filter(pod, ["node-a"])
+    assert res.node is not None, res.error
+    return res
+
+
+class TestSchedulerPreemption:
+    def test_high_priority_no_fit_annotates_victim(self, env):
+        kube, s = env
+        place(kube, s, tpu_pod("lp", "u-lp", "16000", priority=1))
+        hp = tpu_pod("hp", "u-hp", "16000")  # absent priority = 0 (highest)
+        kube.create_pod(hp)
+        res = s.filter(hp, ["node-a"])
+        assert res.node is None and res.error
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION] == "u-hp"
+
+    def test_victim_deletion_frees_and_pod_places(self, env):
+        kube, s = env
+        lp = tpu_pod("lp", "u-lp", "16000", priority=1)
+        place(kube, s, lp)
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        assert s.filter(hp, ["node-a"]).node is None
+        # The victim checkpoints and exits; kubelet deletes the pod.
+        kube.delete_pod("default", "lp")
+        res = s.filter(hp, ["node-a"])
+        assert res.node == "node-a", res.error
+
+    def test_equal_priority_is_never_preempted(self, env):
+        kube, s = env
+        place(kube, s, tpu_pod("lp", "u-lp", "16000"))  # priority 0 too
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        res = s.filter(hp, ["node-a"])
+        assert res.node is None
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert PREEMPT_ANNOTATION not in anns
+
+    def test_low_priority_requester_cannot_preempt_high(self, env):
+        kube, s = env
+        place(kube, s, tpu_pod("hp", "u-hp", "16000"))  # priority 0
+        lp = tpu_pod("lp", "u-lp", "16000", priority=1)
+        kube.create_pod(lp)
+        res = s.filter(lp, ["node-a"])
+        assert res.node is None
+        anns = kube.get_pod("default", "hp")["metadata"]["annotations"]
+        assert PREEMPT_ANNOTATION not in anns
+
+    def test_single_cheapest_victim_chosen(self, env):
+        kube, s = env
+        # Two sharers on the chip; the LOWEST priority one alone frees
+        # enough. Only it may be annotated.
+        place(kube, s, tpu_pod("lp1", "u-lp1", "8000", priority=2))
+        place(kube, s, tpu_pod("lp2", "u-lp2", "8000", priority=1))
+        hp = tpu_pod("hp", "u-hp", "8000")
+        kube.create_pod(hp)
+        assert s.filter(hp, ["node-a"]).node is None
+        a1 = kube.get_pod("default", "lp1")["metadata"]["annotations"]
+        a2 = kube.get_pod("default", "lp2")["metadata"]["annotations"]
+        assert a1.get(PREEMPT_ANNOTATION) == "u-hp"
+        assert PREEMPT_ANNOTATION not in a2
+
+    def test_multi_victim_accumulation(self, env):
+        kube, s = env
+        place(kube, s, tpu_pod("lp1", "u-lp1", "6000", priority=1))
+        place(kube, s, tpu_pod("lp2", "u-lp2", "6000", priority=1))
+        hp = tpu_pod("hp", "u-hp", "14000")  # needs BOTH victims gone
+        kube.create_pod(hp)
+        assert s.filter(hp, ["node-a"]).node is None
+        for name in ("lp1", "lp2"):
+            anns = kube.get_pod("default", name)["metadata"]["annotations"]
+            assert anns.get(PREEMPT_ANNOTATION) == "u-hp", name
+
+    def test_repeat_filter_throttles_patches(self, env):
+        kube, s = env
+        place(kube, s, tpu_pod("lp", "u-lp", "16000", priority=1))
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        assert s.filter(hp, ["node-a"]).node is None
+        t_first = s._preempt_requested["u-lp"]
+        assert s.filter(hp, ["node-a"]).node is None  # pends again
+        assert s._preempt_requested["u-lp"] == t_first  # no re-patch
+
+    def test_gang_members_are_never_victims(self):
+        """Evicting one member of an atomically-placed SPMD gang would
+        hang the collective while freeing a fraction of its footprint —
+        gang uids are excluded from victim candidates wholesale, even
+        when every member declares low priority."""
+        from k8s_vgpu_scheduler_tpu.scheduler.gang import (
+            GANG_GROUP_ANNOTATION, GANG_TOTAL_ANNOTATION)
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        s = Scheduler(kube, Config(enable_preemption=True))
+        register_node(s, "node-a", chips=2)
+        kube.watch_pods(s.on_pod_event)
+        members = []
+        for i in range(2):
+            m = tpu_pod(f"g{i}", f"u-g{i}", "16000", priority=2)
+            m["metadata"]["annotations"].update({
+                GANG_GROUP_ANNOTATION: "job1",
+                GANG_TOTAL_ANNOTATION: "2",
+            })
+            members.append(m)
+            kube.create_pod(m)
+        s.filter(members[0], ["node-a"])  # waits for quorum
+        assert s.filter(members[1], ["node-a"]).node is not None
+        assert s.filter(members[0], ["node-a"]).node is not None
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        res = s.filter(hp, ["node-a"])
+        assert res.node is None and res.preempt is None
+        for i in range(2):
+            anns = kube.get_pod("default", f"g{i}")["metadata"]["annotations"]
+            assert PREEMPT_ANNOTATION not in anns
+
+    def test_sidecar_priority_cannot_make_pod_preemptible(self, env):
+        """A pod whose TPU container never opted into low priority is not
+        a victim even if a non-TPU sidecar declares one (pod_priority is
+        the most-protected value across TPU-requesting containers)."""
+        kube, s = env
+        lp = tpu_pod("lp", "u-lp", "16000")  # TPU container: no priority
+        lp["spec"]["containers"].append({
+            "name": "sidecar",
+            "resources": {"limits": {"vtpu.dev/task-priority": "2"}},
+        })
+        place(kube, s, lp)
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        res = s.filter(hp, ["node-a"])
+        assert res.node is None
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert PREEMPT_ANNOTATION not in anns
+
+    def test_disabled_by_default(self):
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        s = Scheduler(kube, Config())  # enable_preemption absent
+        register_node(s, "node-a")
+        kube.watch_pods(s.on_pod_event)
+        place(kube, s, tpu_pod("lp", "u-lp", "16000", priority=1))
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        res = s.filter(hp, ["node-a"])
+        assert res.node is None and res.preempt is None
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert PREEMPT_ANNOTATION not in anns
+
+
+class TestPreemptionWatch:
+    def _write(self, path, lines):
+        with open(path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def test_missing_file_means_never(self, tmp_path):
+        w = PreemptionWatch(str(tmp_path / "annotations"))
+        assert w.requested() is False
+
+    def test_detects_annotation(self, tmp_path):
+        path = str(tmp_path / "annotations")
+        self._write(path, ['kubernetes.io/config.seen="2026"'])
+        w = PreemptionWatch(path)
+        assert w.requested() is False
+        self._write(path, ['kubernetes.io/config.seen="2026"',
+                           'vtpu.dev/preempt-requested="u-hp"'])
+        os.utime(path, (time.time() + 5, time.time() + 5))  # force mtime move
+        assert w.requested() is True
+        assert w.requester() == "u-hp"
+
+    def test_env_var_path(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "ann")
+        self._write(path, ['vtpu.dev/preempt-requested="x"'])
+        monkeypatch.setenv("VTPU_PODINFO_ANNOTATIONS", path)
+        assert PreemptionWatch().requested() is True
+
+
+class TestPreemptedResume:
+    def test_trajectory_identical_to_uninterrupted(self, tmp_path):
+        from k8s_vgpu_scheduler_tpu.models.checkpoint import CheckpointManager
+        from k8s_vgpu_scheduler_tpu.models.llama import llama_tiny
+        from k8s_vgpu_scheduler_tpu.models.train import (
+            init_sharded_state, jit_train_step, run_preemptible)
+        from k8s_vgpu_scheduler_tpu.parallel.mesh import MeshShape, make_mesh
+
+        cfg = dataclasses.replace(llama_tiny(), dtype="float32")
+        mesh = make_mesh(MeshShape(1, 1, 1), devices=jax.devices()[:1])
+        batch, seq, n_steps = 2, 32, 6
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab)
+
+        def fresh():
+            model, opt, state, _ = init_sharded_state(
+                cfg, mesh, jax.random.PRNGKey(0), batch=batch, seq=seq)
+            return jit_train_step(model, opt, mesh, state), state
+
+        # Uninterrupted run.
+        step, state = fresh()
+        ckpt_a = CheckpointManager(str(tmp_path / "a"))
+        ref, done, preempted = run_preemptible(
+            step, state, tokens, n_steps, ckpt_a, lambda: False)
+        assert (done, preempted) == (n_steps, False)
+
+        # Preempted at step 3, then "rescheduled": fresh process state,
+        # same checkpoint dir, resumes and finishes.
+        ckpt_b = CheckpointManager(str(tmp_path / "b"))
+        step2, state2 = fresh()
+        stop_after = iter([False, False, False, True])
+        mid, done, preempted = run_preemptible(
+            step2, state2, tokens, n_steps, ckpt_b,
+            lambda: next(stop_after))
+        assert preempted is True and done == 3
+
+        step3, state3 = fresh()
+        res, done, preempted = run_preemptible(
+            step3, state3, tokens, n_steps, ckpt_b, lambda: False)
+        assert (done, preempted) == (n_steps, False)
+
+        for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                        jax.tree_util.tree_leaves(res.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        ckpt_a.close()
+        ckpt_b.close()
