@@ -926,6 +926,159 @@ def _oversub_degraded(result: dict) -> None:
 
 
 # ---------------------------------------------------------------------------
+# preempt (beyond-reference: checkpointed eviction, docs/preemption.md)
+# ---------------------------------------------------------------------------
+
+_PREEMPT_TRAIN = """
+import dataclasses, json, os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from k8s_vgpu_scheduler_tpu.models.checkpoint import CheckpointManager
+from k8s_vgpu_scheduler_tpu.models.llama import llama_tiny
+from k8s_vgpu_scheduler_tpu.models.train import (
+    init_sharded_state, jit_train_step, run_preemptible)
+from k8s_vgpu_scheduler_tpu.parallel.mesh import MeshShape, make_mesh
+from k8s_vgpu_scheduler_tpu.shim.preempt import PreemptionWatch
+
+ANN = os.environ["SCEN_ANN_FILE"]
+PENDING = os.environ["SCEN_ANN_PENDING"]
+CKPT = os.environ["SCEN_CKPT_DIR"]
+N = 6
+cfg = dataclasses.replace(llama_tiny(), dtype="float32")
+mesh = make_mesh(MeshShape(1, 1, 1), devices=jax.devices()[:1])
+tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0, cfg.vocab)
+
+def fresh():
+    m, o, st, _ = init_sharded_state(cfg, mesh, jax.random.PRNGKey(0),
+                                     batch=2, seq=32)
+    return jit_train_step(m, o, mesh, st), st
+
+watch = PreemptionWatch(ANN)
+boundary = {"k": 0}
+
+def should_stop():
+    # The victim has genuinely trained for 3 steps when the scheduler's
+    # annotation reaches the downward-API mount (kubelet syncs with an
+    # atomic rename — reproduced deterministically at this boundary).
+    boundary["k"] += 1
+    if boundary["k"] == 4:
+        os.replace(PENDING, ANN)
+    return watch.requested()
+
+# Victim leg: trains until the annotation arrives mid-run.
+step, st = fresh()
+st, done, preempted = run_preemptible(
+    step, st, tokens, N, CheckpointManager(os.path.join(CKPT, "v")),
+    should_stop)
+print("VICTIM", json.dumps({
+    "preempted": preempted, "checkpoint_step": done,
+    "watch_requester": watch.requester()}), flush=True)
+
+# Resume leg: fresh process state, same checkpoint dir -> must restore and
+# finish; trajectory must equal an uninterrupted run bit-for-bit.
+step2, st2 = fresh()
+res, done2, p2 = run_preemptible(
+    step2, st2, tokens, N, CheckpointManager(os.path.join(CKPT, "v")),
+    lambda: False)
+step3, st3 = fresh()
+ref, _, _ = run_preemptible(
+    step3, st3, tokens, N, CheckpointManager(os.path.join(CKPT, "ref")),
+    lambda: False)
+identical = all(
+    np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                    jax.tree_util.tree_leaves(res.params)))
+print("RESUME", json.dumps({
+    "resumed_to": done2, "finished": not p2,
+    "trajectory_identical": identical}), flush=True)
+"""
+
+
+def scenario_preempt() -> None:
+    """Checkpointed preemption end-to-end (docs/preemption.md): a
+    high-priority pod that fits nowhere gets the low-priority victim
+    annotated through the real Filter path; the victim's training loop
+    sees the downward-API file, checkpoints mid-run and exits; the freed
+    grant places the requester; the victim resumes bit-exactly.  Control
+    logic + CPU-forced compute — accelerator-independent by construction
+    (enforcement-side claims live in ENFORCE/THROTTLE/OVERSUB), so this
+    artifact is never degraded."""
+    from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+    from k8s_vgpu_scheduler_tpu.scheduler import (
+        DeviceInfo, NodeInfo, Scheduler)
+    from k8s_vgpu_scheduler_tpu.scheduler.preempt import PREEMPT_ANNOTATION
+    from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+    from k8s_vgpu_scheduler_tpu.util.config import Config
+
+    kube = FakeKube()
+    kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+    sched = Scheduler(kube, Config(enable_preemption=True))
+    sched.nodes.add_node("node-a", NodeInfo(
+        name="node-a",
+        devices=[DeviceInfo(id="node-a-chip-0", count=10, devmem=16384,
+                            type="TPU-v5e", health=True, coords=(0, 0))],
+        topology=TopologyDesc(generation="v5e", mesh=(1, 1))))
+    kube.watch_pods(sched.on_pod_event)
+
+    def pod(name, uid, prio=None):
+        lim = {"google.com/tpu": "1", "google.com/tpumem": "16000"}
+        if prio:
+            lim["vtpu.dev/task-priority"] = prio
+        return {"metadata": {"name": name, "namespace": "default",
+                             "uid": uid, "annotations": {}},
+                "spec": {"containers": [
+                    {"name": "m", "resources": {"limits": lim}}]}}
+
+    victim = pod("victim", "u-victim", prio="1")
+    kube.create_pod(victim)
+    placed = sched.filter(victim, ["node-a"]).node
+    urgent = pod("urgent", "u-urgent")
+    kube.create_pod(urgent)
+    first_try = sched.filter(urgent, ["node-a"])
+    anns = kube.get_pod("default", "victim")["metadata"]["annotations"]
+    annotated = anns.get(PREEMPT_ANNOTATION)
+
+    # kubelet side: stage the annotations; the file reaches the victim's
+    # downward-API mount MID-RUN (atomic rename at a step boundary inside
+    # the child), so the checkpoint provably interrupts real training.
+    tmp = tempfile.mkdtemp(prefix="vtpu-preempt-")
+    ann_file = os.path.join(tmp, "annotations")
+    pending = os.path.join(tmp, "annotations.pending")
+    with open(pending, "w") as f:
+        f.write("\n".join(f'{k}="{v}"' for k, v in anns.items()) + "\n")
+    rc, out, err = run_child(_PREEMPT_TRAIN, {
+        "SCEN_ANN_FILE": ann_file,
+        "SCEN_ANN_PENDING": pending,
+        "SCEN_CKPT_DIR": os.path.join(tmp, "ckpt"),
+    }, timeout=540)
+    vic = _oversub_marker(out, "VICTIM") or {}
+    res = _oversub_marker(out, "RESUME") or {}
+
+    # The victim exited; kubelet deletes the pod; the grant frees and the
+    # urgent pod places.
+    kube.delete_pod("default", "victim")
+    second_try = sched.filter(urgent, ["node-a"])
+
+    result = {
+        "victim_placed_first": placed == "node-a",
+        "urgent_rejected_while_full": first_try.node is None,
+        "victim_annotated_with_requester": annotated == "u-urgent",
+        "victim_preempted_mid_run": (vic.get("preempted") is True
+                                     and vic.get("checkpoint_step", 0) > 0),
+        "checkpoint_step": vic.get("checkpoint_step"),
+        "urgent_placed_after_release": second_try.node == "node-a",
+        "victim_resumed_and_finished": res.get("finished") is True,
+        "trajectory_identical": res.get("trajectory_identical") is True,
+    }
+    result["passed"] = (rc == 0 and all(
+        result[k] for k in result if k != "checkpoint_step"))
+    if rc != 0:
+        result["error"] = (err or "").strip().splitlines()[-3:]
+    emit("preempt", result)
+
+
+# ---------------------------------------------------------------------------
 # gang (BASELINE #5: v5p-256 multi-host gang schedule)
 # ---------------------------------------------------------------------------
 
@@ -1015,6 +1168,7 @@ SCENARIOS = {
     "priority": scenario_priority,
     "oversub": scenario_oversub,
     "gang": scenario_gang,
+    "preempt": scenario_preempt,
 }
 
 
