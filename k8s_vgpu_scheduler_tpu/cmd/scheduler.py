@@ -42,6 +42,11 @@ def parse_args(argv=None):
     p.add_argument("--resource-cores", default="google.com/tpucores")
     p.add_argument("--resource-priority", default="vtpu.dev/task-priority")
     p.add_argument("--topology-policy", default="best-effort")
+    p.add_argument("--node-scheduler-policy", default="spread",
+                   choices=("spread", "binpack"),
+                   help="among fitting nodes: spread = most free capacity "
+                        "wins; binpack = fullest wins (keeps whole "
+                        "nodes/slices free for gangs)")
     p.add_argument("--enable-preemption", action="store_true",
                    help="let a high-priority pod that fits nowhere request "
                         "checkpointed eviction of lower-priority pods "
@@ -96,6 +101,7 @@ def build_config(args) -> Config:
         default_mem=args.default_mem,
         default_cores=args.default_cores,
         topology_policy=args.topology_policy,
+        node_scheduler_policy=args.node_scheduler_policy,
         enable_preemption=args.enable_preemption,
         enable_debug=args.debug,
     )

@@ -361,7 +361,7 @@ class Scheduler:
             if placement is None:
                 failed[name] = "insufficient TPU capacity/topology"
                 continue
-            s = score_mod.node_score(usage)
+            s = score_mod.node_score(usage, self.cfg.node_scheduler_policy)
             if best is None or s > best[0]:
                 best = (s, name, placement)
 
@@ -381,7 +381,8 @@ class Scheduler:
                 plan = plan_preemption(
                     requests, pod_priority(pod, self.cfg), usage_by_node,
                     pods_by_node, anns, self.cfg.topology_policy,
-                    protected_uids=gang_uids)
+                    protected_uids=gang_uids,
+                    node_policy=self.cfg.node_scheduler_policy)
             return FilterResult(error="no node fits TPU request",
                                 failed=failed, preempt=plan)
 
@@ -453,7 +454,8 @@ class Scheduler:
                     if uid not in g.placements]
                    if g.placements else None)
         placements = place_gang(
-            g, usage, score_mod.fit_pod, score_mod.node_score,
+            g, usage, score_mod.fit_pod,
+            lambda u: score_mod.node_score(u, self.cfg.node_scheduler_policy),
             self.cfg.topology_policy, only_uids=missing,
         )
         if placements is None:

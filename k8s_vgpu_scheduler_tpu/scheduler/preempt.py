@@ -66,6 +66,7 @@ def plan_preemption(
     anns: Dict[str, str],
     policy: str,
     protected_uids: Optional[set] = None,
+    node_policy: str = "spread",
 ) -> Optional[PreemptionPlan]:
     """Cheapest (node, victims) whose eviction admits ``requests``.
 
@@ -115,7 +116,8 @@ def plan_preemption(
             continue  # even evicting every lower-priority pod won't fit
         usage_after = score_mod.build_usage(
             info, [p for p in pods if p.uid not in {v.uid for v in chosen}])
-        key = (len(chosen), -score_mod.node_score(usage_after))
+        key = (len(chosen),
+               -score_mod.node_score(usage_after, node_policy))
         if best is None or key < (best[0], best[1]):
             best = (key[0], key[1], node, chosen, placement)
     if best is None:

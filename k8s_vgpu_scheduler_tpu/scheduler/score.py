@@ -212,13 +212,20 @@ def fit_pod(
     return out
 
 
-def node_score(usage: Dict[str, DeviceUsage]) -> float:
-    """Free capacity remaining after tentative placement; Filter picks the
-    max, spreading load like the reference (score.go:165–199)."""
+def node_score(usage: Dict[str, DeviceUsage],
+               policy: str = "spread") -> float:
+    """Node preference among fitting nodes; Filter picks the max.
+
+    - ``spread`` (default, the reference's behavior, score.go:165–199):
+      most free capacity wins — load levels across nodes.
+    - ``binpack``: LEAST free capacity wins (the score is negated), packing
+      fractional pods densely so whole nodes/slices stay free for gangs
+      and multi-chip jobs.
+    """
     score = 0.0
     for u in usage.values():
         if u.total_mem > 0:
             score += u.free_mem / u.total_mem
         if u.total_cores > 0:
             score += u.free_cores / u.total_cores
-    return score
+    return -score if policy == "binpack" else score

@@ -53,6 +53,11 @@ class Config:
     # Topology placement policy default for multi-chip requests.
     topology_policy: str = "best-effort"
 
+    # Node choice among fitting nodes: "spread" (most free capacity wins —
+    # the reference's behavior) or "binpack" (fullest fitting node wins,
+    # keeping whole nodes/slices free for gangs and multi-chip jobs).
+    node_scheduler_policy: str = "spread"
+
     # Priority preemption (scheduler/preempt.py): a high-priority pod that
     # fits nowhere may request checkpointed eviction of strictly-lower-
     # priority pods.  Off by default — eviction is a policy decision the

@@ -55,6 +55,44 @@ def env():
     return kube, s
 
 
+class TestNodeSchedulerPolicy:
+    def _loaded_env(self, policy):
+        kube = FakeKube()
+        for n in ("node-a", "node-b"):
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        s = Scheduler(kube, Config(node_scheduler_policy=policy))
+        register_node(s, "node-a")
+        register_node(s, "node-b")
+        kube.watch_pods(s.on_pod_event)
+        # Pre-load node-a with one fractional pod.
+        seed = tpu_pod(name="seed", uid="u-seed", mem="3000")
+        kube.create_pod(seed)
+        res = s.filter(seed, ["node-a"])
+        assert res.node == "node-a"
+        return kube, s
+
+    def test_spread_prefers_empty_node(self):
+        kube, s = self._loaded_env("spread")
+        pod = tpu_pod(name="p", uid="u-p", mem="3000")
+        kube.create_pod(pod)
+        assert s.filter(pod, ["node-a", "node-b"]).node == "node-b"
+
+    def test_binpack_prefers_loaded_node(self):
+        kube, s = self._loaded_env("binpack")
+        pod = tpu_pod(name="p", uid="u-p", mem="3000")
+        kube.create_pod(pod)
+        assert s.filter(pod, ["node-a", "node-b"]).node == "node-a"
+
+    def test_binpack_still_respects_fit(self):
+        kube, s = self._loaded_env("binpack")
+        # node-a's chips are 4 x 16384; a 16384 ask no longer fits the
+        # chip the seed pod shares, but other chips do — fit wins over
+        # packing preference (packing only ranks FITTING nodes).
+        pod = tpu_pod(name="big", uid="u-big", mem="16384")
+        kube.create_pod(pod)
+        assert s.filter(pod, ["node-a", "node-b"]).node == "node-a"
+
+
 class TestFilter:
     def test_picks_node_and_writes_decision(self, env):
         kube, s = env
