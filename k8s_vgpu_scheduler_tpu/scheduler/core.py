@@ -116,6 +116,10 @@ class Scheduler:
         # (throttles re-patching while the victim checkpoints).
         self._preempt_requested: Dict[str, float] = {}
         self._preempt_lock = threading.Lock()
+        # Lifetime count of successfully-written eviction requests (the
+        # metrics collector exposes it; operators alert on it — every
+        # increment is a checkpoint/restore cycle imposed on a workload).
+        self.preemptions_requested = 0
 
     def _note_deleted(self, uid: str) -> None:
         now = time.monotonic()
@@ -319,6 +323,8 @@ class Scheduler:
             try:
                 self.client.patch_pod_annotations(
                     v.namespace, v.name, {PREEMPT_ANNOTATION: pod_uid(pod)})
+                with self._preempt_lock:
+                    self.preemptions_requested += 1
                 log.warning(
                     "preemption: asked %s/%s (prio %d) to checkpoint and "
                     "release %s for pod %s", v.namespace, v.name, v.priority,

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Iterable
 
-from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 from prometheus_client.registry import Collector
 
 from .core import Scheduler
@@ -74,7 +74,15 @@ class ClusterCollector(Collector):
                     pod_mem.add_metric([pod.namespace, pod.name, g.uuid], g.usedmem)
                     pod_cores.add_metric([pod.namespace, pod.name, g.uuid], g.usedcores)
 
-        return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct, pod_mem, pod_cores]
+        preempts = CounterMetricFamily(
+            "vtpu_preemption_requests",
+            "Eviction requests written to victim pods (each one imposes a "
+            "checkpoint/restore cycle on a workload)",
+        )
+        preempts.add_metric([], self.scheduler.preemptions_requested)
+
+        return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
+                pod_mem, pod_cores, preempts]
 
 
 def start_metrics_server(scheduler: Scheduler, port: int = 9395):

@@ -213,6 +213,28 @@ class TestSchedulerPreemption:
         assert PREEMPT_ANNOTATION not in anns
 
 
+class TestPreemptionMetric:
+    def test_counter_increments_on_request(self, env):
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import ClusterCollector
+        kube, s = env
+
+        def counter_value():
+            for fam in ClusterCollector(s).collect():
+                if fam.name == "vtpu_preemption_requests":
+                    return fam.samples[0].value
+            raise AssertionError("counter family missing")
+
+        assert counter_value() == 0
+        place(kube, s, tpu_pod("lp", "u-lp", "16000", priority=1))
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        assert s.filter(hp, ["node-a"]).node is None
+        assert counter_value() == 1
+        # Throttled re-filter does not double-count.
+        assert s.filter(hp, ["node-a"]).node is None
+        assert counter_value() == 1
+
+
 class TestPreemptionWatch:
     def _write(self, path, lines):
         with open(path, "w") as f:
