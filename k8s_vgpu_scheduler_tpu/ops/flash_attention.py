@@ -14,11 +14,16 @@ Layout choices per the Pallas TPU guide:
 - scores/accumulators in f32 (``preferred_element_type``) — bf16 inputs,
   f32 math, bf16 out, the MXU-native mix.
 
-Training support: ``jax.custom_vjp`` with a rematerializing backward (plain
-XLA ops).  Forward pass — the inference/serving hot path — runs the kernel;
-the backward recomputes blockwise like ``jax.checkpoint`` would.
+Training support: ``jax.custom_vjp`` with Pallas BACKWARD kernels
+(FlashAttention-2 recomputation form).  The forward additionally emits the
+per-row logsumexp; the backward recomputes P blockwise from (q, k, lse) —
+never materializing the (T, T) matrix — with one kernel producing dQ
+(parallel over query blocks) and one producing dK/dV (parallel over key
+blocks), so both passes are O(bq·bk) on-chip and O(T·d) in HBM traffic.
+The earlier rematerializing plain-XLA backward resurrected the full score
+matrix in HBM exactly where long-context training is tightest.
 
-On CPU (tests, dry runs) the kernel runs in interpreter mode automatically.
+On CPU (tests, dry runs) the kernels run in interpreter mode automatically.
 """
 
 from __future__ import annotations
@@ -33,8 +38,8 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
-            block_k: int, seq_len: int):
+def _kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float,
+            causal: bool, block_k: int, seq_len: int):
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
     qi = pl.program_id(1)
@@ -82,10 +87,17 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale: float, causal: bool,
     m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-20)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
+    if maybe_lse_ref:
+        # Per-row logsumexp of the (scaled) scores — the backward's
+        # recomputation anchor: P = exp(S - lse) without a second online
+        # pass.  Only the training path requests it; inference skips the
+        # extra (B·H, T) write.
+        maybe_lse_ref[0][...] = (m + jnp.log(l))[:, 0]
 
 
 def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    return_lse: bool = False):
     """q/k/v: (B, T, H, d) — kernel runs per (B·H) with (T, d) refs."""
     B, T, H, d = q.shape
     qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, d)
@@ -93,7 +105,12 @@ def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, T, d)
 
     grid = (B * H, T // block_q)
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, T, d), q.dtype)]
+    if return_lse:
+        out_specs.append(pl.BlockSpec((None, block_q), lambda b, i: (b, i)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, T), jnp.float32))
+    res = pl.pallas_call(
         functools.partial(
             _kernel, sm_scale=sm_scale, causal=causal,
             block_k=block_k, seq_len=T,
@@ -104,15 +121,17 @@ def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
             pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+    out = res[0].reshape(B, H, T, d).transpose(0, 2, 1, 3)
+    return (out, res[1]) if return_lse else out
 
 
 def _reference(q, k, v, sm_scale: float, causal: bool):
-    """Plain-XLA attention used for the rematerializing backward."""
+    """Plain-XLA attention: the non-tileable-shape fallback (and the
+    numerics oracle the kernel tests pin against)."""
     B, T, H, d = q.shape
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
@@ -124,6 +143,161 @@ def _reference(q, k, v, sm_scale: float, causal: bool):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               sm_scale: float, causal: bool, block_k: int, seq_len: int):
+    """dQ_i = scale · Σ_j dS_ij K_j with dS = P ⊙ (dO Vᵀ − Δ); parallel
+    over query blocks, streaming K/V blocks (FlashAttention-2 eq. 4)."""
+    bq, d = q_ref.shape
+    qi = pl.program_id(1)
+    qs = q_ref[...].astype(jnp.float32) * sm_scale
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...][:, None]
+    delta = delta_ref[...][:, None]
+
+    def body(j, acc):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qs, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    num_kb = seq_len // block_k
+    if causal:
+        num_kb_eff = jnp.minimum(num_kb, (qi + 1) * bq // block_k)
+    else:
+        num_kb_eff = num_kb
+    acc = jax.lax.fori_loop(
+        0, num_kb_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[...] = (acc * sm_scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, sm_scale: float, causal: bool,
+                block_q: int, seq_len: int):
+    """dK_j = Σ_i dS_ijᵀ (scale·Q_i), dV_j = Σ_i P_ijᵀ dO_i; parallel over
+    key blocks, streaming Q/dO blocks.  Using the pre-scaled Q in the dK
+    product folds the softmax scale in exactly once."""
+    bk, d = k_ref.shape
+    kj = pl.program_id(1)
+    k = k_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        qs = q_ref[pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32) * sm_scale
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[pl.ds(i * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            qs, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0)
+            k_pos = kj * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    num_qb = seq_len // block_q
+    # Blocks strictly above the diagonal contribute nothing to this key
+    # block; start the walk at the first query block that can attend here.
+    i0 = (kj * bk) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        i0, num_qb, body,
+        (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal, block_q, block_k,
+                    interpret):
+    B, T, H, d = q.shape
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+
+    qt, kt, vt = fold(q), fold(k), fold(v)
+    dot = fold(g)
+    # Δ_i = rowsum(dO_i ⊙ O_i) — O(T·d), plain XLA, fused upstream.
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).transpose(0, 2, 1).reshape(B * H, T)
+
+    qkv_specs = [
+        pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((None, T), lambda b, i: (b, 0)),
+        pl.BlockSpec((None, T), lambda b, i: (b, 0)),
+    ]
+    dq_specs = list(qkv_specs)
+    dq_specs[0] = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
+    dq_specs[3] = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
+    dq_specs[4] = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
+    dq_specs[5] = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_k=block_k, seq_len=T),
+        grid=(B * H, T // block_q),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dkv_specs = list(qkv_specs)
+    dkv_specs[1] = pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0))
+    dkv_specs[2] = pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, seq_len=T),
+        grid=(B * H, T // block_k),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, d), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    def unfold(x):
+        return x.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     return _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k,
@@ -131,16 +305,15 @@ def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
 
 
 def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    out = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k,
-                          interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k,
+                               interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, sm_scale, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal,
+                           block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
