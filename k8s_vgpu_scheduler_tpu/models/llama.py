@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.flash_attention import flash_attention
 from ..parallel.moe import MoEConfig, MoELayer
 from ..parallel.ring import full_attention_reference, ring_attention
+from ..parallel.ulysses import ulysses_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,7 +36,11 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
-    # "full" | "ring"; ring shards the sequence over the mesh's sp axis.
+    # "full" | "ring" | "ulysses" | "flash".  ring and ulysses shard the
+    # sequence over the mesh's sp axis (ring: K/V rotation, no head-count
+    # constraint; ulysses: all-to-all head scatter, needs heads % sp == 0
+    # — see parallel/ulysses.py for the trade-off); flash is the Pallas
+    # kernel single-device path (ulysses uses it locally too).
     attention: str = "full"
     # >0 switches the FFN to a top-k-routed MoE (top_k=1 Switch-style,
     # top_k=2 Mixtral-style); stacked expert tensors shard over the
@@ -177,6 +182,9 @@ class Attention(nn.Module):
             if cfg.attention == "ring" and self.mesh is not None and \
                     self.mesh.shape.get("sp", 1) > 1:
                 out = ring_attention(q, k, v, self.mesh, causal=True)
+            elif cfg.attention == "ulysses" and self.mesh is not None and \
+                    self.mesh.shape.get("sp", 1) > 1:
+                out = ulysses_attention(q, k, v, self.mesh, causal=True)
             elif cfg.attention == "flash":
                 out = flash_attention(q, k, v, causal=True)
             else:

@@ -1,0 +1,83 @@
+"""Ulysses sequence parallelism — all-to-all head scatter.
+
+The second canonical long-sequence scheme next to ring attention
+(parallel/ring.py), after DeepSpeed-Ulysses: instead of rotating K/V
+blocks around a ring (sp-many neighbor exchanges overlapped with
+compute), ONE ``all_to_all`` re-shards ``[B, T/sp, H, d]`` to
+``[B, T, H/sp, d]`` — every device then holds the FULL sequence for its
+slice of heads and runs attention locally with zero inner-loop
+communication — and a second ``all_to_all`` restores the sequence
+sharding on the output.
+
+Trade-offs vs ring (why both exist):
+
+- Ulysses does 4 collectives total (Q, K, V in; O out) regardless of sp,
+  where ring does sp-1 K/V rotations — fewer, larger transfers, and the
+  local attention runs at full-sequence arithmetic intensity on the MXU
+  (ring's per-block tiles shrink as sp grows).
+- Ulysses requires ``H % sp == 0`` (heads are the scatter dimension) and
+  grouped-KV models additionally ``n_kv_heads % sp == 0``; ring has no
+  head-count constraint — it stays the fallback for small-H models on
+  large sp axes.
+- Per-device memory is the same O(T·H·d / sp) either way.
+
+The local attention is the Pallas flash kernel (ops/flash_attention.py)
+whenever the shapes tile, so the Ulysses path composes the framework's
+two long-context mechanisms: a2a sequence parallelism outside, blockwise
+online-softmax inside.  Everything is differentiable (``all_to_all`` has
+a transpose rule; flash has custom Pallas backward kernels), so the same
+path serves training.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.flash_attention import flash_attention
+
+
+def _ulysses_sharded(q, k, v, *, axis_name: str, causal: bool,
+                     sm_scale: Optional[float]):
+    """Per-device body under shard_map; shapes are sequence shards."""
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    # [B, T/sp, H, d] -> [B, T, H/sp, d]: scatter heads, gather sequence.
+    qh = a2a(q, split_axis=2, concat_axis=1)
+    kh = a2a(k, split_axis=2, concat_axis=1)
+    vh = a2a(v, split_axis=2, concat_axis=1)
+    # Full sequence locally: global causal masking is just the standard
+    # triangular mask — no offset bookkeeping like the ring needs.
+    out = flash_attention(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    # [B, T, H/sp, d] -> [B, T/sp, H, d]: gather heads, scatter sequence.
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
+                      causal: bool = True,
+                      sm_scale: Optional[float] = None):
+    """[B, T, H, D] inputs sharded over ``axis_name`` on T; same layout out.
+
+    Requires ``H % axis_size == 0`` (callers with small-H models should
+    use :func:`..parallel.ring.ring_attention` instead).
+    """
+    sp = mesh.shape[axis_name]
+    H = q.shape[2]
+    if H % sp:
+        raise ValueError(
+            f"ulysses needs heads % sp == 0, got H={H}, sp={sp}; "
+            "use ring attention for this shape")
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(
+        _ulysses_sharded,
+        axis_name=axis_name, causal=causal, sm_scale=sm_scale,
+    )
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
