@@ -231,29 +231,42 @@ def collect_worker(name: str, argv: list, env: dict, out: str,
         tail = (w_err or "").strip().splitlines()[-4:]
         log(f"case {name}: worker rc={rc}: " + " | ".join(tail))
         diag(f"case {name} worker rc={rc}\nstderr:\n{w_err}")
-    if os.path.exists(out):
+    # Claim the result file atomically before reading: a detached worker
+    # from an earlier run can os.replace() this path at ANY moment, and a
+    # plain read-then-unlink would delete its late measurement in the
+    # window between the two calls.
+    claim = f"{out}.claim{os.getpid()}"
+    try:
+        os.replace(out, claim)
+    except OSError:
+        return fallback
+    try:
+        with open(claim) as f:
+            r = json.load(f)
+    except (OSError, json.JSONDecodeError):
         try:
-            with open(out) as f:
-                r = json.load(f)
-        except (OSError, json.JSONDecodeError):
-            return fallback
-        # The spool path is stable across runs: a DETACHED worker from an
-        # earlier run (left alive, never killed) can finish and write this
-        # path after our unlink.  The run token separates "ours" from
-        # "theirs": a foreign result is left in the spool — it is a real
-        # late measurement that harvest_spool merges with honest ranking —
-        # but must not impersonate THIS run's case.
-        if token and r.get("run_token") not in (token, None):
-            log(f"case {name}: spool result is from another run; "
-                "leaving it for harvest")
-            return fallback
-        r.pop("run_token", None)
-        try:
-            os.unlink(out)  # consumed; only abandoned results get harvested
+            os.unlink(claim)  # corrupt; don't leave orphans
         except OSError:
             pass
-        return r
-    return fallback
+        return fallback
+    # The run token separates "ours" from "theirs": a foreign result is a
+    # real late measurement from an earlier run — put it back into the
+    # spool under a name only harvest_spool reads, never impersonating
+    # THIS run's case.
+    if token and r.get("run_token") not in (token, None):
+        log(f"case {name}: spool result is from another run; "
+            "leaving it for harvest")
+        try:
+            os.replace(claim, f"{out[:-5]}.late{os.getpid()}.json")
+        except OSError:
+            pass
+        return fallback
+    r.pop("run_token", None)
+    try:
+        os.unlink(claim)  # consumed
+    except OSError:
+        pass
+    return r
 
 
 def run_case(name: str, env: dict, tmpdir: str, degraded: bool,
@@ -328,20 +341,34 @@ def write_result(path: str, result: dict) -> None:
 
 def harvest_spool(matrix: list) -> None:
     """Fold completed spool files into ``matrix`` (merge dedups by metric).
-    Parsed files are deleted; a half-written file (worker mid-write) fails
-    to parse and is left for the next harvest."""
+    Parsed files are deleted; a file that fails to parse is left for the
+    next harvest while fresh (a writer may be mid-replace) and swept once
+    it is clearly abandoned, as are orphaned .tmp/.claim files."""
     try:
         names = os.listdir(SPOOL)
     except OSError:
         return
+    now = time.time()
     for fn in names:
-        if not fn.endswith(".json"):
-            continue
         path = os.path.join(SPOOL, fn)
+        if not fn.endswith(".json"):
+            # write_result tmp files / collector claim files orphaned by a
+            # crashed process: sweep once stale.
+            try:
+                if now - os.stat(path).st_mtime > 900:
+                    os.unlink(path)
+            except OSError:
+                pass
+            continue
         try:
             with open(path) as f:
                 r = json.load(f)
         except (OSError, json.JSONDecodeError):
+            try:
+                if now - os.stat(path).st_mtime > 900:
+                    os.unlink(path)  # permanently corrupt, not in-flight
+            except OSError:
+                pass
             continue
         r.pop("run_token", None)
         # shim=False marks the bare-metal comparison leg of the
@@ -407,6 +434,11 @@ def main() -> None:
     matrix = []
     tmpdir = tempfile.mkdtemp(prefix="vtpu-bench-")
     try:
+        # Harvest FIRST: an earlier run's detached worker may have left a
+        # completed on-chip result in the spool; re-attempting its case
+        # below would otherwise discard that evidence before the
+        # end-of-run harvest could merge it.
+        harvest_spool(matrix)
         build_native()
         env = shim_env(tmpdir)
         platform, degraded = pick_platform(env)

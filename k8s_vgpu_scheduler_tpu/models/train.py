@@ -277,7 +277,9 @@ def run_preemptible(step, state: TrainState, tokens, n_steps: int,
                 ckpt.save(done, state, wait=True)
             return state, done, True
         state, _loss = step(state, tokens)
-        done = int(state.step)
+        # Count locally: fetching state.step would force a host-device
+        # sync every iteration and serialize the dispatch pipeline.
+        done += 1
     if done > saved:
         ckpt.save(done, state, wait=True)
     return state, done, False

@@ -66,10 +66,13 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, axis_name: str = "sp",
     """
     sp = mesh.shape[axis_name]
     H = q.shape[2]
-    if H % sp:
+    # K/V heads checked too: GQA callers must repeat KV up to H first
+    # (the flagship does) or keep n_kv_heads divisible by sp — otherwise
+    # the scatter would fail deep inside shard_map with a shape error.
+    if H % sp or k.shape[2] % sp or v.shape[2] % sp:
         raise ValueError(
-            f"ulysses needs heads % sp == 0, got H={H}, sp={sp}; "
-            "use ring attention for this shape")
+            f"ulysses needs heads % sp == 0, got H={H}, "
+            f"kv={k.shape[2]}, sp={sp}; use ring attention for this shape")
     spec = P(None, axis_name, None, None)
     fn = functools.partial(
         _ulysses_sharded,

@@ -115,6 +115,10 @@ class Scheduler:
         # victim uid -> monotonic time of the last preempt annotation
         # (throttles re-patching while the victim checkpoints).
         self._preempt_requested: Dict[str, float] = {}
+        # requester uid -> {victim uid: (namespace, name)} for RESCISSION:
+        # when the requester places elsewhere or is deleted, its victims'
+        # annotations are cleared so nobody checkpoints for nothing.
+        self._preempt_by_requester: Dict[str, Dict[str, Tuple[str, str]]] = {}
         self._preempt_lock = threading.Lock()
         # Lifetime count of successfully-written eviction requests (the
         # metrics collector exposes it; operators alert on it — every
@@ -176,6 +180,10 @@ class Scheduler:
             if event == "DELETED" or is_pod_terminated(pod):
                 self.gangs.drop_member(uid)
                 self._note_deleted(uid)
+                # A deleted pod can be an outstanding preemption REQUESTER:
+                # rescind so its victims don't checkpoint for nothing.
+                if self._preempt_by_requester.get(uid):
+                    self._rescind_preemptions(uid)
             elif self.gangs.is_reserved(uid):
                 return
             self.pods.del_pod(uid)
@@ -245,15 +253,21 @@ class Scheduler:
         return rv
 
     # -- usage snapshot --------------------------------------------------------
+    def _pods_by_node(self) -> Dict[str, List[PodInfo]]:
+        """One grouping used by BOTH the usage snapshot and the preemption
+        planner — they must see the same pod→node mapping."""
+        out: Dict[str, List[PodInfo]] = {}
+        for p in self.pods.list_pods():
+            out.setdefault(p.node, []).append(p)
+        return out
+
     def get_nodes_usage(
         self, node_names: Optional[List[str]] = None
     ) -> Dict[str, Tuple[NodeInfo, Dict[str, score_mod.DeviceUsage]]]:
         """Registered inventory minus scheduled grants, per node
         (reference getNodesUsage, scheduler.go:176–222)."""
         all_nodes = self.nodes.list_nodes()
-        pods_by_node: Dict[str, List[PodInfo]] = {}
-        for p in self.pods.list_pods():
-            pods_by_node.setdefault(p.node, []).append(p)
+        pods_by_node = self._pods_by_node()
         out = {}
         for name, info in all_nodes.items():
             if node_names is not None and name not in node_names:
@@ -282,6 +296,10 @@ class Scheduler:
             if result.preempt is not None:
                 self._request_preemptions(pod, result.preempt)
             return result
+        if self._preempt_by_requester.get(pod_uid(pod)):
+            # The pod found a seat after all (capacity freed elsewhere):
+            # its outstanding eviction requests are now pointless.
+            self._rescind_preemptions(pod_uid(pod))
         encoded = codec.encode_pod_devices(self.pods.get(pod_uid(pod)).devices)
         patch = {
             ASSIGNED_NODE_ANNOTATION: result.node,
@@ -325,6 +343,8 @@ class Scheduler:
                     v.namespace, v.name, {PREEMPT_ANNOTATION: pod_uid(pod)})
                 with self._preempt_lock:
                     self.preemptions_requested += 1
+                    self._preempt_by_requester.setdefault(
+                        pod_uid(pod), {})[v.uid] = (v.namespace, v.name)
                 log.warning(
                     "preemption: asked %s/%s (prio %d) to checkpoint and "
                     "release %s for pod %s", v.namespace, v.name, v.priority,
@@ -333,6 +353,30 @@ class Scheduler:
                 log.error("preemption request for %s failed: %s", v.name, e)
                 with self._preempt_lock:
                     self._preempt_requested.pop(v.uid, None)
+
+    def _rescind_preemptions(self, requester_uid: str) -> None:
+        """The requester no longer needs the room (placed elsewhere, or
+        deleted): clear its victims' annotations so no pod checkpoints
+        and exits for nothing.  Rescission writes an EMPTY value — the
+        in-container watch treats empty as not-requested — because k8s
+        strategic-merge patches cannot reliably delete a key through
+        every client."""
+        with self._preempt_lock:
+            victims = self._preempt_by_requester.pop(requester_uid, None)
+        if not victims:
+            return
+        for vuid, (namespace, name) in victims.items():
+            with self._preempt_lock:
+                self._preempt_requested.pop(vuid, None)
+            try:
+                self.client.patch_pod_annotations(
+                    namespace, name, {PREEMPT_ANNOTATION: ""})
+                log.info("preemption rescinded for %s/%s (requester %s "
+                         "no longer pending)", namespace, name,
+                         requester_uid)
+            except Exception as e:  # noqa: BLE001 — victim may be gone
+                log.info("preemption rescission for %s/%s not written "
+                         "(%s)", namespace, name, e)
 
     def _decide_locked(self, pod: dict, node_names: List[str]) -> FilterResult:
         try:
@@ -374,9 +418,7 @@ class Scheduler:
         if best is None:
             plan = None
             if self.cfg.enable_preemption:
-                pods_by_node: Dict[str, List[PodInfo]] = {}
-                for p in self.pods.list_pods():
-                    pods_by_node.setdefault(p.node, []).append(p)
+                pods_by_node = self._pods_by_node()
                 # Gang members are never victims: evicting one would hang
                 # the surviving collective while freeing a fraction of the
                 # gang's footprint.

@@ -46,9 +46,11 @@ PREEMPT_ANNOTATION = "vtpu.dev/preempt-requested"
 
 @dataclasses.dataclass
 class PreemptionPlan:
+    # No placement is carried: victims take minutes to checkpoint and
+    # exit, after which the requester's next Filter re-fits from scratch
+    # against the then-current usage.
     node: str
     victims: List[PodInfo]
-    placement: object  # the fit that becomes valid once victims release
 
 
 def _fits_without(requests, info: NodeInfo, pods: List[PodInfo],
@@ -92,12 +94,10 @@ def plan_preemption(
             continue
         candidates.sort(key=lambda p: (-p.priority, -p.touched_at))
         chosen: Optional[List[PodInfo]] = None
-        placement = None
         # Single-victim pass first (cheapest possible plan on this node).
         for c in candidates:
-            placement = _fits_without(
-                requests, info, pods, {c.uid}, anns, policy)
-            if placement is not None:
+            if _fits_without(requests, info, pods, {c.uid}, anns,
+                             policy) is not None:
                 chosen = [c]
                 break
         if chosen is None:
@@ -107,9 +107,8 @@ def plan_preemption(
             for c in candidates:
                 acc.append(c)
                 excluded.add(c.uid)
-                placement = _fits_without(
-                    requests, info, pods, excluded, anns, policy)
-                if placement is not None:
+                if _fits_without(requests, info, pods, excluded, anns,
+                                 policy) is not None:
                     chosen = list(acc)
                     break
         if chosen is None:
@@ -119,7 +118,7 @@ def plan_preemption(
         key = (len(chosen),
                -score_mod.node_score(usage_after, node_policy))
         if best is None or key < (best[0], best[1]):
-            best = (key[0], key[1], node, chosen, placement)
+            best = (key[0], key[1], node, chosen)
     if best is None:
         return None
-    return PreemptionPlan(node=best[2], victims=best[3], placement=best[4])
+    return PreemptionPlan(node=best[2], victims=best[3])

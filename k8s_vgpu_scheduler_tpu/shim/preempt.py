@@ -34,16 +34,20 @@ class PreemptionWatch:
 
     def __init__(self, path: Optional[str] = None) -> None:
         self.path = path or os.environ.get(PATH_ENV, DEFAULT_PATH)
-        self._mtime: float = -1.0
+        self._stamp: Optional[tuple] = None
         self._cached = False
 
     def requested(self) -> bool:
         try:
-            mtime = os.stat(self.path).st_mtime
+            st = os.stat(self.path)
         except OSError:
             return False
-        if mtime != self._mtime:
-            self._mtime = mtime
+        # Inode + ns-mtime + size: kubelet's atomic symlink swap changes
+        # the inode even when a coarse-granularity mtime stands still, so
+        # equality of this triple really means "same file contents".
+        stamp = (st.st_ino, st.st_mtime_ns, st.st_size)
+        if stamp != self._stamp:
+            self._stamp = stamp
             self._cached = self._parse()
         return self._cached
 
@@ -53,15 +57,18 @@ class PreemptionWatch:
         return val if val else None
 
     def _parse(self) -> bool:
-        return self._read_value() is not None
+        return bool(self._read_value())
 
     def _read_value(self) -> Optional[str]:
+        """Requester uid, or None when absent OR rescinded (the scheduler
+        rescinds by writing an EMPTY value — deleting an annotation key is
+        not portable across patch types)."""
         try:
             with open(self.path) as f:
                 for line in f:
                     key, sep, val = line.partition("=")
                     if sep and key.strip() == PREEMPT_ANNOTATION:
-                        return val.strip().strip('"')
+                        return val.strip().strip('"') or None
         except OSError:
             return None
         return None

@@ -126,7 +126,14 @@ class TestSpool:
         fallback = {"metric": "c", "value": 0.0, "error": "x"}
         got = bench.collect_worker("c", [], {}, out, 5.0, fallback)
         assert got is fallback
-        assert os.path.exists(out)  # left for harvest
+        # The foreign record is preserved under a .late name (the claim
+        # protocol renames it away from the live path) and harvest still
+        # merges it.
+        late = list(tmp_path.glob("*.late*.json"))
+        assert late and not os.path.exists(out)
+        matrix = []
+        bench.harvest_spool(matrix)
+        assert matrix == [tpu("c", 1.0)]
 
     def test_collector_accepts_own_token_and_consumes(self, monkeypatch,
                                                       tmp_path):

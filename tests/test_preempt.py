@@ -213,6 +213,53 @@ class TestSchedulerPreemption:
         assert PREEMPT_ANNOTATION not in anns
 
 
+class TestRescission:
+    """An eviction request whose requester no longer needs the room is
+    RESCINDED (annotation cleared to empty), so no pod checkpoints and
+    exits for nothing."""
+
+    def _pending_requester(self, kube, s):
+        place(kube, s, tpu_pod("lp", "u-lp", "16000", priority=1))
+        hp = tpu_pod("hp", "u-hp", "16000")
+        kube.create_pod(hp)
+        assert s.filter(hp, ["node-a"]).node is None
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION] == "u-hp"
+        return hp
+
+    def test_placement_elsewhere_rescinds(self, env):
+        kube, s = env
+        hp = self._pending_requester(kube, s)
+        # A second node appears with room: hp places WITHOUT the eviction.
+        kube.add_node({"metadata": {"name": "node-b", "annotations": {}}})
+        register_node(s, "node-b")
+        assert s.filter(hp, ["node-a", "node-b"]).node == "node-b"
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION] == ""  # rescinded
+        # The in-container watch treats the empty value as not-requested.
+        assert s._preempt_by_requester == {}
+
+    def test_requester_deletion_rescinds(self, env):
+        kube, s = env
+        self._pending_requester(kube, s)
+        kube.delete_pod("default", "hp")
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION] == ""
+        # The victim is requestable again immediately (throttle cleared).
+        assert "u-lp" not in s._preempt_requested
+
+    def test_watch_treats_empty_value_as_not_requested(self, tmp_path):
+        path = str(tmp_path / "annotations")
+        with open(path, "w") as f:
+            f.write('vtpu.dev/preempt-requested="u-hp"\n')
+        w = PreemptionWatch(path)
+        assert w.requested() is True
+        with open(path, "w") as f:
+            f.write('vtpu.dev/preempt-requested=""\n')
+        os.utime(path, (time.time() + 5, time.time() + 5))
+        assert w.requested() is False and w.requester() is None
+
+
 class TestPreemptionMetric:
     def test_counter_increments_on_request(self, env):
         from k8s_vgpu_scheduler_tpu.scheduler.metrics import ClusterCollector
