@@ -157,3 +157,60 @@ class TestChurn:
         # but nothing deleted may linger.
         assert tracked <= live_uids | {
             u for u in tracked if s.gangs.is_reserved(u)}
+
+    def test_churn_with_preemption_never_targets_gangs(self):
+        """Same interleaving with preemption ON and mixed priorities: the
+        capacity invariant holds, gang members are never annotated, and
+        every annotated victim was strictly lower priority than some
+        then-pending requester."""
+        from k8s_vgpu_scheduler_tpu.scheduler.preempt import (
+            PREEMPT_ANNOTATION)
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config(enable_preemption=True))
+        for n in NODES:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n, chips=CHIPS_PER_NODE, devmem=CHIP_MIB)
+        kube.watch_pods(s.on_pod_event)
+        rng = random.Random(0xBEEF)
+        live, gang_names, counter = {}, set(), 0
+
+        for step in range(300):
+            op = rng.random()
+            if op < 0.5 or not live:
+                counter += 1
+                name, uid = f"p{counter}", f"u{counter}"
+                pod = tpu_pod(name=name, uid=uid,
+                              mem=rng.choice(["3000", "8000", "16384"]),
+                              nums=rng.choice(["1", "1", "2"]))
+                prio = rng.choice([None, None, "1", "2"])
+                if prio is not None:
+                    pod["spec"]["containers"][0]["resources"]["limits"][
+                        "vtpu.dev/task-priority"] = prio
+                if rng.random() < 0.25:
+                    pod["metadata"]["annotations"].update({
+                        GANG_GROUP_ANNOTATION: f"g{counter % 4}",
+                        GANG_TOTAL_ANNOTATION: "2",
+                    })
+                    gang_names.add(name)
+                kube.create_pod(pod)
+                live[name] = pod
+                s.filter(pod, NODES)
+            elif op < 0.75:
+                s.filter(live[rng.choice(sorted(live))], NODES)
+            else:
+                name = rng.choice(sorted(live))
+                kube.delete_pod("default", name)
+                del live[name]
+                gang_names.discard(name)
+            assert_capacity_invariant(s, f"step {step}")
+            for name in list(live):
+                anns = kube.get_pod(
+                    "default", name)["metadata"]["annotations"]
+                if anns.get(PREEMPT_ANNOTATION):
+                    assert name not in gang_names, (
+                        f"gang member {name} annotated for preemption")
+                    limits = live[name]["spec"]["containers"][0][
+                        "resources"]["limits"]
+                    assert limits.get("vtpu.dev/task-priority") in (
+                        "1", "2"), f"priority-0 pod {name} targeted"
