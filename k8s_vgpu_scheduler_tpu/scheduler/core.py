@@ -250,7 +250,44 @@ class Scheduler:
             if info.uid not in alive and info.touched_at < list_started:
                 self.gangs.drop_member(info.uid, tombstone=False)
                 self.pods.del_pod(info.uid)
+        self._reconcile_preemptions(pods)
         return rv
+
+    def _reconcile_preemptions(self, pods: List[dict]) -> None:
+        """Annotations-as-WAL for the preemption ledger: after a scheduler
+        restart the in-memory requester→victims map is empty, but the
+        victims' annotations persist.  Rebuild the ledger from the list —
+        and rescind any request whose requester is gone or already placed,
+        so no victim checkpoints for a requester that no longer waits."""
+        by_uid = {pod_uid(p): p for p in pods}
+        for pod in pods:
+            anns = pod.get("metadata", {}).get("annotations", {})
+            requester = anns.get(PREEMPT_ANNOTATION)
+            if not requester:
+                continue
+            req_pod = by_uid.get(requester)
+            still_pending = (
+                req_pod is not None
+                and not is_pod_terminated(req_pod)
+                and not req_pod.get("metadata", {}).get(
+                    "annotations", {}).get(ASSIGNED_NODE_ANNOTATION)
+            )
+            if still_pending:
+                with self._preempt_lock:
+                    self._preempt_by_requester.setdefault(
+                        requester, {})[pod_uid(pod)] = (
+                            pod_namespace(pod), pod_name(pod))
+            else:
+                try:
+                    self.client.patch_pod_annotations(
+                        pod_namespace(pod), pod_name(pod),
+                        {PREEMPT_ANNOTATION: ""})
+                    log.info("resync: rescinded stale preemption on %s "
+                             "(requester %s gone or placed)",
+                             pod_name(pod), requester)
+                except Exception as e:  # noqa: BLE001 — next resync retries
+                    log.info("resync: stale-preemption rescission for %s "
+                             "not written (%s)", pod_name(pod), e)
 
     # -- usage snapshot --------------------------------------------------------
     def _pods_by_node(self) -> Dict[str, List[PodInfo]]:

@@ -248,6 +248,40 @@ class TestRescission:
         # The victim is requestable again immediately (throttle cleared).
         assert "u-lp" not in s._preempt_requested
 
+    def test_scheduler_restart_rebuilds_ledger_from_annotations(self, env):
+        """Annotation-as-WAL: a FRESH scheduler learns outstanding
+        requests from the resync list and can still rescind them when the
+        requester later places elsewhere."""
+        kube, s = env
+        self._pending_requester(kube, s)
+        s2 = Scheduler(kube, Config(enable_preemption=True))  # restart
+        register_node(s2, "node-a")
+        s2.resync_from_apiserver()
+        assert "u-lp" in s2._preempt_by_requester.get("u-hp", {})
+        # Requester finds a seat on a new node -> the rebuilt ledger
+        # rescinds the victim's annotation.
+        kube.add_node({"metadata": {"name": "node-b", "annotations": {}}})
+        register_node(s2, "node-b")
+        hp = kube.get_pod("default", "hp")
+        assert s2.filter(hp, ["node-a", "node-b"]).node == "node-b"
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION] == ""
+
+    def test_resync_rescinds_when_requester_gone(self, env):
+        """A victim annotated by a requester that was deleted while the
+        scheduler was down is rescinded by the first resync."""
+        kube, s = env
+        self._pending_requester(kube, s)
+        # "Deleted while the scheduler was down": remove via the API, then
+        # resync a fresh scheduler that never saw the delete event.
+        kube.delete_pod("default", "hp")
+        s2 = Scheduler(kube, Config(enable_preemption=True))
+        register_node(s2, "node-a")
+        s2.resync_from_apiserver()
+        anns = kube.get_pod("default", "lp")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION] == ""
+        assert s2._preempt_by_requester == {}
+
     def test_watch_treats_empty_value_as_not_requested(self, tmp_path):
         path = str(tmp_path / "annotations")
         with open(path, "w") as f:
