@@ -111,3 +111,145 @@ def jit_generate(cfg: LlamaConfig, max_new_tokens: int,
                         prompt_lens=prompt_lens)
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding (greedy): draft model proposes k tokens, ONE target
+# forward verifies all of them.
+# ---------------------------------------------------------------------------
+
+def _set_cache_idx(cache, value):
+    """Rewind every layer's cache write index to ``value``.
+
+    Speculative decoding writes cache entries for tokens that may be
+    REJECTED; the next round must overwrite them, so the append index is
+    set explicitly instead of trusting the auto-increment.  Entries past
+    the rewound index are left stale deliberately: every slot's logical
+    position exceeds any query position that could read it before it is
+    overwritten (the causal mask ``key_pos <= q_pos`` hides it), and each
+    round's write interval extends at least to the previous round's end,
+    so a stale slot is always overwritten before it becomes attendable.
+    """
+    def f(path, x):
+        if path and getattr(path[-1], "key", None) == "idx":
+            return jnp.full(x.shape, value, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(f, cache)
+
+
+def speculative_generate(cfg: LlamaConfig, params,
+                         draft_cfg: LlamaConfig, draft_params,
+                         prompt, max_new_tokens: int, k: int = 4):
+    """Greedy speculative decoding for one sequence (B=1).
+
+    A small draft model proposes ``k`` tokens autoregressively; the target
+    verifies all of them in ONE forward over k+1 positions and accepts the
+    longest matching prefix plus its own correction token — so each target
+    forward emits between 1 and k+1 tokens.  With greedy acceptance the
+    output is TOKEN-IDENTICAL to plain greedy :func:`generate` for ANY
+    draft model (tests pin this with a random draft); the draft quality
+    only affects speed, never content.
+
+    Returns ``(tokens [1, P + max_new_tokens], stats)`` where stats holds
+    ``target_forwards`` (prefill excluded) and ``drafted``/``accepted``
+    counts — ``accepted / drafted`` is the acceptance rate that determines
+    the speedup.
+    """
+    B, P = prompt.shape
+    if B != 1:
+        raise ValueError("speculative decoding serves one sequence (B=1); "
+                         "batch serving uses generate()")
+    if max_new_tokens <= 0:
+        return prompt, {"target_forwards": jnp.int32(0),
+                        "drafted": jnp.int32(0), "accepted": jnp.int32(0)}
+    total = P + max_new_tokens + k + 1  # verify-overshoot slack
+    tmodel = Llama(dataclasses.replace(
+        cfg, decode_cache_len=total, attention="full"), decode=True)
+    dmodel = Llama(dataclasses.replace(
+        draft_cfg, decode_cache_len=total, attention="full"), decode=True)
+    # B=1, no padding: slot == logical position for every cache entry, so
+    # ONE constant map serves all rounds — unwritten/stale slots carry a
+    # position greater than any live query and stay masked.
+    key_pos = jnp.arange(total, dtype=jnp.int32)[None]
+    positions = jnp.arange(P, dtype=jnp.int32)[None]
+
+    tlogits, ts = tmodel.apply({"params": params["params"]}, prompt,
+                               positions, key_pos, mutable=["cache"])
+    tcache = ts["cache"]
+    _, dst = dmodel.apply({"params": draft_params["params"]}, prompt,
+                          positions, key_pos, mutable=["cache"])
+    dcache = dst["cache"]
+    first = jnp.argmax(tlogits[0, -1]).astype(jnp.int32)
+
+    buf = jnp.zeros((max_new_tokens + k + 1,), jnp.int32).at[0].set(first)
+    arange_k1 = jnp.arange(k + 1, dtype=jnp.int32)
+
+    def cond(c):
+        return c["n_out"] < max_new_tokens
+
+    def body(c):
+        n_ctx = c["n_ctx"]
+        # 1) Draft k tokens from the pending (emitted, not-yet-cached) one.
+        dcache = _set_cache_idx(c["dcache"], n_ctx)
+
+        def dstep(carry, j):
+            dc, tok = carry
+            lg, st = dmodel.apply(
+                {"params": draft_params["params"], "cache": dc},
+                tok[None, None], (n_ctx + j)[None, None], key_pos,
+                mutable=["cache"])
+            nxt = jnp.argmax(lg[0, -1]).astype(jnp.int32)
+            return (st["cache"], nxt), nxt
+
+        (dcache, _), drafts = jax.lax.scan(
+            dstep, (dcache, c["pending"]),
+            jnp.arange(k, dtype=jnp.int32))
+
+        # 2) One target forward verifies pending + all k drafts.
+        tcache = _set_cache_idx(c["tcache"], n_ctx)
+        verify = jnp.concatenate([c["pending"][None], drafts])[None]
+        vpos = (n_ctx + arange_k1)[None]
+        lg, st = tmodel.apply(
+            {"params": params["params"], "cache": tcache},
+            verify, vpos, key_pos, mutable=["cache"])
+        tpred = jnp.argmax(lg[0], axis=-1).astype(jnp.int32)  # [k+1]
+
+        # 3) Longest agreeing prefix; the target's own token corrects (or
+        # extends, when all k agree) the sequence.
+        eq = (drafts == tpred[:k]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(eq))
+        emit = jnp.where(arange_k1 < m,
+                         jnp.concatenate([drafts, jnp.zeros(1, jnp.int32)]),
+                         tpred)
+        buf = jax.lax.dynamic_update_slice(c["buf"], emit, (c["n_out"],))
+        return {
+            "tcache": st["cache"], "dcache": dcache, "buf": buf,
+            "n_out": c["n_out"] + m + 1, "n_ctx": n_ctx + m + 1,
+            "pending": jnp.take(emit, m),
+            "rounds": c["rounds"] + 1, "accepted": c["accepted"] + m,
+        }
+
+    out = jax.lax.while_loop(cond, body, {
+        "tcache": tcache, "dcache": dcache, "buf": buf,
+        "n_out": jnp.int32(1), "n_ctx": jnp.int32(P),
+        "pending": first, "rounds": jnp.int32(0),
+        "accepted": jnp.int32(0),
+    })
+    tokens = jnp.concatenate(
+        [prompt, out["buf"][None, :max_new_tokens]], axis=1)
+    stats = {"target_forwards": out["rounds"],
+             "drafted": out["rounds"] * k, "accepted": out["accepted"]}
+    return tokens, stats
+
+
+def jit_speculative_generate(cfg: LlamaConfig, draft_cfg: LlamaConfig,
+                             max_new_tokens: int, k: int = 4):
+    """Compiled speculative decode: fn(params, draft_params, prompt)."""
+
+    @jax.jit
+    def run(params, draft_params, prompt):
+        return speculative_generate(cfg, params, draft_cfg, draft_params,
+                                    prompt, max_new_tokens, k=k)
+
+    return run

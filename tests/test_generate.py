@@ -13,7 +13,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from k8s_vgpu_scheduler_tpu.models.generate import generate, jit_generate
+from k8s_vgpu_scheduler_tpu.models.generate import (
+    generate,
+    jit_generate,
+    jit_speculative_generate,
+    speculative_generate,
+)
 from k8s_vgpu_scheduler_tpu.models.llama import Llama, llama_tiny
 
 
@@ -159,3 +164,60 @@ class TestSampling:
         with pytest.raises(ValueError, match="decode_cache_len"):
             dec.apply({"params": params["params"]}, prompt,
                       mutable=["cache"])
+
+
+class TestSpeculative:
+    """Greedy speculative decoding must be TOKEN-IDENTICAL to plain greedy
+    for any draft — the draft only buys speed, never changes content."""
+
+    @pytest.fixture(scope="class")
+    def spec_setup(self):
+        cfg = dataclasses.replace(llama_tiny(), dtype="float32")
+        draft_cfg = dataclasses.replace(
+            cfg, dim=32, n_layers=1, n_heads=2, n_kv_heads=2, ffn_hidden=64)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab)
+        params = Llama(cfg).init(jax.random.PRNGKey(0), prompt)
+        # Untrained random draft: low acceptance — the hardest case for
+        # the rollback/stale-cache logic.
+        draft_params = Llama(draft_cfg).init(jax.random.PRNGKey(9), prompt)
+        return cfg, draft_cfg, params, draft_params, prompt
+
+    def test_random_draft_token_identical_to_greedy(self, spec_setup):
+        cfg, draft_cfg, params, draft_params, prompt = spec_setup
+        want = generate(cfg, params, prompt, 12)
+        got, stats = speculative_generate(
+            cfg, params, draft_cfg, draft_params, prompt, 12, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert int(stats["target_forwards"]) >= 1
+        assert int(stats["accepted"]) <= int(stats["drafted"])
+
+    def test_self_draft_high_acceptance_few_forwards(self, spec_setup):
+        """draft == target: proposals verify except at float argmax
+        tie-breaks (the 1-token draft forward and the (k+1)-token verify
+        forward need not be bitwise identical — the algorithm exists to
+        absorb exactly such divergence).  Output still token-exact, with
+        high acceptance and far fewer target forwards than tokens."""
+        cfg, _, params, _, prompt = spec_setup
+        n, k = 12, 3
+        want = generate(cfg, params, prompt, n)
+        got, stats = speculative_generate(
+            cfg, params, cfg, params, prompt, n, k=k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        rounds = int(stats["target_forwards"])
+        assert -(-(n - 1) // (k + 1)) <= rounds < n - 1
+        assert int(stats["accepted"]) >= int(stats["drafted"]) * 2 // 3
+
+    def test_jit_wrapper_matches(self, spec_setup):
+        cfg, draft_cfg, params, draft_params, prompt = spec_setup
+        run = jit_speculative_generate(cfg, draft_cfg, 8, k=2)
+        got, _ = run(params, draft_params, prompt)
+        want = generate(cfg, params, prompt, 8)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_batch_rejected(self, spec_setup):
+        cfg, draft_cfg, params, draft_params, _ = spec_setup
+        two = jnp.ones((2, 4), jnp.int32)
+        with pytest.raises(ValueError, match="one sequence"):
+            speculative_generate(cfg, params, draft_cfg, draft_params,
+                                 two, 4)
