@@ -178,7 +178,7 @@ class TestWatchLoopE2E:
             # compile-heavy suites; the behavior, not the latency, is
             # under test here.
             assert wait_until(lambda: s.pods.get("ua") is not None,
-                              timeout=15.0)
+                              timeout=40.0)
             # Simulated stream break: server restarts on a new port is not
             # possible mid-fixture, but a journal compaction forces the
             # Gone -> re-list path.
@@ -191,7 +191,7 @@ class TestWatchLoopE2E:
                     sim.kube.create_pod(tpu_pod(name=f"f{i}", uid=f"uf{i}"))
                 sim.kube.delete_pod("default", "a")
                 assert wait_until(lambda: s.pods.get("ua") is None,
-                                  timeout=15.0)
+                                  timeout=40.0)
             finally:
                 fake.JOURNAL_LIMIT = old_limit
         finally:
