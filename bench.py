@@ -89,6 +89,7 @@ FLASH_CASE = "flash_attention_microbench"
 # Flagship serving: KV-cache autoregressive decode, tokens/s (no reference
 # analog — the reference has no LLM; extra on-chip-only metric).
 DECODE_CASE = "llama_decode_microbench"
+SPEC_CASE = "llama_speculative_decode_microbench"
 
 _START = time.monotonic()
 
@@ -506,6 +507,10 @@ def main() -> None:
                 matrix.append(run_worker_case(
                     DECODE_CASE, "--decode-worker", env, tmpdir,
                     min(remaining() - 30, 180.0), unit="tokens/s"))
+            if not degraded and remaining() > 120 and not _WORKER_OVERRAN:
+                matrix.append(run_worker_case(
+                    SPEC_CASE, "--spec-worker", env, tmpdir,
+                    min(remaining() - 30, 240.0), unit="tokens/s"))
     except Exception as e:  # noqa: BLE001 — emission must survive anything
         if not emitted.get("value"):
             emitted["error"] = f"harness: {e!r}"
@@ -708,6 +713,89 @@ def decode_worker(out_path: str) -> None:
     write_result(out_path, result)
 
 
+def spec_worker(out_path: str) -> None:
+    """Speculative vs plain greedy decode, single sequence (B=1): the
+    draft is an EARLY-EXIT of the target itself — its first 2 layers plus
+    the target's own embedding, final norm and head (LayerSkip-style
+    self-speculation), so no second trained model is needed and the
+    acceptance rate is a property of the architecture, not of a random
+    init.  Records both throughputs, the speedup, and the acceptance
+    rate; spec output is asserted token-identical to plain before any
+    timing counts."""
+    sys.path.insert(0, REPO)
+    import dataclasses
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from k8s_vgpu_scheduler_tpu.models.generate import (
+        jit_generate, jit_speculative_generate)
+    from k8s_vgpu_scheduler_tpu.models.llama import Llama, LlamaConfig
+
+    if os.environ.get("BENCH_DECODE_TINY") == "1":
+        cfg = LlamaConfig(vocab=256, dim=128, n_layers=4, n_heads=8,
+                          n_kv_heads=4, ffn_hidden=256)
+        P, N, K = 16, 16, 3
+    else:
+        cfg = LlamaConfig(vocab=8192, dim=768, n_layers=12, n_heads=12,
+                          n_kv_heads=4, ffn_hidden=2048)
+        P, N, K = 128, 128, 4
+    draft_cfg = dataclasses.replace(cfg, n_layers=2)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, P), 0, cfg.vocab)
+    params = jax.jit(Llama(cfg).init)(jax.random.PRNGKey(0), prompt)
+
+    # Early-exit draft: every draft leaf whose path+shape exists in the
+    # target (embed, layers 0-1, final norm, head) takes the target's
+    # weights.
+    draft0 = jax.jit(Llama(draft_cfg).init)(jax.random.PRNGKey(2), prompt)
+    tgt_by_path = {
+        jax.tree_util.keystr(p): x
+        for p, x in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+
+    def graft(path, x):
+        t = tgt_by_path.get(jax.tree_util.keystr(path))
+        return t if t is not None and t.shape == x.shape else x
+
+    draft_params = jax.tree_util.tree_map_with_path(graft, draft0)
+
+    plain = jit_generate(cfg, max_new_tokens=N)
+    spec = jit_speculative_generate(cfg, draft_cfg, N, k=K)
+
+    want = plain(params, prompt)
+    got, stats = spec(params, draft_params, prompt)
+    assert np.array_equal(np.asarray(got), np.asarray(want)), \
+        "speculative decode diverged from greedy"
+
+    def timed(fn, reps=3):
+        t0 = time.perf_counter()
+        for i in range(reps):
+            out = fn((prompt + i) % cfg.vocab)
+            (out[0] if isinstance(out, tuple) else out)[0, -1].item()
+        return (time.perf_counter() - t0) / reps
+
+    dt_plain = timed(lambda p: plain(params, p))
+    dt_spec = timed(lambda p: spec(params, draft_params, p))
+    accept = float(stats["accepted"]) / max(float(stats["drafted"]), 1.0)
+    result = {
+        "metric": SPEC_CASE, "unit": "tokens/s",
+        "value": round(N / dt_spec, 1),
+        "plain_tokens_per_s": round(N / dt_plain, 1),
+        "speedup_vs_plain": round(dt_plain / dt_spec, 3),
+        "acceptance_rate": round(accept, 3),
+        "target_forwards": int(stats["target_forwards"]),
+        "k": K, "token_identical": True,
+        "platform": jax.devices()[0].platform,
+        "config": {"draft_layers": draft_cfg.n_layers,
+                   "target_layers": cfg.n_layers, "new_tokens": N},
+    }
+    write_result(out_path, result)
+
+
 # ----------------------------------------------------------------------------
 # Worker: runs in its own process; the only code that imports jax.
 # ----------------------------------------------------------------------------
@@ -836,16 +924,20 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
 
 
 if __name__ == "__main__":
-    if "--flash-worker" in sys.argv or "--decode-worker" in sys.argv:
+    if ("--flash-worker" in sys.argv or "--decode-worker" in sys.argv
+            or "--spec-worker" in sys.argv):
         import argparse
 
         p = argparse.ArgumentParser()
         p.add_argument("--flash-worker", action="store_true")
         p.add_argument("--decode-worker", action="store_true")
+        p.add_argument("--spec-worker", action="store_true")
         p.add_argument("--out", required=True)
         a = p.parse_args()
         if a.decode_worker:
             decode_worker(a.out)
+        elif a.spec_worker:
+            spec_worker(a.out)
         else:
             flash_worker(a.out)
     elif "--worker" in sys.argv:

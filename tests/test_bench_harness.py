@@ -153,6 +153,27 @@ class TestSpool:
         assert not os.path.exists(out)  # consumed
 
 
+class TestMicrobenchWorkers:
+    def test_spec_worker_smoke(self, tmp_path):
+        """The speculative-decode worker runs end-to-end at tiny sizing
+        and asserts token-identity itself (it would exit nonzero on
+        divergence)."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+        out = str(tmp_path / "spec.json")
+        env = dict(os.environ, BENCH_DECODE_TINY="1", JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "bench.py"),
+             "--spec-worker", "--out", out],
+            env=env, capture_output=True, text=True, timeout=500)
+        assert r.returncode == 0, r.stderr[-500:]
+        rec = _json.load(open(out))
+        assert rec["token_identical"] is True
+        assert rec["metric"] == bench.SPEC_CASE
+        assert 0.0 <= rec["acceptance_rate"] <= 1.0
+
+
 class TestCaseTable:
     def test_full_reference_matrix_covered(self):
         """All 10 reference rows (README.md:191-204 / BASELINE.md): 5 model
