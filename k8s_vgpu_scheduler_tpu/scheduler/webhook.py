@@ -8,7 +8,12 @@ Reference: pkg/scheduler/webhook.go:170–247.  On pod CREATE:
   ``TPU_TASK_PRIORITY`` env injected (consumed by the enforcement shim's
   rate limiter);
 - if any container requests a managed TPU resource, ``spec.schedulerName``
-  is pointed at our extender-backed scheduler.
+  is pointed at our extender-backed scheduler;
+- TPU containers that opted into LOW priority (>= 1) additionally get the
+  downward-API annotations volume + mount + ``VTPU_PODINFO_ANNOTATIONS``
+  env injected, so the preemption contract (docs/preemption.md) works
+  without any manifest boilerplate — the in-container
+  ``PreemptionWatch`` finds the file at its configured path.
 
 Implemented as an AdmissionReview v1 handler returning a JSONPatch.
 """
@@ -48,6 +53,8 @@ def mutate_pod(pod: dict, cfg: Config) -> List[dict]:
 
     patches: List[dict] = []
     wants_tpu = False
+    needs_podinfo = []
+    env_created: set = set()  # containers whose /env was created above
     for i, (ctr, req) in enumerate(zip(containers, requests)):
         limits = dict(ctr.get("resources", {}).get("requests", {}))
         limits.update(ctr.get("resources", {}).get("limits", {}))
@@ -68,6 +75,15 @@ def mutate_pod(pod: dict, cfg: Config) -> List[dict]:
                         {"op": "add", "path": f"/spec/containers/{i}/env",
                          "value": [entry]}
                     )
+                    env_created.add(i)
+            try:
+                low = int(str(prio).strip()) >= 1
+            except ValueError:
+                low = False
+            if low and req.nums > 0:
+                needs_podinfo.append(i)
+    if needs_podinfo:
+        patches.extend(_podinfo_patches(pod, needs_podinfo, env_created))
     if wants_tpu:
         current = pod.get("spec", {}).get("schedulerName", "")
         if current != cfg.scheduler_name:
@@ -75,6 +91,71 @@ def mutate_pod(pod: dict, cfg: Config) -> List[dict]:
                 {"op": "add", "path": "/spec/schedulerName",
                  "value": cfg.scheduler_name}
             )
+    return patches
+
+
+#: Injected volume/mount names — prefixed to avoid colliding with user
+#: volumes; a pod that already mounts one of these names is respected.
+PODINFO_VOLUME = "vtpu-podinfo"
+PODINFO_MOUNT_PATH = "/etc/vtpu-podinfo"
+
+
+def _podinfo_patches(pod: dict, container_idxs: List[int],
+                     env_created: set) -> List[dict]:
+    """Downward-API annotations volume + per-container mount + env, for
+    TPU containers that opted into preemptible priority.  ``env_created``:
+    containers whose /env array was CREATED by an earlier patch in this
+    same mutation — JSONPatch applies sequentially, so appending with
+    ``/env/-`` is correct there, while a second ``add /env`` would
+    REPLACE the earlier entry."""
+    from ..shim.preempt import PATH_ENV
+
+    patches: List[dict] = []
+    spec = pod.get("spec", {})
+    volumes = spec.get("volumes", [])
+    if not any(v.get("name") == PODINFO_VOLUME for v in volumes):
+        vol = {
+            "name": PODINFO_VOLUME,
+            "downwardAPI": {"items": [{
+                "path": "annotations",
+                "fieldRef": {"fieldPath": "metadata.annotations"},
+            }]},
+        }
+        if volumes:
+            patches.append({"op": "add", "path": "/spec/volumes/-",
+                            "value": vol})
+        else:
+            patches.append({"op": "add", "path": "/spec/volumes",
+                            "value": [vol]})
+    containers = spec.get("containers", [])
+    for i in container_idxs:
+        ctr = containers[i]
+        mounts = ctr.get("volumeMounts", [])
+        if not any(m.get("name") == PODINFO_VOLUME for m in mounts):
+            mount = {"name": PODINFO_VOLUME,
+                     "mountPath": PODINFO_MOUNT_PATH, "readOnly": True}
+            if mounts:
+                patches.append(
+                    {"op": "add",
+                     "path": f"/spec/containers/{i}/volumeMounts/-",
+                     "value": mount})
+            else:
+                patches.append(
+                    {"op": "add",
+                     "path": f"/spec/containers/{i}/volumeMounts",
+                     "value": [mount]})
+        env = ctr.get("env", [])
+        if not any(e.get("name") == PATH_ENV for e in env):
+            entry = {"name": PATH_ENV,
+                     "value": f"{PODINFO_MOUNT_PATH}/annotations"}
+            if env or i in env_created:
+                patches.append(
+                    {"op": "add", "path": f"/spec/containers/{i}/env/-",
+                     "value": entry})
+            else:
+                patches.append(
+                    {"op": "add", "path": f"/spec/containers/{i}/env",
+                     "value": [entry]})
     return patches
 
 

@@ -120,6 +120,56 @@ class TestWebhookHTTP:
         env_patches = [p for p in patches if "/env" in p["path"]]
         assert env_patches and env_patches[0]["value"][0]["name"] == "TPU_TASK_PRIORITY"
 
+    def test_low_priority_pod_gets_podinfo_injection(self, server):
+        """A preemptible (priority >= 1) TPU container gets the downward-
+        API annotations volume + mount + path env injected, and applying
+        the patch SEQUENCE yields a pod with BOTH injected env entries
+        (an 'add /env' after another 'add /env' would have replaced the
+        first — the ordering bug this pins against)."""
+        _, _, port = server
+        pod = tpu_pod()
+        pod["spec"]["containers"][0]["resources"]["limits"][
+            "vtpu.dev/task-priority"] = "1"
+        status, res = post(port, "/webhook", self.admission_review(pod))
+        patches = json.loads(base64.b64decode(res["response"]["patch"]))
+
+        def apply(doc, patches):  # minimal JSONPatch 'add' applier
+            import copy
+            doc = copy.deepcopy(doc)
+            for p in patches:
+                parts = [s.replace("~1", "/").replace("~0", "~")
+                         for s in p["path"].lstrip("/").split("/")]
+                tgt = doc
+                for part in parts[:-1]:
+                    tgt = tgt[int(part)] if isinstance(tgt, list) else tgt[part]
+                last = parts[-1]
+                if isinstance(tgt, list):
+                    tgt.append(p["value"]) if last == "-" else \
+                        tgt.insert(int(last), p["value"])
+                else:
+                    tgt[last] = p["value"]
+            return doc
+
+        mutated = apply(pod, patches)
+        ctr = mutated["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in ctr["env"]}
+        assert env["TPU_TASK_PRIORITY"] == "1"
+        assert env["VTPU_PODINFO_ANNOTATIONS"] == \
+            "/etc/vtpu-podinfo/annotations"
+        assert any(m["name"] == "vtpu-podinfo"
+                   for m in ctr["volumeMounts"])
+        vol, = [v for v in mutated["spec"]["volumes"]
+                if v["name"] == "vtpu-podinfo"]
+        assert vol["downwardAPI"]["items"][0]["fieldRef"][
+            "fieldPath"] == "metadata.annotations"
+
+    def test_high_priority_pod_gets_no_podinfo(self, server):
+        _, _, port = server
+        pod = tpu_pod()  # no priority limit -> priority 0, never preempted
+        status, res = post(port, "/webhook", self.admission_review(pod))
+        patches = json.loads(base64.b64decode(res["response"]["patch"]))
+        assert not any("podinfo" in json.dumps(p) for p in patches)
+
     def test_privileged_pod_untouched(self, server):
         _, _, port = server
         pod = tpu_pod()
