@@ -36,6 +36,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # None | "int8": weight-only quantization of the block projection
+    # matrices (serving path; models/quant.py — halves decode HBM
+    # traffic).  Params must be transformed with quantize_params.
+    quant: Optional[str] = None
     # "full" | "ring" | "ulysses" | "flash".  ring and ulysses shard the
     # sequence over the mesh's sp axis (ring: K/V rotation, no head-count
     # constraint; ulysses: all-to-all head scatter, needs heads % sp == 0
@@ -101,6 +105,17 @@ class RMSNorm(nn.Module):
 PAD_POSITION = 2 ** 30
 
 
+def _dense(cfg: "LlamaConfig", features: int, name: str):
+    """Block projection layer: nn.Dense, or QuantDense when the config
+    carries weight-only quantization (models/quant.py)."""
+    if cfg.quant == "int8":
+        from .quant import QuantDense
+
+        return QuantDense(features, dtype=cfg.dtype, name=name)
+    return nn.Dense(features, use_bias=False, dtype=jnp.dtype(cfg.dtype),
+                    name=name)
+
+
 def _cached_attention(q, k_all, v_all, q_pos, key_pos):
     """q: [B,T,H,D] against the UNREPEATED cache [B,L,KV,D] — GQA query
     groups attend their kv head via a grouped einsum (no head-repeated
@@ -131,9 +146,7 @@ class Attention(nn.Module):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, T, _ = x.shape
-        dense = lambda feats, name: nn.Dense(  # noqa: E731
-            feats, use_bias=False, dtype=dtype, name=name
-        )
+        dense = lambda feats, name: _dense(cfg, feats, name)  # noqa: E731
         q = dense(cfg.n_heads * cfg.head_dim, "q_proj")(x)
         k = dense(cfg.n_kv_heads * cfg.head_dim, "k_proj")(x)
         v = dense(cfg.n_kv_heads * cfg.head_dim, "v_proj")(x)
@@ -199,14 +212,10 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dtype = jnp.dtype(cfg.dtype)
-        gate = nn.Dense(cfg.ffn_hidden, use_bias=False, dtype=dtype,
-                        name="gate_proj")(x)
-        up = nn.Dense(cfg.ffn_hidden, use_bias=False, dtype=dtype,
-                      name="up_proj")(x)
+        gate = _dense(cfg, cfg.ffn_hidden, "gate_proj")(x)
+        up = _dense(cfg, cfg.ffn_hidden, "up_proj")(x)
         h = nn.silu(gate) * up
-        return nn.Dense(cfg.dim, use_bias=False, dtype=dtype,
-                        name="down_proj")(h)
+        return _dense(cfg, cfg.dim, "down_proj")(h)
 
 
 class Block(nn.Module):

@@ -1,0 +1,108 @@
+"""Weight-only int8 quantization for serving.
+
+Decode throughput on a TPU is HBM-bandwidth-bound: every generated token
+streams every weight matrix through the MXU once, so bytes-per-weight is
+the ceiling.  Per-output-channel symmetric int8 halves that traffic vs
+bf16 (4x vs f32) at ~0.4% RMS weight error; the dequantization multiply
+commutes with the matmul (``x @ (q·s) == (x @ q)·s`` for column scales),
+so the kernel streams INT8 from HBM and applies one [out]-vector scale
+to the product — XLA fuses the int8→bf16 convert into the matmul's
+operand load.
+
+Scope: the block projection matrices (q/k/v/o, gate/up/down) — the
+weights decode actually streams per token.  Embedding and the tied head
+stay full precision (standard practice: their quantization error lands
+directly on the logits).  Serving-only: gradients do not flow through
+``QuantDense``.
+
+Usage:
+
+    qcfg = dataclasses.replace(cfg, quant="int8")
+    qparams = quantize_params(params)
+    tokens = generate(qcfg, qparams, prompt, n)
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class QuantDense(nn.Module):
+    """Drop-in for ``nn.Dense(use_bias=False)`` over int8 weights +
+    per-output-channel f32 scales (params ``kernel_q`` and ``scale``,
+    produced by :func:`quantize_params`)."""
+
+    features: int
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = jnp.dtype(self.dtype)
+        q = self.param(
+            "kernel_q", nn.initializers.zeros_init(),
+            (x.shape[-1], self.features), jnp.int8)
+        scale = self.param(
+            "scale", nn.initializers.ones_init(),
+            (self.features,), jnp.float32)
+        y = jnp.matmul(x.astype(dtype), q.astype(dtype))
+        return (y * scale.astype(dtype)).astype(dtype)
+
+
+def _quantize_kernel(w):
+    """[in, out] float -> (int8 [in, out], f32 [out]) per-channel
+    symmetric: scale = amax/127, q = round(w/scale)."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=0)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _is_proj(key: str) -> bool:
+    return key.endswith("_proj")
+
+
+def quantize_params(params: dict) -> dict:
+    """Rewrite a full-precision Llama param tree into the layout
+    ``QuantDense`` consumes: every ``*_proj: {kernel}`` becomes
+    ``{kernel_q, scale}``.  Everything else (embed, norms, head, MoE
+    expert stacks) passes through untouched."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, child in node.items():
+            if (_is_proj(key) and isinstance(child, dict)
+                    and "kernel" in child and child["kernel"].ndim == 2):
+                q, scale = _quantize_kernel(child["kernel"])
+                out[key] = {"kernel_q": q, "scale": scale}
+            else:
+                out[key] = walk(child)
+        return out
+
+    return walk(params)
+
+
+def dequantize_params(qparams: dict) -> dict:
+    """Inverse layout transform (values carry the quantization error)."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for key, child in node.items():
+            if (_is_proj(key) and isinstance(child, dict)
+                    and "kernel_q" in child):
+                out[key] = {"kernel": (
+                    child["kernel_q"].astype(jnp.float32)
+                    * child["scale"][None, :])}
+            else:
+                out[key] = walk(child)
+        return out
+
+    return walk(qparams)
+
+
+def quantized_bytes(params: dict) -> int:
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,106 @@
+"""Weight-only int8 serving quantization (models/quant.py)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_vgpu_scheduler_tpu.models.generate import generate
+from k8s_vgpu_scheduler_tpu.models.llama import Llama, llama_tiny
+from k8s_vgpu_scheduler_tpu.models.quant import (
+    dequantize_params,
+    quantize_params,
+    quantized_bytes,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = dataclasses.replace(llama_tiny(), dtype="float32")
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab)
+    params = Llama(cfg).init(jax.random.PRNGKey(0), prompt)
+    return cfg, params, prompt
+
+
+class TestQuantizeParams:
+    def test_roundtrip_error_within_half_scale(self, setup):
+        _, params, _ = setup
+        q = quantize_params(params)
+        deq = dequantize_params(q)
+        w = params["params"]["layer_0"]["attn"]["q_proj"]["kernel"]
+        wq = deq["params"]["layer_0"]["attn"]["q_proj"]["kernel"]
+        scale = q["params"]["layer_0"]["attn"]["q_proj"]["scale"]
+        err = np.abs(np.asarray(w) - np.asarray(wq))
+        bound = np.asarray(scale)[None, :] * 0.5 + 1e-7
+        assert (err <= bound).all()
+
+    def test_only_projections_transformed(self, setup):
+        _, params, _ = setup
+        q = quantize_params(params)
+        p = q["params"]
+        assert "embedding" in p["embed"]           # untouched
+        assert "scale" in p["final_norm"]          # untouched (norm scale)
+        attn = p["layer_0"]["attn"]
+        for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            assert set(attn[proj]) == {"kernel_q", "scale"}
+            assert attn[proj]["kernel_q"].dtype == jnp.int8
+        mlp = p["layer_0"]["mlp"]
+        for proj in ("gate_proj", "up_proj", "down_proj"):
+            assert set(mlp[proj]) == {"kernel_q", "scale"}
+
+    def test_projection_bytes_quartered(self, setup):
+        # f32 kernels -> int8 + a tiny f32 scale vector: ~4x smaller.
+        _, params, _ = setup
+        full = sum(
+            x.nbytes
+            for p, x in jax.tree_util.tree_flatten_with_path(params)[0]
+            if "_proj" in jax.tree_util.keystr(p))
+        quant = sum(
+            x.nbytes
+            for p, x in jax.tree_util.tree_flatten_with_path(
+                quantize_params(params))[0]
+            if "_proj" in jax.tree_util.keystr(p))
+        assert quant < full / 3.5
+        assert quantized_bytes(quantize_params(params)) < \
+            quantized_bytes(params)
+
+
+class TestQuantServing:
+    def test_generate_runs_and_logits_track_full_precision(self, setup):
+        cfg, params, prompt = setup
+        qcfg = dataclasses.replace(cfg, quant="int8")
+        qparams = quantize_params(params)
+
+        full_logits = Llama(cfg).apply(
+            {"params": params["params"]}, prompt)
+        q_logits = Llama(qcfg).apply(
+            {"params": qparams["params"]}, prompt)
+        a = np.asarray(full_logits, np.float32).reshape(-1)
+        b = np.asarray(q_logits, np.float32).reshape(-1)
+        cos = float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+        assert cos > 0.999, f"quantized logits diverged (cos={cos:.4f})"
+
+    def test_generate_emits_valid_tokens(self, setup):
+        cfg, params, prompt = setup
+        qcfg = dataclasses.replace(cfg, quant="int8")
+        qparams = quantize_params(params)
+        toks = generate(qcfg, qparams, prompt, 6)
+        assert toks.shape == (1, prompt.shape[1] + 6)
+        arr = np.asarray(toks)
+        assert (arr >= 0).all() and (arr < cfg.vocab).all()
+
+    def test_quant_matches_dequantized_reference(self, setup):
+        """QuantDense must compute exactly what a plain Dense over the
+        DEQUANTIZED weights computes — the layout changes, the math
+        (x @ q)*s == x @ (q*s) does not."""
+        cfg, params, prompt = setup
+        qcfg = dataclasses.replace(cfg, quant="int8")
+        qparams = quantize_params(params)
+        deq = dequantize_params(qparams)
+        a = Llama(qcfg).apply({"params": qparams["params"]}, prompt)
+        b = Llama(cfg).apply({"params": deq["params"]}, prompt)
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=2e-4, rtol=2e-4)
