@@ -336,6 +336,29 @@ class TestPreemptionWatch:
         assert w.requested() is True
         assert w.requester() == "u-hp"
 
+    def test_kubelet_style_symlink_swap_detected(self, tmp_path):
+        """kubelet updates downward-API files by atomically swapping a
+        symlink to a new data directory — same mtime granule possible,
+        but a NEW inode.  The watch keys on (inode, mtime_ns, size), so
+        the swap is always seen."""
+        d1 = tmp_path / "..data_1"
+        d2 = tmp_path / "..data_2"
+        d1.mkdir(), d2.mkdir()
+        (d1 / "annotations").write_text('other="x"\n')
+        (d2 / "annotations").write_text(
+            'other="x"\nvtpu.dev/preempt-requested="u-hp"\n')
+        link = tmp_path / "annotations"
+        link.symlink_to(d1 / "annotations")
+        w = PreemptionWatch(str(link))
+        assert w.requested() is False
+        # Atomic swap, kubelet-style: build the new symlink aside, then
+        # rename over the old one.
+        tmp_link = tmp_path / ".tmp_link"
+        tmp_link.symlink_to(d2 / "annotations")
+        os.replace(tmp_link, link)
+        assert w.requested() is True
+        assert w.requester() == "u-hp"
+
     def test_env_var_path(self, tmp_path, monkeypatch):
         path = str(tmp_path / "ann")
         self._write(path, ['vtpu.dev/preempt-requested="x"'])
