@@ -91,6 +91,24 @@ class TestQuantServing:
         arr = np.asarray(toks)
         assert (arr >= 0).all() and (arr < cfg.vocab).all()
 
+    def test_moe_config_quantizes_attention_only(self):
+        """MoE blocks route the FFN through stacked expert tensors that
+        quantize_params leaves untouched; attention projections still
+        quantize and the forward stays finite."""
+        cfg = dataclasses.replace(llama_tiny(), dtype="float32",
+                                  n_experts=2, moe_capacity_factor=2.0)
+        prompt = jnp.ones((1, 8), jnp.int32)
+        params = Llama(cfg).init(jax.random.PRNGKey(0), prompt)
+        q = quantize_params({"params": params["params"]})
+        attn = q["params"]["layer_0"]["attn"]
+        assert set(attn["q_proj"]) == {"kernel_q", "scale"}
+        moe_leaves = jax.tree_util.tree_leaves(
+            q["params"]["layer_0"]["moe"])
+        assert all(x.dtype != jnp.int8 for x in moe_leaves)
+        qcfg = dataclasses.replace(cfg, quant="int8")
+        out = Llama(qcfg).apply({"params": q["params"]}, prompt)
+        assert bool(jnp.isfinite(out).all())
+
     def test_quant_matches_dequantized_reference(self, setup):
         """QuantDense must compute exactly what a plain Dense over the
         DEQUANTIZED weights computes — the layout changes, the math
