@@ -247,9 +247,34 @@ class Scheduler:
             self.on_pod_event("ADDED", pod)
         alive = {pod_uid(p) for p in pods}
         for info in self.pods.list_pods():
-            if info.uid not in alive and info.touched_at < list_started:
+            if info.uid in alive:
+                continue
+            if info.touched_at < list_started:
                 self.gangs.drop_member(info.uid, tombstone=False)
                 self.pods.del_pod(info.uid)
+            else:
+                # Ambiguous window: the grant was recorded AFTER this
+                # resync began but the pod is absent from the list.
+                # Usually that means the list snapshot simply predates the
+                # grant (keep it!) — but a pod that was granted AND
+                # deleted inside the list's round-trip is also absent,
+                # and its DELETE event may never replay (the stream
+                # bookmark is already past it).  Disambiguate with a
+                # point read; NotFound = really gone, prune now instead
+                # of leaking the grant until an external resync.
+                try:
+                    cur = self.client.get_pod(info.namespace, info.name)
+                    really_gone = pod_uid(cur) != info.uid
+                except NotFound:
+                    really_gone = True
+                except Exception:  # noqa: BLE001 — keep; next pass retries
+                    really_gone = False
+                if really_gone:
+                    log.info("resync: %s/%s vanished inside the list "
+                             "window; pruning its grant", info.namespace,
+                             info.name)
+                    self.gangs.drop_member(info.uid, tombstone=False)
+                    self.pods.del_pod(info.uid)
         self._reconcile_preemptions(pods)
         return rv
 
