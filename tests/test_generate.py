@@ -215,6 +215,16 @@ class TestSpeculative:
         want = generate(cfg, params, prompt, 8)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @pytest.mark.parametrize("k,n", [(1, 7), (2, 2), (5, 3), (3, 1)])
+    def test_edge_shapes_token_identical(self, spec_setup, k, n):
+        """k=1 (minimal draft), n <= k (the verify overshoots the output
+        budget), n=1 (prefill-only emit) — all must stay token-exact."""
+        cfg, draft_cfg, params, draft_params, prompt = spec_setup
+        want = generate(cfg, params, prompt, n)
+        got, _ = speculative_generate(
+            cfg, params, draft_cfg, draft_params, prompt, n, k=k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_batch_rejected(self, spec_setup):
         cfg, draft_cfg, params, draft_params, _ = spec_setup
         two = jnp.ones((2, 4), jnp.int32)
