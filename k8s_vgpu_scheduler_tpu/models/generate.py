@@ -36,12 +36,20 @@ def _sample(logits, temperature: float, rng):
 def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
              temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
-             prompt_lens: Optional[jax.Array] = None) -> jnp.ndarray:
+             prompt_lens: Optional[jax.Array] = None,
+             prefill_chunk: Optional[int] = None) -> jnp.ndarray:
     """prompt: [B, P] int32 -> [B, P + max_new_tokens] tokens.
 
     ``prompt_lens`` [B]: real length of each LEFT-padded row (defaults to
     P for all rows).  Jit-compatible end to end; wrap via
     :func:`jit_generate` for the compiled form.
+
+    ``prefill_chunk``: feed the prompt through the cache in chunks of
+    this size (must divide P; ignored otherwise) — peak prefill
+    activation memory drops from O(P) to O(chunk) per layer while later
+    chunks attend earlier ones THROUGH the cache, so long prompts fit
+    small fractional grants.  Token-exact vs the one-shot prefill
+    (pinned in tests).
     """
     B, P = prompt.shape
     total = P + max_new_tokens
@@ -72,10 +80,38 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
     # instead of duplicating the array per layer in its cache).
     key_pos = jnp.full((B, total), PAD_POSITION, jnp.int32)
     key_pos = key_pos.at[:, :P].set(positions)
-    logits, state = model.apply({"params": params["params"]}, prompt,
-                                positions, key_pos, mutable=["cache"])
-    cache = state["cache"]
-    first = _sample(logits[:, -1], temperature,
+    if (prefill_chunk and 0 < prefill_chunk < P
+            and P % prefill_chunk == 0):
+        n_ch = P // prefill_chunk
+        # First chunk creates the cache collection; the remaining n_ch-1
+        # chunks scan through it.  Each chunk's queries attend earlier
+        # chunks via the cache exactly as decode steps do.
+        logits, state = model.apply(
+            {"params": params["params"]},
+            prompt[:, :prefill_chunk], positions[:, :prefill_chunk],
+            key_pos, mutable=["cache"])
+        cache = state["cache"]
+
+        def pchunk(cache, inp):
+            toks_c, pos_c = inp
+            lg, st = model.apply(
+                {"params": params["params"], "cache": cache},
+                toks_c, pos_c, key_pos, mutable=["cache"])
+            return st["cache"], lg[:, -1]
+
+        rest_toks = prompt[:, prefill_chunk:].reshape(
+            B, n_ch - 1, prefill_chunk).transpose(1, 0, 2)
+        rest_pos = positions[:, prefill_chunk:].reshape(
+            B, n_ch - 1, prefill_chunk).transpose(1, 0, 2)
+        cache, last_logits = jax.lax.scan(
+            pchunk, cache, (rest_toks, rest_pos))
+        final = last_logits[-1] if n_ch > 1 else logits[:, -1]
+    else:
+        logits, state = model.apply({"params": params["params"]}, prompt,
+                                    positions, key_pos, mutable=["cache"])
+        cache = state["cache"]
+        final = logits[:, -1]
+    first = _sample(final, temperature,
                     None if rng is None else jax.random.fold_in(rng, 0))
 
     def step(carry, i):
@@ -101,14 +137,16 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
 
 
 def jit_generate(cfg: LlamaConfig, max_new_tokens: int,
-                 temperature: float = 0.0):
+                 temperature: float = 0.0,
+                 prefill_chunk: Optional[int] = None):
     """Compiled generate: fn(params, prompt[, rng, prompt_lens])."""
 
     @jax.jit
     def run(params, prompt, rng=None, prompt_lens=None):
         return generate(cfg, params, prompt, max_new_tokens,
                         temperature=temperature, rng=rng,
-                        prompt_lens=prompt_lens)
+                        prompt_lens=prompt_lens,
+                        prefill_chunk=prefill_chunk)
 
     return run
 

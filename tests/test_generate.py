@@ -231,3 +231,46 @@ class TestSpeculative:
         with pytest.raises(ValueError, match="one sequence"):
             speculative_generate(cfg, params, draft_cfg, draft_params,
                                  two, 4)
+
+
+class TestChunkedPrefill:
+    """prefill_chunk bounds prefill activation memory; the cache makes
+    later chunks attend earlier ones, so the result must be token-exact
+    vs the one-shot prefill."""
+
+    def test_chunked_matches_oneshot(self, setup):
+        cfg, model, params, _ = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(21), (2, 12),
+                                    0, cfg.vocab)
+        want = generate(cfg, params, prompt, 6)
+        for chunk in (2, 3, 4, 6):
+            got = generate(cfg, params, prompt, 6, prefill_chunk=chunk)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(want), err_msg=f"chunk={chunk}")
+
+    def test_chunked_with_ragged_prompts(self, setup):
+        cfg, model, params, _ = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(22), (2, 8),
+                                    0, cfg.vocab)
+        lens = jnp.array([5, 8], jnp.int32)
+        want = generate(cfg, params, prompt, 5, prompt_lens=lens)
+        got = generate(cfg, params, prompt, 5, prompt_lens=lens,
+                       prefill_chunk=4)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_non_dividing_chunk_falls_back(self, setup):
+        cfg, model, params, _ = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(23), (1, 7),
+                                    0, cfg.vocab)
+        want = generate(cfg, params, prompt, 4)
+        got = generate(cfg, params, prompt, 4, prefill_chunk=3)  # 7 % 3 != 0
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_jit_wrapper_with_chunk(self, setup):
+        cfg, model, params, _ = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(24), (1, 8),
+                                    0, cfg.vocab)
+        run = jit_generate(cfg, 4, prefill_chunk=4)
+        want = generate(cfg, params, prompt, 4)
+        np.testing.assert_array_equal(
+            np.asarray(run(params, prompt)), np.asarray(want))
