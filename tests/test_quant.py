@@ -109,6 +109,23 @@ class TestQuantServing:
         out = Llama(qcfg).apply({"params": q["params"]}, prompt)
         assert bool(jnp.isfinite(out).all())
 
+    def test_composes_with_speculative_decoding(self, setup):
+        """int8 target + full-precision draft: speculative output must be
+        token-identical to the int8 target's own plain greedy decode (the
+        draft never changes content, quantized or not)."""
+        from k8s_vgpu_scheduler_tpu.models.generate import (
+            speculative_generate)
+        cfg, params, prompt = setup
+        qcfg = dataclasses.replace(cfg, quant="int8")
+        qparams = quantize_params(params)
+        draft_cfg = dataclasses.replace(
+            cfg, dim=32, n_layers=1, n_heads=2, n_kv_heads=2, ffn_hidden=64)
+        draft_params = Llama(draft_cfg).init(jax.random.PRNGKey(9), prompt)
+        want = generate(qcfg, qparams, prompt, 8)
+        got, _ = speculative_generate(
+            qcfg, qparams, draft_cfg, draft_params, prompt, 8, k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     def test_quant_matches_dequantized_reference(self, setup):
         """QuantDense must compute exactly what a plain Dense over the
         DEQUANTIZED weights computes — the layout changes, the math
