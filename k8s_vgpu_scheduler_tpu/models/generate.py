@@ -25,19 +25,38 @@ import jax.numpy as jnp
 from .llama import Llama, LlamaConfig, PAD_POSITION
 
 
-def _sample(logits, temperature: float, rng):
+def _sample(logits, temperature: float, rng,
+            top_k: int = 0, top_p: float = 0.0):
+    """Greedy (temperature 0), else temperature sampling with optional
+    top-k and/or nucleus (top-p) truncation — both applied as -inf masks
+    before the categorical draw, jit-compatible (static k)."""
     if temperature == 0.0 or rng is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        rng, logits.astype(jnp.float32) / temperature, axis=-1
-    ).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        # Nucleus: keep the smallest prefix of descending-prob tokens
+        # whose mass reaches p (always at least the top token).
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Token i (sorted) stays iff the mass BEFORE it is < p.
+        keep = (cum - probs) < top_p
+        cutoff = jnp.max(
+            jnp.where(keep, sorted_logits, -jnp.inf), axis=-1,
+            keepdims=True)  # smallest kept logit
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
              temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
              prompt_lens: Optional[jax.Array] = None,
-             prefill_chunk: Optional[int] = None) -> jnp.ndarray:
+             prefill_chunk: Optional[int] = None,
+             top_k: int = 0, top_p: float = 0.0) -> jnp.ndarray:
     """prompt: [B, P] int32 -> [B, P + max_new_tokens] tokens.
 
     ``prompt_lens`` [B]: real length of each LEFT-padded row (defaults to
@@ -112,7 +131,8 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
         cache = state["cache"]
         final = logits[:, -1]
     first = _sample(final, temperature,
-                    None if rng is None else jax.random.fold_in(rng, 0))
+                    None if rng is None else jax.random.fold_in(rng, 0),
+                    top_k=top_k, top_p=top_p)
 
     def step(carry, i):
         cache, key_pos, tok = carry
@@ -123,7 +143,8 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
             {"params": params["params"], "cache": cache},
             tok[:, None], pos, key_pos, mutable=["cache"])
         key = None if rng is None else jax.random.fold_in(rng, i + 1)
-        nxt = _sample(logits[:, -1], temperature, key)
+        nxt = _sample(logits[:, -1], temperature, key,
+                      top_k=top_k, top_p=top_p)
         return (st["cache"], key_pos, nxt), nxt
 
     # n-1 steps: the prefill already produced token 1, each step emits
@@ -138,7 +159,8 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
 
 def jit_generate(cfg: LlamaConfig, max_new_tokens: int,
                  temperature: float = 0.0,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 top_k: int = 0, top_p: float = 0.0):
     """Compiled generate: fn(params, prompt[, rng, prompt_lens])."""
 
     @jax.jit
@@ -146,7 +168,8 @@ def jit_generate(cfg: LlamaConfig, max_new_tokens: int,
         return generate(cfg, params, prompt, max_new_tokens,
                         temperature=temperature, rng=rng,
                         prompt_lens=prompt_lens,
-                        prefill_chunk=prefill_chunk)
+                        prefill_chunk=prefill_chunk,
+                        top_k=top_k, top_p=top_p)
 
     return run
 

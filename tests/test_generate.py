@@ -274,3 +274,50 @@ class TestChunkedPrefill:
         want = generate(cfg, params, prompt, 4)
         np.testing.assert_array_equal(
             np.asarray(run(params, prompt)), np.asarray(want))
+
+
+class TestTruncatedSampling:
+    def test_top_k_one_equals_greedy(self, setup):
+        """top_k=1 collapses temperature sampling to argmax regardless of
+        temperature or key."""
+        cfg, model, params, prompt = setup
+        want = generate(cfg, params, prompt, 6)
+        got = generate(cfg, params, prompt, 6, temperature=1.5,
+                       rng=jax.random.PRNGKey(3), top_k=1)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_top_p_tiny_equals_greedy(self, setup):
+        """A nucleus smaller than the top token's own probability keeps
+        exactly the top token."""
+        cfg, model, params, prompt = setup
+        want = generate(cfg, params, prompt, 6)
+        got = generate(cfg, params, prompt, 6, temperature=1.0,
+                       rng=jax.random.PRNGKey(4), top_p=1e-6)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_top_k_samples_only_topk_tokens(self):
+        """Direct unit check on _sample: with top_k=3 every draw over many
+        keys lands in the 3 highest-logit ids."""
+        from k8s_vgpu_scheduler_tpu.models.generate import _sample
+        logits = jnp.array([[0.0, 5.0, 1.0, 4.0, 3.0, -2.0]])
+        allowed = {1, 3, 4}
+        for i in range(50):
+            tok = int(_sample(logits, 1.0, jax.random.PRNGKey(i), top_k=3)[0])
+            assert tok in allowed, tok
+
+    def test_top_p_respects_nucleus(self):
+        from k8s_vgpu_scheduler_tpu.models.generate import _sample
+        # probs ~ [0.72, 0.26, 0.01, ...]: p=0.9 keeps ids {0, 1} only.
+        logits = jnp.log(jnp.array([[0.72, 0.26, 0.01, 0.005, 0.005]]))
+        for i in range(50):
+            tok = int(_sample(logits, 1.0, jax.random.PRNGKey(i),
+                              top_p=0.9)[0])
+            assert tok in {0, 1}, tok
+
+    def test_jit_wrapper_with_truncation(self, setup):
+        cfg, model, params, prompt = setup
+        run = jit_generate(cfg, 5, temperature=0.9, top_k=4, top_p=0.95)
+        toks = run(params, prompt, jax.random.PRNGKey(5))
+        arr = np.asarray(toks)
+        assert arr.shape == (2, prompt.shape[1] + 5)
+        assert (arr >= 0).all() and (arr < cfg.vocab).all()
