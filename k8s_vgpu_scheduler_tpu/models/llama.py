@@ -46,6 +46,9 @@ class LlamaConfig:
     # — see parallel/ulysses.py for the trade-off); flash is the Pallas
     # kernel single-device path (ulysses uses it locally too).
     attention: str = "full"
+    # >0 with attention="flash": causal sliding window (Mistral-style);
+    # FLOPs scale O(T·window) — the kernels skip out-of-band blocks.
+    attention_window: int = 0
     # >0 switches the FFN to a top-k-routed MoE (top_k=1 Switch-style,
     # top_k=2 Mixtral-style); stacked expert tensors shard over the
     # mesh's ep axis.
@@ -199,7 +202,8 @@ class Attention(nn.Module):
                     self.mesh.shape.get("sp", 1) > 1:
                 out = ulysses_attention(q, k, v, self.mesh, causal=True)
             elif cfg.attention == "flash":
-                out = flash_attention(q, k, v, causal=True)
+                out = flash_attention(q, k, v, causal=True,
+                                      window=cfg.attention_window)
             else:
                 out = full_attention_reference(q, k, v, causal=True)
         out = out.reshape(B, T, cfg.n_heads * cfg.head_dim)

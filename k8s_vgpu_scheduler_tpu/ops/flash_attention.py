@@ -38,8 +38,24 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _apply_mask(s, q0, k0, shape, causal: bool, window: int):
+    """Causal and/or sliding-window mask for a (bq, bk) score tile whose
+    rows start at absolute position q0 and columns at k0."""
+    if not causal and window <= 0:
+        return s
+    q_pos = q0 + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    keep = None
+    if causal:
+        keep = q_pos >= k_pos
+    if window > 0:
+        near = q_pos - k_pos < window
+        keep = near if keep is None else (keep & near)
+    return jnp.where(keep, s, NEG_INF)
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float,
-            causal: bool, block_k: int, seq_len: int):
+            causal: bool, block_k: int, seq_len: int, window: int = 0):
     bq = q_ref.shape[0]
     d = q_ref.shape[1]
     qi = pl.program_id(1)
@@ -54,14 +70,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (bq, bk)
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _apply_mask(s, qi * bq, j * block_k, (bq, block_k),
+                        causal, window)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         scale = jnp.exp(m - m_new)
@@ -84,7 +94,13 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float,
         num_kb_eff = jnp.minimum(num_kb, (qi + 1) * bq // block_k)
     else:
         num_kb_eff = num_kb
-    m, l, acc = jax.lax.fori_loop(0, num_kb_eff, body, (m0, l0, acc0))
+    if window > 0:
+        # Blocks entirely left of every row's window contribute nothing:
+        # the newest key this q-block can see starts at qi*bq-window+1.
+        jb0 = jnp.maximum(0, (qi * bq - window + 1) // block_k)
+    else:
+        jb0 = 0
+    m, l, acc = jax.lax.fori_loop(jb0, num_kb_eff, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-20)
     o_ref[...] = (acc / l).astype(o_ref.dtype)
     if maybe_lse_ref:
@@ -97,7 +113,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float,
 
 def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
                     block_q: int, block_k: int, interpret: bool,
-                    return_lse: bool = False):
+                    window: int = 0, return_lse: bool = False):
     """q/k/v: (B, T, H, d) — kernel runs per (B·H) with (T, d) refs."""
     B, T, H, d = q.shape
     qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, d)
@@ -113,7 +129,7 @@ def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
     res = pl.pallas_call(
         functools.partial(
             _kernel, sm_scale=sm_scale, causal=causal,
-            block_k=block_k, seq_len=T,
+            block_k=block_k, seq_len=T, window=window,
         ),
         grid=grid,
         in_specs=[
@@ -129,14 +145,20 @@ def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
     return (out, res[1]) if return_lse else out
 
 
-def _reference(q, k, v, sm_scale: float, causal: bool):
+def _reference(q, k, v, sm_scale: float, causal: bool, window: int = 0):
     """Plain-XLA attention: the non-tileable-shape fallback (and the
     numerics oracle the kernel tests pin against)."""
     B, T, H, d = q.shape
     s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * sm_scale
+    mask = None
     if causal:
         mask = jnp.tril(jnp.ones((T, T), bool))
+    if window > 0:
+        pos = jnp.arange(T)
+        near = (pos[:, None] - pos[None, :]) < window
+        mask = near if mask is None else (mask & near)
+    if mask is not None:
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhts,bshd->bthd", p,
@@ -144,7 +166,8 @@ def _reference(q, k, v, sm_scale: float, causal: bool):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               sm_scale: float, causal: bool, block_k: int, seq_len: int):
+               sm_scale: float, causal: bool, block_k: int, seq_len: int,
+               window: int = 0):
     """dQ_i = scale · Σ_j dS_ij K_j with dS = P ⊙ (dO Vᵀ − Δ); parallel
     over query blocks, streaming K/V blocks (FlashAttention-2 eq. 4)."""
     bq, d = q_ref.shape
@@ -160,12 +183,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         s = jax.lax.dot_general(
             qs, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _apply_mask(s, qi * bq, j * block_k, (bq, block_k),
+                        causal, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -180,14 +199,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         num_kb_eff = jnp.minimum(num_kb, (qi + 1) * bq // block_k)
     else:
         num_kb_eff = num_kb
+    jb0 = (jnp.maximum(0, (qi * bq - window + 1) // block_k)
+           if window > 0 else 0)
     acc = jax.lax.fori_loop(
-        0, num_kb_eff, body, jnp.zeros((bq, d), jnp.float32))
+        jb0, num_kb_eff, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[...] = (acc * sm_scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, sm_scale: float, causal: bool,
-                block_q: int, seq_len: int):
+                block_q: int, seq_len: int, window: int = 0):
     """dK_j = Σ_i dS_ijᵀ (scale·Q_i), dV_j = Σ_i P_ijᵀ dO_i; parallel over
     key blocks, streaming Q/dO blocks.  Using the pre-scaled Q in the dK
     product folds the softmax scale in exactly once."""
@@ -206,12 +227,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             qs, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
-            k_pos = kj * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _apply_mask(s, i * block_q, kj * bk, (block_q, bk),
+                        causal, window)
         p = jnp.exp(s - lse)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -229,15 +246,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # Blocks strictly above the diagonal contribute nothing to this key
     # block; start the walk at the first query block that can attend here.
     i0 = (kj * bk) // block_q if causal else 0
+    if window > 0:
+        # Queries at position >= k_pos_max + window see none of this key
+        # block either.
+        i_end = jnp.minimum(
+            num_qb, (kj * bk + bk - 1 + window - 1) // block_q + 1)
+    else:
+        i_end = num_qb
     dk, dv = jax.lax.fori_loop(
-        i0, num_qb, body,
+        i0, i_end, body,
         (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32)))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal, block_q, block_k,
-                    interpret):
+                    interpret, window: int = 0):
     B, T, H, d = q.shape
 
     def fold(x):
@@ -265,7 +289,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal, block_q, block_k,
     dq_specs[5] = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_k=block_k, seq_len=T),
+                          block_k=block_k, seq_len=T, window=window),
         grid=(B * H, T // block_q),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0)),
@@ -278,7 +302,7 @@ def _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal, block_q, block_k,
     dkv_specs[2] = pl.BlockSpec((None, block_k, d), lambda b, j: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, seq_len=T),
+                          block_q=block_q, seq_len=T, window=window),
         grid=(B * H, T // block_k),
         in_specs=dkv_specs,
         out_specs=[
@@ -298,22 +322,24 @@ def _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal, block_q, block_k,
     return unfold(dq), unfold(dk), unfold(dv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret, window):
     return _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k,
-                           interpret)
+                           interpret, window=window)
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+               window):
     out, lse = _flash_fwd_impl(q, k, v, sm_scale, causal, block_q, block_k,
-                               interpret, return_lse=True)
+                               interpret, window=window, return_lse=True)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, window,
+               res, g):
     q, k, v, o, lse = res
     return _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret, window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -322,14 +348,22 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = 256, block_k: int = 256,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    window: int = 0):
     """Fused attention over (B, T, H, d) tensors.
+
+    ``window > 0`` enables causal sliding-window attention (Mistral
+    style): query p attends keys in [p-window+1, p].  Both passes skip
+    key/query blocks entirely outside the band, so FLOPs scale with
+    O(T·window) instead of O(T²/2).
 
     Falls back to the plain-XLA reference when the shape can't tile (T not
     divisible by the blocks, or tiny head_dim) — callers never have to
     special-case shapes.
     """
     B, T, H, d = q.shape
+    if window > 0 and not causal:
+        raise ValueError("sliding window requires causal attention")
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
     if interpret is None:
@@ -337,5 +371,6 @@ def flash_attention(q, k, v, *, causal: bool = True,
     block_q = min(block_q, T)
     block_k = min(block_k, T)
     if T % block_q or T % block_k or block_q % block_k:
-        return _reference(q, k, v, sm_scale, causal)
-    return _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+        return _reference(q, k, v, sm_scale, causal, window)
+    return _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret,
+                  window)

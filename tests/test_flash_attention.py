@@ -145,3 +145,58 @@ class TestModelIntegration:
             np.asarray(out_flash, np.float32),
             atol=3e-2, rtol=3e-2,
         )
+
+
+class TestSlidingWindow:
+    """Causal sliding-window attention (window w: query p attends
+    [p-w+1, p]) — forward and both backward kernels skip out-of-band
+    blocks, pinned against the masked plain reference."""
+
+    @pytest.mark.parametrize("window", [1, 16, 48, 128])
+    def test_forward_matches_reference(self, window):
+        from k8s_vgpu_scheduler_tpu.ops.flash_attention import _reference
+        q, k, v = qkv(T=128)
+        got = flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                              window=window)
+        want = _reference(q, k, v, 1.0 / (q.shape[-1] ** 0.5), True, window)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    def test_window_changes_output(self):
+        q, k, v = qkv(T=128)
+        full = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        windowed = flash_attention(q, k, v, causal=True, block_q=32,
+                                   block_k=32, window=16)
+        assert np.abs(np.asarray(full) - np.asarray(windowed)).max() > 1e-3
+
+    @pytest.mark.parametrize("window", [16, 48])
+    def test_grads_match_reference(self, window):
+        from k8s_vgpu_scheduler_tpu.ops.flash_attention import _reference
+        q, k, v = qkv(T=64)
+        w = jax.random.normal(jax.random.PRNGKey(8), q.shape, jnp.float32)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=32,
+                                block_k=32, window=window)
+            return (o.astype(jnp.float32) * w).sum()
+
+        def loss_ref(q, k, v):
+            o = _reference(q, k, v, 1.0 / (q.shape[-1] ** 0.5), True,
+                           window)
+            return (o.astype(jnp.float32) * w).sum()
+
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+    def test_window_without_causal_rejected(self):
+        q, k, v = qkv(T=64)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8)
+
+    def test_fallback_path_honors_window(self):
+        from k8s_vgpu_scheduler_tpu.ops.flash_attention import _reference
+        q, k, v = qkv(T=100)  # untileable -> reference path
+        got = flash_attention(q, k, v, causal=True, window=20)
+        want = _reference(q, k, v, 1.0 / (q.shape[-1] ** 0.5), True, 20)
+        np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
