@@ -105,7 +105,7 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
         # First chunk creates the cache collection; the remaining n_ch-1
         # chunks scan through it.  Each chunk's queries attend earlier
         # chunks via the cache exactly as decode steps do.
-        logits, state = model.apply(
+        _, state = model.apply(
             {"params": params["params"]},
             prompt[:, :prefill_chunk], positions[:, :prefill_chunk],
             key_pos, mutable=["cache"])
@@ -124,7 +124,7 @@ def generate(cfg: LlamaConfig, params, prompt, max_new_tokens: int,
             B, n_ch - 1, prefill_chunk).transpose(1, 0, 2)
         cache, last_logits = jax.lax.scan(
             pchunk, cache, (rest_toks, rest_pos))
-        final = last_logits[-1] if n_ch > 1 else logits[:, -1]
+        final = last_logits[-1]  # chunk < P guarantees n_ch >= 2
     else:
         logits, state = model.apply({"params": params["params"]}, prompt,
                                     positions, key_pos, mutable=["cache"])

@@ -119,13 +119,15 @@ def _dense(cfg: "LlamaConfig", features: int, name: str):
                     name=name)
 
 
-def _cached_attention(q, k_all, v_all, q_pos, key_pos):
+def _cached_attention(q, k_all, v_all, q_pos, key_pos, window: int = 0):
     """q: [B,T,H,D] against the UNREPEATED cache [B,L,KV,D] — GQA query
     groups attend their kv head via a grouped einsum (no head-repeated
     cache copy per decode step).  ``key_pos`` [B,L] holds each cache
     slot's LOGICAL position (PAD_POSITION when invalid); key slot l
     attends iff key_pos[l] <= the query's logical position, which covers
-    causality, unwritten slots and left-padding in one comparison."""
+    causality, unwritten slots and left-padding in one comparison.
+    ``window > 0`` additionally bounds the lookback (sliding-window
+    models must serve with the same mask they trained with)."""
     B, T, H, D = q.shape
     KV = k_all.shape[2]
     qg = q.reshape(B, T, KV, H // KV, D)
@@ -133,6 +135,8 @@ def _cached_attention(q, k_all, v_all, q_pos, key_pos):
     logits = jnp.einsum("btkrd,blkd->bkrtl", qg, k_all).astype(jnp.float32)
     logits = logits * scale
     mask = key_pos[:, None, :] <= q_pos[:, :, None]          # [B,T,L]
+    if window > 0:
+        mask = mask & (q_pos[:, :, None] - key_pos[:, None, :] < window)
     logits = jnp.where(mask[:, None, None], logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkrtl,blkd->btkrd", probs.astype(v_all.dtype), v_all)
@@ -188,7 +192,8 @@ class Attention(nn.Module):
                 cv.value, v.astype(dtype), (0, cur, 0, 0))
             idx.value = cur + T
             out = _cached_attention(q, ck.value, cv.value, positions,
-                                    key_positions)
+                                    key_positions,
+                                    window=cfg.attention_window)
             out = out.astype(dtype)
         else:
             # GQA: repeat kv heads up to the query head count.

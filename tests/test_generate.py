@@ -321,3 +321,23 @@ class TestTruncatedSampling:
         arr = np.asarray(toks)
         assert arr.shape == (2, prompt.shape[1] + 5)
         assert (arr >= 0).all() and (arr < cfg.vocab).all()
+
+
+class TestWindowedServing:
+    def test_windowed_model_serves_with_its_training_mask(self, setup):
+        """A sliding-window model (attention_window) must decode with the
+        SAME bounded lookback it trained with: generate() through the
+        cache must emit exactly the tokens of the no-cache oracle on the
+        windowed model — and differ from the full-attention decode."""
+        cfg, _, params, _ = setup
+        wcfg = dataclasses.replace(cfg, attention="flash",
+                                   attention_window=4)
+        wmodel = Llama(wcfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(31), (1, 6),
+                                    0, cfg.vocab)
+        n = 8
+        want = oracle_greedy(wmodel, params, prompt, n)
+        got = generate(wcfg, params, prompt, n)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        full = generate(cfg, params, prompt, n)
+        assert not np.array_equal(np.asarray(got), np.asarray(full))
