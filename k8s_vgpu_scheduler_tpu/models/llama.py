@@ -149,7 +149,7 @@ class Attention(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, key_positions=None):
+    def __call__(self, x, positions, key_positions=None, write_index=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, T, _ = x.shape
@@ -185,12 +185,25 @@ class Attention(nn.Module):
                 (B, L, cfg.n_kv_heads, cfg.head_dim), dtype)
             idx = self.variable(
                 "cache", "idx", lambda: jnp.zeros((), jnp.int32))
-            cur = idx.value
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(dtype), (0, cur, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(dtype), (0, cur, 0, 0))
-            idx.value = cur + T
+            if write_index is not None:
+                # Per-ROW write positions (continuous batching: every slot
+                # in the pool sits at its own sequence length, so a shared
+                # scalar index cannot place this step's keys).  Row b's T
+                # entries land at write_index[b] .. write_index[b]+T-1; the
+                # shared auto-increment is left untouched — the serving
+                # engine owns per-slot lengths (models/serve.py).
+                rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+                cols = (write_index.astype(jnp.int32)[:, None]
+                        + jnp.arange(T, dtype=jnp.int32)[None, :])
+                ck.value = ck.value.at[rows, cols].set(k.astype(dtype))
+                cv.value = cv.value.at[rows, cols].set(v.astype(dtype))
+            else:
+                cur = idx.value
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(dtype), (0, cur, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(dtype), (0, cur, 0, 0))
+                idx.value = cur + T
             out = _cached_attention(q, ck.value, cv.value, positions,
                                     key_positions,
                                     window=cfg.attention_window)
@@ -233,10 +246,10 @@ class Block(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, key_positions=None):
+    def __call__(self, x, positions, key_positions=None, write_index=None):
         x = x + Attention(self.cfg, self.mesh, self.decode, name="attn")(
             RMSNorm(self.cfg.norm_eps, name="attn_norm")(x), positions,
-            key_positions
+            key_positions, write_index
         )
         x = self._seq_shard(x)
         h = RMSNorm(self.cfg.norm_eps, name="mlp_norm")(x)
@@ -269,7 +282,8 @@ class Llama(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, tokens, positions=None, key_positions=None):
+    def __call__(self, tokens, positions=None, key_positions=None,
+                 write_index=None):
         cfg = self.cfg
         dtype = jnp.dtype(cfg.dtype)
         B, T = tokens.shape
@@ -279,7 +293,8 @@ class Llama(nn.Module):
         x = nn.Embed(cfg.vocab, cfg.dim, dtype=dtype, name="embed")(tokens)
         for i in range(cfg.n_layers):
             x = Block(cfg, self.mesh, self.decode,
-                      name=f"layer_{i}")(x, positions, key_positions)
+                      name=f"layer_{i}")(x, positions, key_positions,
+                                         write_index)
         x = RMSNorm(cfg.norm_eps, name="final_norm")(x)
         logits = nn.Dense(cfg.vocab, use_bias=False, dtype=dtype,
                           name="lm_head")(x)

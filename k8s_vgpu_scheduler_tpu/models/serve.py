@@ -1,0 +1,324 @@
+"""Continuous batching: a slot-pool serving engine for the flagship decoder.
+
+The reference stack shares one accelerator between many *pods*; this module
+shares one model instance between many *requests* — the serving-side analog
+(the reference has no serving engine at all; this is beyond-parity depth on
+the same thesis: more tenants per grant).
+
+TPU-first design: GPU engines (vLLM) page the KV cache because CUDA allows
+dynamic allocation; under XLA every shape is static, so the idiomatic form
+is a FIXED SLOT POOL — ``max_slots`` sequences × ``max_len`` cache rows
+allocated once, requests admitted into free slots and retired out of them
+with **zero recompilation**:
+
+- one ``decode_step`` jit, shape ``[S]``, runs every step regardless of
+  which slots are live (inactive rows compute garbage that the key-position
+  sentinel keeps unattendable — lock-step SPMD beats ragged dispatch on
+  the MXU);
+- prefill compiles once per power-of-two LENGTH BUCKET, writes the prompt's
+  keys/values straight into the pool rows of one slot (per-row
+  ``write_index`` threading in models/llama.py), so admission never
+  disturbs in-flight neighbours — continuous batching, not batch-restart;
+- the pool's HBM footprint is a closed-form constant (``pool_hbm_bytes``),
+  exactly what a vtpu pod should request as its ``tpumem`` grant.
+
+Greedy outputs are TOKEN-IDENTICAL to :func:`models.generate.generate` per
+request, regardless of arrival order or slot contention (pinned in
+tests/test_serve.py, including slot-reuse-after-EOS staleness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import _sample
+from .llama import Llama, LlamaConfig, PAD_POSITION
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    produced: int
+    tokens: List[int]
+
+
+@dataclasses.dataclass
+class Completion:
+    request_id: int
+    prompt: List[int]
+    tokens: List[int]          # generated tokens (including eos if hit)
+    finished_by: str           # "eos" | "length"
+
+
+class ServingEngine:
+    """Slot-pool continuous-batching engine (single device or tp-sharded
+    params — the pool arrays follow the params' sharding rules).
+
+    Parameters
+    ----------
+    cfg, params : model config / trained params (quant/int8 and
+        sliding-window configs compose — the engine only drives decode).
+    max_slots : concurrent sequences (the pool batch dimension).
+    max_len : cache rows per slot; a request needs
+        ``len(prompt) + max_new_tokens <= max_len``.
+    eos_id : optional stop token.
+    temperature : 0 = greedy (token-exact vs generate()); > 0 samples with
+        the engine rng, folded per decode step.
+    """
+
+    def __init__(self, cfg: LlamaConfig, params, *, max_slots: int,
+                 max_len: int, eos_id: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0,
+                 rng: Optional[jax.Array] = None):
+        if temperature > 0.0 and rng is None:
+            raise ValueError("temperature sampling requires an rng key")
+        if max_slots < 1 or max_len < 1:
+            raise ValueError("max_slots and max_len must be >= 1")
+        self.cfg = dataclasses.replace(
+            cfg, decode_cache_len=max_len, attention="full")
+        self.model = Llama(self.cfg, decode=True)
+        self.params = params
+        self.S = int(max_slots)
+        self.L = int(max_len)
+        self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if rng is not None:
+            self._prefill_rng, self._decode_rng = jax.random.split(rng)
+        else:
+            self._prefill_rng = self._decode_rng = None
+        dtype = jnp.dtype(cfg.dtype)
+        kv, hd = cfg.n_kv_heads, cfg.head_dim
+        # The pool: one flax cache collection covering every slot.  Built
+        # directly (layer_i/attn naming per models/llama.py) — running an
+        # init forward just to learn the tree would compile a throwaway
+        # program.
+        self.cache = {
+            f"layer_{i}": {"attn": {
+                "k": jnp.zeros((self.S, self.L, kv, hd), dtype),
+                "v": jnp.zeros((self.S, self.L, kv, hd), dtype),
+                "idx": jnp.zeros((), jnp.int32),
+            }}
+            for i in range(cfg.n_layers)
+        }
+        self.key_pos = jnp.full((self.S, self.L), PAD_POSITION, jnp.int32)
+        # Small per-slot state lives host-side (numpy): admission control
+        # is host logic anyway, and [S] transfers are noise next to the
+        # decode step itself.
+        self.lengths = np.zeros(self.S, np.int32)   # rows written per slot
+        self.cur = np.zeros(self.S, np.int32)       # sampled, not yet cached
+        self.active = np.zeros(self.S, bool)
+        self.slots: Dict[int, _Slot] = {}
+        self.queue: List[dict] = []
+        self._next_id = 0
+        self._step_count = 0
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fn = None
+        self.stats = {"prefills": 0, "decode_steps": 0, "tokens_out": 0,
+                      "completions": 0}
+
+    # -- capacity ---------------------------------------------------------
+
+    def pool_hbm_bytes(self) -> int:
+        """Closed-form pool footprint — size the pod's tpumem grant on
+        this plus the params (the decode working set is O(1))."""
+        itemsize = jnp.dtype(self.cfg.dtype).itemsize
+        per_layer = 2 * self.S * self.L * self.cfg.n_kv_heads \
+            * self.cfg.head_dim * itemsize
+        return per_layer * self.cfg.n_layers
+
+    # -- request intake ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.L:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
+                f"max_len {self.L}")
+        rid = self._next_id
+        self._next_id += 1
+        self.queue.append({"id": rid, "prompt": prompt,
+                           "max_new_tokens": int(max_new_tokens)})
+        return rid
+
+    # -- compiled paths ---------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return min(b, self.L)
+
+    def _prefill_fn(self, P: int):
+        fn = self._prefill_fns.get(P)
+        if fn is not None:
+            return fn
+        model, temperature = self.model, self.temperature
+        top_k, top_p = self.top_k, self.top_p
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(params, cache, key_pos, prompt, plen, slot, rng):
+            # One slot's rows, viewed as a B=1 cache the model writes at
+            # write_index 0 (rows 0..P-1; pads included — their sentinel
+            # key positions keep them masked until decode overwrites them).
+            sub = {
+                lname: {"attn": {
+                    "k": jax.lax.dynamic_slice_in_dim(lv["attn"]["k"],
+                                                      slot, 1, 0),
+                    "v": jax.lax.dynamic_slice_in_dim(lv["attn"]["v"],
+                                                      slot, 1, 0),
+                    "idx": lv["attn"]["idx"],
+                }}
+                for lname, lv in cache.items()
+            }
+            ar = jnp.arange(P, dtype=jnp.int32)
+            positions = jnp.minimum(ar, plen - 1)[None]
+            row = jnp.full((self.L,), PAD_POSITION, jnp.int32)
+            row = row.at[:P].set(jnp.where(ar < plen, ar, PAD_POSITION))
+            logits, st = model.apply(
+                {"params": params["params"], "cache": sub},
+                prompt, positions, row[None],
+                jnp.zeros((1,), jnp.int32), mutable=["cache"])
+            new_cache = {
+                lname: {"attn": {
+                    "k": jax.lax.dynamic_update_slice_in_dim(
+                        lv["attn"]["k"],
+                        st["cache"][lname]["attn"]["k"], slot, 0),
+                    "v": jax.lax.dynamic_update_slice_in_dim(
+                        lv["attn"]["v"],
+                        st["cache"][lname]["attn"]["v"], slot, 0),
+                    "idx": lv["attn"]["idx"],
+                }}
+                for lname, lv in cache.items()
+            }
+            key_pos = jax.lax.dynamic_update_slice(
+                key_pos, row[None], (slot, 0))
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], plen - 1, 0, keepdims=False)
+            tok = _sample(last, temperature,
+                          rng if temperature > 0.0 else None,
+                          top_k=top_k, top_p=top_p)
+            return new_cache, key_pos, tok.astype(jnp.int32)
+
+        self._prefill_fns[P] = prefill
+        return prefill
+
+    def _decode(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        model, temperature, S = self.model, self.temperature, self.S
+        top_k, top_p = self.top_k, self.top_p
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def step(params, cache, key_pos, lengths, cur, active, rng):
+            wi = jnp.where(active, lengths, 0)
+            rows = jnp.arange(S, dtype=jnp.int32)
+            # Stamp this step's token positions BEFORE the forward: each
+            # row's new key must be attendable by its own query (the
+            # query's position equals the new key's — causal mask is <=).
+            stamped = key_pos.at[rows, wi].set(
+                jnp.where(active, lengths, key_pos[rows, wi]))
+            logits, st = model.apply(
+                {"params": params["params"], "cache": cache},
+                cur[:, None], wi[:, None], stamped, wi,
+                mutable=["cache"])
+            last = logits[:, -1]
+            tok = _sample(last, temperature,
+                          rng if temperature > 0.0 else None,
+                          top_k=top_k, top_p=top_p)
+            return st["cache"], stamped, tok.astype(jnp.int32)
+
+        self._decode_fn = step
+        return self._decode_fn
+
+    # -- engine loop ------------------------------------------------------
+
+    def _admit(self) -> None:
+        while self.queue and not self.active.all():
+            req = self.queue.pop(0)
+            slot = int(np.flatnonzero(~self.active)[0])
+            plen = len(req["prompt"])
+            P = self._bucket(plen)
+            prompt = np.zeros((1, P), np.int32)
+            prompt[0, :plen] = req["prompt"]
+            rng = (jax.random.fold_in(self._prefill_rng, req["id"])
+                   if self._prefill_rng is not None
+                   else jnp.zeros((2,), jnp.uint32))
+            self.cache, self.key_pos, tok = self._prefill_fn(P)(
+                self.params, self.cache, self.key_pos,
+                jnp.asarray(prompt), jnp.int32(plen), jnp.int32(slot), rng)
+            first = int(tok)
+            self.lengths[slot] = plen
+            self.cur[slot] = first
+            self.active[slot] = True
+            self.slots[slot] = _Slot(req["id"], req["prompt"],
+                                     req["max_new_tokens"], 1, [first])
+            self.stats["prefills"] += 1
+            self.stats["tokens_out"] += 1
+            self._finish_if_done(slot, first)
+
+    def _finish_if_done(self, slot: int, tok: int = -1):
+        st = self.slots[slot]
+        done_eos = self.eos_id is not None and tok == self.eos_id
+        done_len = st.produced >= st.max_new_tokens
+        if done_eos or done_len:
+            self.active[slot] = False
+            self._completed.append(Completion(
+                st.request_id, st.prompt, st.tokens,
+                "eos" if done_eos else "length"))
+            del self.slots[slot]
+            self.stats["completions"] += 1
+
+    def step(self) -> List[Completion]:
+        """Admit what fits, run ONE batched decode step, return any
+        requests that completed during it."""
+        self._completed: List[Completion] = []
+        self._admit()
+        if not self.active.any():
+            return self._completed
+        rng = (jax.random.fold_in(self._decode_rng, self._step_count)
+               if self._decode_rng is not None
+               else jnp.zeros((2,), jnp.uint32))
+        self.cache, self.key_pos, toks = self._decode()(
+            self.params, self.cache, self.key_pos,
+            jnp.asarray(self.lengths), jnp.asarray(self.cur),
+            jnp.asarray(self.active), rng)
+        toks = np.asarray(toks)
+        self._step_count += 1
+        self.stats["decode_steps"] += 1
+        for slot in np.flatnonzero(self.active):
+            slot = int(slot)
+            st = self.slots[slot]
+            self.lengths[slot] += 1          # cur is now in the cache
+            nxt = int(toks[slot])
+            self.cur[slot] = nxt
+            st.tokens.append(nxt)
+            st.produced += 1
+            self.stats["tokens_out"] += 1
+            self._finish_if_done(slot, tok=nxt)
+        return self._completed
+
+    def run(self) -> List[Completion]:
+        """Drain queue + pool to completion; completions in finish order."""
+        out: List[Completion] = []
+        while self.queue or self.active.any():
+            out.extend(self.step())
+        return out
+
+    @property
+    def utilization(self) -> float:
+        return float(self.active.sum()) / self.S
