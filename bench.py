@@ -90,6 +90,7 @@ FLASH_CASE = "flash_attention_microbench"
 # analog — the reference has no LLM; extra on-chip-only metric).
 DECODE_CASE = "llama_decode_microbench"
 SPEC_CASE = "llama_speculative_decode_microbench"
+SERVE_CASE = "llama_serve_microbench"
 
 _START = time.monotonic()
 
@@ -511,6 +512,10 @@ def main() -> None:
                 matrix.append(run_worker_case(
                     SPEC_CASE, "--spec-worker", env, tmpdir,
                     min(remaining() - 30, 240.0), unit="tokens/s"))
+            if not degraded and remaining() > 120 and not _WORKER_OVERRAN:
+                matrix.append(run_worker_case(
+                    SERVE_CASE, "--serve-worker", env, tmpdir,
+                    min(remaining() - 30, 300.0), unit="tokens/s"))
     except Exception as e:  # noqa: BLE001 — emission must survive anything
         if not emitted.get("value"):
             emitted["error"] = f"harness: {e!r}"
@@ -829,6 +834,104 @@ def spec_worker(out_path: str) -> None:
     write_result(out_path, result)
 
 
+def serve_worker(out_path: str) -> None:
+    """Continuous batching (models/serve.py) vs batch-1 sequential serving:
+    16 mixed-length requests through an 8-slot engine, tokens/s both ways.
+    The sequential baseline is what a user has WITHOUT the engine — one
+    jit_generate call per request at the same padded bucket shapes (both
+    paths pay one compile per bucket, excluded by the warmup pass)."""
+    sys.path.insert(0, REPO)
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from k8s_vgpu_scheduler_tpu.models.generate import jit_generate
+    from k8s_vgpu_scheduler_tpu.models.llama import Llama, LlamaConfig
+    from k8s_vgpu_scheduler_tpu.models.serve import ServingEngine
+
+    if os.environ.get("BENCH_SERVE_TINY") == "1":
+        cfg = LlamaConfig(vocab=256, dim=128, n_layers=2, n_heads=8,
+                          n_kv_heads=4, ffn_hidden=256)
+        lens, new, slots, max_len = [5, 9, 12, 7], 8, 2, 64
+    else:
+        cfg = LlamaConfig(vocab=8192, dim=768, n_layers=12, n_heads=12,
+                          n_kv_heads=4, ffn_hidden=2048)
+        rng = np.random.RandomState(5)
+        lens = list(rng.randint(48, 160, size=16))
+        new, slots, max_len = 64, 8, 256
+    prompts = [list(np.random.RandomState(100 + i).randint(1, cfg.vocab,
+                                                           size=n))
+               for i, n in enumerate(lens)]
+    import jax.numpy as jnp
+
+    params = jax.jit(Llama(cfg).init)(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+    horizon = 1 if os.environ.get("BENCH_SERVE_TINY") == "1" else 8
+    eng = ServingEngine(cfg, params, max_slots=slots, max_len=max_len,
+                        horizon=horizon)
+
+    def drain():
+        for p in prompts:
+            eng.submit(p, new)
+        done = eng.run()
+        return sum(len(c.tokens) for c in done)
+
+    drain()                       # compile every bucket + the decode step
+    warm_stats = dict(eng.stats)  # timed-drain stats = total minus warmup
+    t0 = time.perf_counter()
+    toks = drain()                # engine state is reusable after a drain
+    dt_engine = time.perf_counter() - t0
+
+    # Sequential baseline: same bucket shapes, left-padded (generate()'s
+    # ragged contract), one request at a time.
+    def bucket(n):
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    runs = {P: jit_generate(cfg, max_new_tokens=new)
+            for P in sorted({bucket(n) for n in lens})}
+
+    def run_one(p):
+        P = bucket(len(p))
+        pad = np.zeros((1, P), np.int32)
+        pad[0, P - len(p):] = p           # left-pad
+        out = runs[P](params, pad,
+                      prompt_lens=np.array([len(p)], np.int32))
+        out[0, -1].item()                 # honest wall time (tunnel)
+
+    for P in runs:                        # compile each bucket once
+        probe = prompts[next(i for i, n in enumerate(lens)
+                             if bucket(n) == P)]
+        run_one(probe)
+    t0 = time.perf_counter()
+    for p in prompts:
+        run_one(p)
+    dt_seq = time.perf_counter() - t0
+
+    engine_tps = toks / max(dt_engine, 1e-9)
+    seq_tps = len(prompts) * new / max(dt_seq, 1e-9)
+    result = {
+        "metric": SERVE_CASE, "unit": "tokens/s",
+        "value": round(engine_tps, 1),
+        "sequential_tokens_per_s": round(seq_tps, 1),
+        "speedup_vs_sequential": round(engine_tps / max(seq_tps, 1e-9), 2),
+        "platform": jax.devices()[0].platform,
+        "config": {"requests": len(prompts), "slots": slots,
+                   "max_new": new, "horizon": horizon,
+                   "prompt_lens": [int(n) for n in lens],
+                   "dtype": cfg.dtype},
+        "stats": {k: v - warm_stats.get(k, 0)
+                  for k, v in eng.stats.items()},
+    }
+    write_result(out_path, result)
+
+
 # ----------------------------------------------------------------------------
 # Worker: runs in its own process; the only code that imports jax.
 # ----------------------------------------------------------------------------
@@ -958,19 +1061,22 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
 
 if __name__ == "__main__":
     if ("--flash-worker" in sys.argv or "--decode-worker" in sys.argv
-            or "--spec-worker" in sys.argv):
+            or "--spec-worker" in sys.argv or "--serve-worker" in sys.argv):
         import argparse
 
         p = argparse.ArgumentParser()
         p.add_argument("--flash-worker", action="store_true")
         p.add_argument("--decode-worker", action="store_true")
         p.add_argument("--spec-worker", action="store_true")
+        p.add_argument("--serve-worker", action="store_true")
         p.add_argument("--out", required=True)
         a = p.parse_args()
         if a.decode_worker:
             decode_worker(a.out)
         elif a.spec_worker:
             spec_worker(a.out)
+        elif a.serve_worker:
+            serve_worker(a.out)
         else:
             flash_worker(a.out)
     elif "--worker" in sys.argv:

@@ -45,6 +45,13 @@ def log(msg: str) -> None:
     print(f"poolwatch[{time.strftime('%H:%M:%S')}]: {msg}", flush=True)
 
 
+def _unlink(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
 def probe_once(window_s: float) -> bool:
     """One never-killed probe; True iff it answers PROBE_OK tpu within the
     window.  An unanswered probe is left running — it either completes
@@ -67,9 +74,11 @@ def probe_once(window_s: float) -> bool:
         if "PROBE_OK" in txt:
             plat = txt.split("PROBE_OK", 1)[1].split()[0]
             log(f"probe answered: {txt.strip().splitlines()[-1]}")
+            _unlink(marker.name)          # child exited; safe to remove
             return plat == "tpu"
         if "Error" in txt or "error" in txt:
             log(f"probe errored: {txt.strip().splitlines()[-1][:120]}")
+            _unlink(marker.name)
             return False
     log(f"probe silent after {window_s:.0f}s (left running, never killed)")
     return False
@@ -98,9 +107,7 @@ def train_tasks():
         argv = [sys.executable, os.path.join(REPO, "bench.py"),
                 "--worker", name, "--out", spool,
                 "--batch", str(spec["batch"]), "--size", str(spec["size"]),
-                "--iters", str(spec["iters"])]
-        if spec["train"]:
-            argv.append("--train")
+                "--iters", str(spec["iters"]), "--train"]
         out.append((name, argv, 600.0))
     return out
 
@@ -112,7 +119,8 @@ def micro_tasks():
     for name, flag, fuse in [
             (bench.FLASH_CASE, "--flash-worker", 420.0),
             (bench.DECODE_CASE, "--decode-worker", 420.0),
-            (bench.SPEC_CASE, "--spec-worker", 480.0)]:
+            (bench.SPEC_CASE, "--spec-worker", 480.0),
+            (bench.SERVE_CASE, "--serve-worker", 480.0)]:
         if any(r.get("metric") == name and r.get("platform") == "tpu"
                and r.get("value") for r in _matrix()):
             continue
@@ -171,11 +179,12 @@ def run_queue(kinds) -> bool:
 def merge_spool() -> None:
     """Fold any spooled results into bench_matrix.json without touching
     the chip: a 1-second-budget bench run skips the probe but still
-    harvests + rank-merges in its finally block."""
+    harvests + rank-merges in its finally block.  run_no_kill keeps the
+    watcher alive (and the child unkilled) even if the merge stalls."""
     env = dict(os.environ, BENCH_BUDGET_S="1")
-    subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                   env=env, capture_output=True, text=True, timeout=300)
-    log("spool merged into bench_matrix.json")
+    rc, _, _ = run_no_kill([sys.executable, os.path.join(REPO, "bench.py")],
+                           env, 300.0)
+    log(f"spool merge rc={rc} (bench_matrix.json rank-merged)")
 
 
 def main() -> None:

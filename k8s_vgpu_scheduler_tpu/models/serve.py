@@ -72,17 +72,27 @@ class ServingEngine:
     eos_id : optional stop token.
     temperature : 0 = greedy (token-exact vs generate()); > 0 samples with
         the engine rng, folded per decode step.
+    horizon : decode steps per device dispatch (lax.scan inside one jit).
+        >1 amortizes the per-dispatch host round trip — decisive on
+        tunneled/remote backends — trading up to horizon-1 wasted row
+        steps per finished slot.  GREEDY output is token-identical for
+        any horizon (overshoot past EOS/length is discarded host-side);
+        temperature sampling draws a different key stream per horizon
+        setting, so sampled outputs are reproducible only at a fixed
+        (rng, horizon) pair.
     """
 
     def __init__(self, cfg: LlamaConfig, params, *, max_slots: int,
                  max_len: int, eos_id: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 0.0,
+                 top_p: float = 0.0, horizon: int = 1,
                  rng: Optional[jax.Array] = None):
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling requires an rng key")
         if max_slots < 1 or max_len < 1:
             raise ValueError("max_slots and max_len must be >= 1")
+        if horizon < 1:
+            raise ValueError("horizon must be >= 1")
         self.cfg = dataclasses.replace(
             cfg, decode_cache_len=max_len, attention="full")
         self.model = Llama(self.cfg, decode=True)
@@ -90,6 +100,11 @@ class ServingEngine:
         self.S = int(max_slots)
         self.L = int(max_len)
         self.eos_id = eos_id
+        # Decode steps per device dispatch: >1 amortizes the host round
+        # trip (decisive on tunneled/remote dispatch) at the cost of up to
+        # horizon-1 wasted steps per finished slot and admission latency
+        # quantized to the horizon.
+        self.horizon = int(horizon)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
@@ -124,7 +139,8 @@ class ServingEngine:
         self._step_count = 0
         self._prefill_fns: Dict[int, object] = {}
         self._decode_fn = None
-        self.stats = {"prefills": 0, "decode_steps": 0, "tokens_out": 0,
+        self.stats = {"prefills": 0, "decode_steps": 0,
+                      "decode_dispatches": 0, "tokens_out": 0,
                       "completions": 0}
 
     # -- capacity ---------------------------------------------------------
@@ -222,25 +238,40 @@ class ServingEngine:
             return self._decode_fn
         model, temperature, S = self.model, self.temperature, self.S
         top_k, top_p = self.top_k, self.top_p
+        L, h = self.L, self.horizon
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def step(params, cache, key_pos, lengths, cur, active, rng):
-            wi = jnp.where(active, lengths, 0)
             rows = jnp.arange(S, dtype=jnp.int32)
-            # Stamp this step's token positions BEFORE the forward: each
-            # row's new key must be attendable by its own query (the
-            # query's position equals the new key's — causal mask is <=).
-            stamped = key_pos.at[rows, wi].set(
-                jnp.where(active, lengths, key_pos[rows, wi]))
-            logits, st = model.apply(
-                {"params": params["params"], "cache": cache},
-                cur[:, None], wi[:, None], stamped, wi,
-                mutable=["cache"])
-            last = logits[:, -1]
-            tok = _sample(last, temperature,
-                          rng if temperature > 0.0 else None,
-                          top_k=top_k, top_p=top_p)
-            return st["cache"], stamped, tok.astype(jnp.int32)
+            act = active.astype(jnp.int32)
+
+            def one(carry, t):
+                cache, key_pos, lengths, cur = carry
+                # Clamp covers rows that finished host-side mid-horizon
+                # but keep decoding until the dispatch boundary: their
+                # write lands in their OWN row (garbage a future prefill
+                # rebuilds), never a neighbour's.
+                wi = jnp.minimum(jnp.where(active, lengths, 0), L - 1)
+                # Stamp this step's token position BEFORE the forward:
+                # each row's new key must be attendable by its own query
+                # (the query's position equals the new key's; mask is <=).
+                stamped = key_pos.at[rows, wi].set(
+                    jnp.where(active, lengths, key_pos[rows, wi]))
+                logits, st = model.apply(
+                    {"params": params["params"], "cache": cache},
+                    cur[:, None], wi[:, None], stamped, wi,
+                    mutable=["cache"])
+                srng = jax.random.fold_in(rng, t)
+                tok = _sample(logits[:, -1], temperature,
+                              srng if temperature > 0.0 else None,
+                              top_k=top_k, top_p=top_p).astype(jnp.int32)
+                return (st["cache"], stamped, lengths + act,
+                        jnp.where(active, tok, cur)), tok
+
+            (cache, key_pos, _, _), toks = jax.lax.scan(
+                one, (cache, key_pos, lengths, cur),
+                jnp.arange(h, dtype=jnp.int32))
+            return cache, key_pos, toks          # [horizon, S]
 
         self._decode_fn = step
         return self._decode_fn
@@ -284,8 +315,11 @@ class ServingEngine:
             self.stats["completions"] += 1
 
     def step(self) -> List[Completion]:
-        """Admit what fits, run ONE batched decode step, return any
-        requests that completed during it."""
+        """Admit what fits, run ONE decode dispatch (``horizon`` batched
+        steps in a single device call), return any requests that completed
+        during it.  A slot hitting EOS/length mid-horizon stops consuming
+        tokens; the extra ones its row computed until the dispatch
+        boundary are discarded (its cache rows are rebuilt on reuse)."""
         self._completed: List[Completion] = []
         self._admit()
         if not self.active.any():
@@ -297,19 +331,23 @@ class ServingEngine:
             self.params, self.cache, self.key_pos,
             jnp.asarray(self.lengths), jnp.asarray(self.cur),
             jnp.asarray(self.active), rng)
-        toks = np.asarray(toks)
+        toks = np.asarray(toks)                  # [horizon, S]
         self._step_count += 1
-        self.stats["decode_steps"] += 1
-        for slot in np.flatnonzero(self.active):
-            slot = int(slot)
-            st = self.slots[slot]
-            self.lengths[slot] += 1          # cur is now in the cache
-            nxt = int(toks[slot])
-            self.cur[slot] = nxt
-            st.tokens.append(nxt)
-            st.produced += 1
-            self.stats["tokens_out"] += 1
-            self._finish_if_done(slot, tok=nxt)
+        self.stats["decode_steps"] += self.horizon
+        self.stats["decode_dispatches"] += 1
+        snapshot = [int(s) for s in np.flatnonzero(self.active)]
+        for t in range(self.horizon):
+            for slot in snapshot:
+                if not self.active[slot]:        # finished mid-horizon
+                    continue
+                st = self.slots[slot]
+                self.lengths[slot] += 1          # cur is now in the cache
+                nxt = int(toks[t, slot])
+                self.cur[slot] = nxt
+                st.tokens.append(nxt)
+                st.produced += 1
+                self.stats["tokens_out"] += 1
+                self._finish_if_done(slot, tok=nxt)
         return self._completed
 
     def run(self) -> List[Completion]:

@@ -94,6 +94,28 @@ def test_eos_truncates(model_and_params):
     assert c.tokens == full[:cut + 1]
 
 
+def test_horizon_token_exact(model_and_params):
+    cfg, params = model_and_params
+    # horizon=4 with requests whose lengths do NOT divide 4, plus an EOS
+    # stop mid-horizon: output must be identical to the horizon=1 engine
+    # and to generate().
+    p1, p2 = [3, 1, 4, 1, 5], [2, 7, 1]
+    full = oracle(cfg, params, p2, 9)
+    eos = full[2]
+    cut = full.index(eos)
+    eng = ServingEngine(cfg, params, max_slots=2, max_len=32, horizon=4,
+                        eos_id=eos)
+    eng.submit(p1, 7)
+    eng.submit(p2, 9)
+    done = {c.request_id: c for c in eng.run()}
+    o1 = oracle(cfg, params, p1, 7)
+    o1 = o1[:o1.index(eos) + 1] if eos in o1 else o1
+    assert done[0].tokens == o1
+    assert done[1].tokens == full[:cut + 1]
+    assert done[1].finished_by == "eos"
+    assert eng.stats["decode_dispatches"] < eng.stats["decode_steps"]
+
+
 def test_rejects_oversized_and_empty(model_and_params):
     cfg, params = model_and_params
     eng = ServingEngine(cfg, params, max_slots=1, max_len=16)
