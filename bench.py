@@ -848,17 +848,18 @@ def serve_worker(out_path: str) -> None:
 
     import numpy as np
 
+    from k8s_vgpu_scheduler_tpu.cmd.serve import DEMO_CONFIGS
     from k8s_vgpu_scheduler_tpu.models.generate import jit_generate
     from k8s_vgpu_scheduler_tpu.models.llama import Llama, LlamaConfig
     from k8s_vgpu_scheduler_tpu.models.serve import ServingEngine
 
+    # The measured shapes ARE the deployable server's demo shapes
+    # (cmd/serve.py DEMO_CONFIGS) — retune one, retune both.
     if os.environ.get("BENCH_SERVE_TINY") == "1":
-        cfg = LlamaConfig(vocab=256, dim=128, n_layers=2, n_heads=8,
-                          n_kv_heads=4, ffn_hidden=256)
+        cfg = LlamaConfig(**DEMO_CONFIGS["tiny"])
         lens, new, slots, max_len = [5, 9, 12, 7], 8, 2, 64
     else:
-        cfg = LlamaConfig(vocab=8192, dim=768, n_layers=12, n_heads=12,
-                          n_kv_heads=4, ffn_hidden=2048)
+        cfg = LlamaConfig(**DEMO_CONFIGS["base"])
         rng = np.random.RandomState(5)
         lens = list(rng.randint(48, 160, size=16))
         new, slots, max_len = 64, 8, 256

@@ -1,0 +1,282 @@
+"""Model-serving entrypoint: the continuous-batching engine behind HTTP.
+
+The deployable form of ``models/serve.py`` — what an ``examples/
+pod-serving.yaml`` pod actually runs.  One engine thread owns ALL device
+work (the ServingEngine is deliberately not thread-safe); HTTP handlers
+hand requests over and block on a per-request event, so any number of
+concurrent clients share the slot pool, which is the point.
+
+API (token ids in/out — tokenization is the application's concern):
+
+- ``POST /v1/generate``  ``{"prompt": [ints], "max_new_tokens": N}`` →
+  ``{"request_id", "tokens", "finished_by"}`` (blocks until complete)
+- ``GET /healthz``   liveness
+- ``GET /statsz``    engine stats, utilization, queue depth, pool bytes
+
+Run (demo scale, random params):
+    python -m k8s_vgpu_scheduler_tpu.cmd.serve --demo base --bind :8000
+
+Run (real checkpoint): ``--config config.json --checkpoint /ckpt`` where
+config.json holds LlamaConfig fields and the checkpoint is an orbax dir
+written by models/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+DEMO_CONFIGS = {
+    # MXU-friendly sizes; "tiny" is CI/demo scale, "base" ~110M params.
+    "tiny": dict(vocab=256, dim=128, n_layers=2, n_heads=8, n_kv_heads=4,
+                 ffn_hidden=256),
+    "base": dict(vocab=8192, dim=768, n_layers=12, n_heads=12, n_kv_heads=4,
+                 ffn_hidden=2048),
+}
+
+
+class EngineFrontend:
+    """Thread-safe facade: submit() from any thread, one worker thread
+    drives the engine and delivers completions."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._cv = threading.Condition()
+        self._incoming = []          # (prompt, max_new, waiter)
+        self._waiters = {}           # request_id -> waiter
+        self._stop = False
+        self._fatal: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-engine")
+        self._thread.start()
+
+    def submit_and_wait(self, prompt, max_new_tokens: int,
+                        timeout: Optional[float] = None):
+        waiter = {"event": threading.Event(), "completion": None,
+                  "error": None}
+        with self._cv:
+            if self._fatal is not None:
+                raise RuntimeError(f"engine failed: {self._fatal!r}")
+            self._incoming.append((prompt, max_new_tokens, waiter))
+            self._cv.notify()
+        if not waiter["event"].wait(timeout):
+            raise TimeoutError("generation timed out")
+        if waiter["error"] is not None:
+            raise waiter["error"]
+        return waiter["completion"]
+
+    def stats(self) -> dict:
+        eng = self.engine
+        with self._cv:
+            depth = len(self._incoming)
+        return {
+            "stats": dict(eng.stats),
+            "utilization": eng.utilization,
+            "queue_depth": depth + len(eng.queue),
+            "slots": eng.S, "max_len": eng.L, "horizon": eng.horizon,
+            "pool_hbm_bytes": eng.pool_hbm_bytes(),
+        }
+
+    def healthy(self) -> bool:
+        return self._fatal is None and self._thread.is_alive()
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=30)
+
+    def _fail_all(self, err: BaseException) -> None:
+        """Fail every in-flight and queued waiter (stop/fatal paths)."""
+        for _, _, w in self._incoming:
+            w["error"] = err
+            w["event"].set()
+        self._incoming = []
+        for w in self._waiters.values():
+            w["error"] = err
+            w["event"].set()
+        self._waiters.clear()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._incoming and not self._stop
+                       and not self.engine.active.any()
+                       and not self.engine.queue):
+                    self._cv.wait()
+                if self._stop:
+                    self._fail_all(RuntimeError("server shutting down"))
+                    return
+                batch = self._incoming
+                self._incoming = []
+            for prompt, max_new, waiter in batch:
+                try:
+                    rid = self.engine.submit(prompt, max_new)
+                    self._waiters[rid] = waiter
+                except Exception as e:  # noqa: BLE001 — refuse, don't die
+                    waiter["error"] = e
+                    waiter["event"].set()
+            try:
+                completed = self.engine.step()
+            except Exception as e:  # noqa: BLE001 — engine is now suspect
+                # A mid-dispatch failure leaves donated pool buffers in an
+                # undefined state: mark the frontend FATALLY unhealthy
+                # (healthz flips 503 so the pod restarts) instead of
+                # retrying a corrupted engine in a hot loop.
+                log.exception("engine step failed; marking frontend down")
+                with self._cv:
+                    self._fatal = e
+                    self._fail_all(e)
+                return
+            for c in completed:
+                w = self._waiters.pop(c.request_id, None)
+                if w is not None:
+                    w["completion"] = c
+                    w["event"].set()
+
+
+def make_handler(frontend: EngineFrontend, request_timeout: float):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route through logging
+            log.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if frontend.healthy():
+                    self._reply(200, {"ok": True})
+                else:
+                    self._reply(503, {"ok": False,
+                                      "error": "engine thread down"})
+            elif self.path == "/statsz":
+                self._reply(200, frontend.stats())
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/generate":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                prompt = req["prompt"]
+                max_new = int(req.get("max_new_tokens", 64))
+            except (KeyError, TypeError, ValueError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            try:
+                c = frontend.submit_and_wait(prompt, max_new,
+                                             timeout=request_timeout)
+            except TimeoutError:
+                self._reply(504, {"error": "generation timed out"})
+                return
+            except ValueError as e:      # over-capacity / bad shapes
+                self._reply(422, {"error": str(e)})
+                return
+            except RuntimeError as e:
+                self._reply(503, {"error": str(e)})
+                return
+            self._reply(200, {"request_id": c.request_id,
+                              "tokens": c.tokens,
+                              "finished_by": c.finished_by})
+
+    return Handler
+
+
+def build_engine(args):
+    # Import under the entrypoint (not module top level): the device
+    # backend must come up inside the pod's enforcement env.
+    import jax
+
+    from ..models.llama import Llama, LlamaConfig
+    from ..models.serve import ServingEngine
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = LlamaConfig(**json.load(f))
+    else:
+        cfg = LlamaConfig(**DEMO_CONFIGS[args.demo])
+    import jax.numpy as jnp
+
+    # Full-precision template first: checkpoints hold fp kernels, so the
+    # restore target must be the fp tree; quantization is a TRANSFORM of
+    # restored params (models/quant.py), not an init-time layout.
+    params = jax.jit(Llama(cfg).init)(jax.random.PRNGKey(0),
+                                      jnp.zeros((1, 8), jnp.int32))
+    if args.checkpoint:
+        from ..models.checkpoint import restore_checkpoint
+
+        params = restore_checkpoint(args.checkpoint, params)
+    if args.quant:
+        import dataclasses
+
+        from ..models.quant import quantize_params
+
+        cfg = dataclasses.replace(cfg, quant=args.quant)
+        params = quantize_params(params)
+    rng = jax.random.PRNGKey(args.seed) if args.temperature > 0 else None
+    return ServingEngine(
+        cfg, params, max_slots=args.max_slots, max_len=args.max_len,
+        horizon=args.horizon, eos_id=args.eos_id,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        rng=rng)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("vtpu-serve")
+    p.add_argument("--bind", default="0.0.0.0:8000")
+    p.add_argument("--demo", choices=sorted(DEMO_CONFIGS), default="base")
+    p.add_argument("--config", default="",
+                   help="LlamaConfig fields as JSON (overrides --demo)")
+    p.add_argument("--checkpoint", default="",
+                   help="orbax checkpoint dir (models/checkpoint.py)")
+    p.add_argument("--quant", choices=["int8"], default="")
+    p.add_argument("--max-slots", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=2048)
+    p.add_argument("--horizon", type=int, default=8)
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-k", type=int, default=0)
+    p.add_argument("--top-p", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--request-timeout", type=float, default=300.0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = parse_args(argv)
+    frontend = EngineFrontend(build_engine(args))
+    host, _, port = args.bind.rpartition(":")
+    server = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
+                                 make_handler(frontend,
+                                              args.request_timeout))
+    log.info("serving on %s (slots=%d max_len=%d horizon=%d, pool=%d MiB)",
+             args.bind, frontend.engine.S, frontend.engine.L,
+             frontend.engine.horizon,
+             frontend.engine.pool_hbm_bytes() // 2**20)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.shutdown()
+
+
+if __name__ == "__main__":
+    main()

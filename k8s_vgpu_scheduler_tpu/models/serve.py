@@ -22,9 +22,14 @@ with **zero recompilation**:
 - the pool's HBM footprint is a closed-form constant (``pool_hbm_bytes``),
   exactly what a vtpu pod should request as its ``tpumem`` grant.
 
-Greedy outputs are TOKEN-IDENTICAL to :func:`models.generate.generate` per
-request, regardless of arrival order or slot contention (pinned in
-tests/test_serve.py, including slot-reuse-after-EOS staleness).
+Greedy outputs match :func:`models.generate.generate` per request,
+regardless of arrival order or slot contention (pinned token-exact in
+fp32 by tests/test_serve.py, including slot-reuse-after-EOS staleness).
+One caveat, stated honestly: the engine and generate() are shape-variant
+compilations of the same math (pool length/batch differ), so in bf16 a
+one-ULP logit difference can flip greedy argmax at a near-tie — the
+divergent token is equally argmax-correct, but reproducibility across
+the two paths is only bit-exact in fp32.
 """
 
 from __future__ import annotations

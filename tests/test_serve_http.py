@@ -1,0 +1,87 @@
+"""HTTP serving front-end (cmd/serve.py): concurrent clients through the
+engine thread, responses token-exact vs generate(); health/stats; errors."""
+
+import json
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_vgpu_scheduler_tpu.cmd.serve import EngineFrontend, make_handler
+from k8s_vgpu_scheduler_tpu.models.generate import generate
+from k8s_vgpu_scheduler_tpu.models.llama import Llama, LlamaConfig
+from k8s_vgpu_scheduler_tpu.models.serve import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def server():
+    # float32 for the same reason as tests/test_serve.py: bf16 argmax
+    # near-ties flip between shape-variant compilations.
+    cfg = LlamaConfig(vocab=64, dim=64, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=128, dtype="float32")
+    params = Llama(cfg).init(jax.random.PRNGKey(0),
+                             jnp.zeros((1, 8), jnp.int32))
+    eng = ServingEngine(cfg, params, max_slots=2, max_len=32, horizon=2)
+    frontend = EngineFrontend(eng)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_handler(frontend, request_timeout=120))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield cfg, params, f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    frontend.shutdown()
+
+
+def post(url, obj, timeout=120):
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_concurrent_clients_token_exact(server):
+    cfg, params, url = server
+    rng = np.random.RandomState(2)
+    prompts = [[int(x) for x in rng.randint(1, 64, size=l)]
+               for l in (4, 9, 6, 11, 5)]
+    results = {}
+
+    def client(i):
+        results[i] = post(url, {"prompt": prompts[i], "max_new_tokens": 6})
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    for i, p in enumerate(prompts):
+        status, body = results[i]
+        assert status == 200
+        want = [int(t) for t in np.asarray(
+            generate(cfg, params,
+                     jnp.asarray(p, jnp.int32)[None], 6)[0, len(p):])]
+        assert body["tokens"] == want
+        assert body["finished_by"] == "length"
+
+
+def test_health_stats_and_errors(server):
+    _, _, url = server
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        assert json.loads(r.read())["ok"] is True
+    with urllib.request.urlopen(url + "/statsz", timeout=30) as r:
+        st = json.loads(r.read())
+    assert st["slots"] == 2 and st["pool_hbm_bytes"] > 0
+    assert st["stats"]["completions"] >= 5     # the concurrent test ran
+    status, body = post(url, {"prompt": [1] * 40, "max_new_tokens": 6})
+    assert status == 422 and "exceeds" in body["error"]
+    status, body = post(url, {"max_new_tokens": 6})
+    assert status == 400
