@@ -31,7 +31,8 @@ import uuid
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-from benchmarks.procutil import run_no_kill  # noqa: E402 — needs REPO path
+from benchmarks.procutil import (  # noqa: E402 — needs REPO path
+    CLEAN_EXIT_SNIPPET, clean_jax_exit, run_no_kill)
 
 # Total wall budget for everything (driver kills at 600s; stay well under).
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "420"))
@@ -162,6 +163,7 @@ def probe_backend(env: dict, platform: str, timeout: float) -> bool:
         "x = jnp.ones((256, 256), jnp.bfloat16)\n"
         "(x @ x).block_until_ready()\n"
         "print('PROBE_OK', len(d), d[0].platform)\n"
+        + CLEAN_EXIT_SNIPPET
     )
     penv = dict(env)
     if platform == "cpu":
@@ -1080,6 +1082,10 @@ if __name__ == "__main__":
             serve_worker(a.out)
         else:
             flash_worker(a.out)
+        # Result is on disk: release the PJRT client and skip interpreter
+        # teardown (the tunnel client's exit path has aborted post-result
+        # and wedged the pool — DIAG_r03.txt; procutil.CLEAN_EXIT_SNIPPET).
+        clean_jax_exit(0)
     elif "--worker" in sys.argv:
         import argparse
 
@@ -1092,5 +1098,6 @@ if __name__ == "__main__":
         p.add_argument("--train", action="store_true")
         a = p.parse_args()
         worker(a.name, a.out, a.batch, a.size, a.iters, a.train)
+        clean_jax_exit(0)  # see the micro-worker branch above
     else:
         main()

@@ -31,13 +31,14 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from benchmarks.procutil import run_no_kill  # noqa: E402
+from benchmarks.procutil import CLEAN_EXIT_SNIPPET, run_no_kill  # noqa: E402
 
 PROBE_SRC = (
     "import time, jax\n"
     "t = time.time()\n"
     "d = jax.devices()\n"
     "print('PROBE_OK', d[0].platform, round(time.time()-t, 2), flush=True)\n"
+    + CLEAN_EXIT_SNIPPET
 )
 
 

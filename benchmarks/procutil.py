@@ -9,8 +9,52 @@ from __future__ import annotations
 
 import os
 import subprocess
+import sys
 import tempfile
 from typing import List, Optional, Tuple
+
+# Appended to device-claiming ``python -c`` snippets (and called by worker
+# mains): release the PJRT client deterministically on the main thread,
+# then skip interpreter teardown entirely.  The tunnel client has aborted
+# during normal finalization ("terminate called…", "FATAL: exception not
+# rethrown" — pthread_cancel unwind, DIAG_r03.txt 16:34 incident), which
+# the pool server cannot distinguish from a kill mid-claim and answers
+# with a ~25-minute wedge.  clear_backends() destroys the client while
+# the interpreter is still healthy; os._exit() makes the fragile exit
+# path unreachable.  Only the success path is covered — a snippet that
+# raises skips the epilogue and takes its chances, same as before.
+CLEAN_EXIT_SNIPPET = """
+import os as _cx_os, sys as _cx_sys
+try:
+    _cx_sys.stdout.flush(); _cx_sys.stderr.flush()
+except Exception:
+    pass
+try:
+    if 'jax' in _cx_sys.modules:
+        from jax.extend import backend as _cx_b
+        _cx_b.clear_backends()
+except Exception:
+    pass
+_cx_os._exit(0)
+"""
+
+
+def clean_jax_exit(code: int = 0) -> None:
+    """Worker-main twin of CLEAN_EXIT_SNIPPET (see its comment).  Never
+    returns."""
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        if "jax" in sys.modules:
+            from jax.extend import backend as _b  # deferred: module stays jax-free
+
+            _b.clear_backends()
+    except Exception:  # noqa: BLE001
+        pass
+    os._exit(code)
 
 
 def run_no_kill(argv: List[str], env: dict,

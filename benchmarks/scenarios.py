@@ -52,7 +52,7 @@ from typing import List
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from benchmarks.procutil import run_no_kill  # noqa: E402
+from benchmarks.procutil import CLEAN_EXIT_SNIPPET, run_no_kill  # noqa: E402
 
 ROUND = os.environ.get("SCENARIO_ROUND", "r03")
 MIB = 1024 * 1024
@@ -126,7 +126,7 @@ def tpu_available(timeout: float = 210.0) -> bool:
             "d = jax.devices()\n"
             "x = jnp.ones((128, 128), jnp.bfloat16)\n"
             "(x @ x).block_until_ready()\n"
-            "print('OK', d[0].platform)\n")
+            "print('OK', d[0].platform)\n" + CLEAN_EXIT_SNIPPET)
     rc, out_text, _ = run_no_kill([sys.executable, "-c", code],
                                    dict(os.environ), timeout)
     if rc is None:
@@ -173,7 +173,10 @@ def run_child(code: str, env: dict, timeout: float = 180.0,
     plugin WRAPPED by libvtpu_pjrt.so — allocation-level enforcement without
     any cooperation from the framework in the container."""
     full = child_env(env, interposer)
-    rc, out, err = run_no_kill([sys.executable, "-c", code], full, timeout)
+    # Clean-exit epilogue: covers the snippet's success path only (an
+    # exception skips it and the child exits nonzero as before).
+    rc, out, err = run_no_kill([sys.executable, "-c",
+                                code + CLEAN_EXIT_SNIPPET], full, timeout)
     if rc is None:
         log(f"worker still running after {timeout:.0f}s; left detached")
         return -1, out, "timeout (worker left running, not killed)"
@@ -529,7 +532,7 @@ while not os.path.exists(stop):
     out.write(json.dumps({"t": time.time(), "dur": dt,
                           "rate": BLOCK / dt}) + "\\n")
 print("LOW_DONE", flush=True)
-"""
+""" + CLEAN_EXIT_SNIPPET
 
 # The high-priority sharer acts at the shared-region ABI — the exact writes
 # its shim would perform per dispatch (vtpu_rate_acquire marks

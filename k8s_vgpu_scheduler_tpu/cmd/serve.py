@@ -191,6 +191,13 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
             except RuntimeError as e:
                 self._reply(503, {"error": str(e)})
                 return
+            except Exception as e:  # noqa: BLE001 — the worker loop stores
+                # ANY exception type in the waiter (e.g. TypeError from a
+                # malformed prompt element); an unmapped type must become
+                # an HTTP error, not a dropped connection.
+                self._reply(400 if isinstance(e, (TypeError, KeyError))
+                            else 500, {"error": f"{type(e).__name__}: {e}"})
+                return
             self._reply(200, {"request_id": c.request_id,
                               "tokens": c.tokens,
                               "finished_by": c.finished_by})
