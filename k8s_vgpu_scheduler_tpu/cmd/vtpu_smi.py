@@ -12,9 +12,15 @@ layers write:
 - on a node (``--containers-dir``): one section per vtpu container, the
   monitor's-eye view (reference ``/tmp/vgpu/containers`` scan).
 
+- cluster-wide (``--cluster http://<scheduler>:9395``): admin's-eye view
+  from the extender's Prometheus surface — per-chip grants vs capacity,
+  sharer counts, per-pod allocations (the ``nvidia-smi`` run on the
+  *cluster*, which the reference has no analog of).
+
 Usage:
   python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi [--json]
   python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi --containers-dir /tmp/vtpu/containers
+  python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi --cluster http://sched:9395
 """
 
 from __future__ import annotations
@@ -75,16 +81,133 @@ def format_info(info: dict, title: str) -> str:
     return "\n".join(lines)
 
 
+def parse_prom(text: str) -> dict:
+    """Minimal Prometheus text-exposition parser: name → [(labels, value)].
+    Only what the extender emits (gauges/counters, quoted label values
+    without embedded quotes) — no client dependency in the CLI."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, val = line.rpartition(" ")
+        name, labels = head, {}
+        if "{" in head:
+            name, _, rest = head.partition("{")
+            for part in rest.rstrip("}").split(","):
+                if not part:
+                    continue
+                k, _, v = part.partition("=")
+                labels[k] = v.strip('"')
+        try:
+            out.setdefault(name, []).append((labels, float(val)))
+        except ValueError:
+            continue
+    return out
+
+
+def cluster_info(metrics: dict) -> dict:
+    """Regroup the extender's metric families into a per-node/per-chip +
+    per-pod structure (names from scheduler/metrics.py)."""
+    nodes: dict = {}
+
+    def chip(labels):
+        node = nodes.setdefault(labels.get("node", "?"), {"chips": {}})
+        return node["chips"].setdefault(
+            labels.get("deviceuuid", "?"),
+            {"capacity_mib": 0, "granted_mib": 0, "sharers": 0, "cores": 0})
+
+    for labels, v in metrics.get("tpu_device_memory_limit_mib", []):
+        chip(labels)["capacity_mib"] = int(v)
+    for labels, v in metrics.get("tpu_device_memory_allocated_mib", []):
+        chip(labels)["granted_mib"] = int(v)
+    for labels, v in metrics.get("tpu_device_shared_num", []):
+        chip(labels)["sharers"] = int(v)
+    for labels, v in metrics.get("tpu_device_core_allocated", []):
+        chip(labels)["cores"] = int(v)
+    for labels, v in metrics.get("node_tpu_memory_percentage", []):
+        nodes.setdefault(labels.get("node", "?"), {"chips": {}})[
+            "hbm_allocated_fraction"] = round(v, 4)
+
+    pods: dict = {}
+    for labels, v in metrics.get("vtpu_pod_device_allocated_mib", []):
+        key = (labels.get("podnamespace", "?"), labels.get("podname", "?"))
+        pods.setdefault(key, []).append(
+            {"deviceuuid": labels.get("deviceuuid", "?"),
+             "granted_mib": int(v), "cores": 0})
+    for labels, v in metrics.get("vtpu_pod_core_allocated", []):
+        key = (labels.get("podnamespace", "?"), labels.get("podname", "?"))
+        for g in pods.get(key, []):
+            if g["deviceuuid"] == labels.get("deviceuuid", "?"):
+                g["cores"] = int(v)
+    preempt = metrics.get("vtpu_preemption_requests_total", [({}, 0.0)])
+    return {
+        "nodes": nodes,
+        "pods": [{"namespace": ns, "name": n, "grants": gs}
+                 for (ns, n), gs in sorted(pods.items())],
+        "preemption_requests": int(preempt[0][1]) if preempt else 0,
+    }
+
+
+def format_cluster(info: dict) -> str:
+    lines = []
+    for node, nd in sorted(info["nodes"].items()):
+        pct = nd.get("hbm_allocated_fraction")
+        lines.append(f"+ {node}"
+                     + (f"  (HBM allocated: {pct:.0%})" if pct is not None
+                        else ""))
+        lines.append("| chip                     granted / capacity    "
+                     "sharers  cores |")
+        for uuid, c in sorted(nd["chips"].items()):
+            lines.append(
+                "| {u:<24s} {g:>6d} / {t:<6d} MiB  {s:>5d}  {co:>4d}% |"
+                .format(u=uuid[:24], g=c["granted_mib"], t=c["capacity_mib"],
+                        s=c["sharers"], co=c["cores"]))
+    if info["pods"]:
+        lines.append("+ pods")
+        for p in info["pods"]:
+            for g in p["grants"]:
+                lines.append(
+                    "| {pn:<34s} {u:<24s} {m:>6d} MiB {c:>4d}% |".format(
+                        pn=f"{p['namespace']}/{p['name']}"[:34],
+                        u=g["deviceuuid"][:24], m=g["granted_mib"],
+                        c=g["cores"]))
+    lines.append(f"| preemption requests: {info['preemption_requests']}")
+    return "\n".join(lines)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser("vtpu-smi")
     p.add_argument("--region", default="",
                    help="region path (default: $TPU_DEVICE_MEMORY_SHARED_CACHE)")
     p.add_argument("--containers-dir", default="",
                    help="host mode: scan per-container region dirs")
+    p.add_argument("--cluster", default="",
+                   help="cluster mode: scheduler metrics URL "
+                        "(http://<extender>:9395)")
     p.add_argument("--json", action="store_true", dest="as_json")
     p.add_argument("--library", default=os.environ.get("VTPU_LIBRARY", ""),
                    help="libvtpu.so path override")
     args = p.parse_args(argv)
+
+    if args.cluster:
+        import urllib.request
+
+        url = args.cluster.rstrip("/")
+        if "://" not in url:
+            url = "http://" + url
+        if not url.endswith("/metrics"):
+            url += "/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                text = r.read().decode()
+        except (OSError, ValueError) as e:
+            print(f"vtpu-smi: cannot fetch {url}: {e}", file=sys.stderr)
+            return 2
+        info = cluster_info(parse_prom(text))
+        print(json.dumps(info, indent=1) if args.as_json
+              else format_cluster(info))
+        return 0
 
     reader = RegionReader(args.library or None)
     targets: List[tuple] = []

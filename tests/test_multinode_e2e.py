@@ -1,0 +1,259 @@
+"""Two-NODE multi-process e2e: gang placement across node agents.
+
+Extends test_multiprocess_e2e.py's single-node topology to the
+distributed case the reference never handles (SURVEY §7 hard part #5 —
+its scheduler places pods one at a time): one scheduler process, TWO
+device-plugin processes (node-a, node-b) each with its own fake-kubelet
+unix socket, and a 2-member SPMD gang that must be admitted atomically
+across both nodes through the real HTTP + gRPC transports.
+
+Pinned end-to-end:
+- the co-scheduling barrier is visible on the wire: the first member's
+  /filter fails with "waiting (1/2)" until the second member arrives;
+- atomic admission puts the two full-node members on DIFFERENT nodes;
+- each node's kubelet-side Allocate pops its own member and emits the
+  jax.distributed bootstrap contract (VTPU_GANG_RANK/SIZE/GROUP/
+  COORDINATOR) with distinct ranks and the user's coordinator address;
+- deleting one member travels the watch and frees that node's capacity.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from k8s_vgpu_scheduler_tpu.api import deviceplugin_pb2 as pb
+from k8s_vgpu_scheduler_tpu.api.kubelet import (
+    DevicePluginStub,
+    add_registration_service,
+)
+from k8s_vgpu_scheduler_tpu.k8s.simserver import KubeSimServer
+from k8s_vgpu_scheduler_tpu.scheduler.gang import (
+    GANG_COORDINATOR_ANNOTATION,
+    GANG_GROUP_ANNOTATION,
+    GANG_TOTAL_ANNOTATION,
+)
+from k8s_vgpu_scheduler_tpu.util.types import (
+    BIND_PHASE_ANNOTATION,
+    NODE_LOCK_ANNOTATION,
+)
+
+from conftest import free_port  # noqa: E402 — shared test helper
+from test_multiprocess_e2e import http_json, wait_until  # noqa: E402
+
+NODES = ("node-a", "node-b")
+
+
+def gang_pod(name, uid, coordinator="ring-0.ring.default.svc"):
+    """A full-node member (8 chips x full HBM on the 4x2 v5e fixture)."""
+    return {
+        "metadata": {
+            "name": name, "namespace": "default", "uid": uid,
+            "annotations": {
+                GANG_GROUP_ANNOTATION: "ring",
+                GANG_TOTAL_ANNOTATION: "2",
+                GANG_COORDINATOR_ANNOTATION: coordinator,
+            },
+        },
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {"google.com/tpu": "8",
+                                     "google.com/tpumem": "16384"}},
+        }]},
+    }
+
+
+@pytest.fixture
+def stack2(tmp_path):
+    sim = KubeSimServer()
+    for n in NODES:
+        sim.kube.add_node({"metadata": {"name": n, "annotations": {}}})
+    sim.start()
+
+    http_port, grpc_port, metrics_port = free_port(), free_port(), free_port()
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        VTPU_MOCK_JSON=os.path.join(REPO, "examples", "v5e-fixture.json"),
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+
+    procs = []
+    kubelets = []
+    socket_dirs = {}
+    registered = {n: [] for n in NODES}
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "k8s_vgpu_scheduler_tpu.cmd.scheduler",
+             "--kube-url", sim.url,
+             "--http-bind", f"127.0.0.1:{http_port}",
+             "--grpc-bind", f"127.0.0.1:{grpc_port}",
+             "--metrics-port", str(metrics_port),
+             "--resync-seconds", "3600"],  # deletions MUST travel the watch
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+        for n in NODES:
+            sdir = tmp_path / f"kubelet-{n}"
+            sdir.mkdir()
+            socket_dirs[n] = str(sdir)
+            kubelet = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+            add_registration_service(
+                kubelet,
+                lambda req, ctx, _n=n: (registered[_n].append(req),
+                                        pb.Empty())[1])
+            kubelet.add_insecure_port(f"unix://{sdir}/kubelet.sock")
+            kubelet.start()
+            kubelets.append(kubelet)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "k8s_vgpu_scheduler_tpu.cmd.device_plugin",
+                 "--kube-url", sim.url,
+                 "--node-name", n,
+                 "--scheduler-endpoint", f"127.0.0.1:{grpc_port}",
+                 "--socket-dir", str(sdir),
+                 "--shim-dir", str(tmp_path / "shim"),
+                 "--cache-dir", str(tmp_path / f"containers-{n}"),
+                 "--config-file", str(tmp_path / "absent.json")],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+        base = f"http://127.0.0.1:{http_port}"
+        probe = {
+            "metadata": {"name": "probe", "namespace": "default",
+                         "uid": "uid-probe", "annotations": {}},
+            "spec": {"containers": [{
+                "name": "main",
+                "resources": {"limits": {"google.com/tpu": "1"}}}]},
+        }
+        sim.kube.create_pod(probe)
+
+        def both_nodes_known():
+            status, res = http_json("POST", f"{base}/filter",
+                                    {"Pod": probe, "NodeNames": list(NODES)})
+            # A 1-chip probe fits anywhere once inventory has streamed in;
+            # the scheduler answers with its single best node, so "both
+            # registered" = no node failed for lack of inventory.
+            return status == 200 and res.get("NodeNames") and not any(
+                "no TPU inventory" in v
+                for v in (res.get("FailedNodes") or {}).values())
+
+        wait_until(lambda: all(registered[n] for n in NODES),
+                   desc="both kubelet registrations")
+        wait_until(both_nodes_known, desc="both nodes' inventory via gRPC")
+        sim.kube.delete_pod("default", "probe")
+
+        yield sim, base, socket_dirs
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for k in kubelets:
+            k.stop(grace=None)
+        sim.stop()
+
+
+@pytest.mark.e2e
+def test_gang_placed_atomically_across_nodes(stack2):
+    sim, base, socket_dirs = stack2
+
+    p0 = gang_pod("ring-0", "uid-ring-0")
+    p1 = gang_pod("ring-1", "uid-ring-1")
+    sim.kube.create_pod(p0)
+    sim.kube.create_pod(p1)
+
+    # Member 1 alone: the co-scheduling barrier holds it on the wire.
+    status, res = http_json("POST", f"{base}/filter",
+                            {"Pod": p0, "NodeNames": list(NODES)})
+    assert status == 200 and not res.get("NodeNames"), res
+    assert "waiting (1/2)" in res.get("Error", ""), res
+
+    # Member 2 completes the quorum: atomic admission places BOTH.
+    status, res = http_json("POST", f"{base}/filter",
+                            {"Pod": p1, "NodeNames": list(NODES)})
+    assert status == 200 and res.get("NodeNames"), res
+    node_p1 = res["NodeNames"][0]
+
+    status, res = http_json("POST", f"{base}/filter",
+                            {"Pod": p0, "NodeNames": list(NODES)})
+    assert status == 200 and res.get("NodeNames"), res
+    node_p0 = res["NodeNames"][0]
+
+    # Two full-node members cannot share: distinct nodes, both real.
+    assert {node_p0, node_p1} == set(NODES)
+
+    # Bind + kubelet Allocate on EACH node's own plugin socket.
+    ranks, coords = {}, {}
+    for pod_name, uid, node in (("ring-0", "uid-ring-0", node_p0),
+                                ("ring-1", "uid-ring-1", node_p1)):
+        status, res = http_json(
+            "POST", f"{base}/bind",
+            {"PodName": pod_name, "PodNamespace": "default",
+             "PodUID": uid, "Node": node})
+        assert status == 200 and not res.get("Error"), res
+
+        channel = grpc.insecure_channel(
+            f"unix://{socket_dirs[node]}/vtpu.sock")
+        stub = DevicePluginStub(channel)
+        req = pb.AllocateRequest()
+        req.container_requests.add().devicesIDs.extend(["ignored"])
+        resp = stub.Allocate(req, timeout=30)
+        envs = resp.container_responses[0].envs
+        assert len(envs["TPU_VISIBLE_CHIPS"].split(",")) == 8
+        assert envs["VTPU_GANG_SIZE"] == "2"
+        assert envs["VTPU_GANG_GROUP"] == "ring"
+        ranks[pod_name] = envs["VTPU_GANG_RANK"]
+        coords[pod_name] = envs.get("VTPU_GANG_COORDINATOR", "")
+        channel.close()
+
+    # jax.distributed bootstrap contract: distinct ranks covering [0, N),
+    # same user-supplied coordinator on every member.
+    assert sorted(ranks.values()) == ["0", "1"]
+    assert set(coords.values()) == {"ring-0.ring.default.svc"}
+
+    def phase(name):
+        return sim.kube.get_pod("default", name)["metadata"][
+            "annotations"].get(BIND_PHASE_ANNOTATION)
+
+    wait_until(lambda: phase("ring-0") == "success"
+               and phase("ring-1") == "success",
+               desc="both members bind-phase=success")
+    for n in NODES:
+        wait_until(
+            lambda n=n: NODE_LOCK_ANNOTATION
+            not in sim.kube.get_node(n)["metadata"]["annotations"],
+            desc=f"{n} lock release")
+
+    # A third full-node pod fits nowhere while the gang holds both nodes…
+    extra = {
+        "metadata": {"name": "extra", "namespace": "default",
+                     "uid": "uid-extra", "annotations": {}},
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {"google.com/tpu": "8",
+                                     "google.com/tpumem": "16384"}}}]},
+    }
+    sim.kube.create_pod(extra)
+    status, res = http_json("POST", f"{base}/filter",
+                            {"Pod": extra, "NodeNames": list(NODES)})
+    assert status == 200 and not res.get("NodeNames"), res
+
+    # …and deleting one member frees exactly that node via the watch
+    # (resync is 3600s, so only the watch can deliver this).
+    sim.kube.delete_pod("default", "ring-0")
+
+    def extra_fits_on_freed_node():
+        status, res = http_json("POST", f"{base}/filter",
+                                {"Pod": extra, "NodeNames": list(NODES)})
+        return status == 200 and res.get("NodeNames") == [node_p0]
+
+    wait_until(extra_fits_on_freed_node, timeout=10.0,
+               desc="watch-driven release of the deleted member's node")

@@ -1,0 +1,136 @@
+"""vtpu-smi --cluster: the admin's-eye view over the extender metrics.
+
+Drives the REAL ClusterCollector (scheduler/metrics.py) through the real
+prometheus_client exposition encoder, then the CLI's parser/regrouper —
+so the test breaks if either side of the contract drifts.  Also pins the
+Grafana dashboard (charts/vtpu/dashboards/vtpu-overview.json) to metric
+names one of the two collectors actually emits.
+"""
+
+import json
+import os
+import re
+
+from prometheus_client import CollectorRegistry, generate_latest
+
+from k8s_vgpu_scheduler_tpu.cmd.vtpu_smi import (
+    cluster_info,
+    format_cluster,
+    parse_prom,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.metrics import ClusterCollector
+from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+from k8s_vgpu_scheduler_tpu.scheduler.score import DeviceUsage
+from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def usage(id_, used_mem, used_cores, used_slots):
+    return DeviceUsage(id=id_, type="v5e", health=True, coords=(0, 0),
+                       total_slots=10, used_slots=used_slots,
+                       total_mem=16384, used_mem=used_mem,
+                       total_cores=100, used_cores=used_cores)
+
+
+class _Pods:
+    def __init__(self, pods):
+        self._pods = pods
+
+    def list_pods(self):
+        return self._pods
+
+
+class _SchedulerStub:
+    preemptions_requested = 3
+
+    def __init__(self):
+        self.pods = _Pods([
+            PodInfo(uid="u1", name="train-a", namespace="default",
+                    node="node-a",
+                    devices=[[ContainerDevice(uuid="chip-0", type="v5e",
+                                              usedmem=3000, usedcores=30)]]),
+            PodInfo(uid="u2", name="train-b", namespace="team",
+                    node="node-a",
+                    devices=[[ContainerDevice(uuid="chip-0", type="v5e",
+                                              usedmem=2000, usedcores=20),
+                              ContainerDevice(uuid="chip-1", type="v5e",
+                                              usedmem=1000, usedcores=0)]]),
+        ])
+
+    def inspect_all_nodes_usage(self):
+        return {
+            "node-a": {"chip-0": usage("chip-0", 5000, 50, 2),
+                       "chip-1": usage("chip-1", 1000, 0, 1)},
+            "node-b": {"chip-0": usage("chip-0", 0, 0, 0)},
+        }
+
+
+def exposition() -> str:
+    registry = CollectorRegistry()
+    registry.register(ClusterCollector(_SchedulerStub()))
+    return generate_latest(registry).decode()
+
+
+def test_cluster_info_roundtrip():
+    info = cluster_info(parse_prom(exposition()))
+
+    a = info["nodes"]["node-a"]
+    assert a["chips"]["chip-0"] == {"capacity_mib": 16384,
+                                    "granted_mib": 5000,
+                                    "sharers": 2, "cores": 50}
+    assert a["chips"]["chip-1"]["granted_mib"] == 1000
+    # cluster_info rounds the fraction to 4 decimals.
+    assert abs(a["hbm_allocated_fraction"] - 6000 / 32768) < 1e-3
+    assert info["nodes"]["node-b"]["chips"]["chip-0"]["granted_mib"] == 0
+    assert info["preemption_requests"] == 3
+
+    pods = {(p["namespace"], p["name"]): p["grants"] for p in info["pods"]}
+    assert pods[("default", "train-a")] == [
+        {"deviceuuid": "chip-0", "granted_mib": 3000, "cores": 30}]
+    assert len(pods[("team", "train-b")]) == 2
+
+    text = format_cluster(info)
+    assert "node-a" in text and "chip-0" in text
+    assert "5000" in text and "16384" in text
+    assert "team/train-b" in text
+    assert "preemption requests: 3" in text
+
+
+def test_parse_prom_tolerates_comments_and_escapes():
+    metrics = parse_prom(
+        "# HELP x y\n# TYPE x gauge\n"
+        'x{a="1",b="two"} 4.5\n'
+        "plain 7\n"
+        "garbage line without value\n")
+    assert metrics["x"] == [({"a": "1", "b": "two"}, 4.5)]
+    assert metrics["plain"] == [({}, 7.0)]
+
+
+def test_grafana_dashboard_uses_real_metric_names():
+    with open(os.path.join(REPO, "charts", "vtpu", "dashboards",
+                           "vtpu-overview.json")) as f:
+        dash = json.load(f)
+
+    exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+    exprs.append(dash["templating"]["list"][0]["query"])
+    referenced = set()
+    for e in exprs:
+        referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
+    referenced -= {"rate", "label_values", "node"}  # promql, not metrics
+
+    emitted = set(re.findall(r'MetricFamily\(\s*"([a-z0-9_]+)"',
+                             _sources()))
+    # prometheus_client renders counters with a _total suffix.
+    emitted |= {f"{m}_total" for m in emitted}
+    missing = referenced - emitted
+    assert not missing, f"dashboard references unknown metrics: {missing}"
+
+
+def _sources() -> str:
+    out = []
+    for rel in ("k8s_vgpu_scheduler_tpu/scheduler/metrics.py",
+                "k8s_vgpu_scheduler_tpu/monitor/metrics.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            out.append(f.read())
+    return "\n".join(out)
