@@ -12,6 +12,8 @@ API (token ids in/out — tokenization is the application's concern):
   ``{"request_id", "tokens", "finished_by"}`` (blocks until complete)
 - ``GET /healthz``   liveness
 - ``GET /statsz``    engine stats, utilization, queue depth, pool bytes
+- ``GET /profilez?seconds=N``  capture an XLA device trace of the live
+  decode loop (tensorboard/xprof format); returns the trace directory
 
 Run (demo scale, random params):
     python -m k8s_vgpu_scheduler_tpu.cmd.serve --demo base --bind :8000
@@ -26,7 +28,10 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
+import tempfile
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -141,6 +146,53 @@ class EngineFrontend:
                     w["event"].set()
 
 
+_PROFILE_LOCK = threading.Lock()
+
+
+def profile_capture(path: str) -> tuple:
+    """``GET /profilez?seconds=N`` — capture a device trace of whatever the
+    engine is executing and return the trace directory.
+
+    TPU-native tracing (SURVEY §5: the reference has no profiler at all):
+    the XLA profiler records device timelines, HLO op costs and memory
+    viewer data for the decode steps running during the window; view with
+    tensorboard or xprof against the returned directory (kubectl cp it out
+    of the pod).  Serialized: one capture at a time per process.  Traces
+    land in fresh directories under $VTPU_PROFILE_BASE (default: the pod
+    tmpdir) — the path is never caller-controlled (unauthenticated port)."""
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+    try:
+        seconds = float(q.get("seconds", ["2"])[0])
+    except ValueError:
+        return 400, {"error": "bad seconds"}
+    if not 0.0 < seconds <= 60.0:   # also rejects NaN
+        return 400, {"error": "seconds must be in (0, 60]"}
+    base = os.environ.get("VTPU_PROFILE_BASE") or None
+    out_dir = tempfile.mkdtemp(prefix="vtpu-prof-", dir=base)
+    if not _PROFILE_LOCK.acquire(blocking=False):
+        return 409, {"error": "a capture is already running"}
+    try:
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            # A failed sleep must not leave the process-wide trace
+            # running (every later capture would 500 "already started").
+            jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001 — never take the server down
+        return 500, {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        _PROFILE_LOCK.release()
+    # Fresh mkdtemp: everything under it was written by THIS capture.
+    n_files = sum(len(fs) for _, _, fs in os.walk(out_dir))
+    return 200, {"trace_dir": out_dir, "seconds": seconds,
+                 "files": n_files}
+
+
 def make_handler(frontend: EngineFrontend, request_timeout: float):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # route through logging
@@ -163,6 +215,8 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
                                       "error": "engine thread down"})
             elif self.path == "/statsz":
                 self._reply(200, frontend.stats())
+            elif self.path.startswith("/profilez"):
+                self._reply(*profile_capture(self.path))
             else:
                 self._reply(404, {"error": "not found"})
 

@@ -93,3 +93,29 @@ def test_health_stats_and_errors(server):
     # not dropped connections (a null prompt element trips int(None)).
     status, body = post(url, {"prompt": [None], "max_new_tokens": 4})
     assert status in (400, 422) and "error" in body
+
+
+def test_profilez_captures_device_trace(server, tmp_path, monkeypatch):
+    _, _, url = server
+    monkeypatch.setenv("VTPU_PROFILE_BASE", str(tmp_path))
+    with urllib.request.urlopen(url + "/profilez?seconds=0.5",
+                                timeout=60) as r:
+        body = json.loads(r.read())
+    # Trace dir is server-chosen under the configured base, never
+    # caller-controlled (the port is unauthenticated).
+    assert body["trace_dir"].startswith(str(tmp_path))
+    # The XLA profiler wrote an xplane even if the engine was idle; the
+    # dir is fresh, so every counted file is from this capture.
+    assert body["files"] >= 1
+    # Bad queries are 400s, not tracebacks — and a rejected capture must
+    # not wedge the profiler for the next one.
+    for bad in ("nope", "-1", "0", "nan", "3600"):
+        try:
+            urllib.request.urlopen(f"{url}/profilez?seconds={bad}",
+                                   timeout=30)
+            raise AssertionError(f"expected HTTP 400 for seconds={bad}")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    with urllib.request.urlopen(url + "/profilez?seconds=0.2",
+                                timeout=60) as r:
+        assert json.loads(r.read())["files"] >= 1
