@@ -9,7 +9,10 @@ concurrent clients share the slot pool, which is the point.
 API (token ids in/out — tokenization is the application's concern):
 
 - ``POST /v1/generate``  ``{"prompt": [ints], "max_new_tokens": N}`` →
-  ``{"request_id", "tokens", "finished_by"}`` (blocks until complete)
+  ``{"request_id", "tokens", "finished_by"}`` (blocks until complete);
+  with ``"stream": true`` the response is server-sent events — one
+  ``data: {"token": id}`` per token as decode dispatches land, then
+  ``data: {"done": true, "finished_by": ...}``
 - ``GET /healthz``   liveness
 - ``GET /statsz``    engine stats, utilization, queue depth, pool bytes
 - ``GET /profilez?seconds=N``  capture an XLA device trace of the live
@@ -29,6 +32,7 @@ import argparse
 import json
 import logging
 import os
+import queue as _queue
 import tempfile
 import threading
 import time
@@ -63,18 +67,31 @@ class EngineFrontend:
 
     def submit_and_wait(self, prompt, max_new_tokens: int,
                         timeout: Optional[float] = None):
-        waiter = {"event": threading.Event(), "completion": None,
-                  "error": None}
-        with self._cv:
-            if self._fatal is not None:
-                raise RuntimeError(f"engine failed: {self._fatal!r}")
-            self._incoming.append((prompt, max_new_tokens, waiter))
-            self._cv.notify()
+        waiter = self._enqueue(prompt, max_new_tokens, stream=False)
         if not waiter["event"].wait(timeout):
             raise TimeoutError("generation timed out")
         if waiter["error"] is not None:
             raise waiter["error"]
         return waiter["completion"]
+
+    def submit_stream(self, prompt, max_new_tokens: int) -> dict:
+        """Streaming submit: returns the waiter whose ``stream_q`` yields
+        ("tok", id) per generated token as decode dispatches land, then
+        ("done", finished_by) — or ("err", message)."""
+        return self._enqueue(prompt, max_new_tokens, stream=True)
+
+    def _enqueue(self, prompt, max_new_tokens: int, stream: bool) -> dict:
+        waiter = {"event": threading.Event(), "completion": None,
+                  "error": None}
+        if stream:
+            waiter["stream_q"] = _queue.Queue()
+            waiter["sent"] = 0
+        with self._cv:
+            if self._fatal is not None:
+                raise RuntimeError(f"engine failed: {self._fatal!r}")
+            self._incoming.append((prompt, max_new_tokens, waiter))
+            self._cv.notify()
+        return waiter
 
     def stats(self) -> dict:
         eng = self.engine
@@ -100,13 +117,18 @@ class EngineFrontend:
     def _fail_all(self, err: BaseException) -> None:
         """Fail every in-flight and queued waiter (stop/fatal paths)."""
         for _, _, w in self._incoming:
-            w["error"] = err
-            w["event"].set()
+            self._fail_one(w, err)
         self._incoming = []
         for w in self._waiters.values():
-            w["error"] = err
-            w["event"].set()
+            self._fail_one(w, err)
         self._waiters.clear()
+
+    @staticmethod
+    def _fail_one(w: dict, err: BaseException) -> None:
+        w["error"] = err
+        if "stream_q" in w:
+            w["stream_q"].put(("err", str(err)))
+        w["event"].set()
 
     def _loop(self) -> None:
         while True:
@@ -125,8 +147,7 @@ class EngineFrontend:
                     rid = self.engine.submit(prompt, max_new)
                     self._waiters[rid] = waiter
                 except Exception as e:  # noqa: BLE001 — refuse, don't die
-                    waiter["error"] = e
-                    waiter["event"].set()
+                    self._fail_one(waiter, e)
             try:
                 completed = self.engine.step()
             except Exception as e:  # noqa: BLE001 — engine is now suspect
@@ -139,10 +160,24 @@ class EngineFrontend:
                     self._fatal = e
                     self._fail_all(e)
                 return
+            # Token streaming: after each dispatch, push the still-active
+            # slots' new tokens (this thread owns the engine, so reading
+            # slot state here is the one safe place).
+            for st in list(self.engine.slots.values()):
+                w = self._waiters.get(st.request_id)
+                if w is not None and "stream_q" in w:
+                    while w["sent"] < len(st.tokens):
+                        w["stream_q"].put(("tok", st.tokens[w["sent"]]))
+                        w["sent"] += 1
             for c in completed:
                 w = self._waiters.pop(c.request_id, None)
                 if w is not None:
                     w["completion"] = c
+                    if "stream_q" in w:
+                        while w["sent"] < len(c.tokens):
+                            w["stream_q"].put(("tok", c.tokens[w["sent"]]))
+                            w["sent"] += 1
+                        w["stream_q"].put(("done", c.finished_by))
                     w["event"].set()
 
 
@@ -169,21 +204,29 @@ def profile_capture(path: str) -> tuple:
         return 400, {"error": "bad seconds"}
     if not 0.0 < seconds <= 60.0:   # also rejects NaN
         return 400, {"error": "seconds must be in (0, 60]"}
-    base = os.environ.get("VTPU_PROFILE_BASE") or None
-    out_dir = tempfile.mkdtemp(prefix="vtpu-prof-", dir=base)
     if not _PROFILE_LOCK.acquire(blocking=False):
+        # Before any filesystem work: the 409 path is the one a polling
+        # client can hit in a loop, and it must not leak tmpdirs.
         return 409, {"error": "a capture is already running"}
     try:
+        base = os.environ.get("VTPU_PROFILE_BASE") or None
+        out_dir = tempfile.mkdtemp(prefix="vtpu-prof-", dir=base)
         import jax
 
-        jax.profiler.start_trace(out_dir)
         try:
-            time.sleep(seconds)
-        finally:
-            # A failed sleep must not leave the process-wide trace
-            # running (every later capture would 500 "already started").
-            jax.profiler.stop_trace()
-    except Exception as e:  # noqa: BLE001 — never take the server down
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                # A failed sleep must not leave the process-wide trace
+                # running (every later capture would 500 "already started").
+                jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — never take the server down
+            import shutil
+
+            shutil.rmtree(out_dir, ignore_errors=True)
+            return 500, {"error": f"{type(e).__name__}: {e}"}
+    except OSError as e:        # mkdtemp itself failed
         return 500, {"error": f"{type(e).__name__}: {e}"}
     finally:
         _PROFILE_LOCK.release()
@@ -215,7 +258,8 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
                                       "error": "engine thread down"})
             elif self.path == "/statsz":
                 self._reply(200, frontend.stats())
-            elif self.path.startswith("/profilez"):
+            elif self.path == "/profilez" or \
+                    self.path.startswith("/profilez?"):
                 self._reply(*profile_capture(self.path))
             else:
                 self._reply(404, {"error": "not found"})
@@ -232,6 +276,9 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
             except (KeyError, TypeError, ValueError,
                     json.JSONDecodeError) as e:
                 self._reply(400, {"error": f"bad request: {e}"})
+                return
+            if req.get("stream"):
+                self._stream(prompt, max_new)
                 return
             try:
                 c = frontend.submit_and_wait(prompt, max_new,
@@ -255,6 +302,57 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
             self._reply(200, {"request_id": c.request_id,
                               "tokens": c.tokens,
                               "finished_by": c.finished_by})
+
+        def _stream(self, prompt, max_new: int) -> None:
+            """Server-sent events: one ``data: {"token": id}`` per
+            generated token as decode dispatches land, terminated by
+            ``data: {"done": true, "finished_by": ...}``.  The body is
+            close-delimited (HTTP/1.0 semantics), so no Content-Length."""
+            # Validate BEFORE committing 200 + SSE headers, so ordinary
+            # rejections keep their status codes on the streaming path too
+            # (validate_request is thread-safe: reads only max_len).
+            try:
+                frontend.engine.validate_request(prompt, max_new)
+            except ValueError as e:
+                self._reply(422, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — e.g. TypeError coercion
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            try:
+                waiter = frontend.submit_stream(prompt, max_new)
+            except RuntimeError as e:
+                self._reply(503, {"error": str(e)})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+
+            def event(obj: dict) -> bool:
+                try:
+                    self.wfile.write(b"data: " + json.dumps(obj).encode()
+                                     + b"\n\n")
+                    self.wfile.flush()
+                    return True
+                except OSError:     # client went away; engine finishes the
+                    return False    # slot on its own, nobody reads the queue
+            while True:
+                try:
+                    kind, val = waiter["stream_q"].get(
+                        timeout=request_timeout)
+                except _queue.Empty:
+                    event({"error": "token timeout"})
+                    return
+                if kind == "tok":
+                    if not event({"token": val}):
+                        return
+                elif kind == "done":
+                    event({"done": True, "finished_by": val})
+                    return
+                else:
+                    event({"error": val})
+                    return
 
     return Handler
 

@@ -160,7 +160,10 @@ class ServingEngine:
 
     # -- request intake ---------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens: int) -> int:
+    def validate_request(self, prompt, max_new_tokens: int) -> list:
+        """Coerce + bounds-check a request WITHOUT touching engine state —
+        safe to call from any thread (reads only the immutable max_len),
+        so HTTP front-ends can reject before committing a response."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -170,6 +173,10 @@ class ServingEngine:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"max_len {self.L}")
+        return prompt
+
+    def submit(self, prompt, max_new_tokens: int) -> int:
+        prompt = self.validate_request(prompt, max_new_tokens)
         rid = self._next_id
         self._next_id += 1
         self.queue.append({"id": rid, "prompt": prompt,

@@ -95,6 +95,55 @@ def test_health_stats_and_errors(server):
     assert status in (400, 422) and "error" in body
 
 
+def test_streaming_tokens_match_blocking(server):
+    """SSE stream yields exactly the blocking response's tokens, in order,
+    terminated by the done event."""
+    _, _, url = server
+    prompt, max_new = [2, 9, 4], 6
+    status, blocking = post(url, {"prompt": prompt,
+                                  "max_new_tokens": max_new})
+    assert status == 200
+
+    req = urllib.request.Request(
+        url + "/v1/generate",
+        data=json.dumps({"prompt": prompt, "max_new_tokens": max_new,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    tokens, done = [], None
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            evt = json.loads(line[len("data: "):])
+            if "token" in evt:
+                tokens.append(evt["token"])
+            elif evt.get("done"):
+                done = evt["finished_by"]
+                break
+            else:
+                raise AssertionError(f"stream error event: {evt}")
+    assert tokens == blocking["tokens"]
+    assert done == blocking["finished_by"]
+
+
+def test_streaming_bad_prompt_is_422_before_headers(server):
+    """Validation runs BEFORE the 200 + SSE headers are committed, so the
+    streaming path keeps the blocking path's status codes."""
+    _, _, url = server
+    req = urllib.request.Request(
+        url + "/v1/generate",
+        data=json.dumps({"prompt": [1] * 40, "max_new_tokens": 4,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        urllib.request.urlopen(req, timeout=60)
+        raise AssertionError("expected HTTP 422")
+    except urllib.error.HTTPError as e:
+        assert e.code == 422 and "exceeds" in json.loads(e.read())["error"]
+
+
 def test_profilez_captures_device_trace(server, tmp_path, monkeypatch):
     _, _, url = server
     monkeypatch.setenv("VTPU_PROFILE_BASE", str(tmp_path))
