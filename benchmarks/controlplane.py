@@ -1,0 +1,172 @@
+"""Control-plane performance proof → CONTROLPLANE_rNN.json.
+
+The reference publishes GPU-workload benchmarks only; its scheduling
+path is never measured (SURVEY §6 — and its Filter snapshot is
+O(pods × devices) per call, §3.1).  This harness records what OUR
+control plane sustains, CPU-only and deterministic:
+
+- ``filter_bind_cycles_per_s``: full filter → bind → lock-release cycles
+  against 50 nodes × 8 chips, windows starting at 300/400/500 pods
+  already scheduled (per-window loads published) — in-process Scheduler
+  against FakeKube, best window so a noisy CI neighbor can't fake a
+  regression.
+- ``watch_release_latency_s`` (p50/p95): pod DELETE → grant freed,
+  through the REAL transport chain (simserver ``?watch=true`` HTTP
+  stream → RestKube → run_watch_loop → Scheduler.on_pod_event), the
+  informer-parity path VERDICT r2 item 4 asked for.
+
+Run:  python benchmarks/controlplane.py        (≈15 s; no chip, no k8s)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube                # noqa: E402
+from k8s_vgpu_scheduler_tpu.k8s.rest import RestKube                # noqa: E402
+from k8s_vgpu_scheduler_tpu.k8s.simserver import KubeSimServer      # noqa: E402
+from k8s_vgpu_scheduler_tpu.scheduler.core import (                 # noqa: E402
+    Scheduler,
+    run_watch_loop,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.nodes import (                # noqa: E402
+    DeviceInfo,
+    NodeInfo,
+)
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc              # noqa: E402
+from k8s_vgpu_scheduler_tpu.util import nodelock                    # noqa: E402
+from k8s_vgpu_scheduler_tpu.util.config import Config               # noqa: E402
+
+ROUND = os.environ.get("SCENARIO_ROUND", "r03")
+
+
+def register_node(s: Scheduler, name: str, chips=8, devmem=16384,
+                  mesh=(4, 2)) -> None:
+    devices = [
+        DeviceInfo(id=f"{name}-chip-{i}", count=10, devmem=devmem,
+                   type="TPU-v5e", health=True,
+                   coords=(i % mesh[0], i // mesh[0]))
+        for i in range(chips)
+    ]
+    s.nodes.add_node(name, NodeInfo(name=name, devices=devices,
+                                    topology=TopologyDesc(generation="v5e",
+                                                          mesh=mesh)))
+
+
+def tpu_pod(name: str, uid: str, mem: int = 2000) -> dict:
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": {}},
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {"google.com/tpu": "1",
+                                     "google.com/tpumem": str(mem)}}}]},
+    }
+
+
+def bench_throughput() -> dict:
+    kube = FakeKube()
+    s = Scheduler(kube, Config())
+    names = [f"node-{i}" for i in range(50)]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        register_node(s, n)
+    kube.watch_pods(s.on_pod_event)
+
+    def cycle(i: int, prefix: str) -> None:
+        name, uid = f"{prefix}{i}", f"{prefix}u{i}"
+        pod = tpu_pod(name, uid)
+        kube.create_pod(pod)
+        r = s.filter(pod, names)
+        assert r.node, r.error
+        s.bind("default", name, uid, r.node)
+        nodelock.release_node(kube, r.node)  # as the device plugin would
+
+    for i in range(300):                     # steady-state load
+        cycle(i, "p")
+    windows = []
+    for attempt in range(3):
+        start_load = 300 + 100 * attempt     # load GROWS across windows
+        t0 = time.monotonic()
+        for i in range(100):
+            cycle(1000 * (attempt + 1) + i, "q")
+        windows.append({"scheduled_pods_at_start": start_load,
+                        "cycles_per_s":
+                            round(100 / (time.monotonic() - t0), 1)})
+    # Best-of-N guards against a noisy CI neighbor; the per-window loads
+    # are published so the headline is not mistaken for the 600-pod rate.
+    best = max(w["cycles_per_s"] for w in windows)
+    return {"filter_bind_cycles_per_s": best, "windows": windows,
+            "nodes": 50, "chips_per_node": 8}
+
+
+def bench_watch_latency(rounds: int = 20) -> dict:
+    sim = KubeSimServer()
+    sim.kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+    sim.start()
+    stop = threading.Event()
+    try:
+        client = RestKube(sim.url)
+        s = Scheduler(client, Config())
+        register_node(s, "node-a")
+        threading.Thread(target=run_watch_loop, args=(s, stop),
+                         daemon=True).start()
+        lats = []
+        for i in range(rounds):
+            pod = tpu_pod(f"w{i}", f"wu{i}")
+            sim.kube.create_pod(pod)
+            r = s.filter(pod, ["node-a"])
+            assert r.node, r.error
+            deadline = time.monotonic() + 10
+            while s.pods.get(f"wu{i}") is None:
+                assert time.monotonic() < deadline, "grant never tracked"
+                time.sleep(0.002)
+            t0 = time.monotonic()
+            sim.kube.delete_pod("default", f"w{i}")
+            while s.pods.get(f"wu{i}") is not None:
+                assert time.monotonic() - t0 < 10, "watch release too slow"
+                time.sleep(0.002)
+            lats.append(time.monotonic() - t0)
+        lats.sort()
+        import math
+
+        p95_idx = max(0, math.ceil(0.95 * len(lats)) - 1)  # nearest-rank
+        return {
+            "watch_release_latency_s": {
+                "p50": round(lats[len(lats) // 2], 4),
+                "p95": round(lats[p95_idx], 4),
+                "max": round(lats[-1], 4),
+            },
+            "rounds": rounds,
+        }
+    finally:
+        stop.set()
+        sim.stop()
+
+
+def main() -> None:
+    result = {"scenario": "controlplane", "round": ROUND,
+              "platform": "cpu (control plane is chip-free)",
+              "note": ("reference baseline: none — the reference never "
+                       "measures its scheduling path (SURVEY §6); its "
+                       "Filter rebuilds an O(pods × devices) snapshot "
+                       "per call (SURVEY §3.1)")}
+    result.update(bench_throughput())
+    result.update(bench_watch_latency())
+    result["passed"] = (result["filter_bind_cycles_per_s"] > 20
+                       and result["watch_release_latency_s"]["p95"] < 1.0)
+    path = os.path.join(REPO, f"CONTROLPLANE_{ROUND}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

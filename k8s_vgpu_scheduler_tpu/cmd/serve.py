@@ -209,10 +209,10 @@ def profile_capture(path: str) -> tuple:
         # client can hit in a loop, and it must not leak tmpdirs.
         return 409, {"error": "a capture is already running"}
     try:
-        base = os.environ.get("VTPU_PROFILE_BASE") or None
-        out_dir = tempfile.mkdtemp(prefix="vtpu-prof-", dir=base)
         import jax
 
+        base = os.environ.get("VTPU_PROFILE_BASE") or None
+        out_dir = tempfile.mkdtemp(prefix="vtpu-prof-", dir=base)
         try:
             jax.profiler.start_trace(out_dir)
             try:
@@ -226,7 +226,7 @@ def profile_capture(path: str) -> tuple:
 
             shutil.rmtree(out_dir, ignore_errors=True)
             return 500, {"error": f"{type(e).__name__}: {e}"}
-    except OSError as e:        # mkdtemp itself failed
+    except Exception as e:  # noqa: BLE001 — import jax / mkdtemp failed
         return 500, {"error": f"{type(e).__name__}: {e}"}
     finally:
         _PROFILE_LOCK.release()
