@@ -36,39 +36,14 @@ from k8s_vgpu_scheduler_tpu.scheduler.core import (                 # noqa: E402
     Scheduler,
     run_watch_loop,
 )
-from k8s_vgpu_scheduler_tpu.scheduler.nodes import (                # noqa: E402
-    DeviceInfo,
-    NodeInfo,
-)
-from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc              # noqa: E402
 from k8s_vgpu_scheduler_tpu.util import nodelock                    # noqa: E402
 from k8s_vgpu_scheduler_tpu.util.config import Config               # noqa: E402
 
+# The same node/pod constructors the scheduler tests validate against —
+# shared so benchmark topology can't silently drift from tested topology.
+from tests.test_scheduler_core import register_node, tpu_pod        # noqa: E402
+
 ROUND = os.environ.get("SCENARIO_ROUND", "r03")
-
-
-def register_node(s: Scheduler, name: str, chips=8, devmem=16384,
-                  mesh=(4, 2)) -> None:
-    devices = [
-        DeviceInfo(id=f"{name}-chip-{i}", count=10, devmem=devmem,
-                   type="TPU-v5e", health=True,
-                   coords=(i % mesh[0], i // mesh[0]))
-        for i in range(chips)
-    ]
-    s.nodes.add_node(name, NodeInfo(name=name, devices=devices,
-                                    topology=TopologyDesc(generation="v5e",
-                                                          mesh=mesh)))
-
-
-def tpu_pod(name: str, uid: str, mem: int = 2000) -> dict:
-    return {
-        "metadata": {"name": name, "namespace": "default", "uid": uid,
-                     "annotations": {}},
-        "spec": {"containers": [{
-            "name": "main",
-            "resources": {"limits": {"google.com/tpu": "1",
-                                     "google.com/tpumem": str(mem)}}}]},
-    }
 
 
 def bench_throughput() -> dict:
@@ -77,12 +52,12 @@ def bench_throughput() -> dict:
     names = [f"node-{i}" for i in range(50)]
     for n in names:
         kube.add_node({"metadata": {"name": n, "annotations": {}}})
-        register_node(s, n)
+        register_node(s, n, chips=8, mesh=(4, 2))
     kube.watch_pods(s.on_pod_event)
 
     def cycle(i: int, prefix: str) -> None:
         name, uid = f"{prefix}{i}", f"{prefix}u{i}"
-        pod = tpu_pod(name, uid)
+        pod = tpu_pod(name, uid=uid, mem="2000")
         kube.create_pod(pod)
         r = s.filter(pod, names)
         assert r.node, r.error
@@ -120,7 +95,7 @@ def bench_watch_latency(rounds: int = 20) -> dict:
                          daemon=True).start()
         lats = []
         for i in range(rounds):
-            pod = tpu_pod(f"w{i}", f"wu{i}")
+            pod = tpu_pod(f"w{i}", uid=f"wu{i}", mem="2000")
             sim.kube.create_pod(pod)
             r = s.filter(pod, ["node-a"])
             assert r.node, r.error
@@ -137,11 +112,13 @@ def bench_watch_latency(rounds: int = 20) -> dict:
         lats.sort()
         import math
 
-        p95_idx = max(0, math.ceil(0.95 * len(lats)) - 1)  # nearest-rank
+        def rank(q: float) -> float:       # nearest-rank percentile
+            return lats[max(0, math.ceil(q * len(lats)) - 1)]
+
         return {
             "watch_release_latency_s": {
-                "p50": round(lats[len(lats) // 2], 4),
-                "p95": round(lats[p95_idx], 4),
+                "p50": round(rank(0.50), 4),
+                "p95": round(rank(0.95), 4),
                 "max": round(lats[-1], 4),
             },
             "rounds": rounds,

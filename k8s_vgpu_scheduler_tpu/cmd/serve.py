@@ -59,6 +59,7 @@ class EngineFrontend:
         self._cv = threading.Condition()
         self._incoming = []          # (prompt, max_new, waiter)
         self._waiters = {}           # request_id -> waiter
+        self._to_cancel = []         # waiters whose client gave up
         self._stop = False
         self._fatal: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -69,10 +70,22 @@ class EngineFrontend:
                         timeout: Optional[float] = None):
         waiter = self._enqueue(prompt, max_new_tokens, stream=False)
         if not waiter["event"].wait(timeout):
+            # Nobody will read the result: free the slot for the next
+            # request instead of decoding to max_new_tokens for a ghost.
+            self.cancel(waiter)
             raise TimeoutError("generation timed out")
         if waiter["error"] is not None:
             raise waiter["error"]
         return waiter["completion"]
+
+    def cancel(self, waiter: dict) -> None:
+        """Abort a request whose client went away (timeout, disconnect).
+        Applied by the worker thread before its next dispatch; a waiter
+        not yet submitted is skipped at submit time instead."""
+        with self._cv:
+            waiter["cancelled"] = True
+            self._to_cancel.append(waiter)
+            self._cv.notify()
 
     def submit_stream(self, prompt, max_new_tokens: int) -> dict:
         """Streaming submit: returns the waiter whose ``stream_q`` yields
@@ -133,7 +146,8 @@ class EngineFrontend:
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while (not self._incoming and not self._stop
+                while (not self._incoming and not self._to_cancel
+                       and not self._stop
                        and not self.engine.active.any()
                        and not self.engine.queue):
                     self._cv.wait()
@@ -142,12 +156,22 @@ class EngineFrontend:
                     return
                 batch = self._incoming
                 self._incoming = []
+                cancels = self._to_cancel
+                self._to_cancel = []
             for prompt, max_new, waiter in batch:
+                if waiter.get("cancelled"):
+                    continue        # client gave up before submission
                 try:
                     rid = self.engine.submit(prompt, max_new)
+                    waiter["rid"] = rid
                     self._waiters[rid] = waiter
                 except Exception as e:  # noqa: BLE001 — refuse, don't die
                     self._fail_one(waiter, e)
+            for w in cancels:
+                rid = w.get("rid")
+                if rid is not None and self._waiters.pop(rid, None) \
+                        is not None:
+                    self.engine.cancel(rid)
             try:
                 completed = self.engine.step()
             except Exception as e:  # noqa: BLE001 — engine is now suspect
@@ -335,17 +359,21 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
                                      + b"\n\n")
                     self.wfile.flush()
                     return True
-                except OSError:     # client went away; engine finishes the
-                    return False    # slot on its own, nobody reads the queue
+                except OSError:
+                    return False    # client went away
             while True:
                 try:
                     kind, val = waiter["stream_q"].get(
                         timeout=request_timeout)
                 except _queue.Empty:
+                    frontend.cancel(waiter)
                     event({"error": "token timeout"})
                     return
                 if kind == "tok":
                     if not event({"token": val}):
+                        # Disconnected mid-stream: free the slot instead
+                        # of decoding the rest for a ghost.
+                        frontend.cancel(waiter)
                         return
                 elif kind == "done":
                     event({"done": True, "finished_by": val})

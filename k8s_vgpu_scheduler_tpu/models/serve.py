@@ -146,7 +146,7 @@ class ServingEngine:
         self._decode_fn = None
         self.stats = {"prefills": 0, "decode_steps": 0,
                       "decode_dispatches": 0, "tokens_out": 0,
-                      "completions": 0}
+                      "completions": 0, "cancelled": 0}
 
     # -- capacity ---------------------------------------------------------
 
@@ -174,6 +174,25 @@ class ServingEngine:
                 f"prompt {len(prompt)} + max_new {max_new_tokens} exceeds "
                 f"max_len {self.L}")
         return prompt
+
+    def cancel(self, request_id: int) -> bool:
+        """Abort a request: drop it from the admission queue, or free its
+        slot mid-decode (the next admit rebuilds the cache rows, exactly
+        as after a normal completion).  No Completion is emitted.  Returns
+        False when the id is unknown — already completed, or never
+        submitted.  Same thread-ownership rule as step()/submit()."""
+        for i, req in enumerate(self.queue):
+            if req["id"] == request_id:
+                del self.queue[i]
+                self.stats["cancelled"] += 1
+                return True
+        for slot, st in self.slots.items():
+            if st.request_id == request_id:
+                self.active[slot] = False
+                del self.slots[slot]
+                self.stats["cancelled"] += 1
+                return True
+        return False
 
     def submit(self, prompt, max_new_tokens: int) -> int:
         prompt = self.validate_request(prompt, max_new_tokens)

@@ -185,3 +185,26 @@ def test_int8_quant_composes(model_and_params):
     eng.submit(p, 6)
     (c,) = eng.run()
     assert c.tokens == oracle(qcfg, qparams, p, 6)
+
+
+def test_cancel_queued_and_active(model_and_params):
+    """cancel() drops a queued request, frees a mid-decode slot for the
+    next admit (rows rebuilt — the successor is token-exact), emits no
+    Completion for the cancelled id, and is a no-op for unknown ids."""
+    cfg, params = model_and_params
+    eng = ServingEngine(cfg, params, max_slots=1, max_len=32)
+    r1 = eng.submit([1, 2, 3], 20)
+    r2 = eng.submit([4, 5, 6], 20)        # queued behind the single slot
+    eng.step()                            # r1 admitted and decoding
+    assert eng.active.any()
+    assert eng.cancel(r2) is True         # still in the queue
+    assert eng.cancel(r1) is True         # mid-decode: slot freed
+    assert not eng.active.any() and not eng.queue
+    assert eng.stats["cancelled"] == 2
+    assert eng.cancel(r1) is False        # already gone
+
+    p3 = [9, 10]
+    r3 = eng.submit(p3, 6)
+    done = eng.run()
+    assert [c.request_id for c in done] == [r3]
+    assert done[0].tokens == oracle(cfg, params, p3, 6)

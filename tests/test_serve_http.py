@@ -18,13 +18,20 @@ from k8s_vgpu_scheduler_tpu.models.serve import ServingEngine
 
 
 @pytest.fixture(scope="module")
-def server():
+def tiny_model():
     # float32 for the same reason as tests/test_serve.py: bf16 argmax
-    # near-ties flip between shape-variant compilations.
+    # near-ties flip between shape-variant compilations.  Module-scoped:
+    # Llama.init is the expensive compile every test here shares.
     cfg = LlamaConfig(vocab=64, dim=64, n_layers=2, n_heads=4,
                       n_kv_heads=2, ffn_hidden=128, dtype="float32")
     params = Llama(cfg).init(jax.random.PRNGKey(0),
                              jnp.zeros((1, 8), jnp.int32))
+    return cfg, params
+
+
+@pytest.fixture(scope="module")
+def server(tiny_model):
+    cfg, params = tiny_model
     eng = ServingEngine(cfg, params, max_slots=2, max_len=32, horizon=2)
     frontend = EngineFrontend(eng)
     httpd = ThreadingHTTPServer(("127.0.0.1", 0),
@@ -168,3 +175,59 @@ def test_profilez_captures_device_trace(server, tmp_path, monkeypatch):
     with urllib.request.urlopen(url + "/profilez?seconds=0.2",
                                 timeout=60) as r:
         assert json.loads(r.read())["files"] >= 1
+
+
+def test_timeout_cancels_and_frees_slot(tiny_model):
+    """A blocking client that times out must not leave its slot decoding
+    for a ghost: the frontend cancels it and the pool drains, then keeps
+    serving new requests correctly."""
+    import time
+
+    cfg, params = tiny_model
+    eng = ServingEngine(cfg, params, max_slots=1, max_len=64, horizon=1)
+    fe = EngineFrontend(eng)
+    try:
+        with pytest.raises(TimeoutError):
+            fe.submit_and_wait([1, 2, 3], 40, timeout=0.05)
+        deadline = time.monotonic() + 60
+        while (eng.stats["cancelled"] < 1 or eng.active.any()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert eng.stats["cancelled"] == 1
+        assert not eng.active.any()
+        c = fe.submit_and_wait([4, 5], 4, timeout=120)
+        assert len(c.tokens) == 4
+    finally:
+        fe.shutdown()
+
+
+def test_stream_disconnect_frees_slot(tiny_model):
+    """A streaming client that hangs up mid-generation frees its slot:
+    the handler's failed write triggers cancel and the pool drains."""
+    import time
+
+    cfg, params = tiny_model
+    eng = ServingEngine(cfg, params, max_slots=1, max_len=64, horizon=1)
+    fe = EngineFrontend(eng)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(fe, 120))
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            url + "/v1/generate",
+            data=json.dumps({"prompt": [3, 1], "max_new_tokens": 50,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        r = urllib.request.urlopen(req, timeout=60)
+        r.fp.readline()          # first SSE event arrived — mid-stream now
+        r.close()                # hang up
+        deadline = time.monotonic() + 60
+        while (eng.stats["cancelled"] < 1 or eng.active.any()) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert eng.stats["cancelled"] == 1
+        assert not eng.active.any()
+    finally:
+        httpd.shutdown()
+        fe.shutdown()
