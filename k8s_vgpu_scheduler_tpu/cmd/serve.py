@@ -448,8 +448,11 @@ def parse_args(argv=None):
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     args = parse_args(argv)
-    frontend = EngineFrontend(build_engine(args))
     host, _, port = args.bind.rpartition(":")
+    if not port.isdigit():
+        raise SystemExit(
+            f"--bind must be host:port or :port, got {args.bind!r}")
+    frontend = EngineFrontend(build_engine(args))
     server = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
                                  make_handler(frontend,
                                               args.request_timeout))

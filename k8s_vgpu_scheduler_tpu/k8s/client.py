@@ -33,7 +33,12 @@ class KubeClient:
     """The narrow apiserver surface this framework consumes."""
 
     # -- pods -----------------------------------------------------------------
-    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+    def list_pods(self, namespace: Optional[str] = None,
+                  node_name: Optional[str] = None) -> List[dict]:
+        """``node_name`` maps to the apiserver's
+        ``fieldSelector=spec.nodeName=<node>`` — the node agent's pending
+        -pod scan is O(pods-on-node), not O(cluster) (improves on the
+        reference's full LIST per Allocate, util.go:49–74)."""
         raise NotImplementedError
 
     def list_pods_with_rv(self) -> "tuple[List[dict], str]":

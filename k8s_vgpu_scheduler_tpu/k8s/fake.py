@@ -106,12 +106,15 @@ class FakeKube(KubeClient):
             fn("ADDED", p)
 
     # -- KubeClient -----------------------------------------------------------
-    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+    def list_pods(self, namespace: Optional[str] = None,
+                  node_name: Optional[str] = None) -> List[dict]:
         with self._lock:
             pods = [
                 copy.deepcopy(p)
                 for k, p in self._pods.items()
-                if namespace is None or k.split("/", 1)[0] == namespace
+                if (namespace is None or k.split("/", 1)[0] == namespace)
+                and (node_name is None
+                     or p.get("spec", {}).get("nodeName") == node_name)
             ]
         return pods
 

@@ -13,6 +13,7 @@ import logging
 import os
 import ssl
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -87,10 +88,14 @@ class RestKube(KubeClient):
         return json.loads(payload) if payload else {}
 
     # -- pods -----------------------------------------------------------------
-    def list_pods(self, namespace: Optional[str] = None) -> List[dict]:
+    def list_pods(self, namespace: Optional[str] = None,
+                  node_name: Optional[str] = None) -> List[dict]:
         path = (
             f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
         )
+        if node_name is not None:   # '' filters too — same rule as FakeKube
+            path += "?fieldSelector=" + urllib.parse.quote(
+                f"spec.nodeName={node_name}")
         return self._request("GET", path).get("items", [])
 
     def list_pods_with_rv(self) -> "tuple[List[dict], str]":

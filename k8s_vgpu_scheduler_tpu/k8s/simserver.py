@@ -38,6 +38,24 @@ _POD_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods(?:/([^/]+))?(/binding)?$
 _NODE_RE = re.compile(r"^/api/v1/nodes(?:/([^/]+))?$")
 
 
+def _apply_field_selector(items: list, query: dict) -> list:
+    """The subset of apiserver fieldSelector semantics the node agent
+    uses: ``spec.nodeName=<node>`` (kubelet-style node-scoped LISTs).
+    Unknown selectors are rejected loudly rather than silently ignored —
+    a filter that doesn't filter would hand every pod to a caller that
+    believes it asked for one node's."""
+    sel = (query.get("fieldSelector") or [""])[0]
+    if not sel:
+        return items
+    field, _, want = sel.partition("=")
+    if field != "spec.nodeName" or "," in want or "=" in want:
+        # Compound/unknown selectors included: a mis-parsed value that
+        # silently returns [] is as wrong as an ignored filter.
+        raise ValueError(f"unsupported fieldSelector {sel!r}")
+    return [p for p in items
+            if p.get("spec", {}).get("nodeName") == want]
+
+
 class _Handler(BaseHTTPRequestHandler):
     kube: FakeKube
 
@@ -119,9 +137,16 @@ class _Handler(BaseHTTPRequestHandler):
 
         if path == "/api/v1/pods" and method == "GET":
             if (query.get("watch") or ["false"])[0] in ("true", "1"):
+                if query.get("fieldSelector"):
+                    # The watch stream doesn't filter; accepting the
+                    # selector would hand a node-scoped subscriber the
+                    # whole cluster's events.
+                    raise ValueError(
+                        "fieldSelector is not supported on watch")
                 self._watch_pods(query)
                 return
             items, rv = self.kube.list_pods_with_rv()
+            items = _apply_field_selector(items, query)
             self._reply(200, {"kind": "PodList",
                               "metadata": {"resourceVersion": rv},
                               "items": items})
@@ -135,7 +160,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self.kube.bind_pod(ns, name, body.get("target", {}).get("name", ""))
                 self._reply(201, {"kind": "Status", "status": "Success"})
             elif name is None and method == "GET":
-                self._reply(200, {"kind": "PodList", "items": self.kube.list_pods(ns)})
+                self._reply(200, {"kind": "PodList", "items":
+                                  _apply_field_selector(
+                                      self.kube.list_pods(ns), query)})
             elif name is None and method == "POST":
                 pod = self._body()
                 pod.setdefault("metadata", {}).setdefault("namespace", ns)

@@ -45,9 +45,14 @@ def get_pending_pod(client: KubeClient, node: str) -> Optional[dict]:
 
     Reference GetPendingPod (util.go:49–74): LIST all pods, match
     bind-time present + bind-phase==allocating + assigned-node==node.
-    The node lock guarantees at most one such pod per node.
+    The node lock guarantees at most one such pod per node.  Unlike the
+    reference, the LIST is node-scoped (fieldSelector spec.nodeName) —
+    Allocate is O(pods-on-node), not O(cluster); Bind has already
+    created the Binding by the time kubelet calls Allocate, so the
+    pending pod always carries its nodeName.  The annotation checks
+    below stay as the actual protocol match.
     """
-    for pod in client.list_pods():
+    for pod in client.list_pods(node_name=node):
         anns = pod.get("metadata", {}).get("annotations", {})
         if BIND_TIME_ANNOTATION not in anns:
             continue

@@ -66,7 +66,9 @@ def allocating_pod(backend_inv, mem=3000, cores=30, nchips=1, name="p1"):
                 TO_ALLOCATE_ANNOTATION: codec.encode_pod_devices([grant]),
             },
         },
-        "spec": {"containers": [{"name": "main"}]},
+        # Bind precedes Allocate: a pending pod always has its nodeName
+        # (get_pending_pod's node-scoped LIST relies on it).
+        "spec": {"containers": [{"name": "main"}], "nodeName": "node-a"},
     }
 
 

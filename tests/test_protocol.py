@@ -36,7 +36,10 @@ def make_pod(name="p1", node="node-a", to_allocate=""):
                 TO_ALLOCATE_ANNOTATION: to_allocate,
             },
         },
-        "spec": {"containers": []},
+        # Bind precedes Allocate in the protocol, so a pending pod always
+        # carries its nodeName (get_pending_pod's node-scoped LIST relies
+        # on it).
+        "spec": {"containers": [], "nodeName": node},
     }
 
 

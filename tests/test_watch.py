@@ -322,3 +322,41 @@ class TestResyncRaceGuards:
         assert "stale" not in (r2.error or ""), \
             "resync prune tombstoned a live gang member"
         assert "waiting" in r2.error
+
+
+class TestFieldSelector:
+    def test_node_scoped_list_over_the_wire(self, sim):
+        """RestKube's node_name arg becomes fieldSelector=spec.nodeName
+        and the simserver filters — the node agent's pending-pod scan is
+        O(pods-on-node), not O(cluster)."""
+        client = RestKube(sim.url)
+        for name, node in (("a", "node-a"), ("b", "node-b"), ("c", None)):
+            pod = tpu_pod(name=name, uid=f"u{name}")
+            sim.kube.create_pod(pod)
+            if node:
+                sim.kube.bind_pod("default", name, node)
+        assert {p["metadata"]["name"]
+                for p in client.list_pods(node_name="node-a")} == {"a"}
+        assert {p["metadata"]["name"]
+                for p in client.list_pods()} == {"a", "b", "c"}
+        # '' is a filter (matches nothing here), same rule as FakeKube.
+        assert client.list_pods(node_name="") == []
+
+    def test_unsupported_selectors_fail_loudly(self, sim):
+        """A filter that doesn't filter must not 200: compound selectors
+        and selectors on the watch path are rejected, not ignored."""
+        import urllib.error
+        import urllib.request
+
+        def get(q):
+            return urllib.request.urlopen(sim.url + "/api/v1/pods?" + q,
+                                          timeout=10)
+
+        for q in ("fieldSelector=spec.nodeName%3Da,status.phase%3DRunning",
+                  "fieldSelector=metadata.name%3Dx",
+                  "watch=true&fieldSelector=spec.nodeName%3Da"):
+            try:
+                get(q)
+                raise AssertionError(f"expected failure for {q}")
+            except urllib.error.HTTPError as e:
+                assert e.code >= 400
