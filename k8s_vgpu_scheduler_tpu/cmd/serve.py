@@ -449,9 +449,11 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     args = parse_args(argv)
     host, _, port = args.bind.rpartition(":")
-    if not port.isdigit():
+    if not port.isdigit() or ":" in host:
+        # ":" in host = bare/bracketed IPv6 — the server is IPv4/hostname
+        # only; reject rather than bind somewhere surprising.
         raise SystemExit(
-            f"--bind must be host:port or :port, got {args.bind!r}")
+            f"--bind must be IPv4-host:port or :port, got {args.bind!r}")
     frontend = EngineFrontend(build_engine(args))
     server = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
                                  make_handler(frontend,

@@ -108,6 +108,8 @@ class FakeKube(KubeClient):
     # -- KubeClient -----------------------------------------------------------
     def list_pods(self, namespace: Optional[str] = None,
                   node_name: Optional[str] = None) -> List[dict]:
+        if node_name == "":     # same loud rule as RestKube
+            raise ValueError("node_name must be non-empty")
         with self._lock:
             pods = [
                 copy.deepcopy(p)

@@ -93,7 +93,14 @@ class RestKube(KubeClient):
         path = (
             f"/api/v1/namespaces/{namespace}/pods" if namespace else "/api/v1/pods"
         )
-        if node_name is not None:   # '' filters too — same rule as FakeKube
+        if node_name is not None:
+            # '' is refused, not passed through: a real apiserver would
+            # interpret spec.nodeName= as "all UNSCHEDULED pods" — the
+            # opposite of a node scope — while the fakes would match
+            # nothing.  A node agent with an empty node-name env is
+            # misconfigured; fail it loudly and identically everywhere.
+            if not node_name:
+                raise ValueError("node_name must be non-empty")
             path += "?fieldSelector=" + urllib.parse.quote(
                 f"spec.nodeName={node_name}")
         return self._request("GET", path).get("items", [])

@@ -84,6 +84,12 @@ class _Handler(BaseHTTPRequestHandler):
         except Gone as e:
             self._reply(410, {"kind": "Status", "reason": "Expired",
                               "message": str(e)})
+        except ValueError as e:
+            # Bad request shape (e.g. unsupported fieldSelector): the real
+            # apiserver's 400, and permanently invalid — retrying clients
+            # must not see a transient-looking 5xx.
+            self._reply(400, {"kind": "Status", "reason": "BadRequest",
+                              "message": str(e)})
         except BrokenPipeError:
             pass  # watcher hung up mid-stream
         except Exception as e:  # noqa: BLE001

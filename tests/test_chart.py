@@ -247,3 +247,38 @@ class TestGoTemplateEngine:
         out = self.eng().render(
             "labels:{{ toYaml .l | nindent 2 }}", {"l": {"a": "1"}})
         assert out == "labels:\n  a: '1'"
+
+
+class TestValuesSchema:
+    """values.schema.json: Helm enforces it natively at install/template
+    time; these tests keep it honest against the shipped defaults."""
+
+    def _schema(self):
+        import json
+        return json.loads(read(os.path.join(CHART, "values.schema.json")))
+
+    def test_default_values_validate(self):
+        import jsonschema
+        vals = yaml.safe_load(read(os.path.join(CHART, "values.yaml")))
+        jsonschema.validate(vals, self._schema())
+
+    def test_bad_values_rejected(self):
+        import jsonschema
+        schema = self._schema()
+        for path, bad in (
+                (("devicePlugin", "mode"), "sriov"),
+                (("devicePlugin", "deviceSplitCount"), 0),
+                (("devicePlugin", "partitionStrategy"), "mig"),
+                (("scheduler", "nodeSchedulerPolicy"), "random"),
+                (("scheduler", "service", "httpPort"), "https"),
+        ):
+            broken = yaml.safe_load(read(os.path.join(CHART, "values.yaml")))
+            cur = broken
+            for k in path[:-1]:
+                cur = cur[k]
+            cur[path[-1]] = bad
+            try:
+                jsonschema.validate(broken, schema)
+                raise AssertionError(f"schema accepted {path}={bad!r}")
+            except jsonschema.ValidationError:
+                pass

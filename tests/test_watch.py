@@ -339,12 +339,18 @@ class TestFieldSelector:
                 for p in client.list_pods(node_name="node-a")} == {"a"}
         assert {p["metadata"]["name"]
                 for p in client.list_pods()} == {"a", "b", "c"}
-        # '' is a filter (matches nothing here), same rule as FakeKube.
-        assert client.list_pods(node_name="") == []
+        # '' is refused everywhere: a real apiserver would read it as
+        # "all unscheduled pods" — the opposite of a node scope.
+        with pytest.raises(ValueError):
+            client.list_pods(node_name="")
+        from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+        with pytest.raises(ValueError):
+            FakeKube().list_pods(node_name="")
 
     def test_unsupported_selectors_fail_loudly(self, sim):
         """A filter that doesn't filter must not 200: compound selectors
-        and selectors on the watch path are rejected, not ignored."""
+        and selectors on the watch path are 400s (the real apiserver's
+        status class — permanently invalid, not retryable), not 5xx."""
         import urllib.error
         import urllib.request
 
@@ -359,4 +365,4 @@ class TestFieldSelector:
                 get(q)
                 raise AssertionError(f"expected failure for {q}")
             except urllib.error.HTTPError as e:
-                assert e.code >= 400
+                assert e.code == 400
