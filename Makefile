@@ -5,17 +5,18 @@
 
 all: native
 
+# Same lock as util/nativebuild.py: detached bench/scenario workers
+# build concurrently and an unserialized make would race the .o files.
 native:                       ## C++ enforcement layer → lib/tpu/build/
-	flock lib/tpu/.build.lock $(MAKE) -C lib/tpu  # same lock as
-	# util/nativebuild.py: detached bench/scenario workers build too
+	flock lib/tpu/.build.lock $(MAKE) -C lib/tpu
 
 test: native                  ## full suite on a virtual 8-device CPU mesh
 	python -m pytest tests/ -q
 
+# dryrun_multichip pins the CPU platform + device count itself,
+# appending to (not clobbering) any user-set XLA_FLAGS.
 dryrun:                       ## multi-chip sharding proof (all families)
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
-	# (dryrun_multichip pins the CPU platform + device count itself,
-	#  appending to — not clobbering — any user-set XLA_FLAGS)
 
 scenarios: native             ## capability proofs, degraded CPU mode
 	SCENARIO_FORCE_CPU=1 python benchmarks/scenarios.py all --strict
@@ -26,10 +27,10 @@ controlplane:                 ## scheduling-path perf artifact
 bench: native                 ## reference benchmark matrix (real chip)
 	python bench.py
 
+# --no-build-isolation: build with the environment's setuptools so
+# air-gapped hosts (like TPU build boxes) need no network; requires
+# setuptools>=68 present (plain `pip wheel .` works when online).
 wheel:                        ## pip-installable control plane
-	# --no-build-isolation: build with the environment's setuptools so
-	# air-gapped hosts (like TPU build boxes) need no network; requires
-	# setuptools>=68 present (plain `pip wheel .` works when online).
 	pip wheel --no-deps --no-build-isolation -w dist .
 
 clean:

@@ -60,7 +60,10 @@ class EngineFrontend:
         self._incoming = []          # (prompt, max_new, waiter)
         self._waiters = {}           # request_id -> waiter
         self._to_cancel = []         # waiters whose client gave up
+        self._submitting = []        # popped from _incoming, not yet in
+        #                              _waiters — drain() must see them
         self._stop = False
+        self._draining = False
         self._fatal: Optional[BaseException] = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-engine")
@@ -102,9 +105,29 @@ class EngineFrontend:
         with self._cv:
             if self._fatal is not None:
                 raise RuntimeError(f"engine failed: {self._fatal!r}")
+            if self._draining:
+                raise RuntimeError("server draining (terminating)")
             self._incoming.append((prompt, max_new_tokens, waiter))
             self._cv.notify()
         return waiter
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """k8s preStop/SIGTERM path: refuse new requests, let in-flight
+        generation finish.  True when the pool is fully idle; False when
+        the grace period expired with work still running (the kubelet's
+        SIGKILL will take it either way)."""
+        with self._cv:
+            self._draining = True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cv:
+                idle = (not self._incoming and not self._submitting
+                        and not self._waiters)
+            if idle and not self.engine.active.any() \
+                    and not self.engine.queue:
+                return True
+            time.sleep(0.1)
+        return False
 
     def stats(self) -> dict:
         eng = self.engine
@@ -156,6 +179,7 @@ class EngineFrontend:
                     return
                 batch = self._incoming
                 self._incoming = []
+                self._submitting = batch
                 cancels = self._to_cancel
                 self._to_cancel = []
             for prompt, max_new, waiter in batch:
@@ -167,6 +191,8 @@ class EngineFrontend:
                     self._waiters[rid] = waiter
                 except Exception as e:  # noqa: BLE001 — refuse, don't die
                     self._fail_one(waiter, e)
+            with self._cv:
+                self._submitting = []
             for w in cancels:
                 rid = w.get("rid")
                 if rid is not None and self._waiters.pop(rid, None) \
@@ -442,6 +468,10 @@ def parse_args(argv=None):
     p.add_argument("--top-p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--request-timeout", type=float, default=300.0)
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="SIGTERM: seconds to let in-flight generation "
+                        "finish before exiting (stay under the pod's "
+                        "terminationGracePeriodSeconds)")
     return p.parse_args(argv)
 
 
@@ -455,19 +485,47 @@ def main(argv=None):
         raise SystemExit(
             f"--bind must be IPv4-host:port or :port, got {args.bind!r}")
     frontend = EngineFrontend(build_engine(args))
-    server = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
-                                 make_handler(frontend,
-                                              args.request_timeout))
+
+    class _Server(ThreadingHTTPServer):
+        # Non-daemon handler threads + block_on_close: server_close()
+        # joins them, so the last response finishes writing before the
+        # process exits (a daemon handler mid-write would be killed at
+        # interpreter teardown and the client would see a reset).
+        daemon_threads = False
+
+    server = _Server((host or "0.0.0.0", int(port)),
+                     make_handler(frontend, args.request_timeout))
     log.info("serving on %s (slots=%d max_len=%d horizon=%d, pool=%d MiB)",
              args.bind, frontend.engine.S, frontend.engine.L,
              frontend.engine.horizon,
              frontend.engine.pool_hbm_bytes() // 2**20)
+
+    def _terminate(_sig, _frame):
+        # Signal handlers must not block: drain in a helper thread, then
+        # stop serve_forever.  New submits 503 immediately; k8s has
+        # already pulled the terminating pod from Service endpoints.
+        def _drain_and_stop():
+            clean = frontend.drain(args.drain_grace)
+            log.info("drain %s; shutting down",
+                     "complete" if clean else "grace expired")
+            server.shutdown()
+
+        threading.Thread(target=_drain_and_stop, daemon=True,
+                         name="drain").start()
+
+    import signal
+
+    signal.signal(signal.SIGTERM, _terminate)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        # Fail leftover waiters first so blocked handlers unblock, then
+        # join the handler threads (daemon_threads=False) so every
+        # response finishes writing.
         frontend.shutdown()
+        server.server_close()
 
 
 if __name__ == "__main__":

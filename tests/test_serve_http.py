@@ -231,3 +231,31 @@ def test_stream_disconnect_frees_slot(tiny_model):
     finally:
         httpd.shutdown()
         fe.shutdown()
+
+
+def test_drain_finishes_inflight_and_refuses_new(tiny_model):
+    """SIGTERM semantics at the frontend: in-flight generation completes
+    during drain; new submissions are refused with the draining error."""
+    import time
+
+    cfg, params = tiny_model
+    eng = ServingEngine(cfg, params, max_slots=1, max_len=64, horizon=1)
+    fe = EngineFrontend(eng)
+    try:
+        result = {}
+
+        def client():
+            result["c"] = fe.submit_and_wait([2, 3], 12, timeout=120)
+
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.monotonic() + 60
+        while not eng.active.any() and time.monotonic() < deadline:
+            time.sleep(0.02)           # wait until it's genuinely in-flight
+        assert fe.drain(timeout=120) is True
+        t.join(timeout=60)
+        assert len(result["c"].tokens) == 12     # finished, not dropped
+        with pytest.raises(RuntimeError, match="draining"):
+            fe.submit_and_wait([5], 4, timeout=10)
+    finally:
+        fe.shutdown()
