@@ -15,6 +15,7 @@ API (token ids in/out — tokenization is the application's concern):
   ``data: {"done": true, "finished_by": ...}``
 - ``GET /healthz``   liveness
 - ``GET /statsz``    engine stats, utilization, queue depth, pool bytes
+- ``GET /metrics``   the same as Prometheus exposition text
 - ``GET /profilez?seconds=N``  capture an XLA device trace of the live
   decode loop (tensorboard/xprof format); returns the trace directory
 
@@ -231,6 +232,36 @@ class EngineFrontend:
                     w["event"].set()
 
 
+def prometheus_text(stats: dict) -> str:
+    """The serving pod's Prometheus surface — the stack's fourth, next to
+    the extender (:9395), the node monitor (:9394) and vtpu-smi.  Plain
+    exposition text, no client dependency (the engine's counters are a
+    flat dict)."""
+    lines = []
+
+    def emit(name: str, kind: str, help_: str, value) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {value}")
+
+    for key, help_ in (
+            ("prefills", "Requests admitted into slots"),
+            ("decode_steps", "Decode steps executed"),
+            ("decode_dispatches", "Device dispatches (horizon steps each)"),
+            ("tokens_out", "Tokens generated"),
+            ("completions", "Requests completed"),
+            ("cancelled", "Requests cancelled (timeout/disconnect)")):
+        emit(f"vtpu_serve_{key}_total", "counter", help_,
+             stats["stats"].get(key, 0))
+    emit("vtpu_serve_slot_utilization", "gauge",
+         "Fraction of slots decoding", round(stats["utilization"], 4))
+    emit("vtpu_serve_queue_depth", "gauge",
+         "Requests waiting for a slot", stats["queue_depth"])
+    emit("vtpu_serve_pool_hbm_bytes", "gauge",
+         "KV-cache pool footprint", stats["pool_hbm_bytes"])
+    return "\n".join(lines) + "\n"
+
+
 _PROFILE_LOCK = threading.Lock()
 
 
@@ -291,10 +322,12 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
         def log_message(self, fmt, *args):  # route through logging
             log.debug("http: " + fmt, *args)
 
-        def _reply(self, code: int, obj: dict) -> None:
-            body = json.dumps(obj).encode()
+        def _reply(self, code: int, obj: dict = None, *,
+                   raw: bytes = b"",
+                   content_type: str = "application/json") -> None:
+            body = raw if obj is None else json.dumps(obj).encode()
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -308,6 +341,10 @@ def make_handler(frontend: EngineFrontend, request_timeout: float):
                                       "error": "engine thread down"})
             elif self.path == "/statsz":
                 self._reply(200, frontend.stats())
+            elif self.path == "/metrics":
+                self._reply(200,
+                            raw=prometheus_text(frontend.stats()).encode(),
+                            content_type="text/plain; version=0.0.4")
             elif self.path == "/profilez" or \
                     self.path.startswith("/profilez?"):
                 self._reply(*profile_capture(self.path))

@@ -259,3 +259,19 @@ def test_drain_finishes_inflight_and_refuses_new(tiny_model):
             fe.submit_and_wait([5], 4, timeout=10)
     finally:
         fe.shutdown()
+
+
+def test_metrics_exposition(server):
+    """/metrics renders valid Prometheus text the node stack can scrape,
+    consistent with /statsz."""
+    _, _, url = server
+    post(url, {"prompt": [8, 9], "max_new_tokens": 3})
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    from k8s_vgpu_scheduler_tpu.cmd.vtpu_smi import parse_prom
+    metrics = parse_prom(text)
+    assert metrics["vtpu_serve_completions_total"][0][1] >= 1
+    assert metrics["vtpu_serve_tokens_out_total"][0][1] >= 3
+    assert metrics["vtpu_serve_pool_hbm_bytes"][0][1] > 0
+    assert 0.0 <= metrics["vtpu_serve_slot_utilization"][0][1] <= 1.0
