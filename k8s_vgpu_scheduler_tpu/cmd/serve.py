@@ -234,32 +234,45 @@ class EngineFrontend:
 
 def prometheus_text(stats: dict) -> str:
     """The serving pod's Prometheus surface — the stack's fourth, next to
-    the extender (:9395), the node monitor (:9394) and vtpu-smi.  Plain
-    exposition text, no client dependency (the engine's counters are a
-    flat dict)."""
-    lines = []
+    the extender (:9395) and the node monitor (:9394), emitted through
+    the same prometheus_client the other two use (one exposition
+    mechanism to maintain, escaping handled by the library)."""
+    from prometheus_client import CollectorRegistry, generate_latest
+    from prometheus_client.core import (
+        CounterMetricFamily,
+        GaugeMetricFamily,
+    )
 
-    def emit(name: str, kind: str, help_: str, value) -> None:
-        lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} {kind}")
-        lines.append(f"{name} {value}")
+    class _Snapshot:
+        def collect(self):
+            for key, help_ in (
+                    ("prefills", "Requests admitted into slots"),
+                    ("decode_steps", "Decode steps executed"),
+                    ("decode_dispatches",
+                     "Device dispatches (horizon steps each)"),
+                    ("tokens_out", "Tokens generated"),
+                    ("completions", "Requests completed"),
+                    ("cancelled",
+                     "Requests cancelled (timeout/disconnect)")):
+                c = CounterMetricFamily(f"vtpu_serve_{key}", help_)
+                c.add_metric([], stats["stats"].get(key, 0))
+                yield c
+            for name, help_, value in (
+                    ("vtpu_serve_slot_utilization",
+                     "Fraction of slots decoding",
+                     stats["utilization"]),
+                    ("vtpu_serve_queue_depth",
+                     "Requests waiting for a slot", stats["queue_depth"]),
+                    ("vtpu_serve_pool_hbm_bytes",
+                     "KV-cache pool footprint",
+                     stats["pool_hbm_bytes"])):
+                g = GaugeMetricFamily(name, help_)
+                g.add_metric([], value)
+                yield g
 
-    for key, help_ in (
-            ("prefills", "Requests admitted into slots"),
-            ("decode_steps", "Decode steps executed"),
-            ("decode_dispatches", "Device dispatches (horizon steps each)"),
-            ("tokens_out", "Tokens generated"),
-            ("completions", "Requests completed"),
-            ("cancelled", "Requests cancelled (timeout/disconnect)")):
-        emit(f"vtpu_serve_{key}_total", "counter", help_,
-             stats["stats"].get(key, 0))
-    emit("vtpu_serve_slot_utilization", "gauge",
-         "Fraction of slots decoding", round(stats["utilization"], 4))
-    emit("vtpu_serve_queue_depth", "gauge",
-         "Requests waiting for a slot", stats["queue_depth"])
-    emit("vtpu_serve_pool_hbm_bytes", "gauge",
-         "KV-cache pool footprint", stats["pool_hbm_bytes"])
-    return "\n".join(lines) + "\n"
+    registry = CollectorRegistry()
+    registry.register(_Snapshot())
+    return generate_latest(registry).decode()
 
 
 _PROFILE_LOCK = threading.Lock()

@@ -1,0 +1,208 @@
+"""vtpu-simulate — capacity planning against the REAL scheduler.
+
+Answers "will this workload fit on that fleet?" without a cluster: a
+synthetic fleet of TPU nodes is registered with the actual Scheduler
+(same fit/score/topology code that runs in production — not a model of
+it), a workload spec is replayed through Filter/Bind, and the result is
+the placement map, per-chip utilization, and exactly which pods didn't
+fit and why.  The reference has no analog; its users discover capacity
+by watching pods pend (README.md:128: "the task will get stuck in
+pending").
+
+Workload spec (JSON):
+
+    {"pods": [
+       {"name": "train",  "count": 4, "tpu": 4, "tpumem": 8000,
+        "tpucores": 100},
+       {"name": "serve",  "count": 10, "tpu": 1, "tpumem": 3000,
+        "tpucores": 30},
+       {"name": "ring",   "count": 2,  "tpu": 8, "tpumem": 16384,
+        "gang": "ring"}
+     ]}
+
+``gang`` members are co-scheduled atomically through the gang manager,
+exactly as on a cluster.
+
+Usage:
+    vtpu-simulate --nodes 4 --chips 8 --hbm 16384 --mesh 4x2 \
+                  --workload workload.json [--policy binpack] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ..k8s import FakeKube
+from ..scheduler import DeviceInfo, NodeInfo, Scheduler
+from ..scheduler.gang import GANG_GROUP_ANNOTATION, GANG_TOTAL_ANNOTATION
+from ..tpulib import TopologyDesc
+from ..util import nodelock
+from ..util.config import Config
+
+
+def build_fleet(s: Scheduler, kube: FakeKube, nodes: int, chips: int,
+                hbm: int, mesh, generation: str) -> List[str]:
+    names = [f"sim-node-{i}" for i in range(nodes)]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        devices = [
+            DeviceInfo(id=f"{n}-chip-{i}", count=10, devmem=hbm,
+                       type=f"TPU-{generation}", health=True,
+                       coords=(i % mesh[0], i // mesh[0]))
+            for i in range(chips)
+        ]
+        s.nodes.add_node(n, NodeInfo(
+            name=n, devices=devices,
+            topology=TopologyDesc(generation=generation, mesh=mesh)))
+    return names
+
+
+def spec_pod(entry: dict, idx: int) -> dict:
+    name = f"{entry['name']}-{idx}"
+    limits = {"google.com/tpu": str(entry.get("tpu", 1))}
+    if "tpumem" in entry:
+        limits["google.com/tpumem"] = str(entry["tpumem"])
+    if "tpumem-percentage" in entry:
+        limits["google.com/tpumem-percentage"] = str(
+            entry["tpumem-percentage"])
+    if "tpucores" in entry:
+        limits["google.com/tpucores"] = str(entry["tpucores"])
+    anns = {}
+    if entry.get("gang"):
+        anns[GANG_GROUP_ANNOTATION] = entry["gang"]
+        anns[GANG_TOTAL_ANNOTATION] = str(entry.get("count", 1))
+    return {
+        "metadata": {"name": name, "namespace": "sim", "uid": f"uid-{name}",
+                     "annotations": anns},
+        "spec": {"containers": [{"name": "main",
+                                 "resources": {"limits": limits}}]},
+    }
+
+
+def run_simulation(workload: dict, *, nodes: int, chips: int, hbm: int,
+                   mesh, generation: str = "v5e",
+                   policy: str = "spread") -> dict:
+    kube = FakeKube()
+    s = Scheduler(kube, Config(node_scheduler_policy=policy))
+    names = build_fleet(s, kube, nodes, chips, hbm, mesh, generation)
+    kube.watch_pods(s.on_pod_event)
+
+    placed, pending = [], []
+    pods = []
+    for entry in workload.get("pods", []):
+        for i in range(int(entry.get("count", 1))):
+            pods.append((entry, spec_pod(entry, i)))
+
+    # Create every pod up front (a gang member must stay registered while
+    # its peers arrive), then replay Filter with one retry pass — the way
+    # kube-scheduler re-queues unschedulable pods.  Two passes suffice:
+    # the second resolves members whose gang reached quorum on the first.
+    for _, pod in pods:
+        kube.create_pod(pod)
+    queue = [(e, p, "") for e, p in pods]
+    for _ in range(2):
+        retry = []
+        for entry, pod, _err in queue:
+            r = s.filter(pod, names)
+            name = pod["metadata"]["name"]
+            if r.node:
+                s.bind("sim", name, pod["metadata"]["uid"], r.node)
+                nodelock.release_node(kube, r.node)
+                placed.append({"pod": name, "node": r.node,
+                               "chips": [
+                                   {"uuid": d.uuid, "mem_mib": d.usedmem,
+                                    "cores": d.usedcores}
+                                   for c in (s.pods.get(
+                                       pod["metadata"]["uid"]).devices or [])
+                                   for d in c]})
+            else:
+                retry.append((entry, pod, r.error or "no fit"))
+        queue = retry
+        if not queue:
+            break
+    for _, pod, err in queue:
+        pending.append({"pod": pod["metadata"]["name"], "reason": err})
+
+    usage = s.inspect_all_nodes_usage()
+    chips_out = {}
+    total_mem = used_mem = 0
+    for node, per_chip in usage.items():
+        for u in per_chip.values():
+            chips_out[f"{node}/{u.id}"] = {
+                "mem_mib": [u.used_mem, u.total_mem],
+                "cores_pct": u.used_cores,
+                "sharers": u.used_slots,
+            }
+            total_mem += u.total_mem
+            used_mem += u.used_mem
+    return {
+        "fleet": {"nodes": nodes, "chips_per_node": chips, "hbm_mib": hbm,
+                  "mesh": list(mesh), "policy": policy},
+        "placed": placed,
+        "pending": pending,
+        "chips": chips_out,
+        "hbm_allocated_fraction": round(used_mem / total_mem, 4)
+        if total_mem else 0.0,
+        "fits": not pending,
+    }
+
+
+def format_report(result: dict) -> str:
+    lines = [
+        "fleet: {nodes} nodes × {chips_per_node} chips × {hbm_mib} MiB "
+        "(mesh {mesh}, {policy})".format(**result["fleet"]),
+        f"placed {len(result['placed'])} pod(s); "
+        f"HBM allocated {result['hbm_allocated_fraction']:.0%}",
+    ]
+    for p in result["placed"]:
+        grants = ", ".join(f"{c['uuid']}({c['mem_mib']}MiB/{c['cores']}%)"
+                           for c in p["chips"][:4])
+        more = "…" if len(p["chips"]) > 4 else ""
+        lines.append(f"  {p['pod']:<24s} → {p['node']}: {grants}{more}")
+    if result["pending"]:
+        lines.append(f"UNSCHEDULABLE: {len(result['pending'])} pod(s)")
+        for p in result["pending"]:
+            lines.append(f"  {p['pod']:<24s} {p['reason']}")
+    else:
+        lines.append("workload fits.")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("vtpu-simulate")
+    p.add_argument("--workload", required=True,
+                   help="workload spec JSON (see module docstring)")
+    p.add_argument("--nodes", type=int, default=1)
+    p.add_argument("--chips", type=int, default=8)
+    p.add_argument("--hbm", type=int, default=16384, help="MiB per chip")
+    p.add_argument("--mesh", default="4x2",
+                   help="ICI mesh per node, e.g. 4x2")
+    p.add_argument("--generation", default="v5e")
+    p.add_argument("--policy", choices=["spread", "binpack"],
+                   default="spread")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+
+    try:
+        mesh = tuple(int(x) for x in args.mesh.lower().split("x"))
+        with open(args.workload) as f:
+            workload = json.load(f)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"vtpu-simulate: {e}", file=sys.stderr)
+        return 2
+    result = run_simulation(workload, nodes=args.nodes, chips=args.chips,
+                            hbm=args.hbm, mesh=mesh,
+                            generation=args.generation, policy=args.policy)
+    try:
+        print(json.dumps(result, indent=1) if args.as_json
+              else format_report(result))
+    except BrokenPipeError:     # `vtpu-simulate ... | head` is fine
+        pass
+    return 0 if result["fits"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""vtpu-simulate: capacity planning through the real scheduler."""
+
+import json
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.cmd.simulate import main, run_simulation
+
+WORKLOAD = {"pods": [
+    {"name": "train", "count": 1, "tpu": 4, "tpumem": 8000,
+     "tpucores": 100},
+    {"name": "serve", "count": 10, "tpu": 1, "tpumem": 3000,
+     "tpucores": 30},
+    {"name": "ring", "count": 2, "tpu": 8, "tpumem": 16384,
+     "gang": "ring"},
+]}
+
+
+def test_policy_decides_gang_fit():
+    """The simulator exposes real scheduler behavior: under spread the
+    fractional pods fragment the fleet and the full-node gang cannot
+    place; under binpack everything fits — exactly the trade the
+    --node-scheduler-policy knob exists for."""
+    spread = run_simulation(WORKLOAD, nodes=4, chips=8, hbm=16384,
+                            mesh=(4, 2), policy="spread")
+    assert not spread["fits"]
+    assert {p["pod"] for p in spread["pending"]} == {"ring-0", "ring-1"}
+    assert all("atomic placement" in p["reason"]
+               for p in spread["pending"])
+
+    packed = run_simulation(WORKLOAD, nodes=4, chips=8, hbm=16384,
+                            mesh=(4, 2), policy="binpack")
+    assert packed["fits"]
+    # The gang members landed on DIFFERENT whole nodes.
+    ring_nodes = {p["node"] for p in packed["placed"]
+                  if p["pod"].startswith("ring-")}
+    assert len(ring_nodes) == 2
+    for p in packed["placed"]:
+        if p["pod"].startswith("ring-"):
+            assert len(p["chips"]) == 8
+
+
+def test_capacity_invariant_and_usage_accounting():
+    r = run_simulation(WORKLOAD, nodes=4, chips=8, hbm=16384,
+                       mesh=(4, 2), policy="binpack")
+    for key, c in r["chips"].items():
+        used, total = c["mem_mib"]
+        assert used <= total, f"{key} over-booked: {used}>{total}"
+    # 1*4*8000 + 10*3000 + 2*8*16384 MiB over 4*8*16384.
+    want = (32000 + 30000 + 262144) / 524288
+    assert abs(r["hbm_allocated_fraction"] - want) < 0.01
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    wl = tmp_path / "wl.json"
+    wl.write_text(json.dumps(
+        {"pods": [{"name": "big", "tpu": 9, "tpumem": 16384}]}))
+    rc = main(["--workload", str(wl), "--nodes", "1", "--chips", "8",
+               "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and not out["fits"]
+    assert out["pending"][0]["pod"] == "big-0"
+
+    wl.write_text(json.dumps(
+        {"pods": [{"name": "ok", "tpu": 1, "tpumem": 1000}]}))
+    rc = main(["--workload", str(wl), "--nodes", "1", "--chips", "8"])
+    assert rc == 0
+    assert "workload fits" in capsys.readouterr().out
+
+    assert main(["--workload", str(tmp_path / "absent.json")]) == 2
+    assert main(["--workload", str(wl), "--mesh", "weird"]) == 2
+
+
+def test_percentage_requests_supported():
+    r = run_simulation(
+        {"pods": [{"name": "half", "count": 2, "tpu": 1,
+                   "tpumem-percentage": 50}]},
+        nodes=1, chips=1, hbm=16384, mesh=(1, 1))
+    assert r["fits"]
+    assert r["hbm_allocated_fraction"] == pytest.approx(1.0, abs=0.01)
