@@ -119,11 +119,7 @@ def test_grafana_dashboard_uses_real_metric_names():
         referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
     referenced -= {"rate", "label_values", "node"}  # promql, not metrics
 
-    emitted = set(re.findall(r'MetricFamily\(\s*"([a-z0-9_]+)"',
-                             _sources()))
-    # prometheus_client renders counters with a _total suffix.
-    emitted |= {f"{m}_total" for m in emitted}
-    missing = referenced - emitted
+    missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
 
 
@@ -134,3 +130,36 @@ def _sources() -> str:
         with open(os.path.join(REPO, rel)) as f:
             out.append(f.read())
     return "\n".join(out)
+
+
+def _emitted_metrics() -> set:
+    """Names exactly as Prometheus renders them: counters ONLY as
+    name_total (the bare counter name never appears in exposition, so
+    accepting it would let a never-firing alert/panel pass), gauges as
+    declared."""
+    src = _sources()
+    counters = set(re.findall(r'CounterMetricFamily\(\s*"([a-z0-9_]+)"',
+                              src))
+    gauges = set(re.findall(r'GaugeMetricFamily\(\s*"([a-z0-9_]+)"', src))
+    return gauges | {f"{c}_total" for c in counters}
+
+
+def test_alert_rules_use_real_metric_names():
+    """Every metric in charts/vtpu/dashboards/vtpu-alerts.yaml exists in
+    a collector — an alert on a typo'd metric silently never fires."""
+    import yaml
+
+    with open(os.path.join(REPO, "charts", "vtpu", "dashboards",
+                           "vtpu-alerts.yaml")) as f:
+        doc = yaml.safe_load(f)
+    rules = [r for g in doc["groups"] for r in g["rules"]]
+    assert len(rules) >= 5
+    referenced = set()
+    for r in rules:
+        referenced |= set(re.findall(r"[a-z][a-z0-9_]{3,}", r["expr"]))
+        assert r["alert"] and r["annotations"]["summary"]
+    # promql fns + the scrape-level `up` series' label matcher, whose
+    # hyphenated job name tokenizes as "vtpu"/"monitor".
+    referenced -= {"rate", "absent", "clamp_min", "vtpu", "monitor"}
+    missing = referenced - _emitted_metrics()
+    assert not missing, f"alerts reference unknown metrics: {missing}"
