@@ -26,6 +26,9 @@ exactly as on a cluster.
 Usage:
     vtpu-simulate --nodes 4 --chips 8 --hbm 16384 --mesh 4x2 \
                   --workload workload.json [--policy binpack] [--json]
+    vtpu-simulate --workload workload.json --from-cluster http://sched:443
+                  # live fleet: the extender's /fleetz snapshot, existing
+                  # grants included — answers for the REMAINING capacity
 """
 
 from __future__ import annotations
@@ -38,9 +41,11 @@ from typing import List, Optional
 from ..k8s import FakeKube
 from ..scheduler import DeviceInfo, NodeInfo, Scheduler
 from ..scheduler.gang import GANG_GROUP_ANNOTATION, GANG_TOTAL_ANNOTATION
+from ..scheduler.pods import PodInfo
 from ..tpulib import TopologyDesc
 from ..util import nodelock
 from ..util.config import Config
+from ..util.types import ContainerDevice
 
 
 def build_fleet(s: Scheduler, kube: FakeKube, nodes: int, chips: int,
@@ -57,6 +62,43 @@ def build_fleet(s: Scheduler, kube: FakeKube, nodes: int, chips: int,
         s.nodes.add_node(n, NodeInfo(
             name=n, devices=devices,
             topology=TopologyDesc(generation=generation, mesh=mesh)))
+    return names
+
+
+def build_fleet_from_export(s: Scheduler, kube: FakeKube,
+                            export: dict) -> List[str]:
+    """Reconstruct a LIVE scheduler's exact state from its ``/fleetz``
+    snapshot: inventory with real topology, plus every existing grant —
+    so the replay answers "will this fit right NOW", not on an empty
+    fleet."""
+    names = []
+    for n in export.get("nodes", []):
+        kube.add_node({"metadata": {"name": n["name"], "annotations": {}}})
+        devices = [
+            DeviceInfo(id=c["id"], count=c["count"], devmem=c["devmem"],
+                       type=c["type"], health=c["health"],
+                       coords=tuple(c["coords"]),
+                       cores=c.get("cores", 100))
+            for c in n["chips"]
+        ]
+        topo = None
+        if n.get("mesh"):
+            topo = TopologyDesc(generation=n.get("generation") or "",
+                                mesh=tuple(n["mesh"]),
+                                wraparound=tuple(
+                                    n.get("wraparound") or ()))
+        s.nodes.add_node(n["name"], NodeInfo(
+            name=n["name"], devices=devices, topology=topo))
+        names.append(n["name"])
+    for p in export.get("pods", []):
+        s.pods.add_pod(PodInfo(
+            uid=p["uid"], name=p["name"], namespace=p["namespace"],
+            node=p["node"], priority=p.get("priority", 0),
+            devices=[[ContainerDevice(uuid=d["uuid"], type=d["type"],
+                                      usedmem=d["usedmem"],
+                                      usedcores=d["usedcores"])
+                      for d in container]
+                     for container in p.get("devices", [])]))
     return names
 
 
@@ -82,12 +124,23 @@ def spec_pod(entry: dict, idx: int) -> dict:
     }
 
 
-def run_simulation(workload: dict, *, nodes: int, chips: int, hbm: int,
-                   mesh, generation: str = "v5e",
-                   policy: str = "spread") -> dict:
+def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
+                   hbm: int = 0, mesh=(1, 1), generation: str = "v5e",
+                   policy: Optional[str] = None,
+                   fleet_export: Optional[dict] = None) -> dict:
+    # Policy resolution: explicit caller choice > the LIVE scheduler's
+    # own config (a replay under different policies answers a different
+    # question) > the spread default.
+    live_cfg = (fleet_export or {}).get("config", {})
+    policy = policy or live_cfg.get("node_scheduler_policy") or "spread"
+    topology_policy = live_cfg.get("topology_policy", "best-effort")
     kube = FakeKube()
-    s = Scheduler(kube, Config(node_scheduler_policy=policy))
-    names = build_fleet(s, kube, nodes, chips, hbm, mesh, generation)
+    s = Scheduler(kube, Config(node_scheduler_policy=policy,
+                               topology_policy=topology_policy))
+    if fleet_export is not None:
+        names = build_fleet_from_export(s, kube, fleet_export)
+    else:
+        names = build_fleet(s, kube, nodes, chips, hbm, mesh, generation)
     kube.watch_pods(s.on_pod_event)
 
     placed, pending = [], []
@@ -139,8 +192,13 @@ def run_simulation(workload: dict, *, nodes: int, chips: int, hbm: int,
             total_mem += u.total_mem
             used_mem += u.used_mem
     return {
-        "fleet": {"nodes": nodes, "chips_per_node": chips, "hbm_mib": hbm,
-                  "mesh": list(mesh), "policy": policy},
+        "fleet": (
+            {"nodes": len(names), "source": "live /fleetz snapshot",
+             "existing_pods": len(fleet_export.get("pods", [])),
+             "policy": policy}
+            if fleet_export is not None else
+            {"nodes": nodes, "chips_per_node": chips, "hbm_mib": hbm,
+             "mesh": list(mesh), "policy": policy}),
         "placed": placed,
         "pending": pending,
         "chips": chips_out,
@@ -151,9 +209,15 @@ def run_simulation(workload: dict, *, nodes: int, chips: int, hbm: int,
 
 
 def format_report(result: dict) -> str:
+    f = result["fleet"]
+    if "source" in f:
+        head = ("fleet: {nodes} node(s) from {source}, "
+                "{existing_pods} existing pod(s) ({policy})".format(**f))
+    else:
+        head = ("fleet: {nodes} nodes × {chips_per_node} chips × "
+                "{hbm_mib} MiB (mesh {mesh}, {policy})".format(**f))
     lines = [
-        "fleet: {nodes} nodes × {chips_per_node} chips × {hbm_mib} MiB "
-        "(mesh {mesh}, {policy})".format(**result["fleet"]),
+        head,
         f"placed {len(result['placed'])} pod(s); "
         f"HBM allocated {result['hbm_allocated_fraction']:.0%}",
     ]
@@ -175,6 +239,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser("vtpu-simulate")
     p.add_argument("--workload", required=True,
                    help="workload spec JSON (see module docstring)")
+    p.add_argument("--from-cluster", default="", metavar="URL",
+                   help="plan against a LIVE fleet: fetch the extender's "
+                        "GET /fleetz snapshot (inventory + topology + "
+                        "existing grants) instead of --nodes/--chips/...")
     p.add_argument("--nodes", type=int, default=1)
     p.add_argument("--chips", type=int, default=8)
     p.add_argument("--hbm", type=int, default=16384, help="MiB per chip")
@@ -182,7 +250,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="ICI mesh per node, e.g. 4x2")
     p.add_argument("--generation", default="v5e")
     p.add_argument("--policy", choices=["spread", "binpack"],
-                   default="spread")
+                   default=None,
+                   help="default: the live cluster's own policy with "
+                        "--from-cluster, else spread")
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
 
@@ -190,12 +260,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         mesh = tuple(int(x) for x in args.mesh.lower().split("x"))
         with open(args.workload) as f:
             workload = json.load(f)
+        export = None
+        if args.from_cluster:
+            import urllib.request
+
+            url = args.from_cluster.rstrip("/")
+            if "://" not in url:
+                url = "http://" + url
+            if not url.endswith("/fleetz"):
+                url += "/fleetz"
+            with urllib.request.urlopen(url, timeout=15) as r:
+                export = json.load(r)
     except (ValueError, OSError, json.JSONDecodeError) as e:
         print(f"vtpu-simulate: {e}", file=sys.stderr)
         return 2
     result = run_simulation(workload, nodes=args.nodes, chips=args.chips,
                             hbm=args.hbm, mesh=mesh,
-                            generation=args.generation, policy=args.policy)
+                            generation=args.generation, policy=args.policy,
+                            fleet_export=export)
     try:
         print(json.dumps(result, indent=1) if args.as_json
               else format_report(result))

@@ -344,6 +344,57 @@ class Scheduler:
                 n: dict(usage) for n, (info, usage) in self.get_nodes_usage().items()
             }
 
+    def export_fleet(self) -> dict:
+        """Read-only fleet snapshot for capacity tooling (``GET /fleetz``
+        → ``vtpu-simulate --from-cluster``): node inventory INCLUDING ICI
+        topology plus every live grant, one consistent copy under the
+        filter lock — enough to reconstruct this scheduler's exact
+        placement state elsewhere."""
+        with self._filter_lock:
+            nodes = [
+                {
+                    "name": name,
+                    # topology is Optional (a registration may omit it);
+                    # export None rather than crash the endpoint.
+                    "generation": (info.topology.generation
+                                   if info.topology else None),
+                    "mesh": (list(info.topology.mesh)
+                             if info.topology else None),
+                    "wraparound": (list(info.topology.wraparound)
+                                   if info.topology else None),
+                    "chips": [
+                        {"id": d.id, "type": d.type, "count": d.count,
+                         "devmem": d.devmem, "health": d.health,
+                         "coords": list(d.coords), "cores": d.cores}
+                        for d in info.devices
+                    ],
+                }
+                for name, info in self.nodes.list_nodes().items()
+            ]
+            pods = [
+                {
+                    "uid": p.uid, "name": p.name, "namespace": p.namespace,
+                    "node": p.node, "priority": p.priority,
+                    "devices": [
+                        [{"uuid": d.uuid, "type": d.type,
+                          "usedmem": d.usedmem, "usedcores": d.usedcores}
+                         for d in container]
+                        for container in p.devices
+                    ],
+                }
+                for p in self.pods.list_pods()
+            ]
+        return {
+            "nodes": nodes,
+            "pods": pods,
+            # The live scheduler's placement-relevant config: a replay
+            # under different policies would answer a different question.
+            "config": {
+                "node_scheduler_policy": self.cfg.node_scheduler_policy,
+                "topology_policy": self.cfg.topology_policy,
+            },
+        }
+
     # -- Filter ----------------------------------------------------------------
     def filter(self, pod: dict, node_names: List[str]) -> FilterResult:
         """Decide under the in-memory lock; talk to the apiserver outside it

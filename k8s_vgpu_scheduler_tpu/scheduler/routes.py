@@ -8,6 +8,8 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
                     → ExtenderBindingResult{Error}
 - ``POST /webhook`` AdmissionReview v1
 - ``GET  /healthz``
+- ``GET  /fleetz``  read-only fleet snapshot (inventory + topology +
+                    live grants) for ``vtpu-simulate --from-cluster``
 """
 
 from __future__ import annotations
@@ -88,6 +90,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         if self.path == "/healthz":
             self._reply(200, {"ok": True})
+        elif self.path == "/fleetz":
+            # Read-only fleet snapshot (nodes + topology + live grants)
+            # for vtpu-simulate --from-cluster capacity planning.
+            try:
+                self._reply(200, self.scheduler.export_fleet())
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("fleetz export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         elif self.path.startswith("/debug/") and self.cfg.enable_debug:
             from urllib.parse import parse_qsl, urlsplit
 

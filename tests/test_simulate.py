@@ -78,3 +78,57 @@ def test_percentage_requests_supported():
         nodes=1, chips=1, hbm=16384, mesh=(1, 1))
     assert r["fits"]
     assert r["hbm_allocated_fraction"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_from_cluster_plans_against_live_state():
+    """End-to-end live planning: a running extender's /fleetz snapshot
+    (real HTTP) reconstructs its exact placement state — existing grants
+    included — and the replay answers for the REMAINING capacity."""
+    import urllib.request
+
+    from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+    from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+    from k8s_vgpu_scheduler_tpu.scheduler.routes import ExtenderServer
+    from k8s_vgpu_scheduler_tpu.util.config import Config
+    from tests.test_scheduler_core import register_node, tpu_pod
+
+    kube = FakeKube()
+    kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+    s = Scheduler(kube, Config(node_scheduler_policy="binpack",
+                               topology_policy="restricted"))
+    register_node(s, "node-a", chips=2, devmem=16384, mesh=(2, 1))
+    kube.watch_pods(s.on_pod_event)
+    # One live grant: 10000 MiB on some chip.
+    pod = tpu_pod(name="live", uid="ulive", mem="10000")
+    kube.create_pod(pod)
+    assert s.filter(pod, ["node-a"]).node == "node-a"
+
+    srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/fleetz", timeout=15) as r:
+            export = json.load(r)
+    finally:
+        srv.stop()
+    assert len(export["nodes"]) == 1 and len(export["pods"]) == 1
+    assert export["nodes"][0]["mesh"] == [2, 1]
+    assert export["nodes"][0]["chips"][0]["cores"] == 100
+    # The live scheduler's placement config rides the snapshot so the
+    # replay answers under the SAME policies.
+    assert export["config"] == {"node_scheduler_policy": "binpack",
+                                "topology_policy": "restricted"}
+
+    # Remaining: 6384 MiB on the granted chip, 16384 on the other.
+    fits = run_simulation(
+        {"pods": [{"name": "a", "tpu": 1, "tpumem": 16384},
+                  {"name": "b", "tpu": 1, "tpumem": 6000}]},
+        fleet_export=export)
+    assert fits["fits"], fits["pending"]
+    toobig = run_simulation(
+        {"pods": [{"name": "a", "tpu": 1, "tpumem": 16384},
+                  {"name": "b", "tpu": 1, "tpumem": 7000}]},
+        fleet_export=export)
+    assert not toobig["fits"]
+    assert toobig["fleet"]["source"] == "live /fleetz snapshot"
+    assert toobig["fleet"]["existing_pods"] == 1

@@ -138,8 +138,9 @@ def test_options_thread_through_init_sharded_state():
     p0 = _flat(state.params)
     state, loss1 = step(state, tokens)
     p1 = _flat(state.params)
-    np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1)), \
-        "params moved on an accumulation micro-step"
+    np.testing.assert_array_equal(
+        np.asarray(p0), np.asarray(p1),
+        err_msg="params moved on an accumulation micro-step")
     state, loss2 = step(state, tokens)
     assert not np.array_equal(np.asarray(p1),
                               np.asarray(_flat(state.params))), \
