@@ -132,3 +132,37 @@ def test_from_cluster_plans_against_live_state():
     assert not toobig["fits"]
     assert toobig["fleet"]["source"] == "live /fleetz snapshot"
     assert toobig["fleet"]["existing_pods"] == 1
+
+
+def test_random_workloads_never_overbook():
+    """Property: whatever the workload mix, the replay never over-books a
+    chip (same invariant the churn tests pin on the live scheduler)."""
+    from hypothesis import given, settings, strategies as st
+
+    pod_st = st.fixed_dictionaries({
+        "name": st.sampled_from(["a", "b", "c", "d"]),
+        "count": st.integers(1, 4),
+        "tpu": st.integers(1, 9),
+        "tpumem": st.sampled_from([1000, 3000, 8000, 16384, 20000]),
+        "tpucores": st.sampled_from([0, 30, 50, 100]),
+    })
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.lists(pod_st, min_size=1, max_size=5),
+           st.sampled_from(["spread", "binpack"]))
+    def run(pods, policy):
+        # Distinct names per entry: duplicate sampled names collide in
+        # pod uids otherwise.
+        for i, p in enumerate(pods):
+            p["name"] = f"{p['name']}{i}"
+        r = run_simulation({"pods": pods}, nodes=2, chips=4, hbm=16384,
+                           mesh=(2, 2), policy=policy)
+        for key, c in r["chips"].items():
+            used, total = c["mem_mib"]
+            assert used <= total, f"{key} over-booked under {policy}"
+            assert c["cores_pct"] <= 100
+        # Accounting consistency: placed+pending covers the workload.
+        assert len(r["placed"]) + len(r["pending"]) == \
+            sum(p["count"] for p in pods)
+
+    run()
