@@ -140,7 +140,6 @@ def test_random_workloads_never_overbook():
     from hypothesis import given, settings, strategies as st
 
     pod_st = st.fixed_dictionaries({
-        "name": st.sampled_from(["a", "b", "c", "d"]),
         "count": st.integers(1, 4),
         "tpu": st.integers(1, 9),
         "tpumem": st.sampled_from([1000, 3000, 8000, 16384, 20000]),
@@ -151,10 +150,9 @@ def test_random_workloads_never_overbook():
     @given(st.lists(pod_st, min_size=1, max_size=5),
            st.sampled_from(["spread", "binpack"]))
     def run(pods, policy):
-        # Distinct names per entry: duplicate sampled names collide in
-        # pod uids otherwise.
-        for i, p in enumerate(pods):
-            p["name"] = f"{p['name']}{i}"
+        # Names assigned on COPIES: mutating drawn examples would make
+        # hypothesis report post-mutation data on a failure.
+        pods = [dict(p, name=f"p{i}") for i, p in enumerate(pods)]
         r = run_simulation({"pods": pods}, nodes=2, chips=4, hbm=16384,
                            mesh=(2, 2), policy=policy)
         for key, c in r["chips"].items():
