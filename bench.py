@@ -877,16 +877,16 @@ def serve_worker(out_path: str) -> None:
     eng = ServingEngine(cfg, params, max_slots=slots, max_len=max_len,
                         horizon=horizon)
 
-    def drain():
+    def drain(engine):
         for p in prompts:
-            eng.submit(p, new)
-        done = eng.run()
+            engine.submit(p, new)
+        done = engine.run()
         return sum(len(c.tokens) for c in done)
 
-    drain()                       # compile every bucket + the decode step
+    drain(eng)                    # compile every bucket + the decode step
     warm_stats = dict(eng.stats)  # timed-drain stats = total minus warmup
     t0 = time.perf_counter()
-    toks = drain()                # engine state is reusable after a drain
+    toks = drain(eng)             # engine state is reusable after a drain
     dt_engine = time.perf_counter() - t0
 
     # Sequential baseline: same bucket shapes, left-padded (generate()'s
@@ -932,6 +932,35 @@ def serve_worker(out_path: str) -> None:
         "stats": {k: v - warm_stats.get(k, 0)
                   for k, v in eng.stats.items()},
     }
+    # Result is safe before the optional leg: a failure below can only
+    # ever ADD the int8 comparison, never lose the bf16 measurement.
+    write_result(out_path, result)
+
+    # Weight-only int8 leg (same requests, quantized engine): the decode
+    # HBM-traffic halving claim measured at the SERVING level, not just
+    # the single-stream decode microbench.
+    del eng                      # free the bf16 pool before the int8 one
+    try:
+        import dataclasses
+
+        from k8s_vgpu_scheduler_tpu.models.quant import quantize_params
+
+        qeng = ServingEngine(
+            dataclasses.replace(cfg, quant="int8"),
+            quantize_params(params), max_slots=slots, max_len=max_len,
+            horizon=horizon)
+        drain(qeng)              # compile
+        t0 = time.perf_counter()
+        qtoks = drain(qeng)
+        dt_q = time.perf_counter() - t0
+        q_tps = qtoks / max(dt_q, 1e-9)
+        result["int8_tokens_per_s"] = round(q_tps, 1)
+        result["int8_speedup_vs_bf16"] = round(
+            q_tps / max(engine_tps, 1e-9), 2)
+    except Exception as e:  # noqa: BLE001 — optional leg, never fatal,
+        # but visible: a skipped leg must not read as "never attempted"
+        # (collect only surfaces stderr on rc!=0).
+        result["int8_error"] = repr(e)[:200]
     write_result(out_path, result)
 
 
