@@ -232,7 +232,11 @@ class TestDispatchGate:
     """Python-layer gate: per-device charging and slot tracking, driven with
     a stub native so no real sleeping or region is involved."""
 
-    def _fake_shim(self, sync_every=2):
+    def _fake_shim(self, sync_every=2, read_cost=0.0):
+        """Shim over a stub native.  The fake clock advances ``read_cost``
+        seconds per read (models a tunnel round trip per sync hop); tests
+        model dispatch/device time by advancing ``shim._test_clock[0]``
+        from inside the dispatched callable."""
         from k8s_vgpu_scheduler_tpu.shim.core import Shim
 
         class FakeLib:
@@ -253,14 +257,16 @@ class TestDispatchGate:
         t = [0.0]
 
         def clock():
-            t[0] += 0.001  # 1ms per clock read: deterministic
+            t[0] += read_cost
             return t[0]
 
         os.environ["VTPU_SYNC_EVERY"] = str(sync_every)
         try:
-            return Shim(FakeNative(), clock=clock)
+            shim = Shim(FakeNative(), clock=clock)
         finally:
             del os.environ["VTPU_SYNC_EVERY"]
+        shim._test_clock = t
+        return shim
 
     def test_charges_every_device_backing_the_result(self):
         import jax
@@ -295,16 +301,22 @@ class TestDispatchGate:
         """The synced sample must cover exactly one dispatch (ADVICE r2
         medium: blocking on the result alone also drains the queued backlog
         and inflates the charge ~N×, over-throttling below the grant).  The
-        drain — block on the PREVIOUS output — happens outside the timed
-        window, so with a fake 1000us-per-dispatch clock every estimate is
-        exactly 1000us, synced or not."""
+        drain — block on the PREVIOUS output — and the overhead re-sync both
+        happen outside the timed dispatch, so with the dispatch itself
+        advancing the clock 1000us every estimate is exactly 1000us, synced
+        or not."""
         import jax
         import jax.numpy as jnp
 
         from k8s_vgpu_scheduler_tpu.shim.core import _SlotHolder
 
         shim = self._fake_shim(sync_every=2)
-        f = jax.jit(lambda v: v + 1)
+        g = jax.jit(lambda v: v + 1)
+
+        def f(v):
+            shim._test_clock[0] += 0.001  # dispatch + device: 1000us
+            return g(v)
+
         x = jnp.arange(8.0)
         holder = _SlotHolder()
         last = None
@@ -341,12 +353,55 @@ class TestDispatchGate:
         x = jnp.arange(8.0)
         holder = _SlotHolder()
         r1 = shim._gated_call(f, holder, (x,), {})
-        # Sync turn 1: no previous output yet — one fetch (the output).
-        assert len(calls) == 1
+        # Sync turn 1: no previous output yet — the output fetch plus the
+        # overhead-calibration re-fetch.
+        assert len(calls) == 2
         r2 = shim._gated_call(f, holder, (x,), {})
-        # Sync turn 2: drain-fetch of r1, then fetch of r2.
-        assert len(calls) == 3
+        # Sync turn 2: drain-fetch of r1, fetch of r2, overhead re-fetch.
+        assert len(calls) == 5
         del r1, r2
+
+    def test_synced_sample_subtracts_round_trip_overhead(self):
+        """VERDICT r3 item 3: the measured THROTTLE duty landed at ~2/3 of
+        the cap because each synced sample charged its sync round trips as
+        device time.  The sample now re-syncs the already-complete output
+        and subtracts that pure-overhead window: with 500us per clock read
+        (one tunnel hop) and a 2000us dispatch, the charge must be 2000us,
+        not 2500us."""
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_vgpu_scheduler_tpu.shim.core import _SlotHolder
+
+        shim = self._fake_shim(sync_every=1, read_cost=0.0005)
+        g = jax.jit(lambda v: v * 2)
+
+        def f(v):
+            shim._test_clock[0] += 0.002  # true device time: 2000us
+            return g(v)
+
+        holder = _SlotHolder()
+        x = jnp.arange(8.0)
+        for _ in range(3):
+            shim._gated_call(f, holder, (x,), {})
+        costs = [c for s, c in shim.native.lib.feedbacks if s == 0]
+        assert costs == [2000, 2000, 2000]
+
+    def test_compensated_sample_floors_at_100us(self):
+        """A dispatch cheaper than its measurement overhead must still
+        charge a positive floor — a 0 charge would let an unthrottled
+        stream starve sharers."""
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_vgpu_scheduler_tpu.shim.core import _SlotHolder
+
+        shim = self._fake_shim(sync_every=1, read_cost=0.0005)
+        f = jax.jit(lambda v: v + 1)  # advances the fake clock not at all
+        holder = _SlotHolder()
+        shim._gated_call(f, holder, (jnp.arange(4.0),), {})
+        costs = [c for s, c in shim.native.lib.feedbacks if s == 0]
+        assert costs == [100]
 
     def test_fetch_small_picks_smallest_and_skips_large(self, monkeypatch):
         import numpy as np
@@ -461,6 +516,36 @@ print("duty", N * COST_US / elapsed_us)
         )
         duty = float(out.split()[-1])
         assert 0.27 <= duty <= 0.33, f"duty cycle {duty} outside 30%±10%"
+
+
+class TestOomWatchdogActions:
+    def test_exit_action_ends_overlimit_process_with_137(self, tmp_path):
+        """VTPU_OOM_ACTION=exit: same enforcement outcome as kill (process
+        ends, 137) but the device client is released first — the deployable
+        action on pooled/tunneled backends where SIGKILL mid-claim wedges
+        the pool (DIAG_r03.txt; VERDICT r3 item 9's output-breach leg
+        relies on this)."""
+        cache = str(tmp_path / "r.cache")
+        full_env = dict(os.environ)
+        full_env.update({
+            "TPU_DEVICE_MEMORY_SHARED_CACHE": cache,
+            "TPU_DEVICE_MEMORY_LIMIT_0": "100",
+            "VTPU_OOM_ACTION": "exit",
+            "VTPU_LIBRARY": LIB,
+        })
+        out = subprocess.run(
+            [sys.executable, "-c", f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+from k8s_vgpu_scheduler_tpu.shim import core
+shim = core.install(jax_hooks=False, ballast=False, watchdog=True)
+shim.native.lib.vtpu_set_used(0, 200 * 1024 * 1024)  # 2x the grant
+time.sleep(15)
+print("SURVIVED")
+"""],
+            env=full_env, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 137, out.stderr
+        assert "SURVIVED" not in out.stdout
 
 
 class TestReaderAPI:
