@@ -1,7 +1,8 @@
 # Development entrypoints (the reference drives everything through
 # hack/build.sh + a Makefile; here each surface is one target).
 
-.PHONY: all native test dryrun scenarios controlplane bench wheel clean
+.PHONY: all native test test-fast test-slow dryrun scenarios controlplane \
+        bench wheel clean
 
 all: native
 
@@ -12,6 +13,12 @@ native:                       ## C++ enforcement layer → lib/tpu/build/
 
 test: native                  ## full suite on a virtual 8-device CPU mesh
 	python -m pytest tests/ -q
+
+test-fast: native             ## control plane + shim + e2e (<2 min, 1 core)
+	python -m pytest tests/ -q -m "not slow"
+
+test-slow: native             ## model/parallelism tier (compiles networks)
+	python -m pytest tests/ -q -m slow
 
 # dryrun_multichip pins the CPU platform + device count itself,
 # appending to (not clobbering) any user-set XLA_FLAGS.

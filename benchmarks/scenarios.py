@@ -829,6 +829,16 @@ if FORCE_CPU:
     cfg = LlamaConfig(vocab=256, dim=128, n_layers=2, n_heads=4,
                       n_kv_heads=4, ffn_hidden=384)
     batch, seq, steps = 2, 64, 2
+elif os.environ.get("SCEN_WIN_CFG") == "1":
+    # Batch-scaling comparison config (VERDICT r3 item 4): sized so the
+    # FULL adam state still fits a 4096 MiB grant at a small batch
+    # (~180M params: bf16 params 360 + grads 360 + f32 moments 1440 MiB)
+    # — the largest-in-grant alternative a user has WITHOUT
+    # oversubscription — while the offloaded leg uses the freed ~1.4 GiB
+    # for a 4x larger batch under the SAME grant.
+    cfg = LlamaConfig(vocab=8192, dim=1280, n_layers=8, n_heads=16,
+                      n_kv_heads=16, ffn_hidden=3456)
+    batch, seq, steps = 2, 512, 4
 else:
     # Sized so the FULL in-HBM working set (params ~890 MiB bf16 + grads
     # + f32 adam state ~3.5 GiB) EXCEEDS a 4096 MiB grant while the
@@ -837,6 +847,7 @@ else:
     cfg = LlamaConfig(vocab=8192, dim=2048, n_layers=8, n_heads=16,
                       n_kv_heads=16, ffn_hidden=5632)
     batch, seq, steps = 4, 512, 4
+batch = int(os.environ.get("SCEN_BATCH", batch))
 mesh = make_mesh(MeshShape(1, 1, 1), devices=jax.devices()[:1])
 rng = jax.random.PRNGKey(0)
 
@@ -970,6 +981,43 @@ def scenario_oversub() -> None:
     if base and off and off["tokens_per_s"]:
         result["offload_overhead"] = round(
             base["tokens_per_s"] / off["tokens_per_s"], 3)
+
+    # Legs D/E — the reference's headline WIN shape (README.md:185-189:
+    # "+virtual devmem" beat the stock plugin by enabling bigger batches),
+    # posed the TPU way: same 4096 MiB grant, same model, both ENFORCED.
+    # D = the largest configuration whose full adam state fits in-grant
+    # (the user's best alternative without oversubscription); E = the
+    # offloaded run spending the freed HBM on a 4x batch.  Whether E wins
+    # is MEASURED, not assumed — if it loses, the artifact carries the
+    # honest boundary (oversub as capacity, not speed; docs/compute.md).
+    rcD, outD, errD = run_child(
+        _OVERSUB, {**enforce_env, "SCEN_OVERSUB_MODE": "baseline",
+                   "SCEN_WIN_CFG": "1", "SCEN_BATCH": "2"},
+        timeout=540, interposer=True)
+    ingrant = _oversub_marker(outD, "BASELINE")
+    rcE, outE, errE = run_child(
+        _OVERSUB, {**enforce_env, "SCEN_OVERSUB_MODE": "offload",
+                   "SCEN_WIN_CFG": "1", "SCEN_BATCH": "8"},
+        timeout=540, interposer=True)
+    offbig = _oversub_marker(outE, "OFFLOAD")
+    if ingrant or offbig:
+        comp = {
+            "grant_mib": int(grant),
+            "in_grant_batch": 2,
+            "in_grant_tokens_per_s": (ingrant or {}).get("tokens_per_s"),
+            "offload_batch": 8,
+            "offload_tokens_per_s": (offbig or {}).get("tokens_per_s"),
+        }
+        if ingrant and offbig and ingrant.get("tokens_per_s"):
+            comp["offload_speedup"] = round(
+                offbig["tokens_per_s"] / ingrant["tokens_per_s"], 3)
+            comp["offload_wins"] = bool(comp["offload_speedup"] > 1.0)
+        result["batch_scaling"] = comp
+    for leg, rc, err in (("in_grant", rcD, errD), ("offload_big", rcE, errE)):
+        if rc != 0:
+            result.setdefault("errors", {})[leg] = \
+                (err or "").strip().splitlines()[-3:]
+
     result["passed"] = bool(base and off and refusal_ok
                             and result["loss_match"]
                             and off["tokens_per_s"] > 0)

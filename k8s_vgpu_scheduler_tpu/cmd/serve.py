@@ -34,6 +34,7 @@ import json
 import logging
 import os
 import queue as _queue
+import shutil
 import tempfile
 import threading
 import time
@@ -66,6 +67,10 @@ class EngineFrontend:
         self._stop = False
         self._draining = False
         self._fatal: Optional[BaseException] = None
+        # Cancellations that never reached the engine (client gave up
+        # while still in _incoming): engine stats can't see them, so the
+        # cancelled metric folds this in at stats() time.
+        self._pre_cancelled = 0
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-engine")
         self._thread.start()
@@ -134,8 +139,12 @@ class EngineFrontend:
         eng = self.engine
         with self._cv:
             depth = len(self._incoming)
+        merged = dict(eng.stats)
+        # Pre-submission abandonments (see _loop): one cancelled metric
+        # covering the whole request lifecycle, not just engine-side.
+        merged["cancelled"] = merged.get("cancelled", 0) + self._pre_cancelled
         return {
-            "stats": dict(eng.stats),
+            "stats": merged,
             "utilization": eng.utilization,
             "queue_depth": depth + len(eng.queue),
             "slots": eng.S, "max_len": eng.L, "horizon": eng.horizon,
@@ -185,7 +194,11 @@ class EngineFrontend:
                 self._to_cancel = []
             for prompt, max_new, waiter in batch:
                 if waiter.get("cancelled"):
-                    continue        # client gave up before submission
+                    # Client gave up before submission: the engine never
+                    # saw it, so count it here or the cancelled metric
+                    # undercounts abandonments (ADVICE r3).
+                    self._pre_cancelled += 1
+                    continue
                 try:
                     rid = self.engine.submit(prompt, max_new)
                     waiter["rid"] = rid
@@ -316,10 +329,25 @@ def profile_capture(path: str) -> tuple:
                 # running (every later capture would 500 "already started").
                 jax.profiler.stop_trace()
         except Exception as e:  # noqa: BLE001 — never take the server down
-            import shutil
-
             shutil.rmtree(out_dir, ignore_errors=True)
             return 500, {"error": f"{type(e).__name__}: {e}"}
+        # Retention bound (ADVICE r3): an unauthenticated poller must not
+        # fill the pod filesystem — keep the newest VTPU_PROFILE_KEEP
+        # captures (default 5), drop older siblings.  Under the lock, so
+        # no concurrent capture's fresh dir can be mistaken for an old one.
+        try:
+            keep = max(1, int(os.environ.get("VTPU_PROFILE_KEEP", "5")))
+            root = os.path.dirname(out_dir)
+            sibs = sorted(
+                (os.path.join(root, d) for d in os.listdir(root)
+                 if d.startswith("vtpu-prof-")
+                 and os.path.isdir(os.path.join(root, d))),
+                key=lambda p: os.stat(p).st_mtime)
+            for old in sibs[:-keep]:
+                if old != out_dir:
+                    shutil.rmtree(old, ignore_errors=True)
+        except Exception:  # noqa: BLE001 — rotation is best-effort
+            pass
     except Exception as e:  # noqa: BLE001 — import jax / mkdtemp failed
         return 500, {"error": f"{type(e).__name__}: {e}"}
     finally:
@@ -332,6 +360,14 @@ def profile_capture(path: str) -> tuple:
 
 def make_handler(frontend: EngineFrontend, request_timeout: float):
     class Handler(BaseHTTPRequestHandler):
+        # Socket timeout for every read/write: with daemon_threads=False a
+        # client that connects and never sends a request (or an SSE reader
+        # that stalls its receive window) would otherwise hold its handler
+        # thread forever and server_close() could never join it outside
+        # k8s (no SIGKILL backstop) — ADVICE r3.  30s stalls only count
+        # socket inactivity; server-side generation waits are unaffected.
+        timeout = 30.0
+
         def log_message(self, fmt, *args):  # route through logging
             log.debug("http: " + fmt, *args)
 

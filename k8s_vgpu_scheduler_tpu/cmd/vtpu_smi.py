@@ -28,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import List, Optional
 
@@ -84,23 +85,38 @@ def format_info(info: dict, title: str) -> str:
 def parse_prom(text: str) -> dict:
     """Minimal Prometheus text-exposition parser: name → [(labels, value)].
     Only what the extender emits (gauges/counters, quoted label values
-    without embedded quotes) — no client dependency in the CLI."""
+    without embedded quotes) — no client dependency in the CLI.  The label
+    block is split off FIRST (on the closing brace), then the sample value
+    is the first field after it: label values containing spaces, and the
+    optional trailing ``name value timestamp`` form a federated/relabelled
+    endpoint emits, both parse correctly (ADVICE r3 — rpartition(' ')
+    silently took the timestamp as the value)."""
     out: dict = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        head, _, val = line.rpartition(" ")
-        name, labels = head, {}
-        if "{" in head:
-            name, _, rest = head.partition("{")
-            for part in rest.rstrip("}").split(","):
-                if not part:
-                    continue
-                k, _, v = part.partition("=")
-                labels[k] = v.strip('"')
+        labels: dict = {}
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            block, brace, tail = rest.rpartition("}")
+            if not brace:
+                continue  # unclosed label block: not an exposition line
+            # Pair-wise regex, not split(","): quoted label values may
+            # legally contain commas (and spaces) — e.g. relabelled
+            # joined values on a federated endpoint.
+            for m in re.finditer(r'([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*'
+                                 r'"((?:[^"\\]|\\.)*)"', block):
+                labels[m.group(1)] = m.group(2)
+            fields = tail.split()
+        else:
+            fields = line.split()
+            name = fields[0] if fields else ""
+            fields = fields[1:]
+        if not name or not fields:
+            continue
         try:
-            out.setdefault(name, []).append((labels, float(val)))
+            out.setdefault(name, []).append((labels, float(fields[0])))
         except ValueError:
             continue
     return out

@@ -52,6 +52,12 @@ def _apply_field_selector(items: list, query: dict) -> list:
         # Compound/unknown selectors included: a mis-parsed value that
         # silently returns [] is as wrong as an ignored filter.
         raise ValueError(f"unsupported fieldSelector {sel!r}")
+    if not want:
+        # A real apiserver treats 'spec.nodeName=' as "unscheduled pods";
+        # matching no pod instead would be opposite semantics delivered
+        # silently.  RestKube/FakeKube refuse '' client-side; refuse it
+        # here too (→400) per this file's loud-failure policy (ADVICE r3).
+        raise ValueError("empty fieldSelector value for spec.nodeName")
     return [p for p in items
             if p.get("spec", {}).get("nodeName") == want]
 

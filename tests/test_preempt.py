@@ -12,6 +12,12 @@ models/train.run_preemptible:
   trajectory as an uninterrupted one.
 """
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import dataclasses
 import os
 import time

