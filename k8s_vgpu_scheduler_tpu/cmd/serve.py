@@ -149,6 +149,8 @@ class EngineFrontend:
             "queue_depth": depth + len(eng.queue),
             "slots": eng.S, "max_len": eng.L, "horizon": eng.horizon,
             "pool_hbm_bytes": eng.pool_hbm_bytes(),
+            # {} until the first completion (latency_percentiles contract)
+            "latency": eng.latency_percentiles(),
         }
 
     def healthy(self) -> bool:
@@ -282,6 +284,20 @@ def prometheus_text(stats: dict) -> str:
                 g = GaugeMetricFamily(name, help_)
                 g.add_metric([], value)
                 yield g
+            # Latency quantiles appear once the first completion lands
+            # (absent-not-zero, same contract as /statsz "latency").
+            lat = stats.get("latency") or {}
+            for key, help_ in (
+                    ("ttft", "Client-observed submit->first-token"),
+                    ("per_token", "Steady-state per-token latency")):
+                q = lat.get(f"{key}_s")
+                if not q:
+                    continue
+                for p in ("p50", "p95"):
+                    g = GaugeMetricFamily(
+                        f"vtpu_serve_{key}_seconds_{p}", help_ + f" ({p})")
+                    g.add_metric([], q[p])
+                    yield g
 
     registry = CollectorRegistry()
     registry.register(_Snapshot())

@@ -35,6 +35,7 @@ the two paths is only bit-exact in fp32.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Dict, List, Optional
 
@@ -46,6 +47,14 @@ from .generate import _sample
 from .llama import Llama, LlamaConfig, PAD_POSITION
 
 
+def nearest_rank(xs, q: float) -> float:
+    """Nearest-rank percentile on a non-empty sequence (shared by the
+    engine's reservoir quantiles and bench.py's drain quantiles — one
+    estimator, or the two surfaces silently diverge)."""
+    s = sorted(xs)
+    return s[min(int(q * len(s)), len(s) - 1)]
+
+
 @dataclasses.dataclass
 class _Slot:
     request_id: int
@@ -53,6 +62,8 @@ class _Slot:
     max_new_tokens: int
     produced: int
     tokens: List[int]
+    t_submit: float = 0.0      # monotonic, stamped by submit()
+    t_first: float = 0.0       # first token on the host (prefill return)
 
 
 @dataclasses.dataclass
@@ -61,6 +72,10 @@ class Completion:
     prompt: List[int]
     tokens: List[int]          # generated tokens (including eos if hit)
     finished_by: str           # "eos" | "length"
+    # Client-observed latency (horizon quantization included — these are
+    # what a caller actually waited, not device-step time):
+    ttft_s: float = 0.0        # submit -> first token on the host
+    total_s: float = 0.0       # submit -> completion observed
 
 
 class ServingEngine:
@@ -147,6 +162,20 @@ class ServingEngine:
         self.stats = {"prefills": 0, "decode_steps": 0,
                       "decode_dispatches": 0, "tokens_out": 0,
                       "completions": 0, "cancelled": 0}
+        # Bounded reservoirs of client-observed latencies (newest ~512
+        # completions) backing latency_percentiles() — enough for stable
+        # p95 without unbounded growth on a long-lived server.
+        import threading
+        from collections import deque
+
+        self._lat_ttft = deque(maxlen=512)
+        self._lat_per_token = deque(maxlen=512)
+        # The engine is single-threaded by contract, but /statsz and
+        # /metrics scrape latency_percentiles() from HTTP handler
+        # threads; iterating a deque while the engine thread appends
+        # raises RuntimeError, so both sides take this lock (appends:
+        # nanoseconds; reads: a copy of <=512 floats).
+        self._lat_lock = threading.Lock()
 
     # -- capacity ---------------------------------------------------------
 
@@ -199,7 +228,8 @@ class ServingEngine:
         rid = self._next_id
         self._next_id += 1
         self.queue.append({"id": rid, "prompt": prompt,
-                           "max_new_tokens": int(max_new_tokens)})
+                           "max_new_tokens": int(max_new_tokens),
+                           "t_submit": time.monotonic()})
         return rid
 
     # -- compiled paths ---------------------------------------------------
@@ -327,8 +357,13 @@ class ServingEngine:
             self.lengths[slot] = plen
             self.cur[slot] = first
             self.active[slot] = True
+            # ``first = int(tok)`` above forced the host sync, so this
+            # timestamp is an honest first-token time even on async
+            # dispatch paths.
             self.slots[slot] = _Slot(req["id"], req["prompt"],
-                                     req["max_new_tokens"], 1, [first])
+                                     req["max_new_tokens"], 1, [first],
+                                     t_submit=req.get("t_submit", 0.0),
+                                     t_first=time.monotonic())
             self.stats["prefills"] += 1
             self.stats["tokens_out"] += 1
             self._finish_if_done(slot, first)
@@ -339,9 +374,18 @@ class ServingEngine:
         done_len = st.produced >= st.max_new_tokens
         if done_eos or done_len:
             self.active[slot] = False
+            now = time.monotonic()
+            ttft = max(st.t_first - st.t_submit, 0.0) if st.t_submit else 0.0
+            total = max(now - st.t_submit, 0.0) if st.t_submit else 0.0
             self._completed.append(Completion(
                 st.request_id, st.prompt, st.tokens,
-                "eos" if done_eos else "length"))
+                "eos" if done_eos else "length",
+                ttft_s=ttft, total_s=total))
+            if st.t_submit:
+                with self._lat_lock:
+                    self._lat_ttft.append(ttft)
+                    self._lat_per_token.append(
+                        (total - ttft) / max(len(st.tokens) - 1, 1))
             del self.slots[slot]
             self.stats["completions"] += 1
 
@@ -391,3 +435,22 @@ class ServingEngine:
     @property
     def utilization(self) -> float:
         return float(self.active.sum()) / self.S
+
+    def latency_percentiles(self) -> dict:
+        """p50/p95 of client-observed TTFT and steady-state per-token
+        latency over the newest completions (bounded reservoir).  Empty
+        dict before the first completion — callers must not invent
+        zeros where nothing was measured."""
+        with self._lat_lock:
+            ttft = list(self._lat_ttft)
+            per_tok = list(self._lat_per_token)
+        if not ttft or not per_tok:
+            return {}
+        return {
+            "n": len(ttft),
+            "ttft_s": {"p50": round(nearest_rank(ttft, 0.50), 4),
+                       "p95": round(nearest_rank(ttft, 0.95), 4)},
+            "per_token_s": {
+                "p50": round(nearest_rank(per_tok, 0.50), 5),
+                "p95": round(nearest_rank(per_tok, 0.95), 5)},
+        }

@@ -214,3 +214,22 @@ def test_cancel_queued_and_active(model_and_params):
     done = eng.run()
     assert [c.request_id for c in done] == [r3]
     assert done[0].tokens == oracle(cfg, params, p3, 6)
+
+
+def test_latency_accounting(model_and_params):
+    """Completions carry client-observed TTFT/total; the engine's bounded
+    reservoir backs latency_percentiles() — absent (not zero) before the
+    first completion, monotone-sane after."""
+    cfg, params = model_and_params
+    eng = ServingEngine(cfg, params, max_slots=2, max_len=32)
+    assert eng.latency_percentiles() == {}
+    for i in range(3):
+        eng.submit([1 + i, 2, 3], 4)
+    done = eng.run()
+    assert len(done) == 3
+    for c in done:
+        assert c.total_s >= c.ttft_s > 0.0
+    lat = eng.latency_percentiles()
+    assert lat["n"] == 3
+    assert lat["ttft_s"]["p95"] >= lat["ttft_s"]["p50"] > 0.0
+    assert lat["per_token_s"]["p95"] >= lat["per_token_s"]["p50"] >= 0.0
