@@ -160,24 +160,43 @@ class TestSpool:
 
 
 class TestMicrobenchWorkers:
+    @staticmethod
+    def _run_worker(flag: str, tiny_env: str, tmp_path) -> dict:
+        """Launch one bench.py micro-worker at tiny CPU sizing and return
+        its parsed result record."""
+        import json as _json
+        import subprocess
+        import sys as _sys
+        out = str(tmp_path / "worker.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", **{tiny_env: "1"})
+        r = subprocess.run(
+            [_sys.executable, os.path.join(REPO, "bench.py"),
+             flag, "--out", out],
+            env=env, capture_output=True, text=True, timeout=500)
+        assert r.returncode == 0, r.stderr[-500:]
+        return _json.load(open(out))
+
     def test_spec_worker_smoke(self, tmp_path):
         """The speculative-decode worker runs end-to-end at tiny sizing
         and asserts token-identity itself (it would exit nonzero on
         divergence)."""
-        import json as _json
-        import subprocess
-        import sys as _sys
-        out = str(tmp_path / "spec.json")
-        env = dict(os.environ, BENCH_DECODE_TINY="1", JAX_PLATFORMS="cpu")
-        r = subprocess.run(
-            [_sys.executable, os.path.join(REPO, "bench.py"),
-             "--spec-worker", "--out", out],
-            env=env, capture_output=True, text=True, timeout=500)
-        assert r.returncode == 0, r.stderr[-500:]
-        rec = _json.load(open(out))
+        rec = self._run_worker("--spec-worker", "BENCH_DECODE_TINY",
+                               tmp_path)
         assert rec["token_identical"] is True
         assert rec["metric"] == bench.SPEC_CASE
         assert 0.0 <= rec["acceptance_rate"] <= 1.0
+
+    def test_serve_worker_smoke(self, tmp_path):
+        """The serving microbench runs end-to-end at tiny sizing and
+        carries the r4 additions: engine-vs-sequential throughput plus
+        drain-level latency quantiles from the Completion stamps."""
+        rec = self._run_worker("--serve-worker", "BENCH_SERVE_TINY",
+                               tmp_path)
+        assert rec["metric"] == bench.SERVE_CASE
+        assert rec["value"] > 0 and rec["sequential_tokens_per_s"] > 0
+        lat = rec["latency"]
+        assert lat["ttft_s"]["p95"] >= lat["ttft_s"]["p50"] > 0
+        assert lat["per_token_s"]["p95"] >= lat["per_token_s"]["p50"] >= 0
 
 
 class TestCaseTable:

@@ -115,9 +115,12 @@ def test_parse_prom_timestamps_and_spacey_labels():
     metrics = parse_prom(
         "with_ts 3.25 1722400000000\n"
         'labeled{pod="a b c",node="n-1"} 9 1722400000000\n'
+        'joined{vals="a,b,c"} 2\n'
         "plain_ts_int 4 17\n")
     assert metrics["with_ts"] == [({}, 3.25)]
     assert metrics["labeled"] == [({"pod": "a b c", "node": "n-1"}, 9.0)]
+    # Quoted label values may contain commas (relabelled joins).
+    assert metrics["joined"] == [({"vals": "a,b,c"}, 2.0)]
     assert metrics["plain_ts_int"] == [({}, 4.0)]
 
 
