@@ -967,8 +967,8 @@ def serve_worker(out_path: str) -> None:
                for c in done if c.total_s]
     if ttfts:
         result["latency"] = {
-            "ttft_s": {"p50": round(pct(ttfts, 0.5), 4),
-                       "p95": round(pct(ttfts, 0.95), 4)},
+            "ttft_s": {"p50": round(pct(ttfts, 0.5), 5),
+                       "p95": round(pct(ttfts, 0.95), 5)},
             "per_token_s": {"p50": round(pct(per_tok, 0.5), 5),
                             "p95": round(pct(per_tok, 0.95), 5)},
         }

@@ -195,7 +195,10 @@ class TestMicrobenchWorkers:
         assert rec["metric"] == bench.SERVE_CASE
         assert rec["value"] > 0 and rec["sequential_tokens_per_s"] > 0
         lat = rec["latency"]
-        assert lat["ttft_s"]["p95"] >= lat["ttft_s"]["p50"] > 0
+        # p50 may legally round to 0.0 at 10us resolution on a fast box;
+        # p95 (the slowest-admitted request's prefill) cannot.
+        assert lat["ttft_s"]["p95"] >= lat["ttft_s"]["p50"] >= 0
+        assert lat["ttft_s"]["p95"] > 0
         assert lat["per_token_s"]["p95"] >= lat["per_token_s"]["p50"] >= 0
 
 

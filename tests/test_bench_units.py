@@ -1,0 +1,49 @@
+"""Pure-Python bench.py unit tests (no device, no compile) — fast tier.
+
+Split from test_bench_harness.py, whose module-wide `slow` mark fits its
+subprocess/model smokes but would hide these table/math checks from
+`make test-fast`.
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "bench_units", os.path.join(REPO, "bench.py"))
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+class TestMfuAccounting:
+    class _Dev:
+        def __init__(self, platform, kind):
+            self.platform = platform
+            self.device_kind = kind
+
+    def test_peak_table_matches_generations(self):
+        cases = {
+            "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
+            "TPU v4": 275e12, "TPU v3": 123e12,
+            "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+        }
+        for kind, want in cases.items():
+            assert bench.peak_bf16_flops(self._Dev("tpu", kind)) == want
+        # Unknown generation / non-TPU: 0.0 — never a made-up MFU.
+        assert bench.peak_bf16_flops(self._Dev("tpu", "TPU v99")) == 0.0
+        assert bench.peak_bf16_flops(self._Dev("cpu", "TPU v4")) == 0.0
+
+    def test_attach_mfu_math(self):
+        r = {}
+        # 1 TFLOP/step at 100 steps/s on a v5e (197 TFLOP/s peak).
+        bench.attach_mfu(r, 1e12, 100.0, self._Dev("tpu", "TPU v5 lite"))
+        assert r["model_tflops_per_step"] == 1.0
+        assert r["achieved_tflops_per_s"] == 100.0
+        assert r["peak_tflops_bf16"] == 197.0
+        assert abs(r["mfu"] - 100.0 / 197.0) < 1e-3
+        # No analysis -> no fabricated fields.
+        r2 = {}
+        bench.attach_mfu(r2, 0.0, 100.0, self._Dev("tpu", "TPU v5 lite"))
+        assert r2 == {}
+
+
