@@ -44,3 +44,22 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def load_bench():
+    """Load repo-root bench.py exactly once per process (it is a script,
+    not a package module).  Shared by the bench harness/unit test
+    modules so the loader lives in one place and the module body never
+    executes twice in a run."""
+    import importlib.util
+    import sys
+
+    if "bench" in sys.modules:
+        return sys.modules["bench"]
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    return mod

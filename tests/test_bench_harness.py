@@ -13,16 +13,12 @@ import pytest
 # fast tier a judge can run on one core (`make test-fast`).
 pytestmark = pytest.mark.slow
 
-import importlib.util
 import os
-import sys
+
+from conftest import load_bench
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-spec = importlib.util.spec_from_file_location(
-    "bench", os.path.join(REPO, "bench.py"))
-bench = importlib.util.module_from_spec(spec)
-spec.loader.exec_module(bench)
+bench = load_bench()
 
 
 def tpu(metric, value):

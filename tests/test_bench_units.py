@@ -5,14 +5,12 @@ subprocess/model smokes but would hide these table/math checks from
 `make test-fast`.
 """
 
-import importlib.util
 import os
 
+from conftest import load_bench
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-spec = importlib.util.spec_from_file_location(
-    "bench_units", os.path.join(REPO, "bench.py"))
-bench = importlib.util.module_from_spec(spec)
-spec.loader.exec_module(bench)
+bench = load_bench()
 
 
 class TestMfuAccounting:

@@ -153,12 +153,28 @@ def _emitted_metrics() -> set:
     """Names exactly as Prometheus renders them: counters ONLY as
     name_total (the bare counter name never appears in exposition, so
     accepting it would let a never-firing alert/panel pass), gauges as
-    declared."""
+    declared.  The serving pod's names are taken from a REAL rendering
+    (its latency gauges are built dynamically, so source regex would
+    miss them)."""
     src = _sources()
     counters = set(re.findall(r'CounterMetricFamily\(\s*"([a-z0-9_]+)"',
                               src))
     gauges = set(re.findall(r'GaugeMetricFamily\(\s*"([a-z0-9_]+)"', src))
-    return gauges | {f"{c}_total" for c in counters}
+    return gauges | {f"{c}_total" for c in counters} | _serve_metrics()
+
+
+def _serve_metrics() -> set:
+    """Render the serving pod's exposition against a fully-populated
+    stats snapshot and take the names the library actually emits."""
+    from k8s_vgpu_scheduler_tpu.cmd.serve import prometheus_text
+
+    stats = {
+        "stats": {}, "utilization": 0.0, "queue_depth": 0,
+        "pool_hbm_bytes": 0,
+        "latency": {"n": 1, "ttft_s": {"p50": 0.1, "p95": 0.2},
+                    "per_token_s": {"p50": 0.01, "p95": 0.02}},
+    }
+    return set(parse_prom(prometheus_text(stats)))
 
 
 def test_alert_rules_use_real_metric_names():
