@@ -193,6 +193,7 @@ def test_alert_rules_use_real_metric_names():
         assert r["alert"] and r["annotations"]["summary"]
     # promql fns + the scrape-level `up` series' label matcher, whose
     # hyphenated job name tokenizes as "vtpu"/"monitor".
-    referenced -= {"rate", "absent", "clamp_min", "vtpu", "monitor"}
+    referenced -= {"rate", "absent", "clamp_min", "min_over_time",
+                   "vtpu", "monitor"}
     missing = referenced - _emitted_metrics()
     assert not missing, f"alerts reference unknown metrics: {missing}"
