@@ -737,16 +737,18 @@ def decode_worker(out_path: str) -> None:
     # there (e.g. holding both param trees at once) must not discard it.
     write_result(out_path, result)
 
-    # Weight-only int8 leg: same decode with the block projections
-    # streamed as int8 (models/quant.py) — the HBM-bandwidth claim,
-    # measured.
-    try:
+    # Weight-only quant legs (models/quant.py): int8 halves, int4
+    # quarters the decode weight traffic — the HBM-bandwidth claim,
+    # measured.  One helper per leg so each leg's param tree and
+    # executables die on return: the bf16 + int8 + int4 trees must never
+    # coexist on an HBM-tight chip.
+    def quant_leg(quant: str, bits: int) -> float:
         import dataclasses as _dc
 
         from k8s_vgpu_scheduler_tpu.models.quant import quantize_params
 
-        qcfg = _dc.replace(cfg, quant="int8")
-        qparams = quantize_params(params)
+        qcfg = _dc.replace(cfg, quant=quant)
+        qparams = quantize_params(params, bits=bits)
         qrun_n = jit_generate(qcfg, max_new_tokens=N)
         qrun_1 = jit_generate(qcfg, max_new_tokens=1)
 
@@ -760,13 +762,17 @@ def decode_worker(out_path: str) -> None:
             return (time.perf_counter() - t0) / reps
 
         qdt_n, qdt_1 = qtimed(qrun_n), qtimed(qrun_1)
-        int8_tps = B * (N - 1) / max(qdt_n - qdt_1, 1e-9)
-        result["int8_decode_tokens_per_s"] = round(int8_tps, 1)
-        result["int8_speedup"] = round(
-            int8_tps / max(decode_tps, 1e-9), 3)
-    except Exception as e:  # noqa: BLE001 — bf16 record survives
-        result["int8_error"] = repr(e)[:200]
-    write_result(out_path, result)
+        return B * (N - 1) / max(qdt_n - qdt_1, 1e-9)
+
+    for quant, bits in (("int8", 8), ("int4", 4)):
+        try:
+            tps = quant_leg(quant, bits)
+            result[f"{quant}_decode_tokens_per_s"] = round(tps, 1)
+            result[f"{quant}_speedup"] = round(
+                tps / max(decode_tps, 1e-9), 3)
+        except Exception as e:  # noqa: BLE001 — earlier legs survive
+            result[f"{quant}_error"] = repr(e)[:200]
+        write_result(out_path, result)
 
 
 def spec_worker(out_path: str) -> None:

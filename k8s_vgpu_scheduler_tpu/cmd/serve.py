@@ -543,7 +543,8 @@ def build_engine(args):
         from ..models.quant import quantize_params
 
         cfg = dataclasses.replace(cfg, quant=args.quant)
-        params = quantize_params(params)
+        params = quantize_params(params,
+                                 bits={"int8": 8, "int4": 4}[args.quant])
     rng = jax.random.PRNGKey(args.seed) if args.temperature > 0 else None
     return ServingEngine(
         cfg, params, max_slots=args.max_slots, max_len=args.max_len,
@@ -560,7 +561,7 @@ def parse_args(argv=None):
                    help="LlamaConfig fields as JSON (overrides --demo)")
     p.add_argument("--checkpoint", default="",
                    help="orbax checkpoint dir (models/checkpoint.py)")
-    p.add_argument("--quant", choices=["int8"], default="")
+    p.add_argument("--quant", choices=["int8", "int4"], default="")
     p.add_argument("--max-slots", type=int, default=8)
     p.add_argument("--max-len", type=int, default=2048)
     p.add_argument("--horizon", type=int, default=8)

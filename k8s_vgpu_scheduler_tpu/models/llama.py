@@ -36,9 +36,10 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
-    # None | "int8": weight-only quantization of the block projection
-    # matrices (serving path; models/quant.py — halves decode HBM
-    # traffic).  Params must be transformed with quantize_params.
+    # None | "int8" | "int4": weight-only quantization of the block
+    # projection matrices (serving path; models/quant.py — int8 halves,
+    # int4 quarters decode HBM weight traffic).  Params must be
+    # transformed with quantize_params(bits=8|4).
     quant: Optional[str] = None
     # "full" | "ring" | "ulysses" | "flash".  ring and ulysses shard the
     # sequence over the mesh's sp axis (ring: K/V rotation, no head-count
@@ -109,12 +110,16 @@ PAD_POSITION = 2 ** 30
 
 
 def _dense(cfg: "LlamaConfig", features: int, name: str):
-    """Block projection layer: nn.Dense, or QuantDense when the config
-    carries weight-only quantization (models/quant.py)."""
+    """Block projection layer: nn.Dense, or a quant module when the
+    config carries weight-only quantization (models/quant.py)."""
     if cfg.quant == "int8":
         from .quant import QuantDense
 
         return QuantDense(features, dtype=cfg.dtype, name=name)
+    if cfg.quant == "int4":
+        from .quant import QuantDense4
+
+        return QuantDense4(features, dtype=cfg.dtype, name=name)
     return nn.Dense(features, use_bias=False, dtype=jnp.dtype(cfg.dtype),
                     name=name)
 

@@ -1,24 +1,33 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8 / int4 quantization for serving.
 
 Decode throughput on a TPU is HBM-bandwidth-bound: every generated token
 streams every weight matrix through the MXU once, so bytes-per-weight is
-the ceiling.  Per-output-channel symmetric int8 halves that traffic vs
-bf16 (4x vs f32) at ~0.4% RMS weight error; the dequantization multiply
-commutes with the matmul (``x @ (q·s) == (x @ q)·s`` for column scales),
-so the kernel streams INT8 from HBM and applies one [out]-vector scale
-to the product — XLA fuses the int8→bf16 convert into the matmul's
-operand load.
+the ceiling.  Two precisions, one transform API:
+
+- **int8** (per-output-channel symmetric): halves traffic vs bf16 at
+  ~0.4% RMS weight error; the dequantization multiply commutes with the
+  matmul (``x @ (q·s) == (x @ q)·s`` for column scales), so the kernel
+  streams INT8 from HBM and applies one [out]-vector scale to the
+  product — XLA fuses the int8→bf16 convert into the matmul's operand
+  load.
+- **int4** (group-wise symmetric, two weights per byte): quarters
+  traffic vs bf16.  Per-channel int4 is too lossy, so scales are per
+  (input-group, output-channel) — the standard GPTQ/AWQ-style layout —
+  and the matmul becomes a sum of per-group partial matmuls
+  (``einsum('...gi,gif->...gf')``), each scaled before the group sum:
+  group scales sit on the CONTRACTING dimension and do NOT commute the
+  way column scales do.
 
 Scope: the block projection matrices (q/k/v/o, gate/up/down) — the
 weights decode actually streams per token.  Embedding and the tied head
 stay full precision (standard practice: their quantization error lands
 directly on the logits).  Serving-only: gradients do not flow through
-``QuantDense``.
+the quant modules.
 
 Usage:
 
-    qcfg = dataclasses.replace(cfg, quant="int8")
-    qparams = quantize_params(params)
+    qcfg = dataclasses.replace(cfg, quant="int8")        # or "int4"
+    qparams = quantize_params(params)                    # bits=4 for int4
     tokens = generate(qcfg, qparams, prompt, n)
 """
 
@@ -27,6 +36,11 @@ from __future__ import annotations
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+
+# Input-dim rows per int4 scale group (GPTQ/AWQ convention).  Matrices
+# narrower than this use one group per matrix; other non-divisible
+# widths are refused loudly at quantize time.
+INT4_GROUP = 128
 
 
 class QuantDense(nn.Module):
@@ -50,6 +64,50 @@ class QuantDense(nn.Module):
         return (y * scale.astype(dtype)).astype(dtype)
 
 
+class QuantDense4(nn.Module):
+    """Drop-in for ``nn.Dense(use_bias=False)`` over packed int4 weights
+    (params ``kernel_q4`` [in/2, out] uint8 — input row 2i in the low
+    nibble, 2i+1 in the high — and ``scale`` [in/group, out] f32,
+    produced by :func:`quantize_params` with ``bits=4``)."""
+
+    features: int
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        dtype = jnp.dtype(self.dtype)
+        in_ = x.shape[-1]
+        group = _int4_group(in_)
+        q4 = self.param(
+            "kernel_q4", nn.initializers.zeros_init(),
+            (in_ // 2, self.features), jnp.uint8)
+        scale = self.param(
+            "scale", nn.initializers.ones_init(),
+            (in_ // group, self.features), jnp.float32)
+        low = (q4 & 0xF).astype(jnp.int8) - 8
+        high = (q4 >> 4).astype(jnp.int8) - 8
+        w = jnp.stack([low, high], axis=1).reshape(in_, self.features)
+        # Group scales live on the contracting dim: partial matmul per
+        # group, scale, then sum — each partial is an MXU matmul and the
+        # unpack above fuses into its operand load.
+        xg = x.astype(dtype).reshape(*x.shape[:-1], in_ // group, group)
+        wg = w.astype(dtype).reshape(in_ // group, group, self.features)
+        y = jnp.einsum("...gi,gif->...gf", xg, wg)
+        return (y * scale[..., :, :].astype(dtype)).sum(axis=-2) \
+            .astype(dtype)
+
+
+def _int4_group(in_: int) -> int:
+    """Scale-group size for an input width; refuses widths the packed
+    layout cannot represent instead of silently mis-grouping."""
+    group = min(INT4_GROUP, in_)
+    if in_ % 2 or in_ % group:
+        raise ValueError(
+            f"int4 quantization needs the input dim divisible by 2 and "
+            f"by the scale group ({group}); got {in_}")
+    return group
+
+
 def _quantize_kernel(w):
     """[in, out] float -> (int8 [in, out], f32 [out]) per-channel
     symmetric: scale = amax/127, q = round(w/scale)."""
@@ -60,15 +118,36 @@ def _quantize_kernel(w):
     return q, scale.astype(jnp.float32)
 
 
+def _quantize_kernel_int4(w):
+    """[in, out] float -> (uint8 [in/2, out] packed nibbles,
+    f32 [in/group, out]) group-wise symmetric: per (group, out-channel)
+    scale = amax/7, q = round(w/scale) in [-8, 7], rows 2i/2i+1 packed
+    low/high."""
+    in_, out = w.shape
+    group = _int4_group(in_)
+    w32 = w.astype(jnp.float32).reshape(in_ // group, group, out)
+    amax = jnp.max(jnp.abs(w32), axis=1)                     # [G, out]
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(w32 / scale[:, None, :]), -8, 7)
+    q = q.astype(jnp.int8).reshape(in_, out)
+    packed = (((q[1::2] + 8).astype(jnp.uint8) << 4)
+              | (q[0::2] + 8).astype(jnp.uint8))
+    return packed, scale.astype(jnp.float32)
+
+
 def _is_proj(key: str) -> bool:
     return key.endswith("_proj")
 
 
-def quantize_params(params: dict) -> dict:
-    """Rewrite a full-precision Llama param tree into the layout
-    ``QuantDense`` consumes: every ``*_proj: {kernel}`` becomes
-    ``{kernel_q, scale}``.  Everything else (embed, norms, head, MoE
-    expert stacks) passes through untouched."""
+def quantize_params(params: dict, bits: int = 8) -> dict:
+    """Rewrite a full-precision Llama param tree into the layout the
+    quant modules consume: every ``*_proj: {kernel}`` becomes
+    ``{kernel_q, scale}`` (int8) or ``{kernel_q4, scale}`` (int4).
+    Everything else (embed, norms, head, MoE expert stacks) passes
+    through untouched."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+
     def walk(node):
         if not isinstance(node, dict):
             return node
@@ -76,8 +155,12 @@ def quantize_params(params: dict) -> dict:
         for key, child in node.items():
             if (_is_proj(key) and isinstance(child, dict)
                     and "kernel" in child and child["kernel"].ndim == 2):
-                q, scale = _quantize_kernel(child["kernel"])
-                out[key] = {"kernel_q": q, "scale": scale}
+                if bits == 4:
+                    q, scale = _quantize_kernel_int4(child["kernel"])
+                    out[key] = {"kernel_q4": q, "scale": scale}
+                else:
+                    q, scale = _quantize_kernel(child["kernel"])
+                    out[key] = {"kernel_q": q, "scale": scale}
             else:
                 out[key] = walk(child)
         return out
@@ -87,6 +170,17 @@ def quantize_params(params: dict) -> dict:
 
 def dequantize_params(qparams: dict) -> dict:
     """Inverse layout transform (values carry the quantization error)."""
+    def unpack4(child):
+        q4, scale = child["kernel_q4"], child["scale"]
+        in_ = q4.shape[0] * 2
+        group = in_ // scale.shape[0]
+        low = (q4 & 0xF).astype(jnp.int8) - 8
+        high = (q4 >> 4).astype(jnp.int8) - 8
+        q = jnp.stack([low, high], axis=1).reshape(in_, q4.shape[1])
+        w = q.astype(jnp.float32).reshape(in_ // group, group, -1) \
+            * scale[:, None, :]
+        return w.reshape(in_, -1)
+
     def walk(node):
         if not isinstance(node, dict):
             return node
@@ -97,6 +191,9 @@ def dequantize_params(qparams: dict) -> dict:
                 out[key] = {"kernel": (
                     child["kernel_q"].astype(jnp.float32)
                     * child["scale"][None, :])}
+            elif (_is_proj(key) and isinstance(child, dict)
+                    and "kernel_q4" in child):
+                out[key] = {"kernel": unpack4(child)}
             else:
                 out[key] = walk(child)
         return out
