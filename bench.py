@@ -982,32 +982,37 @@ def serve_worker(out_path: str) -> None:
     # ever ADD the int8 comparison, never lose the bf16 measurement.
     write_result(out_path, result)
 
-    # Weight-only int8 leg (same requests, quantized engine): the decode
-    # HBM-traffic halving claim measured at the SERVING level, not just
-    # the single-stream decode microbench.
-    del eng                      # free the bf16 pool before the int8 one
-    try:
+    # Weight-only quant legs (same requests, quantized engine): the
+    # decode HBM-traffic claims measured at the SERVING level, not just
+    # the single-stream decode microbench.  One engine alive at a time —
+    # each leg's engine (and its KV pool) dies before the next builds.
+    del eng                      # free the bf16 pool before the quant ones
+
+    def quant_engine_leg(quant: str, bits: int) -> float:
         import dataclasses
 
         from k8s_vgpu_scheduler_tpu.models.quant import quantize_params
 
         qeng = ServingEngine(
-            dataclasses.replace(cfg, quant="int8"),
-            quantize_params(params), max_slots=slots, max_len=max_len,
-            horizon=horizon)
+            dataclasses.replace(cfg, quant=quant),
+            quantize_params(params, bits=bits), max_slots=slots,
+            max_len=max_len, horizon=horizon)
         drain(qeng)              # compile
         t0 = time.perf_counter()
         qtoks = sum(len(c.tokens) for c in drain(qeng))
-        dt_q = time.perf_counter() - t0
-        q_tps = qtoks / max(dt_q, 1e-9)
-        result["int8_tokens_per_s"] = round(q_tps, 1)
-        result["int8_speedup_vs_bf16"] = round(
-            q_tps / max(engine_tps, 1e-9), 2)
-    except Exception as e:  # noqa: BLE001 — optional leg, never fatal,
-        # but visible: a skipped leg must not read as "never attempted"
-        # (collect only surfaces stderr on rc!=0).
-        result["int8_error"] = repr(e)[:200]
-    write_result(out_path, result)
+        return qtoks / max(time.perf_counter() - t0, 1e-9)
+
+    for quant, bits in (("int8", 8), ("int4", 4)):
+        try:
+            q_tps = quant_engine_leg(quant, bits)
+            result[f"{quant}_tokens_per_s"] = round(q_tps, 1)
+            result[f"{quant}_speedup_vs_bf16"] = round(
+                q_tps / max(engine_tps, 1e-9), 2)
+        except Exception as e:  # noqa: BLE001 — optional leg, never
+            # fatal, but visible: a skipped leg must not read as "never
+            # attempted" (collect only surfaces stderr on rc!=0).
+            result[f"{quant}_error"] = repr(e)[:200]
+        write_result(out_path, result)
 
 
 # ----------------------------------------------------------------------------
