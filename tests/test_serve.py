@@ -193,6 +193,20 @@ def test_int8_quant_composes(model_and_params):
     assert c.tokens == oracle(qcfg, qparams, p, 6)
 
 
+def test_int4_quant_composes(model_and_params):
+    cfg, params = model_and_params
+    from k8s_vgpu_scheduler_tpu.models.quant import quantize_params
+
+    import dataclasses
+    qcfg = dataclasses.replace(cfg, quant="int4")
+    qparams = quantize_params(params, bits=4)
+    p = [7, 8, 9, 10]
+    eng = ServingEngine(qcfg, qparams, max_slots=2, max_len=32)
+    eng.submit(p, 6)
+    (c,) = eng.run()
+    assert c.tokens == oracle(qcfg, qparams, p, 6)
+
+
 def test_cancel_queued_and_active(model_and_params):
     """cancel() drops a queued request, frees a mid-decode slot for the
     next admit (rows rebuilt — the successor is token-exact), emits no
