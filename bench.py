@@ -1198,8 +1198,19 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
         result["vs_baseline"] = round(result["value"] / baseline, 3)
     # MFU accounting (VERDICT r3 item 2): model FLOPs for ONE step from the
     # unoptimized lowering, achieved FLOP/s from the timed chain.
-    attach_mfu(result, flops_per_step(analysis_step[0], *analysis_step[1]),
-               iters / elapsed, jax.devices()[0])
+    if kind == "lstm":
+        # XLA's cost analysis counts a lax.scan body ONCE, not × trip
+        # count, so the RNN's seq-length recurrence would be ~1000×
+        # under-counted — use the analytic gate-matmul count instead:
+        # per sample-timestep, [in+h]→4h is 2·(in+h)·4h FLOPs (feature
+        # width from the actual input, h from the cell); backward ≈ 2×
+        # forward.
+        h = model.hidden
+        gate = 2.0 * (x.shape[-1] + h) * 4 * h
+        step_flops = batch * size * gate * (3.0 if train else 1.0)
+    else:
+        step_flops = flops_per_step(analysis_step[0], *analysis_step[1])
+    attach_mfu(result, step_flops, iters / elapsed, jax.devices()[0])
     if shim is not None:
         # Live working-set readback (VERDICT r3 weak #7): sampled HERE,
         # params and inputs still alive.  Prefer real allocator stats; the
