@@ -193,6 +193,34 @@ def test_int8_quant_composes(model_and_params):
     assert c.tokens == oracle(qcfg, qparams, p, 6)
 
 
+def test_tp_sharded_int4_engine_matches_unsharded(model_and_params):
+    """int4 + tensor parallelism: the packed kernels inherit the kernel
+    sharding rules (path-substring match: kernel_q4 under q_proj shards
+    columns like kernel), scales replicate, and the grouped-partial
+    einsum must still produce token-identical output."""
+    cfg, params = model_and_params
+    import dataclasses
+
+    from k8s_vgpu_scheduler_tpu.models.quant import quantize_params
+    from k8s_vgpu_scheduler_tpu.parallel.mesh import (
+        MeshShape, make_mesh, param_shardings)
+
+    qcfg = dataclasses.replace(cfg, quant="int4")
+    qparams = quantize_params(params, bits=4)
+    mesh = make_mesh(MeshShape(dp=1, sp=1, tp=4, ep=1),
+                     devices=jax.devices()[:4])
+    sharded = jax.device_put(qparams, param_shardings(mesh, qparams))
+    reqs = [([3, 1, 4, 1, 5], 6), ([9, 2], 8)]
+    ref = ServingEngine(qcfg, qparams, max_slots=2, max_len=32, horizon=2)
+    tpe = ServingEngine(qcfg, sharded, max_slots=2, max_len=32, horizon=2)
+    for p, n in reqs:
+        ref.submit(p, n)
+        tpe.submit(p, n)
+    want = {c.request_id: c.tokens for c in ref.run()}
+    got = {c.request_id: c.tokens for c in tpe.run()}
+    assert got == want
+
+
 def test_int4_quant_composes(model_and_params):
     cfg, params = model_and_params
     from k8s_vgpu_scheduler_tpu.models.quant import quantize_params
