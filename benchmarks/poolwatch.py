@@ -32,6 +32,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from benchmarks.procutil import CLEAN_EXIT_SNIPPET, run_no_kill  # noqa: E402
+from benchmarks.scenarios import current_round  # noqa: E402
+
+
+def round_id() -> str:
+    """The one authority for this process's round: the pinned env var
+    (set by main(), or by the operator) with the manifest's
+    current_round as the fresh-process default."""
+    return os.environ.get("SCENARIO_ROUND") or current_round()
 
 PROBE_SRC = (
     "import time, jax\n"
@@ -115,10 +123,14 @@ def model_tasks():
         # "tried, fields absent").
         # Markers live in a SUBDIR: harvest_spool sweeps stale non-.json
         # FILES from the spool root, but an unlink on a directory fails
-        # harmlessly, so the subdir survives.
+        # harmlessly, so the subdir survives.  The marker name carries the
+        # round (SCENARIO_ROUND, pinned in main()) so "tried once" is
+        # scoped per round — an attempt in r4 must not suppress the retry
+        # in r5.
+        rnd = round_id()
         mdir = os.path.join(os.path.dirname(spool), "upgraded")
         os.makedirs(mdir, exist_ok=True)
-        marker = os.path.join(mdir, name)
+        marker = os.path.join(mdir, f"{rnd}-{name}")
         if upgraded or (onchip and os.path.exists(marker)):
             continue
         if have and have.get("value") and "mfu" in have:
@@ -198,7 +210,7 @@ def run_queue(kinds) -> bool:
         tail = (err or out).strip().splitlines()[-1:] or ["<no output>"]
         log(f"task {name}: rc={rc} in {time.time()-t0:.0f}s | {tail[0][:140]}")
     senv = dict(os.environ)
-    senv.setdefault("SCENARIO_ROUND", "r04")
+    senv.setdefault("SCENARIO_ROUND", round_id())
     if "scen" in kinds:
         for name, fuse in [("enforce", 900.0), ("throttle", 700.0),
                            ("priority", 1500.0), ("cosched", 300.0),
@@ -244,6 +256,13 @@ def main() -> None:
     ap.add_argument("--max-hours", type=float, default=6.0)
     ap.add_argument("--tasks", default="bench,model,micro,scen,oversub")
     a = ap.parse_args()
+    # One round identity for the whole run: model_tasks' per-round retry
+    # markers and run_queue's scenario children both read SCENARIO_ROUND,
+    # so pin it in THIS process's environment before either looks.  The
+    # default comes from tests/artifact_manifest.json (current_round), so
+    # a round rollover is one edit there — no stale literal here can ever
+    # point a drain at a closed round's artifacts.
+    os.environ.setdefault("SCENARIO_ROUND", round_id())
     kinds = [k.strip() for k in a.tasks.split(",") if k.strip()]
     deadline = time.time() + a.max_hours * 3600
     attempt = 0

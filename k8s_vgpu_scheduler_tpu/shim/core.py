@@ -128,6 +128,23 @@ class Shim:
         self._ballast: List[Any] = []
         self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Set by the watchdog when VTPU_OOM_ACTION=exit trips; consumed by
+        # the next dispatching thread at its gate boundary (_gated_call),
+        # which performs the client teardown + exit.  Teardown must not run
+        # on the watchdog thread while a dispatch is in flight elsewhere
+        # (advisor r4: clear_backends there races the main thread's own
+        # Execute on the same client — a wedge risk on pooled backends).
+        self._oom_exit = threading.Event()
+        # When the last dispatch entered the gate — lets the teardown wait
+        # for dispatch quiescence instead of a blind fixed grace.
+        self._last_dispatch_t: Optional[float] = None
+        # Threads currently inside the dispatch region: teardown must not
+        # release the client while any other thread is mid-dispatch.
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        # Only one thread performs the teardown; later claimants park
+        # until the winner's os._exit ends the process.
+        self._teardown_once = threading.Lock()
         self._last_cost_us: Dict[int, int] = {}
         # Dispatch-gate state: every VTPU_SYNC_EVERY-th gated dispatch
         # blocks on its result so the measured time includes device
@@ -243,6 +260,31 @@ class Shim:
         dispatch's output — before the timed dispatch starts.  Error bound:
         between syncs the estimate lags workload changes by at most N
         dispatches."""
+        # Increment FIRST, then check the flag: checking before entering
+        # the region would let a dispatch slip between the check and the
+        # increment while the teardown scans _inflight == 0 (TOCTOU).
+        # Enter-then-check means any thread the teardown cannot see has
+        # either not yet incremented (and will see the flag here) or is
+        # counted.
+        with self._inflight_lock:
+            self._inflight += 1
+        if self._oom_exit.is_set():
+            # Leave the region, then claim the teardown; _oom_teardown
+            # waits for every OTHER dispatching thread to drain out of
+            # the region and for the device to go quiescent before it
+            # releases the client (VTPU_OOM_ACTION=exit).
+            with self._inflight_lock:
+                self._inflight -= 1
+            self._oom_teardown()
+        try:
+            return self._dispatch(fn, holder, args, kwargs, track_devices)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _dispatch(self, fn, holder: "_SlotHolder", args, kwargs,
+                  track_devices: bool):
+        self._last_dispatch_t = self._clock()
         slots = holder.slots or [0]
         for s in slots:
             self.native.lib.vtpu_rate_acquire(
@@ -546,14 +588,13 @@ class Shim:
                                 "HBM grant exceeded on dev %d (%d > %d "
                                 "MiB); clean exit (VTPU_OOM_ACTION=exit)",
                                 i, used // MIB, limit // MIB)
-                            try:
-                                import sys as _sys
-                                if "jax" in _sys.modules:
-                                    from jax.extend import backend as _b
-                                    _b.clear_backends()
-                            except Exception:  # noqa: BLE001
-                                pass
-                            os._exit(137)
+                            # Stop new work at the gate (dispatching
+                            # threads see the flag and claim the teardown
+                            # themselves), then tear down — _oom_teardown
+                            # waits for in-flight dispatches and device
+                            # quiescence before touching the client.
+                            self._oom_exit.set()
+                            self._oom_teardown()
                         elif not warned:
                             log.warning(
                                 "HBM grant exceeded on dev %d (%d > %d MiB)",
@@ -562,6 +603,48 @@ class Shim:
 
         self._watchdog = threading.Thread(target=loop, daemon=True)
         self._watchdog.start()
+
+    def _oom_teardown(self) -> None:
+        """Terminal stage of ``VTPU_OOM_ACTION=exit``: wait until no
+        dispatch can be racing the client, release it, die with the
+        OOM-kill exit code.
+
+        "No dispatch racing" = (a) no thread inside the dispatch region
+        (in-flight counter — teardown claimants leave the region before
+        claiming), and (b) the last dispatch has had its
+        estimated device time (x2) to drain — async dispatches return to
+        the host before the device finishes, so the counter alone is not
+        enough.  An uncosted first dispatch (compile can take minutes) is
+        never provably quiescent, so the wait runs to the hard deadline;
+        past it the device is wedged and no exit is clean anyway."""
+        if not self._teardown_once.acquire(blocking=False):
+            # Another thread is already tearing down; park until its
+            # os._exit ends the process.
+            while True:
+                time.sleep(0.1)
+        grace = float(os.environ.get("VTPU_OOM_EXIT_GRACE_S", "60"))
+        hard = self._clock() + grace
+        while self._clock() < hard:
+            if self._inflight == 0 and self._quiescent():
+                break
+            time.sleep(0.25)
+        try:
+            import sys as _sys
+            if "jax" in _sys.modules:
+                from jax.extend import backend as _b
+                _b.clear_backends()
+        except Exception:  # noqa: BLE001
+            pass
+        os._exit(137)
+
+    def _quiescent(self) -> bool:
+        last = self._last_dispatch_t
+        if last is None:
+            return True
+        costs = list(self._last_cost_us.values())
+        if not costs:
+            return False  # in-flight duration unknown — not provable
+        return self._clock() - last > max(1.0, 2.0 * max(costs) / 1e6)
 
     # -- oversubscription (virtual device memory) ------------------------------
     def start_pressure_spiller(self) -> Optional[Any]:

@@ -113,3 +113,39 @@ def test_evidence_audit_runs_and_is_coherent():
     assert int(n) == _onchip_count(_matrix())
     assert set(state["scenarios"]) >= {"ENFORCE", "THROTTLE", "PRIORITY",
                                        "OVERSUB", "COSCHED", "GANG"}
+
+
+def test_historical_artifacts_frozen():
+    """Prior rounds' proof artifacts are the historical evidence record;
+    a stray local rerun must never rewrite one silently (advisor r4,
+    high: CONTROLPLANE_r03.json was overwritten by a 'doc-only' commit).
+    tests/artifact_manifest.json freezes their sha256; at round rollover
+    the just-closed round's files are ADDED — an existing hash never
+    changes.  Current-round artifacts are exempt (they are still being
+    written by this round's scenario runs)."""
+    import hashlib
+
+    with open(os.path.join(REPO, "tests", "artifact_manifest.json")) as f:
+        manifest = json.load(f)
+    cur = manifest["current_round"]
+    bad = []
+    for name, want in manifest["files"].items():
+        path = os.path.join(REPO, name)
+        if not os.path.exists(path):
+            bad.append(f"{name}: frozen artifact deleted")
+            continue
+        with open(path, "rb") as f:
+            got = hashlib.sha256(f.read()).hexdigest()
+        if got != want:
+            bad.append(f"{name}: content changed since freeze "
+                       f"(restore it from git history, or if a round "
+                       f"rollover legitimately re-froze it, update the "
+                       f"manifest in the same commit with a rationale)")
+    # Every artifact of a PRIOR round must be under freeze — a new file
+    # claiming to be old evidence is as suspect as a rewritten one.
+    cur_n = int(cur.lstrip("r"))
+    for fn in sorted(os.listdir(REPO)):
+        m = re.fullmatch(r"[A-Z]+_r(\d+)\.json", fn)
+        if m and int(m.group(1)) < cur_n and fn not in manifest["files"]:
+            bad.append(f"{fn}: prior-round artifact missing from manifest")
+    assert not bad, "\n".join(bad)
