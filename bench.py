@@ -1208,8 +1208,11 @@ def worker(name: str, out: str, batch: int, size: int, iters: int,
         h = model.hidden
         gate = 2.0 * (x.shape[-1] + h) * 4 * h
         step_flops = batch * size * gate * (3.0 if train else 1.0)
+        result["flops_source"] = "analytic_scan"
     else:
         step_flops = flops_per_step(analysis_step[0], *analysis_step[1])
+        if step_flops:
+            result["flops_source"] = "xla_cost_analysis"
     attach_mfu(result, step_flops, iters / elapsed, jax.devices()[0])
     if shim is not None:
         # Live working-set readback (VERDICT r3 weak #7): sampled HERE,

@@ -37,7 +37,9 @@ def _matrix() -> dict:
 
 
 def _claim_docs():
-    docs = [os.path.join(REPO, "docs", "parity.md")]
+    ddir = os.path.join(REPO, "docs")
+    docs = sorted(os.path.join(ddir, fn) for fn in os.listdir(ddir)
+                  if fn.endswith(".md"))
     docs += sorted(
         os.path.join(REPO, fn) for fn in os.listdir(REPO)
         if re.fullmatch(r"RESULTS_r\d+\.md", fn))
@@ -149,3 +151,110 @@ def test_historical_artifacts_frozen():
         if m and int(m.group(1)) < cur_n and fn not in manifest["files"]:
             bad.append(f"{fn}: prior-round artifact missing from manifest")
     assert not bad, "\n".join(bad)
+
+
+# ---------------------------------------------------------------------------
+# Scenario-artifact field claims (VERDICT r4 item 6): prose that names a
+# field of a <SCEN>_rNN.json artifact must find that field in the NEWEST
+# landed artifact of that scenario — the r4 judge caught a `batch_scaling`
+# claim naming a field no landed artifact contained, with no test red.
+# ---------------------------------------------------------------------------
+
+_SCEN_WORD = re.compile(r"\b([A-Z]{4,})(?:_r(?:\d+|NN)\.json)?\b")
+_FIELD_TOKEN = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z][a-z0-9_]*)*)`")
+_SCOPE_PHRASE = "on-chip path only"
+
+
+def _scenario_names():
+    names = set()
+    for fn in os.listdir(REPO):
+        m = re.fullmatch(r"([A-Z]+)_r\d+\.json", fn)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def _newest_artifact(scen: str):
+    best, best_n = None, -1
+    for fn in os.listdir(REPO):
+        m = re.fullmatch(rf"{scen}_r(\d+)\.json", fn)
+        if m and int(m.group(1)) > best_n:
+            best_n = int(m.group(1))
+            with open(os.path.join(REPO, fn)) as f:
+                best = json.load(f)
+    return best
+
+
+def _writer_field_vocab():
+    """Quoted snake_case string literals in the benchmark writers — the
+    universe of tokens that can be artifact field names (filters out
+    config/CLI/env tokens that happen to be backticked near a scenario
+    mention)."""
+    vocab = set()
+    bdir = os.path.join(REPO, "benchmarks")
+    for fn in os.listdir(bdir):
+        if fn.endswith(".py"):
+            with open(os.path.join(bdir, fn)) as f:
+                vocab |= set(re.findall(r"\"([a-z][a-z0-9_]*)\"", f.read()))
+    return vocab
+
+
+def _has_key_path(obj, path):
+    """True if obj contains `path` as keys (dot = nesting; each segment may
+    sit at any depth below the previous match) OR, for a single segment,
+    as a string value (tokens like memory kinds appear in artifacts as
+    values, not keys — prose citing them is still artifact-consistent)."""
+    if "." not in path and _has_string_value(obj, path):
+        return True
+    def anywhere(o, key):
+        if isinstance(o, dict):
+            if key in o:
+                return [o[key]]
+            return [v for vv in o.values() for v in anywhere(vv, key)]
+        if isinstance(o, list):
+            return [v for vv in o for v in anywhere(vv, key)]
+        return []
+
+    objs = [obj]
+    for seg in path.split("."):
+        objs = [v for o in objs for v in anywhere(o, seg)]
+        if not objs:
+            return False
+    return True
+
+
+def _has_string_value(obj, tok):
+    if isinstance(obj, dict):
+        return any(_has_string_value(v, tok) for v in obj.values())
+    if isinstance(obj, list):
+        return any(_has_string_value(v, tok) for v in obj)
+    return isinstance(obj, str) and tok in obj
+
+
+def test_scenario_artifact_field_claims_hold():
+    scens = _scenario_names()
+    vocab = _writer_field_vocab()
+    failures = []
+    for path, text in _claim_docs():
+        for unit in _paragraphs(text):
+            if _SCOPE_PHRASE in unit.lower():
+                continue
+            named = {w for w, in (m.groups() for m in
+                                  _SCEN_WORD.finditer(unit))} & scens
+            if not named:
+                continue
+            for tok in _FIELD_TOKEN.findall(unit):
+                segs = tok.split(".")
+                if not all(s in vocab for s in segs):
+                    continue  # not an artifact field name
+                if len(segs) == 1 and "_" not in tok:
+                    continue  # too generic to be a field claim
+                if not any(_has_key_path(_newest_artifact(s), tok)
+                           for s in named):
+                    failures.append(
+                        f"{os.path.basename(path)}: claim unit names "
+                        f"{sorted(named)} and field `{tok}`, but the "
+                        f"newest artifact(s) contain no such field — "
+                        f"land the artifact or scope the prose "
+                        f"'{_SCOPE_PHRASE}'")
+    assert not failures, "\n".join(failures)
