@@ -20,7 +20,6 @@ Run:  python benchmarks/controlplane.py        (≈15 s; no chip, no k8s)
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 import threading
@@ -43,7 +42,10 @@ from k8s_vgpu_scheduler_tpu.util.config import Config               # noqa: E402
 # shared so benchmark topology can't silently drift from tested topology.
 from tests.test_scheduler_core import register_node, tpu_pod        # noqa: E402
 
-ROUND = os.environ.get("SCENARIO_ROUND", "r03")
+# Round identity + artifact write go through scenarios.emit so the
+# closed-history guard applies here too — THIS writer's stale default
+# is how CONTROLPLANE_r03.json got silently rewritten (advisor r4).
+from benchmarks.scenarios import ROUND, emit                        # noqa: E402
 
 
 def bench_throughput() -> dict:
@@ -139,10 +141,7 @@ def main() -> None:
     result.update(bench_watch_latency())
     result["passed"] = (result["filter_bind_cycles_per_s"] > 20
                        and result["watch_release_latency_s"]["p95"] < 1.0)
-    path = os.path.join(REPO, f"CONTROLPLANE_{ROUND}.json")
-    with open(path, "w") as f:
-        json.dump(result, f, indent=1)
-    print(json.dumps(result))
+    emit("controlplane", result)
 
 
 if __name__ == "__main__":

@@ -41,6 +41,7 @@ def bench_state() -> dict:
             "value": (r or {}).get("value"),
             "vs_baseline": (r or {}).get("vs_baseline"),
             "mfu": (r or {}).get("mfu"),
+            "flops_source": (r or {}).get("flops_source"),
             "used_mib": ((r or {}).get("memory_info_mib") or {}).get("used"),
         }
     micro = {}
@@ -79,6 +80,8 @@ def scenario_state() -> dict:
             "degraded": bool(d.get("degraded")),
             "platform": d.get("platform"),
         }
+        if "band_converged" in d:
+            out[name]["band_converged"] = d["band_converged"]
     return out
 
 
@@ -94,6 +97,8 @@ def main() -> None:
         extras = []
         if c["mfu"] is not None:
             extras.append(f"mfu={c['mfu']}")
+            if c["flops_source"]:
+                extras.append(f"({c['flops_source']})")
         if c["used_mib"] is not None:
             extras.append(f"used={c['used_mib']}MiB")
         if c["vs_baseline"]:
@@ -114,7 +119,9 @@ def main() -> None:
         else:
             # cosched/gang/preempt/controlplane never touch the chip.
             tag = "chip-free"
-        print(f"  {name:12s} {s['round']}  passed={s['passed']}  {tag}")
+        extra = (f"  band_converged={s['band_converged']}"
+                 if "band_converged" in s else "")
+        print(f"  {name:12s} {s['round']}  passed={s['passed']}  {tag}{extra}")
 
 
 if __name__ == "__main__":

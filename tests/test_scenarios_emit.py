@@ -29,6 +29,11 @@ spec.loader.exec_module(scenarios)
 def sandbox(tmp_path, monkeypatch):
     monkeypatch.setattr(scenarios, "REPO", str(tmp_path))
     monkeypatch.setattr(scenarios, "ROUND", "rtest")
+    # emit() only writes in place for the manifest's current round; give
+    # the sandbox its own manifest so "rtest" IS current here.
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "artifact_manifest.json").write_text(
+        json.dumps({"current_round": "rtest", "files": {}}))
     return tmp_path
 
 
@@ -71,3 +76,46 @@ class TestEmitRanking:
         scenarios.emit("demo", {"passed": False, "error": "regressed"})
         assert read(sandbox, "demo")["passed"] is True   # file keeps pass
         assert scenarios.LAST_RESULTS["demo"] is False   # strict sees fail
+
+
+class TestClosedHistoryGuard:
+    """advisor r4 high: a rerun carrying a stale round must never write a
+    prior round's artifact — not rewrite an existing one, not fabricate a
+    missing one."""
+
+    def test_stale_round_rewrite_displaced(self, sandbox, monkeypatch):
+        scenarios.emit("demo", {"passed": True, "platform": "tpu"})
+        frozen = read(sandbox, "demo")
+        monkeypatch.setattr(scenarios, "ROUND", "rstale")
+        (sandbox / "DEMO_rstale.json").write_text(json.dumps(frozen))
+        scenarios.emit("demo", {"passed": True, "platform": "tpu",
+                                "value": 999})
+        with open(sandbox / "DEMO_rstale.json") as f:
+            assert "value" not in json.load(f)
+        with open(sandbox / "DEMO_rstale.displaced.json") as f:
+            assert json.load(f)["value"] == 999
+
+    def test_stale_round_fabrication_displaced(self, sandbox, monkeypatch):
+        monkeypatch.setattr(scenarios, "ROUND", "rstale")
+        scenarios.emit("demo", {"passed": True})
+        assert not (sandbox / "DEMO_rstale.json").exists()
+        assert (sandbox / "DEMO_rstale.displaced.json").exists()
+
+    def test_current_round_reads_manifest(self, sandbox):
+        assert scenarios.current_round() == "rtest"
+
+
+class TestThrottleRankTieBreak:
+    def test_converged_not_displaced_by_merely_engaged(self, sandbox):
+        scenarios.emit("demo", {"passed": True, "platform": "tpu",
+                                "band_converged": True, "duty": 0.30})
+        scenarios.emit("demo", {"passed": True, "platform": "tpu",
+                                "band_converged": False, "duty": 0.16})
+        assert read(sandbox, "demo")["duty"] == 0.30
+
+    def test_converged_upgrades_engaged(self, sandbox):
+        scenarios.emit("demo", {"passed": True, "platform": "tpu",
+                                "band_converged": False, "duty": 0.16})
+        scenarios.emit("demo", {"passed": True, "platform": "tpu",
+                                "band_converged": True, "duty": 0.30})
+        assert read(sandbox, "demo")["duty"] == 0.30
