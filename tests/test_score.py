@@ -198,3 +198,16 @@ class TestFitPod:
                      devices=[[ContainerDevice("chip-0", "TPU-v5e", 8192, 50)]])],
         )
         assert node_score(empty) > node_score(half)
+
+
+def test_token_less_whitelist_matches_nothing():
+    """A present-but-blank use-type annotation (' ', ',,') rejects every
+    chip — reference `if use:` semantics; it must not silently degrade
+    to no-restriction (caught by advisor review of the affinity hoist)."""
+    from k8s_vgpu_scheduler_tpu.scheduler.score import check_type
+    from k8s_vgpu_scheduler_tpu.util.types import TPU_USE_TYPE_ANNOTATION
+
+    for bad in (" ", ",,", " , "):
+        assert not check_type({TPU_USE_TYPE_ANNOTATION: bad}, "v5e")
+    assert check_type({TPU_USE_TYPE_ANNOTATION: ""}, "v5e")
+    assert check_type({}, "v5e")
