@@ -103,6 +103,11 @@ class Scheduler:
         self.pods = PodManager()
         self.gangs = GangManager()
         self._filter_lock = threading.Lock()
+        # get_nodes_usage per-node base-usage cache, keyed on (pod rev,
+        # inventory rev); its own lock because the watch thread's pod
+        # events race Filter calls.
+        self._usage_cache_lock = threading.Lock()
+        self._usage_cache: Dict[str, tuple] = {}
         # uid -> monotonic time of its DELETE.  k8s uids never return, so
         # a replayed ADDED for one of these (a resync list older than the
         # delete) must be ignored or it re-books a dead pod's chips.
@@ -316,25 +321,45 @@ class Scheduler:
 
     # -- usage snapshot --------------------------------------------------------
     def _pods_by_node(self) -> Dict[str, List[PodInfo]]:
-        """One grouping used by BOTH the usage snapshot and the preemption
-        planner — they must see the same pod→node mapping."""
-        out: Dict[str, List[PodInfo]] = {}
-        for p in self.pods.list_pods():
-            out.setdefault(p.node, []).append(p)
-        return out
+        """Pod→node grouping for the preemption planner (the usage
+        snapshot reads the registry's by-node index directly)."""
+        return self.pods.by_node()
 
     def get_nodes_usage(
         self, node_names: Optional[List[str]] = None
     ) -> Dict[str, Tuple[NodeInfo, Dict[str, score_mod.DeviceUsage]]]:
         """Registered inventory minus scheduled grants, per node
-        (reference getNodesUsage, scheduler.go:176–222)."""
+        (reference getNodesUsage, scheduler.go:176–222 — which rebuilds
+        from EVERY pod on every Filter, the O(pods × devices) hot loop
+        SURVEY §3.1 flags).  Here each node's base usage is cached under
+        a (pod rev, inventory rev) key and rebuilt only when that node
+        actually changed; callers get fresh COPIES because fit_pod
+        mutates its snapshot.  Revs are read before the data they key, so
+        a concurrent change can only force a rebuild, never hide one."""
+        # Revs FIRST, then the data they key (inventory and pods): a
+        # change landing between the reads makes the data newer than its
+        # key, which can only force a spurious rebuild later — reading
+        # data first would let a concurrent re-registration cache stale
+        # usage under the new rev and serve it indefinitely.
+        pod_revs = self.pods.node_revs()
+        node_revs = self.nodes.node_revs()
         all_nodes = self.nodes.list_nodes()
-        pods_by_node = self._pods_by_node()
         out = {}
-        for name, info in all_nodes.items():
-            if node_names is not None and name not in node_names:
-                continue
-            out[name] = (info, score_mod.build_usage(info, pods_by_node.get(name, [])))
+        clone = score_mod.clone_usage
+        with self._usage_cache_lock:
+            for gone in set(self._usage_cache) - set(all_nodes):
+                del self._usage_cache[gone]
+            for name, info in all_nodes.items():
+                if node_names is not None and name not in node_names:
+                    continue
+                key = (pod_revs.get(name, 0), node_revs.get(name, 0))
+                cached = self._usage_cache.get(name)
+                if cached is None or cached[0] != key:
+                    cached = (key, score_mod.build_usage(
+                        info, self.pods.pods_on_node(name)))
+                    self._usage_cache[name] = cached
+                out[name] = (info, {cid: clone(u)
+                                    for cid, u in cached[1].items()})
         return out
 
     def inspect_all_nodes_usage(self):

@@ -40,6 +40,7 @@ class NodeManager:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._nodes: Dict[str, NodeInfo] = {}
+        self._rev: Dict[str, int] = {}
 
     def add_node(self, name: str, info: NodeInfo) -> None:
         """Each registration message carries the node's FULL inventory, so it
@@ -48,6 +49,7 @@ class NodeManager:
         schedulable.  (The reference merges by id, nodes.go:269–281, which
         keeps stale chips alive; deliberate deviation.)"""
         with self._lock:
+            self._rev[name] = self._rev.get(name, 0) + 1
             existing = self._nodes.get(name)
             if existing is None or not existing.devices:
                 self._nodes[name] = info
@@ -60,7 +62,14 @@ class NodeManager:
         """Node agent stream broke → its inventory is no longer trustworthy
         (reference rmNodeDevice, nodes.go:283–305)."""
         with self._lock:
+            self._rev[name] = self._rev.get(name, 0) + 1
             self._nodes.pop(name, None)
+
+    def node_revs(self) -> Dict[str, int]:
+        """Inventory change counters (same rev-before-data contract as
+        PodManager.node_revs)."""
+        with self._lock:
+            return dict(self._rev)
 
     def get_node(self, name: str) -> Optional[NodeInfo]:
         with self._lock:

@@ -34,17 +34,44 @@ class PodInfo:
 
 
 class PodManager:
+    """Also maintains a by-node index and a per-node revision counter so
+    the scheduler's usage snapshot can be cached per node and rebuilt
+    only when that node's pod set actually changed — the reference
+    rebuilds O(pods × devices) on EVERY Filter call (scheduler.go:176–222,
+    flagged in SURVEY §3.1), a cost this index removes."""
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._pods: Dict[str, PodInfo] = {}
+        self._by_node: Dict[str, Dict[str, PodInfo]] = {}
+        self._rev: Dict[str, int] = {}
+
+    def _bump(self, node: str) -> None:
+        self._rev[node] = self._rev.get(node, 0) + 1
 
     def add_pod(self, info: PodInfo) -> None:
         with self._lock:
+            prev = self._pods.get(info.uid)
+            if prev is not None and prev.node != info.node:
+                bucket = self._by_node.get(prev.node)
+                if bucket:
+                    bucket.pop(info.uid, None)
+                self._bump(prev.node)
             self._pods[info.uid] = info
+            self._by_node.setdefault(info.node, {})[info.uid] = info
+            self._bump(info.node)
 
     def del_pod(self, uid: str) -> None:
         with self._lock:
-            self._pods.pop(uid, None)
+            info = self._pods.pop(uid, None)
+            if info is None:
+                return
+            bucket = self._by_node.get(info.node)
+            if bucket is not None:
+                bucket.pop(uid, None)
+                if not bucket:
+                    del self._by_node[info.node]
+            self._bump(info.node)
 
     def get(self, uid: str) -> Optional[PodInfo]:
         with self._lock:
@@ -53,3 +80,20 @@ class PodManager:
     def list_pods(self) -> List[PodInfo]:
         with self._lock:
             return list(self._pods.values())
+
+    def pods_on_node(self, node: str) -> List[PodInfo]:
+        with self._lock:
+            return list(self._by_node.get(node, {}).values())
+
+    def by_node(self) -> Dict[str, List[PodInfo]]:
+        with self._lock:
+            return {n: list(b.values()) for n, b in self._by_node.items()}
+
+    def node_revs(self) -> Dict[str, int]:
+        """All per-node change counters in one lock acquisition.  Callers
+        must read revs BEFORE the data they key (pods_on_node): data
+        fetched after the rev is at least as new as the rev, so a cache
+        keyed on it can only be transiently conservative (rebuild), never
+        silently stale."""
+        with self._lock:
+            return dict(self._rev)

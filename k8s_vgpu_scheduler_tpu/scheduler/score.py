@@ -134,6 +134,14 @@ def _type_ok(affinity: Tuple[Optional[List[str]], List[str]],
     return True
 
 
+def clone_usage(u: DeviceUsage) -> DeviceUsage:
+    """Positional copy — measurably cheaper than dataclasses.replace in
+    the per-Filter snapshot loop (nodes x chips copies per call)."""
+    return DeviceUsage(u.id, u.type, u.health, u.coords, u.total_slots,
+                       u.used_slots, u.total_mem, u.used_mem,
+                       u.total_cores, u.used_cores)
+
+
 def check_type(annotations: Dict[str, str], dev_type: str) -> bool:
     """Type affinity white/blacklist (reference checkGPUtype, score.go:67–87):
     comma-separated case-insensitive substring match."""

@@ -129,3 +129,84 @@ class TestTorusSliceProperties:
         topo = TopologyDesc(generation="t", mesh=mesh)
         got = torus.find_slice(topo, free, n, "best-effort")
         assert (got is not None) == (n <= len(free))
+
+
+# ---------------------------------------------------------------------------
+# Usage-cache coherence: get_nodes_usage's revision-keyed per-node cache
+# must be indistinguishable from a from-scratch rebuild after ANY event
+# sequence (pod add/del/move, node register/re-register/remove).  A stale
+# cache double-books or phantom-frees chips — the worst silent failure a
+# scheduler can have.
+# ---------------------------------------------------------------------------
+
+def _mk_scheduler():
+    from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+    from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+    from k8s_vgpu_scheduler_tpu.util.config import Config
+
+    return Scheduler(FakeKube(), Config())
+
+
+def _node_info(name, n_chips, devmem=16384):
+    from k8s_vgpu_scheduler_tpu.scheduler.nodes import DeviceInfo, NodeInfo
+
+    return NodeInfo(name=name, devices=[
+        DeviceInfo(id=f"{name}-c{i}", count=8, devmem=devmem, type="v5e",
+                   health=True, coords=(i, 0)) for i in range(n_chips)])
+
+
+def _pod_info(uid, node, mem):
+    from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+    from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+    return PodInfo(uid=uid, name=uid, namespace="default", node=node,
+                   devices=[[ContainerDevice(uuid=f"{node}-c0", type="v5e",
+                                             usedmem=mem, usedcores=10)]])
+
+
+_NODES = ["n0", "n1", "n2"]
+_usage_event = st.one_of(
+    st.tuples(st.just("add_pod"), st.sampled_from(_NODES),
+              st.integers(0, 19), st.integers(100, 4000)),
+    st.tuples(st.just("del_pod"), st.integers(0, 19)),
+    st.tuples(st.just("register"), st.sampled_from(_NODES),
+              st.integers(1, 4)),
+    st.tuples(st.just("rm_node"), st.sampled_from(_NODES)),
+    st.tuples(st.just("snapshot")),
+)
+
+
+class TestUsageCacheCoherence:
+    @settings(max_examples=60, deadline=None)
+    @given(st.lists(_usage_event, min_size=1, max_size=40))
+    def test_cached_equals_scratch(self, events):
+        from k8s_vgpu_scheduler_tpu.scheduler import score as score_mod
+
+        s = _mk_scheduler()
+        for ev in events:
+            if ev[0] == "add_pod":
+                _, node, i, mem = ev
+                s.pods.add_pod(_pod_info(f"u{i}", node, mem))
+            elif ev[0] == "del_pod":
+                s.pods.del_pod(f"u{ev[1]}")
+            elif ev[0] == "register":
+                s.nodes.add_node(ev[1], _node_info(ev[1], ev[2]))
+            elif ev[0] == "rm_node":
+                s.nodes.rm_node(ev[1])
+            else:
+                s.get_nodes_usage()  # populate/refresh the cache mid-stream
+        got = {n: usage for n, (_, usage) in s.get_nodes_usage().items()}
+        # From scratch: same registries, no cache.
+        pods_by_node = {}
+        for p in s.pods.list_pods():
+            pods_by_node.setdefault(p.node, []).append(p)
+        want = {n: score_mod.build_usage(info, pods_by_node.get(n, []))
+                for n, info in s.nodes.list_nodes().items()}
+        assert got == want
+        # And the handed-out copies are safe to mutate: a second snapshot
+        # must not see the first one's mutations.
+        for usage in got.values():
+            for u in usage.values():
+                u.used_mem += 12345
+        again = {n: usage for n, (_, usage) in s.get_nodes_usage().items()}
+        assert again == want

@@ -338,3 +338,38 @@ class TestNodesFormExtender:
         assert out["Error"] == ""
         assert out["NodeNames"] == ["node-a"]
         assert [n["metadata"]["name"] for n in out["Nodes"]["items"]] == ["node-a"]
+
+
+def test_usage_cache_conservative_under_reregistration_race():
+    """A node re-registration landing between the usage cache's rev read
+    and its data read must only ever cause a spurious rebuild, never a
+    stale cache hit (advisor review of the rev-keyed cache: with the
+    reads inverted, the new inventory's rev would key the OLD inventory's
+    usage and serve it indefinitely)."""
+    kube = FakeKube()
+    s = Scheduler(kube, Config())
+    register_node(s, "node-a", chips=4)
+    s.get_nodes_usage()  # warm the cache
+
+    orig = s.nodes.node_revs
+
+    def racy_revs():
+        # Stream-break + re-registration (2 chips now) lands at the
+        # rev-read boundary: with the contract ordering (revs before
+        # data) the change is IN the revs and the data, so the fresh
+        # inventory is cached under its own key; with the reads inverted
+        # it lands after the stale data was read but inside the new rev
+        # — the stale-forever case this test exists to catch.  (rm+add,
+        # not a bare re-register: a merge mutates the shared NodeInfo in
+        # place, which an already-taken list_nodes snapshot would see.)
+        s.nodes.rm_node("node-a")
+        register_node(s, "node-a", chips=2)
+        s.nodes.node_revs = orig  # one-shot
+        return orig()
+
+    s.nodes.node_revs = racy_revs
+    s.get_nodes_usage()  # may cache either view under the OLD key
+
+    usage = s.get_nodes_usage()["node-a"][1]
+    assert len(usage) == 2, (
+        f"stale inventory served from cache: {sorted(usage)}")
