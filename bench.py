@@ -606,10 +606,18 @@ def flash_worker(out_path: str) -> None:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    from k8s_vgpu_scheduler_tpu.ops import flash_attention as fa
+    # NOT "import ...ops.flash_attention as fa": ops/__init__ re-exports
+    # the flash_attention FUNCTION, and "import a.b as c" resolves c via
+    # getattr(a, "b"), so the function would shadow the module.
+    import importlib
+    fa = importlib.import_module(
+        "k8s_vgpu_scheduler_tpu.ops.flash_attention")
 
     platform = jax.devices()[0].platform
-    B, H, d = 4, 8, 128
+    tiny = os.environ.get("BENCH_FLASH_TINY") == "1"
+    B, H, d = (1, 2, 128) if tiny else (4, 8, 128)
+    seqs = (256,) if tiny else (2048, 4096, 8192)
+    numerics_at = 256 if tiny else 2048
     rows = []
 
     def write():
@@ -626,7 +634,7 @@ def flash_worker(out_path: str) -> None:
         }
         write_result(out_path, result)
 
-    for T in (2048, 4096, 8192):
+    for T in seqs:
         try:
             rng = jax.random.PRNGKey(T)
             kq, kk, kv = jax.random.split(rng, 3)
@@ -635,7 +643,7 @@ def flash_worker(out_path: str) -> None:
             v = jax.random.normal(kv, (B, T, H, d), jnp.bfloat16)
 
             flash = jax.jit(lambda q, k, v: fa.flash_attention(
-                q, k, v, causal=True, interpret=False))
+                q, k, v, causal=True, interpret=None))
             naive = jax.jit(lambda q, k, v: fa._reference(
                 q, k, v, 1.0 / d ** 0.5, True))
 
@@ -649,7 +657,51 @@ def flash_worker(out_path: str) -> None:
                 return (time.perf_counter() - t0) / n
 
             t_flash = timed(flash)
-            row = {"seq": T, "flash_ms": round(t_flash * 1e3, 3)}
+            row = {"seq": T, "flash_ms": round(t_flash * 1e3, 3),
+                   "pallas_fwd_ok": True}
+            if T == numerics_at:
+                # First-ever real-compiler legs (VERDICT r4 item 2):
+                # numerics vs the naive oracle at bf16 tolerances, then
+                # the Pallas BACKWARD kernels (custom-vjp dq/dkv) — the
+                # CPU interpreter can never prove these lower on TPU.
+                # Each leg in its own try: a NAIVE-side failure (the
+                # O(T²) oracle OOMing) must not erase the already-
+                # successful flash row or masquerade as a Pallas
+                # lowering failure.
+                try:
+                    err = float(jnp.max(jnp.abs(
+                        flash(q, k, v).astype(jnp.float32)
+                        - naive(q, k, v).astype(jnp.float32))))
+                    row["fwd_max_abs_err"] = round(err, 5)
+                    row["fwd_numerics_ok"] = bool(err < 3e-2)
+                except Exception as fe:  # noqa: BLE001 — record, keep row
+                    row["fwd_numerics_error"] = \
+                        f"{type(fe).__name__}: {fe}"[:200]
+                try:
+                    grad_flash = jax.jit(jax.grad(
+                        lambda q, k, v: fa.flash_attention(
+                            q, k, v, causal=True, interpret=None)
+                        .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+                    grad_naive = jax.jit(jax.grad(
+                        lambda q, k, v: fa._reference(
+                            q, k, v, 1.0 / d ** 0.5, True)
+                        .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+                    t_b = timed(lambda *a: grad_flash(*a))
+                    row["bwd_ms"] = round(t_b * 1e3, 3)
+                    gerr = max(
+                        float(jnp.max(jnp.abs(
+                            gf.astype(jnp.float32)
+                            - gn.astype(jnp.float32))))
+                        for gf, gn in zip(grad_flash(q, k, v),
+                                          grad_naive(q, k, v)))
+                    row["bwd_max_abs_err"] = round(gerr, 5)
+                    # Sum-of-T-terms gradients accumulate bf16 rounding;
+                    # scale the forward tolerance by ~sqrt growth.
+                    row["bwd_numerics_ok"] = bool(gerr < 2e-1)
+                    row["pallas_bwd_ok"] = True
+                except Exception as be:  # noqa: BLE001 — record, keep fwd
+                    row["pallas_bwd_ok"] = False
+                    row["bwd_error"] = f"{type(be).__name__}: {be}"[:200]
             # Causal forward FLOPs: (QK^T + PV) · causal half = 2·B·H·T²·d.
             fl = 2.0 * B * H * T * T * d
             row["flash_tflops_per_s"] = round(fl / t_flash / 1e12, 2)
@@ -662,7 +714,8 @@ def flash_worker(out_path: str) -> None:
             row.update(naive_ms=round(t_naive * 1e3, 3),
                        speedup=round(t_naive / t_flash, 3))
         except Exception as e:  # noqa: BLE001 — keep earlier rows
-            rows.append({"seq": T, "error": f"{type(e).__name__}: {e}"[:200]})
+            rows.append({"seq": T, "pallas_fwd_ok": False,
+                         "error": f"{type(e).__name__}: {e}"[:200]})
         write()
 
 

@@ -107,8 +107,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, sm_scale: float,
         # Per-row logsumexp of the (scaled) scores — the backward's
         # recomputation anchor: P = exp(S - lse) without a second online
         # pass.  Only the training path requests it; inference skips the
-        # extra (B·H, T) write.
-        maybe_lse_ref[0][...] = (m + jnp.log(l))[:, 0]
+        # extra (B·H, T, 1) write.  Trailing-unit layout: every lse/delta
+        # ref in these kernels stays rank-2 — Mosaic's proven territory —
+        # instead of rank-1 blocks needing lane↔sublane relayouts
+        # ([:, None] / [:, 0]) that no shipped TPU kernel exercises.
+        maybe_lse_ref[0][...] = m + jnp.log(l)
 
 
 def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
@@ -124,8 +127,9 @@ def _flash_fwd_impl(q, k, v, sm_scale: float, causal: bool,
     out_specs = [pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((B * H, T, d), q.dtype)]
     if return_lse:
-        out_specs.append(pl.BlockSpec((None, block_q), lambda b, i: (b, i)))
-        out_shape.append(jax.ShapeDtypeStruct((B * H, T), jnp.float32))
+        out_specs.append(
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32))
     res = pl.pallas_call(
         functools.partial(
             _kernel, sm_scale=sm_scale, causal=causal,
@@ -174,8 +178,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     qs = q_ref[...].astype(jnp.float32) * sm_scale
     do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...][:, None]
-    delta = delta_ref[...][:, None]
+    lse = lse_ref[...]        # (bq, 1): trailing-unit, rank-2 end to end
+    delta = delta_ref[...]
 
     def body(j, acc):
         k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -222,8 +226,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         qs = q_ref[pl.ds(i * block_q, block_q), :].astype(
             jnp.float32) * sm_scale
         do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[pl.ds(i * block_q, block_q)][:, None]
-        delta = delta_ref[pl.ds(i * block_q, block_q)][:, None]
+        lse = lse_ref[pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[pl.ds(i * block_q, block_q), :]
         s = jax.lax.dot_general(
             qs, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (bq, bk)
@@ -272,21 +276,21 @@ def _flash_bwd_impl(q, k, v, o, lse, g, sm_scale, causal, block_q, block_k,
     # Δ_i = rowsum(dO_i ⊙ O_i) — O(T·d), plain XLA, fused upstream.
     delta = jnp.sum(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    ).transpose(0, 2, 1).reshape(B * H, T)
+    ).transpose(0, 2, 1).reshape(B * H, T, 1)
 
     qkv_specs = [
         pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
         pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
         pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
         pl.BlockSpec((None, T, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((None, T), lambda b, i: (b, 0)),
-        pl.BlockSpec((None, T), lambda b, i: (b, 0)),
+        pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((None, T, 1), lambda b, i: (b, 0, 0)),
     ]
     dq_specs = list(qkv_specs)
     dq_specs[0] = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
     dq_specs[3] = pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0))
-    dq_specs[4] = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
-    dq_specs[5] = pl.BlockSpec((None, block_q), lambda b, i: (b, i))
+    dq_specs[4] = pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0))
+    dq_specs[5] = pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_k=block_k, seq_len=T, window=window),
