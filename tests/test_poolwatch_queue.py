@@ -1,0 +1,147 @@
+"""Poolwatch drain plumbing (benchmarks/poolwatch.py).
+
+The drain runs once, on the first healthy pool window of a round — the
+same one-shot property that let a never-executed flash-worker import bug
+survive to review.  These tests execute the queue composition and the
+run_queue sequencing with a fake runner, so argv, skip logic, round-
+scoped markers and fuse wiring are proven without a chip or a real
+bench run."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+spec = importlib.util.spec_from_file_location(
+    "poolwatch", os.path.join(REPO, "benchmarks", "poolwatch.py"))
+poolwatch = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(poolwatch)
+
+
+@pytest.fixture
+def sandbox(tmp_path, monkeypatch):
+    monkeypatch.setattr(poolwatch, "REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "SPOOL", str(tmp_path / ".bench_spool"))
+    monkeypatch.setenv("SCENARIO_ROUND", "rt")
+    return tmp_path
+
+
+def _write_matrix(tmp_path, rows):
+    with open(tmp_path / "bench_matrix.json", "w") as f:
+        json.dump(rows, f)
+
+
+class TestModelTasks:
+    def test_all_cases_queued_when_matrix_empty(self, sandbox):
+        _write_matrix(sandbox, [])
+        tasks = poolwatch.model_tasks()
+        names = {t[0] for t in tasks}
+        assert names == set(bench.CASES)
+        for name, argv, fuse, marker in tasks:
+            assert argv[0] == sys.executable
+            assert "--worker" in argv and name in argv
+            assert os.path.basename(marker) == f"rt-{name}"
+            # Train cases get the longer fuse and the --train flag.
+            if bench.CASES[name]["train"]:
+                assert "--train" in argv and fuse == 600.0
+            else:
+                assert "--train" not in argv and fuse == 420.0
+
+    def test_upgraded_onchip_entry_skipped(self, sandbox):
+        name = next(iter(bench.CASES))
+        _write_matrix(sandbox, [{
+            "metric": name, "platform": "tpu", "value": 1.0,
+            "mfu": 0.2, "memory_info_mib": {"used": 123}}])
+        assert name not in {t[0] for t in poolwatch.model_tasks()}
+
+    def test_stale_onchip_entry_requeued_once_per_round(self, sandbox):
+        name = next(iter(bench.CASES))
+        _write_matrix(sandbox, [{
+            "metric": name, "platform": "tpu", "value": 1.0,
+            "memory_info_mib": {"used": 0}}])  # pre-mfu-era entry
+        tasks = {t[0]: t for t in poolwatch.model_tasks()}
+        assert name in tasks
+        # An attempt THIS round suppresses the retry...
+        with open(tasks[name][3], "w") as f:
+            f.write("1")
+        assert name not in {t[0] for t in poolwatch.model_tasks()}
+        # ...but another round's marker must not (advisor r4 low #2).
+        os.environ["SCENARIO_ROUND"] = "rt2"
+        try:
+            assert name in {t[0] for t in poolwatch.model_tasks()}
+        finally:
+            os.environ["SCENARIO_ROUND"] = "rt"
+
+    def test_fresh_spooled_result_not_requeued(self, sandbox):
+        _write_matrix(sandbox, [])
+        name = next(iter(bench.CASES))
+        with open(bench.spool_path(name), "w") as f:
+            json.dump({"metric": name, "value": 2.0, "mfu": 0.1}, f)
+        assert name not in {t[0] for t in poolwatch.model_tasks()}
+
+
+class TestMicroTasks:
+    def test_all_queued_then_skipped_when_onchip(self, sandbox):
+        _write_matrix(sandbox, [])
+        names = {t[0] for t in poolwatch.micro_tasks()}
+        assert names == {bench.FLASH_CASE, bench.DECODE_CASE,
+                         bench.SPEC_CASE, bench.SERVE_CASE}
+        _write_matrix(sandbox, [
+            {"metric": bench.FLASH_CASE, "platform": "tpu", "value": 3.0}])
+        assert bench.FLASH_CASE not in {
+            t[0] for t in poolwatch.micro_tasks()}
+
+    def test_micro_workers_have_flag_argv(self, sandbox):
+        _write_matrix(sandbox, [])
+        for name, argv, fuse, marker in poolwatch.micro_tasks():
+            flag = [a for a in argv if a.startswith("--")]
+            assert flag and flag[0].endswith("-worker")
+            assert marker is None
+
+
+class TestRunQueue:
+    def test_sequence_markers_and_env(self, sandbox, monkeypatch):
+        _write_matrix(sandbox, [])
+        calls = []
+
+        def fake_run(argv, env, fuse):
+            calls.append((argv, env, fuse))
+            return 0, "ok", ""
+
+        monkeypatch.setattr(poolwatch, "run_no_kill", fake_run)
+        assert poolwatch.run_queue(["bench", "model", "micro",
+                                    "scen", "oversub"]) is True
+        # bench budget run first, then model workers, micro workers,
+        # scenario children, oversub.
+        joined = [" ".join(a) for a, _, _ in calls]
+        assert "bench.py" in joined[0]
+        assert sum("--worker" in j for j in joined) == len(bench.CASES)
+        assert sum("scenarios.py" in j for j in joined) == 6  # 5 scen + oversub
+        # Scenario children inherit the pinned round.
+        scen_envs = [e for a, e, _ in calls if "scenarios.py" in " ".join(a)]
+        assert all(e.get("SCENARIO_ROUND") == "rt" for e in scen_envs)
+        # rc=0 model tasks leave round-scoped markers.
+        mdir = sandbox / ".bench_spool" / "upgraded"
+        assert sorted(os.listdir(mdir)) == sorted(
+            f"rt-{n}" for n in bench.CASES)
+
+    def test_overrun_stops_queue(self, sandbox, monkeypatch):
+        _write_matrix(sandbox, [])
+        calls = []
+
+        def fake_run(argv, env, fuse):
+            calls.append(argv)
+            return (None, "", "") if len(calls) == 2 else (0, "ok", "")
+
+        monkeypatch.setattr(poolwatch, "run_no_kill", fake_run)
+        assert poolwatch.run_queue(["bench", "model"]) is False
+        # The overrunning worker (2nd call) must be the last attempted —
+        # the queue stops to protect the serialized pool claim.
+        assert len(calls) == 2
