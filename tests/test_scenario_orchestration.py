@@ -1,0 +1,213 @@
+"""On-chip orchestration proofs for the scenario runners
+(benchmarks/scenarios.py) with FULLY FAKED children — no model compiles,
+no chip, sub-second: deliberately fast-tier so `make test-fast` proves
+legs A-E and the output-breach branch before the drain's one shot."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "scenarios", os.path.join(REPO, "benchmarks", "scenarios.py"))
+scenarios = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(scenarios)
+
+
+@pytest.fixture
+def sandbox(tmp_path, monkeypatch):
+    monkeypatch.setattr(scenarios, "REPO", str(tmp_path))
+    monkeypatch.setattr(scenarios, "ROUND", "rtest")
+    # Keep the runners' scratch dirs inside pytest's tmp tree.
+    def _mkdtemp(prefix="t"):
+        d = tmp_path / f"{prefix}scratch"
+        d.mkdir(exist_ok=True)
+        return str(d)
+
+    monkeypatch.setattr(scenarios.tempfile, "mkdtemp", _mkdtemp)
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "artifact_manifest.json").write_text(
+        json.dumps({"current_round": "rtest", "files": {}}))
+    return tmp_path
+
+
+def read(tmp_path, name):
+    with open(tmp_path / f"{name.upper()}_rtest.json") as f:
+        return json.load(f)
+
+
+class TestOversubOnchipOrchestration:
+    """The on-chip legs A-E of scenario_oversub have never executed (the
+    pool outage forced the degraded path in every round) — fake the
+    children so the marker parsing, batch_scaling assembly, refusal
+    logic, and passed verdict are proven before the drain's one shot."""
+
+    def _run(self, sandbox, monkeypatch, outputs, rcs=None):
+        monkeypatch.setattr(scenarios, "build_native", lambda: None)
+        monkeypatch.setattr(scenarios, "tpu_available", lambda: True)
+        calls = []
+
+        def fake_child(src, env, timeout, interposer=False):
+            mode = env.get("SCEN_OVERSUB_MODE")
+            win = env.get("SCEN_WIN_CFG") == "1"
+            key = (mode, win, bool(interposer))
+            calls.append(key)
+            rc = (rcs or {}).get(key, 0)
+            err = f"boom in {key}\ntraceback tail" if rc else ""
+            return rc, outputs.get(key, ""), err
+
+        monkeypatch.setattr(scenarios, "run_child", fake_child)
+        scenarios.scenario_oversub()
+        return calls, read(sandbox, "oversub")
+
+    def test_full_win_path(self, sandbox, monkeypatch):
+        outputs = {
+            ("baseline", False, False):
+                'BASELINE {"tokens_per_s": 1000.0, "loss": 2.5, '
+                '"opt_state_mib": 3500}',
+            ("baseline", False, True):
+                'BASELINE_REFUSED {"error": "RESOURCE_EXHAUSTED: '
+                'vtpu grant"}',
+            ("offload", False, True):
+                'OFFLOAD {"tokens_per_s": 800.0, "loss": 2.501, '
+                '"opt_state_mib": 3500, '
+                '"opt_state_memory_kinds": ["pinned_host"]}',
+            ("baseline", True, True):
+                'BASELINE {"tokens_per_s": 400.0, "loss": 2.7}',
+            ("offload", True, True):
+                'OFFLOAD {"tokens_per_s": 900.0, "loss": 2.7}',
+        }
+        calls, art = self._run(sandbox, monkeypatch, outputs)
+        assert len(calls) == 5
+        assert art["passed"] is True
+        assert art["platform"] == "tpu"
+        assert art["in_hbm_refused_under_grant"] is True
+        assert art["offloaded_enforced"] is True
+        assert art["loss_match"] is True
+        assert art["offload_overhead"] == 1.25
+        bs = art["batch_scaling"]
+        assert bs["offload_speedup"] == 2.25
+        assert bs["offload_wins"] is True
+        assert (bs["in_grant_batch"], bs["offload_batch"]) == (2, 8)
+
+    def test_honest_loss_when_offload_slower(self, sandbox, monkeypatch):
+        outputs = {
+            ("baseline", False, False):
+                'BASELINE {"tokens_per_s": 1000.0, "loss": 2.5, '
+                '"opt_state_mib": 3500}',
+            ("baseline", False, True):
+                'BASELINE_REFUSED {"error": "RESOURCE_EXHAUSTED"}',
+            ("offload", False, True):
+                'OFFLOAD {"tokens_per_s": 800.0, "loss": 2.5, '
+                '"opt_state_memory_kinds": ["pinned_host"]}',
+            ("baseline", True, True):
+                'BASELINE {"tokens_per_s": 900.0, "loss": 2.7}',
+            ("offload", True, True):
+                'OFFLOAD {"tokens_per_s": 450.0, "loss": 2.7}',
+        }
+        _, art = self._run(sandbox, monkeypatch, outputs)
+        assert art["batch_scaling"]["offload_wins"] is False
+        assert art["passed"] is True  # losing the win case is honest data
+
+    def test_missing_refusal_fails_enforcement_claim(self, sandbox,
+                                                     monkeypatch):
+        outputs = {
+            ("baseline", False, False):
+                'BASELINE {"tokens_per_s": 1000.0, "loss": 2.5}',
+            # interposer leg b: no refusal marker (enforcement breach!)
+            ("baseline", False, True):
+                'BASELINE {"tokens_per_s": 990.0, "loss": 2.5}',
+            ("offload", False, True):
+                'OFFLOAD {"tokens_per_s": 800.0, "loss": 2.5}',
+        }
+        _, art = self._run(sandbox, monkeypatch, outputs)
+        assert art["offloaded_enforced"] is False
+        assert art["passed"] is False
+
+    def test_leg_de_failure_recorded_not_fatal(self, sandbox, monkeypatch):
+        outputs = {
+            ("baseline", False, False):
+                'BASELINE {"tokens_per_s": 1000.0, "loss": 2.5}',
+            ("baseline", False, True):
+                'BASELINE_REFUSED {"error": "RESOURCE_EXHAUSTED"}',
+            ("offload", False, True):
+                'OFFLOAD {"tokens_per_s": 800.0, "loss": 2.501, '
+                '"opt_state_memory_kinds": ["pinned_host"]}',
+        }
+        _, art = self._run(sandbox, monkeypatch, outputs,
+                           rcs={("baseline", True, True): 1,
+                                ("offload", True, True): 1})
+        assert art["passed"] is True       # A-C evidence stands
+        assert "batch_scaling" not in art  # no fabricated comparison
+        assert set(art["errors"]) == {"in_grant", "offload_big"}
+        # The failure EVIDENCE must carry the child's stderr tail, not
+        # just the key (the real drain reads these lines to diagnose).
+        assert any("boom" in ln for ln in art["errors"]["in_grant"])
+
+
+class TestEnforceOnchipOrchestration:
+    """scenario_enforce's on-chip input legs ran in r3, but the r4
+    output-breach leg's on-chip branch never has — pin marker parsing,
+    the rc==137 verdict, and the evidence-keeping fallback."""
+
+    def _run(self, sandbox, monkeypatch, outputs, rcs):
+        monkeypatch.setattr(scenarios, "build_native", lambda: None)
+        monkeypatch.setattr(scenarios, "tpu_available", lambda: True)
+        sims = []
+        monkeypatch.setattr(
+            scenarios, "_enforce_cpu_sim",
+            lambda env, result, note="": sims.append(dict(result)))
+        order = []
+
+        def fake_child(src, env, timeout, interposer=False):
+            for name, marker in (("output", "SCEN_OUT_MIB"),
+                                 ("violator", "VIOLATOR_OOM"),
+                                 ("compliant", "COMPLIANT_OK")):
+                if marker in src:
+                    order.append(name)
+                    return rcs.get(name, 0), outputs.get(name, ""), "boom"
+            raise AssertionError("unknown child source")
+
+        monkeypatch.setattr(scenarios, "run_child", fake_child)
+        scenarios.scenario_enforce()
+        return order, sims, read(sandbox, "enforce")
+
+    def test_full_pass(self, sandbox, monkeypatch):
+        outputs = {
+            "compliant": 'COMPLIANT_OK {"used_mib": 2900}',
+            "violator": "VIOLATOR_OOM RESOURCE_EXHAUSTED: grant",
+            "output": "OUTPUT_MATERIALIZED",
+        }
+        order, sims, art = self._run(sandbox, monkeypatch, outputs,
+                                     {"output": 137})
+        # Output-breach leg must run LAST (it kills its own process; the
+        # input legs' evidence lands first).
+        assert order == ["compliant", "violator", "output"]
+        assert art["passed"] is True
+        assert art["output_breach_stopped"] is True
+        assert art["output_violator"]["rc"] == 137
+        assert not sims  # no degraded fallback on a clean pass
+
+    def test_surviving_output_violator_fails_and_keeps_evidence(
+            self, sandbox, monkeypatch):
+        outputs = {
+            "compliant": 'COMPLIANT_OK {"used_mib": 2900}',
+            "violator": "VIOLATOR_OOM RESOURCE_EXHAUSTED: grant",
+            "output": "OUTPUT_MATERIALIZED\nOUTPUT_VIOLATOR_SURVIVED",
+        }
+        order, sims, art = self._run(sandbox, monkeypatch, outputs,
+                                     {"output": 0})
+        # The PRE-FALLBACK verdict (what the stubbed cpu-sim fallback
+        # received): on-chip failed, evidence kept.  In production the
+        # fallback then rewrites passed/mode to the degraded outcome, so
+        # assert on the captured state, not the emitted artifact.
+        assert len(sims) == 1
+        pre = sims[0]
+        assert pre["output_breach_stopped"] is False
+        assert pre["passed"] is False
+        assert pre["output_violator"]["survived"] is True
+        assert "tpu_stderr_tail" in pre
+        assert art["output_violator"]["survived"] is True  # evidence kept
