@@ -191,24 +191,37 @@ def run_queue(kinds) -> bool:
             log("task full-bench: OVERRAN; left detached — stopping")
             return False
         log(f"task full-bench: rc={rc}")
+    def run_tasks(tasks) -> bool:
+        for name, argv, fuse, marker in tasks:
+            log(f"task {name}: fuse={fuse:.0f}s")
+            t0 = time.time()
+            rc, out, err = run_no_kill(argv, env, fuse)
+            if rc is None:
+                log(f"task {name}: OVERRAN {fuse:.0f}s; left detached — "
+                    "stopping the queue to protect the pool claim")
+                return False
+            if marker and rc == 0:
+                with open(marker, "w") as f:
+                    f.write(str(time.time()))
+            tail = (err or out).strip().splitlines()[-1:] or ["<no output>"]
+            log(f"task {name}: rc={rc} in {time.time()-t0:.0f}s "
+                f"| {tail[0][:140]}")
+        return True
+
+    # An overrun stops the WHOLE queue (the detached child still holds
+    # the serialized pool claim), so tasks run in evidence-priority
+    # order: reference cases, then the flash first-compile, then the
+    # scenario/oversub reruns — the compile-heavy decode/spec/serve
+    # microbenches go LAST so a fuse overrun there cannot cost the
+    # higher-priority artifacts (VERDICT r4 items 1-5 ordering).
     tasks = []
     if "train" in kinds or "model" in kinds:
         tasks += model_tasks()
-    if "micro" in kinds:
-        tasks += micro_tasks()
-    for name, argv, fuse, marker in tasks:
-        log(f"task {name}: fuse={fuse:.0f}s")
-        t0 = time.time()
-        rc, out, err = run_no_kill(argv, env, fuse)
-        if rc is None:
-            log(f"task {name}: OVERRAN {fuse:.0f}s; left detached — "
-                "stopping the queue to protect the pool claim")
-            return False
-        if marker and rc == 0:
-            with open(marker, "w") as f:
-                f.write(str(time.time()))
-        tail = (err or out).strip().splitlines()[-1:] or ["<no output>"]
-        log(f"task {name}: rc={rc} in {time.time()-t0:.0f}s | {tail[0][:140]}")
+    micro = micro_tasks() if "micro" in kinds else []
+    tasks += [t for t in micro if t[0] == bench.FLASH_CASE]
+    late_micro = [t for t in micro if t[0] != bench.FLASH_CASE]
+    if not run_tasks(tasks):
+        return False
     senv = dict(os.environ)
     senv.setdefault("SCENARIO_ROUND", round_id())
     if "scen" in kinds:
@@ -234,7 +247,7 @@ def run_queue(kinds) -> bool:
             log("task oversub: OVERRAN; left detached")
             return False
         log(f"task oversub: rc={rc}")
-    return True
+    return run_tasks(late_micro)
 
 
 def merge_spool() -> None:

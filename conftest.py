@@ -63,3 +63,51 @@ def load_bench():
     sys.modules["bench"] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+# ---------------------------------------------------------------------------
+# Shared scenarios-suite plumbing (test_scenarios_emit / poolwatch /
+# orchestration): one loader + sandbox so the emit/manifest contract
+# lives in a single place.
+# ---------------------------------------------------------------------------
+
+import importlib.util  # noqa: E402
+import json  # noqa: E402
+
+import pytest  # noqa: E402
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_scenarios():
+    spec = importlib.util.spec_from_file_location(
+        "scenarios", os.path.join(_REPO_DIR, "benchmarks", "scenarios.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def scenarios_sandbox(tmp_path, monkeypatch):
+    """(scenarios_module, tmp_path) with REPO/ROUND pinned, the round
+    manifest present (emit refuses non-current rounds), and the runners'
+    scratch dirs under pytest's tmp tree."""
+    scenarios = load_scenarios()
+    monkeypatch.setattr(scenarios, "REPO", str(tmp_path))
+    monkeypatch.setattr(scenarios, "ROUND", "rtest")
+
+    def _mkdtemp(prefix="t"):
+        d = tmp_path / f"{prefix}scratch"
+        d.mkdir(exist_ok=True)
+        return str(d)
+
+    monkeypatch.setattr(scenarios.tempfile, "mkdtemp", _mkdtemp)
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "artifact_manifest.json").write_text(
+        json.dumps({"current_round": "rtest", "files": {}}))
+    return scenarios, tmp_path
+
+
+def read_artifact(tmp_path, name):
+    with open(tmp_path / f"{name.upper()}_rtest.json") as f:
+        return json.load(f)

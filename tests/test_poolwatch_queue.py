@@ -124,6 +124,16 @@ class TestRunQueue:
         assert "bench.py" in joined[0]
         assert sum("--worker" in j for j in joined) == len(bench.CASES)
         assert sum("scenarios.py" in j for j in joined) == 6  # 5 scen + oversub
+        # Evidence-priority order (an overrun stops the whole queue):
+        # flash first-compile BEFORE the scenario/oversub reruns, and the
+        # compile-heavy decode/spec/serve microbenches LAST.
+        def pos(frag):
+            return next(i for i, j in enumerate(joined) if frag in j)
+
+        assert pos("--flash-worker") < pos("scenarios.py")
+        assert pos("oversub") < pos("--decode-worker")
+        assert (pos("--decode-worker") < pos("--spec-worker")
+                < pos("--serve-worker"))
         # Scenario children inherit the pinned round.
         scen_envs = [e for a, e, _ in calls if "scenarios.py" in " ".join(a)]
         assert all(e.get("SCENARIO_ROUND") == "rt" for e in scen_envs)
@@ -131,6 +141,27 @@ class TestRunQueue:
         mdir = sandbox / ".bench_spool" / "upgraded"
         assert sorted(os.listdir(mdir)) == sorted(
             f"rt-{n}" for n in bench.CASES)
+
+    def test_late_micro_overrun_spares_scenarios(self, sandbox,
+                                                 monkeypatch):
+        """A decode/spec/serve fuse overrun must cost only the remaining
+        late microbenches — the scenario/oversub reruns already ran."""
+        _write_matrix(sandbox, [])
+        calls = []
+
+        def fake_run(argv, env, fuse):
+            joined = " ".join(argv)
+            calls.append(joined)
+            if "--decode-worker" in joined:
+                return None, "", ""   # overrun
+            return 0, "ok", ""
+
+        monkeypatch.setattr(poolwatch, "run_no_kill", fake_run)
+        assert poolwatch.run_queue(["bench", "model", "micro",
+                                    "scen", "oversub"]) is False
+        assert sum("scenarios.py" in j for j in calls) == 6
+        assert not any("--spec-worker" in j or "--serve-worker" in j
+                       for j in calls)
 
     def test_overrun_stops_queue(self, sandbox, monkeypatch):
         _write_matrix(sandbox, [])

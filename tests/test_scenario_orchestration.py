@@ -1,42 +1,12 @@
 """On-chip orchestration proofs for the scenario runners
 (benchmarks/scenarios.py) with FULLY FAKED children — no model compiles,
 no chip, sub-second: deliberately fast-tier so `make test-fast` proves
-legs A-E and the output-breach branch before the drain's one shot."""
+legs A-E and the output-breach branch before the drain's one shot.
 
-import importlib.util
-import json
-import os
+Module plumbing (scenarios loader, sandbox, artifact read) is shared via
+tests/conftest.py."""
 
-import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-spec = importlib.util.spec_from_file_location(
-    "scenarios", os.path.join(REPO, "benchmarks", "scenarios.py"))
-scenarios = importlib.util.module_from_spec(spec)
-spec.loader.exec_module(scenarios)
-
-
-@pytest.fixture
-def sandbox(tmp_path, monkeypatch):
-    monkeypatch.setattr(scenarios, "REPO", str(tmp_path))
-    monkeypatch.setattr(scenarios, "ROUND", "rtest")
-    # Keep the runners' scratch dirs inside pytest's tmp tree.
-    def _mkdtemp(prefix="t"):
-        d = tmp_path / f"{prefix}scratch"
-        d.mkdir(exist_ok=True)
-        return str(d)
-
-    monkeypatch.setattr(scenarios.tempfile, "mkdtemp", _mkdtemp)
-    (tmp_path / "tests").mkdir()
-    (tmp_path / "tests" / "artifact_manifest.json").write_text(
-        json.dumps({"current_round": "rtest", "files": {}}))
-    return tmp_path
-
-
-def read(tmp_path, name):
-    with open(tmp_path / f"{name.upper()}_rtest.json") as f:
-        return json.load(f)
+from conftest import read_artifact
 
 
 class TestOversubOnchipOrchestration:
@@ -45,7 +15,8 @@ class TestOversubOnchipOrchestration:
     children so the marker parsing, batch_scaling assembly, refusal
     logic, and passed verdict are proven before the drain's one shot."""
 
-    def _run(self, sandbox, monkeypatch, outputs, rcs=None):
+    def _run(self, scenarios_sandbox, monkeypatch, outputs, rcs=None):
+        scenarios, tmp = scenarios_sandbox
         monkeypatch.setattr(scenarios, "build_native", lambda: None)
         monkeypatch.setattr(scenarios, "tpu_available", lambda: True)
         calls = []
@@ -61,9 +32,9 @@ class TestOversubOnchipOrchestration:
 
         monkeypatch.setattr(scenarios, "run_child", fake_child)
         scenarios.scenario_oversub()
-        return calls, read(sandbox, "oversub")
+        return calls, read_artifact(tmp, "oversub")
 
-    def test_full_win_path(self, sandbox, monkeypatch):
+    def test_full_win_path(self, scenarios_sandbox, monkeypatch):
         outputs = {
             ("baseline", False, False):
                 'BASELINE {"tokens_per_s": 1000.0, "loss": 2.5, '
@@ -80,7 +51,7 @@ class TestOversubOnchipOrchestration:
             ("offload", True, True):
                 'OFFLOAD {"tokens_per_s": 900.0, "loss": 2.7}',
         }
-        calls, art = self._run(sandbox, monkeypatch, outputs)
+        calls, art = self._run(scenarios_sandbox, monkeypatch, outputs)
         assert len(calls) == 5
         assert art["passed"] is True
         assert art["platform"] == "tpu"
@@ -93,7 +64,7 @@ class TestOversubOnchipOrchestration:
         assert bs["offload_wins"] is True
         assert (bs["in_grant_batch"], bs["offload_batch"]) == (2, 8)
 
-    def test_honest_loss_when_offload_slower(self, sandbox, monkeypatch):
+    def test_honest_loss_when_offload_slower(self, scenarios_sandbox, monkeypatch):
         outputs = {
             ("baseline", False, False):
                 'BASELINE {"tokens_per_s": 1000.0, "loss": 2.5, '
@@ -108,11 +79,11 @@ class TestOversubOnchipOrchestration:
             ("offload", True, True):
                 'OFFLOAD {"tokens_per_s": 450.0, "loss": 2.7}',
         }
-        _, art = self._run(sandbox, monkeypatch, outputs)
+        _, art = self._run(scenarios_sandbox, monkeypatch, outputs)
         assert art["batch_scaling"]["offload_wins"] is False
         assert art["passed"] is True  # losing the win case is honest data
 
-    def test_missing_refusal_fails_enforcement_claim(self, sandbox,
+    def test_missing_refusal_fails_enforcement_claim(self, scenarios_sandbox,
                                                      monkeypatch):
         outputs = {
             ("baseline", False, False):
@@ -123,11 +94,11 @@ class TestOversubOnchipOrchestration:
             ("offload", False, True):
                 'OFFLOAD {"tokens_per_s": 800.0, "loss": 2.5}',
         }
-        _, art = self._run(sandbox, monkeypatch, outputs)
+        _, art = self._run(scenarios_sandbox, monkeypatch, outputs)
         assert art["offloaded_enforced"] is False
         assert art["passed"] is False
 
-    def test_leg_de_failure_recorded_not_fatal(self, sandbox, monkeypatch):
+    def test_leg_de_failure_recorded_not_fatal(self, scenarios_sandbox, monkeypatch):
         outputs = {
             ("baseline", False, False):
                 'BASELINE {"tokens_per_s": 1000.0, "loss": 2.5}',
@@ -137,7 +108,7 @@ class TestOversubOnchipOrchestration:
                 'OFFLOAD {"tokens_per_s": 800.0, "loss": 2.501, '
                 '"opt_state_memory_kinds": ["pinned_host"]}',
         }
-        _, art = self._run(sandbox, monkeypatch, outputs,
+        _, art = self._run(scenarios_sandbox, monkeypatch, outputs,
                            rcs={("baseline", True, True): 1,
                                 ("offload", True, True): 1})
         assert art["passed"] is True       # A-C evidence stands
@@ -153,7 +124,8 @@ class TestEnforceOnchipOrchestration:
     output-breach leg's on-chip branch never has — pin marker parsing,
     the rc==137 verdict, and the evidence-keeping fallback."""
 
-    def _run(self, sandbox, monkeypatch, outputs, rcs):
+    def _run(self, scenarios_sandbox, monkeypatch, outputs, rcs):
+        scenarios, tmp = scenarios_sandbox
         monkeypatch.setattr(scenarios, "build_native", lambda: None)
         monkeypatch.setattr(scenarios, "tpu_available", lambda: True)
         sims = []
@@ -173,15 +145,15 @@ class TestEnforceOnchipOrchestration:
 
         monkeypatch.setattr(scenarios, "run_child", fake_child)
         scenarios.scenario_enforce()
-        return order, sims, read(sandbox, "enforce")
+        return order, sims, read_artifact(tmp, "enforce")
 
-    def test_full_pass(self, sandbox, monkeypatch):
+    def test_full_pass(self, scenarios_sandbox, monkeypatch):
         outputs = {
             "compliant": 'COMPLIANT_OK {"used_mib": 2900}',
             "violator": "VIOLATOR_OOM RESOURCE_EXHAUSTED: grant",
             "output": "OUTPUT_MATERIALIZED",
         }
-        order, sims, art = self._run(sandbox, monkeypatch, outputs,
+        order, sims, art = self._run(scenarios_sandbox, monkeypatch, outputs,
                                      {"output": 137})
         # Output-breach leg must run LAST (it kills its own process; the
         # input legs' evidence lands first).
@@ -192,13 +164,13 @@ class TestEnforceOnchipOrchestration:
         assert not sims  # no degraded fallback on a clean pass
 
     def test_surviving_output_violator_fails_and_keeps_evidence(
-            self, sandbox, monkeypatch):
+            self, scenarios_sandbox, monkeypatch):
         outputs = {
             "compliant": 'COMPLIANT_OK {"used_mib": 2900}',
             "violator": "VIOLATOR_OOM RESOURCE_EXHAUSTED: grant",
             "output": "OUTPUT_MATERIALIZED\nOUTPUT_VIOLATOR_SURVIVED",
         }
-        order, sims, art = self._run(sandbox, monkeypatch, outputs,
+        order, sims, art = self._run(scenarios_sandbox, monkeypatch, outputs,
                                      {"output": 0})
         # The PRE-FALLBACK verdict (what the stubbed cpu-sim fallback
         # received): on-chip failed, evidence kept.  In production the
