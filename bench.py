@@ -32,7 +32,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 from benchmarks.procutil import (  # noqa: E402 — needs REPO path
-    CLEAN_EXIT_SNIPPET, clean_jax_exit, run_no_kill)
+    CLEAN_EXIT_SNIPPET, DETACHED_MARK, clean_jax_exit, run_no_kill)
 
 # Total wall budget for everything (driver kills at 600s; stay well under).
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "420"))
@@ -172,7 +172,7 @@ def probe_backend(env: dict, platform: str, timeout: float) -> bool:
                                    timeout)
     if rc is None:
         log(f"probe[{platform}]: still running after {timeout:.0f}s; "
-            "left to finish detached (never kill a pool claim)")
+            f"{DETACHED_MARK} (never kill a pool claim)")
         diag(f"probe[{platform}] OVERRAN {timeout:.0f}s (left running); "
              f"partial stderr:\n{p_err}\npartial stdout:\n{p_out}")
         return False
@@ -227,8 +227,8 @@ def collect_worker(name: str, argv: list, env: dict, out: str,
         # session (DIAG_r03.txt); instead it runs on detached and may still
         # hold the session — stop spawning native cases into that.
         _WORKER_OVERRAN = True
-        log(f"case {name}: worker overran {timeout:.0f}s; left to finish "
-            "detached (never kill a pool claim)")
+        log(f"case {name}: worker overran {timeout:.0f}s; "
+            f"{DETACHED_MARK} (never kill a pool claim)")
         diag(f"case {name} worker OVERRAN {timeout:.0f}s (left running); "
              f"partial stderr:\n{w_err}")
     elif rc != 0:

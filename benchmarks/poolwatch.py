@@ -31,7 +31,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from benchmarks.procutil import CLEAN_EXIT_SNIPPET, run_no_kill  # noqa: E402
+from benchmarks.procutil import (  # noqa: E402
+    CLEAN_EXIT_SNIPPET, DETACHED_MARK, run_no_kill)
 from benchmarks.scenarios import current_round  # noqa: E402
 
 
@@ -41,11 +42,22 @@ def round_id() -> str:
     current_round as the fresh-process default."""
     return os.environ.get("SCENARIO_ROUND") or current_round()
 
+# The probe must reach CLEAN_EXIT_SNIPPET on the ERROR path too: when
+# the pool answers UNAVAILABLE (observed r5, 09:33 — the server replies
+# after ~25 min with a backend-init failure instead of staying silent),
+# an unhandled RuntimeError would take the fragile interpreter-teardown
+# exit the snippet exists to avoid, and an abnormal client death is
+# exactly what re-arms the server-side wedge (DIAG_r03.txt).
 PROBE_SRC = (
     "import time, jax\n"
     "t = time.time()\n"
-    "d = jax.devices()\n"
-    "print('PROBE_OK', d[0].platform, round(time.time()-t, 2), flush=True)\n"
+    "try:\n"
+    "    d = jax.devices()\n"
+    "    print('PROBE_OK', d[0].platform, round(time.time()-t, 2),"
+    " flush=True)\n"
+    "except Exception as e:\n"
+    "    print('PROBE_ERR', type(e).__name__,"
+    " str(e)[:160].replace('\\n', ' '), flush=True)\n"
     + CLEAN_EXIT_SNIPPET
 )
 
@@ -69,9 +81,9 @@ def probe_once(window_s: float) -> bool:
                                          suffix=".probe")
     marker.close()
     with open(marker.name, "w") as out:
-        subprocess.Popen([sys.executable, "-c", PROBE_SRC],
-                         stdout=out, stderr=subprocess.STDOUT,
-                         start_new_session=True)
+        child = subprocess.Popen([sys.executable, "-c", PROBE_SRC],
+                                 stdout=out, stderr=subprocess.STDOUT,
+                                 start_new_session=True)
     deadline = time.time() + window_s
     while time.time() < deadline:
         time.sleep(5)
@@ -85,8 +97,25 @@ def probe_once(window_s: float) -> bool:
             log(f"probe answered: {txt.strip().splitlines()[-1]}")
             _unlink(marker.name)          # child exited; safe to remove
             return plat == "tpu"
-        if "Error" in txt or "error" in txt:
-            log(f"probe errored: {txt.strip().splitlines()[-1][:120]}")
+        # Child exit without PROBE_OK = failed probe, whatever the
+        # failure mode (PROBE_ERR via the wrapped path, a Traceback
+        # before the try block, a C++-level abort, a segfault, an
+        # OOM-kill): exit status beats any output-wording match.  No
+        # fuzzy 'error' substring on a LIVE child — the tunnel logs
+        # error-level lines on transient reconnects that a pending
+        # probe may yet survive to PROBE_OK.
+        if child.poll() is not None:
+            # Re-read once: the child may have printed PROBE_OK after
+            # this iteration's read and exited before the poll.
+            try:
+                with open(marker.name) as f:
+                    txt = f.read()
+            except OSError:
+                pass
+            if "PROBE_OK" in txt:
+                continue
+            last = (txt.strip().splitlines() or ["<no output>"])[-1]
+            log(f"probe failed (rc={child.returncode}): {last[:120]}")
             _unlink(marker.name)
             return False
     log(f"probe silent after {window_s:.0f}s (left running, never killed)")
@@ -171,9 +200,39 @@ def _matrix():
         return []
 
 
+def _held_claim(out: str, err: str) -> bool:
+    """True when a child's output reports it left a device-claiming
+    process running detached — that process may still hold the
+    serialized pool claim even though the child itself exited, so the
+    queue must yield the window.  Every detach emitter in both
+    harnesses (bench.py probe_backend + collect_worker; scenarios.py
+    run_child + the priority low worker) embeds procutil.DETACHED_MARK;
+    tests/test_poolwatch_queue.py pins the contract."""
+    return DETACHED_MARK in (out or "") + (err or "")
+
+
+def _guarded_run(label, argv, env, fuse):
+    """run_no_kill plus the two queue-stop conditions, applied
+    identically at every launch site: (a) the child OVERRAN its fuse
+    (left running detached — it holds the claim), or (b) the child
+    exited but its output reports a detached claim-holder of its own
+    (_held_claim).  Returns (stop, rc, out, err); stop=True means
+    yield the window now."""
+    rc, out, err = run_no_kill(argv, env, fuse)
+    if rc is None:
+        log(f"task {label}: OVERRAN {fuse:.0f}s; left detached — "
+            "stopping the queue to protect the pool claim")
+        return True, rc, out, err
+    if _held_claim(out, err):
+        log(f"task {label}: rc={rc} but reported a detached "
+            "claim-holder — stopping the queue to protect the claim")
+        return True, rc, out, err
+    return False, rc, out, err
+
+
 def run_queue(kinds) -> bool:
-    """Run the queue sequentially; False if a child overran (stop —
-    it may hold the pool claim)."""
+    """Run the queue sequentially; False if a child overran or left a
+    detached claim-holder (stop — the pool claim may still be held)."""
     import bench
 
     tmpdir = tempfile.mkdtemp(prefix="poolwatch-")
@@ -183,26 +242,31 @@ def run_queue(kinds) -> bool:
         # Full harness first: primary case + BOTH enforcement-overhead
         # ratio legs + whatever extra cases fit its budget, all merged
         # rank-aware.  Individual leftovers re-queue below / next window.
+        # rc=0 does NOT imply the claim is free: full-bench leaves its
+        # own overrunning workers (and its native probe) detached and
+        # skips the rest of its cases, so its exit can precede its last
+        # child's.  Launching the next task then convoys a second client
+        # behind the held claim until it overruns its fuse too — window 1
+        # of r5 lost ~22 min exactly this way.  _guarded_run sees the
+        # harness report the detached child and yields the window.
         benv = dict(os.environ, BENCH_BUDGET_S="1500")
         log("task full-bench: fuse=1700s")
-        rc, out, err = run_no_kill(
-            [sys.executable, os.path.join(REPO, "bench.py")], benv, 1700.0)
-        if rc is None:
-            log("task full-bench: OVERRAN; left detached — stopping")
+        stop, rc, out, err = _guarded_run(
+            "full-bench", [sys.executable, os.path.join(REPO, "bench.py")],
+            benv, 1700.0)
+        if stop:
             return False
         log(f"task full-bench: rc={rc}")
     def run_tasks(tasks) -> bool:
         for name, argv, fuse, marker in tasks:
             log(f"task {name}: fuse={fuse:.0f}s")
             t0 = time.time()
-            rc, out, err = run_no_kill(argv, env, fuse)
-            if rc is None:
-                log(f"task {name}: OVERRAN {fuse:.0f}s; left detached — "
-                    "stopping the queue to protect the pool claim")
-                return False
+            stop, rc, out, err = _guarded_run(name, argv, env, fuse)
             if marker and rc == 0:
                 with open(marker, "w") as f:
                     f.write(str(time.time()))
+            if stop:
+                return False
             tail = (err or out).strip().splitlines()[-1:] or ["<no output>"]
             log(f"task {name}: rc={rc} in {time.time()-t0:.0f}s "
                 f"| {tail[0][:140]}")
@@ -229,22 +293,22 @@ def run_queue(kinds) -> bool:
                            ("priority", 1500.0), ("cosched", 300.0),
                            ("gang", 300.0)]:
             log(f"task scenario-{name}: fuse={fuse:.0f}s")
-            rc, out, err = run_no_kill(
+            stop, rc, _, _ = _guarded_run(
+                f"scenario-{name}",
                 [sys.executable, os.path.join(REPO, "benchmarks",
                                               "scenarios.py"), name],
                 senv, fuse)
-            if rc is None:
-                log(f"task scenario-{name}: OVERRAN; left detached")
+            if stop:
                 return False
             log(f"task scenario-{name}: rc={rc}")
     if "oversub" in kinds:
         log("task oversub: fuse=1800s")
-        rc, out, err = run_no_kill(
+        stop, rc, _, _ = _guarded_run(
+            "oversub",
             [sys.executable, os.path.join(REPO, "benchmarks",
                                           "scenarios.py"), "oversub"],
             senv, 1800.0)
-        if rc is None:
-            log("task oversub: OVERRAN; left detached")
+        if stop:
             return False
         log(f"task oversub: rc={rc}")
     return run_tasks(late_micro)

@@ -57,6 +57,14 @@ def clean_jax_exit(code: int = 0) -> None:
     os._exit(code)
 
 
+# Contract with poolwatch._held_claim: every harness message announcing
+# that a device-claiming child was left running detached embeds this
+# exact phrase, and poolwatch stops its drain queue when it sees the
+# phrase in a child's output (the detached process may still hold the
+# serialized pool claim).  Reword here, nowhere else.
+DETACHED_MARK = "left detached"
+
+
 def run_no_kill(argv: List[str], env: dict,
                 timeout: float) -> Tuple[Optional[int], str, str]:
     """Run a child with a timeout but WITHOUT killing it on overrun.

@@ -54,7 +54,8 @@ from typing import List
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from benchmarks.procutil import CLEAN_EXIT_SNIPPET, run_no_kill  # noqa: E402
+from benchmarks.procutil import (  # noqa: E402
+    CLEAN_EXIT_SNIPPET, DETACHED_MARK, run_no_kill)
 
 def current_round() -> str:
     """The round identity everything agrees on: tests/artifact_manifest.json
@@ -173,7 +174,7 @@ def tpu_available(timeout: float = 210.0) -> bool:
     rc, out_text, _ = run_no_kill([sys.executable, "-c", code],
                                    dict(os.environ), timeout)
     if rc is None:
-        log(f"tpu probe still running after {timeout:.0f}s; left detached "
+        log(f"tpu probe still running after {timeout:.0f}s; {DETACHED_MARK} "
             "(killing a pool claim jams the pool — DIAG_r03.txt)")
         _TPU_AVAILABLE = False
         return False
@@ -221,7 +222,7 @@ def run_child(code: str, env: dict, timeout: float = 180.0,
     rc, out, err = run_no_kill([sys.executable, "-c",
                                 code + CLEAN_EXIT_SNIPPET], full, timeout)
     if rc is None:
-        log(f"worker still running after {timeout:.0f}s; left detached")
+        log(f"worker still running after {timeout:.0f}s; {DETACHED_MARK}")
         return -1, out, "timeout (worker left running, not killed)"
     return rc, out, err
 
@@ -799,7 +800,7 @@ def scenario_priority() -> None:
             # a SIGKILL mid-claim would jam the pool (DIAG_r03.txt).
             low.wait(timeout=300 if on_tpu else 60)
         except sp.TimeoutExpired:
-            log("low worker ignored stop file; left detached, not killed")
+            log(f"low worker ignored stop file; {DETACHED_MARK}, not killed")
         stop_mon.set()
         if mon.is_alive():
             mon.join(timeout=5)

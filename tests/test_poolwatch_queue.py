@@ -18,6 +18,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 import bench  # noqa: E402
+from benchmarks import procutil  # noqa: E402
 
 spec = importlib.util.spec_from_file_location(
     "poolwatch", os.path.join(REPO, "benchmarks", "poolwatch.py"))
@@ -162,6 +163,115 @@ class TestRunQueue:
         assert sum("scenarios.py" in j for j in calls) == 6
         assert not any("--spec-worker" in j or "--serve-worker" in j
                        for j in calls)
+
+    def test_fullbench_internal_overrun_stops_queue(self, sandbox,
+                                                    monkeypatch):
+        """full-bench rc=0 with an internal detached overrunner must
+        yield the window: the overrunner may still hold the serialized
+        pool claim (r5 window-1 convoy).  The fake stderr embeds
+        DETACHED_MARK exactly as collect_worker does — 'OVERRAN' only
+        ever goes to bench_diag.txt, never to child output."""
+        _write_matrix(sandbox, [])
+        calls = []
+
+        def fake_run(argv, env, fuse):
+            calls.append(" ".join(argv))
+            if len(calls) == 1:     # the full-bench budget run
+                return 0, "", ("bench[ 310.2s]: case deeplab: worker "
+                               f"overran 180s; {procutil.DETACHED_MARK} "
+                               "(never kill a pool claim)")
+            return 0, "ok", ""
+
+        monkeypatch.setattr(poolwatch, "run_no_kill", fake_run)
+        assert poolwatch.run_queue(["bench", "model"]) is False
+        assert len(calls) == 1      # nothing launched behind the claim
+
+    def test_fullbench_probe_overrun_stops_queue(self, sandbox,
+                                                 monkeypatch):
+        """The native-probe overrun message (bench.py probe_backend, no
+        'overran' word) must also stop the queue — the probe is a
+        detached claim-holder like any worker."""
+        _write_matrix(sandbox, [])
+        calls = []
+
+        def fake_run(argv, env, fuse):
+            calls.append(" ".join(argv))
+            if len(calls) == 1:
+                return 0, "", ("bench[ 241.0s]: probe[native]: still "
+                               f"running after 240s; "
+                               f"{procutil.DETACHED_MARK} (never kill "
+                               "a pool claim)")
+            return 0, "ok", ""
+
+        monkeypatch.setattr(poolwatch, "run_no_kill", fake_run)
+        assert poolwatch.run_queue(["bench", "model"]) is False
+        assert len(calls) == 1
+
+    def test_detached_mark_contract(self):
+        """Single-definition contract: _held_claim keys on
+        procutil.DETACHED_MARK, and every harness emitter that leaves a
+        claim-holder running builds its message from the same constant
+        (an f-string referencing DETACHED_MARK) — rewording the phrase
+        anywhere but procutil.py is structurally impossible without
+        this test going red."""
+        assert poolwatch._held_claim("", f"x {procutil.DETACHED_MARK} y")
+        assert not poolwatch._held_claim("all clean", "rc=0")
+        for fname, n_sites in [("bench.py", 2),
+                               (os.path.join("benchmarks",
+                                             "scenarios.py"), 3)]:
+            with open(os.path.join(REPO, fname)) as f:
+                src = f.read()
+            assert src.count("{DETACHED_MARK}") == n_sites, fname
+            # No emitter hand-writes the phrase as a literal.
+            assert procutil.DETACHED_MARK not in src.replace(
+                "{DETACHED_MARK}", ""), fname
+
+    def test_scenario_detached_claim_holder_stops_queue(self, sandbox,
+                                                        monkeypatch):
+        """A scenario child that exits rc=0 but reports a detached
+        worker ('left detached', scenarios.py:224/802) must stop the
+        queue before the next scenario convoys behind the claim."""
+        _write_matrix(sandbox, [{
+            "metric": n, "platform": "tpu", "value": 1.0, "mfu": 0.2,
+            "memory_info_mib": {"used": 9}} for n in bench.CASES] + [
+            {"metric": m, "platform": "tpu", "value": 1.0}
+            for m in (bench.FLASH_CASE, bench.DECODE_CASE,
+                      bench.SPEC_CASE, bench.SERVE_CASE)])
+        calls = []
+
+        def fake_run(argv, env, fuse):
+            joined = " ".join(argv)
+            calls.append(joined)
+            if "scenarios.py" in joined and "throttle" in joined:
+                return 0, "", ("scenario[ 61s]: worker still running "
+                               f"after 60s; {procutil.DETACHED_MARK}")
+            return 0, "ok", ""
+
+        monkeypatch.setattr(poolwatch, "run_no_kill", fake_run)
+        assert poolwatch.run_queue(["scen", "oversub"]) is False
+        ran = [c for c in calls if "scenarios.py" in c]
+        # enforce ran, throttle stopped the queue; priority/cosched/
+        # gang/oversub never launched behind the held claim.
+        assert any("throttle" in c for c in ran)
+        assert not any("priority" in c or "oversub" in c for c in ran)
+
+    def test_probe_src_error_path_exits_clean(self):
+        """A probe whose backend init FAILS (pool answered UNAVAILABLE)
+        must still print a marker and leave via the clean-exit epilogue,
+        not an unhandled exception — an abnormal client death is what
+        re-arms the server wedge."""
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="no_such_platform")
+        env.pop("XLA_FLAGS", None)
+        # Hermetic: with PALLAS_AXON_POOL_IPS unset the image's global
+        # sitecustomize registers nothing, so the child cannot dial the
+        # real pool — devices() fails fast on the unknown platform.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        p = subprocess.run([sys.executable, "-c", poolwatch.PROBE_SRC],
+                           env=env, capture_output=True, text=True,
+                           timeout=120)
+        assert "PROBE_ERR" in p.stdout
+        assert p.returncode == 0    # CLEAN_EXIT_SNIPPET reached
 
     def test_overrun_stops_queue(self, sandbox, monkeypatch):
         _write_matrix(sandbox, [])
