@@ -113,7 +113,10 @@ def probe_once(window_s: float) -> bool:
             except OSError:
                 pass
             if "PROBE_OK" in txt:
-                continue
+                plat = txt.split("PROBE_OK", 1)[1].split()[0]
+                log(f"probe answered: {txt.strip().splitlines()[-1]}")
+                _unlink(marker.name)
+                return plat == "tpu"
             last = (txt.strip().splitlines() or ["<no output>"])[-1]
             log(f"probe failed (rc={child.returncode}): {last[:120]}")
             _unlink(marker.name)
@@ -281,6 +284,13 @@ def run_queue(kinds) -> bool:
     tasks = []
     if "train" in kinds or "model" in kinds:
         tasks += model_tasks()
+    # Hazard tier: the r5 window-1 wedge began exactly when the deeplab
+    # worker ran (DIAG_r05 08:34).  r3 proved the case compiles and runs
+    # on the tunnel, so it is probably innocent — but if it isn't, a
+    # repeat wedge mid-queue costs every task after it ~25+ min.  Both
+    # deeplab cases therefore run LAST, after everything else is safe.
+    hazard = [t for t in tasks if "deeplab" in t[0]]
+    tasks = [t for t in tasks if "deeplab" not in t[0]]
     micro = micro_tasks() if "micro" in kinds else []
     tasks += [t for t in micro if t[0] == bench.FLASH_CASE]
     late_micro = [t for t in micro if t[0] != bench.FLASH_CASE]
@@ -311,7 +321,7 @@ def run_queue(kinds) -> bool:
         if stop:
             return False
         log(f"task oversub: rc={rc}")
-    return run_tasks(late_micro)
+    return run_tasks(late_micro) and run_tasks(hazard)
 
 
 def merge_spool() -> None:

@@ -135,6 +135,11 @@ class TestRunQueue:
         assert pos("oversub") < pos("--decode-worker")
         assert (pos("--decode-worker") < pos("--spec-worker")
                 < pos("--serve-worker"))
+        # Hazard tier: deeplab cases run dead last (the r5 window-1
+        # wedge began during the deeplab worker; see run_queue).
+        for j in joined:
+            if "deeplab" in j:
+                assert pos("--serve-worker") < joined.index(j)
         # Scenario children inherit the pinned round.
         scen_envs = [e for a, e, _ in calls if "scenarios.py" in " ".join(a)]
         assert all(e.get("SCENARIO_ROUND") == "rt" for e in scen_envs)
