@@ -32,7 +32,8 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 from benchmarks.procutil import (  # noqa: E402 — needs REPO path
-    CLEAN_EXIT_SNIPPET, DETACHED_MARK, clean_jax_exit, run_no_kill)
+    CLEAN_EXIT_SNIPPET, DETACHED_MARK, clean_jax_exit, is_hazard_case,
+    run_no_kill)
 
 # Total wall budget for everything (driver kills at 600s; stay well under).
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "420"))
@@ -471,8 +472,11 @@ def main() -> None:
                         bare.get("platform") == emitted.get("platform"):
                     matrix.append(overhead_entry(
                         "enforcement_overhead_resnet50_inf", emitted, bare))
-            # Extra matrix cases with leftover budget (smallest risk first).
-            for name in CASES:
+            # Extra matrix cases with leftover budget (smallest risk
+            # first), hazard cases last (procutil.is_hazard_case — same
+            # tiering as poolwatch.run_queue).  sorted() is stable, so
+            # the original order is kept among the non-hazard cases.
+            for name in sorted(CASES, key=is_hazard_case):
                 if name == PRIMARY or degraded:
                     continue
                 if _WORKER_OVERRAN:

@@ -32,7 +32,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from benchmarks.procutil import (  # noqa: E402
-    CLEAN_EXIT_SNIPPET, DETACHED_MARK, run_no_kill)
+    CLEAN_EXIT_SNIPPET, DETACHED_MARK, is_hazard_case, run_no_kill)
 from benchmarks.scenarios import current_round  # noqa: E402
 
 
@@ -284,13 +284,14 @@ def run_queue(kinds) -> bool:
     tasks = []
     if "train" in kinds or "model" in kinds:
         tasks += model_tasks()
-    # Hazard tier: the r5 window-1 wedge began exactly when the deeplab
-    # worker ran (DIAG_r05 08:34).  r3 proved the case compiles and runs
-    # on the tunnel, so it is probably innocent — but if it isn't, a
-    # repeat wedge mid-queue costs every task after it ~25+ min.  Both
-    # deeplab cases therefore run LAST, after everything else is safe.
-    hazard = [t for t in tasks if "deeplab" in t[0]]
-    tasks = [t for t in tasks if "deeplab" not in t[0]]
+    # Hazard tier (procutil.is_hazard_case): the r5 window-1 wedge began
+    # exactly when the deeplab worker ran (DIAG_r05 08:34).  r3 proved
+    # the case compiles and runs on the tunnel, so it is probably
+    # innocent — but if it isn't, a repeat wedge mid-queue costs every
+    # task after it ~25+ min.  Hazard cases therefore run LAST, after
+    # everything else is safe.
+    hazard = [t for t in tasks if is_hazard_case(t[0])]
+    tasks = [t for t in tasks if not is_hazard_case(t[0])]
     micro = micro_tasks() if "micro" in kinds else []
     tasks += [t for t in micro if t[0] == bench.FLASH_CASE]
     late_micro = [t for t in micro if t[0] != bench.FLASH_CASE]

@@ -65,6 +65,14 @@ def clean_jax_exit(code: int = 0) -> None:
 DETACHED_MARK = "left detached"
 
 
+def is_hazard_case(name: str) -> bool:
+    """Bench cases tiered LAST everywhere a queue touches the pool: the
+    r5 window-1 wedge began during the deeplab worker (DIAG_r05 08:34),
+    and a repeat would cost everything queued after it.  One predicate
+    so bench.py's extras loop and poolwatch's queue can't diverge."""
+    return "deeplab" in name
+
+
 def run_no_kill(argv: List[str], env: dict,
                 timeout: float) -> Tuple[Optional[int], str, str]:
     """Run a child with a timeout but WITHOUT killing it on overrun.
