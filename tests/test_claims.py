@@ -199,12 +199,16 @@ def _writer_field_vocab():
     return vocab
 
 
-def _has_key_path(obj, path):
+def _has_key_path(obj, path, allow_value_match=True):
     """True if obj contains `path` as keys (dot = nesting; each segment may
     sit at any depth below the previous match) OR, for a single segment,
     as a string value (tokens like memory kinds appear in artifacts as
-    values, not keys — prose citing them is still artifact-consistent)."""
-    if "." not in path and _has_string_value(obj, path):
+    values, not keys — prose citing them is still artifact-consistent).
+    ``allow_value_match=False`` disables the value fallback: matrix-entry
+    field claims must match KEYS, or a note/error string merely containing
+    the token as a substring ('caused' ⊃ 'used') passes vacuously."""
+    if allow_value_match and "." not in path and \
+            _has_string_value(obj, path):
         return True
     def anywhere(o, key):
         if isinstance(o, dict):
@@ -229,6 +233,81 @@ def _has_string_value(obj, tok):
     if isinstance(obj, list):
         return any(_has_string_value(v, tok) for v in obj)
     return isinstance(obj, str) and tok in obj
+
+
+def _current_round() -> str:
+    with open(os.path.join(REPO, "tests", "artifact_manifest.json")) as f:
+        return json.load(f)["current_round"]
+
+
+def _current_claim_docs():
+    """docs/ plus THIS round's RESULTS only: a bench-field claim in a
+    historical RESULTS describes that round's matrix state and will
+    naturally become true again when the drain lands; only live prose
+    must match the live matrix."""
+    cur = f"RESULTS_{_current_round()}.md"
+    # Loud on round-name format drift: if the manifest's current_round
+    # stops matching the RESULTS filename, the filter below would
+    # silently exclude EVERY results file from the bench-field test.
+    assert os.path.exists(os.path.join(REPO, cur)), (
+        f"{cur} not found — manifest current_round does not match the "
+        "RESULTS file naming")
+    for path, text in _claim_docs():
+        if os.path.basename(path).startswith("RESULTS_") and \
+                os.path.basename(path) != cur:
+            continue
+        yield path, text
+
+
+_GENERIC_FIELDS = {"value", "unit", "metric", "platform", "error", "note"}
+
+
+def _bench_field_vocab():
+    """Keys bench.py stamps onto result entries — the universe of tokens
+    that can be bench-matrix field names (``used``/``total`` nest under
+    memory_info_mib)."""
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    vocab = set(re.findall(
+        r'(?:result|row|emitted)\[\s*"([a-z][a-z0-9_]*)"\s*\]', src))
+    return (vocab | {"used", "total"}) - _GENERIC_FIELDS
+
+
+def test_bench_matrix_field_claims_hold():
+    """The r5 window-1 RESULTS claimed the fresh on-chip entries carried
+    `mfu`; they carried only `used` (the axon lowering yields no cost
+    analysis) and no test was red.  Same discipline as the scenario
+    rule, for the matrix: a claim unit naming bench_matrix.json or
+    'on-chip' plus a backticked bench field asserts the field exists in
+    a matrix entry — an on-chip one when the unit says on-chip."""
+    entries = list(_matrix().values())
+    onchip = [r for r in entries
+              if r.get("platform") == "tpu" and r.get("value")]
+    vocab = _bench_field_vocab()
+    failures = []
+    for path, text in _current_claim_docs():
+        for unit in _paragraphs(text):
+            if _SCOPE_PHRASE in unit.lower():
+                continue
+            says_onchip = "on-chip" in unit.lower()
+            if "bench_matrix.json" not in unit and not says_onchip:
+                continue
+            pool = onchip if says_onchip else entries
+            for tok in _FIELD_TOKEN.findall(unit):
+                # Dotted tokens validate per-segment, like the scenario
+                # rule — `memory_info_mib.used` is a field claim too.
+                if not all(s in vocab for s in tok.split(".")):
+                    continue
+                if not any(_has_key_path(r, tok, allow_value_match=False)
+                           for r in pool):
+                    failures.append(
+                        f"{os.path.basename(path)}: claim unit asserts "
+                        f"field `{tok}` in "
+                        f"{'an on-chip ' if says_onchip else 'a '}"
+                        f"bench_matrix.json entry — no such entry has "
+                        f"it; land the rerun or scope the prose "
+                        f"'{_SCOPE_PHRASE}'")
+    assert not failures, "\n".join(failures)
 
 
 def test_scenario_artifact_field_claims_hold():
