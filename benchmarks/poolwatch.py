@@ -93,10 +93,16 @@ def probe_once(window_s: float) -> bool:
         except OSError:
             txt = ""
         if "PROBE_OK" in txt:
-            plat = txt.split("PROBE_OK", 1)[1].split()[0]
-            log(f"probe answered: {txt.strip().splitlines()[-1]}")
-            _unlink(marker.name)          # child exited; safe to remove
-            return plat == "tpu"
+            # Guard against a partially flushed marker line ("PROBE_OK"
+            # with no platform token yet): a live child flushes the token
+            # by the next read, so fall through to the exit check below
+            # rather than crash the watcher — or stall on a dead child.
+            toks = txt.split("PROBE_OK", 1)[1].split()
+            if toks:
+                plat = toks[0]
+                log(f"probe answered: {txt.strip().splitlines()[-1]}")
+                _unlink(marker.name)      # child exited; safe to remove
+                return plat == "tpu"
         # Child exit without PROBE_OK = failed probe, whatever the
         # failure mode (PROBE_ERR via the wrapped path, a Traceback
         # before the try block, a C++-level abort, a segfault, an
@@ -113,7 +119,11 @@ def probe_once(window_s: float) -> bool:
             except OSError:
                 pass
             if "PROBE_OK" in txt:
-                plat = txt.split("PROBE_OK", 1)[1].split()[0]
+                # Same partial-flush guard as above; the child has
+                # exited, so an empty token list means the platform
+                # token never made it out — treat as a failed probe.
+                toks = txt.split("PROBE_OK", 1)[1].split()
+                plat = toks[0] if toks else ""
                 log(f"probe answered: {txt.strip().splitlines()[-1]}")
                 _unlink(marker.name)
                 return plat == "tpu"
@@ -265,11 +275,14 @@ def run_queue(kinds) -> bool:
             log(f"task {name}: fuse={fuse:.0f}s")
             t0 = time.time()
             stop, rc, out, err = _guarded_run(name, argv, env, fuse)
+            if stop:
+                return False
+            # Marker only AFTER the stop check: an rc=0 child that
+            # reported a detached claim-holder yielded the window — its
+            # case must re-run, not be recorded as "tried this round".
             if marker and rc == 0:
                 with open(marker, "w") as f:
                     f.write(str(time.time()))
-            if stop:
-                return False
             tail = (err or out).strip().splitlines()[-1:] or ["<no output>"]
             log(f"task {name}: rc={rc} in {time.time()-t0:.0f}s "
                 f"| {tail[0][:140]}")

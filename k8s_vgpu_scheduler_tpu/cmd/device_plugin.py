@@ -50,6 +50,9 @@ def parse_args(argv=None):
                         "fractional HBM caps, env-share = time-slice with "
                         "no caps, default = exclusive whole chips")
     p.add_argument("--socket-dir", default="/var/lib/kubelet/device-plugins")
+    p.add_argument("--debug-port", type=int, default=0,
+                   help="loopback /debug endpoints incl. tracez/events — "
+                        "the node-side view of Allocate spans (0 = off)")
     p.add_argument("--config-file", default="/config/config.json")
     p.add_argument("--shim-dir", default="/usr/local/vtpu")
     p.add_argument("--cache-dir", default="/tmp/vtpu/containers")
@@ -90,6 +93,13 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    from ..util import trace
+
+    trace.configure(service="vtpu-device-plugin")
+    if args.debug_port:
+        from ..util.debugz import DebugServer
+
+        DebugServer(port=args.debug_port).start()
     cfg = Config(
         node_name=args.node_name or os.uname().nodename,
         scheduler_endpoint=args.scheduler_endpoint,

@@ -14,6 +14,7 @@ import time
 from ..monitor.feedback import FeedbackLoop
 from ..monitor.metrics import start_metrics_server
 from ..tpulib import detect
+from ..util import trace
 
 
 def parse_args(argv=None):
@@ -43,6 +44,7 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    trace.configure(service="vtpu-monitor")
     backend = None
     if not args.no_backend:
         try:
@@ -68,7 +70,12 @@ def main(argv=None):
         while True:
             t0 = time.monotonic()
             try:
-                loop.tick()
+                # Traced per tick: the region-scan latency histogram is
+                # exported by NodeCollector and the spans show up on the
+                # monitor's /debug/tracez (--debug-port).
+                with trace.tracer().span("region-scan") as sp:
+                    loop.tick()
+                    sp.set("containers", len(loop.containers))
             except Exception:
                 logging.exception("feedback tick failed")
             time.sleep(max(0.1, args.interval - (time.monotonic() - t0)))

@@ -62,9 +62,12 @@ def parse_args(argv=None):
     p.add_argument("--no-watch", action="store_true",
                    help="disable the pod watch stream; rely on resync only")
     p.add_argument("--debug", action="store_true",
-                   help="enable the /debug profiling endpoints (stacks, "
-                        "wall-clock profile, vars); unauthenticated — keep "
-                        "off unless the port is restricted")
+                   help="enable the /debug endpoints (stacks, wall-clock "
+                        "profile, vars, tracez, events); unauthenticated — "
+                        "keep off unless the port is restricted")
+    p.add_argument("--trace-capacity", type=int, default=2048,
+                   help="spans kept in the in-memory /debug/tracez ring "
+                        "(the pod-lifecycle event journal keeps 2x this)")
     p.add_argument("--fake-kube", action="store_true",
                    help="in-memory apiserver (dev/dry-run only)")
     p.add_argument("--kube-url", default="",
@@ -132,6 +135,11 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    from ..util import trace
+
+    trace.configure(service="vtpu-scheduler",
+                    capacity=args.trace_capacity,
+                    event_capacity=2 * args.trace_capacity)
     if args.fake_kube:
         client = DryRunKube()
         for n in ("node-a", "node-b"):

@@ -54,7 +54,7 @@ from ..scheduler.gang import (
     GANG_RANK_ANNOTATION,
     GANG_TOTAL_ANNOTATION,
 )
-from ..util import protocol
+from ..util import protocol, trace
 from ..util.enforcement import check_shim_install
 from ..util.config import Config
 from ..util.types import (
@@ -100,18 +100,28 @@ class CrashLoopBreaker:
                 f"{int(self.window_s)}s; giving up (crash-loop breaker)")
 
 
-def attach_enforcement(resp, cfg: Config, cache_key: str) -> None:
+def attach_enforcement(resp, cfg: Config, cache_key: str,
+                       trace_id: str = "") -> None:
     """Attach the L1 enforcement contract to an allocate response: the
     per-container shared accounting region (hostPath dir, scanned by the
     monitor — reference CUDA_DEVICE_MEMORY_SHARED_CACHE +
     /tmp/vgpu/containers/<uid_ctr>, plugin.go:353–380, pathmonitor.go:17)
     and the shim library + ld.so.preload mounts.  Shared by the extender
-    path and the partition passthrough path."""
+    path and the partition passthrough path.  A webhook-issued trace id
+    is dropped as a ``trace`` file next to the shared region (and the
+    shim re-writes it from VTPU_TRACE_ID on install), so host-side
+    tooling can map a region dir back to its scheduling trace."""
     cache_dir = os.path.join(cfg.cache_host_dir, cache_key)
     try:
         os.makedirs(cache_dir, exist_ok=True)
     except OSError as e:
         log.warning("cannot create cache dir %s: %s", cache_dir, e)
+    if trace_id:
+        try:
+            with open(os.path.join(cache_dir, "trace"), "w") as f:
+                f.write(trace_id + "\n")
+        except OSError as e:
+            log.warning("cannot record trace id in %s: %s", cache_dir, e)
     container_cache = "/tmp/vtpu/vtpu.cache"
     resp.envs[ENV_SHARED_CACHE] = container_cache
     resp.mounts.append(
@@ -255,31 +265,50 @@ class TpuDevicePlugin:
         return pb.PreStartContainerResponse()
 
     def Allocate(self, request, context):  # noqa: N802
-        """The node-agent half of the two-phase commit (plugin.go:318–386)."""
+        """The node-agent half of the two-phase commit (plugin.go:318–386).
+        Traced in this process's tracer as the ``allocate`` span; the
+        trace id comes from the pod's webhook-issued annotation (the
+        caller is the kubelet, which carries no trace context)."""
         responses = pb.AllocateResponse()
         pod = None
-        try:
-            pod = protocol.get_pending_pod(self.client, self.cfg.node_name)
-            if pod is None:
-                raise LookupError(
-                    f"no pod in allocating phase on node {self.cfg.node_name}"
-                )
-            for _ in request.container_requests:
-                grant = protocol.get_next_device_request(TPU_DEVICE, pod)
-                protocol.erase_next_device_type(self.client, TPU_DEVICE, pod)
-                responses.container_responses.append(
-                    self.build_container_response(pod, grant)
-                )
-            protocol.pod_allocation_try_success(self.client, pod)
-            return responses
-        except Exception as e:  # noqa: BLE001 — any failure must free the pod
-            log.exception("Allocate failed")
-            if pod is not None:
-                try:
-                    protocol.pod_allocation_failed(self.client, pod)
-                except Exception:
-                    log.exception("failed to mark pod allocation failed")
-            context.abort(grpc.StatusCode.INTERNAL, f"allocate failed: {e}")
+        tr = trace.tracer()
+        tid = ""
+        with tr.span("allocate", trace_id=tid,
+                     node=self.cfg.node_name) as sp:
+            try:
+                pod = protocol.get_pending_pod(self.client,
+                                               self.cfg.node_name)
+                if pod is None:
+                    raise LookupError(
+                        "no pod in allocating phase on node "
+                        f"{self.cfg.node_name}"
+                    )
+                sp.trace_id = tid = trace.trace_id_of(pod) or tid
+                sp.set("pod", pod_name(pod))
+                for _ in request.container_requests:
+                    grant = protocol.get_next_device_request(TPU_DEVICE, pod)
+                    protocol.erase_next_device_type(
+                        self.client, TPU_DEVICE, pod)
+                    responses.container_responses.append(
+                        self.build_container_response(pod, grant)
+                    )
+                    sp.set("chips", len(grant))
+                protocol.pod_allocation_try_success(self.client, pod)
+                tr.event(pod_uid(pod), "allocated", trace_id=tid,
+                         pod=pod_name(pod), node=self.cfg.node_name)
+                return responses
+            except Exception as e:  # noqa: BLE001 — any failure must free the pod
+                log.exception("Allocate failed")
+                sp.set("error", str(e))
+                if pod is not None:
+                    tr.event(pod_uid(pod), "allocate-failed", trace_id=tid,
+                             pod=pod_name(pod), error=str(e))
+                    try:
+                        protocol.pod_allocation_failed(self.client, pod)
+                    except Exception:
+                        log.exception("failed to mark pod allocation failed")
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"allocate failed: {e}")
 
     # -- response assembly -----------------------------------------------------
     def build_container_response(self, pod: dict, grant) -> pb.ContainerAllocateResponse:
@@ -327,7 +356,11 @@ class TpuDevicePlugin:
             coord = anns.get(GANG_COORDINATOR_ANNOTATION, "")
             if coord:
                 resp.envs["VTPU_GANG_COORDINATOR"] = coord
-        attach_enforcement(resp, self.cfg, f"{pod_uid(pod)}_{pod_name(pod)}")
+        trace_id = trace.trace_id_of(pod)
+        if trace_id:
+            resp.envs[trace.ENV_TRACE_ID] = trace_id
+        attach_enforcement(resp, self.cfg, f"{pod_uid(pod)}_{pod_name(pod)}",
+                           trace_id=trace_id)
         return resp
 
     # -- serving lifecycle (Serve/Register, plugin.go:181–253) ----------------

@@ -11,10 +11,11 @@ import logging
 import time
 from typing import Iterable, Optional
 
-from prometheus_client.core import GaugeMetricFamily
+from prometheus_client.core import GaugeMetricFamily, HistogramMetricFamily
 from prometheus_client.registry import Collector
 
 from ..tpulib.backend import Backend
+from ..util import trace
 from .feedback import FeedbackLoop
 
 log = logging.getLogger(__name__)
@@ -101,8 +102,17 @@ class NodeCollector(Collector):
                 c_procs.add_metric([c.key], len(r.proc_pids()))
                 c_oversub.add_metric([c.key], r.oversubscribe)
 
+        phase_latency = HistogramMetricFamily(
+            "vtpu_monitor_phase_latency_seconds",
+            "Wall-clock latency of one monitor phase (region-scan tick)",
+            labels=["phase"],
+        )
+        for phase, (buckets, _count, sum_s) in \
+                trace.tracer().histogram_snapshot().items():
+            phase_latency.add_metric([phase], buckets, sum_s)
+
         return [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs,
-                c_oversub]
+                c_oversub, phase_latency]
 
 
 def start_metrics_server(loop: FeedbackLoop, backend: Optional[Backend],

@@ -27,7 +27,7 @@ from ..k8s.client import (
     pod_uid,
 )
 from ..tpulib.types import TopologyDesc
-from ..util import codec
+from ..util import codec, trace
 from ..util.config import Config
 from ..util.nodelock import NodeLockError, lock_node, release_node
 from ..util.protocol import bind_timestamp
@@ -37,7 +37,9 @@ from ..util.types import (
     ASSIGNED_NODE_ANNOTATION,
     ASSIGNED_TIME_ANNOTATION,
     BIND_ALLOCATING,
+    BIND_FAILED,
     BIND_PHASE_ANNOTATION,
+    BIND_SUCCESS,
     BIND_TIME_ANNOTATION,
     TO_ALLOCATE_ANNOTATION,
 )
@@ -129,6 +131,13 @@ class Scheduler:
         # metrics collector exposes it; operators alert on it — every
         # increment is a checkpoint/restore cycle imposed on a workload).
         self.preemptions_requested = 0
+        # uids whose allocate phase has been traced: watch + resync replay
+        # bind-phase=success MODIFIEDs repeatedly, but the allocate span
+        # (bind-time → success observed) must be recorded once.  Cleared
+        # wholesale at the cap — worst case a replayed span after a very
+        # long run, never unbounded growth.
+        self._alloc_traced: set = set()
+        self._alloc_traced_lock = threading.Lock()
 
     def _note_deleted(self, uid: str) -> None:
         now = time.monotonic()
@@ -176,6 +185,12 @@ class Scheduler:
             return
         anns = pod.get("metadata", {}).get("annotations", {})
         node = anns.get(ASSIGNED_NODE_ANNOTATION, "")
+        phase = anns.get(BIND_PHASE_ANNOTATION, "")
+        if event != "DELETED" and phase in (BIND_SUCCESS, BIND_FAILED):
+            # The node agent's half of the two-phase commit completed:
+            # reconstruct the allocate-phase span (bind-time annotation →
+            # this observation) on the control plane's trace.
+            self._trace_allocate(uid, pod, anns, phase)
         if event == "DELETED" or is_pod_terminated(pod) or not node:
             # A gang member between atomic admission and its own annotation
             # write has a tentative grant but no assigned-node annotation
@@ -184,6 +199,14 @@ class Scheduler:
             # releases it, via the gang registry too.
             if event == "DELETED" or is_pod_terminated(pod):
                 self.gangs.drop_member(uid)
+                if self._deleted_since(uid) is None and \
+                        self.pods.get(uid) is not None:
+                    # First observation of this pod's end while it still
+                    # held a grant — journal it once, not per replay.
+                    trace.tracer().event(
+                        uid, "deleted", trace_id=anns.get(
+                            trace.TRACE_ID_ANNOTATION, ""),
+                        pod=pod_name(pod), event=event)
                 self._note_deleted(uid)
                 # A deleted pod can be an outstanding preemption REQUESTER:
                 # rescind so its victims don't checkpoint for nothing.
@@ -219,6 +242,7 @@ class Scheduler:
                 node=node,
                 devices=devices,
                 priority=prio,
+                trace_id=anns.get(trace.TRACE_ID_ANNOTATION, ""),
             )
         )
         if event == "ADDED" and self._deleted_since(uid) is not None:
@@ -229,6 +253,42 @@ class Scheduler:
             # tombstone here, or the delete's del_pod ran after our add
             # and removed the entry itself).
             self.pods.del_pod(uid)
+
+    def _trace_allocate(self, uid: str, pod: dict, anns: Dict[str, str],
+                        phase: str) -> None:
+        """Reconstruct the allocate-phase span from the bind-time
+        annotation and the arrival of the terminal bind-phase event —
+        the scheduler-side record of the node agent's Allocate.  Once per
+        uid; stale resync replays (a restart re-listing long-running
+        pods) are journal-only so ancient allocations can't pollute the
+        latency histogram."""
+        with self._alloc_traced_lock:
+            if uid in self._alloc_traced:
+                return
+            if len(self._alloc_traced) > 8192:
+                self._alloc_traced.clear()
+            self._alloc_traced.add(uid)
+        tid = anns.get(trace.TRACE_ID_ANNOTATION, "")
+        node = anns.get(ASSIGNED_NODE_ANNOTATION, "")
+        end = time.time()
+        try:
+            start = int(anns.get(BIND_TIME_ANNOTATION, "0")) / 1e9
+        except ValueError:
+            start = 0.0
+        extra: Dict[str, object] = {}
+        if 0.0 < start <= end and end - start < 300.0:
+            trace.tracer().record("allocate", tid, start, end,
+                                  pod=pod_name(pod), node=node, phase=phase)
+        elif start > 0.0:
+            # Over the staleness cutoff (a restart's resync re-listing a
+            # long-bound pod is indistinguishable from a 5-minute
+            # allocate) — excluded from the latency histogram, but NOT
+            # silently: the journal entry says so and carries the
+            # duration, so a genuinely wedged allocate is still findable.
+            extra = {"histogram": "dropped-stale",
+                     "duration_s": round(end - start, 3)}
+        trace.tracer().event(uid, f"allocate-{phase}", trace_id=tid,
+                             pod=pod_name(pod), node=node, **extra)
 
     def resync_from_apiserver(self) -> str:
         """Full reconcile: re-add every listed pod AND prune grants whose pod
@@ -424,16 +484,43 @@ class Scheduler:
     def filter(self, pod: dict, node_names: List[str]) -> FilterResult:
         """Decide under the in-memory lock; talk to the apiserver outside it
         (a slow patch must not stall every concurrent Filter and /metrics
-        scrape).  The tentative grant is rolled back if the patch fails."""
+        scrape).  The tentative grant is rolled back if the patch fails.
+
+        Traced: the in-memory decision is the ``filter`` span, the
+        annotation patch is the separate ``decision-write`` span (it is
+        apiserver I/O — the usual place a 40 ms budget goes)."""
+        tid = trace.trace_id_of(pod)
+        tr = trace.tracer()
         # Expiry sweep first, outside the lock (it may talk to the apiserver).
         if self.gangs.groups():
             self._release_expired_gangs()
-        with self._filter_lock:
-            result = self._decide_locked(pod, node_names)
+        with tr.span("filter", trace_id=tid, pod=pod_name(pod),
+                     candidates=len(node_names)) as sp:
+            with self._filter_lock:
+                result = self._decide_locked(pod, node_names)
+            if result.failed:
+                # Count every per-node rejection by its dominant token
+                # (the summary's leading word keeps cardinality bounded).
+                for reason in result.failed.values():
+                    tr.reject(reason.split(":", 1)[0].strip())
+                sp.set("rejected_nodes", len(result.failed))
+                sp.set("rejections", "; ".join(
+                    f"{n}={r}" for n, r in
+                    sorted(result.failed.items())[:8]))
+            if result.error:
+                sp.set("error", result.error)
+            if result.node is not None:
+                sp.set("node", result.node)
         if result.node is None:
+            if result.error or result.failed:
+                tr.event(pod_uid(pod), "filter-rejected", trace_id=tid,
+                         pod=pod_name(pod), error=result.error,
+                         preempting=result.preempt is not None)
             if result.preempt is not None:
                 self._request_preemptions(pod, result.preempt)
             return result
+        tr.event(pod_uid(pod), "filter-assigned", trace_id=tid,
+                 pod=pod_name(pod), node=result.node)
         if self._preempt_by_requester.get(pod_uid(pod)):
             # The pod found a seat after all (capacity freed elsewhere):
             # its outstanding eviction requests are now pointless.
@@ -450,13 +537,19 @@ class Scheduler:
             # The member's jax.distributed process rank (stable across
             # replacements) — surfaced to the container as VTPU_GANG_RANK.
             patch[GANG_RANK_ANNOTATION] = str(rank)
-        try:
-            self.client.patch_pod_annotations(
-                pod_namespace(pod), pod_name(pod), patch)
-        except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
-            log.error("failed to write decision for %s: %s", pod_name(pod), e)
-            self.pods.del_pod(pod_uid(pod))
-            return FilterResult(error=f"writing decision failed: {e}")
+        with tr.span("decision-write", trace_id=tid, pod=pod_name(pod),
+                     node=result.node) as wsp:
+            try:
+                self.client.patch_pod_annotations(
+                    pod_namespace(pod), pod_name(pod), patch)
+            except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
+                log.error("failed to write decision for %s: %s",
+                          pod_name(pod), e)
+                self.pods.del_pod(pod_uid(pod))
+                wsp.set("error", str(e))
+                tr.event(pod_uid(pod), "decision-write-failed",
+                         trace_id=tid, error=str(e))
+                return FilterResult(error=f"writing decision failed: {e}")
         return result
 
     def _request_preemptions(self, pod: dict, plan: "PreemptionPlan") -> None:
@@ -543,11 +636,14 @@ class Scheduler:
                 failed[name] = "no TPU inventory registered"
                 continue
             info, usage = entry
+            why: Dict[str, str] = {}
             placement = score_mod.fit_pod(
-                requests, usage, info.topology, anns, self.cfg.topology_policy
+                requests, usage, info.topology, anns,
+                self.cfg.topology_policy, reasons=why
             )
             if placement is None:
-                failed[name] = "insufficient TPU capacity/topology"
+                failed[name] = why.get(
+                    "reason", "insufficient TPU capacity/topology")
                 continue
             s = score_mod.node_score(usage, self.cfg.node_scheduler_policy)
             if best is None or s > best[0]:
@@ -582,6 +678,7 @@ class Scheduler:
                 node=node,
                 devices=placement,
                 priority=pod_priority(pod, self.cfg),
+                trace_id=trace.trace_id_of(pod),
             )
         )
         return FilterResult(node=node, failed=failed)
@@ -620,7 +717,8 @@ class Scheduler:
                     PodInfo(uid=uid, name=pod_name(pod),
                             namespace=pod_namespace(pod), node=node,
                             devices=devices,
-                            priority=pod_priority(pod, self.cfg))
+                            priority=pod_priority(pod, self.cfg),
+                            trace_id=trace.trace_id_of(pod))
                 )
             return FilterResult(node=node)
 
@@ -660,7 +758,9 @@ class Scheduler:
             # uids are excluded from victim candidates wholesale.
             self.pods.add_pod(
                 PodInfo(uid=member_uid, name=m.name, namespace=m.namespace,
-                        node=node, devices=devices)
+                        node=node, devices=devices,
+                        trace_id=m.annotations.get(
+                            trace.TRACE_ID_ANNOTATION, ""))
             )
         log.info("gang %s admitted: %s", group,
                  {u: n for u, (n, _) in placements.items()})
@@ -711,27 +811,39 @@ class Scheduler:
         """Returns error string or None (reference Bind, scheduler.go:224–264).
         The node lock is NOT released here on success — the device plugin
         releases it when allocation completes (two-phase commit)."""
-        try:
-            lock_node(self.client, node)
-        except NodeLockError as e:
-            return str(e)
-        try:
-            self.client.patch_pod_annotations(
-                namespace,
-                name,
-                {
-                    BIND_PHASE_ANNOTATION: BIND_ALLOCATING,
-                    BIND_TIME_ANNOTATION: bind_timestamp(),
-                },
-            )
-            self.client.bind_pod(namespace, name, node)
-        except Exception as e:  # noqa: BLE001 — any bind failure frees the node
-            log.error("bind %s/%s to %s failed: %s", namespace, name, node, e)
+        info = self.pods.get(uid)
+        tid = info.trace_id if info is not None else ""
+        tr = trace.tracer()
+        with tr.span("bind", trace_id=tid, pod=name, node=node) as sp:
             try:
-                release_node(self.client, node)
-            except Exception:
-                log.exception("failed to release lock on %s after bind error", node)
-            return str(e)
+                lock_node(self.client, node)
+            except NodeLockError as e:
+                sp.set("error", str(e))
+                tr.event(uid, "bind-lock-denied", trace_id=tid, node=node)
+                return str(e)
+            try:
+                self.client.patch_pod_annotations(
+                    namespace,
+                    name,
+                    {
+                        BIND_PHASE_ANNOTATION: BIND_ALLOCATING,
+                        BIND_TIME_ANNOTATION: bind_timestamp(),
+                    },
+                )
+                self.client.bind_pod(namespace, name, node)
+            except Exception as e:  # noqa: BLE001 — any bind failure frees the node
+                log.error("bind %s/%s to %s failed: %s",
+                          namespace, name, node, e)
+                try:
+                    release_node(self.client, node)
+                except Exception:
+                    log.exception(
+                        "failed to release lock on %s after bind error", node)
+                sp.set("error", str(e))
+                tr.event(uid, "bind-failed", trace_id=tid, node=node,
+                         error=str(e))
+                return str(e)
+        tr.event(uid, "bound", trace_id=tid, pod=name, node=node)
         return None
 
 

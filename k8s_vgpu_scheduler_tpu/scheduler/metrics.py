@@ -10,9 +10,14 @@ from __future__ import annotations
 
 from typing import Iterable
 
-from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+    HistogramMetricFamily,
+)
 from prometheus_client.registry import Collector
 
+from ..util import trace
 from .core import Scheduler
 
 
@@ -82,7 +87,31 @@ class ClusterCollector(Collector):
         preempts.add_metric([], self.scheduler.preemptions_requested)
 
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
-                pod_mem, pod_cores, preempts]
+                pod_mem, pod_cores, preempts] + list(phase_metrics())
+
+
+def phase_metrics():
+    """Per-phase scheduling latency histograms + node-rejection-reason
+    counters, read out of this process's tracer (util/trace.py) — the
+    aggregate face of the spans /debug/tracez shows one pod at a time."""
+    latency = HistogramMetricFamily(
+        "vtpu_scheduling_phase_latency_seconds",
+        "Wall-clock latency of one scheduling phase (webhook, filter, "
+        "decision-write, bind, allocate)",
+        labels=["phase"],
+    )
+    for phase, (buckets, _count, sum_s) in \
+            trace.tracer().histogram_snapshot().items():
+        latency.add_metric([phase], buckets, sum_s)
+    rejections = CounterMetricFamily(
+        "vtpu_filter_rejections",
+        "Nodes rejected during Filter, by dominant reason token "
+        "(from scheduler/score.py per-chip rules)",
+        labels=["reason"],
+    )
+    for reason, n in trace.tracer().rejection_snapshot().items():
+        rejections.add_metric([reason], n)
+    return [latency, rejections]
 
 
 def start_metrics_server(scheduler: Scheduler, port: int = 9395):

@@ -27,6 +27,10 @@ class PodInfo:
     # convention) — read by the preemption planner when a higher-priority
     # pod fits nowhere.
     priority: int = 0
+    # Webhook-issued vtpu.dev/trace-id — carried here so Bind (which gets
+    # only namespace/name/uid, no pod object) can stamp its span without
+    # an apiserver read.
+    trace_id: str = ""
     # Monotonic time of the most recent add/refresh: a full-list resync
     # must not prune a grant recorded AFTER its list snapshot was taken
     # (the pod simply didn't exist yet in that stale list).

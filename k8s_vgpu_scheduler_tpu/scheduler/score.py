@@ -155,23 +155,55 @@ def _resolve_mem(req: ContainerDeviceRequest, chip: DeviceUsage) -> int:
     return chip.total_mem * pct // 100
 
 
+def _chip_reject_reason(req: ContainerDeviceRequest, chip: DeviceUsage,
+                        affinity: Tuple[Optional[List[str]], List[str]],
+                        ) -> Optional[str]:
+    """First failing per-chip rule as a low-cardinality token — feeds the
+    rejection-reason counters and the per-node Filter failure strings, so
+    'why was node X rejected?' has an answer beyond 'no capacity'.  The
+    single source of the per-chip rules: ``_chip_fits`` delegates here,
+    so a rule added to one cannot silently drift from the other."""
+    if not chip.health:
+        return "unhealthy"
+    if not _type_ok(affinity, chip.type):
+        return "type-mismatch"
+    if chip.free_slots <= 0:
+        return "slots-exhausted"
+    if chip.used_cores >= chip.total_cores:
+        # fully-committed compute accepts nothing (score.go:159–162)
+        return "cores-exhausted"
+    if req.coresreq >= 100 and (chip.used_slots > 0 or chip.used_cores > 0):
+        # exclusive wants a virgin chip (score.go:155–157)
+        return "exclusive-chip-busy"
+    if req.coresreq > chip.free_cores:
+        return "insufficient-cores"
+    if _resolve_mem(req, chip) > chip.free_mem:
+        return "insufficient-hbm"
+    return None
+
+
 def _chip_fits(req: ContainerDeviceRequest, chip: DeviceUsage,
                affinity: Tuple[Optional[List[str]], List[str]]) -> bool:
-    if not chip.health:
-        return False
-    if not _type_ok(affinity, chip.type):
-        return False
-    if chip.free_slots <= 0:
-        return False
-    if chip.used_cores >= chip.total_cores:
-        return False  # fully-committed compute accepts nothing (score.go:159–162)
-    if req.coresreq >= 100 and (chip.used_slots > 0 or chip.used_cores > 0):
-        return False  # exclusive wants a virgin chip (score.go:155–157)
-    if req.coresreq > chip.free_cores:
-        return False
-    if _resolve_mem(req, chip) > chip.free_mem:
-        return False
-    return True
+    return _chip_reject_reason(req, chip, affinity) is None
+
+
+def _reject_summary(req: ContainerDeviceRequest,
+                    usage: Dict[str, DeviceUsage],
+                    affinity: Tuple[Optional[List[str]], List[str]],
+                    ) -> str:
+    """Tally per-chip reject reasons into one human-readable line (and a
+    dominant token first, so counters stay low-cardinality)."""
+    tally: Dict[str, int] = {}
+    for chip in usage.values():
+        why = _chip_reject_reason(req, chip, affinity)
+        if why is not None:
+            tally[why] = tally.get(why, 0) + 1
+    if not tally:
+        return (f"too-few-chips: node has {len(usage)} chips, "
+                f"request needs {req.nums}")
+    detail = ", ".join(f"{n}/{len(usage)} {why}" for why, n in
+                       sorted(tally.items(), key=lambda kv: -kv[1]))
+    return f"{max(tally, key=tally.get)}: {detail}"
 
 
 def fit_container(
@@ -180,13 +212,19 @@ def fit_container(
     topo: Optional[TopologyDesc],
     annotations: Dict[str, str],
     policy: str = BEST_EFFORT,
+    reasons: Optional[Dict[str, str]] = None,
 ) -> Optional[ContainerDevices]:
-    """Place one container's request, mutating ``usage`` on success."""
+    """Place one container's request, mutating ``usage`` on success.  On
+    failure, when the caller passes a ``reasons`` dict, its ``reason``
+    key is filled with why (per-chip tally / slice-search outcome) —
+    computed only on the reject path, so the fit hot path is unchanged."""
     if req.nums <= 0:
         return []
     affinity = _affinity(annotations)
     eligible = [u for u in usage.values() if _chip_fits(req, u, affinity)]
     if len(eligible) < req.nums:
+        if reasons is not None:
+            reasons["reason"] = _reject_summary(req, usage, affinity)
         return None
 
     chosen: Optional[List[DeviceUsage]] = None
@@ -198,9 +236,16 @@ def fit_container(
         if len(coord_map) == len(eligible):
             coords = find_slice(topo, coord_map.keys(), req.nums, policy)
             if coords is None:
+                if reasons is not None:
+                    reasons["reason"] = (
+                        f"no-ici-slice: no contiguous slice of "
+                        f"{req.nums} chips under policy {policy}")
                 return None
             chosen = [coord_map[c] for c in coords]
         elif policy == GUARANTEED:
+            if reasons is not None:
+                reasons["reason"] = ("topology-unverifiable: guaranteed "
+                                     "policy but chip coords missing")
             return None  # contiguity demanded but topology is unverifiable
     if chosen is None:
         # Bin-pack shared jobs onto already-shared chips so whole chips stay
@@ -229,14 +274,21 @@ def fit_pod(
     topo: Optional[TopologyDesc],
     annotations: Dict[str, str],
     default_policy: str = BEST_EFFORT,
+    reasons: Optional[Dict[str, str]] = None,
 ) -> Optional[List[ContainerDevices]]:
     """All containers or nothing; mutates ``usage`` as it goes (callers pass a
-    throwaway snapshot per candidate node)."""
+    throwaway snapshot per candidate node).  ``reasons`` (optional out-param)
+    receives the failing container's rejection summary."""
     policy = annotations.get(TOPOLOGY_POLICY_ANNOTATION, default_policy)
     out: List[ContainerDevices] = []
-    for req in requests:
-        got = fit_container(req, usage, topo, annotations, policy)
+    for i, req in enumerate(requests):
+        got = fit_container(req, usage, topo, annotations, policy, reasons)
         if got is None:
+            if reasons is not None and len(requests) > 1:
+                # Suffix, not prefix: the leading token stays the
+                # low-cardinality reason the rejection counter keys on.
+                reasons["reason"] = (reasons.get("reason", "no fit")
+                                     + f" (container {i})")
             return None
         out.append(got)
     return out

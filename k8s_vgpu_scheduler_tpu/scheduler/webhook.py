@@ -8,7 +8,10 @@ Reference: pkg/scheduler/webhook.go:170–247.  On pod CREATE:
   ``TPU_TASK_PRIORITY`` env injected (consumed by the enforcement shim's
   rate limiter);
 - if any container requests a managed TPU resource, ``spec.schedulerName``
-  is pointed at our extender-backed scheduler;
+  is pointed at our extender-backed scheduler and a ``vtpu.dev/trace-id``
+  annotation is issued — the request-scoped ID every later phase (Filter,
+  Bind, Allocate, shim) stamps its spans and journal entries with
+  (util/trace.py);
 - TPU containers that opted into LOW priority (>= 1) additionally get the
   downward-API annotations volume + mount + ``VTPU_PODINFO_ANNOTATIONS``
   env injected, so the preemption contract (docs/preemption.md) works
@@ -25,6 +28,7 @@ import json
 import logging
 from typing import List, Optional
 
+from ..util import trace
 from ..util.config import Config
 from ..util.resources import container_requests
 from ..util.types import ENV_TASK_PRIORITY
@@ -38,8 +42,15 @@ def _is_privileged(container: dict) -> bool:
     )
 
 
-def mutate_pod(pod: dict, cfg: Config) -> List[dict]:
-    """Return JSONPatch ops for one pod (empty list = no mutation)."""
+def mutate_pod(pod: dict, cfg: Config, trace_id: str = "",
+               info: Optional[dict] = None) -> List[dict]:
+    """Return JSONPatch ops for one pod (empty list = no mutation).
+    When ``trace_id`` is set, TPU pods additionally get it written as the
+    ``vtpu.dev/trace-id`` annotation (the webhook is the issuer; an ID
+    already present — e.g. a retried admission — is kept).  ``info``
+    (optional out-param, score.py ``reasons`` idiom) receives
+    ``wants_tpu`` — the single source of the "is this ours?" decision,
+    which also gates trace issuance in the caller."""
     containers = pod.get("spec", {}).get("containers", [])
     if any(_is_privileged(c) for c in containers):
         log.info("pod %s has privileged container; skipping mutation",
@@ -84,6 +95,8 @@ def mutate_pod(pod: dict, cfg: Config) -> List[dict]:
                 needs_podinfo.append(i)
     if needs_podinfo:
         patches.extend(_podinfo_patches(pod, needs_podinfo, env_created))
+    if info is not None:
+        info["wants_tpu"] = wants_tpu
     if wants_tpu:
         current = pod.get("spec", {}).get("schedulerName", "")
         if current != cfg.scheduler_name:
@@ -91,6 +104,23 @@ def mutate_pod(pod: dict, cfg: Config) -> List[dict]:
                 {"op": "add", "path": "/spec/schedulerName",
                  "value": cfg.scheduler_name}
             )
+        anns = pod.get("metadata", {}).get("annotations")
+        if trace_id and (anns is None
+                         or trace.TRACE_ID_ANNOTATION not in anns):
+            if anns is None:
+                patches.append(
+                    {"op": "add", "path": "/metadata/annotations",
+                     "value": {trace.TRACE_ID_ANNOTATION: trace_id}}
+                )
+            else:
+                # JSON-pointer-escape the '/' in the annotation key.
+                key = trace.TRACE_ID_ANNOTATION.replace("~", "~0").replace(
+                    "/", "~1")
+                patches.append(
+                    {"op": "add",
+                     "path": f"/metadata/annotations/{key}",
+                     "value": trace_id}
+                )
     return patches
 
 
@@ -161,13 +191,30 @@ def _podinfo_patches(pod: dict, container_idxs: List[int],
 
 def handle_admission_review(body: dict, cfg: Config) -> dict:
     """AdmissionReview in → AdmissionReview out (always allowed; mutation is
-    advisory — failurePolicy decides what a webhook outage means)."""
+    advisory — failurePolicy decides what a webhook outage means).  Only
+    TPU-requesting pods get a trace id + webhook span: the webhook sees
+    every pod CREATE cluster-wide, and tracing them all would let
+    ordinary churn evict the scheduling traces the ring exists to keep."""
     req = body.get("request", {})
     uid = req.get("uid", "")
     response = {"uid": uid, "allowed": True}
     pod = req.get("object")
     if isinstance(pod, dict) and req.get("operation", "CREATE") == "CREATE":
-        patches = mutate_pod(pod, cfg)
+        trace_id = trace.trace_id_of(pod) or trace.new_trace_id()
+        info: dict = {}
+        # The span is registered only if mutate_pod says the pod is ours
+        # (a dropped Span object costs nothing).
+        sp = trace.Span("webhook", trace_id)
+        patches = mutate_pod(pod, cfg, trace_id=trace_id, info=info)
+        if info.get("wants_tpu"):
+            meta = pod.get("metadata", {})
+            sp.set("pod", meta.get("name", "?"))
+            sp.set("patch_ops", len(patches))
+            trace.tracer().finish(sp)
+            if patches:
+                trace.tracer().event(
+                    meta.get("uid", ""), "webhook-mutated",
+                    trace_id=trace_id, patch_ops=len(patches))
         if patches:
             response["patchType"] = "JSONPatch"
             response["patch"] = base64.b64encode(

@@ -679,6 +679,26 @@ class Shim:
             spiller.stop()
 
 
+def publish_trace_id() -> Optional[str]:
+    """Drop the scheduler's webhook-issued trace id (VTPU_TRACE_ID, set
+    by the device plugin's Allocate) next to the shared accounting region
+    so the host-side monitor and debug tooling can stitch this container
+    into the end-to-end scheduling trace.  Best effort; returns the path
+    written or None.  Stdlib-only — this file ships standalone."""
+    trace_id = os.environ.get("VTPU_TRACE_ID", "")
+    cache = os.environ.get("TPU_DEVICE_MEMORY_SHARED_CACHE", "")
+    if not trace_id or not cache:
+        return None
+    path = os.path.join(os.path.dirname(cache), "trace")
+    try:
+        with open(path, "w") as f:
+            f.write(trace_id + "\n")
+    except OSError as e:
+        log.warning("cannot publish trace id to %s: %s", path, e)
+        return None
+    return path
+
+
 _GLOBAL: Optional[Shim] = None
 
 
@@ -692,6 +712,7 @@ def install(region_path: Optional[str] = None, jax_hooks: bool = True,
     native = Native()
     native.init(region_path)
     shim = Shim(native)
+    publish_trace_id()
     # Same accepted values as the native parser (region.cc apply_env_limits);
     # inlined rather than imported because this file ships standalone.
     oversub = os.environ.get("TPU_OVERSUBSCRIBE", "") in ("true", "1")

@@ -1,13 +1,17 @@
 """pprof-style debug endpoints (SURVEY.md §5: the reference has klog only;
 the rebuild bar is structured logging + optional profiling endpoints).
 
-Three views, modeled on Go's net/http/pprof:
+Five views, modeled on Go's net/http/pprof:
 
 - ``/debug/stacks``   — every thread's current stack (goroutine?debug=2)
 - ``/debug/profile``  — wall-clock sampling profiler over ``?seconds=N``
   (default 5): polls ``sys._current_frames`` and aggregates flat frame
   counts, cheapest useful CPU-profile analog without a C extension
 - ``/debug/vars``     — process vitals (rss, fds, threads, gc, uptime)
+- ``/debug/tracez``   — recent scheduling spans from util/trace.py,
+  grouped by trace id; ``?trace=<id>`` filters, ``?format=json`` emits
+  OTLP-shaped JSON for shipping to a collector
+- ``/debug/events``   — the pod-lifecycle journal; ``?pod=<uid>`` filters
 
 ``handle(path, query) -> (status, content_type, body)`` is transport-
 agnostic so both the extender's HTTP handler and the monitor's standalone
@@ -99,6 +103,14 @@ def handle(path: str, query: Dict[str, str]) -> Tuple[int, str, str]:
         return 200, "text/plain", profile(seconds)
     if path == "/debug/vars":
         return 200, "application/json", json.dumps(vars_(), indent=1)
+    if path == "/debug/tracez":
+        from . import trace
+
+        return trace.render_tracez(query)
+    if path == "/debug/events":
+        from . import trace
+
+        return trace.render_events(query)
     return 404, "application/json", json.dumps({"error": "not found"})
 
 

@@ -1,0 +1,320 @@
+"""util/trace.py: span ring, histograms, journal, OTLP export, and the
+debugz + metrics + webhook integration seams (the in-process half of the
+end-to-end trace contract; the cross-process half lives in
+test_multiprocess_e2e.py)."""
+
+import json
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.util import debugz, trace
+from k8s_vgpu_scheduler_tpu.util.trace import PhaseHistogram, Tracer
+
+
+@pytest.fixture
+def fresh(monkeypatch):
+    """Swap the process-global tracer for an isolated one."""
+    t = Tracer(capacity=64, event_capacity=64, service="test")
+    monkeypatch.setattr(trace, "_GLOBAL", t)
+    return t
+
+
+class TestRing:
+    def test_span_ring_evicts_oldest(self):
+        t = Tracer(capacity=4)
+        for i in range(10):
+            with t.span("filter", trace_id=f"t{i}"):
+                pass
+        spans = t.spans()
+        assert len(spans) == 4
+        assert [s.trace_id for s in spans] == ["t6", "t7", "t8", "t9"]
+
+    def test_event_ring_evicts_oldest(self):
+        t = Tracer(event_capacity=3)
+        for i in range(5):
+            t.event(f"u{i}", "created")
+        assert [e["pod_uid"] for e in t.events()] == ["u2", "u3", "u4"]
+
+    def test_events_filter_by_pod(self):
+        t = Tracer()
+        t.event("u1", "filter-assigned", trace_id="abc", node="node-a")
+        t.event("u2", "filter-rejected")
+        got = t.events("u1")
+        assert len(got) == 1
+        assert got[0]["event"] == "filter-assigned"
+        assert got[0]["trace_id"] == "abc"
+        assert got[0]["attributes"]["node"] == "node-a"
+
+    def test_span_records_exception_and_reraises(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            with t.span("bind", trace_id="x"):
+                raise ValueError("boom")
+        (sp,) = t.spans()
+        assert "boom" in sp.attrs["error"]
+
+
+class TestHistogram:
+    def test_bucket_emission_is_cumulative_with_inf(self):
+        h = PhaseHistogram(bounds=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.005, 0.05, 5.0):
+            h.observe(v)
+        buckets, count, sum_s = h.snapshot()
+        assert buckets == [("0.01", 2), ("0.1", 3), ("1.0", 3), ("+Inf", 4)]
+        assert count == 4
+        assert abs(sum_s - 5.06) < 1e-9
+
+    def test_tracer_histograms_keyed_by_phase(self):
+        t = Tracer()
+        t.record("filter", "tid", 100.0, 100.5)
+        t.record("bind", "tid", 100.0, 100.001)
+        snap = t.histogram_snapshot()
+        assert set(snap) == {"filter", "bind"}
+        _, count, sum_s = snap["filter"]
+        assert count == 1 and abs(sum_s - 0.5) < 1e-9
+
+    def test_prometheus_collector_renders_buckets(self, fresh):
+        from prometheus_client import CollectorRegistry, generate_latest
+        from prometheus_client.registry import Collector
+
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import phase_metrics
+
+        fresh.record("filter", "tid", 10.0, 10.0005)
+        fresh.reject("insufficient-hbm", 3)
+
+        class _C(Collector):
+            def collect(self):
+                return phase_metrics()
+
+        registry = CollectorRegistry()
+        registry.register(_C())
+        text = generate_latest(registry).decode()
+        assert ('vtpu_scheduling_phase_latency_seconds_bucket'
+                '{le="0.001",phase="filter"} 1.0') in text
+        assert ('vtpu_scheduling_phase_latency_seconds_bucket'
+                '{le="+Inf",phase="filter"} 1.0') in text
+        assert 'vtpu_scheduling_phase_latency_seconds_count{phase="filter"} 1.0' in text
+        assert ('vtpu_filter_rejections_total'
+                '{reason="insufficient-hbm"} 3.0') in text
+
+
+class TestRejectionReasons:
+    def test_fit_pod_explains_hbm_shortfall(self):
+        from k8s_vgpu_scheduler_tpu.scheduler.score import (
+            DeviceUsage,
+            fit_pod,
+        )
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDeviceRequest
+
+        usage = {"c0": DeviceUsage(
+            id="c0", type="v5e", health=True, coords=(0, 0),
+            total_slots=10, used_slots=0, total_mem=16384, used_mem=16000,
+            total_cores=100, used_cores=0)}
+        why = {}
+        got = fit_pod([ContainerDeviceRequest(nums=1, memreq=3000)],
+                      usage, None, {}, reasons=why)
+        assert got is None
+        assert why["reason"].split(":")[0] == "insufficient-hbm"
+
+    def test_fit_pod_explains_slice_failure(self):
+        from k8s_vgpu_scheduler_tpu.scheduler.score import (
+            DeviceUsage,
+            fit_pod,
+        )
+        from k8s_vgpu_scheduler_tpu.tpulib.types import TopologyDesc
+        from k8s_vgpu_scheduler_tpu.util.types import (
+            ContainerDeviceRequest,
+            GUARANTEED,
+        )
+
+        # Two healthy chips WITHOUT coords: guaranteed contiguity is
+        # unverifiable.
+        usage = {f"c{i}": DeviceUsage(
+            id=f"c{i}", type="v5e", health=True, coords=(),
+            total_slots=10, used_slots=0, total_mem=16384, used_mem=0,
+            total_cores=100, used_cores=0) for i in range(2)}
+        why = {}
+        got = fit_pod(
+            [ContainerDeviceRequest(nums=2, memreq=100)], usage,
+            TopologyDesc(generation="v5e", mesh=(2, 1)), {},
+            default_policy=GUARANTEED, reasons=why)
+        assert got is None
+        assert why["reason"].startswith("topology-unverifiable")
+
+
+class TestOtlpShape:
+    def test_tracez_json_is_otlp_shaped(self, fresh):
+        with fresh.span("filter", trace_id="a" * 32, node="node-a"):
+            pass
+        with fresh.span("bind", trace_id="b" * 32):
+            pass
+        status, ctype, body = trace.render_tracez({"format": "json"})
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        (rs,) = doc["resourceSpans"]
+        svc = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+        assert svc["service.name"]["stringValue"] == "test"
+        spans = rs["scopeSpans"][0]["spans"]
+        assert {s["name"] for s in spans} == {"filter", "bind"}
+        for s in spans:
+            assert len(s["traceId"]) == 32 and len(s["spanId"]) == 16
+            assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+        (f,) = [s for s in spans if s["name"] == "filter"]
+        attrs = {a["key"]: a["value"] for a in f["attributes"]}
+        assert attrs["node"]["stringValue"] == "node-a"
+
+    def test_tracez_json_filters_by_trace(self, fresh):
+        with fresh.span("filter", trace_id="a" * 32):
+            pass
+        with fresh.span("filter", trace_id="b" * 32):
+            pass
+        _, _, body = trace.render_tracez({"format": "json",
+                                          "trace": "a" * 32})
+        spans = json.loads(body)["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        assert [s["traceId"] for s in spans] == ["a" * 32]
+
+    def test_tracez_text_groups_by_trace(self, fresh):
+        with fresh.span("filter", trace_id="deadbeef" * 4):
+            pass
+        status, ctype, body = trace.render_tracez({})
+        assert status == 200 and ctype == "text/plain"
+        assert "deadbeef" in body and "filter" in body and "ms" in body
+
+
+class TestDebugzRouting:
+    def test_debugz_serves_tracez_and_events(self, fresh):
+        with fresh.span("filter", trace_id="c" * 32):
+            pass
+        fresh.event("uid-1", "filter-assigned", trace_id="c" * 32)
+        status, _, body = debugz.handle("/debug/tracez", {})
+        assert status == 200 and "filter" in body
+        status, _, body = debugz.handle("/debug/events", {"pod": "uid-1"})
+        assert status == 200
+        events = json.loads(body)["events"]
+        assert events and events[0]["pod_uid"] == "uid-1"
+        status, _, body = debugz.handle("/debug/events", {"pod": "no-such"})
+        assert json.loads(body)["events"] == []
+
+
+class TestWebhookIssuesTraceId:
+    def test_mutated_tpu_pod_carries_trace_annotation(self, fresh):
+        import base64
+
+        from k8s_vgpu_scheduler_tpu.scheduler.webhook import (
+            handle_admission_review,
+        )
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        from tests.test_scheduler_core import tpu_pod
+
+        pod = tpu_pod()
+        review = {"request": {"uid": "r1", "operation": "CREATE",
+                              "object": pod}}
+        out = handle_admission_review(review, Config())
+        patches = json.loads(base64.b64decode(out["response"]["patch"]))
+        (tp,) = [p for p in patches if "trace-id" in p["path"]]
+        assert tp["path"] == "/metadata/annotations/vtpu.dev~1trace-id"
+        assert len(tp["value"]) == 32
+        # ... and the webhook span carries the same id.
+        (sp,) = [s for s in fresh.spans() if s.name == "webhook"]
+        assert sp.trace_id == tp["value"]
+
+    def test_trace_annotation_created_when_annotations_absent(self, fresh):
+        from k8s_vgpu_scheduler_tpu.scheduler.webhook import mutate_pod
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        from tests.test_scheduler_core import tpu_pod
+
+        pod = tpu_pod()
+        del pod["metadata"]["annotations"]
+        patches = mutate_pod(pod, Config(), trace_id="f" * 32)
+        (tp,) = [p for p in patches if p["path"] == "/metadata/annotations"]
+        assert tp["value"] == {trace.TRACE_ID_ANNOTATION: "f" * 32}
+
+    def test_existing_trace_id_is_kept(self, fresh):
+        from k8s_vgpu_scheduler_tpu.scheduler.webhook import mutate_pod
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        from tests.test_scheduler_core import tpu_pod
+
+        pod = tpu_pod()
+        pod["metadata"]["annotations"][trace.TRACE_ID_ANNOTATION] = "keep"
+        patches = mutate_pod(pod, Config(), trace_id="g" * 32)
+        assert not any("trace-id" in p["path"] for p in patches)
+
+    def test_non_tpu_pod_gets_no_trace_id(self, fresh):
+        from k8s_vgpu_scheduler_tpu.scheduler.webhook import mutate_pod
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+
+        pod = {"metadata": {"name": "web", "namespace": "d", "uid": "w"},
+               "spec": {"containers": [{
+                   "name": "c", "resources": {"limits": {"cpu": "1"}}}]}}
+        assert mutate_pod(pod, Config(), trace_id="h" * 32) == []
+
+
+class TestSchedulerSpans:
+    def test_filter_bind_share_the_pod_trace_id(self, fresh):
+        from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        from tests.test_scheduler_core import register_node, tpu_pod
+
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        s = Scheduler(kube, Config())
+        register_node(s, "node-a")
+        pod = tpu_pod()
+        tid = "e" * 32
+        pod["metadata"]["annotations"][trace.TRACE_ID_ANNOTATION] = tid
+        kube.create_pod(pod)
+        r = s.filter(pod, ["node-a"])
+        assert r.node == "node-a"
+        assert s.bind("default", "p1", "u1", "node-a") is None
+        names = {sp.name for sp in fresh.spans(tid)}
+        assert {"filter", "decision-write", "bind"} <= names
+        kinds = [e["event"] for e in fresh.events("u1")]
+        assert "filter-assigned" in kinds and "bound" in kinds
+
+    def test_rejection_reason_reaches_counter_and_failed_nodes(self, fresh):
+        from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        from tests.test_scheduler_core import register_node, tpu_pod
+
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        s = Scheduler(kube, Config())
+        register_node(s, "node-a")
+        pod = tpu_pod(mem="99999")
+        kube.create_pod(pod)
+        r = s.filter(pod, ["node-a"])
+        assert r.node is None
+        assert r.failed["node-a"].split(":")[0] == "insufficient-hbm"
+        assert fresh.rejection_snapshot().get("insufficient-hbm", 0) >= 1
+
+
+class TestShimPublish:
+    def test_publish_trace_id_writes_next_to_region(self, tmp_path,
+                                                    monkeypatch):
+        from k8s_vgpu_scheduler_tpu.shim.core import publish_trace_id
+
+        cache = tmp_path / "vtpu.cache"
+        monkeypatch.setenv("TPU_DEVICE_MEMORY_SHARED_CACHE", str(cache))
+        monkeypatch.setenv("VTPU_TRACE_ID", "a1" * 16)
+        path = publish_trace_id()
+        assert path == str(tmp_path / "trace")
+        assert (tmp_path / "trace").read_text().strip() == "a1" * 16
+
+    def test_publish_trace_id_noop_without_env(self, monkeypatch):
+        from k8s_vgpu_scheduler_tpu.shim.core import publish_trace_id
+
+        monkeypatch.delenv("VTPU_TRACE_ID", raising=False)
+        monkeypatch.delenv("TPU_DEVICE_MEMORY_SHARED_CACHE", raising=False)
+        assert publish_trace_id() is None
+
+
+class TestConfigure:
+    def test_configure_renames_and_resizes(self, fresh):
+        t = trace.configure(service="renamed", capacity=2)
+        for i in range(5):
+            with t.span("x", trace_id=str(i)):
+                pass
+        assert t.service == "renamed"
+        assert len(t.spans()) == 2

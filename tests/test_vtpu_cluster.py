@@ -134,7 +134,9 @@ def test_grafana_dashboard_uses_real_metric_names():
     referenced = set()
     for e in exprs:
         referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
-    referenced -= {"rate", "label_values", "node"}  # promql, not metrics
+    # promql functions + aggregation labels, not metrics
+    referenced -= {"rate", "label_values", "node", "histogram_quantile",
+                   "phase", "reason"}
 
     missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
@@ -152,15 +154,20 @@ def _sources() -> str:
 def _emitted_metrics() -> set:
     """Names exactly as Prometheus renders them: counters ONLY as
     name_total (the bare counter name never appears in exposition, so
-    accepting it would let a never-firing alert/panel pass), gauges as
-    declared.  The serving pod's names are taken from a REAL rendering
-    (its latency gauges are built dynamically, so source regex would
-    miss them)."""
+    accepting it would let a never-firing alert/panel pass), histograms
+    as their name_bucket/name_sum/name_count series, gauges as declared.
+    The serving pod's names are taken from a REAL rendering (its latency
+    gauges are built dynamically, so source regex would miss them)."""
     src = _sources()
     counters = set(re.findall(r'CounterMetricFamily\(\s*"([a-z0-9_]+)"',
                               src))
     gauges = set(re.findall(r'GaugeMetricFamily\(\s*"([a-z0-9_]+)"', src))
-    return gauges | {f"{c}_total" for c in counters} | _serve_metrics()
+    hists = set(re.findall(r'HistogramMetricFamily\(\s*"([a-z0-9_]+)"', src))
+    return (gauges
+            | {f"{c}_total" for c in counters}
+            | {f"{h}_{suffix}" for h in hists
+               for suffix in ("bucket", "sum", "count")}
+            | _serve_metrics())
 
 
 def _serve_metrics() -> set:
@@ -175,6 +182,60 @@ def _serve_metrics() -> set:
                     "per_token_s": {"p50": 0.01, "p95": 0.02}},
     }
     return set(parse_prom(prometheus_text(stats)))
+
+
+#: Emitted metrics deliberately NOT on the dashboard or in the alert
+#: rules.  Adding a metric to a collector without either dashboarding it
+#: or listing it here (with a reason) fails the tier-1 run — silent
+#: telemetry drift is how dashboards rot.
+DASHBOARD_EXEMPT = {
+    # raw physical capacity; the dashboard shows the granted/advertised
+    # pair from the scheduler side instead
+    "host_tpu_memory_total_mib",
+    # per-container compute cap: static config, alert-only interest
+    "vtpu_device_core_limit_percent",
+    # serving internals: the dashboard shows throughput/latency heads,
+    # not every intermediate counter
+    "vtpu_serve_decode_dispatches_total",
+    "vtpu_serve_decode_steps_total",
+    "vtpu_serve_per_token_seconds_p50",
+    "vtpu_serve_pool_hbm_bytes",
+    "vtpu_serve_prefills_total",
+}
+
+
+def test_every_emitted_metric_is_dashboarded_or_allowlisted():
+    """Reverse direction of the pinning pair: every metric a collector
+    emits must be referenced by the Grafana dashboard JSON or the alert
+    rules — or sit in DASHBOARD_EXEMPT with a stated reason.  Histogram
+    families count as referenced when any of their series (_bucket /
+    _sum / _count) or the base name appears."""
+    with open(os.path.join(REPO, "charts", "vtpu", "dashboards",
+                           "vtpu-overview.json")) as f:
+        text = f.read()
+    with open(os.path.join(REPO, "charts", "vtpu", "dashboards",
+                           "vtpu-alerts.yaml")) as f:
+        text += f.read()
+    undashboarded = set()
+    emitted = _emitted_metrics()
+    for metric in emitted:
+        base = re.sub(r"_(bucket|sum|count)$", "", metric)
+        # Word-boundary match (underscore is a word char, so a name that
+        # is merely a prefix of a longer dashboarded name does NOT pass);
+        # a histogram family counts as referenced via any of its series.
+        candidates = {metric, base} | {
+            f"{base}_{s}" for s in ("bucket", "sum", "count")}
+        if any(re.search(rf"\b{re.escape(c)}\b", text)
+               for c in candidates):
+            continue
+        if metric in DASHBOARD_EXEMPT or base in DASHBOARD_EXEMPT:
+            continue
+        undashboarded.add(metric)
+    assert not undashboarded, (
+        "collector emits metrics the dashboard/alerts never reference "
+        f"(dashboard them or add to DASHBOARD_EXEMPT): {undashboarded}")
+    stale = {m for m in DASHBOARD_EXEMPT if m not in emitted}
+    assert not stale, f"DASHBOARD_EXEMPT entries no collector emits: {stale}"
 
 
 def test_alert_rules_use_real_metric_names():
