@@ -2,7 +2,7 @@
 # hack/build.sh + a Makefile; here each surface is one target).
 
 .PHONY: all native test test-fast test-slow dryrun scenarios controlplane \
-        bench wheel clean
+        bench-controlplane bench wheel clean
 
 all: native
 
@@ -28,8 +28,15 @@ dryrun:                       ## multi-chip sharding proof (all families)
 scenarios: native             ## capability proofs, degraded CPU mode
 	SCENARIO_FORCE_CPU=1 python benchmarks/scenarios.py all --strict
 
-controlplane:                 ## scheduling-path perf artifact
+# Tracing is on by construction (the process-global tracer always
+# records spans), so the numbers include span overhead — the production
+# configuration.  Emits CONTROLPLANE_<round>.json (BENCH-style, round
+# from tests/artifact_manifest.json), including the concurrent-filter
+# serial-vs-optimistic A/B (docs/scheduler-concurrency.md).
+bench-controlplane:           ## scheduling-path perf artifact (tracing on)
 	python benchmarks/controlplane.py
+
+controlplane: bench-controlplane  ## alias (historical name)
 
 bench: native                 ## reference benchmark matrix (real chip)
 	python bench.py

@@ -52,6 +52,26 @@ def parse_args(argv=None):
                         "checkpointed eviction of lower-priority pods "
                         "(vtpu.dev/preempt-requested annotation; see "
                         "docs/preemption.md)")
+    p.add_argument("--filter-workers", type=int, default=0,
+                   help="candidate-evaluation worker pool size; 0 = auto "
+                        "(min(8, cpu count)), 1 = evaluate in the calling "
+                        "thread (docs/scheduler-concurrency.md)")
+    p.add_argument("--serial-filter", action="store_true",
+                   help="disable the optimistic snapshot/commit Filter and "
+                        "decide serially under one lock (A/B baseline and "
+                        "operational escape hatch)")
+    p.add_argument("--commit-retries", type=int, default=4,
+                   help="optimistic commits that lose their revision race "
+                        "re-evaluate at most this many times before one "
+                        "fully-locked decision")
+    p.add_argument("--gil-switch-interval", type=float, default=0.05,
+                   help="sys.setswitchinterval for this process (seconds); "
+                        "concurrent Filters are short CPU-bound bursts and "
+                        "the CPython default of 5 ms makes 8 submitter "
+                        "threads convoy on GIL handoffs — 50 ms lets each "
+                        "decision run to its next I/O point uninterrupted "
+                        "(docs/scheduler-concurrency.md). 0 = leave the "
+                        "interpreter default")
     # With the watch loop (informer parity) as the primary event path the
     # periodic full resync is a safety net only, so its default is long;
     # in resync-only mode (--no-watch, or a client without watch support)
@@ -107,6 +127,9 @@ def build_config(args) -> Config:
         node_scheduler_policy=args.node_scheduler_policy,
         enable_preemption=args.enable_preemption,
         enable_debug=args.debug,
+        optimistic_commit=not args.serial_filter,
+        filter_workers=args.filter_workers,
+        commit_retries=args.commit_retries,
     )
 
 
@@ -131,6 +154,9 @@ class DryRunKube(FakeKube):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.gil_switch_interval > 0:
+        import sys
+        sys.setswitchinterval(args.gil_switch_interval)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

@@ -13,7 +13,7 @@ Kubernetes objects are represented as plain dicts in their JSON wire shape.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class Conflict(Exception):
@@ -62,6 +62,24 @@ class KubeClient:
     ) -> dict:
         """Merge-patch metadata.annotations; a None value deletes the key."""
         raise NotImplementedError
+
+    def patch_pod_annotations_many(
+        self, patches: List[Tuple[str, str, Dict[str, Optional[str]]]]
+    ) -> List[Optional[Exception]]:
+        """Apply many annotation merge-patches; per-entry outcome (None =
+        applied, else the exception) so one failed pod never poisons the
+        rest of a batch.  The base implementation loops; transports with a
+        cheaper amortized path (a pipelined connection, a server-side
+        batch endpoint) override it — util/decisionwriter.py feeds whole
+        decision-write batches through here."""
+        out: List[Optional[Exception]] = []
+        for namespace, name, annotations in patches:
+            try:
+                self.patch_pod_annotations(namespace, name, annotations)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001 — per-entry isolation
+                out.append(e)
+        return out
 
     def bind_pod(self, namespace: str, name: str, node: str) -> None:
         """POST a v1.Binding (reference scheduler.go:250)."""

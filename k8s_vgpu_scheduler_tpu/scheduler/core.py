@@ -12,9 +12,12 @@ POSTs the Binding; the node agent completes the two-phase commit (SURVEY §3.2).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import nullcontext
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from ..k8s.client import (
     Gone,
@@ -29,6 +32,7 @@ from ..k8s.client import (
 from ..tpulib.types import TopologyDesc
 from ..util import codec, trace
 from ..util.config import Config
+from ..util.decisionwriter import DecisionBatcher
 from ..util.nodelock import NodeLockError, lock_node, release_node
 from ..util.protocol import bind_timestamp
 from ..util.resources import container_requests, pod_priority
@@ -42,6 +46,7 @@ from ..util.types import (
     BIND_SUCCESS,
     BIND_TIME_ANNOTATION,
     TO_ALLOCATE_ANNOTATION,
+    ContainerDevice,
 )
 from . import score as score_mod
 from .gang import (
@@ -97,6 +102,22 @@ def decode_register_request(req) -> NodeInfo:
     return NodeInfo(name=req.node, devices=devices, topology=topo)
 
 
+class SnapEntry(NamedTuple):
+    """One node's slice of an immutable usage snapshot.
+
+    ``usage`` is the SHARED cached map — read-only by contract; every
+    consumer that simulates a placement layers a
+    :class:`~.score.CowUsage` view over it.  ``key`` is the (pod rev,
+    inventory rev) generation the map was built at: optimistic commit
+    re-reads the winning node's live revs and commits only on equality,
+    so a decision computed against a superseded snapshot can never book
+    chips (docs/scheduler-concurrency.md)."""
+
+    key: Tuple[int, int]
+    info: NodeInfo
+    usage: Dict[str, score_mod.DeviceUsage]
+
+
 class Scheduler:
     def __init__(self, client: KubeClient, cfg: Optional[Config] = None) -> None:
         self.client = client
@@ -104,12 +125,46 @@ class Scheduler:
         self.nodes = NodeManager()
         self.pods = PodManager()
         self.gangs = GangManager()
-        self._filter_lock = threading.Lock()
+        # Optimistic-commit critical section: held ONLY to re-validate a
+        # winning node's revision generation and record the grant (plus
+        # the still-serialized gang admissions and the serial-baseline
+        # decide).  Never held across apiserver I/O, candidate
+        # evaluation, preemption planning or gang-expiry sweeps.
+        self._commit_lock = threading.Lock()
         # get_nodes_usage per-node base-usage cache, keyed on (pod rev,
         # inventory rev); its own lock because the watch thread's pod
-        # events race Filter calls.
+        # events race Filter calls.  The cached usage maps are IMMUTABLE
+        # once published (rebuilds replace, never mutate) — that is what
+        # lets snapshot() hand them out lock-free.
         self._usage_cache_lock = threading.Lock()
         self._usage_cache: Dict[str, tuple] = {}
+        # Published full-fleet snapshot dict (name -> SnapEntry), replaced
+        # wholesale whenever drain_dirty reports changed nodes — readers
+        # get it lock-free-after-publish and an unchanged fleet pays zero
+        # copies per decision.
+        self._snap: Dict[str, SnapEntry] = {}
+        # Equivalence cache for candidate evaluation: (node, request
+        # fingerprint) -> (snapshot key, fit outcome).  A hit is valid
+        # only while the node's generation matches, so any grant, delete
+        # or re-registration on the node invalidates it for free.  Makes
+        # the steady-state decision O(changed nodes), not O(candidates).
+        self._fit_cache_lock = threading.Lock()
+        self._fit_cache: Dict[tuple, tuple] = {}
+        # Candidate-evaluation worker pool (created lazily; see
+        # _eval_pool) + busy high-water mark for the saturation gauge.
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_lock = threading.Lock()
+        self._pool_unavailable = False
+        self.worker_pool_size = 0
+        self.workers_busy_peak = 0
+        self._busy = 0
+        self._busy_lock = threading.Lock()
+        # Lifetime count of optimistic commits that lost their revision
+        # race and re-evaluated (vtpu_filter_commit_conflicts_total).
+        self.commit_conflicts = 0
+        # Group-commit batcher for decision-write patches: concurrent
+        # Filters amortize apiserver I/O without any scheduler lock.
+        self._decisions = DecisionBatcher(client)
         # uid -> monotonic time of its DELETE.  k8s uids never return, so
         # a replayed ADDED for one of these (a resync list older than the
         # delete) must be ignored or it re-books a dead pod's chips.
@@ -234,17 +289,21 @@ class Scheduler:
             prio = pod_priority(pod, self.cfg)
         except Exception:  # noqa: BLE001 — priority never blocks rebuild
             prio = 0
-        self.pods.add_pod(
-            PodInfo(
-                uid=uid,
-                name=pod_name(pod),
-                namespace=pod_namespace(pod),
-                node=node,
-                devices=devices,
-                priority=prio,
-                trace_id=anns.get(trace.TRACE_ID_ANNOTATION, ""),
-            )
+        info = PodInfo(
+            uid=uid,
+            name=pod_name(pod),
+            namespace=pod_namespace(pod),
+            node=node,
+            devices=devices,
+            priority=prio,
+            trace_id=anns.get(trace.TRACE_ID_ANNOTATION, ""),
         )
+        # The MODIFIED event for the scheduler's own decision-write (or a
+        # resync replay) carries exactly the grant already registered:
+        # refresh liveness in place so the no-op does not invalidate the
+        # node's usage snapshot.
+        if not self.pods.refresh_if_unchanged(info):
+            self.pods.add_pod(info)
         if event == "ADDED" and self._deleted_since(uid) is not None:
             # Closes the check-then-add race with the watch thread: a
             # DELETE that landed between the pre-check above and add_pod
@@ -385,57 +444,96 @@ class Scheduler:
         snapshot reads the registry's by-node index directly)."""
         return self.pods.by_node()
 
+    def snapshot(self) -> Dict[str, SnapEntry]:
+        """Immutable, versioned usage snapshot of the WHOLE fleet:
+        registered inventory minus scheduled grants, per node (reference
+        getNodesUsage, scheduler.go:176–222 — which rebuilds from EVERY
+        pod on every Filter, the O(pods × devices) hot loop SURVEY §3.1
+        flags).  Maintained incrementally: the managers report which
+        nodes changed since the last call (drain_dirty) and only those
+        entries are refreshed — an unchanged fleet returns the published
+        dict with zero copying, and the steady-state cost per decision is
+        O(nodes changed), not O(nodes).  The dict and its usage maps are
+        IMMUTABLE once published (refreshes replace the dict, never
+        mutate it); candidate evaluation layers CowUsage views on top,
+        and optimistic commit re-validates each entry's ``key`` (pod
+        rev, inventory rev) against the live revs.  Callers that must
+        restrict to an offered node_names list filter the result — extra
+        entries are cheaper than per-call subset dicts on the hot path."""
+        with self._usage_cache_lock:
+            dirty = self.pods.drain_dirty()
+            dirty |= self.nodes.drain_dirty()
+            if not dirty:
+                return self._snap
+            try:
+                snap = dict(self._snap)
+                for name in dirty:
+                    entry = self._refresh_entry_locked(name)
+                    if entry is None:
+                        snap.pop(name, None)
+                    else:
+                        snap[name] = entry
+                self._snap = snap
+                return snap
+            except BaseException:
+                # The drain was destructive; hand the unprocessed names
+                # back or the published view goes silently stale.
+                self.pods.mark_dirty(dirty)
+                self.nodes.mark_dirty(dirty)
+                raise
+
+    def _refresh_entry_locked(self, name: str) -> Optional[SnapEntry]:
+        """Cache-or-rebuild one node's snapshot entry at its LIVE revs
+        (``_usage_cache_lock`` held); None = node gone.  The single home
+        of the rev-ordering invariant: revs FIRST, then the data they
+        key — a change landing between the reads makes the data newer
+        than its key, which can only force a spurious rebuild later (the
+        change's own dirty mark is still pending); reading data first
+        would let a concurrent re-registration cache stale usage under
+        the new rev and serve it indefinitely."""
+        key = (self.pods.rev_of(name), self.nodes.rev_of(name))
+        info = self.nodes.get_node(name)
+        if info is None:
+            self._usage_cache.pop(name, None)
+            return None
+        cached = self._usage_cache.get(name)
+        if cached is None or cached[0] != key:
+            cached = (key, score_mod.build_usage(
+                info, self.pods.pods_on_node(name)))
+            self._usage_cache[name] = cached
+        return SnapEntry(key, info, cached[1])
+
     def get_nodes_usage(
         self, node_names: Optional[List[str]] = None
     ) -> Dict[str, Tuple[NodeInfo, Dict[str, score_mod.DeviceUsage]]]:
-        """Registered inventory minus scheduled grants, per node
-        (reference getNodesUsage, scheduler.go:176–222 — which rebuilds
-        from EVERY pod on every Filter, the O(pods × devices) hot loop
-        SURVEY §3.1 flags).  Here each node's base usage is cached under
-        a (pod rev, inventory rev) key and rebuilt only when that node
-        actually changed; callers get fresh COPIES because fit_pod
-        mutates its snapshot.  Revs are read before the data they key, so
-        a concurrent change can only force a rebuild, never hide one."""
-        # Revs FIRST, then the data they key (inventory and pods): a
-        # change landing between the reads makes the data newer than its
-        # key, which can only force a spurious rebuild later — reading
-        # data first would let a concurrent re-registration cache stale
-        # usage under the new rev and serve it indefinitely.
-        pod_revs = self.pods.node_revs()
-        node_revs = self.nodes.node_revs()
-        all_nodes = self.nodes.list_nodes()
-        out = {}
+        """Legacy eager-clone view over :meth:`snapshot`: callers get
+        fresh COPIES they may mutate (fit_pod mutates plain-dict
+        snapshots in place).  The decision paths use :meth:`snapshot` +
+        CowUsage instead and clone only what a placement touches."""
         clone = score_mod.clone_usage
-        with self._usage_cache_lock:
-            for gone in set(self._usage_cache) - set(all_nodes):
-                del self._usage_cache[gone]
-            for name, info in all_nodes.items():
-                if node_names is not None and name not in node_names:
-                    continue
-                key = (pod_revs.get(name, 0), node_revs.get(name, 0))
-                cached = self._usage_cache.get(name)
-                if cached is None or cached[0] != key:
-                    cached = (key, score_mod.build_usage(
-                        info, self.pods.pods_on_node(name)))
-                    self._usage_cache[name] = cached
-                out[name] = (info, {cid: clone(u)
-                                    for cid, u in cached[1].items()})
-        return out
+        allow = None if node_names is None else set(node_names)
+        return {
+            name: (e.info, {cid: clone(u) for cid, u in e.usage.items()})
+            for name, e in self.snapshot().items()
+            if allow is None or name in allow
+        }
 
     def inspect_all_nodes_usage(self):
-        """For the metrics collector (a consistent copy, not live maps)."""
-        with self._filter_lock:
-            return {
-                n: dict(usage) for n, (info, usage) in self.get_nodes_usage().items()
-            }
+        """For the metrics collector: a consistent per-node read of the
+        immutable snapshot.  Deliberately NOT under the commit lock — a
+        metrics scrape must never block scheduling — and clone-free (the
+        shallow per-node dict copies share the immutable DeviceUsage
+        entries; collectors only read)."""
+        return {n: dict(e.usage) for n, e in self.snapshot().items()}
 
     def export_fleet(self) -> dict:
         """Read-only fleet snapshot for capacity tooling (``GET /fleetz``
         → ``vtpu-simulate --from-cluster``): node inventory INCLUDING ICI
         topology plus every live grant, one consistent copy under the
-        filter lock — enough to reconstruct this scheduler's exact
-        placement state elsewhere."""
-        with self._filter_lock:
+        commit lock (exports are rare; excluding concurrent commits keeps
+        the node/pod lists mutually coherent) — enough to reconstruct
+        this scheduler's exact placement state elsewhere."""
+        with self._commit_lock:
             nodes = [
                 {
                     "name": name,
@@ -482,11 +580,14 @@ class Scheduler:
 
     # -- Filter ----------------------------------------------------------------
     def filter(self, pod: dict, node_names: List[str]) -> FilterResult:
-        """Decide under the in-memory lock; talk to the apiserver outside it
-        (a slow patch must not stall every concurrent Filter and /metrics
-        scrape).  The tentative grant is rolled back if the patch fails.
+        """Decide on an immutable snapshot, commit optimistically; talk
+        to the apiserver outside any lock (a slow patch must not stall
+        every concurrent Filter and /metrics scrape).  The tentative
+        grant is rolled back if the patch fails.
 
         Traced: the in-memory decision is the ``filter`` span, the
+        revision-validated registration is the ``commit`` span, a lost
+        commit re-evaluates under a ``conflict-retry`` span, and the
         annotation patch is the separate ``decision-write`` span (it is
         apiserver I/O — the usual place a 40 ms budget goes)."""
         tid = trace.trace_id_of(pod)
@@ -496,8 +597,7 @@ class Scheduler:
             self._release_expired_gangs()
         with tr.span("filter", trace_id=tid, pod=pod_name(pod),
                      candidates=len(node_names)) as sp:
-            with self._filter_lock:
-                result = self._decide_locked(pod, node_names)
+            result = self._decide(pod, node_names, sp)
             if result.failed:
                 # Count every per-node rejection by its dominant token
                 # (the summary's leading word keeps cardinality bounded).
@@ -540,8 +640,12 @@ class Scheduler:
         with tr.span("decision-write", trace_id=tid, pod=pod_name(pod),
                      node=result.node) as wsp:
             try:
-                self.client.patch_pod_annotations(
+                batched = self._decisions.write(
                     pod_namespace(pod), pod_name(pod), patch)
+                if batched > 1:
+                    # Rode a group commit with batched-1 concurrent
+                    # Filters' decisions (amortized apiserver I/O).
+                    wsp.set("batch_size", batched)
             except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
                 log.error("failed to write decision for %s: %s",
                           pod_name(pod), e)
@@ -609,7 +713,11 @@ class Scheduler:
                 log.info("preemption rescission for %s/%s not written "
                          "(%s)", namespace, name, e)
 
-    def _decide_locked(self, pod: dict, node_names: List[str]) -> FilterResult:
+    def _decide(self, pod: dict, node_names: List[str],
+                sp: "trace.Span") -> FilterResult:
+        """Parse and dispatch: gang admissions and the serial baseline
+        stay under the commit lock; the default path is the optimistic
+        snapshot/commit protocol (docs/scheduler-concurrency.md)."""
         try:
             requests = container_requests(pod, self.cfg)
         except ValueError as e:
@@ -620,25 +728,406 @@ class Scheduler:
 
         gang = gang_of(pod)
         if gang is not None:
-            return self._decide_gang_locked(pod, requests, node_names, gang)
+            # Gang admission mutates multi-node state atomically — it
+            # keeps the lock (its commit bumps every placed node's rev,
+            # so concurrent optimistic singles conflict and retry).
+            with self._commit_lock:
+                return self._decide_gang_locked(pod, requests, node_names,
+                                                gang)
+        if not self.cfg.optimistic_commit:
+            with self._commit_lock:
+                return self._decide_serial_locked(pod, requests, node_names)
+        return self._decide_optimistic(pod, requests, node_names, sp)
 
+    def _decide_optimistic(self, pod: dict, requests,
+                           node_names: List[str],
+                           sp: "trace.Span") -> FilterResult:
+        """Lock-free evaluation + short validated commit.
+
+        Each attempt: take an immutable versioned snapshot, evaluate the
+        candidates (worker pool + equivalence cache) without any lock,
+        then — holding the commit lock only for two rev reads and one
+        registry insert — re-validate that the winning node's (pod rev,
+        inventory rev) generation is still the one the decision was
+        computed against.  A lost race re-evaluates against a fresh
+        snapshot (``conflict-retry`` span); after ``commit_retries``
+        losses the final attempt runs fully locked, so convergence is
+        guaranteed and retry storms are bounded."""
+        uid = pod_uid(pod)
+        anns = pod.get("metadata", {}).get("annotations", {})
+        tid = trace.trace_id_of(pod)
+        tr = trace.tracer()
         # Drop any stale decision for this pod before re-placing (reference
         # Filter calls delPod first, scheduler.go:284).
-        self.pods.del_pod(pod_uid(pod))
+        self.pods.del_pod(uid)
+        retries = max(0, self.cfg.commit_retries)
+        attempt = 0
+        while True:
+            retry_span = (tr.span("conflict-retry", trace_id=tid,
+                                  pod=pod_name(pod), attempt=attempt)
+                          if attempt else nullcontext())
+            with retry_span:
+                snap = self.snapshot()
+                best, failed = self._evaluate_candidates(
+                    uid, requests, anns, node_names, snap)
+            if best is None:
+                plan = self._plan_preemption(pod, requests, anns,
+                                             node_names, snap)
+                return FilterResult(error="no node fits TPU request",
+                                    failed=failed, preempt=plan)
+            _, node, placement = best
+            with tr.span("commit", trace_id=tid, pod=pod_name(pod),
+                         node=node, attempt=attempt):
+                with self._commit_lock:
+                    entry = snap[node]
+                    live = (self.pods.rev_of(node), self.nodes.rev_of(node))
+                    conflicted = live != entry.key
+                    if conflicted:
+                        # Lost the generation race — but losing it to a
+                        # small delta rarely changes whether WE fit.
+                        # Re-fit on just this node's live usage instead
+                        # of re-evaluating every candidate: the common
+                        # conflict (another pod landed here) costs one
+                        # single-node fit under the lock, not a fresh
+                        # snapshot + full candidate sweep.
+                        entry, placement = self._commit_refit(
+                            node, requests, anns, sp)
+                    committed = False
+                    while entry is not None:
+                        pod_rev = self.pods.add_pod(PodInfo(
+                            uid=uid, name=pod_name(pod),
+                            namespace=pod_namespace(pod), node=node,
+                            devices=placement,
+                            priority=pod_priority(pod, self.cfg),
+                            trace_id=tid,
+                        ))
+                        if pod_rev == entry.key[0] + 1:
+                            self._publish_grant(node, entry, placement,
+                                                pod_rev)
+                            committed = True
+                            break
+                        # A watch-thread pod event (the commit lock does
+                        # not exclude the informer) slipped between the
+                        # rev read and our insert: the placement was
+                        # computed blind to its grant and may overlap
+                        # it.  Undo and refit on the live view, which
+                        # now includes the interleaver.  Terminates:
+                        # each pass needs ANOTHER interleave inside the
+                        # held lock, and refit failure exits to the
+                        # outer retry loop.
+                        self.pods.del_pod(uid)
+                        conflicted = True
+                        entry, placement = self._commit_refit(
+                            node, requests, anns, sp)
+            if conflicted:
+                with self._busy_lock:
+                    self.commit_conflicts += 1
+                tr.event(uid, "commit-conflict", trace_id=tid, node=node,
+                         attempt=attempt, refit=committed)
+            if committed:
+                if attempt:
+                    sp.set("commit_retries", attempt)
+                return FilterResult(node=node, failed=failed)
+            attempt += 1
+            if attempt > retries:
+                # Bounded optimism: the last resort decides fully locked,
+                # so a conflict storm degrades to the serial baseline
+                # instead of livelocking.
+                sp.set("commit_fallback", True)
+                with self._commit_lock:
+                    return self._decide_serial_locked(
+                        pod, requests, node_names)
 
-        anns = pod.get("metadata", {}).get("annotations", {})
-        usage_by_node = self.get_nodes_usage(node_names)
+    def _commit_refit(self, node: str, requests, anns: Dict[str, str],
+                      sp: "trace.Span"):
+        """Refit wrapper for the commit section: returns
+        ``(entry, placement)`` or ``(None, None)`` and stamps the span."""
+        got = self._refit_live_locked(node, requests, anns)
+        if got is None:
+            return None, None
+        sp.set("commit_refit", True)
+        return got
+
+    def _refit_live_locked(self, node: str, requests,
+                           anns: Dict[str, str]):
+        """Commit-lock holder lost the revision race on ``node``: re-fit
+        the pod against the node's LIVE usage (cache-or-rebuild at the
+        current revs) rather than abandoning the whole decision.  Returns
+        ``(entry, placement)`` or None (node gone / no longer fits — the
+        caller falls back to a full re-evaluation).  The node was the
+        best candidate a moment ago; accepting a refit placement on it
+        trades a vanishing score delta for skipping an entire candidate
+        sweep.  Bounded work under the lock: one node's chips."""
+        with self._usage_cache_lock:
+            entry = self._refresh_entry_locked(node)
+        if entry is None:
+            return None
+        cow = score_mod.CowUsage(entry.usage)
+        placement = score_mod.fit_pod(requests, cow, entry.info.topology,
+                                      anns, self.cfg.topology_policy)
+        if placement is None:
+            return None
+        return entry, placement
+
+    def _publish_grant(self, node: str, entry: SnapEntry, placement,
+                       pod_rev: int) -> None:
+        """After a validated add_pod (commit lock held): publish the
+        grant's effect on ``entry.usage`` into the usage cache at its new
+        generation, so the next snapshot() reuses it instead of
+        rebuilding the node from every resident pod — the grant IS the
+        only delta.  Publishing requires proving NOTHING else interleaved
+        between the validated revs and the grant: the pod-rev chain must
+        be unbroken (add_pod returned exactly validated+1 — a watch
+        thread's add/del in the window would occupy that rev, and our
+        higher rev would otherwise hide its pending-dirty rebuild), and
+        the key's inventory half stays the VALIDATED one so a concurrent
+        re-registration's newer rev still forces a rebuild."""
+        if pod_rev != entry.key[0] + 1:
+            # A watch-thread pod event on this node slipped between rev
+            # validation and add_pod; its delta is not in entry.usage —
+            # leave its dirty mark to trigger the full rebuild.
+            return
+        touched: Dict[str, score_mod.DeviceUsage] = {}
+        for container in placement:
+            for d in container:
+                u = touched.get(d.uuid)
+                if u is None:
+                    base = entry.usage.get(d.uuid)
+                    if base is None:
+                        # Unknown chip (inventory shrank mid-flight):
+                        # let the dirty rebuild recompute from scratch.
+                        return
+                    u = score_mod.clone_usage(base)
+                    touched[d.uuid] = u
+                u.used_slots += 1
+                u.used_mem += d.usedmem
+                u.used_cores += d.usedcores
+        new_usage = dict(entry.usage)
+        new_usage.update(touched)
+        with self._usage_cache_lock:
+            cached = self._usage_cache.get(node)
+            # Publish only if the cache still holds the exact map this
+            # grant was computed against; if a concurrent snapshot()
+            # rebuilt it meanwhile, that rebuild either already includes
+            # this grant or the node's dirty mark is still pending —
+            # overwriting would resurrect a superseded view.
+            if cached is not None and cached[1] is entry.usage:
+                self._usage_cache[node] = ((pod_rev, entry.key[1]),
+                                           new_usage)
+
+    def _evaluate_candidates(self, uid: str, requests, anns: Dict[str, str],
+                             node_names: List[str],
+                             snap: Dict[str, SnapEntry]):
+        """Score every candidate against the shared snapshot.  Returns
+        ``(best, failed)`` with ``best = (score, node, placement)`` or
+        None.  Three cost tiers per candidate: type-prefilter (no copy,
+        no scan), equivalence-cache hit (generation-keyed), full
+        CowUsage fit — and only the last tier fans out to the pool."""
+        affinity = score_mod.parse_affinity(anns)
+        policy = anns.get(score_mod.TOPOLOGY_POLICY_ANNOTATION,
+                          self.cfg.topology_policy)
         failed: Dict[str, str] = {}
-        best: Optional[Tuple[float, str, List]] = None
+        candidates: List[str] = []
         for name in node_names:
-            entry = usage_by_node.get(name)
+            entry = snap.get(name)
             if entry is None:
                 failed[name] = "no TPU inventory registered"
                 continue
-            info, usage = entry
+            # Prune before clone: a white/blacklist that excludes every
+            # chip type on the node is decided on the shared snapshot —
+            # no per-candidate copy, no fit scan.
+            why = score_mod.type_excluded(affinity, entry.usage)
+            if why is not None:
+                failed[name] = why
+                continue
+            candidates.append(name)
+
+        fp = (tuple((r.nums, r.type, r.memreq, r.mem_percentage_req,
+                     r.coresreq) for r in requests),
+              None if affinity[0] is None else tuple(affinity[0]),
+              tuple(affinity[1]), policy)
+
+        outcomes: Dict[str, tuple] = {}
+        misses: List[str] = []
+        with self._fit_cache_lock:
+            for name in candidates:
+                hit = self._fit_cache.get((name, fp))
+                if hit is not None and hit[0] == snap[name].key:
+                    outcomes[name] = hit[1]
+                else:
+                    misses.append(name)
+
+        def eval_one(name: str) -> tuple:
+            entry = snap[name]
+            cow = score_mod.CowUsage(entry.usage)
             why: Dict[str, str] = {}
             placement = score_mod.fit_pod(
-                requests, usage, info.topology, anns,
+                requests, cow, entry.info.topology, anns,
+                self.cfg.topology_policy, reasons=why)
+            if placement is None:
+                return ("reject", why.get(
+                    "reason", "insufficient TPU capacity/topology"))
+            s = score_mod.node_score(cow, self.cfg.node_scheduler_policy)
+            return ("fit", s, placement)
+
+        pool = self._eval_pool() if len(misses) >= 4 else None
+        if pool is None:
+            computed = [eval_one(n) for n in misses]
+        else:
+            computed = list(pool.map(self._count_busy(eval_one), misses))
+        with self._fit_cache_lock:
+            if len(self._fit_cache) > 8192:
+                # Wholesale drop at the cap (same policy as the traced-
+                # alloc set): worst case a cold decision, never unbounded
+                # growth.
+                self._fit_cache.clear()
+            for name, outcome in zip(misses, computed):
+                self._fit_cache[(name, fp)] = (snap[name].key, outcome)
+                outcomes[name] = outcome
+
+        fits: List[Tuple[float, str, List]] = []
+        for name in candidates:
+            outcome = outcomes[name]
+            if outcome[0] == "reject":
+                failed[name] = outcome[1]
+                continue
+            _, s, placement = outcome
+            fits.append((s, name, placement))
+        if not fits:
+            return None, failed
+        # Near-best scatter: a strict argmax sends every concurrent
+        # Filter to the SAME node (scores over a healthy fleet differ by
+        # fractions of a percent), where all but one lose the commit race
+        # and retry — optimistic concurrency degenerating to a serialized
+        # hot spot.  Instead, candidates within 1% of the best score are
+        # placement-equivalent, and each pod picks deterministically
+        # among them by a per-(pod, node) hash — concurrent Filters fan
+        # out across near-best nodes, conflicts stay rare, and a node
+        # that is better by MORE than the tolerance still always wins.
+        s_max = max(f[0] for f in fits)
+        eps = 0.01 * max(1.0, abs(s_max))
+        best = min((f for f in fits if f[0] >= s_max - eps),
+                   key=lambda f: hash((uid, f[1])))
+        # Fresh grant objects for the winner: fit outcomes live in the
+        # equivalence cache and are shared across hits — a committed
+        # PodInfo must never alias the cache's (or another pod's) device
+        # lists.
+        return (best[0], best[1], self._copy_placement(best[2])), failed
+
+    @staticmethod
+    def _copy_placement(placement: List) -> List:
+        return [[ContainerDevice(uuid=d.uuid, type=d.type,
+                                 usedmem=d.usedmem, usedcores=d.usedcores)
+                 for d in container] for container in placement]
+
+    def _count_busy(self, fn):
+        """Wrap a pool task with busy-worker accounting (the saturation
+        gauge wants the high-water mark, not an instantaneous sample a
+        scrape would almost always read as zero)."""
+        def wrapped(*a):
+            with self._busy_lock:
+                self._busy += 1
+                if self._busy > self.workers_busy_peak:
+                    self.workers_busy_peak = self._busy
+            try:
+                return fn(*a)
+            finally:
+                with self._busy_lock:
+                    self._busy -= 1
+        return wrapped
+
+    def close(self) -> None:
+        """Release the candidate-evaluation worker pool (idempotent).
+        The long-lived daemon never needs this — the pool dies with the
+        process — but embedders, benchmarks and test harnesses that
+        build and discard Scheduler instances must call it or each
+        instance leaks its pool threads until exit."""
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+            self._pool_unavailable = False
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    def _eval_pool(self) -> Optional[ThreadPoolExecutor]:
+        """Lazily-created candidate-evaluation pool; None = evaluate in
+        the calling thread (filter_workers=1, or auto on a 1-core box
+        where dispatch overhead buys nothing)."""
+        if self._pool_unavailable:
+            return None
+        pool = self._pool
+        if pool is None:
+            with self._pool_lock:
+                if self._pool is None and not self._pool_unavailable:
+                    n = self.cfg.filter_workers
+                    if n <= 0:
+                        n = min(8, os.cpu_count() or 1)
+                    if n <= 1:
+                        self._pool_unavailable = True
+                        return None
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=n, thread_name_prefix="filter-eval")
+                    self.worker_pool_size = n
+                pool = self._pool
+        return pool
+
+    def _plan_preemption(self, pod: dict, requests, anns: Dict[str, str],
+                         node_names: List[str],
+                         snap: Dict[str, SnapEntry]):
+        """Preemption planning on the immutable snapshot — always off
+        the commit lock (the planner is pure and can scan every node's
+        pods; a slow scan must not stall concurrent Filters).  Restricted
+        to the offered candidates: the snapshot covers the whole fleet,
+        but victims on a node the pod was never offered free nothing it
+        can use."""
+        if not self.cfg.enable_preemption:
+            return None
+        pods_by_node = self._pods_by_node()
+        # Gang members are never victims: evicting one would hang
+        # the surviving collective while freeing a fraction of the
+        # gang's footprint.
+        gang_uids = {
+            u for g in self.gangs.groups().values()
+            for u in (*g.members, *g.placements)
+        }
+        offered = set(node_names)
+        entries = {name: (e.info, e.usage)
+                   for name, e in snap.items() if name in offered}
+        return plan_preemption(
+            requests, pod_priority(pod, self.cfg), entries,
+            pods_by_node, anns, self.cfg.topology_policy,
+            protected_uids=gang_uids,
+            node_policy=self.cfg.node_scheduler_policy)
+
+    def _decide_serial_locked(self, pod: dict, requests,
+                              node_names: List[str]) -> FilterResult:
+        """Serial baseline (and the guaranteed-progress fallback after
+        exhausted conflict retries): the whole decision under the commit
+        lock with eager per-candidate clones — the pre-optimistic
+        behavior, kept bit-for-bit for A/B benchmarking
+        (``--serial-filter`` / Config.optimistic_commit=False)."""
+        self.pods.del_pod(pod_uid(pod))
+
+        anns = pod.get("metadata", {}).get("annotations", {})
+        affinity = score_mod.parse_affinity(anns)
+        snap = self.snapshot()
+        clone = score_mod.clone_usage
+        failed: Dict[str, str] = {}
+        best: Optional[Tuple[float, str, List]] = None
+        for name in node_names:
+            entry = snap.get(name)
+            if entry is None:
+                failed[name] = "no TPU inventory registered"
+                continue
+            # Prune before clone (the type white/blacklist reads no
+            # usage — rejecting here skips the whole-chip-map copy).
+            why_t = score_mod.type_excluded(affinity, entry.usage)
+            if why_t is not None:
+                failed[name] = why_t
+                continue
+            usage = {cid: clone(u) for cid, u in entry.usage.items()}
+            why: Dict[str, str] = {}
+            placement = score_mod.fit_pod(
+                requests, usage, entry.info.topology, anns,
                 self.cfg.topology_policy, reasons=why
             )
             if placement is None:
@@ -650,21 +1139,8 @@ class Scheduler:
                 best = (s, name, placement)
 
         if best is None:
-            plan = None
-            if self.cfg.enable_preemption:
-                pods_by_node = self._pods_by_node()
-                # Gang members are never victims: evicting one would hang
-                # the surviving collective while freeing a fraction of the
-                # gang's footprint.
-                gang_uids = {
-                    u for g in self.gangs.groups().values()
-                    for u in (*g.members, *g.placements)
-                }
-                plan = plan_preemption(
-                    requests, pod_priority(pod, self.cfg), usage_by_node,
-                    pods_by_node, anns, self.cfg.topology_policy,
-                    protected_uids=gang_uids,
-                    node_policy=self.cfg.node_scheduler_policy)
+            plan = self._plan_preemption(pod, requests, anns,
+                                         node_names, snap)
             return FilterResult(error="no node fits TPU request",
                                 failed=failed, preempt=plan)
 
@@ -729,7 +1205,15 @@ class Scheduler:
                 error=f"gang {group} waiting ({len(g.members)}/{g.total})"
             )
 
-        usage = self.get_nodes_usage(node_names or None)
+        # Immutable snapshot entries; place_gang layers CowUsage views
+        # for its trial/probe simulation, so no per-candidate eager
+        # clones here either.  The snapshot is fleet-wide — restrict to
+        # the offered candidates (an empty offer means all, matching the
+        # pre-snapshot behavior).
+        offered = set(node_names) if node_names else None
+        usage = {n: (e.info, e.usage)
+                 for n, e in self.snapshot().items()
+                 if offered is None or n in offered}
         # For an admitted gang a quorum here means replacement members
         # filled freed slots: place ONLY them — the placed peers' grants
         # are already charged in the snapshot, and re-placing bound

@@ -298,12 +298,18 @@ def place_gang(
     Returns uid -> (node, devices) covering ALL members (or just
     ``only_uids`` — replacement members joining an admitted gang whose
     placed peers are already charged in the snapshot), or None.  The
-    snapshot's usage maps are mutated as members are placed, so later
-    members see earlier members' grants — the all-or-nothing simulation.
+    passed usage maps are never mutated: each homogeneous-set attempt
+    stacks a copy-on-write ``trial`` layer, each member×node probe a
+    further layer, and committing a member swaps its winning probe into
+    the trial — so later members see earlier members' grants (the
+    all-or-nothing simulation) while the only chips ever cloned are the
+    ones tentative placements actually touch (callers may therefore pass
+    the scheduler's shared immutable snapshot directly).
 
     Node preference: homogeneous generation sets first (a DCN slice is
     built from identical hosts), then the regular free-capacity score.
     """
+    from .score import CowUsage
     # Bucket candidate nodes by topology generation; try the largest
     # homogeneous bucket first, fall back to "any node".
     by_gen: Dict[str, List[str]] = {}
@@ -329,10 +335,11 @@ def place_gang(
             candidate_sets.append(list(usage_by_node.keys()))
 
     for candidates in candidate_sets:
-        # Work on a deep-ish copy of the snapshot per attempt: a failed
-        # homogeneous attempt must not leave partial grants behind.
+        # COW trial layer per attempt: a failed homogeneous attempt
+        # simply discards its overlays — no partial grants left behind,
+        # no upfront copy of every node's chip map.
         trial = {
-            name: (info, {k: dataclasses.replace(u) for k, u in usage.items()})
+            name: (info, CowUsage(usage))
             for name, (info, usage) in usage_by_node.items()
         }
         placements: Dict[str, Tuple[str, list]] = {}
@@ -340,10 +347,10 @@ def place_gang(
         for uid in sorted(only_uids if only_uids is not None
                           else gang.members):
             m = gang.members[uid]
-            best: Optional[Tuple[float, str, list, dict]] = None
+            best: Optional[Tuple[float, str, list, object]] = None
             for name in candidates:
                 info, usage = trial[name]
-                probe = {k: dataclasses.replace(u) for k, u in usage.items()}
+                probe = CowUsage(usage)
                 got = fit_pod(m.requests, probe, info.topology,
                               m.annotations, default_policy)
                 if got is None:

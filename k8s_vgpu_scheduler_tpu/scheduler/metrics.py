@@ -86,8 +86,32 @@ class ClusterCollector(Collector):
         )
         preempts.add_metric([], self.scheduler.preemptions_requested)
 
+        conflicts = CounterMetricFamily(
+            "vtpu_filter_commit_conflicts",
+            "Optimistic Filter commits that lost their revision "
+            "generation race and re-evaluated (a high rate means many "
+            "concurrent Filters chase the same node — check node-policy "
+            "spread and fleet headroom)",
+        )
+        conflicts.add_metric([], self.scheduler.commit_conflicts)
+
+        pool_size = GaugeMetricFamily(
+            "vtpu_filter_worker_pool_size",
+            "Candidate-evaluation worker pool size (0 until the pool is "
+            "first used, or when evaluation is in-thread)",
+        )
+        pool_size.add_metric([], self.scheduler.worker_pool_size)
+        busy_peak = GaugeMetricFamily(
+            "vtpu_filter_workers_busy_peak",
+            "High-water mark of concurrently busy candidate-evaluation "
+            "workers (peak/size ~ 1 means the pool saturates and "
+            "--filter-workers may be raised)",
+        )
+        busy_peak.add_metric([], self.scheduler.workers_busy_peak)
+
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
-                pod_mem, pod_cores, preempts] + list(phase_metrics())
+                pod_mem, pod_cores, preempts, conflicts, pool_size,
+                busy_peak] + list(phase_metrics())
 
 
 def phase_metrics():

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ..tpulib.types import TopologyDesc
 
@@ -41,6 +41,9 @@ class NodeManager:
         self._lock = threading.Lock()
         self._nodes: Dict[str, NodeInfo] = {}
         self._rev: Dict[str, int] = {}
+        # Nodes whose inventory changed since the last drain_dirty()
+        # (same incremental-snapshot contract as PodManager._dirty).
+        self._dirty: Set[str] = set()
 
     def add_node(self, name: str, info: NodeInfo) -> None:
         """Each registration message carries the node's FULL inventory, so it
@@ -50,6 +53,7 @@ class NodeManager:
         keeps stale chips alive; deliberate deviation.)"""
         with self._lock:
             self._rev[name] = self._rev.get(name, 0) + 1
+            self._dirty.add(name)
             existing = self._nodes.get(name)
             if existing is None or not existing.devices:
                 self._nodes[name] = info
@@ -63,13 +67,25 @@ class NodeManager:
         (reference rmNodeDevice, nodes.go:283–305)."""
         with self._lock:
             self._rev[name] = self._rev.get(name, 0) + 1
+            self._dirty.add(name)
             self._nodes.pop(name, None)
 
-    def node_revs(self) -> Dict[str, int]:
-        """Inventory change counters (same rev-before-data contract as
-        PodManager.node_revs)."""
+    def rev_of(self, name: str) -> int:
+        """One node's inventory rev (same rev-before-data contract as
+        PodManager.rev_of)."""
         with self._lock:
-            return dict(self._rev)
+            return self._rev.get(name, 0)
+
+    def drain_dirty(self) -> Set[str]:
+        """Return-and-clear the inventory-changed node set (see
+        PodManager.drain_dirty for the caller's restore obligation)."""
+        with self._lock:
+            dirty, self._dirty = self._dirty, set()
+            return dirty
+
+    def mark_dirty(self, names: Iterable[str]) -> None:
+        with self._lock:
+            self._dirty.update(names)
 
     def get_node(self, name: str) -> Optional[NodeInfo]:
         with self._lock:

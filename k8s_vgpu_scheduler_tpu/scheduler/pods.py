@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional, Set
 
 from ..util.types import PodDevices
 
@@ -49,11 +49,21 @@ class PodManager:
         self._pods: Dict[str, PodInfo] = {}
         self._by_node: Dict[str, Dict[str, PodInfo]] = {}
         self._rev: Dict[str, int] = {}
+        # Nodes whose pod set changed since the last drain_dirty() — the
+        # scheduler's snapshot maintains its published fleet view
+        # incrementally from this instead of re-scanning every node's rev
+        # per decision (docs/scheduler-concurrency.md).
+        self._dirty: Set[str] = set()
 
     def _bump(self, node: str) -> None:
         self._rev[node] = self._rev.get(node, 0) + 1
+        self._dirty.add(node)
 
-    def add_pod(self, info: PodInfo) -> None:
+    def add_pod(self, info: PodInfo) -> int:
+        """Record (or move) a grant; returns ``info.node``'s new rev —
+        the optimistic committer publishes its incrementally-updated
+        usage under exactly this generation, so a concurrent change
+        landing after it (a newer rev) always forces a rebuild."""
         with self._lock:
             prev = self._pods.get(info.uid)
             if prev is not None and prev.node != info.node:
@@ -64,6 +74,26 @@ class PodManager:
             self._pods[info.uid] = info
             self._by_node.setdefault(info.node, {})[info.uid] = info
             self._bump(info.node)
+            return self._rev[info.node]
+
+    def refresh_if_unchanged(self, info: PodInfo) -> bool:
+        """Informer-reconciliation no-op detection: when the decoded
+        grant matches what is already registered — the common MODIFIED
+        event is the scheduler observing its OWN decision-write — refresh
+        liveness in place WITHOUT bumping the node's rev.  A spurious
+        bump would invalidate the usage snapshot and every fit-cache
+        entry for a state that did not change, putting an O(pods × chips)
+        rebuild back on the per-decision path."""
+        with self._lock:
+            prev = self._pods.get(info.uid)
+            if prev is None or prev.node != info.node \
+                    or prev.devices != info.devices:
+                return False
+            prev.priority = info.priority
+            if info.trace_id:
+                prev.trace_id = info.trace_id
+            prev.touched_at = info.touched_at
+            return True
 
     def del_pod(self, uid: str) -> None:
         with self._lock:
@@ -93,11 +123,28 @@ class PodManager:
         with self._lock:
             return {n: list(b.values()) for n, b in self._by_node.items()}
 
-    def node_revs(self) -> Dict[str, int]:
-        """All per-node change counters in one lock acquisition.  Callers
-        must read revs BEFORE the data they key (pods_on_node): data
-        fetched after the rev is at least as new as the rev, so a cache
-        keyed on it can only be transiently conservative (rebuild), never
-        silently stale."""
+    def rev_of(self, node: str) -> int:
+        """One node's change counter — the snapshot-refresh and
+        optimistic-commit validation read (copying a whole rev map per
+        read would put an O(nodes) cost back on the per-decision path).
+        Callers must read revs BEFORE the data they key (pods_on_node):
+        data fetched after the rev is at least as new as the rev, so a
+        cache keyed on it can only be transiently conservative (rebuild),
+        never silently stale."""
         with self._lock:
-            return dict(self._rev)
+            return self._rev.get(node, 0)
+
+    def drain_dirty(self) -> Set[str]:
+        """Return-and-clear the set of nodes whose pod set changed since
+        the previous drain.  Destructive — the caller owns refreshing
+        those nodes; on failure it must hand them back via mark_dirty or
+        its view goes silently stale."""
+        with self._lock:
+            dirty, self._dirty = self._dirty, set()
+            return dirty
+
+    def mark_dirty(self, nodes: Iterable[str]) -> None:
+        """Re-queue nodes for the next drain (a drainer that failed
+        mid-refresh returns what it could not process)."""
+        with self._lock:
+            self._dirty.update(nodes)

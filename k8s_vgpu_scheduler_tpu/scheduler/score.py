@@ -142,10 +142,103 @@ def clone_usage(u: DeviceUsage) -> DeviceUsage:
                        u.total_cores, u.used_cores)
 
 
+class CowUsage:
+    """Copy-on-write view over an immutable usage mapping.
+
+    ``fit_container`` clones a chip through :meth:`own` only when a
+    tentative placement actually mutates it, so evaluating a candidate
+    node against a shared snapshot costs one clone per GRANTED chip
+    instead of one per chip on every candidate (the eager-clone cost the
+    serial Filter paid).  The base mapping is never written; reads merge
+    the private overlay over it, so a multi-container pod's later
+    containers see the earlier containers' tentative grants.  Layers
+    compose: the base may itself be a CowUsage (gang placement stacks a
+    trial layer per admission attempt and a probe layer per member).
+    """
+
+    __slots__ = ("_base", "_own")
+
+    def __init__(self, base) -> None:
+        self._base = base
+        self._own: Dict[str, DeviceUsage] = {}
+
+    def own(self, chip_id: str) -> DeviceUsage:
+        """Private, mutable copy of one chip (cloned once per view)."""
+        u = self._own.get(chip_id)
+        if u is None:
+            u = clone_usage(self._base[chip_id])
+            self._own[chip_id] = u
+        return u
+
+    def __getitem__(self, chip_id: str) -> DeviceUsage:
+        got = self._own.get(chip_id)
+        return got if got is not None else self._base[chip_id]
+
+    def get(self, chip_id: str, default=None):
+        got = self._own.get(chip_id)
+        if got is not None:
+            return got
+        return self._base.get(chip_id, default)
+
+    def __contains__(self, chip_id: str) -> bool:
+        return chip_id in self._base
+
+    def __len__(self) -> int:
+        return len(self._base)
+
+    def __iter__(self):
+        return iter(self._base)
+
+    def keys(self):
+        return self._base.keys()
+
+    def values(self):
+        if not self._own:
+            return self._base.values()
+        own = self._own
+        return [own.get(k) or u for k, u in self._base.items()]
+
+    def items(self):
+        if not self._own:
+            return self._base.items()
+        own = self._own
+        return [(k, own.get(k) or u) for k, u in self._base.items()]
+
+    def materialize(self) -> Dict[str, DeviceUsage]:
+        """Flatten to a plain dict of private copies (callers that hand
+        the result across a commit boundary must not alias the base)."""
+        own = self._own
+        return {k: own[k] if k in own else clone_usage(u)
+                for k, u in self._base.items()}
+
+
 def check_type(annotations: Dict[str, str], dev_type: str) -> bool:
     """Type affinity white/blacklist (reference checkGPUtype, score.go:67–87):
     comma-separated case-insensitive substring match."""
     return _type_ok(_affinity(annotations), dev_type)
+
+
+def parse_affinity(annotations: Dict[str, str]):
+    """Public handle on the parsed white/blacklist (callers that
+    prefilter many nodes parse once and reuse)."""
+    return _affinity(annotations)
+
+
+def type_excluded(affinity, usage) -> Optional[str]:
+    """Reject reason when the pod's type white/blacklist excludes EVERY
+    chip type on the node, else None.  Runs against the shared snapshot
+    BEFORE any per-candidate copy is made (checkGPUtype semantics, but
+    hoisted out of the clone-then-fit path): a candidate rejected here
+    never pays a chip clone or a fit scan.  Same dominant-token format
+    as ``_reject_summary`` so rejection counters stay low-cardinality."""
+    use, nouse = affinity
+    if use is None and not nouse:
+        return None
+    types = {u.type for u in usage.values()}
+    if any(_type_ok(affinity, t) for t in types):
+        return None
+    n = len(usage)
+    return f"type-mismatch: {n}/{n} type-mismatch"
 
 
 def _resolve_mem(req: ContainerDeviceRequest, chip: DeviceUsage) -> int:
@@ -255,8 +348,14 @@ def fit_container(
         )[: req.nums]
 
     grants: ContainerDevices = []
+    # Copy-on-write: against a CowUsage view, clone exactly the chips
+    # this placement mutates; a plain dict (callers that already own
+    # their snapshot) is mutated in place as before.
+    own = getattr(usage, "own", None)
     for chip in chosen:
         mem = _resolve_mem(req, chip)
+        if own is not None:
+            chip = own(chip.id)
         chip.used_slots += 1
         chip.used_mem += mem
         chip.used_cores += req.coresreq

@@ -64,6 +64,23 @@ class Config:
     # operator must opt into (--enable-preemption).
     enable_preemption: bool = False
 
+    # Optimistic-commit Filter (docs/scheduler-concurrency.md): candidate
+    # evaluation runs lock-free on an immutable snapshot; the commit lock
+    # is held only to re-validate the winning node's revision generation
+    # and record the grant.  False selects the serial baseline (the whole
+    # decision under one lock, eager per-candidate chip clones) — kept for
+    # A/B benchmarking and as an operational escape hatch.
+    optimistic_commit: bool = True
+
+    # Candidate-evaluation worker pool: 0 = auto (min(8, cpu count)),
+    # 1 = evaluate in the calling thread, N>1 = pool size.
+    filter_workers: int = 0
+
+    # Optimistic commits that lose their revision race re-evaluate against
+    # a fresh snapshot at most this many times, then fall back to one
+    # fully-locked decision (bounded retries ⇒ guaranteed convergence).
+    commit_retries: int = 4
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

@@ -1,0 +1,531 @@
+"""Optimistic-commit Filter: concurrency stress + protocol units.
+
+The tentpole invariant for a fractional-accelerator scheduler running
+Filters in parallel (docs/scheduler-concurrency.md): through ANY
+interleaving of concurrent filter / bind / pod-delete, no chip's granted
+slots, HBM or cores may ever exceed its advertised totals, and every
+optimistic commit that loses its revision race must converge (bounded
+retries, then one fully-locked decision).  The stress test here races
+real threads over a shared fleet; the unit tests pin the parts the race
+relies on — copy-on-write usage views, generation-keyed equivalence
+caching, the decision-write group commit, and the conflict-retry path
+itself (forced deterministically, since a lost race is rare in-process).
+"""
+
+import threading
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler import score as score_mod
+from k8s_vgpu_scheduler_tpu.util import nodelock
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.decisionwriter import DecisionBatcher
+
+from tests.test_scheduler_core import register_node, tpu_pod
+
+CHIP_MIB = 16384
+CHIPS_PER_NODE = 4
+SLOTS_PER_CHIP = 10
+CORES_PER_CHIP = 100
+
+
+def make_env(n_nodes=8, **cfg_kwargs):
+    kube = FakeKube()
+    s = Scheduler(kube, Config(**cfg_kwargs))
+    names = [f"node-{i}" for i in range(n_nodes)]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        register_node(s, n, chips=CHIPS_PER_NODE, devmem=CHIP_MIB)
+    kube.watch_pods(s.on_pod_event)
+    return kube, s, names
+
+
+def assert_no_overallocation(s: Scheduler):
+    """Sum every tracked grant per chip; compare against the advertised
+    totals — the invariant the commit re-validation exists to hold."""
+    granted = {}  # chip id -> [slots, mem, cores]
+    for info in s.pods.list_pods():
+        for container in info.devices:
+            for dev in container:
+                g = granted.setdefault(dev.uuid, [0, 0, 0])
+                g[0] += 1
+                g[1] += dev.usedmem
+                g[2] += dev.usedcores
+    for chip, (slots, mem, cores) in granted.items():
+        assert slots <= SLOTS_PER_CHIP, f"{chip}: {slots} slots granted"
+        assert mem <= CHIP_MIB, f"{chip}: {mem} MiB granted"
+        assert cores <= CORES_PER_CHIP, f"{chip}: {cores} cores granted"
+
+
+class TestConcurrentFilterStress:
+    def test_racing_filters_binds_and_deletes_never_overbook(self):
+        """8 threads × filter/bind/delete over a shared 8-node fleet;
+        the capacity invariant is checked at every thread's every step
+        AND at the end, so a transiently double-booked chip fails even
+        if a later delete would have masked it."""
+        kube, s, names = make_env()
+        n_threads, ops_per_thread = 8, 30
+        errors = []
+        barrier = threading.Barrier(n_threads)
+
+        def worker(t: int) -> None:
+            barrier.wait()
+            placed = []  # (name, uid, node)
+            try:
+                for i in range(ops_per_thread):
+                    name, uid = f"t{t}p{i}", f"t{t}u{i}"
+                    # Mixed sizes so placements fragment and chips fill.
+                    mem = ("4000", "8000", "2000")[i % 3]
+                    pod = tpu_pod(name, uid=uid, mem=mem)
+                    kube.create_pod(pod)
+                    r = s.filter(pod, names)
+                    if r.node is not None:
+                        placed.append((name, uid, r.node))
+                        if i % 3 == 0:
+                            err = s.bind("default", name, uid, r.node)
+                            if err is None:
+                                nodelock.release_node(kube, r.node)
+                    else:
+                        # Capacity exhaustion is legal; silent failure
+                        # modes are not.
+                        assert r.error or r.failed
+                    if i % 4 == 3 and placed:
+                        victim = placed.pop(0)
+                        kube.delete_pod("default", victim[0])
+                    assert_no_overallocation(s)
+            except Exception as e:  # noqa: BLE001 — surface on main thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+            assert not th.is_alive(), "worker wedged (conflict livelock?)"
+        assert not errors, errors[0]
+        assert_no_overallocation(s)
+
+    def test_conflict_retry_converges_under_node_churn(self):
+        """Filters racing node re-registration (inventory rev churn —
+        every commit validation sees a moving generation) must still
+        converge and never over-book."""
+        kube, s, names = make_env(n_nodes=4)
+        stop = threading.Event()
+
+        def churn() -> None:
+            i = 0
+            while not stop.is_set():
+                register_node(s, names[i % len(names)],
+                              chips=CHIPS_PER_NODE, devmem=CHIP_MIB)
+                i += 1
+
+        churner = threading.Thread(target=churn, daemon=True)
+        churner.start()
+        try:
+            for i in range(40):
+                pod = tpu_pod(f"c{i}", uid=f"cu{i}", mem="1000")
+                kube.create_pod(pod)
+                r = s.filter(pod, names)
+                assert r.node is not None, r.error
+        finally:
+            stop.set()
+            churner.join(timeout=10)
+        assert_no_overallocation(s)
+
+
+class TestOptimisticCommitProtocol:
+    def test_lost_revision_race_retries_and_places(self):
+        """Deterministically lose the first commit: a competing grant
+        lands on the winning node between snapshot and commit.  The
+        filter must count the conflict, re-evaluate, and still place —
+        with both pods' grants intact (no double-booking)."""
+        kube, s, names = make_env(n_nodes=2)
+        real_eval = s._evaluate_candidates
+        fired = {"n": 0}
+
+        from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+        def racing_eval(uid, requests, anns, node_names, snap):
+            best, failed = real_eval(uid, requests, anns, node_names, snap)
+            if best is not None and fired["n"] == 0:
+                fired["n"] += 1
+                node = best[1]
+                # Competing commit on the winner (bumps its pod rev).
+                s.pods.add_pod(PodInfo(
+                    uid="rival", name="rival", namespace="default",
+                    node=node,
+                    devices=[[ContainerDevice(
+                        uuid=f"{node}-chip-0", type="TPU-v5e",
+                        usedmem=1000, usedcores=0)]]))
+            return best, failed
+
+        s._evaluate_candidates = racing_eval
+        pod = tpu_pod("p", uid="u", mem="2000")
+        kube.create_pod(pod)
+        r = s.filter(pod, names)
+        assert r.node is not None, r.error
+        assert s.commit_conflicts == 1
+        assert s.pods.get("u") is not None
+        assert s.pods.get("rival") is not None
+        assert_no_overallocation(s)
+        # And the published snapshot is coherent: both the rival's and
+        # the refitted pod's grants are visible to the next reader.
+        got = s.inspect_all_nodes_usage()
+        assert sum(u.used_mem for usage in got.values()
+                   for u in usage.values()) == 3000
+
+    def test_exhausted_retries_fall_back_to_locked_decide(self):
+        """A conflict storm beyond commit_retries must degrade to the
+        serial locked path — and still place, proving convergence is
+        unconditional."""
+        kube, s, names = make_env(n_nodes=2, commit_retries=1)
+        real_snapshot = s.snapshot
+        bumps = {"n": 0}
+
+        def racing_snapshot():
+            snap = real_snapshot()
+            # Invalidate EVERY node after every snapshot until the
+            # optimistic attempts are exhausted.
+            if bumps["n"] < 4:
+                bumps["n"] += 1
+                for n in names:
+                    register_node(s, n, chips=CHIPS_PER_NODE,
+                                  devmem=CHIP_MIB)
+            return snap
+
+        s.snapshot = racing_snapshot
+        # A refit would resolve each lost race in place; force the worst
+        # case (the winner can no longer take the pod) so what must
+        # converge is the bounded-retry → fully-locked fallback.
+        s._refit_live_locked = lambda *a, **kw: None
+        pod = tpu_pod("p", uid="u", mem="2000")
+        kube.create_pod(pod)
+        r = s.filter(pod, names)
+        assert r.node is not None, r.error
+        assert s.commit_conflicts >= 2  # initial + retry both lost
+        assert_no_overallocation(s)
+
+    def test_metrics_scrape_never_blocks_on_commit_lock(self):
+        """inspect_all_nodes_usage must read the immutable snapshot —
+        a held commit lock (a slow locked decide in flight) must not
+        stall the scrape."""
+        kube, s, names = make_env(n_nodes=2)
+        pod = tpu_pod("p", uid="u", mem="2000")
+        kube.create_pod(pod)
+        assert s.filter(pod, names).node is not None
+        got = {}
+        with s._commit_lock:  # scrape while "a decision holds the lock"
+            t = threading.Thread(
+                target=lambda: got.update(s.inspect_all_nodes_usage()))
+            t.start()
+            t.join(timeout=5)
+            assert not t.is_alive(), "scrape blocked on the commit lock"
+        granted = sum(u.used_mem for usage in got.values()
+                      for u in usage.values())
+        assert granted == 2000
+
+    def test_interleaved_watch_add_forces_refit(self):
+        """A watch-thread pod event landing between rev validation and
+        the commit's add_pod occupies the next rev — the broken pod-rev
+        chain must be treated as a conflict (undo + refit against the
+        live view that includes the interleaver), or the commit would
+        keep a placement computed blind to the interleaved grant AND
+        publish a snapshot that hides it (double-booking both ways)."""
+        kube, s, names = make_env(n_nodes=1)
+        from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+        real_add = s.pods.add_pod
+        fired = {"n": 0}
+
+        def interleaved_add(info):
+            if fired["n"] == 0 and info.uid == "u":
+                fired["n"] = 1
+                real_add(PodInfo(
+                    uid="watch-rival", name="watch-rival",
+                    namespace="default", node=info.node,
+                    devices=[[ContainerDevice(
+                        uuid=f"{info.node}-chip-0", type="TPU-v5e",
+                        usedmem=1000, usedcores=0)]]))
+            return real_add(info)
+
+        s.pods.add_pod = interleaved_add
+        pod = tpu_pod("p", uid="u", mem="2000")
+        kube.create_pod(pod)
+        assert s.filter(pod, names).node is not None
+        assert s.commit_conflicts == 1  # the chain break is a conflict
+        got = s.inspect_all_nodes_usage()
+        total = sum(u.used_mem for usage in got.values()
+                    for u in usage.values())
+        assert total == 3000, f"interleaved grant hidden: {total}"
+        assert_no_overallocation(s)
+
+    def test_commit_publishes_snapshot_incrementally(self, monkeypatch):
+        """A committed grant is the only delta to its node's usage — the
+        commit publishes it copy-on-write, so the steady-state decision
+        path never rebuilds a node from its resident pods (build_usage
+        must not run), and the informer observing the scheduler's own
+        decision-write must not invalidate the entry either."""
+        kube, s, names = make_env(n_nodes=2)
+        s.snapshot()  # cold build of both nodes, outside the count
+        calls = {"n": 0}
+        real_build = score_mod.build_usage
+
+        def counting_build(info, pods):
+            calls["n"] += 1
+            return real_build(info, pods)
+
+        monkeypatch.setattr(score_mod, "build_usage", counting_build)
+        for i in range(6):
+            pod = tpu_pod(f"p{i}", uid=f"u{i}", mem="1000")
+            kube.create_pod(pod)
+            assert s.filter(pod, names).node is not None
+        assert calls["n"] == 0, (
+            f"{calls['n']} full node rebuilds on the steady-state path")
+        got = s.inspect_all_nodes_usage()
+        assert sum(u.used_mem for usage in got.values()
+                   for u in usage.values()) == 6000
+
+    def test_fit_cache_invalidated_by_any_grant(self):
+        """The equivalence cache must never serve a fit computed against
+        a superseded generation: fill a chip, then re-ask — the second
+        identical request must see the first one's grant."""
+        kube, s, names = make_env(n_nodes=1)
+        big = str(CHIP_MIB)  # whole chip per grant: 4 fit, the 5th not
+        for i in range(CHIPS_PER_NODE):
+            pod = tpu_pod(f"p{i}", uid=f"u{i}", mem=big)
+            kube.create_pod(pod)
+            assert s.filter(pod, names).node is not None
+        pod = tpu_pod("p-extra", uid="u-extra", mem=big)
+        kube.create_pod(pod)
+        r = s.filter(pod, names)
+        assert r.node is None and (r.error or r.failed)
+        assert_no_overallocation(s)
+
+
+class TestCowUsage:
+    def _base(self):
+        return {f"c{i}": score_mod.DeviceUsage(
+            id=f"c{i}", type="v5e", health=True, coords=(i, 0),
+            total_slots=10, used_slots=0, total_mem=CHIP_MIB, used_mem=0,
+            total_cores=100, used_cores=0) for i in range(4)}
+
+    def test_mutation_stays_in_overlay(self):
+        base = self._base()
+        cow = score_mod.CowUsage(base)
+        cow.own("c0").used_mem = 5000
+        assert base["c0"].used_mem == 0
+        assert cow["c0"].used_mem == 5000
+        # values() merges the overlay; untouched chips are the base
+        # objects themselves (no clone paid for them).
+        merged = {u.id: u for u in cow.values()}
+        assert merged["c0"].used_mem == 5000
+        assert merged["c1"] is base["c1"]
+
+    def test_layering_composes(self):
+        base = self._base()
+        trial = score_mod.CowUsage(base)
+        trial.own("c0").used_mem = 1000
+        probe = score_mod.CowUsage(trial)
+        probe.own("c0").used_mem += 500
+        probe.own("c1").used_mem = 7
+        assert base["c0"].used_mem == 0
+        assert trial["c0"].used_mem == 1000
+        assert probe["c0"].used_mem == 1500
+        assert trial["c1"].used_mem == 0 and probe["c1"].used_mem == 7
+
+    def test_fit_container_clones_only_granted_chips(self):
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDeviceRequest
+
+        base = self._base()
+        cow = score_mod.CowUsage(base)
+        got = score_mod.fit_container(
+            ContainerDeviceRequest(nums=1, memreq=1000, coresreq=10),
+            cow, None, {})
+        assert got is not None and len(got) == 1
+        assert len(cow._own) == 1  # exactly the granted chip was cloned
+        assert all(u.used_mem == 0 for u in base.values())
+
+    def test_multi_container_sees_earlier_grants(self):
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDeviceRequest
+
+        base = self._base()
+        cow = score_mod.CowUsage(base)
+        reqs = [ContainerDeviceRequest(nums=4, memreq=CHIP_MIB - 1000),
+                ContainerDeviceRequest(nums=1, memreq=2000)]
+        # First container nearly fills all 4 chips; the second one's
+        # 2000 MiB fits nowhere IF it sees those tentative grants.
+        assert score_mod.fit_pod(reqs, cow, None, {}) is None
+
+
+class TestTypePrefilter:
+    def test_whitelist_miss_rejects_without_fit(self):
+        kube, s, names = make_env(n_nodes=2)
+        pod = tpu_pod("p", uid="u", mem="1000")
+        pod["metadata"]["annotations"]["vtpu.dev/use-tputype"] = "v6"
+        kube.create_pod(pod)
+        r = s.filter(pod, names)
+        assert r.node is None
+        assert all(reason.startswith("type-mismatch")
+                   for reason in r.failed.values()), r.failed
+
+    def test_prefilter_matches_chip_rule(self):
+        aff = score_mod.parse_affinity({"vtpu.dev/use-tputype": "v5e"})
+        usage = {"c0": score_mod.DeviceUsage(
+            id="c0", type="TPU-v5e", health=True, coords=(0, 0),
+            total_slots=10, used_slots=0, total_mem=1, used_mem=0,
+            total_cores=100, used_cores=0)}
+        assert score_mod.type_excluded(aff, usage) is None
+        aff = score_mod.parse_affinity({"vtpu.dev/use-tputype": "v4"})
+        assert score_mod.type_excluded(aff, usage) is not None
+
+
+class TestDecisionBatcher:
+    def test_single_writer_writes_alone(self):
+        kube = FakeKube()
+        kube.create_pod(tpu_pod("p", uid="u"))
+        b = DecisionBatcher(kube)
+        assert b.write("default", "p", {"k": "v"}) == 1
+        assert kube.get_pod("default", "p")["metadata"]["annotations"][
+            "k"] == "v"
+
+    def test_concurrent_writers_share_batches(self):
+        class SlowKube(FakeKube):
+            def patch_pod_annotations_many(self, patches):
+                import time
+                time.sleep(0.01)  # hold the leader so followers pile up
+                return super().patch_pod_annotations_many(patches)
+
+        kube = SlowKube()
+        n = 12
+        for i in range(n):
+            kube.create_pod(tpu_pod(f"p{i}", uid=f"u{i}"))
+        b = DecisionBatcher(kube)
+        sizes = []
+        lock = threading.Lock()
+
+        def write(i):
+            got = b.write("default", f"p{i}", {"k": str(i)})
+            with lock:
+                sizes.append(got)
+
+        threads = [threading.Thread(target=write, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(sizes) == n
+        assert b.writes == n
+        assert b.batches < n  # at least one group commit actually grouped
+        assert max(sizes) > 1
+        for i in range(n):
+            assert kube.get_pod("default", f"p{i}")["metadata"][
+                "annotations"]["k"] == str(i)
+
+    def test_one_failure_does_not_poison_the_batch(self):
+        class FlakyKube(FakeKube):
+            def patch_pod_annotations(self, ns, name, anns):
+                if name == "bad":
+                    raise RuntimeError("apiserver said no")
+                return super().patch_pod_annotations(ns, name, anns)
+
+        kube = FlakyKube()
+        kube.create_pod(tpu_pod("good", uid="g"))
+        kube.create_pod(tpu_pod("bad", uid="b"))
+        b = DecisionBatcher(kube)
+        results = {}
+
+        def write(name):
+            try:
+                results[name] = b.write("default", name, {"k": "v"})
+            except RuntimeError as e:
+                results[name] = e
+
+        threads = [threading.Thread(target=write, args=(n,))
+                   for n in ("good", "bad")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert isinstance(results["bad"], RuntimeError)
+        assert isinstance(results["good"], int)
+
+    def test_leader_death_releases_inflight_followers(self):
+        """A BaseException escaping mid-batch (KeyboardInterrupt in the
+        transport) must resolve the IN-FLIGHT batch's followers too —
+        they were already dequeued, so the queue-only orphan sweep would
+        leave them blocked forever on done.wait()."""
+        import time as _t
+
+        entered = threading.Event()
+
+        class DyingKube(FakeKube):
+            def patch_pod_annotations_many(self, patches):
+                entered.set()
+                _t.sleep(0.05)  # let a follower pile onto the queue
+                raise KeyboardInterrupt
+
+        b = DecisionBatcher(DyingKube())
+        outcomes = {}
+
+        def writer(name, wait_for_leader):
+            if wait_for_leader:
+                entered.wait(5)
+            try:
+                b.write("default", name, {"k": "v"})
+                outcomes[name] = None
+            except BaseException as e:  # noqa: BLE001 — the point
+                outcomes[name] = e
+
+        threads = [threading.Thread(target=writer, args=("a", False)),
+                   threading.Thread(target=writer, args=("b", True))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive(), "writer wedged on a dead leader"
+        assert isinstance(outcomes["a"], BaseException)
+        assert isinstance(outcomes["b"], BaseException)
+        assert b._leader_active is False  # usable again, not wedged
+
+    def test_failed_decision_write_still_rolls_back_grant(self):
+        """The batcher must preserve filter()'s rollback contract."""
+
+        class PatchlessKube(FakeKube):
+            def patch_pod_annotations(self, ns, name, anns):
+                raise RuntimeError("apiserver down")
+
+        kube = PatchlessKube()
+        s = Scheduler(kube, Config())
+        register_node(s, "node-a", chips=CHIPS_PER_NODE, devmem=CHIP_MIB)
+        pod = tpu_pod("p", uid="u")
+        kube.create_pod(pod)
+        r = s.filter(pod, ["node-a"])
+        assert r.error != ""
+        assert s.pods.get("u") is None
+
+
+class TestSerialBaselineParity:
+    @pytest.mark.parametrize("optimistic", [True, False])
+    def test_same_placements_either_mode(self, optimistic):
+        """Both decide paths must enforce identical fit semantics (the
+        baseline exists for A/B perf, not alternative behavior)."""
+        kube, s, names = make_env(n_nodes=2,
+                                  optimistic_commit=optimistic)
+        placed = 0
+        for i in range(2 * CHIPS_PER_NODE):
+            pod = tpu_pod(f"p{i}", uid=f"u{i}", mem=str(CHIP_MIB))
+            kube.create_pod(pod)
+            r = s.filter(pod, names)
+            assert r.node is not None, r.error
+            placed += 1
+        pod = tpu_pod("px", uid="ux", mem=str(CHIP_MIB))
+        kube.create_pod(pod)
+        assert s.filter(pod, names).node is None
+        assert placed == 2 * CHIPS_PER_NODE
+        assert_no_overallocation(s)

@@ -350,24 +350,29 @@ def test_usage_cache_conservative_under_reregistration_race():
     s = Scheduler(kube, Config())
     register_node(s, "node-a", chips=4)
     s.get_nodes_usage()  # warm the cache
+    # Make the node dirty so the next snapshot refreshes it — the race
+    # below lands inside that refresh.
+    register_node(s, "node-a", chips=4)
 
-    orig = s.nodes.node_revs
+    orig = s.nodes.rev_of
 
-    def racy_revs():
+    def racy_rev_of(name):
         # Stream-break + re-registration (2 chips now) lands at the
         # rev-read boundary: with the contract ordering (revs before
-        # data) the change is IN the revs and the data, so the fresh
-        # inventory is cached under its own key; with the reads inverted
-        # it lands after the stale data was read but inside the new rev
-        # — the stale-forever case this test exists to catch.  (rm+add,
-        # not a bare re-register: a merge mutates the shared NodeInfo in
-        # place, which an already-taken list_nodes snapshot would see.)
+        # data) the fresh inventory is read AFTER the rev, so it can at
+        # worst be cached under a stale key (whose pending dirty mark
+        # forces a rebuild); with the reads inverted the OLD inventory
+        # would be keyed by the NEW rev and served indefinitely.  (rm+
+        # add, not a bare re-register: a merge mutates the shared
+        # NodeInfo in place, which an already-taken get_node snapshot
+        # would see.)
+        rev = orig(name)
+        s.nodes.rev_of = orig  # one-shot
         s.nodes.rm_node("node-a")
         register_node(s, "node-a", chips=2)
-        s.nodes.node_revs = orig  # one-shot
-        return orig()
+        return rev
 
-    s.nodes.node_revs = racy_revs
+    s.nodes.rev_of = racy_rev_of
     s.get_nodes_usage()  # may cache either view under the OLD key
 
     usage = s.get_nodes_usage()["node-a"][1]

@@ -43,6 +43,9 @@ class _Pods:
 
 class _SchedulerStub:
     preemptions_requested = 3
+    commit_conflicts = 2
+    worker_pool_size = 8
+    workers_busy_peak = 5
 
     def __init__(self):
         self.pods = _Pods([
@@ -136,7 +139,7 @@ def test_grafana_dashboard_uses_real_metric_names():
         referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
     # promql functions + aggregation labels, not metrics
     referenced -= {"rate", "label_values", "node", "histogram_quantile",
-                   "phase", "reason"}
+                   "phase", "reason", "clamp_min"}
 
     missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
