@@ -1,8 +1,8 @@
 # Development entrypoints (the reference drives everything through
 # hack/build.sh + a Makefile; here each surface is one target).
 
-.PHONY: all native test test-fast test-slow dryrun scenarios controlplane \
-        bench-controlplane bench wheel clean
+.PHONY: all native test test-fast test-slow chaos-smoke dryrun scenarios \
+        controlplane bench-controlplane bench wheel clean
 
 all: native
 
@@ -19,6 +19,11 @@ test-fast: native             ## control plane + shim + e2e (<2 min, 1 core)
 
 test-slow: native             ## model/parallelism tier (compiles networks)
 	python -m pytest tests/ -q -m slow
+
+# Seeded + deterministic: every scenario replays bit-identically (virtual
+# clock, fixed seeds), so a failure here is a real regression, not flake.
+chaos-smoke: native           ## fault-injection suite in the simulator
+	python -m pytest tests/ -q -m chaos
 
 # dryrun_multichip pins the CPU platform + device count itself,
 # appending to (not clobbering) any user-set XLA_FLAGS.

@@ -34,6 +34,13 @@ def pytest_configure(config):
         "markers", "slow: model/parallelism tier — compiles real networks; "
                    "excluded from `make test-fast` (the <2-min tier a "
                    "judge can run on one core)")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection suite (health/faults.py "
+                   "in the simulator; `make chaos-smoke`).  Chaos tests "
+                   "are also marked slow so the `-m 'not slow'` tier-1 "
+                   "convention keeps them out of the fast gate; the fast "
+                   "deterministic health units live in tests/"
+                   "test_health.py instead")
 
 
 def free_port() -> int:

@@ -49,6 +49,14 @@ def parse_args(argv=None):
                    help="sharing mode (reference MLU modes): mem-share = "
                         "fractional HBM caps, env-share = time-slice with "
                         "no caps, default = exclusive whole chips")
+    p.add_argument("--health-poll-seconds", type=float, default=5.0,
+                   help="backend health poll period")
+    p.add_argument("--heartbeat-seconds", type=float, default=5.0,
+                   help="max quiet time before the full inventory is "
+                        "re-advertised down the register stream anyway — "
+                        "the scheduler's lease beat (docs/fault-tolerance"
+                        ".md); must stay well under the scheduler's "
+                        "--lease-ttl; 0 disables heartbeats")
     p.add_argument("--socket-dir", default="/var/lib/kubelet/device-plugins")
     p.add_argument("--debug-port", type=int, default=0,
                    help="loopback /debug endpoints incl. tracez/events — "
@@ -120,7 +128,8 @@ def main(argv=None):
 
     client = make_client(fake=args.fake_kube, kube_url=args.kube_url)
     backend = detect()
-    cache = DeviceCache(backend)
+    cache = DeviceCache(backend, poll_seconds=args.health_poll_seconds,
+                        heartbeat_seconds=args.heartbeat_seconds)
     # Whole-chip surfaces (kubelet fan-out, extender stream, annotations)
     # exclude partition-designated chips; ChipInfo objects are shared with
     # the cache inventory so health refreshes still propagate.
@@ -173,7 +182,9 @@ def main(argv=None):
         # inventory to the extender would double-book chips it doesn't
         # actually manage.
         cache.subscribe("plugin", on_health_change)
-        cache.subscribe("register", register.push_update)
+        # The register stream is the lease-heartbeat channel: it alone
+        # receives the periodic unchanged-inventory keepalives.
+        cache.subscribe("register", register.push_update, heartbeat=True)
         publish_unsatisfiable(client, cfg.node_name, whole_inv,
                               cfg.topology_policy)
     cache.start()

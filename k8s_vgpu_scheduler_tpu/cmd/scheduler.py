@@ -72,6 +72,35 @@ def parse_args(argv=None):
                         "decision run to its next I/O point uninterrupted "
                         "(docs/scheduler-concurrency.md). 0 = leave the "
                         "interpreter default")
+    # Fleet health (health/; docs/fault-tolerance.md).
+    p.add_argument("--lease-ttl", type=float, default=15.0,
+                   help="seconds without a node-agent heartbeat before the "
+                        "node is Suspect (no new placements)")
+    p.add_argument("--lease-grace-beats", type=int, default=2,
+                   help="additional lease-ttl periods a Suspect node gets "
+                        "before it is Dead and its pods are rescued")
+    p.add_argument("--quarantine-flap-threshold", type=int, default=3,
+                   help="chip health flips inside the flap window that "
+                        "quarantine the chip out of the schedulable set")
+    p.add_argument("--quarantine-flap-window", type=float, default=60.0,
+                   help="seconds of the flap-damping window")
+    p.add_argument("--quarantine-probation", type=float, default=30.0,
+                   help="seconds a quarantined chip must stay continuously "
+                        "healthy before it re-enters the snapshot")
+    p.add_argument("--rescue-interval", type=float, default=5.0,
+                   help="background rescue sweep period")
+    p.add_argument("--rescue-checkpoint-grace", type=float, default=120.0,
+                   help="seconds a checkpoint-requested victim on a "
+                        "quarantined chip gets to exit before its grant "
+                        "is rescinded anyway")
+    p.add_argument("--lease-retention", type=float, default=900.0,
+                   help="seconds a Dead lease is remembered once nothing "
+                        "remains to rescue on the node (then its metrics "
+                        "series and storm-alert contribution drop)")
+    p.add_argument("--no-rescue", action="store_true",
+                   help="disable the background rescue sweep (failure "
+                        "detection and quarantine gating stay on; grants "
+                        "stranded on dead nodes are then never rescinded)")
     # With the watch loop (informer parity) as the primary event path the
     # periodic full resync is a safety net only, so its default is long;
     # in resync-only mode (--no-watch, or a client without watch support)
@@ -130,6 +159,15 @@ def build_config(args) -> Config:
         optimistic_commit=not args.serial_filter,
         filter_workers=args.filter_workers,
         commit_retries=args.commit_retries,
+        lease_ttl_s=args.lease_ttl,
+        lease_grace_beats=args.lease_grace_beats,
+        quarantine_flap_threshold=args.quarantine_flap_threshold,
+        quarantine_flap_window_s=args.quarantine_flap_window,
+        quarantine_probation_s=args.quarantine_probation,
+        rescue_interval_s=args.rescue_interval,
+        rescue_checkpoint_grace_s=args.rescue_checkpoint_grace,
+        lease_retention_s=args.lease_retention,
+        enable_rescue=not args.no_rescue,
     )
 
 
@@ -182,6 +220,11 @@ def main(argv=None):
     watch_enabled, args.resync_seconds = resolve_watch_and_resync(
         args.no_watch, client, args.resync_seconds)
 
+    # Fleet health: the rescue sweep runs from here (not the Scheduler
+    # ctor) so embedders/tests own their own sweep cadence.
+    if scheduler.cfg.enable_rescue:
+        scheduler.rescuer.start()
+
     watch_stop = threading.Event()
     if watch_enabled:
         threading.Thread(target=run_watch_loop,
@@ -226,6 +269,7 @@ def main(argv=None):
                 logging.exception("resync failed")
     except KeyboardInterrupt:
         watch_stop.set()
+        scheduler.rescuer.stop()
         http_server.stop()
         grpc_server.stop(grace=2)
 

@@ -23,9 +23,28 @@ Workload spec (JSON):
 ``gang`` members are co-scheduled atomically through the gang manager,
 exactly as on a cluster.
 
+A workload may also carry a ``chaos`` section — a deterministic failure
+scenario played against the placed fleet through the REAL health subsystem
+(health/: leases, quarantine, rescuer) on a virtual clock:
+
+    {"pods": [...],
+     "chaos": {"seed": 7,
+               "events": [{"at_s": 5, "kind": "partition-node",
+                           "node": "sim-node-0"},
+                          {"at_s": 8, "kind": "flap-chip",
+                           "node": "sim-node-1",
+                           "chip": "sim-node-1-chip-0", "count": 4}],
+               "random_events": 0, "settle_s": 60}}
+
+The report then answers the capacity question UNDER FAILURE: which pods
+were rescued off the dead/quarantined hardware, whether they re-placed on
+the survivors, and that no chip was ever overbooked during the rescue.
+
 Usage:
     vtpu-simulate --nodes 4 --chips 8 --hbm 16384 --mesh 4x2 \
                   --workload workload.json [--policy binpack] [--json]
+    vtpu-simulate --workload workload.json --chaos-seed 7 \
+                  --chaos-random-events 5   # seeded random fault schedule
     vtpu-simulate --workload workload.json --from-cluster http://sched:443
                   # live fleet: the extender's /fleetz snapshot, existing
                   # grants included — answers for the REMAINING capacity
@@ -38,6 +57,7 @@ import json
 import sys
 from typing import List, Optional
 
+from ..health.faults import FaultEvent, FaultInjector, SimClock
 from ..k8s import FakeKube
 from ..scheduler import DeviceInfo, NodeInfo, Scheduler
 from ..scheduler.gang import GANG_GROUP_ANNOTATION, GANG_TOTAL_ANNOTATION
@@ -134,9 +154,14 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
     live_cfg = (fleet_export or {}).get("config", {})
     policy = policy or live_cfg.get("node_scheduler_policy") or "spread"
     topology_policy = live_cfg.get("topology_policy", "best-effort")
+    chaos = workload.get("chaos")
+    # A chaos scenario runs on a virtual clock so minutes of lease decay
+    # and quarantine probation replay in microseconds — deterministically.
+    clock = SimClock() if chaos else None
     kube = FakeKube()
     s = Scheduler(kube, Config(node_scheduler_policy=policy,
-                               topology_policy=topology_policy))
+                               topology_policy=topology_policy),
+                  clock=clock)
     if fleet_export is not None:
         names = build_fleet_from_export(s, kube, fleet_export)
     else:
@@ -179,6 +204,10 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
     for _, pod, err in queue:
         pending.append({"pod": pod["metadata"]["name"], "reason": err})
 
+    chaos_report = None
+    if chaos:
+        chaos_report = run_chaos_phase(s, kube, names, chaos, clock, placed)
+
     usage = s.inspect_all_nodes_usage()
     chips_out = {}
     total_mem = used_mem = 0
@@ -191,7 +220,7 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
             }
             total_mem += u.total_mem
             used_mem += u.used_mem
-    return {
+    result = {
         "fleet": (
             {"nodes": len(names), "source": "live /fleetz snapshot",
              "existing_pods": len(fleet_export.get("pods", [])),
@@ -205,6 +234,77 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
         "hbm_allocated_fraction": round(used_mem / total_mem, 4)
         if total_mem else 0.0,
         "fits": not pending,
+    }
+    if chaos_report is not None:
+        result["chaos"] = chaos_report
+    return result
+
+
+def overbooked_chips(s: Scheduler) -> List[str]:
+    """Chips whose granted slots/HBM/cores exceed advertised totals — the
+    invariant the rescue must never break (empty = healthy)."""
+    bad = []
+    for node, per_chip in s.inspect_all_nodes_usage().items():
+        for u in per_chip.values():
+            if (u.used_slots > u.total_slots or u.used_mem > u.total_mem
+                    or u.used_cores > u.total_cores):
+                bad.append(f"{node}/{u.id}")
+    return sorted(bad)
+
+
+def run_chaos_phase(s: Scheduler, kube: FakeKube, names: List[str],
+                    chaos: dict, clock: SimClock, placed: List[dict]) -> dict:
+    """Play the failure scenario, let the rescuer contain it, then try to
+    re-place every rescued pod on the surviving fleet — the whole health
+    stack (lease decay, quarantine, rescind, re-filter) end to end, on
+    virtual time."""
+    inj = FaultInjector(s, clock, seed=int(chaos.get("seed", 0)))
+    inj.attach()
+    plan = [FaultEvent(**ev) for ev in chaos.get("events", [])]
+    plan += inj.random_plan(int(chaos.get("random_events", 0)),
+                            horizon_s=float(chaos.get("horizon_s", 60.0)))
+    # Default settle: long enough for a partitioned node's lease to die
+    # AND a quarantined chip's probation to elapse.
+    settle = float(chaos.get(
+        "settle_s",
+        s.leases.cfg.dead_after_s + 2 * s.quarantine.cfg.probation_s))
+    actions = inj.run_plan(plan, sweep=s.rescuer.sweep, settle_s=settle)
+
+    placed_uids = {f"uid-{p['pod']}": p["pod"] for p in placed}
+    rescued = sorted(name for uid, name in placed_uids.items()
+                     if s.pods.get(uid) is None)
+
+    # Re-place pass over the survivors (the way kube-scheduler re-queues a
+    # pod whose assignment was rescinded).
+    survivors = [n for n in names if s.nodes.get_node(n) is not None]
+    replaced, still_pending = [], []
+    for pod_name_ in rescued:
+        try:
+            pod = kube.get_pod("sim", pod_name_)
+        except Exception:  # noqa: BLE001 — deleted outright; its controller
+            # would recreate it, which is outside this replay's scope
+            still_pending.append({"pod": pod_name_, "reason": "pod gone"})
+            continue
+        r = s.filter(pod, survivors)
+        if r.node:
+            s.bind("sim", pod_name_, pod["metadata"]["uid"], r.node)
+            nodelock.release_node(kube, r.node)
+            replaced.append({"pod": pod_name_, "node": r.node})
+        else:
+            still_pending.append({"pod": pod_name_,
+                                  "reason": r.error or "no fit"})
+    return {
+        "seed": int(chaos.get("seed", 0)),
+        "injected": inj.log,
+        "lease_states": {n: st.name
+                         for n, st in sorted(s.leases.states().items())},
+        "quarantined": {n: sorted(c)
+                        for n, c in sorted(s.quarantine.active().items())},
+        "rescued": rescued,
+        "replaced": replaced,
+        "still_pending": still_pending,
+        "sweep_actions": len(actions),
+        "overbooked_chips": overbooked_chips(s),
     }
 
 
@@ -232,6 +332,19 @@ def format_report(result: dict) -> str:
             lines.append(f"  {p['pod']:<24s} {p['reason']}")
     else:
         lines.append("workload fits.")
+    chaos = result.get("chaos")
+    if chaos:
+        lines.append(
+            f"chaos (seed {chaos['seed']}): {len(chaos['injected'])} "
+            f"fault(s) injected; {len(chaos['rescued'])} pod(s) rescued, "
+            f"{len(chaos['replaced'])} re-placed on survivors")
+        for r in chaos["replaced"]:
+            lines.append(f"  {r['pod']:<24s} ↻ {r['node']}")
+        for p in chaos["still_pending"]:
+            lines.append(f"  {p['pod']:<24s} STRANDED: {p['reason']}")
+        if chaos["overbooked_chips"]:
+            lines.append("  OVERBOOKED during rescue: "
+                         + ", ".join(chaos["overbooked_chips"]))
     return "\n".join(lines)
 
 
@@ -253,6 +366,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                    default=None,
                    help="default: the live cluster's own policy with "
                         "--from-cluster, else spread")
+    p.add_argument("--chaos-seed", type=int, default=None,
+                   help="seed for the chaos phase (overrides the "
+                        "workload's chaos.seed; enables chaos when the "
+                        "workload has no chaos section)")
+    p.add_argument("--chaos-random-events", type=int, default=None,
+                   help="number of seeded random fault events to add to "
+                        "the chaos schedule")
     p.add_argument("--json", action="store_true", dest="as_json")
     args = p.parse_args(argv)
 
@@ -274,6 +394,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (ValueError, OSError, json.JSONDecodeError) as e:
         print(f"vtpu-simulate: {e}", file=sys.stderr)
         return 2
+    if args.chaos_seed is not None or args.chaos_random_events is not None:
+        chaos = dict(workload.get("chaos") or {})
+        if args.chaos_seed is not None:
+            chaos["seed"] = args.chaos_seed
+        if args.chaos_random_events is not None:
+            chaos["random_events"] = args.chaos_random_events
+        workload["chaos"] = chaos
     result = run_simulation(workload, nodes=args.nodes, chips=args.chips,
                             hbm=args.hbm, mesh=mesh,
                             generation=args.generation, policy=args.policy,

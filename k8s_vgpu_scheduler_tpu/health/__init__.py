@@ -1,0 +1,43 @@
+"""Fleet health subsystem: heartbeat leases, chip quarantine, stranded-pod
+rescue, and a deterministic fault-injection harness.
+
+The reference stack's availability story stops at ``rmNodeDevice`` — when a
+node agent's registration stream breaks its inventory vanishes, but pods
+already granted on that node linger as placed forever, and a flapping chip
+oscillates in and out of the schedulable set (nodes.go:283–305).  This
+package closes that gap with the lease/failure-detector/self-healing shape
+every production control plane is built on (Borg-style leases, k8s node
+leases):
+
+- :mod:`.lease` — deadline-based failure detector over heartbeats that the
+  node agents piggyback on the existing register stream
+  (``Healthy → Suspect → Dead``);
+- :mod:`.quarantine` — per-chip flap-damping state machine with a
+  sustained-healthy probation;
+- :mod:`.rescuer` — background sweep that rescinds grants stranded on dead
+  nodes / quarantined chips, reusing the checkpointed-eviction machinery so
+  training victims exit at a step boundary and resume losslessly;
+- :mod:`.faults` — seedable chaos harness driving all of the above from
+  tests and ``vtpu-simulate``.
+
+See docs/fault-tolerance.md for the protocol and its interaction with the
+optimistic snapshot/commit Filter.
+"""
+
+from .lease import LeaseConfig, LeaseState, LeaseTracker
+from .quarantine import ChipQuarantine, QuarantineConfig
+from .rescuer import RescueConfig, Rescuer
+from .faults import FaultEvent, FaultInjector, SimClock
+
+__all__ = [
+    "LeaseConfig",
+    "LeaseState",
+    "LeaseTracker",
+    "ChipQuarantine",
+    "QuarantineConfig",
+    "RescueConfig",
+    "Rescuer",
+    "FaultEvent",
+    "FaultInjector",
+    "SimClock",
+]

@@ -1,0 +1,176 @@
+"""Node heartbeat leases — a deadline-based failure detector.
+
+Node agents piggyback heartbeats on the channel they already hold open: every
+message on the register stream (initial advertisement, health-flip
+re-registration, periodic keepalive — deviceplugin/cache.py) counts as one
+beat.  No new RPC, no proto change; a partitioned agent simply stops
+producing messages and its lease decays.
+
+State machine (computed lazily from the last beat's age, so gating a Filter
+needs no background thread):
+
+    Healthy  ── ttl_s without a beat ──▶  Suspect
+    Suspect  ── grace_beats more ttl_s ──▶  Dead
+    any      ── beat arrives ──▶  Healthy
+
+``Suspect`` is the containment half-step: the node is excluded from NEW
+placements (its lease may just be late) but its existing grants stand — a
+GC pause or a dropped packet must not evict a fleet's training jobs.  Only
+``Dead`` hands the node's pods to the rescuer (health/rescuer.py).
+
+Nodes that never beat are UNTRACKED (``state_of`` returns None) and treated
+as placeable: embedders, benchmarks and the simulator register inventory
+directly without running node agents, and a failure detector that faults
+every node it has never heard from would brick them all at boot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class LeaseState(enum.IntEnum):
+    # Values are the wire/metric encoding (vtpu_node_lease_state).
+    HEALTHY = 0
+    SUSPECT = 1
+    DEAD = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class LeaseConfig:
+    #: Seconds without a heartbeat before a node turns Suspect.  Must be
+    #: comfortably above the agents' beat interval (deviceplugin cache
+    #: heartbeat, default 5s) or every scheduling pause flaps the fleet.
+    ttl_s: float = 15.0
+    #: Missed-beat grace: how many MORE ttl_s periods a Suspect node gets
+    #: before it is declared Dead and its grants become rescuable.
+    grace_beats: int = 2
+
+    @property
+    def dead_after_s(self) -> float:
+        return self.ttl_s * (1 + max(0, self.grace_beats))
+
+
+@dataclasses.dataclass
+class NodeLease:
+    node: str
+    last_beat: float
+    beats: int = 1
+    #: Cumulative per-chip error counters (agents may report deltas with
+    #: each beat; the quarantine consumes them as flap-equivalents).
+    errors: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+class LeaseTracker:
+    """Thread-safe lease registry.  ``state_of`` is a pure read computed
+    from the clock; ``sweep`` additionally reports transitions exactly once
+    (for logs, the journal and the rescuer's node-death handling)."""
+
+    def __init__(self, cfg: Optional[LeaseConfig] = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.cfg = cfg or LeaseConfig()
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._leases: Dict[str, NodeLease] = {}
+        # Last state reported by sweep(), per node — the transition edge
+        # detector.  Distinct from the live state: between sweeps a node
+        # may already BE dead (state_of says so, Filter gating applies)
+        # while the transition has not been acted on yet.
+        self._reported: Dict[str, LeaseState] = {}
+
+    # -- writes ---------------------------------------------------------------
+    def beat(self, node: str,
+             error_deltas: Optional[Dict[str, int]] = None,
+             now: Optional[float] = None) -> None:
+        """One heartbeat (= one register-stream message) from ``node``."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            lease = self._leases.get(node)
+            if lease is None:
+                self._leases[node] = lease = NodeLease(node=node,
+                                                       last_beat=now)
+            else:
+                lease.last_beat = now
+                lease.beats += 1
+            if error_deltas:
+                for chip, delta in error_deltas.items():
+                    lease.errors[chip] = lease.errors.get(chip, 0) + delta
+
+    def forget(self, node: str) -> None:
+        """Stop tracking (a node deliberately decommissioned; NOT called on
+        stream breaks — those are exactly what the lease must outlive)."""
+        with self._lock:
+            self._leases.pop(node, None)
+            self._reported.pop(node, None)
+
+    # -- reads ----------------------------------------------------------------
+    def _state(self, lease: NodeLease, now: float) -> LeaseState:
+        age = now - lease.last_beat
+        if age <= self.cfg.ttl_s:
+            return LeaseState.HEALTHY
+        if age <= self.cfg.dead_after_s:
+            return LeaseState.SUSPECT
+        return LeaseState.DEAD
+
+    def state_of(self, node: str) -> Optional[LeaseState]:
+        """Live state, or None for an untracked node (treated healthy)."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(node)
+            if lease is None:
+                return None
+            return self._state(lease, now)
+
+    def age_of(self, node: str) -> Optional[float]:
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(node)
+            return None if lease is None else now - lease.last_beat
+
+    def errors_of(self, node: str) -> Dict[str, int]:
+        with self._lock:
+            lease = self._leases.get(node)
+            return dict(lease.errors) if lease else {}
+
+    def reject_reason(self, node: str) -> Optional[str]:
+        """Filter-gating read: non-None when the node must not take NEW
+        placements.  The leading token is the low-cardinality rejection
+        counter key (trace.reject splits on the first colon)."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(node)
+            if lease is None:
+                return None
+            st = self._state(lease, now)
+        if st is LeaseState.HEALTHY:
+            return None
+        return (f"lease-{st.name.lower()}: no heartbeat for "
+                f"{now - lease.last_beat:.1f}s "
+                f"(ttl {self.cfg.ttl_s:.0f}s)")
+
+    def states(self) -> Dict[str, LeaseState]:
+        """Per-node live states (the vtpu_node_lease_state gauge)."""
+        now = self._clock()
+        with self._lock:
+            return {n: self._state(lease, now)
+                    for n, lease in self._leases.items()}
+
+    def sweep(self, now: Optional[float] = None
+              ) -> List[Tuple[str, LeaseState, LeaseState]]:
+        """Edge-detect state transitions since the previous sweep; each
+        transition is reported exactly once.  Called by the rescuer's
+        periodic pass (and directly by deterministic tests)."""
+        now = self._clock() if now is None else now
+        out: List[Tuple[str, LeaseState, LeaseState]] = []
+        with self._lock:
+            for node, lease in self._leases.items():
+                st = self._state(lease, now)
+                prev = self._reported.get(node, LeaseState.HEALTHY)
+                if st != prev:
+                    self._reported[node] = st
+                    out.append((node, prev, st))
+        return out

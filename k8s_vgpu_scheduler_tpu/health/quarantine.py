@@ -1,0 +1,253 @@
+"""Per-chip quarantine with flap damping.
+
+A chip whose health oscillates is worse than a dead one: every flip of the
+plain ``health`` bit re-registers it in and out of the schedulable set, so
+pods land on it during the healthy half-cycles and die during the unhealthy
+ones.  The quarantine adds hysteresis on top of the raw bit:
+
+    ACTIVE  ── flap_threshold health flips inside flap_window_s ──▶ QUARANTINED
+    QUARANTINED ── continuously healthy for probation_s ──▶ ACTIVE
+
+A quarantined chip is stripped from the scheduler's usage snapshot entirely
+(Scheduler._refresh_entry_locked), so no fit — optimistic or serial — can
+ever see it; existing grants that reference it become rescuable
+(health/rescuer.py).  Release requires a SUSTAINED healthy probation: any
+unhealthy observation during probation restarts the clock.
+
+The health observations arrive on the register stream (the device plugin's
+health poll triggers a full re-registration on every flip —
+deviceplugin/cache.py), and agents may additionally report per-chip error
+COUNTER deltas with their heartbeats; ``error_threshold`` errors inside the
+flap window quarantine a chip that never flipped its health bit at all
+(creeping ICI corruption looks exactly like that).
+
+Every quarantine/release fires ``on_change(node)`` — the scheduler wires it
+to ``NodeManager.touch``, which bumps the node's inventory revision.  That
+is the whole concurrency story: snapshot entries are keyed on (pod rev,
+inventory rev), so the rev bump invalidates cached usage, and an optimistic
+commit computed against the pre-quarantine snapshot fails its revision
+validation and refits on the live (chip-less) view
+(docs/fault-tolerance.md, docs/scheduler-concurrency.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineConfig:
+    #: Health flips inside flap_window_s that trigger quarantine.
+    flap_threshold: int = 3
+    flap_window_s: float = 60.0
+    #: A quarantined chip must be continuously healthy this long to return.
+    probation_s: float = 30.0
+    #: Error-counter sum inside flap_window_s that also quarantines
+    #: (0 = disabled; agents that report no counters are unaffected).
+    error_threshold: int = 0
+
+
+@dataclasses.dataclass
+class _ChipRecord:
+    node: str
+    chip: str
+    last_health: Optional[bool] = None
+    flips: Deque[float] = dataclasses.field(default_factory=collections.deque)
+    errors: Deque[Tuple[float, int]] = dataclasses.field(
+        default_factory=collections.deque)
+    quarantined_at: Optional[float] = None
+    #: Most recent moment the chip was NOT trustworthy (observed unhealthy,
+    #: flipped, errored, or entered quarantine) — probation counts from here.
+    last_bad: float = 0.0
+    reason: str = ""
+
+
+class ChipQuarantine:
+    """Thread-safe per-chip state machine.  Reads used on the scheduling
+    path (``quarantined_on``) are pure — state only changes in ``observe*``
+    / ``quarantine`` / ``sweep``, and change callbacks fire outside the
+    internal lock (they take the NodeManager lock)."""
+
+    def __init__(self, cfg: Optional[QuarantineConfig] = None,
+                 clock: Optional[Callable[[], float]] = None,
+                 on_change: Optional[Callable[[str], None]] = None) -> None:
+        self.cfg = cfg or QuarantineConfig()
+        self._clock = clock or time.monotonic
+        self._on_change = on_change
+        self._lock = threading.Lock()
+        self._chips: Dict[Tuple[str, str], _ChipRecord] = {}
+        #: Lifetime count of quarantine entries (vtpu_chip_quarantines_total).
+        self.quarantines_total = 0
+
+    # -- observations ----------------------------------------------------------
+    def observe(self, node: str, chip: str, healthy: bool,
+                now: Optional[float] = None) -> bool:
+        """One health reading for one chip (from a register message).
+        Returns True when the chip's quarantine state changed."""
+        now = self._clock() if now is None else now
+        changed_node = None
+        with self._lock:
+            rec = self._record(node, chip)
+            flipped = (rec.last_health is not None
+                       and healthy != rec.last_health)
+            rec.last_health = healthy
+            if not healthy:
+                rec.last_bad = now
+            if flipped:
+                rec.flips.append(now)
+                rec.last_bad = now
+                self._prune(rec.flips, now)
+                if (rec.quarantined_at is None
+                        and len(rec.flips) >= self.cfg.flap_threshold):
+                    self._quarantine_locked(
+                        rec, now,
+                        f"{len(rec.flips)} health flips in "
+                        f"{self.cfg.flap_window_s:.0f}s")
+                    changed_node = node
+        if changed_node is not None:
+            self._notify(changed_node)
+        return changed_node is not None
+
+    def observe_node(self, node: str, health: Dict[str, bool],
+                     now: Optional[float] = None) -> bool:
+        changed = False
+        for chip, healthy in health.items():
+            changed |= self.observe(node, chip, healthy, now=now)
+        return changed
+
+    def observe_errors(self, node: str, chip: str, delta: int,
+                       now: Optional[float] = None) -> bool:
+        """Error-counter delta from a heartbeat; quarantines on sustained
+        error volume even when the health bit never flips."""
+        if delta <= 0 or self.cfg.error_threshold <= 0:
+            return False
+        now = self._clock() if now is None else now
+        changed_node = None
+        with self._lock:
+            rec = self._record(node, chip)
+            rec.errors.append((now, delta))
+            rec.last_bad = now
+            while rec.errors and rec.errors[0][0] < now - self.cfg.flap_window_s:
+                rec.errors.popleft()
+            total = sum(d for _, d in rec.errors)
+            if rec.quarantined_at is None and total >= self.cfg.error_threshold:
+                self._quarantine_locked(
+                    rec, now,
+                    f"{total} chip errors in {self.cfg.flap_window_s:.0f}s")
+                changed_node = node
+        if changed_node is not None:
+            self._notify(changed_node)
+        return changed_node is not None
+
+    # -- direct transitions ----------------------------------------------------
+    def quarantine(self, node: str, chip: str, reason: str,
+                   now: Optional[float] = None) -> bool:
+        """Quarantine unconditionally (slice-neighbor containment, fault
+        injection, operator action)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            rec = self._record(node, chip)
+            if rec.quarantined_at is not None:
+                return False
+            self._quarantine_locked(rec, now, reason)
+        self._notify(node)
+        return True
+
+    def release(self, node: str, chip: str) -> bool:
+        """Unconditional release (operator action; normal exits go through
+        the probation in :meth:`sweep`)."""
+        with self._lock:
+            rec = self._chips.get((node, chip))
+            if rec is None or rec.quarantined_at is None:
+                return False
+            self._release_locked(rec)
+        self._notify(node)
+        return True
+
+    def sweep(self, now: Optional[float] = None) -> List[Tuple[str, str]]:
+        """Release quarantined chips whose sustained-healthy probation has
+        elapsed; returns the (node, chip) pairs released.  Called from the
+        rescuer's periodic pass and from deterministic tests."""
+        now = self._clock() if now is None else now
+        released: List[Tuple[str, str]] = []
+        with self._lock:
+            for rec in self._chips.values():
+                if rec.quarantined_at is None:
+                    continue
+                if rec.last_health is False:
+                    continue  # still observing unhealthy — no probation
+                if now - rec.last_bad >= self.cfg.probation_s:
+                    self._release_locked(rec)
+                    released.append((rec.node, rec.chip))
+        for node, _chip in released:
+            self._notify(node)
+        return released
+
+    # -- reads -----------------------------------------------------------------
+    def is_quarantined(self, node: str, chip: str) -> bool:
+        with self._lock:
+            rec = self._chips.get((node, chip))
+            return rec is not None and rec.quarantined_at is not None
+
+    def quarantined_on(self, node: str) -> Set[str]:
+        """Chip ids currently quarantined on ``node`` — the snapshot
+        refresh strips exactly this set.  Pure read."""
+        with self._lock:
+            return {rec.chip for (n, _), rec in self._chips.items()
+                    if n == node and rec.quarantined_at is not None}
+
+    def active(self) -> Dict[str, Set[str]]:
+        with self._lock:
+            out: Dict[str, Set[str]] = {}
+            for (node, _), rec in self._chips.items():
+                if rec.quarantined_at is not None:
+                    out.setdefault(node, set()).add(rec.chip)
+            return out
+
+    def count(self) -> int:
+        with self._lock:
+            return sum(1 for rec in self._chips.values()
+                       if rec.quarantined_at is not None)
+
+    # -- internals -------------------------------------------------------------
+    def _record(self, node: str, chip: str) -> _ChipRecord:
+        rec = self._chips.get((node, chip))
+        if rec is None:
+            self._chips[(node, chip)] = rec = _ChipRecord(node=node, chip=chip)
+        return rec
+
+    def _prune(self, dq: Deque[float], now: float) -> None:
+        while dq and dq[0] < now - self.cfg.flap_window_s:
+            dq.popleft()
+
+    def _quarantine_locked(self, rec: _ChipRecord, now: float,
+                           reason: str) -> None:
+        rec.quarantined_at = now
+        rec.last_bad = now
+        rec.reason = reason
+        self.quarantines_total += 1
+        log.warning("quarantined chip %s on %s: %s", rec.chip, rec.node,
+                    reason)
+
+    def _release_locked(self, rec: _ChipRecord) -> None:
+        log.info("released chip %s on %s from quarantine (was: %s)",
+                 rec.chip, rec.node, rec.reason)
+        rec.quarantined_at = None
+        rec.reason = ""
+        rec.flips.clear()
+        rec.errors.clear()
+
+    def _notify(self, node: str) -> None:
+        if self._on_change is not None:
+            try:
+                self._on_change(node)
+            except Exception:  # noqa: BLE001 — snapshot bump must not wedge health
+                log.exception("quarantine change callback failed for %s",
+                              node)

@@ -1,0 +1,355 @@
+"""Stranded-grant rescue: find placements the fleet can no longer honor and
+rescind them so the pods reschedule.
+
+A grant becomes rescuable when:
+
+- its node's lease is **Dead** (health/lease.py) — the kubelet/agent is
+  unreachable, the workload may or may not still be running, but the chips
+  cannot be accounted for;
+- any of its chips is **quarantined** (health/quarantine.py) or has
+  **vanished** from a re-registration (the full-inventory-replace deviation
+  documented in scheduler/nodes.py).
+
+Rescission reuses the machinery that already exists rather than inventing a
+teardown path:
+
+1. **Checkpoint first** (quarantined chip on a live node): the victim gets
+   the same ``vtpu.dev/preempt-requested`` annotation the priority
+   preemption path writes (scheduler/preempt.py), with a ``rescue:`` value
+   prefix for provenance.  The in-container watch (shim/preempt.py) sees it
+   through the downward API, the training loop checkpoints at the next step
+   boundary and exits, and the normal delete path frees the grant — the
+   victim later resumes losslessly (pinned by tests/test_chaos.py).  A
+   victim that does not exit within ``checkpoint_grace_s`` is rescinded
+   anyway (it may be wedged on the broken chip).
+2. **Rescind through the commit path**: clear the decision annotations
+   (``assigned-node`` et al. — the informer's MODIFIED event then drops the
+   grant exactly like any other pod losing its assignment) and release the
+   registry entry directly, which bumps the node's revision and publishes
+   the usage delta to the snapshot — the same rev-ordering contract every
+   other grant change follows (docs/scheduler-concurrency.md).  No new
+   lock: the rescuer holds none of the scheduler's.
+
+The sweep is a plain method so tests and the simulator drive it
+deterministically; ``start()`` wraps it in the daemon's background thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..k8s.client import NotFound, is_pod_terminated, pod_uid
+from ..util.types import (
+    ASSIGNED_IDS_ANNOTATION,
+    ASSIGNED_NODE_ANNOTATION,
+    BIND_PHASE_ANNOTATION,
+    TO_ALLOCATE_ANNOTATION,
+)
+from .lease import LeaseState
+
+log = logging.getLogger(__name__)
+
+#: Value prefix for rescuer-written eviction requests: the in-container
+#: watch only needs non-empty, and the preemption ledger reconciliation
+#: skips rescue-prefixed values (they are not requester uids).
+RESCUE_VALUE_PREFIX = "rescue:"
+
+
+@dataclasses.dataclass(frozen=True)
+class RescueConfig:
+    #: Background sweep period (cmd/scheduler --rescue-interval).
+    interval_s: float = 5.0
+    #: How long a checkpoint-requested victim gets to exit on its own
+    #: before the grant is rescinded from under it.
+    checkpoint_grace_s: float = 120.0
+    #: How long a Dead lease is remembered before it is forgotten (once
+    #: its inventory is gone and no grants remain).  Decommissioned nodes
+    #: must eventually leave the lease table, or vtpu_node_leases_unhealthy
+    #: latches the lease-expiry-storm alert forever and the per-node gauge
+    #: cardinality grows without bound under node churn.  A node that
+    #: returns later simply starts a fresh lease with its first beat.
+    lease_retention_s: float = 900.0
+
+
+@dataclasses.dataclass
+class RescueItem:
+    uid: str
+    namespace: str
+    name: str
+    node: str
+    reason: str
+    enqueued_at: float
+    #: When the checkpoint request (preempt annotation) was written;
+    #: None until it is.
+    asked_at: Optional[float] = None
+
+
+class Rescuer:
+    def __init__(self, scheduler, cfg: Optional[RescueConfig] = None,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.s = scheduler
+        self.cfg = cfg or RescueConfig()
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._queue: Dict[str, RescueItem] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: Lifetime count of rescinded grants (vtpu_rescued_pods_total).
+        self.rescued_total = 0
+
+    # -- queue -----------------------------------------------------------------
+    def enqueue(self, uid: str, reason: str, namespace: str = "",
+                name: str = "", node: str = "") -> bool:
+        """Queue one grant for rescue (idempotent per uid).  Callers that
+        have no registry entry (the resync stranded-pod path) pass the
+        identity explicitly; otherwise it is read from the registry."""
+        info = self.s.pods.get(uid)
+        if info is not None:
+            namespace = namespace or info.namespace
+            name = name or info.name
+            node = node or info.node
+        with self._lock:
+            if uid in self._queue:
+                return False
+            self._queue[uid] = RescueItem(
+                uid=uid, namespace=namespace, name=name, node=node,
+                reason=reason, enqueued_at=self._clock())
+        log.warning("rescue queued for %s/%s (uid %s): %s", namespace,
+                    name, uid, reason)
+        return True
+
+    def pending(self) -> Dict[str, RescueItem]:
+        with self._lock:
+            return dict(self._queue)
+
+    # -- the sweep -------------------------------------------------------------
+    def sweep(self) -> List[dict]:
+        """One full pass: lease transitions → quarantine probation →
+        stranded-grant scan → queue drain.  Returns the actions taken
+        (observable for tests, the simulator's chaos report, and logs)."""
+        from ..util import trace
+
+        now = self._clock()
+        actions: List[dict] = []
+        tr = trace.tracer()
+
+        # 1. Lease transitions (reported exactly once per edge).
+        for node, old, new in self.s.leases.sweep(now):
+            actions.append({"kind": "lease", "node": node,
+                            "from": old.name, "to": new.name})
+            tr.event(node, f"lease-{new.name.lower()}",
+                     node=node, previous=old.name)
+            if new is LeaseState.DEAD:
+                # Containment: the inventory is no longer trustworthy.
+                # Idempotent — the register-stream close usually already
+                # dropped it; a partition with a live-but-silent stream
+                # has not.
+                age = self.s.leases.age_of(node)
+                log.error("node %s lease expired (no heartbeat for %.0fs); "
+                          "removing inventory and rescuing its pods",
+                          node, age if age is not None else -1.0)
+                self.s.nodes.rm_node(node)
+                for info in self.s.pods.pods_on_node(node):
+                    self.enqueue(info.uid, "node-dead")
+            elif old is LeaseState.DEAD:
+                log.warning("node %s lease recovered (%s); awaiting "
+                            "re-registration", node, new.name)
+
+        # 1b. Dead-lease retention: forget leases that stayed Dead past
+        # the retention window, once there is nothing left to rescue on
+        # them (inventory gone, no grants).  Keeping the grants check
+        # matters: a rescind that keeps failing (apiserver outage) must
+        # keep its node lease-Dead so the stranded-grant scan re-finds it.
+        for node, state in self.s.leases.states().items():
+            if state is not LeaseState.DEAD:
+                continue
+            age = self.s.leases.age_of(node)
+            if age is None or age < self.cfg.lease_retention_s:
+                continue
+            if self.s.nodes.get_node(node) is not None \
+                    or self.s.pods.pods_on_node(node):
+                continue
+            self.s.leases.forget(node)
+            actions.append({"kind": "lease-forgotten", "node": node})
+            log.info("forgot lease of %s (Dead for %.0fs, nothing left "
+                     "to rescue)", node, age)
+
+        # 2. Quarantine probation releases.
+        for node, chip in self.s.quarantine.sweep(now):
+            actions.append({"kind": "quarantine-release", "node": node,
+                            "chip": chip})
+
+        # 3. Stranded-grant scan.
+        for info in self.s.pods.list_pods():
+            state = self.s.leases.state_of(info.node)
+            if state is LeaseState.DEAD:
+                self.enqueue(info.uid, "node-dead")
+                continue
+            uuids = {d.uuid for container in info.devices for d in container}
+            quarantined = uuids & self.s.quarantine.quarantined_on(info.node)
+            if quarantined:
+                # Slice-neighbor containment: a multi-chip grant rides one
+                # ICI domain — the quarantined chip's co-granted neighbors
+                # share whatever is corrupting it, and rescuing the pod
+                # while leaving them schedulable would hand the same
+                # broken slice to the next gang.
+                if len(uuids) > 1:
+                    for other in sorted(uuids - quarantined):
+                        if self.s.quarantine.quarantine(
+                                info.node, other, "slice-neighbor"):
+                            actions.append({"kind": "quarantine",
+                                            "node": info.node,
+                                            "chip": other,
+                                            "reason": "slice-neighbor"})
+                self.enqueue(info.uid, "chip-quarantined")
+                continue
+            node_info = self.s.nodes.get_node(info.node)
+            if node_info is not None:
+                known = {d.id for d in node_info.devices}
+                if uuids - known:
+                    # Re-registration replaced the inventory without the
+                    # chip (nodes.py's deliberate deviation): the grant
+                    # references hardware that no longer exists.
+                    self.enqueue(info.uid, "chip-vanished")
+
+        # 4. Drain.
+        with self._lock:
+            items = list(self._queue.values())
+        for item in items:
+            action = self._process(item, now)
+            if action is not None:
+                actions.append(action)
+        return actions
+
+    # -- per-item processing ---------------------------------------------------
+    def _process(self, item: RescueItem, now: float) -> Optional[dict]:
+        pod = None
+        if item.namespace and item.name:
+            try:
+                pod = self.s.client.get_pod(item.namespace, item.name)
+                if pod_uid(pod) != item.uid:
+                    pod = None  # a successor pod reused the name
+            except NotFound:
+                pod = None
+            except Exception as e:  # noqa: BLE001 — apiserver glitch; retry next sweep
+                log.warning("rescue: cannot read %s/%s (%s); retrying",
+                            item.namespace, item.name, e)
+                return None
+        if pod is None or is_pod_terminated(pod):
+            # The pod is gone (or done): the normal delete path frees the
+            # grant; drop the registry entry in case no watch is running.
+            self.s.gangs.drop_member(item.uid, tombstone=False)
+            self.s.pods.del_pod(item.uid)
+            self._done(item)
+            return {"kind": "rescued", "pod": item.name, "uid": item.uid,
+                    "reason": item.reason, "via": "pod-gone"}
+
+        if item.reason == "chip-quarantined" and self._bound(pod):
+            # Live node, broken chip: ask for a checkpointed exit first.
+            if item.asked_at is None:
+                if not self._ask_checkpoint(item):
+                    return None  # write failed; retry next sweep
+                return {"kind": "checkpoint-requested", "pod": item.name,
+                        "uid": item.uid, "reason": item.reason}
+            if now - item.asked_at < self.cfg.checkpoint_grace_s:
+                return None  # still within its grace window
+            log.warning("rescue: %s/%s did not exit within %.0fs of the "
+                        "checkpoint request; rescinding its grant",
+                        item.namespace, item.name,
+                        self.cfg.checkpoint_grace_s)
+
+        if not self._rescind(item):
+            return None
+        return {"kind": "rescued", "pod": item.name, "uid": item.uid,
+                "reason": item.reason, "via": "rescind"}
+
+    @staticmethod
+    def _bound(pod: dict) -> bool:
+        return bool(pod.get("spec", {}).get("nodeName"))
+
+    def _ask_checkpoint(self, item: RescueItem) -> bool:
+        from ..scheduler.preempt import PREEMPT_ANNOTATION
+
+        try:
+            self.s.client.patch_pod_annotations(
+                item.namespace, item.name,
+                {PREEMPT_ANNOTATION: RESCUE_VALUE_PREFIX + item.reason})
+        except NotFound:
+            return True  # gone already; next pass takes the pod-gone exit
+        except Exception as e:  # noqa: BLE001 — retried next sweep
+            log.warning("rescue: checkpoint request for %s/%s not "
+                        "written (%s)", item.namespace, item.name, e)
+            return False
+        with self._lock:
+            queued = self._queue.get(item.uid)
+            if queued is not None:
+                queued.asked_at = self._clock()
+        item.asked_at = self._clock()
+        log.warning("rescue: asked %s/%s to checkpoint and exit (%s)",
+                    item.namespace, item.name, item.reason)
+        return True
+
+    def _rescind(self, item: RescueItem) -> bool:
+        from ..scheduler.preempt import PREEMPT_ANNOTATION
+        from ..util import trace
+
+        # Empty values, not deletions — same portability rule as the
+        # preemption rescission path (strategic-merge key deletion is not
+        # reliable across clients); the informer treats an empty
+        # assigned-node as "no grant".
+        clear = {
+            ASSIGNED_NODE_ANNOTATION: "",
+            ASSIGNED_IDS_ANNOTATION: "",
+            TO_ALLOCATE_ANNOTATION: "",
+            BIND_PHASE_ANNOTATION: "",
+            PREEMPT_ANNOTATION: "",
+        }
+        if item.namespace and item.name:
+            try:
+                self.s.client.patch_pod_annotations(
+                    item.namespace, item.name, clear)
+            except NotFound:
+                pass
+            except Exception as e:  # noqa: BLE001 — grant must not outlive a half-rescind
+                log.warning("rescue: rescind patch for %s/%s failed "
+                            "(%s); retrying next sweep", item.namespace,
+                            item.name, e)
+                return False
+        self.s.gangs.drop_member(item.uid, tombstone=False)
+        self.s.pods.del_pod(item.uid)
+        self._done(item)
+        log.warning("rescued %s/%s off %s (%s): grant rescinded, pod "
+                    "will reschedule", item.namespace, item.name,
+                    item.node, item.reason)
+        trace.tracer().event(item.uid, "rescued", pod=item.name,
+                             node=item.node, reason=item.reason)
+        return True
+
+    def _done(self, item: RescueItem) -> None:
+        with self._lock:
+            if self._queue.pop(item.uid, None) is not None:
+                self.rescued_total += 1
+
+    # -- background thread -----------------------------------------------------
+    def start(self, interval_s: Optional[float] = None) -> None:
+        if self._thread is not None:
+            return
+        period = interval_s if interval_s is not None else self.cfg.interval_s
+
+        def loop() -> None:
+            while not self._stop.wait(period):
+                try:
+                    self.sweep()
+                except Exception:  # noqa: BLE001 — keep sweeping through glitches
+                    log.exception("rescue sweep failed")
+
+        self._thread = threading.Thread(target=loop, name="fleet-rescuer",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -19,6 +19,9 @@ from concurrent.futures import ThreadPoolExecutor
 from contextlib import nullcontext
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
+from ..health.lease import LeaseConfig, LeaseState, LeaseTracker
+from ..health.quarantine import ChipQuarantine, QuarantineConfig
+from ..health.rescuer import RESCUE_VALUE_PREFIX, RescueConfig, Rescuer
 from ..k8s.client import (
     Gone,
     KubeClient,
@@ -119,12 +122,40 @@ class SnapEntry(NamedTuple):
 
 
 class Scheduler:
-    def __init__(self, client: KubeClient, cfg: Optional[Config] = None) -> None:
+    def __init__(self, client: KubeClient, cfg: Optional[Config] = None,
+                 clock=None) -> None:
         self.client = client
         self.cfg = cfg or Config()
         self.nodes = NodeManager()
         self.pods = PodManager()
         self.gangs = GangManager()
+        # Fleet health subsystem (health/; docs/fault-tolerance.md).
+        # ``clock`` is injectable (time.monotonic by default) so the
+        # simulator and tests drive minutes-long failure scenarios
+        # deterministically in microseconds (health/faults.py SimClock).
+        self.leases = LeaseTracker(
+            LeaseConfig(ttl_s=self.cfg.lease_ttl_s,
+                        grace_beats=self.cfg.lease_grace_beats),
+            clock=clock)
+        # Quarantine flips bump the node's inventory rev (NodeManager.touch)
+        # so cached snapshot entries rebuild and in-flight optimistic
+        # commits fail their revision validation — the chip leaves the
+        # schedulable set atomically with respect to the commit protocol.
+        self.quarantine = ChipQuarantine(
+            QuarantineConfig(
+                flap_threshold=self.cfg.quarantine_flap_threshold,
+                flap_window_s=self.cfg.quarantine_flap_window_s,
+                probation_s=self.cfg.quarantine_probation_s),
+            clock=clock, on_change=self.nodes.touch)
+        # The rescue sweep is started by the daemon entrypoint
+        # (cmd/scheduler.py); embedders/tests call rescuer.sweep() directly.
+        self.rescuer = Rescuer(
+            self,
+            RescueConfig(
+                interval_s=self.cfg.rescue_interval_s,
+                checkpoint_grace_s=self.cfg.rescue_checkpoint_grace_s,
+                lease_retention_s=self.cfg.lease_retention_s),
+            clock=clock)
         # Optimistic-commit critical section: held ONLY to re-validate a
         # winning node's revision generation and record the grant (plus
         # the still-serialized gang admissions and the serial-baseline
@@ -214,17 +245,34 @@ class Scheduler:
             return t
 
     # -- registration stream (gRPC DeviceService.Register) --------------------
+    def observe_registration(self, node_name: str, info: NodeInfo) -> None:
+        """One registration-stream message, from the gRPC handler or any
+        replayer (benchmarks, the fault injector).  Every message is a
+        lease heartbeat and a per-chip health observation; the inventory
+        is replaced only when it actually changed, so the keepalive
+        cadence (deviceplugin/cache.py heartbeats) does not invalidate
+        the usage snapshot fleet-wide every beat interval."""
+        self.leases.beat(node_name)
+        self.quarantine.observe_node(
+            node_name, {d.id: d.health for d in info.devices})
+        if not self.nodes.same_inventory(node_name, info):
+            self.nodes.add_node(node_name, info)
+            log.info("registered node %s with %d chips", node_name,
+                     len(info.devices))
+
     def handle_register_stream(self, request_iterator, context=None) -> str:
         """Consume one node agent's stream; on disconnect, drop the node
-        (reference Register, scheduler.go:134–169)."""
+        (reference Register, scheduler.go:134–169).  The node's LEASE is
+        deliberately kept through the drop: agents reconnect within
+        seconds and the failure detector must not declare a blip Dead —
+        pods granted on the node keep their grants until the lease
+        actually expires (health/lease.py)."""
         node_name = ""
         try:
             for req in request_iterator:
                 node_name = req.node
-                info = decode_register_request(req)
-                self.nodes.add_node(node_name, info)
-                log.info("registered node %s with %d chips", node_name,
-                         len(info.devices))
+                self.observe_registration(node_name,
+                                          decode_register_request(req))
         finally:
             if node_name:
                 log.warning("register stream for %s closed; dropping node", node_name)
@@ -278,6 +326,21 @@ class Scheduler:
             return
         encoded = anns.get(ASSIGNED_IDS_ANNOTATION, "")
         if not encoded:
+            return
+        if self.nodes.get_node(node) is None and \
+                self.leases.state_of(node) is LeaseState.DEAD:
+            # Granted on a node whose inventory is gone AND whose lease
+            # has expired: re-adding (a watch replay, or resync's full
+            # re-list) would resurrect the grant into usage against
+            # hardware nobody can account for.  Route it to the rescuer
+            # instead — the rescind clears the stale decision so the pod
+            # can reschedule.  (A node with no lease record stays on the
+            # add path: embedders register inventory without heartbeats,
+            # and at boot the agents haven't connected yet.)
+            self.pods.del_pod(uid)
+            self.rescuer.enqueue(uid, "node-dead",
+                                 namespace=pod_namespace(pod),
+                                 name=pod_name(pod), node=node)
             return
         try:
             devices = codec.decode_pod_devices(encoded)
@@ -414,6 +477,12 @@ class Scheduler:
             requester = anns.get(PREEMPT_ANNOTATION)
             if not requester:
                 continue
+            if requester.startswith(RESCUE_VALUE_PREFIX):
+                # Rescuer-written eviction requests are not requester
+                # uids; their lifecycle (grace, rescind) belongs to the
+                # rescue sweep — reconciling them here would clear a
+                # checkpoint request mid-checkpoint.
+                continue
             req_pod = by_uid.get(requester)
             still_pending = (
                 req_pod is not None
@@ -498,8 +567,18 @@ class Scheduler:
             return None
         cached = self._usage_cache.get(name)
         if cached is None or cached[0] != key:
-            cached = (key, score_mod.build_usage(
-                info, self.pods.pods_on_node(name)))
+            usage = score_mod.build_usage(info, self.pods.pods_on_node(name))
+            quarantined = self.quarantine.quarantined_on(name)
+            if quarantined:
+                # Quarantined chips are stripped from the snapshot outright
+                # (not just health-flagged): no fit path — optimistic,
+                # serial, gang or preemption — can place on a chip it
+                # cannot see.  Safe against stale views because every
+                # quarantine/release bumped this node's rev (touch), so
+                # the key above already reflects the current set.
+                usage = {cid: u for cid, u in usage.items()
+                         if cid not in quarantined}
+            cached = (key, usage)
             self._usage_cache[name] = cached
         return SnapEntry(key, info, cached[1])
 
@@ -858,6 +937,11 @@ class Scheduler:
         best candidate a moment ago; accepting a refit placement on it
         trades a vanishing score delta for skipping an entire candidate
         sweep.  Bounded work under the lock: one node's chips."""
+        if self.leases.reject_reason(node) is not None:
+            # The node went Suspect/Dead between snapshot and commit:
+            # don't refit onto it — fail to the outer retry, which
+            # re-evaluates with the lease gate applied.
+            return None
         with self._usage_cache_lock:
             entry = self._refresh_entry_locked(node)
         if entry is None:
@@ -932,6 +1016,13 @@ class Scheduler:
             entry = snap.get(name)
             if entry is None:
                 failed[name] = "no TPU inventory registered"
+                continue
+            # Lease gate before any fit work: a Suspect/Dead node takes
+            # no NEW placements (existing grants stand until the lease
+            # is Dead and the rescuer acts — docs/fault-tolerance.md).
+            why = self.leases.reject_reason(name)
+            if why is not None:
+                failed[name] = why
                 continue
             # Prune before clone: a white/blacklist that excludes every
             # chip type on the node is decided on the shared snapshot —
@@ -1042,6 +1133,7 @@ class Scheduler:
         process — but embedders, benchmarks and test harnesses that
         build and discard Scheduler instances must call it or each
         instance leaks its pool threads until exit."""
+        self.rescuer.stop()
         with self._pool_lock:
             pool, self._pool = self._pool, None
             self._pool_unavailable = False
@@ -1090,8 +1182,13 @@ class Scheduler:
             for u in (*g.members, *g.placements)
         }
         offered = set(node_names)
+        # Suspect/Dead nodes are excluded here too: evicting victims to
+        # make room on a node that takes no new placements frees nothing
+        # the requester can use.
         entries = {name: (e.info, e.usage)
-                   for name, e in snap.items() if name in offered}
+                   for name, e in snap.items()
+                   if name in offered
+                   and self.leases.reject_reason(name) is None}
         return plan_preemption(
             requests, pod_priority(pod, self.cfg), entries,
             pods_by_node, anns, self.cfg.topology_policy,
@@ -1117,6 +1214,10 @@ class Scheduler:
             entry = snap.get(name)
             if entry is None:
                 failed[name] = "no TPU inventory registered"
+                continue
+            why_l = self.leases.reject_reason(name)
+            if why_l is not None:
+                failed[name] = why_l
                 continue
             # Prune before clone (the type white/blacklist reads no
             # usage — rejecting here skips the whole-chip-map copy).
@@ -1213,7 +1314,8 @@ class Scheduler:
         offered = set(node_names) if node_names else None
         usage = {n: (e.info, e.usage)
                  for n, e in self.snapshot().items()
-                 if offered is None or n in offered}
+                 if (offered is None or n in offered)
+                 and self.leases.reject_reason(n) is None}
         # For an admitted gang a quorum here means replacement members
         # filled freed slots: place ONLY them — the placed peers' grants
         # are already charged in the snapshot, and re-placing bound

@@ -109,9 +109,51 @@ class ClusterCollector(Collector):
         )
         busy_peak.add_metric([], self.scheduler.workers_busy_peak)
 
+        # Fleet health (health/; docs/fault-tolerance.md).  All reads are
+        # off the scheduler's locks (lease/quarantine/rescuer keep their
+        # own small ones) — same scrape-never-blocks-scheduling rule as
+        # inspect_all_nodes_usage.
+        lease_state = GaugeMetricFamily(
+            "vtpu_node_lease_state",
+            "Node heartbeat-lease state (0 healthy, 1 suspect = excluded "
+            "from new placements, 2 dead = grants being rescued)",
+            labels=["node"],
+        )
+        states = self.scheduler.leases.states()
+        for node, st in sorted(states.items()):
+            lease_state.add_metric([node], int(st))
+        leases_unhealthy = GaugeMetricFamily(
+            "vtpu_node_leases_unhealthy",
+            "Nodes whose lease is currently Suspect or Dead (many at once "
+            "is a lease-expiry storm: suspect a scheduler-side partition "
+            "or overload before believing in mass node death)",
+        )
+        leases_unhealthy.add_metric(
+            [], sum(1 for st in states.values() if int(st) > 0))
+        chips_quar = GaugeMetricFamily(
+            "vtpu_chips_quarantined",
+            "Chips currently quarantined out of the schedulable set "
+            "(flap damping / slice-neighbor containment)",
+        )
+        chips_quar.add_metric([], self.scheduler.quarantine.count())
+        quarantines = CounterMetricFamily(
+            "vtpu_chip_quarantines",
+            "Chip quarantine entries over this scheduler's lifetime",
+        )
+        quarantines.add_metric(
+            [], self.scheduler.quarantine.quarantines_total)
+        rescued = CounterMetricFamily(
+            "vtpu_rescued_pods",
+            "Grants rescinded by the rescue sweep (stranded on a dead "
+            "node, a quarantined chip, or vanished inventory); each one "
+            "forces a pod back through scheduling",
+        )
+        rescued.add_metric([], self.scheduler.rescuer.rescued_total)
+
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
                 pod_mem, pod_cores, preempts, conflicts, pool_size,
-                busy_peak] + list(phase_metrics())
+                busy_peak, lease_state, leases_unhealthy, chips_quar,
+                quarantines, rescued] + list(phase_metrics())
 
 
 def phase_metrics():

@@ -62,6 +62,29 @@ class NodeManager:
             if info.topology is not None:
                 existing.topology = info.topology
 
+    def same_inventory(self, name: str, info: NodeInfo) -> bool:
+        """True when ``info`` carries exactly the stored inventory (and
+        topology, when it sends one).  The register stream doubles as the
+        lease heartbeat channel (health/lease.py), so most messages are
+        keepalives — replacing the inventory for those would bump the rev
+        and invalidate the usage snapshot + fit cache fleet-wide every
+        beat interval for no state change."""
+        with self._lock:
+            cur = self._nodes.get(name)
+            if cur is None or cur.devices != info.devices:
+                return False
+            return info.topology is None or cur.topology == info.topology
+
+    def touch(self, name: str) -> None:
+        """Bump a node's revision for a placement-relevant change that is
+        NOT an inventory message — chip quarantine/release
+        (health/quarantine.py).  The bump invalidates cached snapshot
+        entries and fails any optimistic commit validated against the
+        pre-change generation, exactly like a re-registration would."""
+        with self._lock:
+            self._rev[name] = self._rev.get(name, 0) + 1
+            self._dirty.add(name)
+
     def rm_node(self, name: str) -> None:
         """Node agent stream broke → its inventory is no longer trustworthy
         (reference rmNodeDevice, nodes.go:283–305)."""

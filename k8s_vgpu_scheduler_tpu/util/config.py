@@ -81,6 +81,30 @@ class Config:
     # fully-locked decision (bounded retries ⇒ guaranteed convergence).
     commit_retries: int = 4
 
+    # Fleet health subsystem (health/; docs/fault-tolerance.md).
+    # Leases: seconds without a register-stream heartbeat before a node
+    # turns Suspect (no new placements), and how many MORE ttl periods a
+    # Suspect node gets before it is Dead and its grants are rescued.
+    lease_ttl_s: float = 15.0
+    lease_grace_beats: int = 2
+    # Chip quarantine flap damping: this many health flips inside the
+    # window quarantines the chip out of the snapshot until it has been
+    # continuously healthy for the probation period.
+    quarantine_flap_threshold: int = 3
+    quarantine_flap_window_s: float = 60.0
+    quarantine_probation_s: float = 30.0
+    # Rescue sweep: background period, and how long a checkpoint-requested
+    # victim on a quarantined chip gets to exit at a step boundary before
+    # its grant is rescinded from under it.
+    rescue_interval_s: float = 5.0
+    rescue_checkpoint_grace_s: float = 120.0
+    # How long a Dead lease is remembered (alert/gauge hygiene for
+    # decommissioned nodes) once nothing remains to rescue on it.
+    lease_retention_s: float = 900.0
+    # Gates the daemon's background rescue thread (cmd/scheduler.py);
+    # the failure detector and quarantine gating are always on.
+    enable_rescue: bool = True
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

@@ -1,0 +1,630 @@
+"""Fleet health subsystem (health/): leases, quarantine, rescue.
+
+Fast + deterministic (virtual clock, no jax, no sleeps) — this is the
+tier-1 face of the subsystem; the end-to-end chaos scenarios (seeded fault
+schedules, checkpointed-resume trajectories) live in tests/test_chaos.py
+behind the ``chaos`` marker.
+
+Pins the acceptance contract of ISSUE 3:
+
+- lease protocol: Healthy → Suspect → Dead on missed heartbeats, Suspect
+  takes no NEW grants but keeps existing ones, Dead hands pods to the
+  rescuer;
+- flap damping: K health flips inside the window quarantines a chip OUT of
+  the snapshot until a sustained-healthy probation elapses;
+- rescue: rescinds through the normal commit path (annotation clear +
+  usage-delta publish), checkpoint-first for live victims, and never
+  double-books a chip (the PR 2 invariant, re-asserted here under node
+  death);
+- the satellites: device-plugin health flips trigger full
+  re-registration + heartbeats, resync must not resurrect grants on dead
+  nodes, and ``add_node`` full-inventory-replace makes orphaned grants
+  rescuable.
+"""
+
+import threading
+
+from prometheus_client import CollectorRegistry, generate_latest
+
+from k8s_vgpu_scheduler_tpu.health import (
+    ChipQuarantine,
+    FaultInjector,
+    LeaseConfig,
+    LeaseState,
+    LeaseTracker,
+    QuarantineConfig,
+    SimClock,
+)
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import DeviceInfo, NodeInfo, Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.metrics import ClusterCollector
+from k8s_vgpu_scheduler_tpu.scheduler.preempt import PREEMPT_ANNOTATION
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import ASSIGNED_NODE_ANNOTATION
+
+from tests.test_scheduler_concurrency import assert_no_overallocation
+from tests.test_scheduler_core import tpu_pod
+
+CHIP_MIB = 16384
+
+
+def node_info(name, chips=4, devmem=CHIP_MIB, health=None):
+    devices = [
+        DeviceInfo(id=f"{name}-chip-{i}", count=10, devmem=devmem,
+                   type="TPU-v5e",
+                   health=True if health is None else health.get(
+                       f"{name}-chip-{i}", True),
+                   coords=(i, 0))
+        for i in range(chips)
+    ]
+    return NodeInfo(name=name, devices=devices,
+                    topology=TopologyDesc(generation="v5e", mesh=(chips, 1)))
+
+
+def make_env(n_nodes=2, chips=4, clock=None, **cfg_kwargs):
+    """Fleet registered THROUGH observe_registration (so leases track the
+    nodes), with the watch wired — the daemon's shape, minus threads."""
+    clock = clock or SimClock()
+    kube = FakeKube()
+    s = Scheduler(kube, Config(**cfg_kwargs), clock=clock)
+    names = [f"node-{i}" for i in range(n_nodes)]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        s.observe_registration(n, node_info(n, chips=chips))
+    kube.watch_pods(s.on_pod_event)
+    return kube, s, names, clock
+
+
+def beat_all(s, names, clock, dt=5.0, times=1):
+    for _ in range(times):
+        clock.advance(dt)
+        for n in names:
+            s.observe_registration(n, node_info(n))
+
+
+def place(kube, s, pod, names):
+    kube.create_pod(pod)
+    r = s.filter(pod, names)
+    assert r.node is not None, (r.error, r.failed)
+    return r
+
+
+class TestLeaseTracker:
+    def test_states_follow_heartbeat_age(self):
+        clock = SimClock()
+        lt = LeaseTracker(LeaseConfig(ttl_s=10.0, grace_beats=2),
+                          clock=clock)
+        assert lt.state_of("n") is None          # untracked == placeable
+        lt.beat("n")
+        assert lt.state_of("n") is LeaseState.HEALTHY
+        clock.advance(10.5)
+        assert lt.state_of("n") is LeaseState.SUSPECT
+        clock.advance(20.0)                       # past ttl*(1+grace)=30
+        assert lt.state_of("n") is LeaseState.DEAD
+        lt.beat("n")                              # agent came back
+        assert lt.state_of("n") is LeaseState.HEALTHY
+
+    def test_sweep_reports_each_transition_once(self):
+        clock = SimClock()
+        lt = LeaseTracker(LeaseConfig(ttl_s=10.0, grace_beats=1),
+                          clock=clock)
+        lt.beat("n")
+        assert lt.sweep() == []
+        clock.advance(11.0)
+        assert lt.sweep() == [("n", LeaseState.HEALTHY, LeaseState.SUSPECT)]
+        assert lt.sweep() == []                   # edge, not level
+        clock.advance(15.0)
+        assert lt.sweep() == [("n", LeaseState.SUSPECT, LeaseState.DEAD)]
+        lt.beat("n")
+        assert lt.sweep() == [("n", LeaseState.DEAD, LeaseState.HEALTHY)]
+
+    def test_reject_reason_token_is_low_cardinality(self):
+        clock = SimClock()
+        lt = LeaseTracker(LeaseConfig(ttl_s=10.0), clock=clock)
+        lt.beat("n")
+        assert lt.reject_reason("n") is None
+        clock.advance(12.0)
+        assert lt.reject_reason("n").startswith("lease-suspect:")
+        clock.advance(60.0)
+        assert lt.reject_reason("n").startswith("lease-dead:")
+
+    def test_error_counters_accumulate(self):
+        lt = LeaseTracker(clock=SimClock())
+        lt.beat("n", error_deltas={"c0": 2})
+        lt.beat("n", error_deltas={"c0": 3, "c1": 1})
+        assert lt.errors_of("n") == {"c0": 5, "c1": 1}
+
+
+class TestSuspectAndDead:
+    def test_suspect_node_takes_no_new_grants_but_keeps_existing(self):
+        """Acceptance: a Suspect node accepts no new grants but keeps
+        existing ones until Dead."""
+        kube, s, names, clock = make_env(lease_ttl_s=15.0,
+                                         lease_grace_beats=2)
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        victim_node = r.node
+        # Only the victim's agent goes quiet; the other keeps beating.
+        other = [n for n in names if n != victim_node][0]
+        for _ in range(4):
+            clock.advance(5.0)
+            s.observe_registration(other, node_info(other))
+        assert s.leases.state_of(victim_node) is LeaseState.SUSPECT
+        # Existing grant still stands — no rescue on Suspect.
+        s.rescuer.sweep()
+        assert s.pods.get("u1") is not None
+        assert s.pods.get("u1").node == victim_node
+        # New placements avoid the Suspect node.
+        r2 = place(kube, s, tpu_pod("p2", uid="u2", mem="4000"), names)
+        assert r2.node == other
+        assert "lease-suspect" in \
+            s.filter(tpu_pod("p3", uid="u3", mem="99999"),
+                     [victim_node]).failed.get(victim_node, "")
+
+    def test_dead_node_pods_are_rescued_and_replace_elsewhere(self):
+        kube, s, names, clock = make_env(lease_ttl_s=15.0,
+                                         lease_grace_beats=2)
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        victim_node, other = r.node, [n for n in names if n != r.node][0]
+        for _ in range(12):                         # 60s > dead_after=45s
+            clock.advance(5.0)
+            s.observe_registration(other, node_info(other))
+        assert s.leases.state_of(victim_node) is LeaseState.DEAD
+        actions = s.rescuer.sweep()
+        assert any(a.get("kind") == "rescued" and a.get("uid") == "u1"
+                   for a in actions)
+        assert s.pods.get("u1") is None
+        assert s.rescuer.rescued_total == 1
+        # The decision annotations were cleared through the commit path.
+        anns = kube.get_pod("default", "p1")["metadata"]["annotations"]
+        assert anns[ASSIGNED_NODE_ANNOTATION] == ""
+        # The pod re-places on the survivor; the dead node's inventory is
+        # gone so nothing can double-book it.
+        r2 = s.filter(kube.get_pod("default", "p1"), names)
+        assert r2.node == other
+        assert_no_overallocation(s)
+
+    def test_serial_filter_also_gates_on_lease(self):
+        kube, s, names, clock = make_env(optimistic_commit=False,
+                                         lease_ttl_s=15.0)
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        other = [n for n in names if n != r.node][0]
+        clock.advance(20.0)
+        s.observe_registration(other, node_info(other))
+        r2 = place(kube, s, tpu_pod("p2", uid="u2", mem="4000"), names)
+        assert r2.node == other
+
+    def test_dead_lease_forgotten_after_retention(self):
+        """A decommissioned node's Dead lease must eventually leave the
+        table (else the storm alert latches and gauge cardinality grows),
+        but only AFTER its grants were rescued and its inventory dropped."""
+        kube, s, names, clock = make_env(lease_retention_s=300.0)
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        dead = r.node
+        clock.advance(60.0)                          # both nodes die
+        s.rescuer.sweep()                            # rescue + rm_node
+        assert s.leases.state_of(dead) is LeaseState.DEAD
+        assert dead in s.leases.states()             # retained for now
+        clock.advance(301.0)
+        actions = s.rescuer.sweep()
+        assert any(a.get("kind") == "lease-forgotten" for a in actions)
+        assert dead not in s.leases.states()
+        assert s.leases.state_of(dead) is None       # fresh start if back
+
+    def test_lease_recovery_restores_placements(self):
+        kube, s, names, clock = make_env()
+        node = names[0]
+        clock.advance(60.0)
+        s.rescuer.sweep()                           # node-0 and node-1 die
+        assert s.nodes.get_node(node) is None
+        s.observe_registration(node, node_info(node))  # agent reconnects
+        assert s.leases.state_of(node) is LeaseState.HEALTHY
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), [node])
+        assert r.node == node
+
+
+class TestFlapDamping:
+    def test_flapping_chip_is_quarantined_until_probation(self):
+        """Acceptance: a chip flipping health K times within the window is
+        quarantined and does NOT re-enter the snapshot until probation
+        elapses."""
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=1, chips=2, clock=clock,
+                                         quarantine_flap_threshold=3,
+                                         quarantine_flap_window_s=60.0,
+                                         quarantine_probation_s=30.0)
+        node = names[0]
+        chip = f"{node}-chip-0"
+        health = {chip: True}
+        for healthy in (False, True, False):        # 3 flips
+            health[chip] = healthy
+            clock.advance(1.0)
+            s.observe_registration(node, node_info(node, chips=2,
+                                                   health=health))
+        assert s.quarantine.is_quarantined(node, chip)
+        assert chip not in s.snapshot()[node].usage
+        # Healthy beats resume, but probation has not elapsed: the chip
+        # must NOT come back — even though its health bit reads true.
+        health[chip] = True
+        for _ in range(4):
+            clock.advance(5.0)
+            s.observe_registration(node, node_info(node, chips=2,
+                                                   health=health))
+            s.quarantine.sweep()
+            assert chip not in s.snapshot()[node].usage
+        # Sustained-healthy probation elapses → released, back in the
+        # snapshot.
+        clock.advance(31.0)
+        s.observe_registration(node, node_info(node, chips=2, health=health))
+        assert s.quarantine.sweep() == [(node, chip)]
+        assert chip in s.snapshot()[node].usage
+
+    def test_unhealthy_during_probation_restarts_the_clock(self):
+        clock = SimClock()
+        q = ChipQuarantine(QuarantineConfig(probation_s=30.0), clock=clock)
+        q.quarantine("n", "c", "test")
+        clock.advance(25.0)
+        q.observe("n", "c", False)                  # bad again at t+25
+        clock.advance(10.0)                         # t+35 > 30, but...
+        assert q.sweep() == []                      # ...probation restarted
+        q.observe("n", "c", True)
+        clock.advance(31.0)
+        assert q.sweep() == [("n", "c")]
+
+    def test_filter_never_places_on_quarantined_chip(self):
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=1, chips=2, clock=clock)
+        node = names[0]
+        s.quarantine.quarantine(node, f"{node}-chip-0", "test")
+        for i in range(2):
+            r = place(kube, s,
+                      tpu_pod(f"p{i}", uid=f"u{i}", mem="6000"), names)
+            granted = {d.uuid for c in s.pods.get(f"u{i}").devices
+                       for d in c}
+            assert granted == {f"{node}-chip-1"}
+        # chip-1 has 4384 MiB left: a 9000 MiB pod must pend rather than
+        # touch the quarantined (empty, otherwise-perfect) chip-0.
+        kube.create_pod(tpu_pod("p2", uid="u2", mem="9000"))
+        assert s.filter(tpu_pod("p2", uid="u2", mem="9000"),
+                        names).node is None
+        assert_no_overallocation(s)
+
+    def test_quarantine_flip_invalidates_optimistic_snapshot(self):
+        """Rev-ordering interaction with the PR 2 commit protocol: a
+        quarantine landing after a snapshot was taken bumps the node's
+        rev (NodeManager.touch), so the stale snapshot cannot commit a
+        placement onto the now-quarantined chip."""
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=1, chips=1, clock=clock)
+        node = names[0]
+        snap = s.snapshot()
+        key_before = snap[node].key
+        s.quarantine.quarantine(node, f"{node}-chip-0", "test")
+        assert s.nodes.rev_of(node) == key_before[1] + 1
+        assert s.snapshot()[node].key != key_before
+        # A filter now finds no chip at all.
+        r = s.filter(tpu_pod("p", uid="u", mem="1000"), names)
+        assert r.node is None
+
+
+class TestRescuerQuarantinePath:
+    def _quarantined_env(self, **cfg):
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=2, chips=1, clock=clock,
+                                         **cfg)
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        # Bind so the victim counts as running (spec.nodeName set).
+        s.bind("default", "p1", "u1", r.node)
+        chip = f"{r.node}-chip-0"
+        s.quarantine.quarantine(r.node, chip, "test")
+        return kube, s, names, clock, r.node
+
+    def test_running_victim_gets_checkpoint_request_first(self):
+        kube, s, names, clock, node = self._quarantined_env(
+            rescue_checkpoint_grace_s=120.0)
+        actions = s.rescuer.sweep()
+        assert any(a["kind"] == "checkpoint-requested" for a in actions)
+        anns = kube.get_pod("default", "p1")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION].startswith("rescue:")
+        # Within grace: the grant stands (the victim is checkpointing).
+        assert s.pods.get("u1") is not None
+        # The victim exits on its own → normal delete path frees it.
+        kube.delete_pod("default", "p1")
+        s.rescuer.sweep()
+        assert s.pods.get("u1") is None
+        assert s.rescuer.rescued_total == 1
+        assert s.rescuer.pending() == {}
+
+    def test_wedged_victim_is_rescinded_after_grace(self):
+        kube, s, names, clock, node = self._quarantined_env(
+            rescue_checkpoint_grace_s=60.0)
+        s.rescuer.sweep()                            # writes the request
+        clock.advance(61.0)
+        actions = s.rescuer.sweep()
+        assert any(a.get("via") == "rescind" for a in actions)
+        assert s.pods.get("u1") is None
+
+    def test_resync_does_not_cancel_rescue_checkpoint_request(self):
+        """The rescuer's preempt value is not a requester uid; the
+        preemption-ledger reconciliation must leave it alone."""
+        kube, s, names, clock, node = self._quarantined_env()
+        s.rescuer.sweep()
+        s.resync_from_apiserver()
+        anns = kube.get_pod("default", "p1")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION].startswith("rescue:")
+
+    def test_multi_chip_grant_quarantines_slice_neighbors(self):
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=1, chips=4, clock=clock)
+        node = names[0]
+        r = place(kube, s, tpu_pod("g1", uid="ug", mem="2000", nums="2"),
+                  names)
+        granted = sorted({d.uuid for c in s.pods.get("ug").devices
+                          for d in c})
+        assert len(granted) == 2
+        s.quarantine.quarantine(node, granted[0], "test")
+        s.rescuer.sweep()
+        # The co-granted chip shares the broken slice: quarantined too.
+        assert s.quarantine.is_quarantined(node, granted[1])
+        assert s.pods.get("ug") is None              # grant rescued
+
+
+class TestResyncStrandedPod:
+    def test_resync_routes_dead_node_grants_to_rescuer(self):
+        """Satellite: a pod granted on a since-removed node must not be
+        resurrected into usage on resync — it goes to the rescue queue."""
+        kube, s, names, clock = make_env()
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        victim_node = r.node
+        other = [n for n in names if n != victim_node][0]
+        # Agent stream breaks (reference rm_node) AND the lease dies.
+        s.nodes.rm_node(victim_node)
+        for _ in range(12):
+            clock.advance(5.0)
+            s.observe_registration(other, node_info(other))
+        assert s.leases.state_of(victim_node) is LeaseState.DEAD
+        # Full resync replays the pod's ADDED with its stale grant.
+        s.resync_from_apiserver()
+        assert s.pods.get("u1") is None              # NOT resurrected
+        assert "u1" in s.rescuer.pending()
+        s.rescuer.sweep()
+        anns = kube.get_pod("default", "p1")["metadata"]["annotations"]
+        assert anns[ASSIGNED_NODE_ANNOTATION] == ""
+        assert s.rescuer.rescued_total == 1
+
+    def test_boot_resync_without_leases_keeps_grants(self):
+        """The guard must NOT fire for nodes with no lease record — at
+        boot the agents haven't connected yet and every grant would be
+        falsely rescued."""
+        kube, s, names, clock = make_env()
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        # Fresh scheduler (restart): same apiserver, no lease state.
+        s2 = Scheduler(kube, Config(), clock=clock)
+        s2.resync_from_apiserver()
+        assert s2.pods.get("u1") is not None
+        assert s2.pods.get("u1").node == r.node
+        s2.close()
+
+
+class TestAddNodeFullReplace:
+    def test_chip_absent_from_reregistration_is_gone_and_rescuable(self):
+        """Satellite: pins the deliberate deviation documented in
+        nodes.py — a re-registration REPLACES the inventory, a chip
+        absent from it disappears from the snapshot, and any grant
+        referencing it becomes rescuable."""
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=1, chips=2, clock=clock)
+        node = names[0]
+        r = place(kube, s, tpu_pod("p1", uid="u1", mem="4000"), names)
+        granted_chip = next(d.uuid for c in s.pods.get("u1").devices
+                            for d in c)
+        # Re-register with ONLY the other chip (died / un-enumerated).
+        keep = [d for d in node_info(node, chips=2).devices
+                if d.id != granted_chip]
+        s.observe_registration(node, NodeInfo(
+            name=node, devices=keep,
+            topology=TopologyDesc(generation="v5e", mesh=(2, 1))))
+        assert granted_chip not in s.snapshot()[node].usage
+        # The orphaned grant is found by the sweep and rescued.
+        s.rescuer.sweep()
+        assert s.pods.get("u1") is None
+        assert s.rescuer.rescued_total == 1
+        anns = kube.get_pod("default", "p1")["metadata"]["annotations"]
+        assert anns[ASSIGNED_NODE_ANNOTATION] == ""
+
+    def test_unchanged_reregistration_does_not_bump_rev(self):
+        """Heartbeat keepalives must not invalidate the snapshot."""
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=1, clock=clock)
+        node = names[0]
+        s.snapshot()
+        rev = s.nodes.rev_of(node)
+        for _ in range(5):
+            clock.advance(5.0)
+            s.observe_registration(node, node_info(node))
+        assert s.nodes.rev_of(node) == rev
+        assert s.leases.state_of(node) is LeaseState.HEALTHY
+
+
+class TestDeviceCacheHeartbeat:
+    """Satellite: the device plugin's health poll must trigger a full
+    re-registration on a flip (not just a log line) and a periodic
+    heartbeat when nothing changed."""
+
+    class _Backend:
+        def __init__(self):
+            from k8s_vgpu_scheduler_tpu.tpulib.types import (
+                ChipInfo, NodeInventory, TopologyDesc)
+
+            self.inv = NodeInventory(
+                chips=[ChipInfo(index=0, uuid="c0", type="TPU-v5e",
+                                hbm_mib=16384, coords=(0, 0))],
+                topology=TopologyDesc(generation="v5e", mesh=(1, 1)))
+            self.flip_next = False
+
+        def inventory(self):
+            return self.inv
+
+        def refresh_health(self, inv):
+            if self.flip_next:
+                self.flip_next = False
+                inv.chips[0].healthy = not inv.chips[0].healthy
+                return True
+            return False
+
+    def _cache(self, heartbeat_seconds=30.0):
+        from k8s_vgpu_scheduler_tpu.deviceplugin import DeviceCache
+
+        backend = self._Backend()
+        cache = DeviceCache(backend, poll_seconds=999,
+                            heartbeat_seconds=heartbeat_seconds)
+        notified = []
+        cache.subscribe("register", lambda inv: notified.append(
+            [c.healthy for c in inv.chips]), heartbeat=True)
+        return backend, cache, notified
+
+    def test_health_flip_triggers_full_reregistration(self):
+        backend, cache, notified = self._cache()
+        assert cache.poll_once(now=0.0) is False     # no change, no beat
+        backend.flip_next = True
+        assert cache.poll_once(now=1.0) is True      # flip → immediate
+        assert notified == [[False]]
+
+    def test_heartbeat_rebroadcasts_unchanged_inventory(self):
+        backend, cache, notified = self._cache(heartbeat_seconds=30.0)
+        cache._last_broadcast = 0.0
+        assert cache.poll_once(now=10.0) is False    # quiet, not due
+        assert cache.poll_once(now=31.0) is True     # beat due
+        assert cache.poll_once(now=40.0) is False    # next beat at 61
+        assert len(notified) == 1
+
+    def test_zero_heartbeat_disables_keepalive(self):
+        backend, cache, notified = self._cache(heartbeat_seconds=0)
+        cache._last_broadcast = 0.0
+        assert cache.poll_once(now=1e9) is False
+        assert notified == []
+
+    def test_keepalive_skips_flip_only_subscribers(self):
+        """The kubelet/annotation feeds must see real changes ONLY — a
+        keepalive fanned out to them would re-send device lists and
+        re-PATCH node annotations once per beat, fleet-wide, forever."""
+        backend, cache, beats = self._cache(heartbeat_seconds=30.0)
+        flips = []
+        cache.subscribe("plugin", lambda inv: flips.append(1))  # no beat
+        cache._last_broadcast = 0.0
+        assert cache.poll_once(now=31.0) is True     # keepalive
+        assert (len(beats), len(flips)) == (1, 0)
+        backend.flip_next = True
+        assert cache.poll_once(now=32.0) is True     # real change
+        assert (len(beats), len(flips)) == (2, 1)
+
+    def test_failed_health_refresh_still_beats(self):
+        """A broken health probe must not silence the keepalive — the
+        agent is alive, and a silent agent gets its node declared Dead
+        and every grant on it rescinded."""
+        backend, cache, beats = self._cache(heartbeat_seconds=30.0)
+
+        def boom(inv):
+            raise RuntimeError("probe glitch")
+
+        backend.refresh_health = boom
+        cache._last_broadcast = 0.0
+        assert cache.poll_once(now=31.0) is True
+        assert len(beats) == 1
+
+
+class TestFaultInjector:
+    def test_random_plan_is_deterministic_per_seed(self):
+        clock = SimClock()
+        kube, s, names, clock = make_env(clock=clock)
+        make = lambda seed: FaultInjector(s, clock, seed=seed)  # noqa: E731
+        a, b = make(7), make(7)
+        a.attach(), b.attach()
+        assert a.random_plan(10) == b.random_plan(10)
+        c = make(8)
+        c.attach()
+        assert c.random_plan(10) != a.random_plan(10)
+
+    def test_partition_and_heal_roundtrip(self):
+        clock = SimClock()
+        kube, s, names, clock = make_env(clock=clock)
+        inj = FaultInjector(s, clock, seed=0)
+        inj.attach()
+        inj.partition_node(names[0])
+        inj.tick(60.0)
+        assert s.leases.state_of(names[0]) is LeaseState.DEAD
+        assert s.leases.state_of(names[1]) is LeaseState.HEALTHY
+        inj.heal_node(names[0])
+        assert s.leases.state_of(names[0]) is LeaseState.HEALTHY
+
+
+class TestHealthMetrics:
+    def test_collector_exposes_fleet_health_series(self):
+        kube, s, names, clock = make_env()
+        clock.advance(20.0)                          # node leases → Suspect
+        s.quarantine.quarantine(names[0], f"{names[0]}-chip-0", "test")
+        registry = CollectorRegistry()
+        registry.register(ClusterCollector(s))
+        text = generate_latest(registry).decode()
+        assert 'vtpu_node_lease_state{node="node-0"} 1.0' in text
+        assert "vtpu_node_leases_unhealthy 2.0" in text
+        assert "vtpu_chips_quarantined 1.0" in text
+        assert "vtpu_chip_quarantines_total 1.0" in text
+        assert "vtpu_rescued_pods_total 0.0" in text
+        s.close()
+
+
+class TestRescueConcurrencyInvariant:
+    def test_concurrent_filters_during_node_death_never_overbook(self):
+        """PR 2 invariant suite extension: racing Filters while a node's
+        lease dies and the rescuer rescinds its grants — through any
+        interleaving, no chip exceeds its advertised totals."""
+        clock = SimClock()
+        kube, s, names, clock = make_env(n_nodes=4, chips=4, clock=clock)
+        errors = []
+        stop = threading.Event()
+        barrier = threading.Barrier(5)
+
+        def submitter(t):
+            barrier.wait()
+            for i in range(20):
+                uid = f"t{t}u{i}"
+                pod = tpu_pod(f"t{t}p{i}", uid=uid,
+                              mem=("4000", "8000", "2000")[i % 3])
+                try:
+                    kube.create_pod(pod)
+                    s.filter(pod, names)
+                    assert_no_overallocation(s)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        def chaos():
+            barrier.wait()
+            try:
+                # node-0's agent goes silent; everyone else keeps beating.
+                for _ in range(15):
+                    clock.advance(5.0)
+                    for n in names[1:]:
+                        s.observe_registration(n, node_info(n))
+                    s.rescuer.sweep()
+                    assert_no_overallocation(s)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                stop.set()
+
+        threads = [threading.Thread(target=submitter, args=(t,))
+                   for t in range(4)] + [threading.Thread(target=chaos)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+            assert not th.is_alive()
+        assert not errors, errors[0]
+        assert s.leases.state_of(names[0]) is LeaseState.DEAD
+        # Every grant that survived lives on a live node.
+        for info in s.pods.list_pods():
+            assert info.node != names[0]
+        assert_no_overallocation(s)
+        s.close()

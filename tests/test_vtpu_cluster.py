@@ -48,6 +48,18 @@ class _SchedulerStub:
     workers_busy_peak = 5
 
     def __init__(self):
+        # Real fleet-health components (not stubs): the collector reads
+        # leases.states() / quarantine counters / rescuer.rescued_total,
+        # and using the real objects breaks this test if that surface
+        # drifts.  Rescuer only dereferences the scheduler inside sweep(),
+        # which the collector never calls.
+        from k8s_vgpu_scheduler_tpu.health import (
+            ChipQuarantine, LeaseTracker, Rescuer)
+
+        self.leases = LeaseTracker()
+        self.leases.beat("node-a")
+        self.quarantine = ChipQuarantine()
+        self.rescuer = Rescuer(self)
         self.pods = _Pods([
             PodInfo(uid="u1", name="train-a", namespace="default",
                     node="node-a",
