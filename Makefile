@@ -1,8 +1,8 @@
 # Development entrypoints (the reference drives everything through
 # hack/build.sh + a Makefile; here each surface is one target).
 
-.PHONY: all native test test-fast test-slow chaos-smoke dryrun scenarios \
-        controlplane bench-controlplane bench wheel clean
+.PHONY: all native test test-fast test-slow chaos-smoke lint-dashboards \
+        dryrun scenarios controlplane bench-controlplane bench wheel clean
 
 all: native
 
@@ -24,6 +24,14 @@ test-slow: native             ## model/parallelism tier (compiles networks)
 # clock, fixed seeds), so a failure here is a real regression, not flake.
 chaos-smoke: native           ## fault-injection suite in the simulator
 	python -m pytest tests/ -q -m chaos
+
+# Dashboard/alert ↔ code pinning, standalone (the same tests also run in
+# the default tier): every panel/alert expression must name a metric a
+# collector actually registers, and every registered metric must be
+# dashboarded or allowlisted with a reason (tests/test_vtpu_cluster.py).
+lint-dashboards:              ## validate Grafana panels + alert rules vs code
+	python -m pytest tests/test_vtpu_cluster.py -q \
+	    -k "dashboard or alert or emitted"
 
 # dryrun_multichip pins the CPU platform + device count itself,
 # appending to (not clobbering) any user-set XLA_FLAGS.

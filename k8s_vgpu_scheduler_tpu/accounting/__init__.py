@@ -1,0 +1,33 @@
+"""Fleet utilization accounting (docs/observability.md §accounting).
+
+The reference monitor only *exposes* instantaneous per-container usage
+(cmd/vGPUmonitor/metrics.go); nothing aggregates it over time or compares
+it to what the scheduler *granted* — so the classic vGPU failure mode
+(pods holding 60% of a chip while using 5%) is invisible.  This package
+is the Borg/Autopilot-style usage-vs-request loop:
+
+- :mod:`sampler` — node side: integrates each shared region's duty cycle
+  and HBM occupancy into monotonic per-container counters (chip-seconds,
+  HBM-byte-seconds, throttled-seconds, oversub-spill-seconds) on the
+  monitor's existing FeedbackLoop tick;
+- :mod:`ledger` — scheduler side: durable per-pod accounts built from the
+  counters each node piggybacks on its register-stream heartbeats, with
+  ring-buffered time series for windowed showback;
+- :mod:`efficiency` — the join: ledger actuals against live grants in the
+  registry → per-pod efficiency scores, idle-grant findings, and the
+  optional ``--score-by-actual`` placement signal.
+"""
+
+from .efficiency import EfficiencyConfig, FleetEfficiency, PodEfficiency
+from .ledger import PodAccount, UsageLedger
+from .sampler import USAGE_FIELDS, UsageSampler
+
+__all__ = [
+    "EfficiencyConfig",
+    "FleetEfficiency",
+    "PodAccount",
+    "PodEfficiency",
+    "USAGE_FIELDS",
+    "UsageLedger",
+    "UsageSampler",
+]

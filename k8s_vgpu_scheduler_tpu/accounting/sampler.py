@@ -1,0 +1,157 @@
+"""Node-side usage metering: shared-region samples → monotonic counters.
+
+Rides the monitor's existing FeedbackLoop tick (cmd/monitor.py calls
+:meth:`UsageSampler.sample` right after ``loop.tick()``): each sample
+integrates one tick interval into per-container counters —
+
+- **chip-seconds**: elapsed time × chips held, credited only when the
+  container dispatched during the interval (the feedback loop's
+  ``age_kernel`` census, the same duty signal the priority throttle keys
+  on);
+- **HBM-byte-seconds**: elapsed time × bytes currently accounted in the
+  region (right-rectangle integration of occupancy);
+- **throttled-seconds**: time spent with the priority utilization switch
+  engaged (borrowed-compute time reclaimed by a higher-priority sharer);
+- **oversub-spill-seconds**: active time under an oversubscribed grant —
+  the window in which host-RAM spills can occur.
+
+Counters live HERE, keyed by container key, never inside the region: a
+workload SIGKILL, a slot GC (feedback.py) or an in-place container
+restart resets the region's instantaneous fields but can only stop the
+integrals from growing, never rewind them.  A container first seen this
+tick gets no credit for the interval (nobody observed it), and a key that
+vanishes is retained for ``retention_s`` so its final totals still reach
+one more report before GC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+#: Field names shared by every transport of a counter row (the noderpc
+#: ReportUsage piggyback, the register-stream usage field, the ledger's
+#: record input) — one tuple so encoders/decoders cannot drift.
+USAGE_FIELDS = (
+    "ctrkey", "chips", "active", "oversubscribe", "chip_seconds",
+    "hbm_byte_seconds", "throttled_seconds", "oversub_spill_seconds",
+    "window_s",
+)
+
+
+@dataclasses.dataclass
+class CounterSet:
+    """One container's monotonic usage integrals plus its last observed
+    instantaneous state (the latter rides along so consumers get
+    busy/oversub flags without a second data path)."""
+
+    first_seen: float
+    last_seen: float
+    chips: int = 0
+    active: bool = False
+    oversubscribe: bool = False
+    chip_seconds: float = 0.0
+    hbm_byte_seconds: float = 0.0
+    throttled_seconds: float = 0.0
+    oversub_spill_seconds: float = 0.0
+
+    def row(self, key: str) -> dict:
+        return {
+            "ctrkey": key,
+            "chips": self.chips,
+            "active": self.active,
+            "oversubscribe": self.oversubscribe,
+            "chip_seconds": self.chip_seconds,
+            "hbm_byte_seconds": self.hbm_byte_seconds,
+            "throttled_seconds": self.throttled_seconds,
+            "oversub_spill_seconds": self.oversub_spill_seconds,
+            "window_s": self.last_seen - self.first_seen,
+        }
+
+
+class UsageSampler:
+    def __init__(self, loop, clock=time.monotonic,
+                 retention_s: float = 300.0) -> None:
+        self.loop = loop  # FeedbackLoop (or any .lock + .containers duck)
+        self._clock = clock
+        self.retention_s = retention_s
+        # Own lock (not the loop's): snapshot() is called from the
+        # metrics/noderpc threads while sample() runs on the tick thread,
+        # and holding the loop lock across both would couple a Prometheus
+        # scrape to the region rescan.
+        self._lock = threading.Lock()
+        self._counters: Dict[str, CounterSet] = {}
+        self._last_sample: Optional[float] = None
+
+    def sample(self, now: Optional[float] = None) -> int:
+        """Integrate one tick interval; returns the number of containers
+        credited.  Region reads happen under the loop lock (rescan()
+        munmaps regions); the arithmetic happens under the sampler's own
+        lock only."""
+        now = self._clock() if now is None else now
+        rows = []
+        with self.loop.lock:
+            for key, state in self.loop.containers.items():
+                region = state.region
+                try:
+                    n = region.num_devices
+                    used = sum(region.used(i) for i in range(n))
+                    rows.append((key, n, bool(state.active),
+                                 bool(region.utilization_switch),
+                                 bool(region.oversubscribe), used))
+                except Exception:  # noqa: BLE001 — region unmapped mid-read
+                    continue
+        with self._lock:
+            dt = (0.0 if self._last_sample is None
+                  else max(0.0, now - self._last_sample))
+            self._last_sample = now
+            seen = set()
+            credited = 0
+            for key, chips, active, throttled, oversub, used in rows:
+                seen.add(key)
+                cs = self._counters.get(key)
+                if cs is None:
+                    # First observation: record instantaneous state only —
+                    # crediting dt would meter an interval nobody watched.
+                    self._counters[key] = CounterSet(
+                        first_seen=now, last_seen=now, chips=chips,
+                        active=active, oversubscribe=oversub)
+                    continue
+                if active:
+                    # ``active`` means "dispatched since the previous
+                    # tick" (age_kernel census), so it describes exactly
+                    # the interval being credited.
+                    cs.chip_seconds += dt * chips
+                    if oversub:
+                        cs.oversub_spill_seconds += dt
+                cs.hbm_byte_seconds += dt * used
+                if throttled:
+                    cs.throttled_seconds += dt
+                cs.chips = chips
+                cs.active = active
+                cs.oversubscribe = oversub
+                cs.last_seen = now
+                credited += 1
+            # GC: a key gone past retention has had retention_s worth of
+            # reports carrying its final totals; dropping it bounds the
+            # map under pod churn.
+            for key in [k for k, cs in self._counters.items()
+                        if k not in seen
+                        and now - cs.last_seen > self.retention_s]:
+                del self._counters[key]
+            return credited
+
+    def snapshot(self) -> List[dict]:
+        """Current counter rows (USAGE_FIELDS shape), including
+        recently-ended containers still inside the retention window —
+        sorted by key so reports are deterministic."""
+        with self._lock:
+            return [cs.row(key)
+                    for key, cs in sorted(self._counters.items())]
+
+    def get(self, key: str) -> Optional[CounterSet]:
+        with self._lock:
+            cs = self._counters.get(key)
+            return dataclasses.replace(cs) if cs is not None else None

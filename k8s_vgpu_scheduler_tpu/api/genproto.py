@@ -1,0 +1,207 @@
+"""Regenerate the checked-in ``*_pb2.py`` modules without protoc.
+
+The build image ships neither ``protoc`` nor ``grpc_tools`` (see
+api/service.py), so schema changes cannot go through the normal protobuf
+toolchain.  Instead the wire schemas are declared here as
+``FileDescriptorProto`` structures — the exact intermediate form protoc
+itself serializes into generated modules — and serialized into the same
+``AddSerializedFile`` byte blobs a real protoc run would emit.  Run after
+editing a schema:
+
+    python -m k8s_vgpu_scheduler_tpu.api.genproto
+
+The declarations below ARE the .proto sources of truth for this repo;
+keep field numbers append-only (both ends of the register stream and the
+noderpc service tolerate unknown fields, so rolling upgrades only work if
+existing numbers never change meaning).
+"""
+
+from __future__ import annotations
+
+import os
+
+from google.protobuf import descriptor_pb2 as dp
+
+_TYPE = dp.FieldDescriptorProto
+_OPT = _TYPE.LABEL_OPTIONAL
+_REP = _TYPE.LABEL_REPEATED
+
+
+def _field(name: str, number: int, ftype, label=_OPT,
+           type_name: str = "") -> dp.FieldDescriptorProto:
+    f = dp.FieldDescriptorProto(name=name, number=number, type=ftype,
+                                label=label)
+    if type_name:
+        f.type_name = type_name
+    return f
+
+
+def _usage_counters_fields():
+    """Per-container accounting counters (accounting/sampler.py): shared
+    shape between the noderpc ReportUsage piggyback and the register
+    stream's usage field, declared once so the two packages cannot
+    drift."""
+    return [
+        _field("ctrkey", 1, _TYPE.TYPE_STRING),
+        _field("chips", 2, _TYPE.TYPE_INT32),
+        _field("active", 3, _TYPE.TYPE_BOOL),
+        _field("oversubscribe", 4, _TYPE.TYPE_BOOL),
+        _field("chip_seconds", 5, _TYPE.TYPE_DOUBLE),
+        _field("hbm_byte_seconds", 6, _TYPE.TYPE_DOUBLE),
+        _field("throttled_seconds", 7, _TYPE.TYPE_DOUBLE),
+        _field("oversub_spill_seconds", 8, _TYPE.TYPE_DOUBLE),
+        _field("window_s", 9, _TYPE.TYPE_DOUBLE),
+    ]
+
+
+def noderpc_file() -> dp.FileDescriptorProto:
+    f = dp.FileDescriptorProto(name="noderpc.proto", package="vtpu.noderpc",
+                               syntax="proto3")
+    msg = f.message_type.add(name="ProcSlot")
+    msg.field.append(_field("pid", 1, _TYPE.TYPE_INT32))
+
+    msg = f.message_type.add(name="RegionInfo")
+    msg.field.extend([
+        _field("uuids", 1, _TYPE.TYPE_STRING, _REP),
+        _field("limit", 2, _TYPE.TYPE_UINT64, _REP),
+        _field("sm_limit", 3, _TYPE.TYPE_UINT64, _REP),
+        # Per-device ACTUAL occupancy, alongside the cap — a reader must
+        # not need to mmap the region itself to see usage.
+        _field("used", 4, _TYPE.TYPE_UINT64, _REP),
+        _field("priority", 5, _TYPE.TYPE_INT32),
+        _field("utilization_switch", 6, _TYPE.TYPE_INT32),
+        _field("oversubscribe", 7, _TYPE.TYPE_INT32),
+        _field("procs", 8, _TYPE.TYPE_MESSAGE, _REP,
+               ".vtpu.noderpc.ProcSlot"),
+    ])
+
+    msg = f.message_type.add(name="UsageCounters")
+    msg.field.extend(_usage_counters_fields())
+
+    msg = f.message_type.add(name="ReportUsage")
+    msg.field.extend([
+        _field("nodeid", 1, _TYPE.TYPE_STRING),
+        _field("counters", 2, _TYPE.TYPE_MESSAGE, _REP,
+               ".vtpu.noderpc.UsageCounters"),
+    ])
+
+    msg = f.message_type.add(name="PodUsage")
+    msg.field.extend([
+        _field("ctrkey", 1, _TYPE.TYPE_STRING),
+        _field("info", 2, _TYPE.TYPE_MESSAGE, _OPT,
+               ".vtpu.noderpc.RegionInfo"),
+    ])
+
+    msg = f.message_type.add(name="GetNodeTPURequest")
+    msg.field.append(_field("ctrkey", 1, _TYPE.TYPE_STRING))
+    # usage_only=true skips the per-region snapshots (taken under the
+    # feedback loop's lock) and answers with just the sampler counters —
+    # the device plugin's per-heartbeat fetch wants nothing else.
+    msg.field.append(_field("usage_only", 2, _TYPE.TYPE_BOOL))
+
+    msg = f.message_type.add(name="GetNodeTPUReply")
+    msg.field.extend([
+        _field("nodeid", 1, _TYPE.TYPE_STRING),
+        _field("usages", 2, _TYPE.TYPE_MESSAGE, _REP,
+               ".vtpu.noderpc.PodUsage"),
+        # Accounting piggyback: the same GetNodeTPU round-trip carries the
+        # sampler's monotonic counters — consumers that only want RegionInfo
+        # snapshots ignore it (unknown-field tolerant).
+        _field("usage", 3, _TYPE.TYPE_MESSAGE, _OPT,
+               ".vtpu.noderpc.ReportUsage"),
+    ])
+
+    svc = f.service.add(name="NodeTPUInfo")
+    svc.method.add(name="GetNodeTPU",
+                   input_type=".vtpu.noderpc.GetNodeTPURequest",
+                   output_type=".vtpu.noderpc.GetNodeTPUReply")
+    return f
+
+
+def device_register_file() -> dp.FileDescriptorProto:
+    f = dp.FileDescriptorProto(
+        name="k8s_vgpu_scheduler_tpu/api/device_register.proto",
+        package="vtpu.api", syntax="proto3")
+
+    msg = f.message_type.add(name="ChipDevice")
+    msg.field.extend([
+        _field("id", 1, _TYPE.TYPE_STRING),
+        _field("count", 2, _TYPE.TYPE_INT32),
+        _field("devmem", 3, _TYPE.TYPE_INT32),
+        _field("type", 4, _TYPE.TYPE_STRING),
+        _field("health", 5, _TYPE.TYPE_BOOL),
+        _field("coords", 6, _TYPE.TYPE_INT32, _REP),
+        _field("cores", 7, _TYPE.TYPE_INT32),
+    ])
+
+    msg = f.message_type.add(name="Topology")
+    msg.field.extend([
+        _field("generation", 1, _TYPE.TYPE_STRING),
+        _field("mesh", 2, _TYPE.TYPE_INT32, _REP),
+        _field("wraparound", 3, _TYPE.TYPE_BOOL, _REP),
+    ])
+
+    msg = f.message_type.add(name="UsageCounters")
+    msg.field.extend(_usage_counters_fields())
+
+    msg = f.message_type.add(name="RegisterRequest")
+    msg.field.extend([
+        _field("node", 1, _TYPE.TYPE_STRING),
+        _field("devices", 2, _TYPE.TYPE_MESSAGE, _REP,
+               ".vtpu.api.ChipDevice"),
+        _field("topology", 3, _TYPE.TYPE_MESSAGE, _OPT,
+               ".vtpu.api.Topology"),
+        # Usage piggyback on the register stream: every heartbeat carries
+        # the node's latest per-container counters, so the scheduler's
+        # ledger rides the one connection that already exists.
+        _field("usage", 4, _TYPE.TYPE_MESSAGE, _REP,
+               ".vtpu.api.UsageCounters"),
+    ])
+
+    msg = f.message_type.add(name="RegisterReply")
+    msg.field.append(_field("message", 1, _TYPE.TYPE_STRING))
+
+    svc = f.service.add(name="DeviceService")
+    m = svc.method.add(name="Register",
+                       input_type=".vtpu.api.RegisterRequest",
+                       output_type=".vtpu.api.RegisterReply")
+    m.client_streaming = True
+    return f
+
+
+_TEMPLATE = '''# -*- coding: utf-8 -*-
+# Generated by k8s_vgpu_scheduler_tpu/api/genproto.py — DO NOT EDIT BY HAND.
+# source: {source}
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+
+_sym_db = _symbol_database.Default()
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({blob!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, {module!r}, globals())
+'''
+
+
+def generate(out_dir: str | None = None) -> None:
+    out_dir = out_dir or os.path.dirname(os.path.abspath(__file__))
+    for fdp, module, fname in (
+        (noderpc_file(), "noderpc_pb2", "noderpc_pb2.py"),
+        (device_register_file(),
+         "k8s_vgpu_scheduler_tpu.api.device_register_pb2",
+         "device_register_pb2.py"),
+    ):
+        path = os.path.join(out_dir, fname)
+        with open(path, "w") as f:
+            f.write(_TEMPLATE.format(source=fdp.name,
+                                     blob=fdp.SerializeToString(),
+                                     module=module))
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    generate()

@@ -57,6 +57,12 @@ def parse_args(argv=None):
                         "the scheduler's lease beat (docs/fault-tolerance"
                         ".md); must stay well under the scheduler's "
                         "--lease-ttl; 0 disables heartbeats")
+    p.add_argument("--usage-from", default="127.0.0.1:9395",
+                   help="co-located monitor's noderpc endpoint; each "
+                        "register-stream heartbeat piggybacks the usage "
+                        "counters fetched here, feeding the scheduler's "
+                        "accounting ledger (docs/observability.md); "
+                        "empty disables usage reporting")
     p.add_argument("--socket-dir", default="/var/lib/kubelet/device-plugins")
     p.add_argument("--debug-port", type=int, default=0,
                    help="loopback /debug endpoints incl. tracez/events — "
@@ -136,7 +142,12 @@ def main(argv=None):
     whole_inv = whole_chip_view(cache.inventory, cfg)
     plugin = TpuDevicePlugin(client, whole_inv, cfg,
                              socket_dir=args.socket_dir)
-    register = DeviceRegister(backend, cfg)
+    from ..deviceplugin.register import monitor_usage_source
+
+    register = DeviceRegister(
+        backend, cfg,
+        usage_source=(monitor_usage_source(args.usage_from)
+                      if args.usage_from else None))
 
     def on_health_change(inv):
         plugin.notify_health_changed()

@@ -11,6 +11,7 @@ import logging
 import os
 import time
 
+from ..accounting.sampler import UsageSampler
 from ..monitor.feedback import FeedbackLoop
 from ..monitor.metrics import start_metrics_server
 from ..tpulib import detect
@@ -53,7 +54,12 @@ def main(argv=None):
             logging.exception("chip backend unavailable; continuing without")
     loop = FeedbackLoop(args.container_root)
     node = args.node_name or os.uname().nodename
-    start_metrics_server(loop, backend, node, args.metrics_port)
+    # Usage metering rides the same tick as the feedback loop; its
+    # counters feed the :9394 exporter, the noderpc ReportUsage piggyback,
+    # and (via the device plugin's register stream) the scheduler ledger.
+    sampler = UsageSampler(loop)
+    start_metrics_server(loop, backend, node, args.metrics_port,
+                         sampler=sampler)
     if args.debug_port:
         from ..util.debugz import DebugServer
 
@@ -62,7 +68,7 @@ def main(argv=None):
     if args.grpc_port:
         from ..monitor.noderpc import NodeTPUInfoServer
 
-        rpc = NodeTPUInfoServer(loop, node)
+        rpc = NodeTPUInfoServer(loop, node, sampler=sampler)
         rpc.serve(args.grpc_port, args.grpc_bind)
     logging.info("vtpu-monitor up: root=%s metrics=:%d grpc=:%d",
                  args.container_root, args.metrics_port, args.grpc_port)
@@ -75,6 +81,7 @@ def main(argv=None):
                 # monitor's /debug/tracez (--debug-port).
                 with trace.tracer().span("region-scan") as sp:
                     loop.tick()
+                    sampler.sample()
                     sp.set("containers", len(loop.containers))
             except Exception:
                 logging.exception("feedback tick failed")

@@ -97,6 +97,20 @@ def parse_args(argv=None):
                    help="seconds a Dead lease is remembered once nothing "
                         "remains to rescue on the node (then its metrics "
                         "series and storm-alert contribution drop)")
+    # Fleet utilization accounting (accounting/; docs/observability.md).
+    p.add_argument("--score-by-actual", action="store_true",
+                   help="bias candidate selection toward nodes whose "
+                        "MEASURED utilization (ledger usage reports) is "
+                        "low — packs against actual, not just granted, "
+                        "capacity; requires node monitors reporting usage")
+    p.add_argument("--efficiency-window", type=float, default=300.0,
+                   help="trailing window (seconds) for the granted-vs-"
+                        "actual efficiency join (vtpu_grant_efficiency_"
+                        "ratio, /usagez default window)")
+    p.add_argument("--idle-grant-grace", type=float, default=600.0,
+                   help="seconds a grant must accrue ~no chip-seconds "
+                        "before it is surfaced as an idle grant "
+                        "(vtpu_idle_grants; flagged, never evicted)")
     p.add_argument("--no-rescue", action="store_true",
                    help="disable the background rescue sweep (failure "
                         "detection and quarantine gating stay on; grants "
@@ -168,6 +182,9 @@ def build_config(args) -> Config:
         rescue_checkpoint_grace_s=args.rescue_checkpoint_grace,
         lease_retention_s=args.lease_retention,
         enable_rescue=not args.no_rescue,
+        score_by_actual=args.score_by_actual,
+        efficiency_window_s=args.efficiency_window,
+        idle_grant_grace_s=args.idle_grant_grace,
     )
 
 

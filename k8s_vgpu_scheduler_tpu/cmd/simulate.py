@@ -23,6 +23,17 @@ Workload spec (JSON):
 ``gang`` members are co-scheduled atomically through the gang manager,
 exactly as on a cluster.
 
+A workload may also carry an ``accounting`` section — after placement, the
+REAL metering pipeline (accounting/sampler.py over synthetic regions →
+scheduler ledger → efficiency join) replays each pod's declared duty cycle
+on a virtual clock and reports metered vs simulated chip-seconds (they
+must agree within 5%), per-pod efficiency, and which pods surface as idle
+grants:
+
+    {"pods": [{"name": "train", "count": 2, "tpu": 2, "duty": 0.9},
+              {"name": "squatter", "count": 1, "tpu": 4, "duty": 0.0}],
+     "accounting": {"runtime_s": 300, "tick_s": 5, "idle_grace_s": 120}}
+
 A workload may also carry a ``chaos`` section — a deterministic failure
 scenario played against the placed fleet through the REAL health subsystem
 (health/: leases, quarantine, rescuer) on a virtual clock:
@@ -55,8 +66,11 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional
+import threading
+from typing import Dict, List, Optional
 
+from ..accounting import efficiency as eff_mod
+from ..accounting.sampler import UsageSampler
 from ..health.faults import FaultEvent, FaultInjector, SimClock
 from ..k8s import FakeKube
 from ..scheduler import DeviceInfo, NodeInfo, Scheduler
@@ -155,9 +169,10 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
     policy = policy or live_cfg.get("node_scheduler_policy") or "spread"
     topology_policy = live_cfg.get("topology_policy", "best-effort")
     chaos = workload.get("chaos")
-    # A chaos scenario runs on a virtual clock so minutes of lease decay
-    # and quarantine probation replay in microseconds — deterministically.
-    clock = SimClock() if chaos else None
+    accounting = workload.get("accounting")
+    # A chaos or accounting scenario runs on a virtual clock so minutes of
+    # lease decay / usage metering replay in microseconds — deterministically.
+    clock = SimClock() if (chaos or accounting) else None
     kube = FakeKube()
     s = Scheduler(kube, Config(node_scheduler_policy=policy,
                                topology_policy=topology_policy),
@@ -204,6 +219,12 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
     for _, pod, err in queue:
         pending.append({"pod": pod["metadata"]["name"], "reason": err})
 
+    accounting_report = None
+    if accounting:
+        # Before chaos: the metering replay wants the placed fleet intact.
+        accounting_report = run_accounting_phase(s, workload, accounting,
+                                                 clock, placed)
+
     chaos_report = None
     if chaos:
         chaos_report = run_chaos_phase(s, kube, names, chaos, clock, placed)
@@ -235,9 +256,144 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
         if total_mem else 0.0,
         "fits": not pending,
     }
+    if accounting_report is not None:
+        result["accounting"] = accounting_report
     if chaos_report is not None:
         result["chaos"] = chaos_report
     return result
+
+
+class _SimRegion:
+    """Duck-typed shared region for the accounting replay: exactly the
+    surface UsageSampler reads (num_devices / used / switches)."""
+
+    def __init__(self, chips: int, used_bytes_per_chip: int,
+                 oversubscribe: bool) -> None:
+        self.num_devices = chips
+        self._used = used_bytes_per_chip
+        self.utilization_switch = 0
+        self.oversubscribe = 1 if oversubscribe else 0
+
+    def used(self, _dev: int) -> int:
+        return self._used
+
+
+class _SimState:
+    def __init__(self, region: _SimRegion) -> None:
+        self.region = region
+        self.active = False
+
+
+class _SimLoop:
+    """FeedbackLoop stand-in (lock + containers) the sampler runs over."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.containers: Dict[str, _SimState] = {}
+
+
+def run_accounting_phase(s: Scheduler, workload: dict, spec: dict,
+                         clock: SimClock, placed: List[dict]) -> dict:
+    """Replay each placed pod's declared duty cycle through the REAL
+    metering pipeline: UsageSampler over synthetic regions → ledger
+    (node-grouped counter reports, the register-stream shape) →
+    efficiency join.  The report asserts the accounting invariant —
+    metered chip-seconds within 5% of simulated occupancy — and surfaces
+    the idle grants the efficiency layer exists to find."""
+    runtime = float(spec.get("runtime_s", 300.0))
+    tick = float(spec.get("tick_s", 5.0))
+    grace = float(spec.get("idle_grace_s", min(600.0, runtime / 2)))
+    steps = max(1, int(round(runtime / tick)))
+
+    duty_by_pod: Dict[str, float] = {}
+    oversub_by_pod: Dict[str, bool] = {}
+    for entry in workload.get("pods", []):
+        for i in range(int(entry.get("count", 1))):
+            duty_by_pod[f"{entry['name']}-{i}"] = float(
+                entry.get("duty", 1.0))
+            oversub_by_pod[f"{entry['name']}-{i}"] = bool(
+                entry.get("oversubscribe", False))
+
+    MIB = 1024 * 1024
+    loop = _SimLoop()
+    node_of: Dict[str, str] = {}
+    meta: Dict[str, dict] = {}  # ctrkey -> pod metadata
+    for p in placed:
+        name = p["pod"]
+        uid = f"uid-{name}"
+        ctrkey = f"{uid}_{name}"
+        chips = len(p["chips"])
+        mem_bytes = (p["chips"][0]["mem_mib"] * MIB) if p["chips"] else 0
+        loop.containers[ctrkey] = _SimState(_SimRegion(
+            chips, mem_bytes, oversub_by_pod.get(name, False)))
+        node_of[ctrkey] = p["node"]
+        meta[ctrkey] = {"pod": name, "uid": uid, "node": p["node"],
+                        "chips": chips,
+                        "duty": duty_by_pod.get(name, 1.0),
+                        "accumulator": 0.0}
+
+    sampler = UsageSampler(loop, clock=clock)
+    sampler.sample()  # t0 baseline: first sight credits nothing
+    for _ in range(steps):
+        # ``active`` describes the interval about to be credited (the
+        # age_kernel census semantics): set it, elapse one tick, sample.
+        for ctrkey, m in meta.items():
+            m["accumulator"] += m["duty"]
+            active = m["accumulator"] >= 1.0 - 1e-9
+            if active:
+                m["accumulator"] -= 1.0
+            loop.containers[ctrkey].active = active
+        clock.advance(tick)
+        sampler.sample()
+        rows = sampler.snapshot()
+        by_node: Dict[str, List[dict]] = {}
+        for row in rows:
+            by_node.setdefault(node_of[row["ctrkey"]], []).append(row)
+        for node, node_rows in by_node.items():
+            s.ledger.record(node, node_rows)
+
+    pods_out = []
+    max_err = 0.0
+    ok = True
+    for ctrkey, m in sorted(meta.items()):
+        acct = s.ledger.get(m["uid"])
+        metered = acct.chip_seconds if acct is not None else 0.0
+        simulated = m["duty"] * runtime * m["chips"]
+        if simulated > 0:
+            err = 100.0 * abs(metered - simulated) / simulated
+        else:
+            # An idle pod must meter (close to) nothing: one tick of one
+            # chip is the discretization slack.
+            err = 0.0 if metered <= tick * m["chips"] else float("inf")
+        max_err = max(max_err, err)
+        ok = ok and err <= 5.0
+        pods_out.append({
+            "pod": m["pod"], "node": m["node"], "chips": m["chips"],
+            "duty": m["duty"],
+            "simulated_chip_seconds": round(simulated, 3),
+            "metered_chip_seconds": round(metered, 3),
+            "error_pct": round(err, 3),
+        })
+
+    fleet = eff_mod.grant_efficiency(
+        s.pods.list_pods(), s.ledger,
+        eff_mod.EfficiencyConfig(window_s=runtime, idle_grace_s=grace),
+        now=clock())
+    return {
+        "runtime_s": runtime,
+        "tick_s": tick,
+        "pods": pods_out,
+        "max_error_pct": round(max_err, 3),
+        "tolerance_pct": 5.0,
+        "metering_ok": ok,
+        "idle_grants": sorted(p.name for p in fleet.idle),
+        "efficiency": {p.name: (round(p.efficiency, 4)
+                                if p.efficiency is not None else None)
+                       for p in fleet.pods},
+        "fleet_efficiency": (round(fleet.fleet_efficiency, 4)
+                             if fleet.fleet_efficiency is not None
+                             else None),
+    }
 
 
 def overbooked_chips(s: Scheduler) -> List[str]:
@@ -332,6 +488,25 @@ def format_report(result: dict) -> str:
             lines.append(f"  {p['pod']:<24s} {p['reason']}")
     else:
         lines.append("workload fits.")
+    acct = result.get("accounting")
+    if acct:
+        verdict = ("metered within {}% of simulated occupancy"
+                   .format(acct["tolerance_pct"]) if acct["metering_ok"]
+                   else "METERING DRIFT over tolerance")
+        lines.append(
+            f"accounting ({acct['runtime_s']:.0f}s @ {acct['tick_s']:.0f}s"
+            f" ticks): {verdict} (max error {acct['max_error_pct']:.2f}%)")
+        for p in acct["pods"]:
+            lines.append(
+                "  {:<24s} duty {:>4.0%}: {:>9.1f} metered / {:>9.1f} "
+                "simulated chip-s ({:.2f}%)".format(
+                    p["pod"], p["duty"], p["metered_chip_seconds"],
+                    p["simulated_chip_seconds"], p["error_pct"]))
+        if acct["idle_grants"]:
+            lines.append("  IDLE GRANTS: " + ", ".join(acct["idle_grants"]))
+        if acct["fleet_efficiency"] is not None:
+            lines.append(
+                f"  fleet efficiency: {acct['fleet_efficiency']:.1%}")
     chaos = result.get("chaos")
     if chaos:
         lines.append(

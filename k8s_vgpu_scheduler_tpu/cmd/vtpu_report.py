@@ -1,0 +1,134 @@
+"""vtpu-report — per-namespace showback over a time window.
+
+Fetches the extender's ``GET /usagez`` export (accounting/efficiency.py
+``showback``) and emits chargeback-style rows: chip-seconds and HBM-byte-
+seconds actually consumed per namespace, granted chip-seconds for the
+same window, the efficiency ratio, and idle-grant counts.  JSON for
+pipelines, CSV for the spreadsheet the finance conversation inevitably
+happens in.
+
+Usage:
+  python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_report --cluster http://sched:9443
+  python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_report --cluster ... --window 3600 --csv
+  python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_report --cluster ... --pods   # per-pod rows
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import io
+import json
+import sys
+from typing import List, Optional
+
+NAMESPACE_COLUMNS = ["namespace", "pods", "chip_seconds",
+                     "hbm_byte_seconds", "granted_chip_seconds",
+                     "efficiency", "idle_grants"]
+POD_COLUMNS = ["namespace", "pod", "node", "granted_chips", "chip_seconds",
+               "hbm_byte_seconds", "window_covered_s", "efficiency",
+               "idle", "live"]
+
+
+def fetch_usage(cluster: str, window: Optional[float]) -> dict:
+    import urllib.request
+
+    url = cluster.rstrip("/")
+    if "://" not in url:
+        url = "http://" + url
+    if not url.endswith("/usagez"):
+        url += "/usagez"
+    if window is not None:
+        url += f"?window={window:g}"
+    with urllib.request.urlopen(url, timeout=15) as r:
+        return json.load(r)
+
+
+def to_csv(rows: List[dict], columns: List[str]) -> str:
+    buf = io.StringIO()
+    w = csv.DictWriter(buf, fieldnames=columns, extrasaction="ignore")
+    w.writeheader()
+    for row in rows:
+        w.writerow(row)
+    return buf.getvalue()
+
+
+def format_report(export: dict, pods: bool = False) -> str:
+    fleet = export.get("fleet", {})
+    eff = fleet.get("efficiency")
+    lines = [
+        "showback over the last {:.0f}s — fleet efficiency: {}".format(
+            export.get("window_s", 0.0),
+            f"{eff:.1%}" if eff is not None else "n/a (no usage reports)"),
+        "| {:<20s} {:>5s} {:>12s} {:>16s} {:>12s} {:>6s} {:>5s} |".format(
+            "namespace", "pods", "chip-s", "hbm-byte-s", "granted-s",
+            "eff%", "idle"),
+    ]
+    for row in export.get("namespaces", []):
+        e = row.get("efficiency")
+        lines.append(
+            "| {:<20s} {:>5d} {:>12.1f} {:>16.3g} {:>12.1f} {:>6s} "
+            "{:>5d} |".format(
+                row["namespace"][:20], row["pods"], row["chip_seconds"],
+                row["hbm_byte_seconds"], row["granted_chip_seconds"],
+                f"{100 * e:.1f}" if e is not None else "-",
+                row["idle_grants"]))
+    if pods:
+        lines.append("+ pods")
+        for row in export.get("pods", []):
+            e = row.get("efficiency")
+            flags = "IDLE" if row.get("idle") else (
+                "" if row.get("live") else "gone")
+            lines.append(
+                "| {:<34s} {:>2d} chips {:>10.1f} chip-s {:>6s}% {} |"
+                .format(f"{row['namespace']}/{row['pod']}"[:34],
+                        row["granted_chips"], row["chip_seconds"],
+                        f"{100 * e:.1f}" if e is not None else "-",
+                        flags))
+    idle = export.get("idle_grants", [])
+    if idle:
+        lines.append(f"IDLE GRANTS: {len(idle)} pod(s) holding unused "
+                     "capacity")
+        for p in idle:
+            lines.append(
+                "  {:<34s} {} chip(s) on {}, idle {:.0f}s".format(
+                    f"{p['namespace']}/{p['name']}"[:34],
+                    p["granted_chips"], p["node"], p["idle_for_s"]))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("vtpu-report")
+    p.add_argument("--cluster", required=True,
+                   help="extender HTTP base URL (the /usagez endpoint), "
+                        "e.g. http://sched:9443")
+    p.add_argument("--window", type=float, default=None,
+                   help="trailing window in seconds (default: the "
+                        "scheduler's --efficiency-window)")
+    p.add_argument("--pods", action="store_true",
+                   help="include per-pod rows, not just namespaces")
+    fmt = p.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true", dest="as_json")
+    fmt.add_argument("--csv", action="store_true", dest="as_csv")
+    args = p.parse_args(argv)
+
+    try:
+        export = fetch_usage(args.cluster, args.window)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"vtpu-report: cannot fetch usage: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(export, indent=1))
+    elif args.as_csv:
+        if args.pods:
+            print(to_csv(export.get("pods", []), POD_COLUMNS), end="")
+        else:
+            print(to_csv(export.get("namespaces", []), NAMESPACE_COLUMNS),
+                  end="")
+    else:
+        print(format_report(export, pods=args.pods))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,10 +17,15 @@ layers write:
   sharer counts, per-pod allocations (the ``nvidia-smi`` run on the
   *cluster*, which the reference has no analog of).
 
+- ``top`` (``--cluster`` required): the waste view — per-pod ACTUAL usage
+  (accounting ledger counters) against granted capacity, sorted by wasted
+  chips; the place to find pods holding 60% of a chip while using 5%.
+
 Usage:
   python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi [--json]
   python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi --containers-dir /tmp/vtpu/containers
   python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi --cluster http://sched:9395
+  python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_smi top --cluster http://sched:9395
 """
 
 from __future__ import annotations
@@ -82,15 +87,34 @@ def format_info(info: dict, title: str) -> str:
     return "\n".join(lines)
 
 
+def _unescape_label(value: str) -> str:
+    """Exposition-format label-value unescaping (``\\\\``, ``\\"``,
+    ``\\n``) — returning the raw escapes would make a label value
+    compare unequal to what the emitting collector stored."""
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
 def parse_prom(text: str) -> dict:
     """Minimal Prometheus text-exposition parser: name → [(labels, value)].
-    Only what the extender emits (gauges/counters, quoted label values
-    without embedded quotes) — no client dependency in the CLI.  The label
-    block is split off FIRST (on the closing brace), then the sample value
-    is the first field after it: label values containing spaces, and the
-    optional trailing ``name value timestamp`` form a federated/relabelled
-    endpoint emits, both parse correctly (ADVICE r3 — rpartition(' ')
-    silently took the timestamp as the value)."""
+    Only what the extender emits (gauges/counters/histogram series) — no
+    client dependency in the CLI.  Hardened against adversarial label
+    values (tests/test_vtpu_cluster.py): the label block is split off
+    FIRST (on the LAST closing brace, so ``}`` inside a quoted value is
+    fine), pairs are matched with a quote-aware regex instead of
+    ``split(",")`` (values may contain ``,``, ``=``, spaces and escaped
+    quotes), escapes are decoded, and the sample value is the first field
+    AFTER the block — never a trailing timestamp (ADVICE r3)."""
     out: dict = {}
     for line in text.splitlines():
         line = line.strip()
@@ -99,15 +123,17 @@ def parse_prom(text: str) -> dict:
         labels: dict = {}
         if "{" in line:
             name, _, rest = line.partition("{")
+            name = name.strip()
             block, brace, tail = rest.rpartition("}")
             if not brace:
                 continue  # unclosed label block: not an exposition line
             # Pair-wise regex, not split(","): quoted label values may
-            # legally contain commas (and spaces) — e.g. relabelled
-            # joined values on a federated endpoint.
+            # legally contain commas, equals signs and spaces — e.g.
+            # relabelled joined values or PromQL selectors copied into a
+            # label on a federated endpoint.
             for m in re.finditer(r'([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*'
                                  r'"((?:[^"\\]|\\.)*)"', block):
-                labels[m.group(1)] = m.group(2)
+                labels[m.group(1)] = _unescape_label(m.group(2))
             fields = tail.split()
         else:
             fields = line.split()
@@ -165,6 +191,71 @@ def cluster_info(metrics: dict) -> dict:
     }
 
 
+def top_info(metrics: dict) -> dict:
+    """Per-pod actual-vs-granted join from the extender's accounting
+    metrics (scheduler/metrics.py) — the data behind ``vtpu-smi top``.
+    ``waste_chips`` = granted chips × (1 - efficiency): the capacity the
+    pod holds but does not use; None when the pod has no usage reports
+    (node without a monitor — unknown is not the same as idle)."""
+    pods: dict = {}
+
+    def pod(labels):
+        key = (labels.get("podnamespace", "?"), labels.get("podname", "?"))
+        return pods.setdefault(key, {
+            "chips": 0, "granted_mib": 0, "granted_cores": 0,
+            "chip_seconds": 0.0, "hbm_byte_seconds": 0.0,
+            "efficiency": None,
+        })
+
+    for labels, v in metrics.get("vtpu_pod_device_allocated_mib", []):
+        p = pod(labels)
+        p["chips"] += 1
+        p["granted_mib"] += int(v)
+    for labels, v in metrics.get("vtpu_pod_core_allocated", []):
+        pod(labels)["granted_cores"] += int(v)
+    for labels, v in metrics.get("vtpu_usage_chip_seconds_total", []):
+        pod(labels)["chip_seconds"] = v
+    for labels, v in metrics.get("vtpu_usage_hbm_byte_seconds_total", []):
+        pod(labels)["hbm_byte_seconds"] = v
+    for labels, v in metrics.get("vtpu_grant_efficiency_ratio", []):
+        pod(labels)["efficiency"] = round(v, 4)
+
+    rows = []
+    for (ns, name), p in pods.items():
+        eff = p["efficiency"]
+        waste = (round(p["chips"] * (1.0 - min(1.0, eff)), 3)
+                 if eff is not None and p["chips"] else None)
+        rows.append({"namespace": ns, "name": name, **p,
+                     "waste_chips": waste})
+    # Sorted by waste, worst first; pods with unknown efficiency sink to
+    # the bottom (they may be fine — there is just no monitor data).
+    rows.sort(key=lambda r: (r["waste_chips"] is None,
+                             -(r["waste_chips"] or 0.0),
+                             r["namespace"], r["name"]))
+    idle = metrics.get("vtpu_idle_grants", [({}, 0.0)])
+    return {"pods": rows,
+            "idle_grants": int(idle[0][1]) if idle else 0}
+
+
+def format_top(info: dict) -> str:
+    lines = [
+        f"+ fleet: {info['idle_grants']} idle grant(s)",
+        "| pod                                chips  granted    eff%  "
+        "waste  chip-s     |",
+    ]
+    for r in info["pods"]:
+        eff = (f"{100 * r['efficiency']:5.1f}"
+               if r["efficiency"] is not None else "    -")
+        waste = (f"{r['waste_chips']:5.2f}"
+                 if r["waste_chips"] is not None else "    -")
+        lines.append(
+            "| {pn:<34s} {c:>5d} {g:>6d}MiB {e}% {w} {cs:>9.1f} |".format(
+                pn=f"{r['namespace']}/{r['name']}"[:34], c=r["chips"],
+                g=r["granted_mib"], e=eff, w=waste,
+                cs=r["chip_seconds"]))
+    return "\n".join(lines)
+
+
 def format_cluster(info: dict) -> str:
     lines = []
     for node, nd in sorted(info["nodes"].items()):
@@ -194,6 +285,9 @@ def format_cluster(info: dict) -> str:
 
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser("vtpu-smi")
+    p.add_argument("command", nargs="?", default="", choices=["", "top"],
+                   help="'top': per-pod actual vs granted, sorted by "
+                        "waste (requires --cluster)")
     p.add_argument("--region", default="",
                    help="region path (default: $TPU_DEVICE_MEMORY_SHARED_CACHE)")
     p.add_argument("--containers-dir", default="",
@@ -206,6 +300,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="libvtpu.so path override")
     args = p.parse_args(argv)
 
+    if args.command == "top" and not args.cluster:
+        print("vtpu-smi: top needs --cluster http://<extender>:9395",
+              file=sys.stderr)
+        return 2
     if args.cluster:
         import urllib.request
 
@@ -220,7 +318,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (OSError, ValueError) as e:
             print(f"vtpu-smi: cannot fetch {url}: {e}", file=sys.stderr)
             return 2
-        info = cluster_info(parse_prom(text))
+        metrics = parse_prom(text)
+        if args.command == "top":
+            info = top_info(metrics)
+            print(json.dumps(info, indent=1) if args.as_json
+                  else format_top(info))
+            return 0
+        info = cluster_info(metrics)
         print(json.dumps(info, indent=1) if args.as_json
               else format_cluster(info))
         return 0

@@ -10,7 +10,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-from typing import Optional
+from typing import Callable, List, Optional
 
 import grpc
 
@@ -23,7 +23,82 @@ from ..util.config import Config
 log = logging.getLogger(__name__)
 
 
-def inventory_to_request(node_name: str, inv: NodeInventory, cfg: Config
+def usage_to_proto(rows) -> List[pb.UsageCounters]:
+    """Sampler counter rows (accounting/sampler.py USAGE_FIELDS) → the
+    register stream's usage field."""
+    return [
+        pb.UsageCounters(
+            ctrkey=row["ctrkey"],
+            chips=int(row["chips"]),
+            active=bool(row["active"]),
+            oversubscribe=bool(row["oversubscribe"]),
+            chip_seconds=row["chip_seconds"],
+            hbm_byte_seconds=row["hbm_byte_seconds"],
+            throttled_seconds=row["throttled_seconds"],
+            oversub_spill_seconds=row["oversub_spill_seconds"],
+            window_s=row["window_s"],
+        )
+        for row in rows
+    ]
+
+
+def monitor_usage_source(endpoint: str) -> Callable[[], List[dict]]:
+    """Usage source backed by the co-located monitor's loopback noderpc
+    (``usage_only`` GetNodeTPU — counters, no region snapshots).
+    Node-local plumbing only — monitor→scheduler transport stays on the
+    one existing register connection.
+
+    NON-BLOCKING by design: the register stream's generator thread is
+    the lease-heartbeat path, and a hung monitor must never delay a
+    beat toward the failure detector's TTL.  Each call returns the last
+    cached rows immediately and kicks a background refresh (at most one
+    in flight); counters are cumulative, so a one-beat-stale report
+    loses nothing.  Any failure (monitor restarting, endpoint disabled)
+    leaves the cache as-is and the heartbeat goes out without usage."""
+    from ..accounting.ledger import decode_usage
+    from ..monitor.noderpc import node_tpu_stub
+
+    lock = threading.Lock()
+    state: dict = {"rows": [], "inflight": False}
+
+    def _refresh() -> None:
+        try:
+            with lock:
+                stub = state.get("stub")
+            if stub is None:
+                stub = node_tpu_stub(grpc.insecure_channel(endpoint))
+                with lock:
+                    state["stub"] = stub
+            from ..api import noderpc_pb2 as npb
+
+            reply = stub(npb.GetNodeTPURequest(usage_only=True), timeout=5)
+            rows = decode_usage(reply.usage.counters)
+            with lock:
+                state["rows"] = rows
+        except Exception as e:  # noqa: BLE001 — usage is best-effort
+            log.debug("usage fetch from %s failed: %s", endpoint, e)
+            with lock:
+                state.pop("stub", None)
+        finally:
+            with lock:
+                state["inflight"] = False
+
+    def fetch() -> List[dict]:
+        with lock:
+            rows = state["rows"]
+            start = not state["inflight"]
+            if start:
+                state["inflight"] = True
+        if start:
+            threading.Thread(target=_refresh, daemon=True,
+                             name="usage-fetch").start()
+        return rows
+
+    return fetch
+
+
+def inventory_to_request(node_name: str, inv: NodeInventory, cfg: Config,
+                         usage: Optional[List[dict]] = None
                          ) -> pb.RegisterRequest:
     """Advertise scaled capacity: deviceMemoryScaling>1 oversubscribes HBM,
     deviceCoresScaling>1 oversubscribes compute (register.go:422–426).
@@ -52,7 +127,10 @@ def inventory_to_request(node_name: str, inv: NodeInventory, cfg: Config
         mesh=list(inv.topology.mesh),
         wraparound=list(inv.topology.wrap()),
     )
-    return pb.RegisterRequest(node=node_name, devices=devices, topology=topo)
+    req = pb.RegisterRequest(node=node_name, devices=devices, topology=topo)
+    if usage:
+        req.usage.extend(usage_to_proto(usage))
+    return req
 
 
 class DeviceRegister:
@@ -60,10 +138,16 @@ class DeviceRegister:
     fresh inventory message down the same stream."""
 
     def __init__(self, backend: Backend, cfg: Config,
-                 endpoint: Optional[str] = None) -> None:
+                 endpoint: Optional[str] = None,
+                 usage_source: Optional[Callable[[], List[dict]]] = None
+                 ) -> None:
         self.backend = backend
         self.cfg = cfg
         self.endpoint = endpoint or cfg.scheduler_endpoint
+        #: Optional provider of accounting counter rows; each stream
+        #: message piggybacks its latest answer (the scheduler ledger's
+        #: transport — no connection beyond the register stream itself).
+        self.usage_source = usage_source
         self._q: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -90,7 +174,14 @@ class DeviceRegister:
                         continue
                 if inv is None:
                     return
-                yield inventory_to_request(self.cfg.node_name, inv, self.cfg)
+                usage = []
+                if self.usage_source is not None:
+                    try:
+                        usage = self.usage_source() or []
+                    except Exception as e:  # noqa: BLE001 — heartbeat must go out
+                        log.debug("usage source failed: %s", e)
+                yield inventory_to_request(self.cfg.node_name, inv,
+                                           self.cfg, usage=usage)
                 self.connected.set()
 
         try:

@@ -100,6 +100,10 @@ class Rescuer:
         self._thread: Optional[threading.Thread] = None
         #: Lifetime count of rescinded grants (vtpu_rescued_pods_total).
         self.rescued_total = 0
+        #: uid -> first flag time for chronically idle OVERSUBSCRIBED
+        #: grants (accounting/efficiency.py).  Flag only — an idle pod
+        #: may be between steps; eviction stays a human/preemption call.
+        self.idle_flagged: Dict[str, float] = {}
 
     # -- queue -----------------------------------------------------------------
     def enqueue(self, uid: str, reason: str, namespace: str = "",
@@ -215,6 +219,40 @@ class Rescuer:
                     # chip (nodes.py's deliberate deviation): the grant
                     # references hardware that no longer exists.
                     self.enqueue(info.uid, "chip-vanished")
+
+        # 3b. Chronically idle oversubscribed grants: FLAGGED, never
+        # evicted.  An oversubscribed idle grant is the worst waste shape
+        # — it holds virtual HBM beyond physical while dispatching
+        # nothing — but idleness is not brokenness, so the action is an
+        # operator-visible finding (journal event + sweep action +
+        # vtpu_idle_grants), not a rescind.
+        grant_eff = getattr(self.s, "grant_efficiency", None)
+        if grant_eff is not None:
+            idle_now = set()
+            for pe in grant_eff(now).idle:
+                if not pe.oversubscribe:
+                    continue
+                idle_now.add(pe.uid)
+                if pe.uid in self.idle_flagged:
+                    continue
+                self.idle_flagged[pe.uid] = now
+                actions.append({"kind": "idle-grant", "pod": pe.name,
+                                "uid": pe.uid, "node": pe.node,
+                                "idle_for_s": round(pe.idle_for_s, 1)})
+                log.warning(
+                    "idle grant: %s/%s holds %d chip(s) on %s "
+                    "(oversubscribed) but has dispatched nothing for "
+                    "%.0fs — capacity wasted, not rescinding",
+                    pe.namespace, pe.name, pe.granted_chips, pe.node,
+                    pe.idle_for_s)
+                tr.event(pe.uid, "idle-grant", pod=pe.name, node=pe.node,
+                         idle_for_s=round(pe.idle_for_s, 1),
+                         granted_chips=pe.granted_chips)
+            # A pod that resumed dispatching (or left) clears its flag,
+            # so a later relapse is reported again.
+            for uid in [u for u in self.idle_flagged
+                        if u not in idle_now]:
+                del self.idle_flagged[uid]
 
         # 4. Drain.
         with self._lock:

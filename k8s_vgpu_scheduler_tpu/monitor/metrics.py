@@ -11,7 +11,11 @@ import logging
 import time
 from typing import Iterable, Optional
 
-from prometheus_client.core import GaugeMetricFamily, HistogramMetricFamily
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+    HistogramMetricFamily,
+)
 from prometheus_client.registry import Collector
 
 from ..tpulib.backend import Backend
@@ -29,10 +33,12 @@ class NodeCollector(Collector):
     INVENTORY_TTL_S = 30.0
 
     def __init__(self, loop: FeedbackLoop, backend: Optional[Backend] = None,
-                 node_name: str = "", now=time.monotonic) -> None:
+                 node_name: str = "", now=time.monotonic,
+                 sampler=None) -> None:
         self.loop = loop
         self.backend = backend
         self.node_name = node_name
+        self.sampler = sampler  # Optional[accounting.UsageSampler]
         self._now = now
         self._inv_cache: Optional[list] = None
         self._inv_at = float("-inf")
@@ -102,6 +108,45 @@ class NodeCollector(Collector):
                 c_procs.add_metric([c.key], len(r.proc_pids()))
                 c_oversub.add_metric([c.key], r.oversubscribe)
 
+        # Accounting counters (accounting/sampler.py): monotonic usage
+        # integrals — the node-side face of the fleet-wide showback layer
+        # (the scheduler exporter carries the per-pod/namespace join).
+        families = [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs,
+                    c_oversub]
+        if self.sampler is not None:
+            u_chip = CounterMetricFamily(
+                "vtpu_usage_chip_seconds",
+                "Chip-seconds actually consumed by one container "
+                "(elapsed time x chips held, credited only while "
+                "dispatching)",
+                labels=["container"],
+            )
+            u_hbm = CounterMetricFamily(
+                "vtpu_usage_hbm_byte_seconds",
+                "HBM byte-seconds actually held by one container "
+                "(occupancy integrated over time)",
+                labels=["container"],
+            )
+            u_throttled = CounterMetricFamily(
+                "vtpu_usage_throttled_seconds",
+                "Seconds one container spent priority-throttled "
+                "(utilization switch engaged)",
+                labels=["container"],
+            )
+            u_spill = CounterMetricFamily(
+                "vtpu_usage_oversub_spill_seconds",
+                "Active seconds under an oversubscribed grant (the "
+                "window in which host-RAM spills can occur)",
+                labels=["container"],
+            )
+            for row in self.sampler.snapshot():
+                key = [row["ctrkey"]]
+                u_chip.add_metric(key, row["chip_seconds"])
+                u_hbm.add_metric(key, row["hbm_byte_seconds"])
+                u_throttled.add_metric(key, row["throttled_seconds"])
+                u_spill.add_metric(key, row["oversub_spill_seconds"])
+            families += [u_chip, u_hbm, u_throttled, u_spill]
+
         phase_latency = HistogramMetricFamily(
             "vtpu_monitor_phase_latency_seconds",
             "Wall-clock latency of one monitor phase (region-scan tick)",
@@ -111,14 +156,14 @@ class NodeCollector(Collector):
                 trace.tracer().histogram_snapshot().items():
             phase_latency.add_metric([phase], buckets, sum_s)
 
-        return [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs,
-                c_oversub, phase_latency]
+        return families + [phase_latency]
 
 
 def start_metrics_server(loop: FeedbackLoop, backend: Optional[Backend],
-                         node_name: str, port: int = 9394):
+                         node_name: str, port: int = 9394, sampler=None):
     from prometheus_client import CollectorRegistry, start_http_server
 
     registry = CollectorRegistry()
-    registry.register(NodeCollector(loop, backend, node_name))
+    registry.register(NodeCollector(loop, backend, node_name,
+                                    sampler=sampler))
     return start_http_server(port, registry=registry)

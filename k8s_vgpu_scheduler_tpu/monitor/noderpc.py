@@ -33,22 +33,52 @@ def snapshot_region(region) -> pb.RegionInfo:
         info.uuids.append(region.uuid(dev))
         info.limit.append(region.limit(dev))
         info.sm_limit.append(region.sm_limit(dev))
+        # Actual occupancy alongside the cap: a reader must be able to
+        # see per-device used memory without mmapping the region itself.
         info.used.append(region.used(dev))
     for pid in region.proc_pids():
         info.procs.append(pb.ProcSlot(pid=pid))
     return info
 
 
+def usage_report(node_name: str, rows) -> pb.ReportUsage:
+    """Sampler counter rows (accounting/sampler.py USAGE_FIELDS) → the
+    ReportUsage message piggybacked on GetNodeTPUReply."""
+    report = pb.ReportUsage(nodeid=node_name)
+    for row in rows:
+        report.counters.add(
+            ctrkey=row["ctrkey"],
+            chips=int(row["chips"]),
+            active=bool(row["active"]),
+            oversubscribe=bool(row["oversubscribe"]),
+            chip_seconds=row["chip_seconds"],
+            hbm_byte_seconds=row["hbm_byte_seconds"],
+            throttled_seconds=row["throttled_seconds"],
+            oversub_spill_seconds=row["oversub_spill_seconds"],
+            window_s=row["window_s"],
+        )
+    return report
+
+
 class NodeTPUInfoServer:
-    def __init__(self, loop, node_name: str) -> None:
+    def __init__(self, loop, node_name: str, sampler=None) -> None:
         self.loop = loop  # FeedbackLoop
         self.node_name = node_name
+        self.sampler = sampler  # Optional[accounting.UsageSampler]
         self._server: Optional[grpc.Server] = None
 
     # -- handler ---------------------------------------------------------------
     def get_node_tpu(self, request: pb.GetNodeTPURequest, context
                      ) -> pb.GetNodeTPUReply:
         reply = pb.GetNodeTPUReply(nodeid=self.node_name)
+        if request.usage_only:
+            # Counters only (the register-stream piggyback's fetch):
+            # skip the per-region snapshots and the loop lock entirely —
+            # the sampler keeps its own lock and its own copies.
+            if self.sampler is not None:
+                reply.usage.CopyFrom(
+                    usage_report(self.node_name, self.sampler.snapshot()))
+            return reply
         with self.loop.lock:
             for key, state in self.loop.containers.items():
                 if request.ctrkey and key != request.ctrkey:
@@ -61,6 +91,11 @@ class NodeTPUInfoServer:
                     log.exception("snapshot failed for %s", key)
                     continue
                 reply.usages.append(usage)
+        if self.sampler is not None:
+            # Accounting piggyback: the same round-trip carries the
+            # monotonic usage counters (no extra connection or endpoint).
+            reply.usage.CopyFrom(
+                usage_report(self.node_name, self.sampler.snapshot()))
         return reply
 
     # -- serving ---------------------------------------------------------------

@@ -19,6 +19,8 @@ from concurrent.futures import ThreadPoolExecutor
 from contextlib import nullcontext
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
+from ..accounting import efficiency as eff_mod
+from ..accounting.ledger import UsageLedger, decode_usage
 from ..health.lease import LeaseConfig, LeaseState, LeaseTracker
 from ..health.quarantine import ChipQuarantine, QuarantineConfig
 from ..health.rescuer import RESCUE_VALUE_PREFIX, RescueConfig, Rescuer
@@ -129,6 +131,16 @@ class Scheduler:
         self.nodes = NodeManager()
         self.pods = PodManager()
         self.gangs = GangManager()
+        self._clock = clock or time.monotonic
+        # Fleet utilization accounting (accounting/): per-pod actual-usage
+        # accounts fed by the counters each node agent piggybacks on its
+        # register-stream heartbeats, plus the granted-vs-actual join
+        # consumed by /metrics, /usagez and the --score-by-actual signal.
+        self.ledger = UsageLedger(clock=clock,
+                                  retention_s=self.cfg.usage_retention_s)
+        self.efficiency_cfg = eff_mod.EfficiencyConfig(
+            window_s=self.cfg.efficiency_window_s,
+            idle_grace_s=self.cfg.idle_grant_grace_s)
         # Fleet health subsystem (health/; docs/fault-tolerance.md).
         # ``clock`` is injectable (time.monotonic by default) so the
         # simulator and tests drive minutes-long failure scenarios
@@ -245,16 +257,21 @@ class Scheduler:
             return t
 
     # -- registration stream (gRPC DeviceService.Register) --------------------
-    def observe_registration(self, node_name: str, info: NodeInfo) -> None:
+    def observe_registration(self, node_name: str, info: NodeInfo,
+                             usage=None) -> None:
         """One registration-stream message, from the gRPC handler or any
         replayer (benchmarks, the fault injector).  Every message is a
         lease heartbeat and a per-chip health observation; the inventory
         is replaced only when it actually changed, so the keepalive
         cadence (deviceplugin/cache.py heartbeats) does not invalidate
-        the usage snapshot fleet-wide every beat interval."""
+        the usage snapshot fleet-wide every beat interval.  ``usage`` is
+        the message's piggybacked accounting counters (USAGE_FIELDS rows)
+        — absorbed into the ledger, never touching the snapshot path."""
         self.leases.beat(node_name)
         self.quarantine.observe_node(
             node_name, {d.id: d.health for d in info.devices})
+        if usage:
+            self.ledger.record(node_name, usage)
         if not self.nodes.same_inventory(node_name, info):
             self.nodes.add_node(node_name, info)
             log.info("registered node %s with %d chips", node_name,
@@ -272,7 +289,8 @@ class Scheduler:
             for req in request_iterator:
                 node_name = req.node
                 self.observe_registration(node_name,
-                                          decode_register_request(req))
+                                          decode_register_request(req),
+                                          usage=decode_usage(req.usage))
         finally:
             if node_name:
                 log.warning("register stream for %s closed; dropping node", node_name)
@@ -604,6 +622,24 @@ class Scheduler:
         shallow per-node dict copies share the immutable DeviceUsage
         entries; collectors only read)."""
         return {n: dict(e.usage) for n, e in self.snapshot().items()}
+
+    def grant_efficiency(self, now: Optional[float] = None
+                         ) -> "eff_mod.FleetEfficiency":
+        """Granted-vs-actual join of the live registry against the usage
+        ledger (accounting/efficiency.py) — consumed by the metrics
+        collector, the rescuer's idle-grant flagging, and /usagez.  Off
+        every scheduler lock: the registry list and ledger reads take
+        their own small ones."""
+        return eff_mod.grant_efficiency(
+            self.pods.list_pods(), self.ledger, self.efficiency_cfg,
+            now=now if now is not None else self._clock())
+
+    def export_usage(self, window_s: Optional[float] = None) -> dict:
+        """Per-namespace showback over a trailing window (``GET /usagez``
+        → ``vtpu-report``)."""
+        return eff_mod.showback(self.pods.list_pods(), self.ledger,
+                                self.efficiency_cfg,
+                                now=self._clock(), window_s=window_s)
 
     def export_fleet(self) -> dict:
         """Read-only fleet snapshot for capacity tooling (``GET /fleetz``
@@ -1083,6 +1119,15 @@ class Scheduler:
                 failed[name] = outcome[1]
                 continue
             _, s, placement = outcome
+            if self.cfg.score_by_actual:
+                # Utilization-aware feedback: bias toward nodes whose
+                # MEASURED utilization is low.  Applied at selection
+                # time, never stored with the cached fit outcome — the
+                # ledger moves on report cadence, not on the snapshot's
+                # revision clock, so a cached bonus would go stale
+                # without any rev to invalidate it.
+                s += eff_mod.actual_idle_bonus(
+                    self.ledger, name, len(snap[name].usage))
             fits.append((s, name, placement))
         if not fits:
             return None, failed
@@ -1236,6 +1281,9 @@ class Scheduler:
                     "reason", "insufficient TPU capacity/topology")
                 continue
             s = score_mod.node_score(usage, self.cfg.node_scheduler_policy)
+            if self.cfg.score_by_actual:
+                s += eff_mod.actual_idle_bonus(self.ledger, name,
+                                               len(entry.usage))
             if best is None or s > best[0]:
                 best = (s, name, placement)
 

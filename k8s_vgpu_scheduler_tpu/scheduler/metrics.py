@@ -8,7 +8,7 @@ per-pod grant gauges.
 
 from __future__ import annotations
 
-from typing import Iterable
+from typing import Dict, Iterable
 
 from prometheus_client.core import (
     CounterMetricFamily,
@@ -150,10 +150,68 @@ class ClusterCollector(Collector):
         )
         rescued.add_metric([], self.scheduler.rescuer.rescued_total)
 
+        # Fleet utilization accounting (accounting/; docs/observability
+        # .md): ACTUAL usage per pod from the ledger, and the granted-vs-
+        # actual efficiency join.  Same scrape-never-blocks-scheduling
+        # rule — ledger and registry reads take their own small locks.
+        u_chip = CounterMetricFamily(
+            "vtpu_usage_chip_seconds",
+            "Chip-seconds actually consumed by one pod (from node usage "
+            "reports; compare against its granted chips over time)",
+            labels=["podnamespace", "podname"],
+        )
+        u_hbm = CounterMetricFamily(
+            "vtpu_usage_hbm_byte_seconds",
+            "HBM byte-seconds actually held by one pod (occupancy "
+            "integrated over time, from node usage reports)",
+            labels=["podnamespace", "podname"],
+        )
+        eff_ratio = GaugeMetricFamily(
+            "vtpu_grant_efficiency_ratio",
+            "Actual / granted chip-seconds over the efficiency window "
+            "(1 = the grant is fully used; near 0 = the classic idle-"
+            "grant waste the fractional scheduler exists to prevent)",
+            labels=["podnamespace", "podname"],
+        )
+        idle_grants = GaugeMetricFamily(
+            "vtpu_idle_grants",
+            "Live grants that accrued ~no chip-seconds past the idle "
+            "grace — capacity held but unused (see /usagez and "
+            "vtpu-report for the per-pod list)",
+        )
+        fleet = self.scheduler.grant_efficiency()
+        by_uid = {p.uid: p for p in fleet.pods}
+        # Aggregate by label pair BEFORE emitting: two retained accounts
+        # can resolve to the same (namespace, name) — successive
+        # incarnations of a restarted pod, both "(unresolved)" — and
+        # duplicate series would invalidate the whole exposition.
+        # Summing is correct for lifetime counters.
+        sums: Dict[tuple, list] = {}
+        for acct in self.scheduler.ledger.accounts():
+            pe = by_uid.get(acct.uid)
+            namespace = pe.namespace if pe is not None else "(unresolved)"
+            name = pe.name if pe is not None else acct.name
+            agg = sums.setdefault((namespace, name), [0.0, 0.0])
+            agg[0] += acct.chip_seconds
+            agg[1] += acct.hbm_byte_seconds
+        for (namespace, name), (chip_s, hbm_s) in sorted(sums.items()):
+            u_chip.add_metric([namespace, name], chip_s)
+            u_hbm.add_metric([namespace, name], hbm_s)
+        # Same dedup discipline: a delete/recreate race can briefly hold
+        # two uids under one (namespace, name); latest registry entry wins.
+        ratios: Dict[tuple, float] = {}
+        for pe in fleet.pods:
+            if pe.efficiency is not None:
+                ratios[(pe.namespace, pe.name)] = pe.efficiency
+        for (namespace, name), ratio in sorted(ratios.items()):
+            eff_ratio.add_metric([namespace, name], ratio)
+        idle_grants.add_metric([], len(fleet.idle))
+
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
                 pod_mem, pod_cores, preempts, conflicts, pool_size,
                 busy_peak, lease_state, leases_unhealthy, chips_quar,
-                quarantines, rescued] + list(phase_metrics())
+                quarantines, rescued, u_chip, u_hbm, eff_ratio,
+                idle_grants] + list(phase_metrics())
 
 
 def phase_metrics():

@@ -10,6 +10,8 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
 - ``GET  /healthz``
 - ``GET  /fleetz``  read-only fleet snapshot (inventory + topology +
                     live grants) for ``vtpu-simulate --from-cluster``
+- ``GET  /usagez``  per-namespace showback over a trailing window
+                    (``?window=<s>``) for ``vtpu-report``
 """
 
 from __future__ import annotations
@@ -97,6 +99,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, self.scheduler.export_fleet())
             except Exception as e:  # noqa: BLE001 — 500, not a hangup
                 log.exception("fleetz export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        elif self.path.startswith("/usagez"):
+            # Per-namespace showback over a trailing window (accounting/
+            # efficiency.py) for the vtpu-report CLI; ?window=<seconds>
+            # overrides the configured efficiency window.
+            from urllib.parse import parse_qsl, urlsplit
+
+            query = dict(parse_qsl(urlsplit(self.path).query))
+            try:
+                window = (float(query["window"])
+                          if "window" in query else None)
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad window: {e}"})
+                return
+            try:
+                self._reply(200, self.scheduler.export_usage(window))
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("usagez export failed")
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         elif self.path.startswith("/debug/") and self.cfg.enable_debug:
             from urllib.parse import parse_qsl, urlsplit

@@ -105,6 +105,23 @@ class Config:
     # the failure detector and quarantine gating are always on.
     enable_rescue: bool = True
 
+    # Fleet utilization accounting (accounting/; docs/observability.md).
+    # Trailing window for the granted-vs-actual efficiency join, and how
+    # long a grant must accrue ~no chip-seconds before it is an
+    # idle-grant finding (vtpu_idle_grants / the rescuer's flag).
+    efficiency_window_s: float = 300.0
+    idle_grant_grace_s: float = 600.0
+    # How long the ledger remembers an account after its node stops
+    # reporting it (pod gone; bounded cardinality under churn).
+    usage_retention_s: float = 900.0
+    # Utilization-aware feedback: when True, candidate selection adds a
+    # bounded bonus (≤ one chip's worth of spread score) for nodes whose
+    # MEASURED utilization is low — packing against actual, not just
+    # granted, capacity.  Off by default: without monitor usage reports
+    # the signal is uniformly zero, and operators should opt into
+    # actual-based placement deliberately (--score-by-actual).
+    score_by_actual: bool = False
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

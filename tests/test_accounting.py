@@ -1,0 +1,647 @@
+"""Fleet utilization accounting: sampler integration, ledger semantics,
+the granted-vs-actual efficiency join, the register-stream transport,
+utilization-aware scoring, rescuer idle-grant flagging and showback —
+all on virtual clocks (SimClock): no sleeps, no real regions, and every
+scenario replays bit-identically."""
+
+import json
+import threading
+import urllib.request
+
+from k8s_vgpu_scheduler_tpu.accounting import (
+    EfficiencyConfig,
+    UsageLedger,
+    UsageSampler,
+)
+from k8s_vgpu_scheduler_tpu.accounting import efficiency as eff_mod
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import DeviceInfo, NodeInfo, Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+MIB = 1024 * 1024
+
+
+# -- fakes ------------------------------------------------------------------
+class FakeRegion:
+    """The surface UsageSampler (and NodeCollector) read off a region."""
+
+    def __init__(self, chips=1, used=0, switch=0, oversub=0):
+        self.num_devices = chips
+        self._used = used
+        self.utilization_switch = switch
+        self.oversubscribe = oversub
+        self.priority = 0
+
+    def used(self, _dev):
+        return self._used
+
+    def uuid(self, dev):
+        return f"chip-{dev}"
+
+    def limit(self, _dev):
+        return 0
+
+    def sm_limit(self, _dev):
+        return 0
+
+    def proc_pids(self):
+        return []
+
+
+class FakeState:
+    def __init__(self, region, active=False, key=""):
+        self.region = region
+        self.active = active
+        self.key = key  # NodeCollector labels by it; the sampler doesn't
+
+
+class FakeLoop:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.containers = {}
+
+
+def counter_row(ctrkey, chip_seconds=0.0, hbm=0.0, chips=1, active=True,
+                oversub=False, throttled=0.0, spill=0.0, window=0.0):
+    return {"ctrkey": ctrkey, "chips": chips, "active": active,
+            "oversubscribe": oversub, "chip_seconds": chip_seconds,
+            "hbm_byte_seconds": hbm, "throttled_seconds": throttled,
+            "oversub_spill_seconds": spill, "window_s": window}
+
+
+def register_node(s, name, chips=4, devmem=16384):
+    devices = [
+        DeviceInfo(id=f"{name}-chip-{i}", count=10, devmem=devmem,
+                   type="TPU-v5e", health=True, coords=(i, 0))
+        for i in range(chips)
+    ]
+    s.nodes.add_node(name, NodeInfo(
+        name=name, devices=devices,
+        topology=TopologyDesc(generation="v5e", mesh=(chips, 1))))
+
+
+def grant(uid, name, node, chips=1, mem=3000, cores=30, namespace="team"):
+    return PodInfo(uid=uid, name=name, namespace=namespace, node=node,
+                   devices=[[ContainerDevice(uuid=f"{node}-chip-{i}",
+                                             type="TPU-v5e", usedmem=mem,
+                                             usedcores=cores)
+                             for i in range(chips)]])
+
+
+def tpu_pod(name, uid, mem="3000", nums="1"):
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": {}},
+        "spec": {"containers": [{"name": "main", "resources": {
+            "limits": {"google.com/tpu": nums,
+                       "google.com/tpumem": mem}}}]},
+    }
+
+
+# -- sampler ----------------------------------------------------------------
+class TestSampler:
+    def test_integrates_duty_cycle_and_occupancy(self):
+        clock = SimClock()
+        loop = FakeLoop()
+        loop.containers["u1_podA"] = FakeState(
+            FakeRegion(chips=2, used=100 * MIB), active=False)
+        s = UsageSampler(loop, clock=clock)
+        s.sample()  # first sight: no credit
+        loop.containers["u1_podA"].active = True
+        clock.advance(5.0)
+        s.sample()
+        cs = s.get("u1_podA")
+        assert cs.chip_seconds == 10.0           # 5 s x 2 chips
+        assert cs.hbm_byte_seconds == 5.0 * 2 * 100 * MIB  # per-chip sum
+        loop.containers["u1_podA"].active = False
+        clock.advance(5.0)
+        s.sample()
+        cs = s.get("u1_podA")
+        assert cs.chip_seconds == 10.0           # idle interval: no credit
+        # Occupancy is still held while idle — byte-seconds keep accruing.
+        assert cs.hbm_byte_seconds == 10.0 * 2 * 100 * MIB
+
+    def test_throttled_and_oversub_spill_seconds(self):
+        clock = SimClock()
+        loop = FakeLoop()
+        region = FakeRegion(chips=1, used=MIB, switch=1, oversub=1)
+        loop.containers["u1_p"] = FakeState(region, active=True)
+        s = UsageSampler(loop, clock=clock)
+        s.sample()
+        clock.advance(4.0)
+        s.sample()
+        cs = s.get("u1_p")
+        assert cs.throttled_seconds == 4.0
+        assert cs.oversub_spill_seconds == 4.0   # oversub AND active
+        loop.containers["u1_p"].active = False
+        region.utilization_switch = 0
+        clock.advance(4.0)
+        s.sample()
+        cs = s.get("u1_p")
+        assert cs.throttled_seconds == 4.0
+        assert cs.oversub_spill_seconds == 4.0   # inactive: no spill window
+
+    def test_counters_survive_region_replacement(self):
+        """An in-place container restart (new region, used back to 0)
+        must never rewind the integrals — they live in the sampler, not
+        the region (churn/SIGKILL robustness)."""
+        clock = SimClock()
+        loop = FakeLoop()
+        loop.containers["u1_p"] = FakeState(
+            FakeRegion(chips=1, used=50 * MIB), active=True)
+        s = UsageSampler(loop, clock=clock)
+        s.sample()
+        clock.advance(10.0)
+        s.sample()
+        before = s.get("u1_p")
+        assert before.chip_seconds == 10.0
+        # Restart in place: same key, fresh region, zero usage.
+        loop.containers["u1_p"] = FakeState(FakeRegion(chips=1, used=0),
+                                            active=False)
+        clock.advance(10.0)
+        s.sample()
+        after = s.get("u1_p")
+        assert after.chip_seconds == before.chip_seconds
+        assert after.hbm_byte_seconds == before.hbm_byte_seconds
+
+    def test_ended_container_retained_then_gced(self):
+        clock = SimClock()
+        loop = FakeLoop()
+        loop.containers["u1_p"] = FakeState(FakeRegion(), active=True)
+        s = UsageSampler(loop, clock=clock, retention_s=60.0)
+        s.sample()
+        clock.advance(5.0)
+        s.sample()
+        del loop.containers["u1_p"]
+        clock.advance(30.0)
+        s.sample()
+        # Inside retention: the final totals still ride along.
+        assert [r["ctrkey"] for r in s.snapshot()] == ["u1_p"]
+        clock.advance(60.0)
+        s.sample()
+        assert s.snapshot() == []
+
+
+# -- ledger -----------------------------------------------------------------
+class TestLedger:
+    def test_accumulates_and_handles_counter_reset(self):
+        clock = SimClock()
+        led = UsageLedger(clock=clock)
+        led.record("node-a", [counter_row("u1_p", chip_seconds=10.0,
+                                          hbm=100.0)])
+        clock.advance(5.0)
+        led.record("node-a", [counter_row("u1_p", chip_seconds=14.0,
+                                          hbm=150.0)])
+        acct = led.get("u1")
+        assert acct.chip_seconds == 14.0
+        assert acct.hbm_byte_seconds == 150.0
+        # Monitor restart: counters begin again at zero — the new raw
+        # value is NEW usage on top of what the ledger already absorbed.
+        clock.advance(5.0)
+        led.record("node-a", [counter_row("u1_p", chip_seconds=3.0,
+                                          hbm=20.0)])
+        acct = led.get("u1")
+        assert acct.chip_seconds == 17.0
+        assert acct.hbm_byte_seconds == 170.0
+        assert led.resets_observed >= 1
+
+    def test_window_usage_covers_trailing_window(self):
+        clock = SimClock()
+        led = UsageLedger(clock=clock)
+        for i in range(10):
+            led.record("n", [counter_row("u1_p",
+                                         chip_seconds=float(10 * i))])
+            clock.advance(10.0)
+        # Totals reached 90, last recorded at t+90 (clock now at t+100):
+        # the window [t+70, t+100] baselines at the t+70 sample (70) and
+        # the delta is the 20 chip-seconds accrued after it.
+        chip_s, _hbm, covered = led.window_usage("u1", 30.0)
+        assert chip_s == 20.0
+        assert covered == 20.0
+
+    def test_node_busy_chips_and_prune(self):
+        clock = SimClock()
+        led = UsageLedger(clock=clock, retention_s=100.0)
+        led.record("n1", [counter_row("u1_a", chips=2, active=True),
+                          counter_row("u2_b", chips=4, active=False)])
+        led.record("n2", [counter_row("u3_c", chips=1, active=True)])
+        assert led.node_busy_chips("n1") == 2
+        assert led.node_busy_chips("n2") == 1
+        clock.advance(200.0)
+        led.record("n2", [counter_row("u3_c", chips=1, active=True)])
+        # n1's accounts fell past retention and were pruned: the node
+        # now reads as UNKNOWN (None), not as idle.
+        assert led.node_busy_chips("n1") is None
+        assert led.get("u1") is None
+        assert led.get("u3") is not None
+
+
+# -- efficiency join --------------------------------------------------------
+class TestEfficiencyJoin:
+    def _ledger(self, clock):
+        led = UsageLedger(clock=clock)
+        # busy pod: 1 chip fully used; squatter: 2 chips, nothing ever.
+        for i in range(13):
+            led.record("node-a", [
+                counter_row("u1_busy", chip_seconds=float(10 * i),
+                            chips=1, active=True),
+                counter_row("u2_squat", chip_seconds=0.0, chips=2,
+                            active=False, oversub=True),
+            ])
+            clock.advance(10.0)
+        return led
+
+    def test_efficiency_and_idle_findings(self):
+        clock = SimClock()
+        led = self._ledger(clock)
+        pods = [grant("u1", "busy", "node-a", chips=1),
+                grant("u2", "squat", "node-a", chips=2),
+                grant("u9", "unmonitored", "node-b", chips=1)]
+        fleet = eff_mod.grant_efficiency(
+            pods, led, EfficiencyConfig(window_s=60.0, idle_grace_s=30.0),
+            now=clock())
+        by = {p.name: p for p in fleet.pods}
+        assert 0.9 <= by["busy"].efficiency <= 1.1
+        assert by["busy"].idle is False
+        assert by["squat"].efficiency == 0.0
+        assert by["squat"].idle is True
+        assert by["squat"].oversubscribe is True
+        # No usage reports at all: unknown, which is NOT idle.
+        assert by["unmonitored"].efficiency is None
+        assert by["unmonitored"].idle is False
+        assert [p.name for p in fleet.idle] == ["squat"]
+        assert 0.0 < fleet.fleet_efficiency < 1.0
+
+    def test_idle_needs_grace_not_just_a_quiet_sample(self):
+        clock = SimClock()
+        led = UsageLedger(clock=clock)
+        led.record("n", [counter_row("u1_p", chips=1, active=False)])
+        clock.advance(5.0)
+        led.record("n", [counter_row("u1_p", chips=1, active=False)])
+        fleet = eff_mod.grant_efficiency(
+            [grant("u1", "p", "n")], led,
+            EfficiencyConfig(window_s=60.0, idle_grace_s=600.0),
+            now=clock())
+        assert fleet.pods[0].idle is False     # only 5 s of silence
+        clock.advance(600.0)
+        fleet = eff_mod.grant_efficiency(
+            [grant("u1", "p", "n")], led,
+            EfficiencyConfig(window_s=60.0, idle_grace_s=600.0),
+            now=clock())
+        assert fleet.pods[0].idle is True
+
+
+# -- transport: register stream + noderpc piggyback -------------------------
+class TestTransport:
+    def test_register_request_roundtrip_feeds_ledger(self):
+        """Node → scheduler: sampler rows ride RegisterRequest.usage
+        through real proto serialization into observe_registration —
+        the one existing connection, no new channel."""
+        from k8s_vgpu_scheduler_tpu.accounting.ledger import decode_usage
+        from k8s_vgpu_scheduler_tpu.api import device_register_pb2 as pb
+        from k8s_vgpu_scheduler_tpu.deviceplugin.register import (
+            inventory_to_request, usage_to_proto)
+        from k8s_vgpu_scheduler_tpu.scheduler.core import (
+            decode_register_request)
+        from k8s_vgpu_scheduler_tpu.tpulib import MockBackend
+
+        inv = MockBackend({"generation": "v5e", "mesh": [2, 1],
+                           "hbm_mib": 16384}).inventory()
+        cfg = Config(node_name="node-a")
+        rows = [counter_row("u1_podA", chip_seconds=42.0, hbm=7.0,
+                            chips=2, window=60.0)]
+        req = inventory_to_request("node-a", inv, cfg, usage=rows)
+        wire = pb.RegisterRequest.FromString(req.SerializeToString())
+        assert [u.ctrkey for u in wire.usage] == ["u1_podA"]
+
+        clock = SimClock()
+        s = Scheduler(FakeKube(), Config(), clock=clock)
+        try:
+            s.observe_registration("node-a",
+                                   decode_register_request(wire),
+                                   usage=decode_usage(wire.usage))
+            acct = s.ledger.get("u1")
+            assert acct is not None
+            assert acct.chip_seconds == 42.0
+            assert acct.node == "node-a"
+            # And the plain no-usage path (old agents) still registers.
+            s.observe_registration("node-b",
+                                   decode_register_request(req),
+                                   usage=[])
+        finally:
+            s.close()
+
+    def test_usage_to_proto_and_usage_report_agree(self):
+        """The two transports (register stream, noderpc reply) encode
+        the same rows identically field-for-field."""
+        from k8s_vgpu_scheduler_tpu.accounting.ledger import decode_usage
+        from k8s_vgpu_scheduler_tpu.deviceplugin.register import (
+            usage_to_proto)
+        from k8s_vgpu_scheduler_tpu.monitor.noderpc import usage_report
+
+        rows = [counter_row("u1_a", chip_seconds=1.5, hbm=2.5, chips=3,
+                            active=True, oversub=True, throttled=0.5,
+                            spill=0.25, window=9.0)]
+        via_stream = decode_usage(usage_to_proto(rows))
+        via_rpc = decode_usage(usage_report("node-x", rows).counters)
+        assert via_stream == via_rpc == rows
+
+
+# -- utilization-aware scoring ----------------------------------------------
+class TestScoreByActual:
+    def _fleet(self, score_by_actual):
+        kube = FakeKube()
+        for n in ("node-a", "node-b"):
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        clock = SimClock()
+        s = Scheduler(kube, Config(score_by_actual=score_by_actual),
+                      clock=clock)
+        register_node(s, "node-a")
+        register_node(s, "node-b")
+        kube.watch_pods(s.on_pod_event)
+        # Identical GRANTED state; measured state differs: node-a's
+        # chips are all busy, node-b reports but sits idle.  (Both nodes
+        # MUST report: an unmonitored node gets no bonus at all.)
+        s.ledger.record("node-a", [counter_row(
+            "u0_loud", chips=4, active=True, chip_seconds=100.0)])
+        s.ledger.record("node-b", [counter_row(
+            "u0b_quiet", chips=1, active=False, chip_seconds=0.0)])
+        return kube, s
+
+    def test_prefers_measured_idle_node(self):
+        kube, s = self._fleet(score_by_actual=True)
+        try:
+            pod = tpu_pod("p1", "u1")
+            kube.create_pod(pod)
+            r = s.filter(pod, ["node-a", "node-b"])
+            assert r.node == "node-b"
+        finally:
+            s.close()
+
+    def test_serial_path_applies_the_same_signal(self):
+        kube, s = self._fleet(score_by_actual=True)
+        s.cfg = Config(score_by_actual=True, optimistic_commit=False)
+        try:
+            pod = tpu_pod("p1", "u1")
+            kube.create_pod(pod)
+            r = s.filter(pod, ["node-a", "node-b"])
+            assert r.node == "node-b"
+        finally:
+            s.close()
+
+    def test_unmonitored_node_gets_no_bonus(self):
+        """'Unmonitored' is not 'idle': a node with no fresh usage
+        reports must read as unknown (bonus 0), or the signal would
+        steer placement toward exactly the nodes it knows nothing
+        about.  Likewise a node whose only accounts went stale (deleted
+        pods retained in the ledger) is unknown, not busy."""
+        clock = SimClock()
+        led = UsageLedger(clock=clock)
+        assert led.node_busy_chips("never-reported") is None
+        assert eff_mod.actual_idle_bonus(led, "never-reported", 8) == 0.0
+        led.record("n1", [counter_row("u1_p", chips=2, active=True)])
+        assert led.node_busy_chips("n1") == 2
+        assert eff_mod.actual_idle_bonus(led, "n1", 4) == 0.5
+        clock.advance(120.0)  # past the 60s freshness horizon
+        assert led.node_busy_chips("n1") is None
+        assert eff_mod.actual_idle_bonus(led, "n1", 4) == 0.0
+
+    def test_off_by_default_no_ledger_influence(self):
+        # Same fleet, same ledger data, flag off: the decision must
+        # match a ledger-free scheduler's — the signal is inert unless
+        # opted into.
+        kube1, s1 = self._fleet(score_by_actual=False)
+        kube2, s2 = self._fleet(score_by_actual=False)
+        s2.ledger = UsageLedger()  # empty ledger
+        try:
+            pod = tpu_pod("p1", "u1")
+            kube1.create_pod(pod)
+            kube2.create_pod(pod)
+            r1 = s1.filter(pod, ["node-a", "node-b"])
+            r2 = s2.filter(pod, ["node-a", "node-b"])
+            assert r1.node == r2.node
+        finally:
+            s1.close()
+            s2.close()
+
+
+# -- rescuer: flag, never evict ---------------------------------------------
+class TestIdleGrantFlagging:
+    def _env(self):
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        clock = SimClock()
+        s = Scheduler(kube, Config(idle_grant_grace_s=60.0,
+                                   efficiency_window_s=120.0),
+                      clock=clock)
+        register_node(s, "node-a")
+        return kube, s, clock
+
+    def test_idle_oversubscribed_grant_flagged_once_not_evicted(self):
+        _, s, clock = self._env()
+        try:
+            s.pods.add_pod(grant("u1", "squat", "node-a", chips=2))
+            s.ledger.record("node-a", [counter_row(
+                "u1_squat", chips=2, active=False, oversub=True)])
+            clock.advance(120.0)
+            s.ledger.record("node-a", [counter_row(
+                "u1_squat", chips=2, active=False, oversub=True)])
+            actions = s.rescuer.sweep()
+            flags = [a for a in actions if a["kind"] == "idle-grant"]
+            assert [f["pod"] for f in flags] == ["squat"]
+            # Flag, not eviction: the grant is untouched.
+            assert s.pods.get("u1") is not None
+            # Idempotent while it stays idle.
+            assert not [a for a in s.rescuer.sweep()
+                        if a["kind"] == "idle-grant"]
+            # Resumes dispatching → flag clears → a relapse re-reports.
+            s.ledger.record("node-a", [counter_row(
+                "u1_squat", chips=2, active=True, chip_seconds=5.0,
+                oversub=True)])
+            s.rescuer.sweep()
+            assert "u1" not in s.rescuer.idle_flagged
+            clock.advance(120.0)
+            s.ledger.record("node-a", [counter_row(
+                "u1_squat", chips=2, active=False, chip_seconds=5.0,
+                oversub=True)])
+            assert [a["kind"] for a in s.rescuer.sweep()] == ["idle-grant"]
+        finally:
+            s.close()
+
+    def test_idle_but_not_oversubscribed_is_metric_only(self):
+        _, s, clock = self._env()
+        try:
+            s.pods.add_pod(grant("u1", "quiet", "node-a"))
+            s.ledger.record("node-a", [counter_row(
+                "u1_quiet", chips=1, active=False, oversub=False)])
+            clock.advance(120.0)
+            s.ledger.record("node-a", [counter_row(
+                "u1_quiet", chips=1, active=False, oversub=False)])
+            assert not [a for a in s.rescuer.sweep()
+                        if a["kind"] == "idle-grant"]
+            # ...but it still counts in vtpu_idle_grants / showback.
+            assert [p.name for p in s.grant_efficiency().idle] == ["quiet"]
+        finally:
+            s.close()
+
+
+# -- showback + vtpu-report + /usagez ---------------------------------------
+class TestShowback:
+    def _scheduler_with_usage(self):
+        kube = FakeKube()
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        clock = SimClock()
+        s = Scheduler(kube, Config(efficiency_window_s=100.0), clock=clock)
+        register_node(s, "node-a")
+        s.pods.add_pod(grant("u1", "train", "node-a", chips=2,
+                             namespace="ml"))
+        s.pods.add_pod(grant("u2", "squat", "node-a", chips=1,
+                             namespace="web"))
+        # Granted but NEVER reported (node without a monitor): must be
+        # charged in its namespace's granted column, not flattered away.
+        s.pods.add_pod(grant("u4", "dark", "node-a", chips=3,
+                             namespace="dark"))
+        for i in range(11):
+            s.ledger.record("node-a", [
+                counter_row("u1_train", chips=2, active=True,
+                            chip_seconds=float(20 * i)),
+                counter_row("u2_squat", chips=1, active=False),
+                # An account whose pod never reached the registry
+                # (deleted, or another scheduler's): still shown.
+                counter_row("u3_ghost", chips=1, active=True,
+                            chip_seconds=float(i)),
+            ])
+            clock.advance(10.0)
+        return s
+
+    def test_export_usage_namespaced_rows(self):
+        s = self._scheduler_with_usage()
+        try:
+            export = s.export_usage()
+            ns = {r["namespace"]: r for r in export["namespaces"]}
+            assert ns["ml"]["chip_seconds"] > 0
+            assert ns["ml"]["efficiency"] > 0.9
+            assert ns["web"]["chip_seconds"] == 0.0
+            assert ns["web"]["efficiency"] == 0.0
+            assert ns["(unresolved)"]["pods"] == 1
+            # Never-reported grant: charged at the full window with zero
+            # measured usage — efficiency 0, never a flattering None/1.0
+            # at the rollup (per-pod stays None = unknown).
+            assert ns["dark"]["granted_chip_seconds"] == 3 * 100.0
+            assert ns["dark"]["efficiency"] == 0.0
+            assert export["fleet"][
+                "unmeasured_granted_chip_seconds"] == 3 * 100.0
+            assert export["fleet"]["efficiency"] is not None
+            pods = {r["pod"]: r for r in export["pods"]}
+            assert pods["train"]["live"] and pods["train"]["namespace"] == "ml"
+            assert pods["dark"]["efficiency"] is None
+            assert not pods["ghost"]["live"]
+            # Windowed query narrows the accrual.
+            narrow = s.export_usage(window_s=30.0)
+            wide_ml = ns["ml"]["chip_seconds"]
+            narrow_ml = {r["namespace"]: r
+                         for r in narrow["namespaces"]}["ml"]["chip_seconds"]
+            assert 0 < narrow_ml < wide_ml
+        finally:
+            s.close()
+
+    def test_vtpu_report_formats(self):
+        from k8s_vgpu_scheduler_tpu.cmd.vtpu_report import (
+            NAMESPACE_COLUMNS, format_report, to_csv)
+
+        s = self._scheduler_with_usage()
+        try:
+            export = s.export_usage()
+        finally:
+            s.close()
+        text = format_report(export, pods=True)
+        assert "ml" in text and "web" in text
+        assert "fleet efficiency" in text
+        csv_text = to_csv(export["namespaces"], NAMESPACE_COLUMNS)
+        lines = csv_text.strip().splitlines()
+        assert lines[0] == ",".join(NAMESPACE_COLUMNS)
+        assert len(lines) == 1 + len(export["namespaces"])
+
+    def test_usagez_endpoint(self):
+        from k8s_vgpu_scheduler_tpu.scheduler.routes import ExtenderServer
+
+        s = self._scheduler_with_usage()
+        server = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(f"{base}/usagez", timeout=10) as r:
+                export = json.load(r)
+            assert {row["namespace"] for row in export["namespaces"]} \
+                >= {"ml", "web"}
+            with urllib.request.urlopen(f"{base}/usagez?window=30",
+                                        timeout=10) as r:
+                assert json.load(r)["window_s"] == 30.0
+        finally:
+            server.stop()
+            s.close()
+
+
+# -- metrics exposition ------------------------------------------------------
+class TestAccountingMetrics:
+    def test_cluster_collector_emits_accounting_families(self):
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import (
+            ClusterCollector)
+
+        kube = FakeKube()
+        clock = SimClock()
+        s = Scheduler(kube, Config(efficiency_window_s=100.0,
+                                   idle_grant_grace_s=60.0), clock=clock)
+        register_node(s, "node-a")
+        s.pods.add_pod(grant("u1", "train", "node-a", namespace="ml"))
+        s.pods.add_pod(grant("u2", "squat", "node-a", namespace="web"))
+        for i in range(8):
+            s.ledger.record("node-a", [
+                counter_row("u1_train", chips=1, active=True,
+                            chip_seconds=float(10 * i)),
+                counter_row("u2_squat", chips=1, active=False),
+            ])
+            clock.advance(10.0)
+        try:
+            registry = CollectorRegistry()
+            registry.register(ClusterCollector(s))
+            text = generate_latest(registry).decode()
+        finally:
+            s.close()
+        assert ('vtpu_usage_chip_seconds_total{podname="train",'
+                'podnamespace="ml"} 70.0') in text
+        assert 'vtpu_usage_hbm_byte_seconds_total{podname="train"' in text
+        assert ('vtpu_grant_efficiency_ratio{podname="squat",'
+                'podnamespace="web"} 0.0') in text
+        assert "vtpu_idle_grants 1.0" in text
+
+    def test_node_collector_emits_sampler_counters(self):
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from k8s_vgpu_scheduler_tpu.monitor.metrics import NodeCollector
+
+        clock = SimClock()
+        loop = FakeLoop()
+        loop.containers["u1_podA"] = FakeState(
+            FakeRegion(chips=2, used=10 * MIB), active=True,
+            key="u1_podA")
+        sampler = UsageSampler(loop, clock=clock)
+        sampler.sample()
+        clock.advance(5.0)
+        sampler.sample()
+        registry = CollectorRegistry()
+        registry.register(NodeCollector(loop, None, "node-a",
+                                        sampler=sampler))
+        text = generate_latest(registry).decode()
+        assert ('vtpu_usage_chip_seconds_total{container="u1_podA"} 10.0'
+                in text)
+        assert ('vtpu_usage_hbm_byte_seconds_total{container="u1_podA"}'
+                in text)
+        assert 'vtpu_usage_throttled_seconds_total' in text
+        assert 'vtpu_usage_oversub_spill_seconds_total' in text

@@ -225,6 +225,79 @@ class TestHostPidMapping:
         assert loop.containers["uid1_nsgc"].region.used(0) == 0
 
 
+class TestAgingGcInteraction:
+    """Satellite pin: activity aging + NSpid GC under pid reuse.  A host
+    pid recycled after SIGKILL must not resurrect a dead slot — the
+    NSpid-tail match alone is never sufficient, the region-mapping
+    confirmation must gate it — or the new accounting ledger would keep
+    metering chip-seconds for a process that no longer exists."""
+
+    def test_reused_pid_does_not_resurrect_dead_slot(self, loop_env,
+                                                     monkeypatch):
+        import k8s_vgpu_scheduler_tpu.monitor.feedback as fb
+
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid1_reuse", ["chip-0"])
+        loop.rescan()
+        region = loop.containers["uid1_reuse"].region
+        pids = region.proc_pids()
+        assert pids and region.used(0) > 0
+        victim_pid = pids[0]
+        w.kill()
+        # Hostile pid reuse: an unrelated LIVE process now owns a host
+        # pid whose NSpid tail matches the dead workload's container pid
+        # (exactly what a recycled pid in another container looks like).
+        dummy = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(120)"])
+        try:
+            monkeypatch.setattr(
+                fb, "build_nspid_index",
+                lambda proc_root="/proc": {victim_pid: [dummy.pid]})
+            # Poison the cross-tick cache too: a stale cached host pid
+            # must be re-confirmed against the region mapping, not
+            # trusted (the dummy does NOT map this region).
+            loop._hostpid_cache[("uid1_reuse", victim_pid)] = dummy.pid
+            cleared = loop.gc_dead_procs()
+            assert cleared >= 1
+            assert loop.containers["uid1_reuse"].region.used(0) == 0
+            assert ("uid1_reuse", victim_pid) not in loop._hostpid_cache
+        finally:
+            dummy.kill()
+            dummy.wait(timeout=30)
+
+    def test_sigkill_gc_stops_counter_accrual(self, loop_env):
+        """After SIGKILL + slot GC the accounting sampler must stop
+        accruing HBM-byte-seconds for the dead slot (it keeps the totals
+        already earned — integrals never rewind)."""
+        from k8s_vgpu_scheduler_tpu.accounting import UsageSampler
+
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid1_meter", ["chip-0"])
+        sampler = UsageSampler(loop)
+        loop.rescan()
+        loop.observe()
+        sampler.sample()
+        time.sleep(0.1)
+        loop.observe()
+        sampler.sample()
+        before = sampler.get("uid1_meter")
+        assert before.hbm_byte_seconds > 0
+        w.kill()
+        # Injected liveness (the documented test seam): the SIGKILLed
+        # process is dead, gc clears its leaked slot.
+        loop.gc_dead_procs(pid_alive=lambda p: False)
+        assert loop.containers["uid1_meter"].region.used(0) == 0
+        sampler.sample()
+        baseline = sampler.get("uid1_meter").hbm_byte_seconds
+        assert baseline >= before.hbm_byte_seconds  # monotonic
+        time.sleep(0.1)
+        loop.observe()
+        sampler.sample()
+        after = sampler.get("uid1_meter")
+        # Dead slot: zero occupancy → zero further byte-second accrual.
+        assert after.hbm_byte_seconds == baseline
+
+
 class TestNodeRPC:
     """NodeTPUInfo gRPC over live regions (reference ships only a stub —
     pathmonitor.go:89–113; ours answers with real snapshots)."""
@@ -258,6 +331,45 @@ class TestNodeRPC:
             # key filter
             reply = stub(pb.GetNodeTPURequest(ctrkey="nope"), timeout=10)
             assert len(reply.usages) == 0
+        finally:
+            server.stop()
+            w.stop()
+
+    def test_report_usage_piggybacks_on_reply(self, loop_env):
+        """The accounting counters ride the SAME GetNodeTPU round-trip
+        (no extra endpoint): a server wired with a sampler answers with
+        a ReportUsage carrying the monotonic integrals."""
+        import grpc
+
+        from k8s_vgpu_scheduler_tpu.accounting import UsageSampler
+        from k8s_vgpu_scheduler_tpu.api import noderpc_pb2 as pb
+        from k8s_vgpu_scheduler_tpu.monitor.noderpc import (
+            NodeTPUInfoServer,
+            node_tpu_stub,
+        )
+
+        tmp_path, loop = loop_env
+        w = Workload(tmp_path, "uid5_podU", ["chip-3"], mem=1000)
+        sampler = UsageSampler(loop)
+        server = NodeTPUInfoServer(loop, "node-test", sampler=sampler)
+        try:
+            loop.rescan()
+            loop.observe()
+            sampler.sample()
+            time.sleep(0.05)
+            loop.observe()
+            sampler.sample()
+            port = server.serve(0)
+            stub = node_tpu_stub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+            reply = stub(pb.GetNodeTPURequest(), timeout=10)
+            assert reply.usage.nodeid == "node-test"
+            counters = {c.ctrkey: c for c in reply.usage.counters}
+            assert "uid5_podU" in counters
+            c = counters["uid5_podU"]
+            assert c.chips == 1
+            # 100 MiB held across a real interval: byte-seconds accrued.
+            assert c.hbm_byte_seconds > 0
+            assert c.window_s > 0
         finally:
             server.stop()
             w.stop()

@@ -16,7 +16,9 @@ from prometheus_client import CollectorRegistry, generate_latest
 from k8s_vgpu_scheduler_tpu.cmd.vtpu_smi import (
     cluster_info,
     format_cluster,
+    format_top,
     parse_prom,
+    top_info,
 )
 from k8s_vgpu_scheduler_tpu.scheduler.metrics import ClusterCollector
 from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
@@ -48,11 +50,14 @@ class _SchedulerStub:
     workers_busy_peak = 5
 
     def __init__(self):
-        # Real fleet-health components (not stubs): the collector reads
-        # leases.states() / quarantine counters / rescuer.rescued_total,
+        # Real fleet-health AND accounting components (not stubs): the
+        # collector reads leases.states() / quarantine counters /
+        # rescuer.rescued_total / ledger accounts / the efficiency join,
         # and using the real objects breaks this test if that surface
         # drifts.  Rescuer only dereferences the scheduler inside sweep(),
         # which the collector never calls.
+        from k8s_vgpu_scheduler_tpu.accounting import (
+            EfficiencyConfig, UsageLedger)
         from k8s_vgpu_scheduler_tpu.health import (
             ChipQuarantine, LeaseTracker, Rescuer)
 
@@ -60,6 +65,19 @@ class _SchedulerStub:
         self.leases.beat("node-a")
         self.quarantine = ChipQuarantine()
         self.rescuer = Rescuer(self)
+        self._now = [1000.0]
+        self.ledger = UsageLedger(clock=lambda: self._now[0])
+        self.efficiency_cfg = EfficiencyConfig(window_s=300.0,
+                                               idle_grace_s=600.0)
+        # Two reports 60 virtual seconds apart so the efficiency join has
+        # a window to compute a ratio over (30/60 chip-seconds = 0.5).
+        row = {"ctrkey": "u1_train-a", "chips": 1, "active": True,
+               "oversubscribe": False, "chip_seconds": 90.0,
+               "hbm_byte_seconds": 5.0e9, "throttled_seconds": 0.0,
+               "oversub_spill_seconds": 0.0, "window_s": 120.0}
+        self.ledger.record("node-a", [row])
+        self._now[0] += 60.0
+        self.ledger.record("node-a", [dict(row, chip_seconds=120.0)])
         self.pods = _Pods([
             PodInfo(uid="u1", name="train-a", namespace="default",
                     node="node-a",
@@ -79,6 +97,13 @@ class _SchedulerStub:
                        "chip-1": usage("chip-1", 1000, 0, 1)},
             "node-b": {"chip-0": usage("chip-0", 0, 0, 0)},
         }
+
+    def grant_efficiency(self, now=None):
+        from k8s_vgpu_scheduler_tpu.accounting import efficiency as eff
+
+        return eff.grant_efficiency(self.pods.list_pods(), self.ledger,
+                                    self.efficiency_cfg,
+                                    now=self.ledger.now())
 
 
 def exposition() -> str:
@@ -137,6 +162,45 @@ def test_parse_prom_timestamps_and_spacey_labels():
     # Quoted label values may contain commas (relabelled joins).
     assert metrics["joined"] == [({"vals": "a,b,c"}, 2.0)]
     assert metrics["plain_ts_int"] == [({}, 4.0)]
+
+
+def test_parse_prom_adversarial_label_values():
+    """Label values containing ``=``, ``,``, braces, escaped quotes and
+    newline escapes must parse — not be silently dropped or truncated
+    (a federated endpoint relabelling PromQL selectors into labels
+    produces exactly these shapes)."""
+    metrics = parse_prom(
+        'sel{expr="rate(x{a=\\"b\\"}[5m])",q="a=b,c=d"} 1\n'
+        'braced{v="x}y{z"} 2\n'
+        'esc{v="line1\\nline2",w="back\\\\slash"} 3\n'
+        'spaced { a = "b" } 4\n')
+    assert metrics["sel"] == [
+        ({"expr": 'rate(x{a="b"}[5m])', "q": "a=b,c=d"}, 1.0)]
+    assert metrics["braced"] == [({"v": "x}y{z"}, 2.0)]
+    assert metrics["esc"] == [
+        ({"v": "line1\nline2", "w": "back\\slash"}, 3.0)]
+    assert metrics["spaced"] == [({"a": "b"}, 4.0)]
+
+
+def test_top_view_joins_actual_against_granted():
+    """vtpu-smi top: the waste view over the extender's accounting
+    metrics — real collector exposition in, sorted rows out."""
+    info = top_info(parse_prom(exposition()))
+    pods = {(p["namespace"], p["name"]): p for p in info["pods"]}
+    t = pods[("default", "train-a")]
+    assert t["chips"] == 1 and t["granted_mib"] == 3000
+    assert t["chip_seconds"] == 120.0
+    # 30 chip-seconds accrued over the 60s the ledger window covers.
+    assert t["efficiency"] == 0.5
+    assert t["waste_chips"] == 0.5
+    # train-b has no usage reports: unknown efficiency sinks to the
+    # bottom (unknown is not the same as idle).
+    assert info["pods"][-1]["name"] == "train-b"
+    assert info["pods"][-1]["efficiency"] is None
+    assert info["pods"][-1]["waste_chips"] is None
+    assert info["idle_grants"] == 0
+    text = format_top(info)
+    assert "default/train-a" in text and "idle grant(s)" in text
 
 
 def test_grafana_dashboard_uses_real_metric_names():
