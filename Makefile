@@ -1,8 +1,9 @@
 # Development entrypoints (the reference drives everything through
 # hack/build.sh + a Makefile; here each surface is one target).
 
-.PHONY: all native test test-fast test-slow chaos-smoke lint-dashboards \
-        dryrun scenarios controlplane bench-controlplane bench wheel clean
+.PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
+        lint-dashboards dryrun scenarios controlplane bench-controlplane \
+        bench wheel clean
 
 all: native
 
@@ -24,6 +25,17 @@ test-slow: native             ## model/parallelism tier (compiles networks)
 # clock, fixed seeds), so a failure here is a real regression, not flake.
 chaos-smoke: native           ## fault-injection suite in the simulator
 	python -m pytest tests/ -q -m chaos
+
+# Contended two-tenant + gang capacity-queue scenario through the REAL
+# admission loop on the virtual clock (docs/quota.md).  Deterministic
+# (fixed arrival schedule, SimClock, uid tie-breaks everywhere), so the
+# verdict gates CI: fair-share convergence to the configured weights,
+# utilization at the FIFO baseline, reclaim victims all borrowed, zero
+# double-booking.
+quota-sim:                    ## capacity-queue fairness A/B in the simulator
+	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
+	    --workload examples/workload-queueing.json --nodes 2 --chips 4 --json \
+	  | python -c "import json,sys; v = json.load(sys.stdin)['queueing']['verdict']; assert v['ok'], v; print('quota-sim:', v)"
 
 # Dashboard/alert ↔ code pinning, standalone (the same tests also run in
 # the default tier): every panel/alert expression must name a metric a

@@ -111,6 +111,35 @@ def parse_args(argv=None):
                    help="seconds a grant must accrue ~no chip-seconds "
                         "before it is surfaced as an idle grant "
                         "(vtpu_idle_grants; flagged, never evicted)")
+    # Multi-tenant capacity queues (quota/; docs/quota.md).
+    p.add_argument("--quota-config", default="",
+                   help="path to the capacity-queue config JSON "
+                        "({'queues': [{'name', 'namespaces', 'cohort', "
+                        "'weight', 'quota': {'chips', 'hbm_mib'}, "
+                        "'borrow_limit_chips', ...}]}); empty = the "
+                        "admission layer is off and every namespace "
+                        "bypasses it")
+    p.add_argument("--fair-share-usage-informed", action="store_true",
+                   help="fold measured grant efficiency (the accounting "
+                        "ledger) into fair-share weights: chronically "
+                        "idle tenants are demoted toward a floor")
+    p.add_argument("--admission-interval", type=float, default=2.0,
+                   help="capacity-queue admission loop period (seconds)")
+    p.add_argument("--queue-reclaim-grace", type=float, default=15.0,
+                   help="seconds a released pod may sit unplaced before "
+                        "its under-nominal queue reclaims borrowed "
+                        "grants (also the per-queue reclaim floor)")
+    p.add_argument("--queue-fleet-headroom", type=float, default=1.0,
+                   help="release-throttle multiplier over registered "
+                        "whole chips; raise above 1.0 on fleets whose "
+                        "split-count sharing packs many grants per chip")
+    p.add_argument("--no-queue-backfill", action="store_true",
+                   help="disable gang-aware backfill (small pods "
+                        "admitting ahead of an accumulating gang)")
+    p.add_argument("--no-reclaim", action="store_true",
+                   help="never reclaim borrowed grants for starved "
+                        "in-quota tenants (fair-share ordering and "
+                        "borrowing stay on)")
     p.add_argument("--no-rescue", action="store_true",
                    help="disable the background rescue sweep (failure "
                         "detection and quarantine gating stay on; grants "
@@ -154,6 +183,36 @@ def resolve_watch_and_resync(no_watch: bool, client, resync_seconds):
     return watch_enabled, resync_seconds
 
 
+def load_quota_config(path: str) -> tuple:
+    """--quota-config file → Config.quota_queues tuple.  JSON first,
+    YAML fallback (the chart renders values into quota.yaml).
+    Validation is loud and at boot (parse_quota_config raises on
+    duplicate queues or doubly-governed namespaces): a misconfigured
+    quota must not come up half-governing."""
+    if not path:
+        return ()
+    import json
+
+    from ..quota.queues import parse_quota_config
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        doc = yaml.safe_load(text)
+    if doc is None:
+        return ()  # empty / comments-only file = quota off
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"--quota-config {path}: expected a mapping with a "
+            f"'queues' list, got {type(doc).__name__}")
+    parse_quota_config(doc)  # raise early on bad config
+    return tuple(doc.get("queues", []))
+
+
 def build_config(args) -> Config:
     return Config(
         resources=ResourceNames(
@@ -185,6 +244,13 @@ def build_config(args) -> Config:
         score_by_actual=args.score_by_actual,
         efficiency_window_s=args.efficiency_window,
         idle_grant_grace_s=args.idle_grant_grace,
+        quota_queues=load_quota_config(args.quota_config),
+        fair_share_usage_informed=args.fair_share_usage_informed,
+        admission_interval_s=args.admission_interval,
+        queue_reclaim_grace_s=args.queue_reclaim_grace,
+        queue_fleet_headroom=args.queue_fleet_headroom,
+        enable_queue_backfill=not args.no_queue_backfill,
+        enable_reclaim=not args.no_reclaim,
     )
 
 
@@ -241,6 +307,10 @@ def main(argv=None):
     # ctor) so embedders/tests own their own sweep cadence.
     if scheduler.cfg.enable_rescue:
         scheduler.rescuer.start()
+    # Capacity-queue admission loop: a no-op (start refuses) without a
+    # quota config.  After the boot reconcile, so held/admitted state was
+    # already re-learned from the queue-state annotations (WAL).
+    scheduler.admission.start()
 
     watch_stop = threading.Event()
     if watch_enabled:
@@ -287,6 +357,7 @@ def main(argv=None):
     except KeyboardInterrupt:
         watch_stop.set()
         scheduler.rescuer.stop()
+        scheduler.admission.stop()
         http_server.stop()
         grpc_server.stop(grace=2)
 

@@ -51,6 +51,24 @@ The report then answers the capacity question UNDER FAILURE: which pods
 were rescued off the dead/quarantined hardware, whether they re-placed on
 the survivors, and that no chip was ever overbooked during the rescue.
 
+A workload may instead carry a ``queueing`` section — a contended
+multi-tenant scenario replayed through the REAL capacity-queue admission
+loop (quota/) on the virtual clock, A/B against a FIFO baseline with the
+admission layer off.  Arrivals create pods over time, placed pods run
+for their declared runtime and exit, reclaim victims checkpoint and exit
+after a delay, and the report answers the fairness question: do admitted
+chip-seconds converge to the configured weights, does backfill keep
+utilization at the FIFO level, and did reclaim ever touch an in-quota
+grant:
+
+    {"queueing": {
+       "queues": [{"name": "tenant-a", "namespaces": ["tenant-a"],
+                   "cohort": "main", "weight": 3,
+                   "quota": {"chips": 6}, "borrow_limit_chips": 2}, ...],
+       "arrivals": [{"name": "a", "namespace": "tenant-a", "tpu": 2,
+                     "count": 40, "at_s": 0, "runtime_s": 40}, ...],
+       "horizon_s": 600, "tick_s": 5, "measure_from_s": 180}}
+
 Usage:
     vtpu-simulate --nodes 4 --chips 8 --hbm 16384 --mesh 4x2 \
                   --workload workload.json [--policy binpack] [--json]
@@ -168,6 +186,24 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
     live_cfg = (fleet_export or {}).get("config", {})
     policy = policy or live_cfg.get("node_scheduler_policy") or "spread"
     topology_policy = live_cfg.get("topology_policy", "best-effort")
+    queueing = workload.get("queueing")
+    if queueing:
+        # A queueing scenario is a self-contained time-stepped A/B (it
+        # builds its own fair and FIFO schedulers on the virtual clock);
+        # the plain placement replay below would double-place its pods.
+        result = run_queueing_phase(
+            queueing, nodes=nodes, chips=chips, hbm=hbm, mesh=mesh,
+            generation=generation, policy=policy or "spread")
+        return {
+            "fleet": {"nodes": nodes, "chips_per_node": chips,
+                      "hbm_mib": hbm, "mesh": list(mesh),
+                      "policy": policy or "spread"},
+            "placed": [], "pending": [], "chips": {},
+            "hbm_allocated_fraction": 0.0,
+            "fits": bool(result["verdict"]["ok"]),
+            "queueing": result,
+        }
+
     chaos = workload.get("chaos")
     accounting = workload.get("accounting")
     # A chaos or accounting scenario runs on a virtual clock so minutes of
@@ -396,6 +432,252 @@ def run_accounting_phase(s: Scheduler, workload: dict, spec: dict,
     }
 
 
+# --- capacity-queue A/B (quota/; docs/quota.md) ------------------------------
+
+def _arrival_schedule(spec: dict) -> List[dict]:
+    """Flatten the arrivals list into per-pod records sorted by arrival
+    time (uid tie-break — the whole replay must be order-deterministic)."""
+    out = []
+    for entry in spec.get("arrivals", []):
+        count = int(entry.get("count", 1))
+        at = float(entry.get("at_s", 0.0))
+        every = float(entry.get("every_s", 0.0))
+        for i in range(count):
+            out.append({
+                "entry": entry,
+                "idx": i,
+                "name": f"{entry['name']}-{i}",
+                "namespace": entry.get("namespace", "sim"),
+                "at_s": at + i * every,
+                "runtime_s": float(entry.get("runtime_s", 60.0)),
+            })
+    out.sort(key=lambda a: (a["at_s"], a["name"]))
+    return out
+
+
+def _queue_spec_pod(arrival: dict, governed_queue: Optional[str]) -> dict:
+    """Pod manifest for one arrival — the webhook's mutations applied by
+    hand (the simulator has no admission webhook in the path): queue +
+    held-state annotations when governed, gang membership, and the
+    optional runtime estimate the backfill rule reads."""
+    from ..quota.queues import (
+        QUEUE_ANNOTATION,
+        QUEUE_STATE_ANNOTATION,
+        RUNTIME_ESTIMATE_ANNOTATION,
+        STATE_HELD,
+    )
+
+    entry = arrival["entry"]
+    pod = spec_pod(entry, arrival["idx"])
+    pod["metadata"]["namespace"] = arrival["namespace"]
+    pod["metadata"]["uid"] = f"uid-{arrival['namespace']}-{arrival['name']}"
+    anns = pod["metadata"]["annotations"]
+    if governed_queue is not None:
+        anns[QUEUE_ANNOTATION] = governed_queue
+        anns[QUEUE_STATE_ANNOTATION] = STATE_HELD
+    if entry.get("declare_runtime"):
+        anns[RUNTIME_ESTIMATE_ANNOTATION] = str(arrival["runtime_s"])
+    return pod
+
+
+def _run_queue_sim(spec: dict, quota_on: bool, *, nodes: int, chips: int,
+                   hbm: int, mesh, generation: str, policy: str) -> dict:
+    """One time-stepped replay (fair or FIFO) through the real Scheduler
+    + admission loop on a SimClock.  Placed pods run for their declared
+    runtime and exit; reclaim victims 'checkpoint' (are deleted) after
+    ``checkpoint_delay_s`` — the in-container watch's role, played by
+    the harness."""
+    from ..quota.queues import queue_for_namespace
+    from ..scheduler.preempt import PREEMPT_ANNOTATION
+
+    horizon = float(spec.get("horizon_s", 600.0))
+    tick = float(spec.get("tick_s", 5.0))
+    measure_from = float(spec.get("measure_from_s", horizon / 3))
+    checkpoint_delay = float(spec.get("checkpoint_delay_s", tick))
+    queues = tuple(spec.get("queues", ())) if quota_on else ()
+
+    clock = SimClock()
+    kube = FakeKube()
+    cfg = Config(node_scheduler_policy=policy,
+                 quota_queues=queues,
+                 queue_reclaim_grace_s=float(
+                     spec.get("reclaim_grace_s", 2 * tick)),
+                 fair_share_usage_informed=bool(
+                     spec.get("usage_informed", False)))
+    s = Scheduler(kube, cfg, clock=clock)
+    names = build_fleet(s, kube, nodes, chips, hbm, mesh, generation)
+    fleet_chips = nodes * chips
+    kube.watch_pods(s.on_pod_event)
+
+    schedule = _arrival_schedule(spec)
+    ns_queue = {a["namespace"]: (queue_for_namespace(queues,
+                                                     a["namespace"]).name
+                                 if quota_on and queue_for_namespace(
+                                     queues, a["namespace"]) else None)
+                for a in schedule}
+    next_arrival = 0
+    live: Dict[str, dict] = {}       # name -> arrival record
+    placed_at: Dict[str, float] = {}
+    preempt_seen: Dict[str, float] = {}
+    chip_seconds: Dict[str, float] = {}   # namespace -> measured window
+    busy_seconds = 0.0                     # fleet, measured window
+    admit_actions: List[dict] = []
+    reclaim_actions: List[dict] = []
+    reclaim_victims_borrowed = True
+    overbooked: List[str] = []
+
+    steps = int(round(horizon / tick))
+    t0 = clock()  # SimClock's epoch is arbitrary; the scenario runs on
+    for _step in range(steps):  # elapsed time from here.
+        now = clock() - t0
+        # 1. Arrivals.
+        while next_arrival < len(schedule) \
+                and schedule[next_arrival]["at_s"] <= now:
+            a = schedule[next_arrival]
+            next_arrival += 1
+            kube.create_pod(_queue_spec_pod(a, ns_queue[a["namespace"]]))
+            live[a["name"]] = a
+        # 2. Completions.
+        for name in [n for n, t0 in placed_at.items()
+                     if t0 + live[n]["runtime_s"] <= now]:
+            a = live.pop(name)
+            placed_at.pop(name)
+            kube.delete_pod(a["namespace"], name)
+        # 3. Checkpointing reclaim victims exit after the delay.
+        for pod in kube.list_pods():
+            anns = pod.get("metadata", {}).get("annotations", {})
+            name = pod["metadata"]["name"]
+            if anns.get(PREEMPT_ANNOTATION):
+                first = preempt_seen.setdefault(name, now)
+                if now - first >= checkpoint_delay and name in live:
+                    a = live.pop(name)
+                    placed_at.pop(name, None)
+                    kube.delete_pod(a["namespace"], name)
+            else:
+                preempt_seen.pop(name, None)
+        # 4. Admission.  Every reclaim victim must come out of capacity
+        # its donor queue held OVER nominal at plan time ("reclaim only
+        # ever evicts borrowed grants") — the loop records that amount
+        # per victim, the verdict enforces it.
+        if quota_on:
+            for act in s.admission.tick():
+                if act["kind"] == "admit":
+                    admit_actions.append(dict(act, at_s=now))
+                elif act["kind"] == "reclaim":
+                    reclaim_actions.append(dict(act, at_s=now))
+                    for v in act["victims"]:
+                        if v.get("donor_borrowed", 0) < v["chips"]:
+                            reclaim_victims_borrowed = False
+        # 5. Filter pass over unplaced pods (kube-scheduler's retry of
+        # unschedulable pods, one pass per tick).
+        for name, a in sorted(live.items()):
+            if name in placed_at:
+                continue
+            try:
+                pod = kube.get_pod(a["namespace"], name)
+            except Exception:  # noqa: BLE001 — deleted this tick
+                continue
+            r = s.filter(pod, names)
+            if r.node:
+                s.bind(a["namespace"], name, pod["metadata"]["uid"],
+                       r.node)
+                nodelock.release_node(kube, r.node)
+                placed_at[name] = now
+        # 6. Accrue admitted chip-seconds + the double-booking invariant.
+        if now >= measure_from:
+            busy = 0
+            for p in s.pods.list_pods():
+                n_chips = sum(len(c) for c in p.devices)
+                busy += n_chips
+                chip_seconds[p.namespace] = \
+                    chip_seconds.get(p.namespace, 0.0) + n_chips * tick
+            busy_seconds += busy * tick
+        bad = overbooked_chips(s)
+        if bad:
+            overbooked = sorted(set(overbooked) | set(bad))
+        clock.advance(tick)
+
+    measured_window = max(tick, horizon - measure_from)
+    util = busy_seconds / (fleet_chips * measured_window) \
+        if fleet_chips else 0.0
+    s.close()
+    return {
+        "chip_seconds_by_namespace": {
+            ns: round(v, 1) for ns, v in sorted(chip_seconds.items())},
+        "utilization": round(util, 4),
+        "admitted": len(admit_actions),
+        "backfilled": sum(1 for a in admit_actions if a.get("backfilled")),
+        "reclaims": reclaim_actions,
+        "reclaim_only_borrowed": reclaim_victims_borrowed,
+        "overbooked_chips": overbooked,
+        "still_pending": sorted(n for n in live if n not in placed_at),
+        "queues": (s.quota.stats(s.pods.list_pods())["queues"]
+                   if quota_on else []),
+    }
+
+
+def run_queueing_phase(spec: dict, *, nodes: int, chips: int, hbm: int,
+                       mesh, generation: str, policy: str) -> dict:
+    """Fair-share vs FIFO A/B on the same contended arrival schedule.
+    The verdict encodes the acceptance bar: admitted chip-seconds within
+    ``weight_tolerance_pct`` of the configured weight proportions, fleet
+    utilization at or above the FIFO baseline, reclaim victims always
+    borrowed, and zero overbooked chips."""
+    fair = _run_queue_sim(spec, True, nodes=nodes, chips=chips, hbm=hbm,
+                          mesh=mesh, generation=generation, policy=policy)
+    fifo = _run_queue_sim(spec, False, nodes=nodes, chips=chips, hbm=hbm,
+                          mesh=mesh, generation=generation, policy=policy)
+
+    queues = spec.get("queues", [])
+    weight_total = sum(float(q.get("weight", 1.0)) for q in queues) or 1.0
+    measured_total = sum(
+        fair["chip_seconds_by_namespace"].get(ns, 0.0)
+        for q in queues for ns in q.get("namespaces", ()))
+    tol = float(spec.get("weight_tolerance_pct", 10.0)) / 100.0
+    shares = []
+    converged = measured_total > 0
+    for q in queues:
+        got = sum(fair["chip_seconds_by_namespace"].get(ns, 0.0)
+                  for ns in q.get("namespaces", ()))
+        share = got / measured_total if measured_total else 0.0
+        target = float(q.get("weight", 1.0)) / weight_total
+        ok = abs(share - target) <= tol
+        converged = converged and ok
+        shares.append({"queue": q["name"], "weight": q.get("weight", 1.0),
+                       "target_share": round(target, 4),
+                       "admitted_share": round(share, 4),
+                       "admitted_chip_seconds": round(got, 1),
+                       "within_tolerance": ok})
+    # Discretized replay: one tick of one pod's chips is measurement
+    # noise, not a real utilization regression.
+    utilization_ok = fair["utilization"] >= fifo["utilization"] - 0.02
+    verdict = {
+        "converged": converged,
+        "tolerance_pct": float(spec.get("weight_tolerance_pct", 10.0)),
+        "utilization_ok": utilization_ok,
+        "reclaim_only_borrowed": fair["reclaim_only_borrowed"],
+        "no_overbooking": not (fair["overbooked_chips"]
+                               or fifo["overbooked_chips"]),
+    }
+    verdict["ok"] = all(verdict[k] for k in
+                        ("converged", "utilization_ok",
+                         "reclaim_only_borrowed", "no_overbooking"))
+    return {
+        "horizon_s": float(spec.get("horizon_s", 600.0)),
+        "tick_s": float(spec.get("tick_s", 5.0)),
+        "measure_from_s": float(spec.get("measure_from_s",
+                                         float(spec.get("horizon_s",
+                                                        600.0)) / 3)),
+        "shares": shares,
+        "fair": fair,
+        "fifo": {"chip_seconds_by_namespace":
+                 fifo["chip_seconds_by_namespace"],
+                 "utilization": fifo["utilization"],
+                 "overbooked_chips": fifo["overbooked_chips"]},
+        "verdict": verdict,
+    }
+
+
 def overbooked_chips(s: Scheduler) -> List[str]:
     """Chips whose granted slots/HBM/cores exceed advertised totals — the
     invariant the rescue must never break (empty = healthy)."""
@@ -507,6 +789,35 @@ def format_report(result: dict) -> str:
         if acct["fleet_efficiency"] is not None:
             lines.append(
                 f"  fleet efficiency: {acct['fleet_efficiency']:.1%}")
+    qr = result.get("queueing")
+    if qr:
+        v = qr["verdict"]
+        lines = [
+            "capacity-queue A/B over {:.0f}s (measured from {:.0f}s):"
+            .format(qr["horizon_s"], qr["measure_from_s"]),
+            "  fair-share utilization {:.1%} vs FIFO {:.1%} ({})".format(
+                qr["fair"]["utilization"], qr["fifo"]["utilization"],
+                "OK" if v["utilization_ok"] else "REGRESSED"),
+        ]
+        for row in qr["shares"]:
+            lines.append(
+                "  {:<12s} weight {:>4.1f}: admitted share {:>5.1%} "
+                "(target {:>5.1%}) {}".format(
+                    row["queue"], row["weight"], row["admitted_share"],
+                    row["target_share"],
+                    "✓" if row["within_tolerance"] else "OFF-TARGET"))
+        lines.append(
+            "  {} reclaim plan(s), victims {}; admissions {} "
+            "({} backfilled)".format(
+                len(qr["fair"]["reclaims"]),
+                "all borrowed" if v["reclaim_only_borrowed"]
+                else "TOUCHED IN-QUOTA GRANTS",
+                qr["fair"]["admitted"], qr["fair"]["backfilled"]))
+        if qr["fair"]["overbooked_chips"]:
+            lines.append("  OVERBOOKED: "
+                         + ", ".join(qr["fair"]["overbooked_chips"]))
+        lines.append("  verdict: " + ("PASS" if v["ok"] else "FAIL"))
+        return "\n".join(lines)
     chaos = result.get("chaos")
     if chaos:
         lines.append(

@@ -3,9 +3,12 @@
 Fetches the extender's ``GET /usagez`` export (accounting/efficiency.py
 ``showback``) and emits chargeback-style rows: chip-seconds and HBM-byte-
 seconds actually consumed per namespace, granted chip-seconds for the
-same window, the efficiency ratio, and idle-grant counts.  JSON for
-pipelines, CSV for the spreadsheet the finance conversation inevitably
-happens in.
+same window, the efficiency ratio, and idle-grant counts.  When the
+scheduler runs capacity queues (quota/), ``GET /queuez`` is joined in:
+each namespace row gains its queue's nominal vs held vs borrowed chips,
+so ONE report answers "who is over quota and are they actually using
+it".  JSON for pipelines, CSV for the spreadsheet the finance
+conversation inevitably happens in.
 
 Usage:
   python -m k8s_vgpu_scheduler_tpu.cmd.vtpu_report --cluster http://sched:9443
@@ -24,24 +27,69 @@ from typing import List, Optional
 
 NAMESPACE_COLUMNS = ["namespace", "pods", "chip_seconds",
                      "hbm_byte_seconds", "granted_chip_seconds",
-                     "efficiency", "idle_grants"]
+                     "efficiency", "idle_grants",
+                     "queue", "nominal_chips", "held_chips",
+                     "borrowed_chips"]
 POD_COLUMNS = ["namespace", "pod", "node", "granted_chips", "chip_seconds",
                "hbm_byte_seconds", "window_covered_s", "efficiency",
                "idle", "live"]
 
 
-def fetch_usage(cluster: str, window: Optional[float]) -> dict:
-    import urllib.request
-
+def _base_url(cluster: str) -> str:
     url = cluster.rstrip("/")
     if "://" not in url:
         url = "http://" + url
+    return url
+
+
+def fetch_usage(cluster: str, window: Optional[float]) -> dict:
+    import urllib.request
+
+    url = _base_url(cluster)
     if not url.endswith("/usagez"):
         url += "/usagez"
     if window is not None:
         url += f"?window={window:g}"
     with urllib.request.urlopen(url, timeout=15) as r:
         return json.load(r)
+
+
+def fetch_queues(cluster: str) -> Optional[dict]:
+    """GET /queuez, or None when the scheduler predates capacity queues
+    or runs without them (the report degrades to plain showback)."""
+    import urllib.request
+
+    url = _base_url(cluster)
+    if not url.endswith("/queuez"):
+        url += "/queuez"
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            doc = json.load(r)
+    except Exception:  # noqa: BLE001 — quota is optional
+        return None
+    return doc if doc.get("enabled") else None
+
+
+def join_quota(export: dict, queues: Optional[dict]) -> dict:
+    """Annotate each namespace showback row with its governing queue's
+    quota utilization (nominal vs held vs borrowed) — the 'measured'
+    column is the row's own chip_seconds from the usage ledger."""
+    if not queues:
+        return export
+    by_ns = {}
+    for row in queues.get("queues", []):
+        for ns in row.get("namespaces", ()):
+            by_ns[ns] = row
+    for row in export.get("namespaces", []):
+        q = by_ns.get(row["namespace"])
+        if q is None:
+            continue
+        row["queue"] = q["queue"]
+        row["nominal_chips"] = q["nominal_chips"]
+        row["held_chips"] = q["held_chips"]
+        row["borrowed_chips"] = q["borrowed_chips"]
+    export["queues"] = queues.get("queues", [])
+    return export
 
 
 def to_csv(rows: List[dict], columns: List[str]) -> str:
@@ -73,6 +121,24 @@ def format_report(export: dict, pods: bool = False) -> str:
                 row["hbm_byte_seconds"], row["granted_chip_seconds"],
                 f"{100 * e:.1f}" if e is not None else "-",
                 row["idle_grants"]))
+    if export.get("queues"):
+        lines.append("+ capacity queues (nominal vs held vs measured)")
+        lines.append(
+            "| {:<14s} {:>6s} {:>7s} {:>4s} {:>8s} {:>8s} {:>7s} "
+            "{:>12s} |".format("queue", "weight", "nominal", "held",
+                               "borrowed", "pending", "share", "chip-s"))
+        ns_measured = {r["namespace"]: r["chip_seconds"]
+                       for r in export.get("namespaces", [])}
+        for q in export["queues"]:
+            measured = sum(ns_measured.get(ns, 0.0)
+                           for ns in q.get("namespaces", ()))
+            over = " OVER" if q["borrowed_chips"] > 0 else ""
+            lines.append(
+                "| {:<14s} {:>6.1f} {:>7d} {:>4d} {:>8d} {:>8d} "
+                "{:>7.3f} {:>12.1f} |{}".format(
+                    q["queue"][:14], q["weight"], q["nominal_chips"],
+                    q["held_chips"], q["borrowed_chips"], q["pending"],
+                    q["fair_share"], measured, over))
     if pods:
         lines.append("+ pods")
         for row in export.get("pods", []):
@@ -117,6 +183,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"vtpu-report: cannot fetch usage: {e}", file=sys.stderr)
         return 2
+    export = join_quota(export, fetch_queues(args.cluster))
     if args.as_json:
         print(json.dumps(export, indent=1))
     elif args.as_csv:

@@ -85,6 +85,16 @@ class KubeClient:
         """POST a v1.Binding (reference scheduler.go:250)."""
         raise NotImplementedError
 
+    def create_event(self, namespace: str, involved: dict, reason: str,
+                     message: str, type_: str = "Normal") -> None:
+        """POST a v1.Event about ``involved`` (a partial objectReference:
+        kind/name/namespace/uid) — how the quota admission loop makes
+        hold/admit/reclaim visible to `kubectl describe pod`.  Events are
+        best-effort observability; callers treat any failure (including
+        this NotImplementedError on clients without an events surface)
+        as non-fatal."""
+        raise NotImplementedError
+
     # -- nodes ----------------------------------------------------------------
     def list_nodes(self) -> List[dict]:
         raise NotImplementedError

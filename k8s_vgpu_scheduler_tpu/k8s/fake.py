@@ -38,6 +38,9 @@ class FakeKube(KubeClient):
         self._pods: Dict[str, dict] = {}  # "ns/name" -> pod
         self._nodes: Dict[str, dict] = {}
         self.bindings: List[dict] = []
+        # v1.Events recorded via create_event (tests assert the quota
+        # admission loop's hold/admit/reclaim trail here).
+        self.events: List[dict] = []
         self._rv = 0
         # Informer-style subscribers: fn(event, pod) with event in
         # {"ADDED", "MODIFIED", "DELETED"}.
@@ -182,6 +185,17 @@ class FakeKube(KubeClient):
                 raise NotFound(f"pod {namespace}/{name}")
             pod["spec"]["nodeName"] = node
             self.bindings.append({"namespace": namespace, "name": name, "node": node})
+
+    def create_event(self, namespace: str, involved: dict, reason: str,
+                     message: str, type_: str = "Normal") -> None:
+        with self._lock:
+            self.events.append({
+                "namespace": namespace,
+                "involvedObject": dict(involved),
+                "reason": reason,
+                "message": message,
+                "type": type_,
+            })
 
     def list_nodes(self) -> List[dict]:
         with self._lock:

@@ -177,6 +177,40 @@ class RestKube(KubeClient):
             },
         )
 
+    def create_event(self, namespace: str, involved: dict, reason: str,
+                     message: str, type_: str = "Normal") -> None:
+        import time as _time
+
+        # core/v1 Events (not events.k8s.io): the minimal shape every
+        # kubectl version aggregates under `describe`.  Name must be
+        # unique per event; the involved uid + monotonic-ish suffix is
+        # the convention client-go's correlator also produces.
+        now = _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime())
+        name = f"{involved.get('name', 'obj')}.{int(_time.time() * 1e6):x}"
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/events",
+            {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name, "namespace": namespace},
+                "involvedObject": {
+                    "apiVersion": "v1",
+                    "kind": involved.get("kind", "Pod"),
+                    "name": involved.get("name", ""),
+                    "namespace": involved.get("namespace", namespace),
+                    "uid": involved.get("uid", ""),
+                },
+                "reason": reason,
+                "message": message,
+                "type": type_,
+                "source": {"component": "vtpu-scheduler"},
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "count": 1,
+            },
+        )
+
     # -- nodes ----------------------------------------------------------------
     def list_nodes(self) -> List[dict]:
         return self._request("GET", "/api/v1/nodes").get("items", [])

@@ -1,0 +1,47 @@
+"""Multi-tenant capacity queues — quota, weighted fair share, borrowing.
+
+A Kueue-style admission layer between the webhook and the Filter
+(docs/quota.md): pods in governed namespaces are *held* at creation
+(``vtpu.dev/queue`` + ``vtpu.dev/queue-state: held``), an admission loop
+releases them in weighted dominant-resource fair-share order against
+per-tenant nominal quotas with cohort borrowing, and a starved in-quota
+tenant reclaims *borrowed* grants through the existing checkpoint-first
+preemption machinery.  Ungoverned namespaces bypass the layer entirely.
+"""
+
+from .admission import AdmissionConfig, AdmissionLoop
+from .fairshare import dominant_share, effective_weight, fair_share_order
+from .queues import (
+    QUEUE_ANNOTATION,
+    QUEUE_POSITION_ANNOTATION,
+    QUEUE_STATE_ANNOTATION,
+    STATE_ADMITTED,
+    STATE_HELD,
+    QueueConfig,
+    QueueEntry,
+    QueueUsage,
+    QuotaManager,
+    parse_quota_config,
+    queue_for_namespace,
+)
+from .reclaim import plan_reclaim
+
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionLoop",
+    "QUEUE_ANNOTATION",
+    "QUEUE_POSITION_ANNOTATION",
+    "QUEUE_STATE_ANNOTATION",
+    "STATE_ADMITTED",
+    "STATE_HELD",
+    "QueueConfig",
+    "QueueEntry",
+    "QueueUsage",
+    "QuotaManager",
+    "dominant_share",
+    "effective_weight",
+    "fair_share_order",
+    "parse_quota_config",
+    "plan_reclaim",
+    "queue_for_namespace",
+]

@@ -1,0 +1,501 @@
+"""The admission loop: releases held pods in weighted fair-share order.
+
+Each tick (a plain method — the simulator and tests drive it on a
+virtual clock; ``start()`` wraps it in the daemon's background thread,
+same shape as health/rescuer.py):
+
+1. prune entries whose pod placed or vanished;
+2. compute per-queue usage (granted + released-unplaced) and the fleet
+   release throttle (whole chips registered minus chips outstanding —
+   releasing far past physical capacity would just move the waiting line
+   from the queue into the Filter, where fairness no longer orders it);
+3. release admissible pods lowest-weighted-dominant-share queue first,
+   re-sorting after every release so shares equalize; a ready gang
+   releases all members atomically, and while a gang ACCUMULATES members
+   the backfill rule may admit small pods ahead of it — those that fit
+   outside the gang's estimated footprint, or that declare a runtime
+   ending inside the gang's reservation window (gang.py expiry), so the
+   gang is never starved by its own queue;
+4. reclaim for starved in-quota queues (reclaim.py) through the
+   scheduler's checkpoint-first preemption path;
+5. publish ``vtpu.dev/queue-position`` and Kubernetes events so
+   ``kubectl describe pod`` explains the wait.
+
+Apiserver writes (annotation patches, events) happen with NO scheduler
+lock held, and in-memory release state is the gate's truth — a failed
+patch is retried next tick without blocking admission."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .fairshare import fair_share_order, queue_efficiencies
+from .queues import (
+    QUEUE_POSITION_ANNOTATION,
+    QUEUE_STATE_ANNOTATION,
+    STATE_ADMITTED,
+    STATE_HELD,
+    QueueEntry,
+    QueueUsage,
+    grant_chips,
+)
+from .reclaim import plan_reclaim
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionConfig:
+    #: Background tick period (cmd/scheduler --admission-interval).
+    interval_s: float = 2.0
+    #: How long a released pod may sit unplaced before its queue (if
+    #: under nominal) reclaims borrowed grants to make room — and the
+    #: per-queue floor between successive reclaim plans.
+    reclaim_grace_s: float = 15.0
+    #: Fold measured grant efficiency into fair-share weights
+    #: (--fair-share-usage-informed; fairshare.effective_weight).
+    usage_informed: bool = False
+    #: Gang-aware backfill on/off (--no-queue-backfill).
+    backfill: bool = True
+    #: Reclaim on/off (--no-reclaim).
+    reclaim: bool = True
+    #: Fleet release throttle multiplier over registered whole chips;
+    #: raise above 1.0 on fleets whose split-count sharing packs many
+    #: grants per chip (the throttle counts whole-chip grants).
+    fleet_headroom: float = 1.0
+
+
+class AdmissionLoop:
+    def __init__(self, scheduler, cfg: Optional[AdmissionConfig] = None,
+                 clock=None) -> None:
+        self.s = scheduler
+        self.cfg = cfg or AdmissionConfig()
+        self._clock = clock or time.monotonic
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: queue name -> monotonic time of its last issued reclaim plan.
+        self._last_reclaim: Dict[str, float] = {}
+
+    # -- one tick --------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """One full admission pass; returns the actions taken (the
+        observable record for tests, /queuez consumers and the
+        simulator's queueing report)."""
+        mgr = self.s.quota
+        if not mgr.enabled:
+            return []
+        now = self._clock() if now is None else now
+        actions: List[dict] = []
+        pods = self.s.pods.list_pods()
+        granted_uids = {p.uid for p in pods}
+        mgr.prune(granted_uids, now)
+        self._retry_unwritten_releases(mgr, actions)
+
+        usage = mgr.usage(pods)
+        fleet_cap = self._fleet_chip_cap()
+        outstanding = sum(grant_chips(p)[0] for p in pods)
+        for e in mgr.entries():
+            if e.state == STATE_ADMITTED and e.uid not in granted_uids:
+                outstanding += e.chips
+
+        effs = None
+        if self.cfg.usage_informed:
+            by_ns = {ns: q.name for q in mgr.queues.values()
+                     for ns in q.namespaces}
+            try:
+                effs = queue_efficiencies(self.s.grant_efficiency(now),
+                                          by_ns)
+            except Exception:  # noqa: BLE001 — the ledger must never block admission
+                log.exception("usage-informed fair share: efficiency "
+                              "join failed; using configured weights")
+
+        # 3. Release loop: one release per pass, shares re-sorted after
+        # each, so capacity distributes in weight proportion instead of
+        # draining whichever queue happened to sort first.  Held entries
+        # are snapshotted ONCE per tick (a full entry-table copy per
+        # loop iteration would contend the manager lock against the
+        # Filter-path gate) and maintained locally as releases happen.
+        held_by_queue: Dict[str, List[QueueEntry]] = {
+            qname: [] for qname in mgr.queues}
+        for e in sorted(mgr.entries(),
+                        key=lambda e: (e.enqueued_at, e.uid)):
+            if e.state == STATE_HELD and e.queue in held_by_queue:
+                held_by_queue[e.queue].append(e)
+        blocked: Dict[str, Tuple[QueueEntry, str]] = {}
+        state = {"outstanding": outstanding}
+        for _ in range(256):
+            order = fair_share_order(mgr.queues, usage, effs,
+                                     self.cfg.usage_informed)
+            if not self._release_next(order, held_by_queue, usage,
+                                      fleet_cap, state, blocked, actions,
+                                      now):
+                break
+
+        if self.cfg.reclaim:
+            self._reclaim_pass(usage, blocked, pods, actions, now)
+
+        self._publish_positions(actions)
+        return actions
+
+    # -- fleet throttle --------------------------------------------------------
+    def _fleet_chip_cap(self) -> Optional[float]:
+        """Whole chips registered fleet-wide (None = no inventory yet —
+        quota-only gating, so a cold-booting control plane or a pure
+        embedder never deadlocks its queues on an empty node registry)."""
+        nodes = self.s.nodes.list_nodes()
+        if not nodes:
+            return None
+        chips = sum(len(info.devices) for info in nodes.values())
+        return chips * self.cfg.fleet_headroom
+
+    def _fits_fleet(self, chips: int, fleet_cap: Optional[float],
+                    state: dict) -> bool:
+        return fleet_cap is None or \
+            state["outstanding"] + chips <= fleet_cap
+
+    # -- release ---------------------------------------------------------------
+    def _held_fifo(self, mgr, queue: str) -> List[QueueEntry]:
+        return sorted((e for e in mgr.entries()
+                       if e.queue == queue and e.state == STATE_HELD),
+                      key=lambda e: (e.enqueued_at, e.uid))
+
+    def _release_next(self, order, held_by_queue, usage, fleet_cap,
+                      state, blocked, actions, now: float) -> bool:
+        mgr = self.s.quota
+        for _share, qname in order:
+            q = mgr.queues[qname]
+            held = held_by_queue[qname]
+            if not held:
+                continue
+            head = held[0]
+            if head.gang is not None:
+                if self._release_gang(q, head, held, usage, fleet_cap,
+                                      state, blocked, actions, now):
+                    return True
+                continue
+            ok, why = mgr.fits_quota(q, usage, head.chips, head.mem_mib)
+            if ok and not self._fits_fleet(head.chips, fleet_cap, state):
+                ok, why = False, "fleet capacity exhausted"
+            if not ok:
+                blocked.setdefault(qname, (head, why))
+                continue
+            self._release_one(q, head, held, usage, state, actions)
+            return True
+        return False
+
+    def _release_gang(self, q, head: QueueEntry, held: List[QueueEntry],
+                      usage, fleet_cap, state, blocked, actions,
+                      now: float) -> bool:
+        """Head of queue is a gang member.  Ready gang (all members
+        held): release every member atomically.  Accumulating gang: hold
+        the head but try the backfill rule on the entries behind it."""
+        # Deferred import: scheduler modules import quota (core builds
+        # the manager/loop), so quota modules import scheduler lazily.
+        from ..scheduler.gang import GANG_EXPIRE_SECONDS
+
+        mgr = self.s.quota
+        members = [e for e in held if e.gang == head.gang]
+        if len(members) >= head.gang_total > 0:
+            members = members[:head.gang_total]
+            chips = sum(e.chips for e in members)
+            mem = sum(e.mem_mib for e in members)
+            ok, why = mgr.fits_quota(q, usage, chips, mem)
+            if ok and not self._fits_fleet(chips, fleet_cap, state):
+                ok, why = False, "fleet capacity exhausted"
+            if not ok:
+                blocked.setdefault(q.name, (head, why))
+                return False
+            for e in members:
+                self._release_one(q, e, held, usage, state, actions,
+                                  gang=head.gang)
+            return True
+        # Accumulating: estimate the gang's eventual footprint from the
+        # members already seen and backfill around the reservation.
+        if not self.cfg.backfill:
+            blocked.setdefault(
+                q.name, (head, f"gang {head.gang} accumulating "
+                               f"({len(members)}/{head.gang_total})"))
+            return False
+        known = sum(e.chips for e in members)
+        avg = known / max(1, len(members))
+        footprint = known + avg * max(0, head.gang_total - len(members))
+        window_left = head.enqueued_at + GANG_EXPIRE_SECONDS - now
+        gang_uids = {e.uid for e in members}
+        for e in held:
+            if e.uid in gang_uids or e.gang is not None:
+                continue
+            ok, _why = mgr.fits_quota(q, usage, e.chips, e.mem_mib)
+            if not ok:
+                continue
+            fits_hole = (
+                fleet_cap is not None
+                and state["outstanding"] + footprint + e.chips <= fleet_cap)
+            short_lived = 0.0 < e.runtime_estimate_s <= window_left
+            if (fits_hole or short_lived) and \
+                    self._fits_fleet(e.chips, fleet_cap, state):
+                self._release_one(q, e, held, usage, state, actions,
+                                  backfilled=True)
+                return True
+        blocked.setdefault(
+            q.name, (head, f"gang {head.gang} accumulating "
+                           f"({len(members)}/{head.gang_total})"))
+        return False
+
+    def _release_one(self, q, entry: QueueEntry, held: List[QueueEntry],
+                     usage, state, actions,
+                     gang: Optional[str] = None,
+                     backfilled: bool = False) -> None:
+        mgr = self.s.quota
+        released = mgr.release(entry.uid, backfilled=backfilled)
+        if released is None:
+            return
+        held[:] = [e for e in held if e.uid != entry.uid]
+        usage.setdefault(q.name, QueueUsage())
+        usage[q.name].chips += entry.chips
+        usage[q.name].mem_mib += entry.mem_mib
+        state["outstanding"] += entry.chips
+        borrowed = usage[q.name].borrowed_chips(q)
+        actions.append({"kind": "admit", "queue": q.name,
+                        "pod": f"{entry.namespace}/{entry.name}",
+                        "uid": entry.uid, "chips": entry.chips,
+                        "gang": gang, "backfilled": backfilled,
+                        "borrowed_after": borrowed})
+        log.info("queue %s: admitted %s/%s (%d chip(s)%s%s; queue now "
+                 "holds %d, %d borrowed)", q.name, entry.namespace,
+                 entry.name, entry.chips,
+                 f", gang {gang}" if gang else "",
+                 ", backfilled" if backfilled else "",
+                 usage[q.name].chips, borrowed)
+        self._write_release(mgr, released)
+
+    def _write_release(self, mgr, entry: QueueEntry) -> None:
+        """WAL write + user-visible event for one release; a failed
+        patch parks the uid for retry (in-memory admission stands)."""
+        try:
+            self.s.client.patch_pod_annotations(
+                entry.namespace, entry.name,
+                {QUEUE_STATE_ANNOTATION: STATE_ADMITTED,
+                 QUEUE_POSITION_ANNOTATION: ""})
+        except Exception as e:  # noqa: BLE001 — retried next tick
+            log.warning("queue %s: admitted-state patch for %s/%s not "
+                        "written (%s); will retry", entry.queue,
+                        entry.namespace, entry.name, e)
+            with mgr._lock:
+                mgr._release_unwritten.add(entry.uid)
+        self._event(entry.namespace, entry, "Admitted",
+                    f"released from capacity queue {entry.queue} by "
+                    "fair-share admission")
+
+    def _retry_unwritten_releases(self, mgr, actions) -> None:
+        with mgr._lock:
+            uids = list(mgr._release_unwritten)
+        for uid in uids:
+            e = mgr.entry(uid)
+            if e is None or e.state != STATE_ADMITTED:
+                mgr._release_unwritten.discard(uid)
+                continue
+            try:
+                self.s.client.patch_pod_annotations(
+                    e.namespace, e.name,
+                    {QUEUE_STATE_ANNOTATION: STATE_ADMITTED,
+                     QUEUE_POSITION_ANNOTATION: ""})
+                with mgr._lock:
+                    mgr._release_unwritten.discard(uid)
+            except Exception:  # noqa: BLE001 — keep retrying
+                pass
+
+    # -- reclaim ---------------------------------------------------------------
+    def _reclaim_pass(self, usage, blocked, pods, actions,
+                      now: float) -> None:
+        """Starved in-quota queues take back borrowed grants.  Two
+        triggers: the release loop could not admit an entitled head
+        (cohort exhausted by borrowers / fleet full), or an admitted pod
+        sat unplaced past the grace (borrowers hold the chips the Filter
+        needs).  Victim selection is reclaim.plan_reclaim; execution
+        reuses the scheduler's preemption request path, so throttling,
+        the requester→victims ledger and rescission on placement all
+        come for free."""
+        mgr = self.s.quota
+        for qname, q in mgr.queues.items():
+            u = usage.get(qname, QueueUsage())
+            if now - self._last_reclaim.get(qname, float("-inf")) \
+                    < self.cfg.reclaim_grace_s:
+                continue
+            entry = self._reclaim_trigger(mgr, qname, blocked, now)
+            if entry is None:
+                continue
+            demand = entry.chips
+            if entry.gang is not None:
+                # Reclaim for a gang only once it has ACCUMULATED (an
+                # incomplete gang is the backfill rule's business —
+                # evicting for members that may never arrive wastes
+                # checkpoints), and for its aggregate footprint (member
+                # by member would stack partial plans).  FIFO-sorted
+                # before slicing: entry iteration order is not stable
+                # across restarts, and reclaim demand must be.
+                members = sorted(
+                    (e for e in mgr.entries()
+                     if e.gang == entry.gang and e.queue == qname
+                     and e.state == STATE_HELD),
+                    key=lambda e: (e.enqueued_at, e.uid))
+                if len(members) < entry.gang_total:
+                    continue
+                demand = sum(e.chips for e in members[:entry.gang_total])
+            # Entitlement check EXCLUDING the trigger's own reservation:
+            # a released-but-unplaced entry is already charged in usage,
+            # so counting it again would both mis-read the queue as
+            # at-nominal (skipping reclaim for exactly the stuck pod the
+            # trigger exists for) and double the demand.
+            held_excl = u.chips
+            if entry.state == STATE_ADMITTED:
+                held_excl -= entry.chips
+            if held_excl + demand > q.nominal_chips:
+                continue  # the pod itself would borrow; not a reclaim case
+            protected = {
+                uid for g in self.s.gangs.groups().values()
+                for uid in (*g.members, *g.placements)
+            }
+            # Never double-evict: victims already queued for rescue (the
+            # interplay the rescuer owns) or already carrying an active
+            # eviction request are off the table — and chips already on
+            # their way back from in-flight evictions count against the
+            # demand, or every grace period would stack a fresh plan on
+            # top of victims still checkpointing and reclaim PAST the
+            # borrowed slice.
+            protected |= set(self.s.rescuer.pending())
+            with self.s._preempt_lock:
+                in_flight = set(self.s._preempt_requested)
+            protected |= in_flight
+            cohort_names = {m.name for m in mgr.cohort_members(q)}
+            pending_free = sum(
+                grant_chips(p)[0] for p in pods
+                if p.uid in in_flight
+                and mgr.governed(p.namespace) is not None
+                and mgr.governed(p.namespace).name in cohort_names)
+            if pending_free >= demand:
+                continue
+            plan = plan_reclaim(demand - pending_free, q, mgr.queues,
+                                usage, pods, protected_uids=protected)
+            if plan is None:
+                continue
+            self._last_reclaim[qname] = now
+            mgr.reclaims_total += 1
+            requester = {"metadata": {"uid": entry.uid, "name": entry.name,
+                                      "namespace": entry.namespace}}
+            self.s._request_preemptions(requester, plan)
+            # Victims carry their donor queue's borrowed amount AT PLAN
+            # TIME — the observable proof (tests, the simulator verdict)
+            # that reclaim never touched an in-quota grant.
+            victims = []
+            for v in plan.victims:
+                vq = mgr.governed(v.namespace)
+                victims.append({
+                    "pod": f"{v.namespace}/{v.name}", "uid": v.uid,
+                    "node": v.node, "chips": grant_chips(v)[0],
+                    "queue": vq.name if vq else None,
+                    "donor_borrowed": (
+                        usage.get(vq.name, QueueUsage()).borrowed_chips(vq)
+                        if vq else 0),
+                })
+            actions.append({"kind": "reclaim", "queue": qname,
+                            "for": f"{entry.namespace}/{entry.name}",
+                            "chips": demand, "victims": victims})
+            log.warning(
+                "queue %s under nominal (%d/%d chips) with %s waiting: "
+                "reclaiming %d borrowed chip(s) from %d victim(s)",
+                qname, held_excl, q.nominal_chips,
+                f"{entry.namespace}/{entry.name}", demand,
+                len(plan.victims))
+            self._event(entry.namespace, entry, "QuotaReclaim",
+                        f"reclaiming {demand} borrowed chip(s) from "
+                        f"{len(plan.victims)} over-quota pod(s) in cohort "
+                        f"{q.cohort or qname}")
+            for v in plan.victims:
+                self._event(
+                    v.namespace,
+                    QueueEntry(uid=v.uid, name=v.name,
+                               namespace=v.namespace, queue=qname,
+                               chips=0, mem_mib=0),
+                    "BorrowedGrantReclaimed",
+                    "checkpoint requested: this grant is borrowed "
+                    f"capacity reclaimed for queue {qname}")
+
+    def _reclaim_trigger(self, mgr, qname: str, blocked,
+                         now: float) -> Optional[QueueEntry]:
+        if qname in blocked:
+            return blocked[qname][0]
+        for e in sorted((e for e in mgr.entries()
+                         if e.queue == qname
+                         and e.state == STATE_ADMITTED
+                         and e.gang is None),
+                        key=lambda e: (e.released_at or 0.0, e.uid)):
+            if e.released_at is not None and \
+                    now - e.released_at > self.cfg.reclaim_grace_s:
+                return e
+        return None
+
+    # -- user-facing state -----------------------------------------------------
+    def _publish_positions(self, actions) -> None:
+        """Patch ``vtpu.dev/queue-position`` on held pods whose position
+        changed, and emit the one-time Queued event — `kubectl describe`
+        then shows both the why and the how-far."""
+        mgr = self.s.quota
+        for qname in mgr.queues:
+            held = self._held_fifo(mgr, qname)
+            total = len(held)
+            for i, e in enumerate(held):
+                label = f"{i + 1}/{total}"
+                if e.published_position == label and e.hold_event_sent:
+                    continue
+                try:
+                    self.s.client.patch_pod_annotations(
+                        e.namespace, e.name,
+                        {QUEUE_POSITION_ANNOTATION: label})
+                except Exception:  # noqa: BLE001 — position is advisory
+                    continue
+                if not e.hold_event_sent:
+                    self._event(
+                        e.namespace, e, "Queued",
+                        f"held in capacity queue {qname} at position "
+                        f"{label}; released in fair-share order")
+                mgr.set_published_position(e.uid, label, hold_event=True)
+
+    def _event(self, namespace: str, entry: QueueEntry, reason: str,
+               message: str) -> None:
+        try:
+            self.s.client.create_event(
+                namespace,
+                {"kind": "Pod", "name": entry.name,
+                 "namespace": namespace, "uid": entry.uid},
+                reason, message)
+        except NotImplementedError:
+            pass  # embedder clients without an events surface
+        except Exception as e:  # noqa: BLE001 — events are best-effort
+            log.debug("event %s for %s/%s not written: %s", reason,
+                      namespace, entry.name, e)
+
+    # -- background thread -----------------------------------------------------
+    def start(self, interval_s: Optional[float] = None) -> None:
+        if self._thread is not None or not self.s.quota.enabled:
+            return
+        period = interval_s if interval_s is not None \
+            else self.cfg.interval_s
+
+        def loop() -> None:
+            while not self._stop.wait(period):
+                try:
+                    self.tick()
+                except Exception:  # noqa: BLE001 — keep admitting through glitches
+                    log.exception("admission tick failed")
+
+        self._thread = threading.Thread(target=loop,
+                                        name="quota-admission",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

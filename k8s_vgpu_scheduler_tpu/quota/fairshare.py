@@ -1,0 +1,90 @@
+"""Weighted dominant-resource fair share across capacity queues.
+
+DRF (Ghodsi et al., NSDI'11) adapted to quota-relative shares: a queue's
+dominant share is its held fraction of NOMINAL quota, maximized across
+resource dimensions, divided by its weight — the admission loop always
+releases next from the queue with the LOWEST weighted share, which
+equalizes weighted dominant shares and allocates contended capacity in
+weight proportion.
+
+The opt-in usage-informed mode folds the accounting ledger's
+granted-vs-actual join (PR 4, accounting/efficiency.py) into the weight:
+a tenant whose grants sit chronically idle has its effective weight
+scaled down toward a floor — holding chips you do not use demotes your
+next admission, informed by what tenants *really* consume rather than
+what they hold.  The ledger's counter-reset handling makes the signal
+safe across monitor restarts (a reset can only under-state idleness for
+one window, never produce negative usage)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .queues import QueueConfig, QueueUsage
+
+#: Usage-informed demotion never scales a weight below this fraction:
+#: a fully idle tenant is deprioritized, not starved out of its quota.
+USAGE_WEIGHT_FLOOR = 0.25
+
+
+def dominant_share(usage: QueueUsage, q: QueueConfig) -> float:
+    """Held / nominal, maximized over dimensions.  A dimension with zero
+    nominal and nonzero held reads as infinite on chips (no entitlement:
+    everything is borrowed) and is ignored on HBM (unconstrained)."""
+    shares: List[float] = []
+    if q.nominal_chips > 0:
+        shares.append(usage.chips / q.nominal_chips)
+    elif usage.chips > 0:
+        shares.append(float("inf"))
+    if q.nominal_hbm_mib > 0:
+        shares.append(usage.mem_mib / q.nominal_hbm_mib)
+    return max(shares) if shares else 0.0
+
+
+def effective_weight(q: QueueConfig, efficiency: Optional[float],
+                     usage_informed: bool) -> float:
+    """The queue's weight, optionally demoted by measured efficiency.
+    ``efficiency`` None (no usage reports — unmonitored tenants must not
+    be punished for missing monitors) or the mode being off leaves the
+    configured weight untouched."""
+    if not usage_informed or efficiency is None:
+        return q.weight
+    return q.weight * max(USAGE_WEIGHT_FLOOR, min(1.0, efficiency))
+
+
+def fair_share_order(
+    queues: Dict[str, QueueConfig],
+    usage: Dict[str, QueueUsage],
+    efficiencies: Optional[Dict[str, Optional[float]]] = None,
+    usage_informed: bool = False,
+) -> List[Tuple[float, str]]:
+    """Queues ordered lowest weighted dominant share first — the next
+    release always goes to the head of this list that has an admissible
+    pod.  Deterministic: name tie-breaks equal shares, so seeded
+    simulations replay identically."""
+    effs = efficiencies or {}
+    out = []
+    for name, q in queues.items():
+        w = effective_weight(q, effs.get(name), usage_informed)
+        share = dominant_share(usage.get(name, QueueUsage()), q) / w
+        out.append((share, name))
+    out.sort(key=lambda t: (t[0], t[1]))
+    return out
+
+
+def queue_efficiencies(fleet, by_ns: Dict[str, str]
+                       ) -> Dict[str, Optional[float]]:
+    """Aggregate the per-pod efficiency join into per-queue actual /
+    granted chip-second ratios.  ``fleet`` is a FleetEfficiency
+    (accounting/efficiency.py); ``by_ns`` maps namespace → queue name.
+    Queues with no measured grants map to None (unknown ≠ idle)."""
+    granted: Dict[str, float] = {}
+    actual: Dict[str, float] = {}
+    for pe in fleet.pods:
+        qname = by_ns.get(pe.namespace)
+        if qname is None or pe.efficiency is None:
+            continue
+        granted[qname] = granted.get(qname, 0.0) + pe.granted_chip_seconds
+        actual[qname] = actual.get(qname, 0.0) + pe.actual_chip_seconds
+    return {qname: (actual.get(qname, 0.0) / g if g > 0 else None)
+            for qname, g in granted.items()}

@@ -1,0 +1,483 @@
+"""Capacity queues: per-tenant quota state and the webhook/Filter gate.
+
+One :class:`QueueConfig` per tenant queue (namespaces → queue is the
+single governance decision; the webhook annotation is informational).
+Queues group into *cohorts*: a queue may exceed its nominal quota into
+its cohort's unused capacity — up to its borrowing limit and never past
+the cohort's aggregate nominal — and everything above nominal is
+*borrowed*, which is exactly the set the reclaimer (reclaim.py) may
+evict.  :class:`QuotaManager` is the shared runtime state: held/released
+entries keyed by pod uid, usage computed on demand from the scheduler's
+grant registry (annotation-as-WAL — a restart rebuilds held state from
+the ``vtpu.dev/queue-state`` annotations the webhook/admission loop
+wrote, and granted usage from the registry like everything else).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Iterable, List, Mapping, Optional, Tuple
+
+from ..k8s.client import pod_annotations, pod_name, pod_namespace, pod_uid
+from ..util.types import ASSIGNED_NODE_ANNOTATION
+
+#: Written by the webhook on governed pods: the capacity queue name.
+QUEUE_ANNOTATION = "vtpu.dev/queue"
+#: ``held`` until the admission loop releases the pod; ``admitted`` after.
+QUEUE_STATE_ANNOTATION = "vtpu.dev/queue-state"
+#: Published by the admission loop while held, so `kubectl describe pod`
+#: answers "why is my pod waiting and how far back in line is it".
+QUEUE_POSITION_ANNOTATION = "vtpu.dev/queue-position"
+#: Optional user hint for gang-aware backfill: a held pod declaring a
+#: runtime shorter than a waiting gang's reservation window may admit
+#: ahead of the gang even into capacity the gang will need.
+RUNTIME_ESTIMATE_ANNOTATION = "vtpu.dev/estimated-runtime-seconds"
+
+STATE_HELD = "held"
+STATE_ADMITTED = "admitted"
+
+#: A held entry that stops being seen (no gate retry, no informer event —
+#: possible only in no-watch mode where DELETEs never replay) is dropped
+#: after this long so the pending gauge cannot leak forever.
+ENTRY_TTL_S = 1800.0
+
+
+@dataclasses.dataclass(frozen=True)
+class QueueConfig:
+    """One tenant queue.  ``nominal_*`` is the entitled quota; a zero
+    nominal on the chip dimension means "no entitlement — everything
+    this queue holds is borrowed"; a zero nominal on the HBM dimension
+    means the dimension is unconstrained for this queue."""
+
+    name: str
+    namespaces: Tuple[str, ...]
+    cohort: str = ""
+    weight: float = 1.0
+    nominal_chips: int = 0
+    nominal_hbm_mib: int = 0
+    borrow_limit_chips: int = 0
+    borrow_limit_hbm_mib: int = 0
+
+
+def parse_quota_config(doc) -> Tuple[QueueConfig, ...]:
+    """``{"queues": [...]}`` (the --quota-config file / chart values
+    shape) → QueueConfig tuple.  Raises ValueError on duplicate queue
+    names or a namespace governed by two queues — silent ambiguity here
+    would mis-charge tenants."""
+    if not doc:
+        return ()
+    queues: List[QueueConfig] = []
+    seen_ns: Dict[str, str] = {}
+    for entry in doc.get("queues", []):
+        quota = entry.get("quota", {})
+        q = QueueConfig(
+            name=entry["name"],
+            namespaces=tuple(entry.get("namespaces", ())),
+            cohort=entry.get("cohort", ""),
+            weight=float(entry.get("weight", 1.0)),
+            nominal_chips=int(quota.get("chips", 0)),
+            nominal_hbm_mib=int(quota.get("hbm_mib", 0)),
+            borrow_limit_chips=int(entry.get("borrow_limit_chips", 0)),
+            borrow_limit_hbm_mib=int(entry.get("borrow_limit_hbm_mib", 0)),
+        )
+        if q.weight <= 0:
+            raise ValueError(f"queue {q.name}: weight must be > 0")
+        if any(q.name == p.name for p in queues):
+            raise ValueError(f"duplicate queue name {q.name}")
+        for ns in q.namespaces:
+            if ns in seen_ns:
+                raise ValueError(
+                    f"namespace {ns} governed by both {seen_ns[ns]} "
+                    f"and {q.name}")
+            seen_ns[ns] = q.name
+        queues.append(q)
+    return tuple(queues)
+
+
+def queue_for_namespace(queues: Iterable[Mapping or QueueConfig],
+                        namespace: str) -> Optional[QueueConfig]:
+    """The queue governing ``namespace`` (None = ungoverned).  Accepts
+    either parsed QueueConfig tuples or the raw config dicts Config
+    carries, so the webhook can consult it without a manager."""
+    for q in queues:
+        if isinstance(q, QueueConfig):
+            if namespace in q.namespaces:
+                return q
+        elif namespace in q.get("namespaces", ()):
+            return parse_quota_config({"queues": [q]})[0]
+    return None
+
+
+@dataclasses.dataclass
+class QueueEntry:
+    """One held-or-released pod in a queue."""
+
+    uid: str
+    name: str
+    namespace: str
+    queue: str
+    chips: int
+    mem_mib: int
+    gang: Optional[str] = None
+    gang_total: int = 0
+    runtime_estimate_s: float = 0.0
+    enqueued_at: float = 0.0
+    last_seen: float = 0.0
+    state: str = STATE_HELD
+    released_at: Optional[float] = None
+    #: Last published queue-position annotation value ("pos/total" —
+    #: the FULL string, so a changed denominator re-patches too).
+    published_position: Optional[str] = None
+    #: Whether the hold event was already emitted (once per entry).
+    hold_event_sent: bool = False
+    backfilled: bool = False
+
+
+@dataclasses.dataclass
+class QueueUsage:
+    """Held capacity of one queue: granted pods + released-but-unplaced
+    entries (a release reserves quota until the Filter places the pod,
+    or the loop over-admits)."""
+
+    chips: int = 0
+    mem_mib: int = 0
+
+    def borrowed_chips(self, q: QueueConfig) -> int:
+        return max(0, self.chips - q.nominal_chips)
+
+    def borrowed_mem_mib(self, q: QueueConfig) -> int:
+        if q.nominal_hbm_mib <= 0:
+            return 0
+        return max(0, self.mem_mib - q.nominal_hbm_mib)
+
+
+def demand_of(requests) -> Tuple[int, int]:
+    """(chips, mem_mib) a request list will be charged as.  Percentage
+    memory requests resolve only at placement time; they charge 0 MiB
+    here — the chip dimension is the primary quota axis."""
+    chips = sum(r.nums for r in requests)
+    mem = sum(r.nums * r.memreq for r in requests)
+    return chips, mem
+
+
+def grant_chips(pod_info) -> Tuple[int, int]:
+    """(chips, mem_mib) actually held by a granted pod."""
+    chips = mem = 0
+    for container in pod_info.devices:
+        for d in container:
+            chips += 1
+            mem += d.usedmem
+    return chips, mem
+
+
+class QuotaManager:
+    """Thread-safe queue registry.  Filter threads call :meth:`gate`,
+    the watch/resync threads call :meth:`observe_pod`, the admission
+    loop reads/mutates entries — all under one small lock; usage is a
+    pure function of the grant registry plus the released entries."""
+
+    def __init__(self, quota_queues=(), clock=None) -> None:
+        self.queues: Dict[str, QueueConfig] = {}
+        self._by_ns: Dict[str, QueueConfig] = {}
+        for q in (quota_queues if quota_queues
+                  and isinstance(quota_queues[0], QueueConfig)
+                  else parse_quota_config({"queues": list(quota_queues)})):
+            self.queues[q.name] = q
+            for ns in q.namespaces:
+                self._by_ns[ns] = q
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        self._entries: Dict[str, QueueEntry] = {}
+        #: Lifetime released count per queue (vtpu_queue_admitted_total).
+        self.admitted_total: Dict[str, int] = {
+            name: 0 for name in self.queues}
+        #: Lifetime reclaim plans issued (vtpu_reclaims_total).
+        self.reclaims_total = 0
+        #: Entries whose release is stuck on a failed annotation patch
+        #: retry next tick (uid set) — in-memory release already stands.
+        self._release_unwritten: set = set()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.queues)
+
+    def governed(self, namespace: str) -> Optional[QueueConfig]:
+        return self._by_ns.get(namespace)
+
+    # -- Filter gate -----------------------------------------------------------
+    def gate(self, pod: dict, requests) -> Optional[str]:
+        """None = pass (ungoverned, or admitted); otherwise the hold
+        reason the Filter returns as its error.  Enqueue-on-sight: the
+        gate is also how held pods enter the queue in no-watch mode
+        (kube-scheduler retries unschedulable pods continually)."""
+        if not self.queues:
+            return None
+        namespace = pod_namespace(pod)
+        q = self._by_ns.get(namespace)
+        if q is None:
+            return None
+        uid = pod_uid(pod)
+        if not uid:
+            return None
+        anns = pod_annotations(pod)
+        now = self._clock()
+        with self._lock:
+            e = self._entries.get(uid)
+            if e is None:
+                # Admitted in a previous life (annotation-as-WAL), or
+                # already granted: never re-hold.
+                if anns.get(QUEUE_STATE_ANNOTATION) == STATE_ADMITTED \
+                        or anns.get(ASSIGNED_NODE_ANNOTATION):
+                    return None
+                e = self._make_entry(pod, q, requests, now)
+                self._entries[uid] = e
+            e.last_seen = now
+            if e.state == STATE_ADMITTED:
+                return None
+            pos, total = self._position_locked(e)
+            return (f"held in capacity queue {q.name} "
+                    f"(position {pos}/{total}; fair-share admission)")
+
+    def _make_entry(self, pod: dict, q: QueueConfig, requests,
+                    now: float) -> QueueEntry:
+        from ..scheduler.gang import gang_of
+
+        chips, mem = demand_of(requests)
+        gang = gang_of(pod)
+        anns = pod_annotations(pod)
+        try:
+            runtime = float(anns.get(RUNTIME_ESTIMATE_ANNOTATION, "0"))
+        except ValueError:
+            runtime = 0.0
+        return QueueEntry(
+            uid=pod_uid(pod), name=pod_name(pod),
+            namespace=pod_namespace(pod), queue=q.name,
+            chips=chips, mem_mib=mem,
+            gang=gang[0] if gang else None,
+            gang_total=gang[1] if gang else 0,
+            runtime_estimate_s=max(0.0, runtime),
+            enqueued_at=now, last_seen=now)
+
+    def _position_locked(self, e: QueueEntry) -> Tuple[int, int]:
+        """(1-based position among held entries of e's queue, total held).
+        FIFO by (enqueued_at, uid) — uid tie-break keeps positions
+        reproducible under the simulator's frozen clock."""
+        held = sorted(
+            (x for x in self._entries.values()
+             if x.queue == e.queue and x.state == STATE_HELD),
+            key=lambda x: (x.enqueued_at, x.uid))
+        for i, x in enumerate(held):
+            if x.uid == e.uid:
+                return i + 1, len(held)
+        return len(held), len(held)
+
+    # -- informer sync ---------------------------------------------------------
+    def observe_pod(self, event: str, pod: dict, requests_fn=None) -> None:
+        """Keep entries in step with the informer: DELETED/placed pods
+        leave the queue; a listed held/admitted pod the manager has never
+        seen (scheduler restart) is re-learned from its annotations."""
+        if not self.queues:
+            return
+        uid = pod_uid(pod)
+        if not uid:
+            return
+        if event == "DELETED":
+            self.forget(uid)
+            return
+        namespace = pod_namespace(pod)
+        q = self._by_ns.get(namespace)
+        if q is None:
+            return
+        anns = pod_annotations(pod)
+        if anns.get(ASSIGNED_NODE_ANNOTATION):
+            # Placed: its usage is charged through the grant registry now.
+            self.forget(uid)
+            return
+        state = anns.get(QUEUE_STATE_ANNOTATION)
+        if state not in (STATE_HELD, STATE_ADMITTED):
+            return
+        now = self._clock()
+        with self._lock:
+            e = self._entries.get(uid)
+            if e is None:
+                if requests_fn is None:
+                    return
+                try:
+                    requests = requests_fn(pod)
+                except Exception:  # noqa: BLE001 — malformed pod never breaks sync
+                    return
+                if not any(r.nums > 0 for r in requests):
+                    return
+                e = self._make_entry(pod, q, requests, now)
+                self._entries[uid] = e
+            e.last_seen = now
+            if state == STATE_ADMITTED and e.state == STATE_HELD:
+                # The WAL says a previous scheduler already released it.
+                e.state = STATE_ADMITTED
+                e.released_at = now
+
+    def forget(self, uid: str) -> None:
+        with self._lock:
+            self._entries.pop(uid, None)
+            self._release_unwritten.discard(uid)
+
+    def note_unplaced(self, uid: str) -> None:
+        """The Filter found no node for a released pod — the reclaimer's
+        'stuck' signal (admission.py reads released_at + this refresh)."""
+        with self._lock:
+            e = self._entries.get(uid)
+            if e is not None:
+                e.last_seen = self._clock()
+
+    # -- admission-loop surface ------------------------------------------------
+    def release(self, uid: str, backfilled: bool = False
+                ) -> Optional[QueueEntry]:
+        """Mark one held entry admitted (in-memory truth; the annotation
+        patch is the caller's WAL write).  Returns the entry snapshot."""
+        with self._lock:
+            e = self._entries.get(uid)
+            if e is None or e.state != STATE_HELD:
+                return None
+            e.state = STATE_ADMITTED
+            e.released_at = self._clock()
+            e.backfilled = backfilled
+            self.admitted_total[e.queue] = \
+                self.admitted_total.get(e.queue, 0) + 1
+            return dataclasses.replace(e)
+
+    def entries(self) -> List[QueueEntry]:
+        with self._lock:
+            return [dataclasses.replace(e) for e in self._entries.values()]
+
+    def entry(self, uid: str) -> Optional[QueueEntry]:
+        with self._lock:
+            e = self._entries.get(uid)
+            return dataclasses.replace(e) if e is not None else None
+
+    def set_published_position(self, uid: str, pos: Optional[str],
+                               hold_event: bool = False) -> None:
+        with self._lock:
+            e = self._entries.get(uid)
+            if e is not None:
+                e.published_position = pos
+                if hold_event:
+                    e.hold_event_sent = True
+
+    def prune(self, granted_uids: set, now: Optional[float] = None) -> None:
+        """Drop entries whose pod placed (now charged via the registry)
+        or that went stale (no sight past ENTRY_TTL_S — no-watch mode's
+        unobservable deletes)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            for uid in [u for u, e in self._entries.items()
+                        if (e.state == STATE_ADMITTED and u in granted_uids)
+                        or now - e.last_seen > ENTRY_TTL_S]:
+                del self._entries[uid]
+                self._release_unwritten.discard(uid)
+
+    # -- usage + quota arithmetic ----------------------------------------------
+    def usage(self, pods) -> Dict[str, QueueUsage]:
+        """Per-queue held capacity: granted pods in governed namespaces
+        plus released-but-unplaced entries (each pod counted once — a
+        released entry whose grant landed is excluded here and pruned
+        next tick)."""
+        out = {name: QueueUsage() for name in self.queues}
+        granted = set()
+        for p in pods:
+            q = self._by_ns.get(p.namespace)
+            granted.add(p.uid)
+            if q is None:
+                continue
+            chips, mem = grant_chips(p)
+            out[q.name].chips += chips
+            out[q.name].mem_mib += mem
+        with self._lock:
+            for e in self._entries.values():
+                if e.state == STATE_ADMITTED and e.uid not in granted:
+                    out[e.queue].chips += e.chips
+                    out[e.queue].mem_mib += e.mem_mib
+        return out
+
+    def cohort_members(self, q: QueueConfig) -> List[QueueConfig]:
+        """Queues sharing ``q``'s cohort.  An EMPTY cohort is private:
+        the queue is its own cohort — two queues that never opted into a
+        shared cohort must not cap each other's admissions or become
+        reclaim donors for each other."""
+        if not q.cohort:
+            return [q]
+        return [m for m in self.queues.values() if m.cohort == q.cohort]
+
+    def fits_quota(self, q: QueueConfig, usage: Dict[str, QueueUsage],
+                   chips: int, mem_mib: int) -> Tuple[bool, str]:
+        """Would admitting (chips, mem) keep ``q`` inside its quota?
+        Per-queue: nominal + borrowing limit.  Cohort: the aggregate
+        never exceeds the members' summed nominal (borrowing is a
+        redistribution of unused entitlement, never new capacity)."""
+        u = usage.get(q.name, QueueUsage())
+        if u.chips + chips > q.nominal_chips + q.borrow_limit_chips:
+            return False, (f"queue {q.name} at its borrowing limit "
+                           f"({u.chips}+{chips} > {q.nominal_chips}"
+                           f"+{q.borrow_limit_chips} chips)")
+        if q.nominal_hbm_mib > 0 and mem_mib > 0 and \
+                u.mem_mib + mem_mib > q.nominal_hbm_mib \
+                + q.borrow_limit_hbm_mib:
+            return False, f"queue {q.name} over its HBM quota"
+        members = self.cohort_members(q)
+        total_nominal = sum(m.nominal_chips for m in members)
+        if total_nominal > 0:
+            total_held = sum(usage.get(m.name, QueueUsage()).chips
+                             for m in members)
+            if total_held + chips > total_nominal:
+                return False, (f"cohort {q.cohort or q.name} exhausted "
+                               f"({total_held}+{chips} > {total_nominal} "
+                               "chips)")
+        nominal_hbm = sum(m.nominal_hbm_mib for m in members)
+        if nominal_hbm > 0 and mem_mib > 0:
+            held_hbm = sum(usage.get(m.name, QueueUsage()).mem_mib
+                           for m in members)
+            if held_hbm + mem_mib > nominal_hbm:
+                return False, f"cohort {q.cohort or q.name} HBM exhausted"
+        return True, ""
+
+    # -- observability ---------------------------------------------------------
+    def stats(self, pods) -> dict:
+        """Everything the metrics collector and ``GET /queuez`` need, in
+        one consistent read (usage from the passed registry list; entry
+        state under the manager lock)."""
+        from .fairshare import dominant_share
+
+        usage = self.usage(pods)
+        with self._lock:
+            entries = [dataclasses.replace(e)
+                       for e in self._entries.values()]
+        rows = []
+        for name, q in sorted(self.queues.items()):
+            u = usage[name]
+            held = sorted((e for e in entries
+                           if e.queue == name and e.state == STATE_HELD),
+                          key=lambda e: (e.enqueued_at, e.uid))
+            released = [e for e in entries
+                        if e.queue == name and e.state == STATE_ADMITTED]
+            rows.append({
+                "queue": name,
+                "cohort": q.cohort,
+                "weight": q.weight,
+                "nominal_chips": q.nominal_chips,
+                "nominal_hbm_mib": q.nominal_hbm_mib,
+                "borrow_limit_chips": q.borrow_limit_chips,
+                "held_chips": u.chips,
+                "held_hbm_mib": u.mem_mib,
+                "borrowed_chips": u.borrowed_chips(q),
+                "fair_share": round(dominant_share(u, q) / q.weight, 6),
+                "pending": len(held),
+                "released_unplaced": len(released),
+                "admitted_total": self.admitted_total.get(name, 0),
+                "namespaces": list(q.namespaces),
+                "pending_pods": [
+                    {"pod": f"{e.namespace}/{e.name}", "position": i + 1,
+                     "chips": e.chips, "gang": e.gang}
+                    for i, e in enumerate(held)],
+            })
+        return {"queues": rows, "reclaims_total": self.reclaims_total}

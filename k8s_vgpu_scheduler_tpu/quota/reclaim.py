@@ -1,0 +1,92 @@
+"""Reclaim borrowed grants for a starved in-quota tenant.
+
+When a queue with headroom under its nominal quota cannot admit or place
+a pod — its cohort's capacity is occupied by tenants running OVER their
+nominal — the reclaimer picks victims from exactly the *borrowed* slice
+of those tenants' usage and routes them through the existing
+checkpoint-first preemption machinery (scheduler/preempt.py annotation +
+shim/preempt.py in-container watch): victims checkpoint at a step
+boundary, exit losslessly, and the freed chips admit the entitled pod.
+In-quota grants are never victims — reclaim can take a borrower back DOWN
+to its nominal, never below it.
+
+The planner is pure (same discipline as plan_preemption): inputs in,
+victims out, no I/O, no locks — the admission loop owns the annotation
+writes and reuses the scheduler's requester→victims rescission ledger so
+a reclaim whose beneficiary places elsewhere (or is deleted) is rescinded
+before anyone checkpoints for nothing."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .queues import QueueConfig, QueueUsage, grant_chips
+
+
+def plan_reclaim(
+    demand_chips: int,
+    target: QueueConfig,
+    queues: Dict[str, QueueConfig],
+    usage: Dict[str, QueueUsage],
+    pods,
+    protected_uids: Optional[Set[str]] = None,
+):
+    """Victims freeing ≥ ``demand_chips``, drawn only from borrowed
+    capacity of ``target``'s cohort peers.
+
+    Ordering is fully deterministic (seeded simulations must replay
+    reclaim plans bit-identically): donor queues most-borrowed first
+    (name tie-break), victims within a queue youngest grant first
+    (touched_at desc, uid tie-break — the same least-sunk-work rule as
+    priority preemption).  Per-donor cap: its borrowed amount — the plan
+    can never push a donor below nominal.  Returns None when borrowed
+    capacity cannot cover the demand (a partial reclaim would evict
+    workloads without unblocking the requester).  Returns a
+    scheduler/preempt.py PreemptionPlan so execution and rescission ride
+    the existing machinery (imported lazily — scheduler modules import
+    quota, so quota modules import scheduler inside functions)."""
+    from ..scheduler.preempt import PreemptionPlan
+
+    if demand_chips <= 0:
+        return None
+    protected = protected_uids or set()
+    by_ns = {ns: q for q in queues.values() for ns in q.namespaces}
+    # An empty cohort is PRIVATE (queues.py cohort_members): a queue
+    # that never opted into a shared cohort has no donors and is never
+    # a donor — cross-tenant eviction must be an explicit config choice.
+    donors = sorted(
+        (q for q in queues.values()
+         if q.name != target.name and target.cohort
+         and q.cohort == target.cohort
+         and usage.get(q.name, QueueUsage()).borrowed_chips(q) > 0),
+        key=lambda q: (-usage[q.name].borrowed_chips(q), q.name))
+    if not donors:
+        return None
+    pods_by_queue: Dict[str, List] = {}
+    for p in pods:
+        q = by_ns.get(p.namespace)
+        if q is not None:
+            pods_by_queue.setdefault(q.name, []).append(p)
+    victims: List = []
+    freed = 0
+    for donor in donors:
+        budget = usage[donor.name].borrowed_chips(donor)
+        candidates = sorted(
+            (p for p in pods_by_queue.get(donor.name, [])
+             if p.uid not in protected),
+            key=lambda p: (-p.touched_at, p.uid))
+        for p in candidates:
+            if freed >= demand_chips or budget <= 0:
+                break
+            chips, _ = grant_chips(p)
+            if chips <= 0 or chips > budget:
+                # Evicting it would dip the donor below nominal.
+                continue
+            victims.append(p)
+            freed += chips
+            budget -= chips
+        if freed >= demand_chips:
+            break
+    if freed < demand_chips or not victims:
+        return None
+    return PreemptionPlan(node=victims[0].node, victims=victims)

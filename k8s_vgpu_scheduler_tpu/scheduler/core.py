@@ -34,6 +34,8 @@ from ..k8s.client import (
     pod_namespace,
     pod_uid,
 )
+from ..quota.admission import AdmissionConfig, AdmissionLoop
+from ..quota.queues import QuotaManager
 from ..tpulib.types import TopologyDesc
 from ..util import codec, trace
 from ..util.config import Config
@@ -167,6 +169,22 @@ class Scheduler:
                 interval_s=self.cfg.rescue_interval_s,
                 checkpoint_grace_s=self.cfg.rescue_checkpoint_grace_s,
                 lease_retention_s=self.cfg.lease_retention_s),
+            clock=clock)
+        # Multi-tenant capacity queues (quota/; docs/quota.md).  Empty
+        # config = the manager is inert and every namespace bypasses it
+        # (existing embedders/tests see no behavior change).  The
+        # admission loop is started by the daemon entrypoint like the
+        # rescuer; embedders/tests call admission.tick() directly.
+        self.quota = QuotaManager(self.cfg.quota_queues, clock=clock)
+        self.admission = AdmissionLoop(
+            self,
+            AdmissionConfig(
+                interval_s=self.cfg.admission_interval_s,
+                reclaim_grace_s=self.cfg.queue_reclaim_grace_s,
+                usage_informed=self.cfg.fair_share_usage_informed,
+                backfill=self.cfg.enable_queue_backfill,
+                reclaim=self.cfg.enable_reclaim,
+                fleet_headroom=self.cfg.queue_fleet_headroom),
             clock=clock)
         # Optimistic-commit critical section: held ONLY to re-validate a
         # winning node's revision generation and record the grant (plus
@@ -304,6 +322,13 @@ class Scheduler:
         uid = pod_uid(pod)
         if not uid:
             return
+        if self.quota.enabled:
+            # Keep queue entries in step with the informer: deletes and
+            # placements leave the queue; a restart re-learns held and
+            # admitted pods from their queue-state annotations (WAL).
+            self.quota.observe_pod(
+                event, pod,
+                requests_fn=lambda p: container_requests(p, self.cfg))
         anns = pod.get("metadata", {}).get("annotations", {})
         node = anns.get(ASSIGNED_NODE_ANNOTATION, "")
         phase = anns.get(BIND_PHASE_ANNOTATION, "")
@@ -641,6 +666,20 @@ class Scheduler:
                                 self.efficiency_cfg,
                                 now=self._clock(), window_s=window_s)
 
+    def export_queues(self) -> dict:
+        """Capacity-queue state (``GET /queuez``): per-queue quota,
+        held/borrowed usage, fair shares, pending pods with positions.
+        Off every scheduler lock (registry list + the quota manager's
+        own small one)."""
+        stats = self.quota.stats(self.pods.list_pods())
+        stats["fair_share_order"] = [
+            name for _s, name in sorted(
+                (row["fair_share"], row["queue"])
+                for row in stats["queues"])
+        ]
+        stats["enabled"] = self.quota.enabled
+        return stats
+
     def export_fleet(self) -> dict:
         """Read-only fleet snapshot for capacity tooling (``GET /fleetz``
         → ``vtpu-simulate --from-cluster``): node inventory INCLUDING ICI
@@ -731,6 +770,11 @@ class Scheduler:
                 tr.event(pod_uid(pod), "filter-rejected", trace_id=tid,
                          pod=pod_name(pod), error=result.error,
                          preempting=result.preempt is not None)
+            if result.failed:
+                # A RELEASED governed pod that found no seat is the
+                # reclaim trigger's signal (admission loop: borrowers may
+                # hold the chips this in-quota pod is entitled to).
+                self.quota.note_unplaced(pod_uid(pod))
             if result.preempt is not None:
                 self._request_preemptions(pod, result.preempt)
             return result
@@ -840,6 +884,13 @@ class Scheduler:
         if not any(r.nums > 0 for r in requests):
             # Not ours; admit everywhere (the vanilla scheduler handles it).
             return FilterResult(node=None, failed={})
+
+        # Capacity-queue gate (quota/): a governed pod stays held until
+        # the admission loop releases it in fair-share order; ungoverned
+        # namespaces (or no quota config) pass straight through.
+        hold = self.quota.gate(pod, requests)
+        if hold is not None:
+            return FilterResult(error=hold)
 
         gang = gang_of(pod)
         if gang is not None:
@@ -1179,6 +1230,7 @@ class Scheduler:
         build and discard Scheduler instances must call it or each
         instance leaks its pool threads until exit."""
         self.rescuer.stop()
+        self.admission.stop()
         with self._pool_lock:
             pool, self._pool = self._pool, None
             self._pool_unavailable = False

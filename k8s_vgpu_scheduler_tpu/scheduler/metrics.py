@@ -179,6 +179,56 @@ class ClusterCollector(Collector):
             "grace — capacity held but unused (see /usagez and "
             "vtpu-report for the per-pod list)",
         )
+        # Multi-tenant capacity queues (quota/; docs/quota.md).  Guarded
+        # getattr: collector test stubs predate the quota surface.  All
+        # families are emitted (empty when no queues are configured) so
+        # dashboards and alerts never reference a vanishing series.
+        q_pending = GaugeMetricFamily(
+            "vtpu_queue_pending",
+            "Pods held in one capacity queue awaiting fair-share "
+            "admission (sustained nonzero with zero admissions is "
+            "starvation — see the VtpuQueueStarvation alert)",
+            labels=["queue"],
+        )
+        q_admitted = CounterMetricFamily(
+            "vtpu_queue_admitted",
+            "Pods released from one capacity queue by the admission "
+            "loop over this scheduler's lifetime",
+            labels=["queue"],
+        )
+        q_share = GaugeMetricFamily(
+            "vtpu_queue_fair_share",
+            "Weighted dominant-resource share of one queue (held / "
+            "nominal / weight; the admission loop releases lowest "
+            "first, so sustained imbalance means quota or weight "
+            "misconfiguration)",
+            labels=["queue"],
+        )
+        q_borrowed = GaugeMetricFamily(
+            "vtpu_borrowed_chips",
+            "Chips one queue holds beyond its nominal quota (borrowed "
+            "from its cohort's unused capacity; the reclaimable set)",
+            labels=["queue"],
+        )
+        q_reclaims = CounterMetricFamily(
+            "vtpu_reclaims",
+            "Reclaim plans issued for starved in-quota tenants (each "
+            "one checkpoint-evicts borrowed grants)",
+        )
+        quota = getattr(self.scheduler, "quota", None)
+        if quota is not None and quota.enabled:
+            stats = quota.stats(self.scheduler.pods.list_pods())
+            for row in stats["queues"]:
+                q_pending.add_metric([row["queue"]], row["pending"])
+                q_admitted.add_metric([row["queue"]],
+                                      row["admitted_total"])
+                q_share.add_metric([row["queue"]], row["fair_share"])
+                q_borrowed.add_metric([row["queue"]],
+                                      row["borrowed_chips"])
+            q_reclaims.add_metric([], stats["reclaims_total"])
+        else:
+            q_reclaims.add_metric([], 0)
+
         fleet = self.scheduler.grant_efficiency()
         by_uid = {p.uid: p for p in fleet.pods}
         # Aggregate by label pair BEFORE emitting: two retained accounts
@@ -210,7 +260,8 @@ class ClusterCollector(Collector):
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
                 pod_mem, pod_cores, preempts, conflicts, pool_size,
                 busy_peak, lease_state, leases_unhealthy, chips_quar,
-                quarantines, rescued, u_chip, u_hbm, eff_ratio,
+                quarantines, rescued, q_pending, q_admitted, q_share,
+                q_borrowed, q_reclaims, u_chip, u_hbm, eff_ratio,
                 idle_grants] + list(phase_metrics())
 
 

@@ -92,7 +92,12 @@ def plan_preemption(
                       and p.uid not in protected]
         if not candidates:
             continue
-        candidates.sort(key=lambda p: (-p.priority, -p.touched_at))
+        # uid is the final tie-break: equal-priority victims granted at
+        # the same instant (a batch admission on the simulator's frozen
+        # clock, or same-tick grants) must order identically on every
+        # run, or reclaim/preemption plans stop being reproducible under
+        # seeded simulation.
+        candidates.sort(key=lambda p: (-p.priority, -p.touched_at, p.uid))
         chosen: Optional[List[PodInfo]] = None
         # Single-victim pass first (cheapest possible plan on this node).
         for c in candidates:
@@ -115,9 +120,13 @@ def plan_preemption(
             continue  # even evicting every lower-priority pod won't fit
         usage_after = score_mod.build_usage(
             info, [p for p in pods if p.uid not in {v.uid for v in chosen}])
+        # Node name completes the tie-break chain (fewest victims, then
+        # score, then name): two nodes offering identical plans must
+        # resolve the same way regardless of dict iteration order.
         key = (len(chosen),
-               -score_mod.node_score(usage_after, node_policy))
-        if best is None or key < (best[0], best[1]):
+               -score_mod.node_score(usage_after, node_policy),
+               node)
+        if best is None or key < (best[0], best[1], best[2]):
             best = (key[0], key[1], node, chosen)
     if best is None:
         return None

@@ -12,6 +12,9 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
                     live grants) for ``vtpu-simulate --from-cluster``
 - ``GET  /usagez``  per-namespace showback over a trailing window
                     (``?window=<s>``) for ``vtpu-report``
+- ``GET  /queuez``  capacity-queue state (quota, held/borrowed usage,
+                    fair shares, pending pods + positions) for
+                    ``vtpu-report --queues`` and operators
 """
 
 from __future__ import annotations
@@ -99,6 +102,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, self.scheduler.export_fleet())
             except Exception as e:  # noqa: BLE001 — 500, not a hangup
                 log.exception("fleetz export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        elif self.path == "/queuez":
+            # Capacity-queue state (quota/queues.py stats): who is held,
+            # who is over nominal, current fair shares.
+            try:
+                self._reply(200, self.scheduler.export_queues())
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("queuez export failed")
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         elif self.path.startswith("/usagez"):
             # Per-namespace showback over a trailing window (accounting/

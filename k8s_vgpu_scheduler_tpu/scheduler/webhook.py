@@ -28,6 +28,11 @@ import json
 import logging
 from typing import List, Optional
 
+from ..quota.queues import (
+    QUEUE_ANNOTATION,
+    QUEUE_STATE_ANNOTATION,
+    STATE_HELD,
+)
 from ..util import trace
 from ..util.config import Config
 from ..util.resources import container_requests
@@ -43,14 +48,17 @@ def _is_privileged(container: dict) -> bool:
 
 
 def mutate_pod(pod: dict, cfg: Config, trace_id: str = "",
-               info: Optional[dict] = None) -> List[dict]:
+               info: Optional[dict] = None,
+               namespace: str = "") -> List[dict]:
     """Return JSONPatch ops for one pod (empty list = no mutation).
     When ``trace_id`` is set, TPU pods additionally get it written as the
     ``vtpu.dev/trace-id`` annotation (the webhook is the issuer; an ID
     already present — e.g. a retried admission — is kept).  ``info``
     (optional out-param, score.py ``reasons`` idiom) receives
     ``wants_tpu`` — the single source of the "is this ours?" decision,
-    which also gates trace issuance in the caller."""
+    which also gates trace issuance in the caller.  ``namespace`` is the
+    AdmissionReview request namespace (pod CREATEs often omit
+    metadata.namespace) — the capacity-queue governance key."""
     containers = pod.get("spec", {}).get("containers", [])
     if any(_is_privileged(c) for c in containers):
         log.info("pod %s has privileged container; skipping mutation",
@@ -105,23 +113,50 @@ def mutate_pod(pod: dict, cfg: Config, trace_id: str = "",
                  "value": cfg.scheduler_name}
             )
         anns = pod.get("metadata", {}).get("annotations")
+        new_anns: dict = {}
         if trace_id and (anns is None
                          or trace.TRACE_ID_ANNOTATION not in anns):
+            new_anns[trace.TRACE_ID_ANNOTATION] = trace_id
+        # Capacity-queue gate (quota/; docs/quota.md): a TPU pod in a
+        # governed namespace is SUSPENDED at creation — the queue +
+        # held-state annotations make the Filter refuse it until the
+        # admission loop releases it in fair-share order.  A pod that
+        # already carries a queue state (retried admission, or a
+        # controller round-tripping an admitted pod) is left untouched.
+        namespace = namespace or pod.get("metadata", {}).get(
+            "namespace", "default")
+        q = _governing_queue(cfg, namespace)
+        if q is not None and (anns is None
+                              or QUEUE_STATE_ANNOTATION not in anns):
+            new_anns[QUEUE_ANNOTATION] = q
+            new_anns[QUEUE_STATE_ANNOTATION] = STATE_HELD
+        if new_anns:
             if anns is None:
                 patches.append(
                     {"op": "add", "path": "/metadata/annotations",
-                     "value": {trace.TRACE_ID_ANNOTATION: trace_id}}
+                     "value": new_anns}
                 )
             else:
-                # JSON-pointer-escape the '/' in the annotation key.
-                key = trace.TRACE_ID_ANNOTATION.replace("~", "~0").replace(
-                    "/", "~1")
-                patches.append(
-                    {"op": "add",
-                     "path": f"/metadata/annotations/{key}",
-                     "value": trace_id}
-                )
+                for k, v in new_anns.items():
+                    # JSON-pointer-escape the '/' in the annotation key.
+                    key = k.replace("~", "~0").replace("/", "~1")
+                    patches.append(
+                        {"op": "add",
+                         "path": f"/metadata/annotations/{key}",
+                         "value": v}
+                    )
     return patches
+
+
+def _governing_queue(cfg: Config, namespace: str) -> Optional[str]:
+    """Name of the capacity queue governing ``namespace`` (None =
+    ungoverned / quota off)."""
+    if not cfg.quota_queues:
+        return None
+    from ..quota.queues import queue_for_namespace
+
+    q = queue_for_namespace(cfg.quota_queues, namespace)
+    return q.name if q is not None else None
 
 
 #: Injected volume/mount names — prefixed to avoid colliding with user
@@ -205,7 +240,8 @@ def handle_admission_review(body: dict, cfg: Config) -> dict:
         # The span is registered only if mutate_pod says the pod is ours
         # (a dropped Span object costs nothing).
         sp = trace.Span("webhook", trace_id)
-        patches = mutate_pod(pod, cfg, trace_id=trace_id, info=info)
+        patches = mutate_pod(pod, cfg, trace_id=trace_id, info=info,
+                             namespace=req.get("namespace", ""))
         if info.get("wants_tpu"):
             meta = pod.get("metadata", {})
             sp.set("pod", meta.get("name", "?"))

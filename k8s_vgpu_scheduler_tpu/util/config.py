@@ -122,6 +122,29 @@ class Config:
     # actual-based placement deliberately (--score-by-actual).
     score_by_actual: bool = False
 
+    # Multi-tenant capacity queues (quota/; docs/quota.md).  Tuple of
+    # queue config dicts ({"name", "namespaces", "cohort", "weight",
+    # "quota": {"chips", "hbm_mib"}, "borrow_limit_chips", ...} — the
+    # --quota-config file's "queues" list).  Empty = the whole admission
+    # layer is off and every namespace bypasses it.
+    quota_queues: tuple = ()
+    # Fold measured grant efficiency (the PR 4 accounting ledger) into
+    # fair-share weights: chronically idle tenants are demoted toward a
+    # floor (--fair-share-usage-informed; quota/fairshare.py).
+    fair_share_usage_informed: bool = False
+    # Admission loop cadence, and how long a released pod may sit
+    # unplaced before its under-nominal queue reclaims borrowed grants.
+    admission_interval_s: float = 2.0
+    queue_reclaim_grace_s: float = 15.0
+    # Gang-aware backfill and borrowed-grant reclaim gates
+    # (--no-queue-backfill / --no-reclaim).
+    enable_queue_backfill: bool = True
+    enable_reclaim: bool = True
+    # Fleet release-throttle multiplier over registered whole chips
+    # (the throttle counts whole-chip grants; raise on heavily split
+    # fleets — quota/admission.py AdmissionConfig.fleet_headroom).
+    queue_fleet_headroom: float = 1.0
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

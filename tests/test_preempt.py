@@ -144,6 +144,49 @@ class TestSchedulerPreemption:
             anns = kube.get_pod("default", name)["metadata"]["annotations"]
             assert anns.get(PREEMPT_ANNOTATION) == "u-hp", name
 
+    def test_victim_ordering_deterministic_uid_tiebreak(self):
+        """Equal-priority, equal-footprint victims granted at the SAME
+        instant (a frozen simulation clock, or one batch admission) must
+        order by uid — reclaim/preemption plans replay bit-identically
+        under seeded simulation regardless of registry iteration order.
+        Regression: before the uid tie-break, the sort was stable on
+        insertion order, which differs between a live watch feed and a
+        resync rebuild of the same state."""
+        from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+        from k8s_vgpu_scheduler_tpu.scheduler.preempt import (
+            plan_preemption,
+        )
+        from k8s_vgpu_scheduler_tpu.scheduler.score import build_usage
+        from k8s_vgpu_scheduler_tpu.util.resources import (
+            container_requests,
+        )
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+        s = Scheduler(FakeKube(), Config(enable_preemption=True))
+        register_node(s, "node-a", chips=1)
+        info = s.nodes.get_node("node-a")
+
+        def victim(uid):
+            return PodInfo(
+                uid=uid, name=uid, namespace="default", node="node-a",
+                devices=[[ContainerDevice("node-a-chip-0", "TPU-v5e",
+                                          5000, 0)]],
+                priority=1, touched_at=123.0)  # identical grant instant
+
+        requests = container_requests(
+            tpu_pod("hp", "u-hp", "10000"), s.cfg)
+        entries = {"node-a": (info, build_usage(info, []))}
+        for ordering in (["zz", "aa", "mm"], ["mm", "zz", "aa"],
+                         ["aa", "mm", "zz"]):
+            plan = plan_preemption(
+                requests, 0, entries,
+                {"node-a": [victim(u) for u in ordering]},
+                {}, "best-effort")
+            assert plan is not None
+            # 10000 MiB needs two 5000-MiB victims gone; always the
+            # uid-smallest pair, whatever order the registry yields.
+            assert [v.uid for v in plan.victims] == ["aa", "mm"]
+
     def test_repeat_filter_throttles_patches(self, env):
         kube, s = env
         place(kube, s, tpu_pod("lp", "u-lp", "16000", priority=1))

@@ -1,0 +1,747 @@
+"""Capacity queues (quota/): fair-share ordering, borrow/reclaim
+invariants, gang-aware backfill, ungoverned bypass, and the
+reclaim-vs-rescuer interplay.
+
+Everything runs on a virtual clock (health/faults.SimClock) against the
+REAL Scheduler + FakeKube — fast tier-1 units, no sleeps, fully
+deterministic.
+"""
+
+import threading
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.accounting.ledger import UsageLedger
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.quota.fairshare import (
+    USAGE_WEIGHT_FLOOR,
+    dominant_share,
+    effective_weight,
+    fair_share_order,
+    queue_efficiencies,
+)
+from k8s_vgpu_scheduler_tpu.quota.queues import (
+    QUEUE_ANNOTATION,
+    QUEUE_POSITION_ANNOTATION,
+    QUEUE_STATE_ANNOTATION,
+    STATE_ADMITTED,
+    STATE_HELD,
+    QueueConfig,
+    QueueUsage,
+    parse_quota_config,
+    queue_for_namespace,
+)
+from k8s_vgpu_scheduler_tpu.quota.reclaim import plan_reclaim
+from k8s_vgpu_scheduler_tpu.scheduler import (
+    DeviceInfo,
+    NodeInfo,
+    Scheduler,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.preempt import PREEMPT_ANNOTATION
+from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+from k8s_vgpu_scheduler_tpu.scheduler.webhook import mutate_pod
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util import nodelock
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+QA = {"name": "a", "namespaces": ["team-a"], "cohort": "m", "weight": 3,
+      "quota": {"chips": 6}, "borrow_limit_chips": 2}
+QB = {"name": "b", "namespaces": ["team-b"], "cohort": "m", "weight": 1,
+      "quota": {"chips": 2}, "borrow_limit_chips": 6}
+
+
+def build(queues=(QA, QB), nodes=2, chips=4, hbm=16384, **cfg_kw):
+    clock = SimClock()
+    cfg = Config(quota_queues=tuple(queues),
+                 queue_reclaim_grace_s=0.0, **cfg_kw)
+    kube = FakeKube()
+    s = Scheduler(kube, cfg, clock=clock)
+    names = []
+    for i in range(nodes):
+        n = f"n{i}"
+        names.append(n)
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        devs = [DeviceInfo(id=f"{n}-c{j}", count=1, devmem=hbm,
+                           type="TPU-v5e", health=True, coords=(j, 0))
+                for j in range(chips)]
+        s.nodes.add_node(n, NodeInfo(
+            name=n, devices=devs,
+            topology=TopologyDesc(generation="v5e", mesh=(chips, 1))))
+    kube.watch_pods(s.on_pod_event)
+    return s, kube, names, clock
+
+
+def mkpod(name, ns, chips=2, queue=None, extra_anns=None):
+    anns = dict(extra_anns or {})
+    if queue is not None:
+        anns[QUEUE_ANNOTATION] = queue
+        anns[QUEUE_STATE_ANNOTATION] = STATE_HELD
+    return {
+        "metadata": {"name": name, "namespace": ns, "uid": f"uid-{name}",
+                     "annotations": anns},
+        "spec": {"containers": [{
+            "name": "m",
+            "resources": {"limits": {"google.com/tpu": str(chips),
+                                     "google.com/tpumem": "16384"}}}]},
+    }
+
+
+def place(s, kube, pod, names):
+    r = s.filter(pod, names)
+    assert r.node, r.error
+    ns = pod["metadata"]["namespace"]
+    s.bind(ns, pod["metadata"]["name"], pod["metadata"]["uid"], r.node)
+    nodelock.release_node(kube, r.node)
+    return r.node
+
+
+def held_usage(s):
+    return {k: v.chips
+            for k, v in s.quota.usage(s.pods.list_pods()).items()}
+
+
+# ---------------------------------------------------------------------------
+# config + fair-share math
+# ---------------------------------------------------------------------------
+
+class TestConfig:
+    def test_parse_rejects_duplicate_queue(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_quota_config({"queues": [QA, dict(QA, namespaces=[])]})
+
+    def test_parse_rejects_doubly_governed_namespace(self):
+        with pytest.raises(ValueError, match="governed by both"):
+            parse_quota_config(
+                {"queues": [QA, dict(QB, namespaces=["team-a"])]})
+
+    def test_parse_rejects_nonpositive_weight(self):
+        with pytest.raises(ValueError, match="weight"):
+            parse_quota_config({"queues": [dict(QA, weight=0)]})
+
+    def test_load_quota_config_tolerates_empty_and_yaml(self, tmp_path):
+        from k8s_vgpu_scheduler_tpu.cmd.scheduler import load_quota_config
+
+        assert load_quota_config("") == ()
+        empty = tmp_path / "empty.yaml"
+        empty.write_text("# nothing here\n")
+        assert load_quota_config(str(empty)) == ()
+        y = tmp_path / "quota.yaml"
+        y.write_text("queues:\n  - name: a\n    namespaces: [team-a]\n")
+        (q,) = load_quota_config(str(y))
+        assert q["name"] == "a"
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("- just\n- a\n- list\n")
+        with pytest.raises(ValueError, match="expected a mapping"):
+            load_quota_config(str(bad))
+
+    def test_queue_for_namespace_accepts_raw_dicts(self):
+        q = queue_for_namespace((QA, QB), "team-b")
+        assert q is not None and q.name == "b"
+        assert queue_for_namespace((QA, QB), "elsewhere") is None
+
+
+class TestFairShare:
+    def test_dominant_share_is_max_over_dimensions(self):
+        q = QueueConfig(name="q", namespaces=("x",), nominal_chips=8,
+                        nominal_hbm_mib=1000)
+        assert dominant_share(QueueUsage(chips=4, mem_mib=900), q) == 0.9
+        assert dominant_share(QueueUsage(chips=6, mem_mib=100), q) == 0.75
+
+    def test_zero_nominal_chips_reads_as_all_borrowed(self):
+        q = QueueConfig(name="scavenger", namespaces=("x",),
+                        nominal_chips=0)
+        assert dominant_share(QueueUsage(chips=1), q) == float("inf")
+        assert dominant_share(QueueUsage(), q) == 0.0
+
+    def test_weighted_order_prefers_underweighted_queue(self):
+        queues = {
+            "a": QueueConfig(name="a", namespaces=("a",), weight=3,
+                             nominal_chips=6),
+            "b": QueueConfig(name="b", namespaces=("b",), weight=1,
+                             nominal_chips=6),
+        }
+        # Equal held: the heavier-weighted queue has the smaller share.
+        usage = {"a": QueueUsage(chips=3), "b": QueueUsage(chips=3)}
+        order = fair_share_order(queues, usage)
+        assert [n for _s, n in order] == ["a", "b"]
+
+    def test_equal_shares_tie_break_by_name_deterministically(self):
+        queues = {n: QueueConfig(name=n, namespaces=(n,), nominal_chips=4)
+                  for n in ("zz", "aa", "mm")}
+        usage = {n: QueueUsage(chips=2) for n in queues}
+        for _ in range(5):
+            assert [n for _s, n in fair_share_order(queues, usage)] == \
+                ["aa", "mm", "zz"]
+
+    def test_usage_informed_demotes_idle_tenant_with_floor(self):
+        q = QueueConfig(name="q", namespaces=("x",), weight=2.0)
+        assert effective_weight(q, None, True) == 2.0       # unknown ≠ idle
+        assert effective_weight(q, 0.5, False) == 2.0       # mode off
+        assert effective_weight(q, 0.5, True) == 1.0
+        assert effective_weight(q, 0.0, True) == \
+            2.0 * USAGE_WEIGHT_FLOOR                         # floored
+        assert effective_weight(q, 5.0, True) == 2.0         # capped at 1
+
+    def test_counter_reset_safe_usage_weighting(self):
+        """A monitor restart (counters back to zero) must never produce
+        a negative or wild efficiency — the ledger treats the reset raw
+        value as fresh usage, so the queue's effective weight stays in
+        [floor*w, w]."""
+        clock = SimClock()
+        ledger = UsageLedger(clock=clock)
+        row = {"ctrkey": "u1_p1", "chips": 2, "active": True,
+               "chip_seconds": 100.0, "hbm_byte_seconds": 0.0,
+               "throttled_seconds": 0.0, "oversub_spill_seconds": 0.0}
+        ledger.record("n0", [row])
+        clock.advance(60)
+        ledger.record("n0", [dict(row, chip_seconds=160.0)])
+        clock.advance(60)
+        # Reset: the monitor restarted and begins again near zero.
+        ledger.record("n0", [dict(row, chip_seconds=5.0)])
+        assert ledger.resets_observed == 1
+
+        from k8s_vgpu_scheduler_tpu.accounting import efficiency as eff
+
+        pods = [PodInfo(uid="u1", name="p1", namespace="team-a", node="n0",
+                        devices=[[ContainerDevice("c0", "v5e", 100, 0),
+                                  ContainerDevice("c1", "v5e", 100, 0)]])]
+        fleet = eff.grant_efficiency(
+            pods, ledger, eff.EfficiencyConfig(window_s=300.0),
+            now=clock())
+        effs = queue_efficiencies(fleet, {"team-a": "a"})
+        assert "a" in effs and effs["a"] is not None
+        assert effs["a"] >= 0.0
+        q = QueueConfig(name="a", namespaces=("team-a",), weight=3.0)
+        w = effective_weight(q, effs["a"], True)
+        assert 3.0 * USAGE_WEIGHT_FLOOR <= w <= 3.0
+
+
+# ---------------------------------------------------------------------------
+# gate / bypass / webhook
+# ---------------------------------------------------------------------------
+
+class TestGate:
+    def test_ungoverned_namespace_bypasses_entirely(self):
+        s, kube, names, _ = build()
+        pod = mkpod("free-0", "other")
+        kube.create_pod(pod)
+        assert place(s, kube, pod, names)
+        assert s.quota.entries() == []
+
+    def test_governed_pod_held_with_position(self):
+        s, kube, names, _ = build()
+        for i in range(3):
+            kube.create_pod(mkpod(f"a{i}", "team-a", queue="a"))
+        r = s.filter(mkpod("a1", "team-a", queue="a"), names)
+        assert r.node is None
+        assert "held in capacity queue a" in r.error
+        assert "position 2/3" in r.error
+
+    def test_admitted_annotation_is_the_restart_wal(self):
+        """A restarted scheduler (fresh manager) re-learns admission
+        from the queue-state annotation instead of re-holding."""
+        s, kube, names, _ = build()
+        pod = mkpod("a0", "team-a", queue="a")
+        pod["metadata"]["annotations"][QUEUE_STATE_ANNOTATION] = \
+            STATE_ADMITTED
+        kube.create_pod(pod)
+        assert place(s, kube, pod, names)
+
+    def test_quota_disabled_is_inert(self):
+        s, kube, names, _ = build(queues=())
+        pod = mkpod("a0", "team-a", queue="a")  # annotation but no config
+        kube.create_pod(pod)
+        assert place(s, kube, pod, names)
+        assert not s.quota.enabled
+
+    def test_webhook_stamps_governed_pods_only(self):
+        cfg = Config(quota_queues=(QA, QB))
+        pod = mkpod("w0", "team-a")
+        patches = mutate_pod(pod, cfg, trace_id="t1", namespace="team-a")
+        added = {}
+        for p in patches:
+            if p["path"] == "/metadata/annotations":
+                added.update(p["value"])
+            elif p["path"].startswith("/metadata/annotations/"):
+                added[p["path"].rsplit("/", 1)[1]
+                      .replace("~1", "/")] = p["value"]
+        assert added[QUEUE_ANNOTATION] == "a"
+        assert added[QUEUE_STATE_ANNOTATION] == STATE_HELD
+
+        free = mutate_pod(mkpod("w1", "nobody"), cfg, trace_id="t2",
+                          namespace="nobody")
+        text = str(free)
+        assert QUEUE_ANNOTATION not in text
+
+    def test_webhook_leaves_existing_queue_state_alone(self):
+        cfg = Config(quota_queues=(QA,))
+        pod = mkpod("w2", "team-a",
+                    extra_anns={QUEUE_STATE_ANNOTATION: STATE_ADMITTED})
+        patches = mutate_pod(pod, cfg, namespace="team-a")
+        assert QUEUE_ANNOTATION not in str(patches)
+
+
+# ---------------------------------------------------------------------------
+# admission flow
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_hold_admit_place_with_events_and_positions(self):
+        s, kube, names, _ = build()
+        pods = [mkpod(f"a{i}", "team-a", queue="a") for i in range(4)]
+        for p in pods:
+            kube.create_pod(p)
+        acts = s.admission.tick()
+        # nominal 6 + borrow 2 = 8 chips = all four 2-chip pods.
+        assert [a["kind"] for a in acts].count("admit") == 4
+        for p in pods:
+            place(s, kube, p, names)
+        assert held_usage(s) == {"a": 8, "b": 0}
+        reasons = [e["reason"] for e in kube.events]
+        assert reasons.count("Admitted") == 4
+        # WAL annotation written.
+        anns = kube.get_pod("team-a", "a0")["metadata"]["annotations"]
+        assert anns[QUEUE_STATE_ANNOTATION] == STATE_ADMITTED
+
+    def test_held_pod_gets_position_annotation_and_queued_event(self):
+        s, kube, names, _ = build()
+        for i in range(5):  # 10 chips demand > 8 admissible
+            kube.create_pod(mkpod(f"a{i}", "team-a", queue="a"))
+        s.admission.tick()
+        anns = kube.get_pod("team-a", "a4")["metadata"]["annotations"]
+        assert anns[QUEUE_POSITION_ANNOTATION] == "1/1"
+        assert "Queued" in [e["reason"] for e in kube.events]
+
+    def test_fleet_throttle_holds_releases_at_capacity(self):
+        s, kube, _names, _ = build()
+        for i in range(6):  # 12 chips demand, fleet 8
+            kube.create_pod(mkpod(f"a{i}", "team-a", queue="a"))
+        for i in range(2):
+            kube.create_pod(mkpod(f"b{i}", "team-b", queue="b"))
+        s.admission.tick()
+        u = held_usage(s)
+        assert u["a"] + u["b"] <= 8
+        assert u["b"] == 2  # b's nominal is entitled even under pressure
+
+    def test_fair_share_order_equalizes_weighted_shares(self):
+        # Same nominal, weights 3:1 — both backlogged, releases land 3:1.
+        qa = dict(QA, quota={"chips": 4}, borrow_limit_chips=0)
+        qb = dict(QB, quota={"chips": 4}, borrow_limit_chips=0)
+        s, kube, _names, _ = build(queues=(qa, qb), nodes=2, chips=3)
+        for i in range(4):
+            kube.create_pod(mkpod(f"a{i}", "team-a", chips=1, queue="a"))
+            kube.create_pod(mkpod(f"b{i}", "team-b", chips=1, queue="b"))
+        s.admission.tick()
+        u = held_usage(s)
+        # 6 fleet chips; DRF equalizes held/(nominal*weight): the exact
+        # greedy sequence is deterministic and lands 4:2 — the weighted
+        # queue gets the contended capacity in (integer-rounded) weight
+        # proportion.
+        assert u == {"a": 4, "b": 2}
+
+
+# ---------------------------------------------------------------------------
+# borrow / reclaim
+# ---------------------------------------------------------------------------
+
+class TestBorrowReclaim:
+    def _borrowed_fleet(self):
+        s, kube, names, clock = build()
+        pods = [mkpod(f"a{i}", "team-a", queue="a") for i in range(4)]
+        for p in pods:
+            kube.create_pod(p)
+        s.admission.tick()
+        for p in pods:
+            place(s, kube, p, names)
+        assert held_usage(s)["a"] == 8  # nominal 6 + borrowed 2
+        return s, kube, names, clock
+
+    def test_reclaim_targets_only_borrowed_youngest_first(self):
+        s, kube, names, clock = self._borrowed_fleet()
+        kube.create_pod(mkpod("b0", "team-b", queue="b"))
+        clock.advance(1)
+        acts = s.admission.tick()
+        recl = [a for a in acts if a["kind"] == "reclaim"]
+        assert len(recl) == 1
+        victims = recl[0]["victims"]
+        # Only as much as borrowed (2 chips = one 2-chip pod), youngest
+        # grant first (a3 was placed last), donor verifiably over
+        # nominal at plan time.
+        assert [v["pod"] for v in victims] == ["team-a/a3"]
+        assert all(v["donor_borrowed"] >= v["chips"] for v in victims)
+        anns = kube.get_pod("team-a", "a3")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION] == "uid-b0"
+        # In-quota grants untouched.
+        for n in ("a0", "a1", "a2"):
+            anns = kube.get_pod("team-a", n)["metadata"]["annotations"]
+            assert not anns.get(PREEMPT_ANNOTATION)
+
+    def test_no_replan_while_victims_checkpoint(self):
+        s, kube, names, clock = self._borrowed_fleet()
+        kube.create_pod(mkpod("b0", "team-b", queue="b"))
+        clock.advance(1)
+        acts1 = s.admission.tick()
+        clock.advance(30)
+        acts2 = s.admission.tick()  # victim still checkpointing
+        assert sum(1 for a in acts1 + acts2
+                   if a["kind"] == "reclaim") == 1
+        assert s.quota.reclaims_total == 1
+
+    def test_victim_exit_admits_entitled_tenant(self):
+        s, kube, names, clock = self._borrowed_fleet()
+        b0 = mkpod("b0", "team-b", queue="b")
+        kube.create_pod(b0)
+        clock.advance(1)
+        s.admission.tick()
+        # Victim checkpoints and exits (the in-container watch's role).
+        kube.delete_pod("team-a", "a3")
+        clock.advance(1)
+        s.admission.tick()
+        assert place(s, kube, b0, names)
+        u = held_usage(s)
+        assert u == {"a": 6, "b": 2}  # back to nominal entitlements
+
+    def test_reclaim_never_dips_donor_below_nominal(self):
+        """plan_reclaim unit invariant: per-donor victim chips are
+        capped at its borrowed amount."""
+        queues = {q.name: q for q in parse_quota_config(
+            {"queues": [QA, QB]})}
+        usage = {"a": QueueUsage(chips=8), "b": QueueUsage(chips=0)}
+        pods = [PodInfo(uid=f"u{i}", name=f"p{i}", namespace="team-a",
+                        node="n0",
+                        devices=[[ContainerDevice("c", "v5e", 100, 0)]
+                                 * 2],
+                        touched_at=float(i))
+                for i in range(4)]
+        plan = plan_reclaim(2, queues["b"], queues, usage, pods)
+        assert plan is not None
+        assert [v.uid for v in plan.victims] == ["u3"]  # youngest
+        # Demanding more than the borrowed slice: refuse outright.
+        assert plan_reclaim(4, queues["b"], queues, usage, pods) is None
+
+    def test_cohortless_queues_are_private(self):
+        """No cohort = no sharing: two cohort-less queues must not cap
+        each other's admissions (implicit '' cohort) nor become reclaim
+        donors for each other."""
+        qa = dict(QA, cohort="", quota={"chips": 4},
+                  borrow_limit_chips=0)
+        qb = dict(QB, cohort="", quota={"chips": 4},
+                  borrow_limit_chips=0)
+        s, kube, names, _ = build(queues=(qa, qb))
+        mgr = s.quota
+        usage = {"a": QueueUsage(chips=4), "b": QueueUsage(chips=0)}
+        # a at nominal, b empty: b admitting 4 must NOT be capped by an
+        # accidental shared-''-cohort sum (4+4 > 4+4 would refuse).
+        ok, why = mgr.fits_quota(mgr.queues["b"], usage, 4, 0)
+        assert ok, why
+        # And neither queue can donate reclaim victims to the other.
+        pods = [PodInfo(uid="u0", name="p0", namespace="team-a",
+                        node="n0",
+                        devices=[[ContainerDevice("c", "v5e", 100, 0)]],
+                        touched_at=1.0)]
+        assert plan_reclaim(1, mgr.queues["b"], mgr.queues,
+                            {"a": QueueUsage(chips=5),
+                             "b": QueueUsage(chips=0)}, pods) is None
+
+    def test_reclaim_fires_for_released_but_unplaced_in_quota_pod(self):
+        """The second reclaim trigger: a pod already ADMITTED but stuck
+        unplaced (its reservation charges the queue) must still reclaim
+        — the entitlement check excludes the trigger's own reservation,
+        or a pod using >= half of remaining nominal silently starves."""
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 6}, borrow_limit_chips=2),
+                    QB),
+            nodes=2, chips=4)
+        pods = [mkpod(f"a{i}", "team-a", queue="a") for i in range(4)]
+        for p in pods:
+            kube.create_pod(p)
+            clock.advance(1)
+        s.admission.tick()
+        for p in pods:
+            place(s, kube, p, names)  # fleet full, a holds 8 (2 borrowed)
+        b0 = mkpod("b0", "team-b", queue="b")
+        kube.create_pod(b0)  # watch enqueues the held entry
+        clock.advance(1)
+        assert s.quota.entry("uid-b0").state == STATE_HELD
+        # Release in-memory (as the loop would), then fail placement:
+        # the RELEASED entry's reservation now charges queue b's usage,
+        # and the entitlement check must not double-count it.
+        s.quota.release("uid-b0")
+        r = s.filter(b0, names)
+        assert r.node is None
+        clock.advance(5)
+        acts = s.admission.tick()
+        recl = [a for a in acts if a["kind"] == "reclaim"]
+        assert len(recl) == 1, acts
+        assert [v["pod"] for v in recl[0]["victims"]] == ["team-a/a3"]
+
+    def test_position_annotation_tracks_denominator(self):
+        """'1/1' must become '1/2' when a pod queues up behind — the
+        patch throttle keys on the full pos/total string."""
+        s, kube, names, clock = build()
+        for i in range(5):  # 10 chips demand > 8 admissible: a4 held
+            kube.create_pod(mkpod(f"a{i}", "team-a", queue="a"))
+            clock.advance(1)
+        s.admission.tick()
+        anns = kube.get_pod("team-a", "a4")["metadata"]["annotations"]
+        assert anns[QUEUE_POSITION_ANNOTATION] == "1/1"
+        kube.create_pod(mkpod("a5", "team-a", queue="a"))
+        s.admission.tick()
+        anns = kube.get_pod("team-a", "a4")["metadata"]["annotations"]
+        assert anns[QUEUE_POSITION_ANNOTATION] == "1/2"
+
+    def test_reclaim_plan_is_deterministic_under_frozen_clock(self):
+        """Equal touched_at (batch admission on a frozen SimClock) must
+        order victims by uid — identical plans on every run."""
+        queues = {q.name: q for q in parse_quota_config(
+            {"queues": [dict(QA, borrow_limit_chips=4), QB]})}
+        usage = {"a": QueueUsage(chips=10), "b": QueueUsage(chips=0)}
+        pods = [PodInfo(uid=u, name=u, namespace="team-a", node="n0",
+                        devices=[[ContainerDevice("c", "v5e", 100, 0)]
+                                 * 2],
+                        touched_at=50.0)
+                for u in ("zz", "aa", "mm")]
+        for _ in range(5):
+            plan = plan_reclaim(4, queues["b"], queues, usage, pods)
+            assert [v.uid for v in plan.victims] == ["aa", "mm"]
+
+
+# ---------------------------------------------------------------------------
+# gang-aware backfill
+# ---------------------------------------------------------------------------
+
+GANG_ANNS = {"vtpu.dev/pod-group": "ring", "vtpu.dev/pod-group-total": "2"}
+
+
+class TestBackfill:
+    def test_short_runtime_pod_admits_ahead_of_accumulating_gang(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 4}),), nodes=1, chips=4)
+        kube.create_pod(mkpod("ring-0", "team-a", queue="a",
+                              extra_anns=GANG_ANNS))
+        clock.advance(1)  # gang strictly FIRST in FIFO order
+        # Behind the gang: one pod declaring a short runtime, one not.
+        kube.create_pod(mkpod(
+            "quick", "team-a", chips=1, queue="a",
+            extra_anns={"vtpu.dev/estimated-runtime-seconds": "30"}))
+        kube.create_pod(mkpod("slow", "team-a", chips=1, queue="a"))
+        acts = s.admission.tick()
+        admitted = [a["pod"] for a in acts if a["kind"] == "admit"]
+        # Fleet 4 chips == gang footprint estimate: no hole, so only the
+        # runtime-declaring pod may ride the reservation window.
+        assert admitted == ["team-a/quick"]
+        assert all(a.get("backfilled") for a in acts
+                   if a["kind"] == "admit")
+
+    def test_backfill_uses_footprint_hole_when_fleet_has_room(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 8}),), nodes=2, chips=4)
+        kube.create_pod(mkpod("ring-0", "team-a", queue="a",
+                              extra_anns=GANG_ANNS))
+        clock.advance(1)
+        kube.create_pod(mkpod("filler", "team-a", chips=2, queue="a"))
+        acts = s.admission.tick()
+        # Footprint estimate 4 (2 known + 2 projected); fleet 8; hole 4
+        # fits the 2-chip filler with NO runtime declaration.
+        assert [a["pod"] for a in acts if a["kind"] == "admit"] == \
+            ["team-a/filler"]
+
+    def test_gang_admits_atomically_once_complete_never_starved(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 4}),), nodes=1, chips=4)
+        m0 = mkpod("ring-0", "team-a", queue="a", extra_anns=GANG_ANNS)
+        kube.create_pod(m0)
+        clock.advance(1)
+        quick = mkpod("quick", "team-a", chips=1, queue="a",
+                      extra_anns={
+                          "vtpu.dev/estimated-runtime-seconds": "30"})
+        kube.create_pod(quick)
+        s.admission.tick()
+        place(s, kube, quick, names)
+        m1 = mkpod("ring-1", "team-a", queue="a", extra_anns=GANG_ANNS)
+        kube.create_pod(m1)
+        # Complete gang blocked only by the backfilled pod's chip.
+        acts = s.admission.tick()
+        assert not [a for a in acts if a["kind"] == "admit"]
+        # The short-lived pod exits inside the reservation window; the
+        # gang then releases atomically and places.
+        kube.delete_pod("team-a", "quick")
+        acts = s.admission.tick()
+        assert sorted(a["pod"] for a in acts if a["kind"] == "admit") == \
+            ["team-a/ring-0", "team-a/ring-1"]
+        r0 = s.filter(m0, names)          # registers with the gang
+        assert "waiting" in (r0.error or "")
+        r1 = s.filter(m1, names)          # quorum: atomic placement
+        assert r1.node
+        assert s.filter(m0, names).node   # reserved seat handed back
+
+
+# ---------------------------------------------------------------------------
+# interplay: reclaim vs rescuer (no double eviction)
+# ---------------------------------------------------------------------------
+
+class TestReclaimRescuerInterplay:
+    def test_reclaim_skips_victims_already_being_rescued(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 2}, borrow_limit_chips=2),
+                    QB),
+            nodes=1, chips=4)
+        pods = [mkpod(f"a{i}", "team-a", queue="a") for i in range(2)]
+        for p in pods:
+            kube.create_pod(p)
+        s.admission.tick()
+        placed_nodes = [place(s, kube, p, names) for p in pods]
+        assert held_usage(s)["a"] == 4  # 2 borrowed
+        # a1 lands on a chip that goes bad: the rescuer owns its
+        # eviction (checkpoint-first, rescue: annotation value).
+        a1_chip = s.pods.get("uid-a1").devices[0][0].uuid
+        s.quarantine.quarantine(placed_nodes[1], a1_chip, "flap")
+        s.rescuer.sweep()
+        assert "uid-a1" in s.rescuer.pending()
+        anns = kube.get_pod("team-a", "a1")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION].startswith("rescue:")
+
+        # b's entitled pod arrives; reclaim must NOT pick a1 (one
+        # eviction per victim — stacking a reclaim on a rescue would
+        # reset its checkpoint clock and double-count the eviction).
+        kube.create_pod(mkpod("b0", "team-b", queue="b"))
+        clock.advance(1)
+        acts = s.admission.tick()
+        recl = [a for a in acts if a["kind"] == "reclaim"]
+        assert len(recl) == 1
+        assert [v["pod"] for v in recl[0]["victims"]] == ["team-a/a0"]
+        # The rescue annotation survives untouched.
+        anns = kube.get_pod("team-a", "a1")["metadata"]["annotations"]
+        assert anns[PREEMPT_ANNOTATION].startswith("rescue:")
+        # And a racing rescuer sweep still cannot evict a0: it is not
+        # stranded (healthy chip), so the sweep leaves it alone.
+        s.rescuer.sweep()
+        assert s.pods.get("uid-a0") is not None
+
+
+# ---------------------------------------------------------------------------
+# scheduling-protocol invariant with the admission loop on
+# ---------------------------------------------------------------------------
+
+class TestConcurrency:
+    def test_zero_double_booking_with_admission_loop_on(self):
+        from k8s_vgpu_scheduler_tpu.cmd.simulate import overbooked_chips
+
+        qa = dict(QA, quota={"chips": 4}, borrow_limit_chips=0)
+        qb = dict(QB, quota={"chips": 4}, borrow_limit_chips=0)
+        s, kube, names, _ = build(queues=(qa, qb))
+        pods = []
+        for i in range(4):
+            pods.append(mkpod(f"a{i}", "team-a", chips=1, queue="a"))
+            pods.append(mkpod(f"b{i}", "team-b", chips=1, queue="b"))
+        for p in pods:
+            kube.create_pod(p)
+
+        stop = threading.Event()
+
+        def admission_churn():
+            while not stop.is_set():
+                s.admission.tick()
+
+        t = threading.Thread(target=admission_churn, daemon=True)
+        t.start()
+        placed, errors = [], []
+
+        def filter_one(pod):
+            for _ in range(200):
+                r = s.filter(pod, names)
+                if r.node:
+                    ns = pod["metadata"]["namespace"]
+                    s.bind(ns, pod["metadata"]["name"],
+                           pod["metadata"]["uid"], r.node)
+                    nodelock.release_node(kube, r.node)
+                    placed.append(pod["metadata"]["name"])
+                    return
+            errors.append(pod["metadata"]["name"])
+
+        threads = [threading.Thread(target=filter_one, args=(p,))
+                   for p in pods]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        stop.set()
+        t.join(timeout=5)
+        assert overbooked_chips(s) == []
+        # Quota 4+4 chips on an 8-chip fleet: everything admits and
+        # places exactly once.
+        assert sorted(placed) == sorted(p["metadata"]["name"]
+                                        for p in pods)
+        assert not errors
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_metrics_exporter_emits_queue_families(self):
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import (
+            ClusterCollector,
+        )
+
+        s, kube, names, _ = build()
+        for i in range(3):
+            kube.create_pod(mkpod(f"a{i}", "team-a", queue="a"))
+        s.admission.tick()
+        registry = CollectorRegistry()
+        registry.register(ClusterCollector(s))
+        text = generate_latest(registry).decode()
+        assert 'vtpu_queue_pending{queue="a"}' in text
+        assert 'vtpu_queue_admitted_total{queue="a"} 3.0' in text
+        assert 'vtpu_queue_fair_share{queue="a"}' in text
+        assert 'vtpu_borrowed_chips{queue="a"}' in text
+        assert "vtpu_reclaims_total 0.0" in text
+
+    def test_queuez_export_shape(self):
+        s, kube, names, _ = build()
+        for i in range(4):
+            kube.create_pod(mkpod(f"a{i}", "team-a", queue="a"))
+        s.admission.tick()
+        out = s.export_queues()
+        assert out["enabled"]
+        assert out["fair_share_order"]
+        rows = {r["queue"]: r for r in out["queues"]}
+        assert rows["a"]["nominal_chips"] == 6
+        assert rows["a"]["held_chips"] == 8
+        assert rows["a"]["borrowed_chips"] == 2
+        assert rows["b"]["pending"] == 0
+
+    def test_vtpu_report_joins_quota_columns(self):
+        from k8s_vgpu_scheduler_tpu.cmd.vtpu_report import (
+            join_quota,
+            to_csv,
+            NAMESPACE_COLUMNS,
+            format_report,
+        )
+
+        export = {"window_s": 300.0, "fleet": {},
+                  "namespaces": [{"namespace": "team-a", "pods": 2,
+                                  "chip_seconds": 100.0,
+                                  "hbm_byte_seconds": 0.0,
+                                  "granted_chip_seconds": 200.0,
+                                  "efficiency": 0.5, "idle_grants": 0}],
+                  "pods": [], "idle_grants": []}
+        queues = {"enabled": True, "queues": [
+            {"queue": "a", "cohort": "m", "weight": 3.0,
+             "nominal_chips": 6, "held_chips": 8, "borrowed_chips": 2,
+             "pending": 1, "fair_share": 0.44,
+             "namespaces": ["team-a"]}]}
+        joined = join_quota(export, queues)
+        row = joined["namespaces"][0]
+        assert row["queue"] == "a" and row["nominal_chips"] == 6
+        assert row["held_chips"] == 8 and row["borrowed_chips"] == 2
+        csv_text = to_csv(joined["namespaces"], NAMESPACE_COLUMNS)
+        assert "nominal_chips" in csv_text.splitlines()[0]
+        text = format_report(joined)
+        assert "capacity queues" in text and "OVER" in text
