@@ -2,8 +2,8 @@
 # hack/build.sh + a Makefile; here each surface is one target).
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
-        lint-dashboards dryrun scenarios controlplane bench-controlplane \
-        bench wheel clean
+        batch-protocol lint-dashboards dryrun scenarios controlplane \
+        bench-controlplane bench wheel clean
 
 all: native
 
@@ -36,6 +36,15 @@ quota-sim:                    ## capacity-queue fairness A/B in the simulator
 	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
 	    --workload examples/workload-queueing.json --nodes 2 --chips 4 --json \
 	  | python -c "import json,sys; v = json.load(sys.stdin)['queueing']['verdict']; assert v['ok'], v; print('quota-sim:', v)"
+
+# The scheduler-concurrency protocol suite (racing filter/bind/delete,
+# zero over-grant, conflict convergence) re-run with the batched Filter
+# on (--filter-batch; scheduler/batch.py), plus the batch-specific
+# parity and protocol units — proves batched cycles keep every invariant
+# of docs/scheduler-concurrency.md.
+batch-protocol:               ## concurrency protocol suite, batched Filter on
+	VTPU_TEST_FILTER_BATCH=1 python -m pytest \
+	    tests/test_scheduler_concurrency.py tests/test_scheduler_batch.py -q
 
 # Dashboard/alert ↔ code pinning, standalone (the same tests also run in
 # the default tier): every panel/alert expression must name a metric a

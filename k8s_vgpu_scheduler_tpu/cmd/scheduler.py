@@ -64,6 +64,26 @@ def parse_args(argv=None):
                    help="optimistic commits that lose their revision race "
                         "re-evaluate at most this many times before one "
                         "fully-locked decision")
+    p.add_argument("--filter-batch", action="store_true",
+                   help="batched scheduling cycles: concurrent Filters "
+                        "collapse into one snapshot + vectorized "
+                        "pods×chips evaluation + per-node group commit "
+                        "(docs/scheduler-concurrency.md, Batched cycles)")
+    p.add_argument("--batch-tick-ms", type=float, default=2.0,
+                   help="how long the first Filter into an idle batch "
+                        "gate waits for concurrent Filters to join its "
+                        "cycle; 0 = no wait")
+    p.add_argument("--batch-max", type=int, default=256,
+                   help="pods per batch cycle cap (bounds per-cycle "
+                        "latency; a deeper backlog drains over "
+                        "successive cycles)")
+    p.add_argument("--batch-solver", default="regret",
+                   choices=("regret", "fifo"),
+                   help="joint-placement solver: regret = greedy-with-"
+                        "regret over the score matrix (a pod with one "
+                        "feasible node is served before a flexible pod "
+                        "takes it); fifo = sequential argmax in fair-"
+                        "share order (serial-path decision parity)")
     p.add_argument("--gil-switch-interval", type=float, default=0.05,
                    help="sys.setswitchinterval for this process (seconds); "
                         "concurrent Filters are short CPU-bound bursts and "
@@ -232,6 +252,10 @@ def build_config(args) -> Config:
         optimistic_commit=not args.serial_filter,
         filter_workers=args.filter_workers,
         commit_retries=args.commit_retries,
+        filter_batch=args.filter_batch,
+        batch_tick_ms=args.batch_tick_ms,
+        batch_max=args.batch_max,
+        batch_solver=args.batch_solver,
         lease_ttl_s=args.lease_ttl,
         lease_grace_beats=args.lease_grace_beats,
         quarantine_flap_threshold=args.quarantine_flap_threshold,

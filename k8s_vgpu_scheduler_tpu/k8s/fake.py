@@ -11,7 +11,7 @@ reference never tests.
 
 from __future__ import annotations
 
-import copy
+
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -21,6 +21,20 @@ from .client import Conflict, Gone, KubeClient, NotFound
 # Journal depth before old events are compacted away (watchers further back
 # get Gone and must re-list — apiserver etcd-compaction semantics).
 JOURNAL_LIMIT = 1024
+
+
+def _copy(obj):
+    """Structural copy for the JSON-shaped objects an apiserver stores
+    (dicts/lists of scalars).  copy.deepcopy spends most of its time on
+    memo bookkeeping these objects never need; at thousands of watch
+    events per benchmark second that overhead IS the fake's latency.
+    Non-container values are shared — they are immutable in any object
+    that round-trips a real apiserver."""
+    if isinstance(obj, dict):
+        return {k: _copy(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_copy(v) for v in obj]
+    return obj
 
 
 def _apply_annotation_patch(obj: dict, annotations: Dict[str, Optional[str]]) -> None:
@@ -57,9 +71,11 @@ class FakeKube(KubeClient):
 
     def _journal_append(self, event: str, pod: dict) -> None:
         """Under self._lock: stamp the pod's rv, journal the event, wake
-        watchers."""
+        watchers.  The journal keeps its OWN copy — watchers and callers
+        receive separate snapshots they are free to mutate; a shared
+        dict would let them rewrite journal history retroactively."""
         rv = int(pod.setdefault("metadata", {}).get("resourceVersion", "0"))
-        self._journal.append((rv, event, copy.deepcopy(pod)))
+        self._journal.append((rv, event, _copy(pod)))
         if len(self._journal) > JOURNAL_LIMIT:
             drop = len(self._journal) - JOURNAL_LIMIT
             self._compacted_below = self._journal[drop - 1][0]
@@ -71,7 +87,7 @@ class FakeKube(KubeClient):
         # Store a copy: the real apiserver never shares memory with callers,
         # so later local mutation of the argument must not change server state.
         with self._lock:
-            node = copy.deepcopy(node)
+            node = _copy(node)
             node.setdefault("metadata", {}).setdefault(
                 "resourceVersion", self._next_rv()
             )
@@ -79,12 +95,12 @@ class FakeKube(KubeClient):
 
     def create_pod(self, pod: dict) -> dict:
         with self._lock:
-            pod = copy.deepcopy(pod)
+            pod = _copy(pod)
             key = f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
             pod.setdefault("metadata", {})["resourceVersion"] = self._next_rv()
             self._pods[key] = pod
             watchers = list(self._pod_watchers)
-            snapshot = copy.deepcopy(pod)
+            snapshot = _copy(pod)
             self._journal_append("ADDED", pod)
         for w in watchers:
             w("ADDED", snapshot)
@@ -99,12 +115,12 @@ class FakeKube(KubeClient):
                 self._journal_append("DELETED", pod)
         if pod is not None:
             for w in watchers:
-                w("DELETED", copy.deepcopy(pod))
+                w("DELETED", _copy(pod))
 
     def watch_pods(self, fn: Callable[[str, dict], None]) -> None:
         with self._lock:
             self._pod_watchers.append(fn)
-            existing = [copy.deepcopy(p) for p in self._pods.values()]
+            existing = [_copy(p) for p in self._pods.values()]
         for p in existing:
             fn("ADDED", p)
 
@@ -115,7 +131,7 @@ class FakeKube(KubeClient):
             raise ValueError("node_name must be non-empty")
         with self._lock:
             pods = [
-                copy.deepcopy(p)
+                _copy(p)
                 for k, p in self._pods.items()
                 if (namespace is None or k.split("/", 1)[0] == namespace)
                 and (node_name is None
@@ -125,7 +141,7 @@ class FakeKube(KubeClient):
 
     def list_pods_with_rv(self) -> Tuple[List[dict], str]:
         with self._lock:
-            return ([copy.deepcopy(p) for p in self._pods.values()],
+            return ([_copy(p) for p in self._pods.values()],
                     str(self._rv))
 
     def watch_pods_events(self, resource_version: str,
@@ -143,7 +159,7 @@ class FakeKube(KubeClient):
             with self._cond:
                 if since < self._compacted_below:
                     raise Gone(f"resourceVersion {since} compacted")
-                batch = [(ev, copy.deepcopy(p), rv)
+                batch = [(ev, _copy(p), rv)
                          for rv, ev, p in self._journal if rv > since]
                 if not batch:
                     remaining = deadline - time.monotonic()
@@ -160,7 +176,7 @@ class FakeKube(KubeClient):
             pod = self._pods.get(f"{namespace}/{name}")
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
-            return copy.deepcopy(pod)
+            return _copy(pod)
 
     def patch_pod_annotations(
         self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
@@ -171,7 +187,7 @@ class FakeKube(KubeClient):
                 raise NotFound(f"pod {namespace}/{name}")
             _apply_annotation_patch(pod, annotations)
             pod["metadata"]["resourceVersion"] = self._next_rv()
-            snapshot = copy.deepcopy(pod)
+            snapshot = _copy(pod)
             watchers = list(self._pod_watchers)
             self._journal_append("MODIFIED", pod)
         for w in watchers:
@@ -199,14 +215,14 @@ class FakeKube(KubeClient):
 
     def list_nodes(self) -> List[dict]:
         with self._lock:
-            return [copy.deepcopy(n) for n in self._nodes.values()]
+            return [_copy(n) for n in self._nodes.values()]
 
     def get_node(self, name: str) -> dict:
         with self._lock:
             node = self._nodes.get(name)
             if node is None:
                 raise NotFound(f"node {name}")
-            return copy.deepcopy(node)
+            return _copy(node)
 
     def patch_node_annotations(
         self,
@@ -227,4 +243,4 @@ class FakeKube(KubeClient):
                 )
             _apply_annotation_patch(node, annotations)
             node["metadata"]["resourceVersion"] = self._next_rv()
-            return copy.deepcopy(node)
+            return _copy(node)

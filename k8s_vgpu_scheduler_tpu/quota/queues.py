@@ -127,6 +127,12 @@ class QueueEntry:
     last_seen: float = 0.0
     state: str = STATE_HELD
     released_at: Optional[float] = None
+    #: Monotonic release ordinal stamped by release(): the fair-share
+    #: order the admission loop let this pod through in.  The batched
+    #: Filter's drain re-sorts governed pods by this, so a batch cycle
+    #: never inverts the order fairness released in (clock timestamps
+    #: can tie on the simulator's virtual clock; the ordinal cannot).
+    release_seq: Optional[int] = None
     #: Last published queue-position annotation value ("pos/total" —
     #: the FULL string, so a changed denominator re-patches too).
     published_position: Optional[str] = None
@@ -198,6 +204,8 @@ class QuotaManager:
         #: Entries whose release is stuck on a failed annotation patch
         #: retry next tick (uid set) — in-memory release already stands.
         self._release_unwritten: set = set()
+        #: Release ordinal counter (QueueEntry.release_seq source).
+        self._release_counter = 0
 
     @property
     def enabled(self) -> bool:
@@ -342,6 +350,8 @@ class QuotaManager:
                 return None
             e.state = STATE_ADMITTED
             e.released_at = self._clock()
+            self._release_counter += 1
+            e.release_seq = self._release_counter
             e.backfilled = backfilled
             self.admitted_total[e.queue] = \
                 self.admitted_total.get(e.queue, 0) + 1
@@ -350,6 +360,14 @@ class QuotaManager:
     def entries(self) -> List[QueueEntry]:
         with self._lock:
             return [dataclasses.replace(e) for e in self._entries.values()]
+
+    def release_seq_of(self, uid: str) -> Optional[int]:
+        """The fair-share release ordinal of an admitted pod (None for
+        ungoverned, still-held or unknown uids) — the batched Filter's
+        drain-order key."""
+        with self._lock:
+            e = self._entries.get(uid)
+            return e.release_seq if e is not None else None
 
     def entry(self, uid: str) -> Optional[QueueEntry]:
         with self._lock:

@@ -1,0 +1,1037 @@
+"""Batched, vectorized scheduling cycles over a columnar fleet snapshot.
+
+PR 2 made each decision lock-free (optimistic snapshot/commit); each
+decision is still one-pod-at-a-time Python, walking per-node dicts of
+``DeviceUsage`` for every candidate.  This module restructures the hot
+path into *cycles*: drain every pending pod, evaluate the pods×chips fit
+and the pods×nodes score matrices as vectorized numpy over a
+**columnar** view of the fleet, solve placement jointly
+(greedy-with-regret over the score matrix), and commit per-node groups
+through the existing rev-validated optimistic commit — preserving the
+zero-over-grant protocol of docs/scheduler-concurrency.md unchanged.
+
+Three layers:
+
+- :class:`ColumnarFleet` — padded ``[nodes, max_chips]`` numpy arrays
+  (free HBM, free cores, free slots, type ids, health) keyed by a stable
+  row per node, maintained **incrementally**: a node's row is reloaded
+  only when its immutable :class:`~.core.SnapEntry` identity changed
+  (the snapshot replaces entries exactly when a node's generation moved,
+  so entry identity *is* the dirty signal), or when the previous cycle's
+  solver charged in-batch grants to it.  Every row also keeps plain
+  Python mirrors of its mutable columns: the solver's per-assignment
+  updates run on those (a one-row recompute over ≤ a dozen chips is
+  faster in scalar Python than as a numpy call chain), while the
+  cycle-start full-matrix evaluation runs vectorized.  Both compute the
+  identical arithmetic in the identical order, so scores agree bitwise
+  (pinned by the parity suite).
+- the **class evaluator** — pods dedup into request classes (the same
+  fingerprint the PR 2 fit cache keys on); one evaluation per class
+  yields the class's whole score row over the fleet, so 2000 pending
+  pods of 3 shapes cost 3 matrix evaluations, not 2000 candidate
+  sweeps.  The per-chip rules are the reference semantics, bit-for-bit
+  against ``score.fit_pod`` (randomized parity suite).
+- the **solver** — ``regret`` (default) assigns the pod with the
+  largest best-minus-second-best score gap first, so a pod with one
+  feasible node is never starved by a flexible pod taking it; ``fifo``
+  reproduces the serial path's sequential-argmax decisions exactly
+  (the decision-parity mode).  Ties break toward earlier submission,
+  which preserves the quota admission loop's fair-share release order.
+
+Multi-chip requests on a fleet advertising ICI topology still need the
+closed-form slice engine (topology/torus.py) and fall back to the
+per-pod optimistic path, as do gang members, multi-container pods and
+any pod whose batch commit loses its revision race.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import logging
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..util import trace
+from ..util.types import ContainerDevice
+from . import score as score_mod
+
+log = logging.getLogger(__name__)
+
+# Chip-choice sort key: (used_slots, used_mem) packed into one integer so
+# a single argmax/argsort reproduces fit_container's binpack preference
+# (most-used first, ties by chip index — numpy's first-max / stable sort
+# matches Python's stable descending sort).  used_mem is MiB and can
+# never reach 2^40.
+_KEY_BASE = 1 << 40
+_NEG_INF = float("-inf")
+
+
+@dataclasses.dataclass
+class BatchJob:
+    """One pod's slice of a batch cycle (parsed once, outside any lock)."""
+
+    pod: dict
+    uid: str
+    name: str
+    namespace: str
+    trace_id: str
+    requests: list          # [ContainerDeviceRequest] — exactly one effective
+    anns: Dict[str, str]
+    node_names: List[str]
+    priority: int = 0
+    #: Created lazily by the gate (filter_many resolves synchronously).
+    done: Optional[threading.Event] = None
+    result: Optional[object] = None   # FilterResult, set by the leader
+
+
+class ColumnarFleet:
+    """Padded ``[N nodes, C chips]`` columnar mirror of the usage
+    snapshot, plus per-row Python mirrors for the solver's scalar hot
+    loop.  Node-set membership changes (register/unregister, a node
+    outgrowing the chip pad) trigger a full rebuild — rare against the
+    grant churn the incremental path absorbs."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[str, object] = {}   # name -> SnapEntry (identity)
+        self.names: List[str] = []
+        self.row_of: Dict[str, int] = {}
+        self.chip_ids: List[List[str]] = []
+        self.chip_types: List[List[str]] = []
+        self._types: List[str] = []
+        self._type_id: Dict[str, int] = {}
+        self.any_topology = False
+        #: Rows the solver charged in-batch grants to since the last
+        #: refresh: their mirrors no longer match their (unchanged)
+        #: snapshot entries, so the next refresh reloads them even if
+        #: the commit never happened (a lost revision race must not
+        #: leave phantom grants in the columnar view).
+        self.touched: Set[int] = set()
+        #: row -> the snapshot generation key the last group commit
+        #: published for it.  When the next snapshot's entry carries
+        #: exactly this key, the entry's usage IS the columnar state
+        #: (apply_grant wrote the same deltas through) — the row adopts
+        #: the entry without a reload, so a steady-state cycle is O(rows
+        #: changed by OTHERS), not O(rows we granted on).
+        self.expected_key: Dict[int, tuple] = {}
+        self._alloc(0, 1)
+
+    # -- storage ---------------------------------------------------------------
+    def _alloc(self, n: int, c: int) -> None:
+        self.N, self.C = n, c
+        shape = (n, c)
+        self.valid = np.zeros(shape, dtype=bool)
+        self.health = np.zeros(shape, dtype=bool)
+        self.type_id = np.zeros(shape, dtype=np.int32)
+        self.total_slots = np.zeros(shape, dtype=np.int64)
+        self.used_slots = np.zeros(shape, dtype=np.int64)
+        self.total_mem = np.zeros(shape, dtype=np.int64)
+        self.used_mem = np.zeros(shape, dtype=np.int64)
+        self.total_cores = np.zeros(shape, dtype=np.int64)
+        self.used_cores = np.zeros(shape, dtype=np.int64)
+        self.has_topology = np.zeros(n, dtype=bool)
+        # Python mirrors: mutable per-chip state as lists (solver writes),
+        # static per-chip state as tuples, per-row scalars as lists.
+        self.p_used_slots: List[List[int]] = [[] for _ in range(n)]
+        self.p_used_mem: List[List[int]] = [[] for _ in range(n)]
+        self.p_used_cores: List[List[int]] = [[] for _ in range(n)]
+        self.p_total_slots: List[Tuple[int, ...]] = [()] * n
+        self.p_total_mem: List[Tuple[int, ...]] = [()] * n
+        self.p_total_cores: List[Tuple[int, ...]] = [()] * n
+        self.p_health: List[Tuple[bool, ...]] = [()] * n
+        self.p_type: List[Tuple[int, ...]] = [()] * n
+        self.alive: List[bool] = [True] * n       # lease gate, set per cycle
+        self.bonus: List[float] = [0.0] * n       # --score-by-actual
+        self.base: List[float] = [0.0] * n        # spread-form node score
+
+    def _type_of(self, t: str) -> int:
+        got = self._type_id.get(t)
+        if got is None:
+            got = len(self._types)
+            self._type_id[t] = got
+            self._types.append(t)
+        return got
+
+    # -- maintenance -----------------------------------------------------------
+    def refresh(self, snap: Dict[str, object]) -> int:
+        """Bring the columnar view up to the snapshot; returns how many
+        rows were reloaded (0 on an unchanged fleet)."""
+        if snap.keys() != self._entries.keys():
+            self._rebuild(snap)
+            return self.N
+        touched, self.touched = self.touched, set()
+        expected, self.expected_key = self.expected_key, {}
+        reloaded = 0
+        for name, entry in snap.items():
+            row = self.row_of[name]
+            if self._entries.get(name) is entry:
+                if row in touched:
+                    # Solver charged grants that never committed (lost
+                    # race / failed pod): roll the phantom state back.
+                    self._load_row(row, name, entry)
+                    reloaded += 1
+                continue
+            if entry.key == expected.get(row):
+                # The entry moved to exactly the generation our group
+                # commit published — its usage equals the written-
+                # through columnar state; adopt without reloading.
+                self._entries[name] = entry
+                continue
+            if len(entry.usage) > self.C:
+                self._rebuild(snap)
+                return self.N
+            self._load_row(row, name, entry)
+            reloaded += 1
+        if reloaded:
+            self.any_topology = bool(self.has_topology.any())
+        return reloaded
+
+    def _rebuild(self, snap: Dict[str, object]) -> None:
+        names = sorted(snap)
+        c = max((len(e.usage) for e in snap.values()), default=1)
+        self._alloc(len(names), max(1, c))
+        self.names = names
+        self.row_of = {n: i for i, n in enumerate(names)}
+        self.chip_ids = [[] for _ in names]
+        self.chip_types = [[] for _ in names]
+        self._entries = {}
+        self.touched = set()
+        for row, name in enumerate(names):
+            self._load_row(row, name, snap[name])
+        self.any_topology = bool(self.has_topology.any())
+
+    def _load_row(self, row: int, name: str, entry) -> None:
+        us = entry.usage
+        ids: List[str] = []
+        types: List[str] = []
+        n = len(us)
+        p_us: List[int] = []
+        p_um: List[int] = []
+        p_uc: List[int] = []
+        p_ts: List[int] = []
+        p_tm: List[int] = []
+        p_tc: List[int] = []
+        p_h: List[bool] = []
+        p_t: List[int] = []
+        for c, (cid, u) in enumerate(us.items()):
+            ids.append(cid)
+            types.append(u.type)
+            tid = self._type_of(u.type)
+            self.valid[row, c] = True
+            self.health[row, c] = u.health
+            self.type_id[row, c] = tid
+            self.total_slots[row, c] = u.total_slots
+            self.used_slots[row, c] = u.used_slots
+            self.total_mem[row, c] = u.total_mem
+            self.used_mem[row, c] = u.used_mem
+            self.total_cores[row, c] = u.total_cores
+            self.used_cores[row, c] = u.used_cores
+            p_us.append(u.used_slots)
+            p_um.append(u.used_mem)
+            p_uc.append(u.used_cores)
+            p_ts.append(u.total_slots)
+            p_tm.append(u.total_mem)
+            p_tc.append(u.total_cores)
+            p_h.append(u.health)
+            p_t.append(tid)
+        if n < self.C:
+            self.valid[row, n:] = False
+            self.health[row, n:] = False
+            for arr in (self.type_id, self.total_slots, self.used_slots,
+                        self.total_mem, self.used_mem, self.total_cores,
+                        self.used_cores):
+                arr[row, n:] = 0
+        self.chip_ids[row] = ids
+        self.chip_types[row] = types
+        self.p_used_slots[row] = p_us
+        self.p_used_mem[row] = p_um
+        self.p_used_cores[row] = p_uc
+        self.p_total_slots[row] = tuple(p_ts)
+        self.p_total_mem[row] = tuple(p_tm)
+        self.p_total_cores[row] = tuple(p_tc)
+        self.p_health[row] = tuple(p_h)
+        self.p_type[row] = tuple(p_t)
+        self.has_topology[row] = entry.info.topology is not None
+        self._entries[name] = entry
+        self._recompute_base(row)
+
+    def _recompute_base(self, row: int) -> None:
+        """Node spread score = Σ over chips of free fractions, in the
+        CANONICAL order (per chip: mem fraction then cores fraction,
+        sequential) — the vectorized evaluator accumulates column-by-
+        column in the same order, so the two paths agree bitwise and
+        tie-breaks never depend on which computed the score."""
+        b = 0.0
+        tm = self.p_total_mem[row]
+        tc = self.p_total_cores[row]
+        um = self.p_used_mem[row]
+        uc = self.p_used_cores[row]
+        for c in range(len(tm)):
+            if tm[c] > 0:
+                b += (tm[c] - um[c]) / tm[c]
+            if tc[c] > 0:
+                b += (tc[c] - uc[c]) / tc[c]
+        self.base[row] = b
+
+    def entry_of(self, name: str):
+        return self._entries.get(name)
+
+    def apply_grant(self, row: int, chips: List[int], mems: List[int],
+                    coresreq: int) -> None:
+        """Charge one in-batch grant to the solver's Python mirrors AND
+        the numpy columns (write-through keeps the two views identical,
+        so a cleanly-committed row needs no reload next refresh — see
+        ``expected_key``).  The authoritative commit still goes through
+        the scheduler's rev-validated registry insert."""
+        us = self.p_used_slots[row]
+        um = self.p_used_mem[row]
+        uc = self.p_used_cores[row]
+        for c, m in zip(chips, mems):
+            us[c] += 1
+            um[c] += m
+            uc[c] += coresreq
+            self.used_slots[row, c] += 1
+            self.used_mem[row, c] += m
+            self.used_cores[row, c] += coresreq
+        self._recompute_base(row)
+        self.touched.add(row)
+
+    # -- vectorized class evaluation (cycle start) -----------------------------
+    def mem_need(self, req) -> np.ndarray:
+        """Per-chip resolved HBM demand (score._resolve_mem semantics:
+        absolute wins, else percentage of the chip's advertised size)."""
+        if req.memreq > 0:
+            return np.full((self.N, self.C), req.memreq, dtype=np.int64)
+        pct = req.mem_percentage_req if req.mem_percentage_req > 0 else 100
+        return (self.total_mem * pct) // 100
+
+    def eligibility(self, req, affinity) -> Tuple[np.ndarray, np.ndarray]:
+        """Pods×chips fit mask (one request class at a time) + resolved
+        mem demand — the full per-chip rule set of
+        score._chip_reject_reason, vectorized."""
+        allowed = np.fromiter(
+            (score_mod.type_allows(affinity, t) for t in self._types),
+            dtype=bool, count=len(self._types)) \
+            if self._types else np.ones(1, dtype=bool)
+        mem = self.mem_need(req)
+        free_slots = self.total_slots - self.used_slots
+        free_cores = self.total_cores - self.used_cores
+        free_mem = self.total_mem - self.used_mem
+        elig = (self.valid & self.health
+                & allowed[self.type_id]
+                & (free_slots > 0)
+                & (self.used_cores < self.total_cores)
+                & (req.coresreq <= free_cores)
+                & (mem <= free_mem))
+        if req.coresreq >= 100:
+            # Exclusive wants a virgin chip (score.go:155–157).
+            elig &= (self.used_slots == 0) & (self.used_cores == 0)
+        return elig, mem
+
+
+class _ClassEval:
+    """One request class's outcome over every node: fit mask, chosen
+    chip + resolved mem (single-chip classes), and the post-placement
+    node score (−inf where the class does not fit).  Evaluated fully
+    (vectorized) at cycle start; patched per row (scalar) after each
+    in-batch assignment.  ``score``/``chip``/``mem`` are plain Python
+    lists — the solver reads and writes them scalar-at-a-time."""
+
+    __slots__ = ("req", "affinity", "nums", "binpack", "allowed", "pct",
+                 "score", "chip", "mem")
+
+    def __init__(self, req, affinity, binpack: bool) -> None:
+        self.req = req
+        self.affinity = affinity
+        self.nums = max(1, req.nums)
+        self.binpack = binpack
+        self.allowed: List[bool] = []
+        pct = req.mem_percentage_req if req.mem_percentage_req > 0 else 100
+        self.pct = pct
+        self.score: List[float] = []
+        self.chip: List[int] = []
+        self.mem: List[int] = []
+
+
+def class_fingerprint(requests, anns, policy_default: str) -> tuple:
+    """Dedup key for a batchable pod: the same request fingerprint the
+    PR 2 fit-equivalence cache uses, plus the topology policy."""
+    affinity = score_mod.parse_affinity(anns)
+    policy = anns.get(score_mod.TOPOLOGY_POLICY_ANNOTATION, policy_default)
+    return (tuple((r.nums, r.type, r.memreq, r.mem_percentage_req,
+                   r.coresreq) for r in requests),
+            None if affinity[0] is None else tuple(affinity[0]),
+            tuple(affinity[1]), policy)
+
+
+def eval_class_full(fleet: ColumnarFleet, ce: _ClassEval) -> None:
+    """Vectorized whole-fleet evaluation of one request class: the
+    pods×chips predicates collapse to this class's [N, C] mask, the
+    chip choice to a packed-key argmax/argsort, and the node score to
+    ``base − delta`` — one numpy pass per class per cycle."""
+    ce.allowed = [score_mod.type_allows(ce.affinity, t)
+                  for t in fleet._types]
+    if fleet.N == 0:
+        ce.score, ce.chip, ce.mem = [], [], []
+        return
+    elig, mem = fleet.eligibility(ce.req, ce.affinity)
+    k = ce.nums
+    base = np.asarray(fleet.base)
+    if k <= 1:
+        key = np.where(elig,
+                       fleet.used_slots * np.int64(_KEY_BASE)
+                       + fleet.used_mem,
+                       np.int64(-1))
+        chip = key.argmax(axis=1)
+        sel = chip[:, None]
+        ok = np.take_along_axis(key, sel, 1)[:, 0] >= 0
+        mm = np.take_along_axis(mem, sel, 1)[:, 0]
+        tm = np.take_along_axis(fleet.total_mem, sel, 1)[:, 0]
+        tc = np.take_along_axis(fleet.total_cores, sel, 1)[:, 0]
+        delta = (np.where(tm > 0, mm / np.maximum(tm, 1), 0.0)
+                 + np.where(tc > 0, ce.req.coresreq / np.maximum(tc, 1),
+                            0.0))
+        chips = chip
+        mems = mm
+    else:
+        # Plain multi-chip selection (no ICI engine — topology fleets
+        # route nums>1 pods to the per-pod path before evaluation): the
+        # first k eligible chips in binpack-preference order, exactly
+        # fit_container's sorted()[:k].
+        key = fleet.used_slots * np.int64(_KEY_BASE) + fleet.used_mem
+        order = np.argsort(-key, axis=1, kind="stable")
+        eo = np.take_along_axis(elig, order, 1)
+        cs = eo.cumsum(axis=1)
+        ok = cs[:, -1] >= k
+        pick = eo & (cs <= k)
+        memo = np.take_along_axis(mem, order, 1)
+        tmo = np.take_along_axis(fleet.total_mem, order, 1)
+        tco = np.take_along_axis(fleet.total_cores, order, 1)
+        fr = (np.where(tmo > 0, memo / np.maximum(tmo, 1), 0.0)
+              + np.where(tco > 0, ce.req.coresreq / np.maximum(tco, 1),
+                         0.0))
+        # Sequential column accumulation — the same addition order the
+        # scalar row evaluator uses (adding 0.0 for unpicked chips is
+        # bit-exact), so both paths produce identical floats.
+        delta = np.zeros(fleet.N, dtype=np.float64)
+        picked = pick * fr
+        for c in range(fleet.C):
+            delta += picked[:, c]
+        chips = None
+        mems = None
+    after = base - delta
+    sc = np.where(ok & np.asarray(fleet.alive),
+                  (-after if ce.binpack else after) + np.asarray(fleet.bonus),
+                  -np.inf)
+    ce.score = sc.tolist()
+    if k <= 1:
+        ce.chip = chips.tolist()
+        ce.mem = mems.tolist()
+    else:
+        ce.chip = [-1] * fleet.N
+        ce.mem = [0] * fleet.N
+
+
+def eval_class_row(fleet: ColumnarFleet, ce: _ClassEval, row: int) -> None:
+    """Scalar one-row re-evaluation after an in-batch grant changed the
+    row — the same rules and the same arithmetic order as
+    :func:`eval_class_full`, over ≤ a dozen chips (faster in Python than
+    a numpy call chain at this size; bitwise-equality pinned by the
+    parity suite)."""
+    req = ce.req
+    cores = req.coresreq
+    memreq = req.memreq
+    pct = ce.pct
+    us = fleet.p_used_slots[row]
+    um = fleet.p_used_mem[row]
+    uc = fleet.p_used_cores[row]
+    ts = fleet.p_total_slots[row]
+    tm = fleet.p_total_mem[row]
+    tc = fleet.p_total_cores[row]
+    health = fleet.p_health[row]
+    types = fleet.p_type[row]
+    allowed = ce.allowed
+    exclusive = cores >= 100
+    k = ce.nums
+    if k <= 1:
+        best_key = -1
+        chip = -1
+        mem_at = 0
+        for c in range(len(ts)):
+            if not health[c] or not allowed[types[c]]:
+                continue
+            if us[c] >= ts[c] or uc[c] >= tc[c]:
+                continue
+            if cores > tc[c] - uc[c]:
+                continue
+            m = memreq if memreq > 0 else tm[c] * pct // 100
+            if m > tm[c] - um[c]:
+                continue
+            if exclusive and (us[c] > 0 or uc[c] > 0):
+                continue
+            key = us[c] * _KEY_BASE + um[c]
+            if key > best_key:
+                best_key = key
+                chip = c
+                mem_at = m
+        if chip < 0 or not fleet.alive[row]:
+            ce.score[row] = _NEG_INF
+            ce.chip[row] = chip
+            return
+        delta = ((mem_at / tm[chip] if tm[chip] > 0 else 0.0)
+                 + (cores / tc[chip] if tc[chip] > 0 else 0.0))
+        after = fleet.base[row] - delta
+        ce.score[row] = ((-after if ce.binpack else after)
+                         + fleet.bonus[row])
+        ce.chip[row] = chip
+        ce.mem[row] = mem_at
+        return
+    chips, mems = _choose_multi(fleet, ce, row)
+    if len(chips) < k or not fleet.alive[row]:
+        ce.score[row] = _NEG_INF
+        return
+    delta = 0.0
+    for c, m in zip(chips, mems):
+        delta += ((m / tm[c] if tm[c] > 0 else 0.0)
+                  + (cores / tc[c] if tc[c] > 0 else 0.0))
+    after = fleet.base[row] - delta
+    ce.score[row] = (-after if ce.binpack else after) + fleet.bonus[row]
+
+
+def _choose_multi(fleet: ColumnarFleet, ce: _ClassEval,
+                  row: int) -> Tuple[List[int], List[int]]:
+    """First ``nums`` eligible chips in binpack-preference order
+    (fit_container's sorted()[:k], stable ties by chip index)."""
+    req = ce.req
+    cores = req.coresreq
+    memreq = req.memreq
+    pct = ce.pct
+    us = fleet.p_used_slots[row]
+    um = fleet.p_used_mem[row]
+    uc = fleet.p_used_cores[row]
+    ts = fleet.p_total_slots[row]
+    tm = fleet.p_total_mem[row]
+    tc = fleet.p_total_cores[row]
+    health = fleet.p_health[row]
+    types = fleet.p_type[row]
+    allowed = ce.allowed
+    exclusive = cores >= 100
+    eligible: List[Tuple[int, int]] = []   # (-key, chip)
+    mems: Dict[int, int] = {}
+    for c in range(len(ts)):
+        if not health[c] or not allowed[types[c]]:
+            continue
+        if us[c] >= ts[c] or uc[c] >= tc[c]:
+            continue
+        if cores > tc[c] - uc[c]:
+            continue
+        m = memreq if memreq > 0 else tm[c] * pct // 100
+        if m > tm[c] - um[c]:
+            continue
+        if exclusive and (us[c] > 0 or uc[c] > 0):
+            continue
+        eligible.append((-(us[c] * _KEY_BASE + um[c]), c))
+        mems[c] = m
+    eligible.sort()
+    chosen = [c for _k, c in eligible[:ce.nums]]
+    return chosen, [mems[c] for c in chosen]
+
+
+def choose_chips(fleet: ColumnarFleet, ce: _ClassEval,
+                 row: int) -> Tuple[List[int], List[int]]:
+    """Chip indices + resolved mems for one assignment on ``row``."""
+    if ce.nums <= 1:
+        return [ce.chip[row]], [ce.mem[row]]
+    return _choose_multi(fleet, ce, row)
+
+
+class _Cohort:
+    """Jobs sharing (request class, offered-node set): they see identical
+    score rows, so the solver evaluates once per cohort, not per pod.
+    The candidate ranking lives in a lazy max-heap keyed (−score, offer
+    position): every score change pushes a fresh entry, stale entries
+    are discarded when popped (they no longer match ``ce.score``), so a
+    best/second read is O(log rows) instead of an O(rows) rescan per
+    assignment — the term that dominated large-fleet cycles."""
+
+    __slots__ = ("ce", "rows", "rowset", "pos_of", "jobs", "head",
+                 "heap")
+
+    def __init__(self, ce: _ClassEval, rows: Optional[List[int]]) -> None:
+        self.ce = ce
+        self.rows = rows        # fleet rows in OFFER order; None = all
+        if rows is None:
+            self.rowset = None
+            self.pos_of = None
+        else:
+            self.rowset = set(rows)
+            self.pos_of: Dict[int, int] = {}
+            for pos, r in enumerate(rows):
+                self.pos_of.setdefault(r, pos)   # first offer slot wins
+        #: (rank, original job index) in fair-share priority order; the
+        #: regret solver consumes members head-first, so within a cohort
+        #: earlier-released pods place first.
+        self.jobs: List[Tuple[int, int]] = []
+        self.head = 0
+        score = ce.score
+        it = rows if rows is not None else range(len(score))
+        heap = []
+        for pos, r in enumerate(it):
+            s = score[r]
+            if s != _NEG_INF:
+                heap.append((-s, pos, r))
+        heapq.heapify(heap)
+        self.heap = heap
+
+    def note_update(self, row: int) -> None:
+        """A grant changed ``row``'s score: push the fresh value (the
+        superseded entries die lazily on pop)."""
+        if self.rowset is None:
+            pos = row
+        else:
+            pos = self.pos_of.get(row)
+            if pos is None:
+                return
+        s = self.ce.score[row]
+        if s != _NEG_INF:
+            heapq.heappush(self.heap, (-s, pos, row))
+
+    def best2(self) -> Tuple[float, int, float]:
+        """(best score, fleet row of best, second-best score); the
+        (−score, offer position) heap order keeps the FIRST maximum in
+        offer order — the serial path's iteration tie-break."""
+        score = self.ce.score
+        heap = self.heap
+        saved: List[Tuple[float, int, int]] = []
+        best = _NEG_INF
+        best_row = -1
+        second = _NEG_INF
+        while heap:
+            entry = heap[0]
+            negs, _pos, r = entry
+            if -negs != score[r]:
+                heapq.heappop(heap)     # stale: a fresher entry exists
+                continue
+            if best_row < 0:
+                best = -negs
+                best_row = r
+                saved.append(heapq.heappop(heap))
+                continue
+            if r == best_row:           # duplicate of the best entry
+                saved.append(heapq.heappop(heap))
+                continue
+            second = -negs
+            break
+        for e in saved:
+            heapq.heappush(heap, e)
+        return best, best_row, second
+
+
+def solve(fleet: ColumnarFleet, cohorts: List[_Cohort], n_jobs: int,
+          solver: str) -> List[Optional[Tuple[int, List[int], List[int]]]]:
+    """Joint placement over the score matrix.  Returns, per ORIGINAL job
+    index, ``(fleet row, chip indices, mems)`` or None (no fit).
+
+    ``fifo`` assigns in priority (fair-share release) order by
+    sequential argmax — decision parity with the serial per-pod path.
+    ``regret`` assigns the largest best-minus-second-best gap first:
+    when pods contend for the same node, the pod that has somewhere
+    else to go yields to the pod that does not — strictly better
+    packing than sequential argmax, proven by the contention tests.
+    Capacity only shrinks within a cycle, so a cohort that stops
+    fitting never fits again and its remaining members resolve to None
+    (the caller's per-pod fallback re-checks them against the live
+    fleet and produces reasons)."""
+    results: List[Optional[Tuple[int, List[int], List[int]]]] = \
+        [None] * n_jobs
+
+    def assign(cohort: _Cohort, job_idx: int, row: int) -> None:
+        chips, mems = choose_chips(fleet, cohort.ce, row)
+        results[job_idx] = (row, chips, mems)
+        fleet.apply_grant(row, chips, mems, cohort.ce.req.coresreq)
+        for c in cohorts:
+            eval_class_row(fleet, c.ce, row)
+            c.note_update(row)
+
+    if solver == "fifo":
+        ordered = sorted(((rank, idx, c) for c in cohorts
+                          for rank, idx in c.jobs))
+        for _rank, idx, cohort in ordered:
+            best, row, _second = cohort.best2()
+            if best == _NEG_INF:
+                continue
+            assign(cohort, idx, row)
+        return results
+
+    # Lazy greedy-with-regret: heap entries carry the version (number of
+    # assignments so far) they were scored at; a popped entry scored
+    # against a superseded state is re-scored and pushed back, so every
+    # assignment uses fresh scores.
+    version = 0
+    heap: List[Tuple[float, int, int, int, int]] = []
+
+    def push(ci: int) -> None:
+        cohort = cohorts[ci]
+        best, row, second = cohort.best2()
+        regret = math.inf if second == _NEG_INF else best - second
+        rank = cohort.jobs[cohort.head][0]
+        heapq.heappush(heap, (-regret, rank, ci, row, version))
+
+    for ci in range(len(cohorts)):
+        push(ci)   # -inf best still enters: resolved to None on pop
+    while heap:
+        _negr, _rank, ci, row, ver = heapq.heappop(heap)
+        cohort = cohorts[ci]
+        if cohort.head >= len(cohort.jobs):
+            continue
+        if ver != version:
+            push(ci)   # stale score: re-rank against the current state
+            continue
+        best = cohort.ce.score[row] if row >= 0 else _NEG_INF
+        if best == _NEG_INF:
+            # Monotone capacity: nothing left for this whole cohort.
+            cohort.head = len(cohort.jobs)
+            continue
+        job_idx = cohort.jobs[cohort.head][1]
+        cohort.head += 1
+        assign(cohort, job_idx, row)
+        version += 1
+        if cohort.head < len(cohort.jobs):
+            push(ci)
+    return results
+
+
+class BatchStats:
+    """Prometheus-shaped histograms of batch size and cycle latency
+    (writes take the small lock; the metrics collector reads a
+    consistent snapshot under it)."""
+
+    SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+    LAT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5)
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._size_counts = [0] * (len(self.SIZE_BUCKETS) + 1)
+        self._lat_counts = [0] * (len(self.LAT_BUCKETS) + 1)
+        self.size_sum = 0.0
+        self.lat_sum = 0.0
+        self.cycles = 0
+        self.pods = 0
+        self.fallbacks = 0      # jobs resolved via the per-pod path
+        self.conflicts = 0      # group-commit members that lost a rev race
+
+    def record(self, size: int, seconds: float, fallbacks: int,
+               conflicts: int) -> None:
+        with self._lock:
+            self.cycles += 1
+            self.pods += size
+            self.size_sum += size
+            self.lat_sum += seconds
+            self.fallbacks += fallbacks
+            self.conflicts += conflicts
+            for i, b in enumerate(self.SIZE_BUCKETS):
+                if size <= b:
+                    self._size_counts[i] += 1
+                    break
+            else:
+                self._size_counts[-1] += 1
+            for i, b in enumerate(self.LAT_BUCKETS):
+                if seconds <= b:
+                    self._lat_counts[i] += 1
+                    break
+            else:
+                self._lat_counts[-1] += 1
+
+    @staticmethod
+    def _prom(buckets, counts) -> List[Tuple[str, float]]:
+        out: List[Tuple[str, float]] = []
+        cum = 0
+        for b, n in zip(buckets, counts):
+            cum += n
+            out.append((str(float(b)), cum))
+        out.append(("+Inf", cum + counts[-1]))
+        return out
+
+    def size_histogram(self) -> Tuple[List[Tuple[str, float]], float]:
+        with self._lock:
+            return self._prom(self.SIZE_BUCKETS, self._size_counts), \
+                self.size_sum
+
+    def size_distribution(self) -> Dict[str, int]:
+        """Per-bucket (non-cumulative) cycle counts, for benchmark
+        artifacts (bench_batch_cycle's batch-size distribution)."""
+        with self._lock:
+            out = {f"<={b}": n for b, n in zip(self.SIZE_BUCKETS,
+                                               self._size_counts) if n}
+            if self._size_counts[-1]:
+                out[f">{self.SIZE_BUCKETS[-1]}"] = self._size_counts[-1]
+            return out
+
+    def latency_histogram(self) -> Tuple[List[Tuple[str, float]], float]:
+        with self._lock:
+            return self._prom(self.LAT_BUCKETS, self._lat_counts), \
+                self.lat_sum
+
+
+class BatchEngine:
+    """The scheduler's batch front: a leader/follower gate that collapses
+    concurrent ``filter()`` calls into cycles (same shape as
+    util/decisionwriter.DecisionBatcher), and the cycle itself —
+    snapshot → columnar refresh → class eval → joint solve → per-node
+    rev-validated group commit → per-pod fallback for the remainder."""
+
+    def __init__(self, scheduler) -> None:
+        self.s = scheduler
+        self.fleet = ColumnarFleet()
+        self.stats = BatchStats()
+        # One cycle at a time: the columnar state is single-writer.
+        self._cycle_lock = threading.Lock()
+        self._qlock = threading.Lock()
+        self._queue: List[BatchJob] = []
+        self._leader_active = False
+        self._full = threading.Event()
+
+    # -- the gate (filter() path) ----------------------------------------------
+    def submit(self, job: BatchJob):
+        """Enqueue one pod and return its FilterResult.  The first caller
+        into an idle gate leads: it waits up to ``batch_tick_ms`` for
+        concurrent Filters to pile on, then drains the queue through
+        cycles until empty and resigns."""
+        cfg = self.s.cfg
+        job.done = threading.Event()
+        with self._qlock:
+            self._queue.append(job)
+            lead = not self._leader_active
+            if lead:
+                self._leader_active = True
+                self._full.clear()
+            elif len(self._queue) >= cfg.batch_max:
+                self._full.set()
+        if not lead:
+            job.done.wait()
+            return job.result
+        if cfg.batch_tick_ms > 0:
+            self._full.wait(cfg.batch_tick_ms / 1000.0)
+        batch: List[BatchJob] = []
+        try:
+            while True:
+                with self._qlock:
+                    batch = self._queue[:cfg.batch_max]
+                    del self._queue[:len(batch)]
+                    if not batch:
+                        self._leader_active = False
+                        break
+                results = self.decide_many(batch)
+                for j, r in zip(batch, results):
+                    j.result = r
+                    if j.done is not None:
+                        j.done.set()
+        except BaseException:
+            # Leader died mid-cycle: resolve everything in flight or the
+            # followers block forever (DecisionBatcher's discipline).
+            with self._qlock:
+                orphans, self._queue = self._queue, []
+                self._leader_active = False
+            from .core import FilterResult
+            for j in batch + orphans:
+                if j.done is not None and not j.done.is_set():
+                    j.result = FilterResult(error="batch cycle leader died")
+                    j.done.set()
+            raise
+        return job.result
+
+    # -- one cycle -------------------------------------------------------------
+    def decide_many(self, jobs: List[BatchJob]) -> List[object]:
+        """Run one batched scheduling cycle over ``jobs``.  Returns one
+        FilterResult per job, in input order."""
+        from .core import FilterResult  # cycle-free deferred import
+
+        t0 = time.monotonic()
+        tr = trace.tracer()
+        ranks = self.fair_share_ranks(jobs)
+        results: List[Optional[object]] = [None] * len(jobs)
+        fallback: set = set()
+        conflicts = 0
+        with self._cycle_lock, \
+                tr.span("batch-cycle", pods=len(jobs)) as sp:
+            snap = self.s.snapshot()
+            self.fleet.refresh(snap)
+            self._gate_rows()
+            vector: List[int] = []
+            for i, job in enumerate(jobs):
+                req = job.requests[0]
+                if req.nums > 1 and self.fleet.any_topology:
+                    # Slice placements need the ICI engine — per-pod path.
+                    fallback.add(i)
+                else:
+                    vector.append(i)
+            cohorts = self._build_cohorts(jobs, vector, ranks)
+            plan = solve(self.fleet, cohorts, len(jobs),
+                         self.s.cfg.batch_solver)
+            committed, lost = self._commit(snap, jobs, vector, plan)
+            conflicts = len(lost)
+            for i, res in committed.items():
+                results[i] = res
+            fallback.update(lost)
+            fallback.update(i for i in vector if results[i] is None)
+            sp.set("committed", len(committed))
+            sp.set("fallback", len(fallback))
+        # Per-pod fallback OUTSIDE the cycle lock: these run the normal
+        # optimistic protocol (fresh snapshot — which already includes
+        # this cycle's grants — conflict retries, preemption planning,
+        # per-node failure reasons).
+        for i in sorted(fallback, key=lambda i: ranks[i]):
+            job = jobs[i]
+            with tr.span("batch-fallback", trace_id=job.trace_id,
+                         pod=job.name) as fsp:
+                try:
+                    results[i] = self.s._decide_optimistic(
+                        job.pod, job.requests, job.node_names, fsp)
+                except Exception as e:  # noqa: BLE001 — one pod's failure
+                    # must not poison the cycle's other decisions.
+                    log.exception("batch fallback for %s failed", job.name)
+                    fsp.set("error", str(e))
+                    results[i] = FilterResult(
+                        error=f"batch fallback failed: {e}")
+        self.stats.record(len(jobs), time.monotonic() - t0,
+                          len(fallback), conflicts)
+        return [r if r is not None
+                else FilterResult(error="batch cycle produced no decision")
+                for r in results]
+
+    def fair_share_ranks(self, jobs: List[BatchJob]) -> List[int]:
+        """Per-job priority rank for the solver: arrival order, except
+        that quota-governed pods are reordered among themselves by the
+        admission loop's release sequence (PR 5's fair-share order) — a
+        drain must not invert the order fairness released in, and must
+        not privilege governed pods over ungoverned ones either."""
+        ranks = list(range(len(jobs)))
+        quota = self.s.quota
+        if not quota.enabled or len(jobs) < 2:
+            return ranks
+        seqs = [quota.release_seq_of(j.uid) for j in jobs]
+        governed = [i for i, s in enumerate(seqs) if s is not None]
+        if len(governed) < 2:
+            return ranks
+        # Governed pods swap ranks among their own arrival slots, sorted
+        # by release sequence; everyone else keeps their slot.
+        by_release = sorted(governed, key=lambda i: seqs[i])
+        for slot, i in zip(governed, by_release):
+            ranks[i] = slot
+        return ranks
+
+    def _gate_rows(self) -> None:
+        """Per-cycle node gates: the lease reject (Suspect/Dead nodes
+        take no new placements) and the measured-utilization bonus."""
+        fleet = self.fleet
+        leases = self.s.leases
+        fleet.alive = [leases.reject_reason(name) is None
+                       for name in fleet.names]
+        if self.s.cfg.score_by_actual:
+            from ..accounting import efficiency as eff_mod
+            fleet.bonus = [
+                eff_mod.actual_idle_bonus(self.s.ledger, name,
+                                          len(fleet.chip_ids[row]))
+                for row, name in enumerate(fleet.names)]
+        else:
+            fleet.bonus = [0.0] * fleet.N
+
+    def _build_cohorts(self, jobs: List[BatchJob], vector: List[int],
+                       ranks: List[int]) -> List[_Cohort]:
+        fleet = self.fleet
+        binpack = self.s.cfg.node_scheduler_policy == "binpack"
+        cohorts: Dict[tuple, _Cohort] = {}
+        for i in sorted(vector, key=lambda i: ranks[i]):
+            job = jobs[i]
+            fp = class_fingerprint(job.requests, job.anns,
+                                   self.s.cfg.topology_policy)
+            key = (fp, tuple(job.node_names))
+            cohort = cohorts.get(key)
+            if cohort is None:
+                ce = _ClassEval(job.requests[0],
+                                score_mod.parse_affinity(job.anns), binpack)
+                eval_class_full(fleet, ce)
+                # An empty offer means NO candidates (the per-pod paths
+                # iterate node_names), never the whole fleet.
+                rows = [fleet.row_of[n] for n in job.node_names
+                        if n in fleet.row_of]
+                cohort = cohorts[key] = _Cohort(ce, rows)
+            cohort.jobs.append((ranks[i], i))
+        return list(cohorts.values())
+
+    def _commit(self, snap, jobs: List[BatchJob], vector: List[int],
+                plan) -> Tuple[Dict[int, object], List[int]]:
+        """Per-node-group optimistic commit: one rev validation per node,
+        then the group's grants inserted as an unbroken pod-rev chain and
+        published as a single usage delta.  A node whose generation moved
+        (or whose chain an interleaved informer event broke) sends its
+        whole remaining group to the per-pod fallback — the protocol's
+        conflict semantics, amortized."""
+        from .core import FilterResult
+        from .pods import PodInfo
+
+        s = self.s
+        groups: Dict[int, List[int]] = {}
+        for i in vector:
+            if plan[i] is not None:
+                groups.setdefault(plan[i][0], []).append(i)
+        committed: Dict[int, object] = {}
+        lost: List[int] = []
+        for row, members in groups.items():
+            node = self.fleet.names[row]
+            entry = snap[node]
+            placed: List[int] = []
+            placements: List[list] = []
+            with s._commit_lock:
+                live = (s.pods.rev_of(node), s.nodes.rev_of(node))
+                if live != entry.key:
+                    lost.extend(members)
+                    continue
+                expected = entry.key[0]
+                for i in members:
+                    job = jobs[i]
+                    _row, chips, mems = plan[i]
+                    placement = [[
+                        ContainerDevice(
+                            uuid=self.fleet.chip_ids[row][c],
+                            type=self.fleet.chip_types[row][c],
+                            usedmem=m,
+                            usedcores=job.requests[0].coresreq)
+                        for c, m in zip(chips, mems)]]
+                    rev = s.pods.add_pod(PodInfo(
+                        uid=job.uid, name=job.name,
+                        namespace=job.namespace, node=node,
+                        devices=placement, priority=job.priority,
+                        trace_id=job.trace_id))
+                    if rev != expected + 1:
+                        # An informer event interleaved inside the held
+                        # lock (it doesn't exclude the watch thread): the
+                        # chain is broken — undo this grant and conflict
+                        # the rest of the group.
+                        s.pods.del_pod(job.uid)
+                        done = set(placed)
+                        lost.extend(m for m in members if m not in done)
+                        break
+                    expected = rev
+                    placed.append(i)
+                    placements.append(placement)
+                if placements:
+                    s._publish_grants(node, entry, placements, expected)
+                    if len(placed) == len(members):
+                        # Every planned grant on this row committed: the
+                        # columnar mirrors equal the usage the publish
+                        # just cached under this generation, so the next
+                        # refresh can adopt the new entry reload-free.
+                        self.fleet.expected_key[row] = (expected,
+                                                        entry.key[1])
+            for i in placed:
+                committed[i] = FilterResult(node=node)
+        if lost:
+            with s._busy_lock:
+                s.commit_conflicts += len(lost)
+        return committed, lost

@@ -42,7 +42,11 @@ from ..util.config import Config
 from ..util.decisionwriter import DecisionBatcher
 from ..util.nodelock import NodeLockError, lock_node, release_node
 from ..util.protocol import bind_timestamp
-from ..util.resources import container_requests, pod_priority
+from ..util.resources import (
+    container_requests,
+    pod_priority,
+    pod_requests_and_priority,
+)
 from ..util.types import (
     ASSIGNED_IDS_ANNOTATION,
     ASSIGNED_NODE_ANNOTATION,
@@ -56,6 +60,7 @@ from ..util.types import (
     ContainerDevice,
 )
 from . import score as score_mod
+from .batch import BatchEngine, BatchJob
 from .gang import (
     GANG_RANK_ANNOTATION,
     GangConflictError,
@@ -226,6 +231,12 @@ class Scheduler:
         # Group-commit batcher for decision-write patches: concurrent
         # Filters amortize apiserver I/O without any scheduler lock.
         self._decisions = DecisionBatcher(client)
+        # Batched scheduling cycles (scheduler/batch.py): columnar fleet
+        # view + vectorized pods×chips evaluation + joint placement.
+        # Always constructed (filter_many and the benchmarks drive it
+        # directly); filter() routes through it only with
+        # Config.filter_batch on.
+        self.batch = BatchEngine(self)
         # uid -> monotonic time of its DELETE.  k8s uids never return, so
         # a replayed ADDED for one of these (a resync list older than the
         # delete) must be ignored or it re-books a dead pod's chips.
@@ -765,6 +776,92 @@ class Scheduler:
                 sp.set("error", result.error)
             if result.node is not None:
                 sp.set("node", result.node)
+        return self._finish_decision(pod, result)
+
+    def filter_many(self, items: List[Tuple[dict, List[str]]]
+                    ) -> List[FilterResult]:
+        """Filter a backlog of pods through batched scheduling cycles
+        (docs/scheduler-concurrency.md "Batched cycles"): same semantics
+        as calling :meth:`filter` per pod, but batchable pods are
+        decided jointly — one snapshot, one columnar evaluation per
+        request class, one rev-validated group commit per node — instead
+        of paying snapshot + candidate sweep + commit each.  Gang
+        members, multi-container pods, quota-held pods and slice
+        placements route through the per-pod path unchanged."""
+        if self.gangs.groups():
+            self._release_expired_gangs()
+        results: List[Optional[FilterResult]] = [None] * len(items)
+        batched: List[Tuple[int, "BatchJob"]] = []
+        for i, (pod, node_names) in enumerate(items):
+            routed = self._route_batch(pod, node_names)
+            if isinstance(routed, FilterResult):
+                results[i] = self._finish_decision(pod, routed)
+            elif routed is None:
+                results[i] = self.filter(pod, node_names)
+            else:
+                batched.append((i, routed))
+        step = max(1, self.cfg.batch_max)
+        for at in range(0, len(batched), step):
+            chunk = batched[at:at + step]
+            decided = self.batch.decide_many([j for _i, j in chunk])
+            for (i, job), res in zip(chunk, decided):
+                results[i] = self._finish_decision(job.pod, res)
+        return results
+
+    def _route_batch(self, pod: dict, node_names: List[str]):
+        """filter_many's router — mirrors ``_decide``'s pre-checks in
+        order.  Returns a FilterResult (decided already: parse error,
+        not-ours, quota hold), a BatchJob (vectorizable), or None (the
+        pod needs the full per-pod path)."""
+        try:
+            requests, priority = pod_requests_and_priority(pod, self.cfg)
+        except ValueError as e:
+            return FilterResult(error=f"bad resource request: {e}")
+        if not any(r.nums > 0 for r in requests):
+            return FilterResult(node=None, failed={})
+        hold = self.quota.gate(pod, requests)
+        if hold is not None:
+            return FilterResult(error=hold)
+        if gang_of(pod) is not None or not self.cfg.optimistic_commit \
+                or not self._batchable(requests):
+            return None
+        return self._make_batch_job(pod, requests, node_names,
+                                    priority=priority)
+
+    @staticmethod
+    def _batchable(requests) -> bool:
+        """Vectorizable shape: exactly one container with a device
+        request.  Multi-container pods keep the per-pod path (their
+        containers place sequentially against each other's tentative
+        grants)."""
+        return len(requests) == 1 and requests[0].nums >= 1
+
+    def _make_batch_job(self, pod: dict, requests, node_names: List[str],
+                        priority: Optional[int] = None
+                        ) -> Optional["BatchJob"]:
+        if priority is None:
+            try:
+                priority = pod_priority(pod, self.cfg)
+            except Exception:  # noqa: BLE001 — per-pod path decides
+                return None
+        # Drop any stale decision before re-placing (reference Filter
+        # calls delPod first) — same as the per-pod paths do.
+        self.pods.del_pod(pod_uid(pod))
+        return BatchJob(
+            pod=pod, uid=pod_uid(pod), name=pod_name(pod),
+            namespace=pod_namespace(pod), trace_id=trace.trace_id_of(pod),
+            requests=requests,
+            anns=pod.get("metadata", {}).get("annotations", {}),
+            node_names=node_names, priority=priority)
+
+    def _finish_decision(self, pod: dict,
+                         result: FilterResult) -> FilterResult:
+        """Everything after the in-memory decision: rejection events and
+        the reclaim/preemption signals on a no-fit, or the decision
+        write (rolled back on failure) on a placement.  Shared by the
+        per-pod and batched front doors."""
+        tid = trace.trace_id_of(pod)
+        tr = trace.tracer()
         if result.node is None:
             if result.error or result.failed:
                 tr.event(pod_uid(pod), "filter-rejected", trace_id=tid,
@@ -903,6 +1000,17 @@ class Scheduler:
         if not self.cfg.optimistic_commit:
             with self._commit_lock:
                 return self._decide_serial_locked(pod, requests, node_names)
+        if self.cfg.filter_batch and self._batchable(requests):
+            # Batched cycles: concurrent Filters collapse into one
+            # snapshot + vectorized evaluation + per-node group commit
+            # (scheduler/batch.py); non-batchable shapes fall through to
+            # the per-pod optimistic protocol below.
+            job = self._make_batch_job(pod, requests, node_names)
+            if job is not None:
+                result = self.batch.submit(job)
+                if result.node is not None:
+                    sp.set("batched", True)
+                return result
         return self._decide_optimistic(pod, requests, node_names, sp)
 
     def _decide_optimistic(self, pod: dict, requests,
@@ -1042,48 +1150,57 @@ class Scheduler:
 
     def _publish_grant(self, node: str, entry: SnapEntry, placement,
                        pod_rev: int) -> None:
-        """After a validated add_pod (commit lock held): publish the
-        grant's effect on ``entry.usage`` into the usage cache at its new
-        generation, so the next snapshot() reuses it instead of
-        rebuilding the node from every resident pod — the grant IS the
-        only delta.  Publishing requires proving NOTHING else interleaved
-        between the validated revs and the grant: the pod-rev chain must
-        be unbroken (add_pod returned exactly validated+1 — a watch
-        thread's add/del in the window would occupy that rev, and our
-        higher rev would otherwise hide its pending-dirty rebuild), and
-        the key's inventory half stays the VALIDATED one so a concurrent
-        re-registration's newer rev still forces a rebuild."""
-        if pod_rev != entry.key[0] + 1:
+        """Single-grant publish (see :meth:`_publish_grants`)."""
+        self._publish_grants(node, entry, [placement], pod_rev)
+
+    def _publish_grants(self, node: str, entry: SnapEntry,
+                        placements: List, final_rev: int) -> None:
+        """After validated add_pods (commit lock held): publish the
+        grants' combined effect on ``entry.usage`` into the usage cache
+        at the new generation, so the next snapshot() reuses it instead
+        of rebuilding the node from every resident pod — the grants ARE
+        the only delta.  Publishing requires proving NOTHING else
+        interleaved between the validated revs and the grants: the
+        pod-rev chain must be unbroken (each add_pod returned exactly
+        previous+1, so ``final_rev`` is the validated rev plus the group
+        size — a watch thread's add/del in the window would occupy a rev
+        in the chain, and our higher rev would otherwise hide its
+        pending-dirty rebuild), and the key's inventory half stays the
+        VALIDATED one so a concurrent re-registration's newer rev still
+        forces a rebuild.  Batched cycles pass the whole per-node group
+        here, amortizing one publish over the group (ISSUE 6)."""
+        if final_rev != entry.key[0] + len(placements):
             # A watch-thread pod event on this node slipped between rev
             # validation and add_pod; its delta is not in entry.usage —
             # leave its dirty mark to trigger the full rebuild.
             return
         touched: Dict[str, score_mod.DeviceUsage] = {}
-        for container in placement:
-            for d in container:
-                u = touched.get(d.uuid)
-                if u is None:
-                    base = entry.usage.get(d.uuid)
-                    if base is None:
-                        # Unknown chip (inventory shrank mid-flight):
-                        # let the dirty rebuild recompute from scratch.
-                        return
-                    u = score_mod.clone_usage(base)
-                    touched[d.uuid] = u
-                u.used_slots += 1
-                u.used_mem += d.usedmem
-                u.used_cores += d.usedcores
+        for placement in placements:
+            for container in placement:
+                for d in container:
+                    u = touched.get(d.uuid)
+                    if u is None:
+                        base = entry.usage.get(d.uuid)
+                        if base is None:
+                            # Unknown chip (inventory shrank mid-flight):
+                            # let the dirty rebuild recompute from scratch.
+                            return
+                        u = score_mod.clone_usage(base)
+                        touched[d.uuid] = u
+                    u.used_slots += 1
+                    u.used_mem += d.usedmem
+                    u.used_cores += d.usedcores
         new_usage = dict(entry.usage)
         new_usage.update(touched)
         with self._usage_cache_lock:
             cached = self._usage_cache.get(node)
-            # Publish only if the cache still holds the exact map this
-            # grant was computed against; if a concurrent snapshot()
+            # Publish only if the cache still holds the exact map the
+            # grants were computed against; if a concurrent snapshot()
             # rebuilt it meanwhile, that rebuild either already includes
-            # this grant or the node's dirty mark is still pending —
+            # them or the node's dirty mark is still pending —
             # overwriting would resurrect a superseded view.
             if cached is not None and cached[1] is entry.usage:
-                self._usage_cache[node] = ((pod_rev, entry.key[1]),
+                self._usage_cache[node] = ((final_rev, entry.key[1]),
                                            new_usage)
 
     def _evaluate_candidates(self, uid: str, requests, anns: Dict[str, str],

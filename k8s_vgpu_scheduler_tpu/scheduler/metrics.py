@@ -95,6 +95,29 @@ class ClusterCollector(Collector):
         )
         conflicts.add_metric([], self.scheduler.commit_conflicts)
 
+        # Batched scheduling cycles (scheduler/batch.py).  Emitted even
+        # with --filter-batch off (zero-valued histograms): dashboards
+        # and alerts must never reference a vanishing series, and
+        # filter_many drives the engine regardless of the flag.
+        batch_size = HistogramMetricFamily(
+            "vtpu_filter_batch_size",
+            "Pods decided per batched scheduling cycle (the drain size "
+            "of one tick; sustained 1s mean the gate never aggregates — "
+            "check --batch-tick-ms against the Filter arrival rate)",
+        )
+        batch_lat = HistogramMetricFamily(
+            "vtpu_filter_batch_cycle_seconds",
+            "Wall-clock latency of one batched scheduling cycle "
+            "(snapshot refresh + vectorized evaluation + joint solve + "
+            "group commit + per-pod fallbacks)",
+        )
+        engine = getattr(self.scheduler, "batch", None)
+        if engine is not None:
+            buckets, total = engine.stats.size_histogram()
+            batch_size.add_metric([], buckets, total)
+            buckets, total = engine.stats.latency_histogram()
+            batch_lat.add_metric([], buckets, total)
+
         pool_size = GaugeMetricFamily(
             "vtpu_filter_worker_pool_size",
             "Candidate-evaluation worker pool size (0 until the pool is "
@@ -258,10 +281,11 @@ class ClusterCollector(Collector):
         idle_grants.add_metric([], len(fleet.idle))
 
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
-                pod_mem, pod_cores, preempts, conflicts, pool_size,
-                busy_peak, lease_state, leases_unhealthy, chips_quar,
-                quarantines, rescued, q_pending, q_admitted, q_share,
-                q_borrowed, q_reclaims, u_chip, u_hbm, eff_ratio,
+                pod_mem, pod_cores, preempts, conflicts, batch_size,
+                batch_lat, pool_size, busy_peak, lease_state,
+                leases_unhealthy, chips_quar, quarantines, rescued,
+                q_pending, q_admitted, q_share, q_borrowed, q_reclaims,
+                u_chip, u_hbm, eff_ratio,
                 idle_grants] + list(phase_metrics())
 
 

@@ -224,6 +224,14 @@ def parse_affinity(annotations: Dict[str, str]):
     return _affinity(annotations)
 
 
+def type_allows(affinity, dev_type: str) -> bool:
+    """Public per-type check against a parsed affinity — the batched
+    columnar evaluator (scheduler/batch.py) builds its per-type-id
+    eligibility table through this, so the vectorized type rule can
+    never drift from the per-chip one."""
+    return _type_ok(affinity, dev_type)
+
+
 def type_excluded(affinity, usage) -> Optional[str]:
     """Reject reason when the pod's type white/blacklist excludes EVERY
     chip type on the node, else None.  Runs against the shared snapshot

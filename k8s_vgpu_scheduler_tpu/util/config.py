@@ -81,6 +81,29 @@ class Config:
     # fully-locked decision (bounded retries ⇒ guaranteed convergence).
     commit_retries: int = 4
 
+    # Batched scheduling cycles (scheduler/batch.py; the "Batched
+    # cycles" section of docs/scheduler-concurrency.md).  When on,
+    # concurrent Filters collapse into cycles: one immutable snapshot,
+    # a vectorized pods×chips evaluation over a columnar fleet view,
+    # joint placement (greedy-with-regret), and one rev-validated group
+    # commit per node.  Off by default: the per-pod optimistic path
+    # stays the production default until operators opt in
+    # (--filter-batch); filter_many and the benchmarks drive the batch
+    # engine directly either way.
+    filter_batch: bool = False
+    # How long the first Filter into an idle batch gate waits for
+    # concurrent Filters to join its cycle (ms).  0 = no wait: each
+    # cycle takes whatever is already queued.
+    batch_tick_ms: float = 2.0
+    # Pods per cycle cap — bounds per-cycle latency and the columnar
+    # working set; a deeper backlog drains over successive cycles.
+    batch_max: int = 256
+    # Joint-placement solver: "regret" (greedy-with-regret — a pod with
+    # one feasible node is served before a flexible pod can take it) or
+    # "fifo" (sequential argmax in fair-share order; decision parity
+    # with the serial per-pod path, used by the parity suite).
+    batch_solver: str = "regret"
+
     # Fleet health subsystem (health/; docs/fault-tolerance.md).
     # Leases: seconds without a register-stream heartbeat before a node
     # turns Suspect (no new placements), and how many MORE ttl periods a

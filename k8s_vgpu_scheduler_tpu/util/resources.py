@@ -31,6 +31,11 @@ def _quantity_to_int(q) -> int:
     if isinstance(q, (int, float)):
         return int(q)
     s = str(q).strip()
+    if s.isdigit():
+        # The overwhelmingly common case — extended resources are plain
+        # integers ("1", "500") — skips the 12-suffix scan and the
+        # precision-lossy float round-trip on the per-decision hot path.
+        return int(s)
     mult = 1
     for suffix, m in (
         ("Ki", 1024), ("Mi", 1024 ** 2), ("Gi", 1024 ** 3),
@@ -74,11 +79,20 @@ def pod_priority(pod: dict, cfg: Config) -> int:
     return min(prios) if prios else 0
 
 
-def container_requests(pod: dict, cfg: Config) -> List[ContainerDeviceRequest]:
-    """One ContainerDeviceRequest per container (nums==0 when the container
-    requests no TPU)."""
+def pod_requests_and_priority(pod: dict, cfg: Config
+                              ) -> tuple:
+    """``(container_requests(pod), priority)`` in ONE walk of the
+    containers — the batched Filter parses thousands of pods per cycle,
+    and a separate priority pass would be a second full spec walk per
+    pod.  This IS the request decode (:func:`container_requests`
+    delegates here, so the two can never drift); the priority half
+    matches :func:`pod_priority` on every pod whose count resource
+    parses — pod_priority alone is lenient about malformed counts,
+    because it also runs on informer rebuilds of foreign pods
+    (equivalence pinned by test_resources)."""
     res = cfg.resources
     out: List[ContainerDeviceRequest] = []
+    prios: List[int] = []
     for ctr in pod.get("spec", {}).get("containers", []):
         limits = dict(ctr.get("resources", {}).get("requests", {}))
         limits.update(ctr.get("resources", {}).get("limits", {}))
@@ -103,7 +117,17 @@ def container_requests(pod: dict, cfg: Config) -> List[ContainerDeviceRequest]:
                 coresreq=cores,
             )
         )
-    return out
+        try:
+            prios.append(_quantity_to_int(limits.get(res.priority, 0)))
+        except QuantityError:
+            prios.append(0)
+    return out, (min(prios) if prios else 0)
+
+
+def container_requests(pod: dict, cfg: Config) -> List[ContainerDeviceRequest]:
+    """One ContainerDeviceRequest per container (nums==0 when the container
+    requests no TPU)."""
+    return pod_requests_and_priority(pod, cfg)[0]
 
 
 def pod_requests_any(pod: dict, cfg: Config) -> bool:

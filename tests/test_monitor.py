@@ -182,7 +182,22 @@ class TestNodeMetrics:
             w.stop()
 
 
+def _proc_has_nspid() -> bool:
+    """find_host_pid maps container pids through the NSpid chain in
+    /proc/<pid>/status; sandboxed kernels (gVisor-style /proc) omit the
+    field entirely, so the positive-path test cannot run there.  The
+    negative-path tests stand either way."""
+    try:
+        with open("/proc/self/status") as f:
+            return "NSpid" in f.read()
+    except OSError:
+        return False
+
+
 class TestHostPidMapping:
+    @pytest.mark.skipif(not _proc_has_nspid(),
+                        reason="/proc reports no NSpid (sandboxed "
+                               "kernel); host-pid mapping unavailable")
     def test_find_host_pid_same_namespace(self, loop_env):
         """In a shared PID namespace, find_host_pid returns the pid itself
         (NSpid chain has one entry) via the map-inode confirmation."""

@@ -86,3 +86,50 @@ class TestQuantities:
 
         with _pytest.raises(QuantityError):
             _quantity_to_int("banana")
+
+
+class TestCombinedWalk:
+    """pod_requests_and_priority is the single container walk the batched
+    Filter uses; container_requests delegates to it, and its priority
+    half must match pod_priority wherever both are defined."""
+
+    def test_priority_matches_pod_priority(self):
+        from k8s_vgpu_scheduler_tpu.util.resources import (
+            pod_priority,
+            pod_requests_and_priority,
+        )
+
+        cases = [
+            [{"google.com/tpu": "1", "vtpu.dev/task-priority": "2"}],
+            [{"google.com/tpu": "1"}],
+            [{"google.com/tpu": "2", "vtpu.dev/task-priority": "3"},
+             {"google.com/tpu": "1", "vtpu.dev/task-priority": "1"}],
+            # sidecar without TPUs must not lower the pod's protection
+            [{"google.com/tpu": "1"},
+             {"cpu": "1", "vtpu.dev/task-priority": "9"}],
+            # malformed priority counts as 0 (most protected)
+            [{"google.com/tpu": "1", "vtpu.dev/task-priority": "zzz"}],
+            [],
+        ]
+        for limits in cases:
+            pod = pod_with(limits)
+            reqs, prio = pod_requests_and_priority(pod, CFG)
+            assert reqs == container_requests(pod, CFG)
+            assert prio == pod_priority(pod, CFG), limits
+
+    def test_lenient_divergence_on_malformed_count(self):
+        """pod_priority tolerates a malformed count (it also runs on
+        informer rebuilds of foreign pods); the combined walk keeps
+        container_requests' strictness and raises."""
+        import pytest
+
+        from k8s_vgpu_scheduler_tpu.util.resources import (
+            QuantityError,
+            pod_priority,
+            pod_requests_and_priority,
+        )
+
+        pod = pod_with([{"google.com/tpu": "not-a-number"}])
+        assert pod_priority(pod, CFG) == 0
+        with pytest.raises(QuantityError):
+            pod_requests_and_priority(pod, CFG)

@@ -1,0 +1,514 @@
+"""Batched scheduling cycles: columnar parity + batch protocol units.
+
+The tentpole invariant (docs/scheduler-concurrency.md, "Batched
+cycles"): the vectorized pods×chips evaluation must enforce exactly the
+per-chip rules of ``score.fit_pod``, the FIFO solver must reproduce the
+serial per-pod path's decisions grant-for-grant on the same snapshot,
+and the per-node group commit must preserve the zero-over-grant
+revision protocol — conflicts fall back to the per-pod optimistic path,
+never to a silently stale placement.  Randomized parity here; the
+concurrency stress suite re-runs with the batch gate on via the
+VTPU_TEST_FILTER_BATCH knob (`make batch-protocol`).
+"""
+
+import copy
+import random
+import threading
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler import batch as batch_mod
+from k8s_vgpu_scheduler_tpu.scheduler import score as score_mod
+from k8s_vgpu_scheduler_tpu.scheduler.core import SnapEntry
+from k8s_vgpu_scheduler_tpu.scheduler.nodes import DeviceInfo, NodeInfo
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import ContainerDeviceRequest
+
+from tests.test_scheduler_core import register_node, tpu_pod
+
+
+def random_fleet(rng, n_nodes=None, with_topology=False):
+    """Seeded snapshot: nodes with random chip counts/sizes and random
+    pre-existing usage — the raw material both evaluators must agree
+    on."""
+    snap = {}
+    for n in range(n_nodes or rng.randint(2, 8)):
+        name = f"node-{n}"
+        chips = rng.randint(1, 6)
+        devmem = rng.choice([8000, 16384, 24000])
+        ctype = rng.choice(["TPU-v5e", "TPU-v4"])
+        usage = {}
+        devices = []
+        for c in range(chips):
+            cid = f"{name}-chip-{c}"
+            devices.append(DeviceInfo(
+                id=cid, count=10, devmem=devmem, type=ctype,
+                health=True, coords=(c, 0)))
+            used_slots = rng.randint(0, 9)
+            usage[cid] = score_mod.DeviceUsage(
+                id=cid, type=ctype, health=rng.random() > 0.1,
+                coords=(c, 0), total_slots=10, used_slots=used_slots,
+                total_mem=devmem,
+                used_mem=rng.randint(0, devmem) if used_slots else 0,
+                total_cores=100,
+                used_cores=rng.choice([0, 15, 30, 60]) if used_slots
+                else 0)
+        info = NodeInfo(name=name, devices=devices, topology=None)
+        snap[name] = SnapEntry((1, 1), info, usage)
+    return snap
+
+
+def random_request(rng, multi=False):
+    nums = rng.randint(2, 4) if multi else 1
+    if rng.random() < 0.3:
+        memreq, pct = 0, rng.choice([10, 25, 50, 100])
+    else:
+        memreq, pct = rng.choice([500, 2000, 8000, 16384]), 0
+    cores = rng.choice([0, 15, 30, 100])
+    return ContainerDeviceRequest(nums=nums, type="TPU", memreq=memreq,
+                                  mem_percentage_req=pct, coresreq=cores)
+
+
+def random_anns(rng):
+    r = rng.random()
+    if r < 0.2:
+        return {"vtpu.dev/use-tputype": "v5e"}
+    if r < 0.3:
+        return {"vtpu.dev/nouse-tputype": "v4"}
+    return {}
+
+
+class TestColumnarParity:
+    """The vectorized evaluator vs score.fit_pod, rule for rule."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fit_and_chip_choice_match_fit_pod(self, seed):
+        rng = random.Random(seed)
+        snap = random_fleet(rng)
+        fleet = batch_mod.ColumnarFleet()
+        fleet.refresh(snap)
+        for trial in range(12):
+            multi = rng.random() < 0.3
+            req = random_request(rng, multi=multi)
+            anns = random_anns(rng)
+            affinity = score_mod.parse_affinity(anns)
+            ce = batch_mod._ClassEval(req, affinity, binpack=False)
+            batch_mod.eval_class_full(fleet, ce)
+            for row, name in enumerate(fleet.names):
+                entry = snap[name]
+                cow = score_mod.CowUsage(entry.usage)
+                placement = score_mod.fit_pod(
+                    [req], cow, None, anns, "best-effort")
+                vec_fits = ce.score[row] != float("-inf")
+                assert vec_fits == (placement is not None), \
+                    f"seed {seed} trial {trial} node {name}: fit mismatch"
+                if placement is None:
+                    continue
+                ref_chips = [d.uuid for d in placement[0]]
+                ref_mems = [d.usedmem for d in placement[0]]
+                chips, mems = batch_mod.choose_chips(fleet, ce, row)
+                got_chips = [fleet.chip_ids[row][c] for c in chips]
+                assert got_chips == ref_chips, \
+                    f"seed {seed} node {name}: chip choice diverged"
+                assert mems == ref_mems
+                # The post-placement score drives node choice: the two
+                # computations differ only in float summation order.
+                ref_score = score_mod.node_score(cow, "spread")
+                assert abs(ce.score[row] - ref_score) < 1e-9
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_scalar_row_eval_matches_vector_eval_bitwise(self, seed):
+        """The solver patches rows scalar-at-a-time between vectorized
+        full evaluations; the two must agree BITWISE or tie-breaks
+        would depend on which path last computed a node's score."""
+        rng = random.Random(100 + seed)
+        snap = random_fleet(rng)
+        fleet = batch_mod.ColumnarFleet()
+        fleet.refresh(snap)
+        for _ in range(8):
+            req = random_request(rng, multi=rng.random() < 0.3)
+            ce = batch_mod._ClassEval(
+                req, score_mod.parse_affinity(random_anns(rng)),
+                binpack=rng.random() < 0.5)
+            batch_mod.eval_class_full(fleet, ce)
+            vec_score = list(ce.score)
+            vec_chip = list(ce.chip)
+            vec_mem = list(ce.mem)
+            for row in range(fleet.N):
+                batch_mod.eval_class_row(fleet, ce, row)
+                assert ce.score[row] == vec_score[row], \
+                    f"row {row}: scalar {ce.score[row]!r} != " \
+                    f"vector {vec_score[row]!r}"
+                if req.nums <= 1 and vec_score[row] != float("-inf"):
+                    assert ce.chip[row] == vec_chip[row]
+                    assert ce.mem[row] == vec_mem[row]
+
+
+def build_pair(n_nodes=4, chips=4, devmem=16384, topology=True,
+               **batched_cfg):
+    """Two identical fleets: one serial per-pod scheduler, one batched
+    (FIFO solver unless overridden)."""
+    def mk(cfg):
+        kube = FakeKube()
+        s = Scheduler(kube, cfg)
+        names = [f"node-{i}" for i in range(n_nodes)]
+        for n in names:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            if topology:
+                register_node(s, n, chips=chips, devmem=devmem)
+            else:
+                s.nodes.add_node(n, NodeInfo(
+                    name=n,
+                    devices=[DeviceInfo(id=f"{n}-chip-{i}", count=10,
+                                        devmem=devmem, type="TPU-v5e",
+                                        health=True, coords=(i, 0))
+                             for i in range(chips)],
+                    topology=None))
+        kube.watch_pods(s.on_pod_event)
+        return kube, s, names
+    serial = mk(Config(optimistic_commit=False))
+    batched = mk(Config(filter_batch=True,
+                        batch_solver=batched_cfg.pop("solver", "fifo"),
+                        **batched_cfg))
+    return serial, batched
+
+
+def random_pod_stream(rng, n, multi_ok=False):
+    pods = []
+    for i in range(n):
+        limits = {"google.com/tpu":
+                  str(rng.randint(2, 3)) if multi_ok and
+                  rng.random() < 0.25 else "1"}
+        if rng.random() < 0.3:
+            limits["google.com/tpumem-percentage"] = \
+                str(rng.choice([10, 25, 50]))
+        else:
+            limits["google.com/tpumem"] = \
+                str(rng.choice([500, 2000, 4000, 8000]))
+        if rng.random() < 0.5:
+            limits["google.com/tpucores"] = str(rng.choice([0, 15, 100]))
+        pod = {
+            "metadata": {"name": f"p{i}", "namespace": "default",
+                         "uid": f"u{i}", "annotations": random_anns(rng)},
+            "spec": {"containers": [
+                {"name": "main", "resources": {"limits": limits}}]},
+        }
+        pods.append(pod)
+    return pods
+
+
+class TestDecisionParity:
+    """Batched FIFO cycles vs the serial per-pod path, grant for grant:
+    same pods, same fleets, same order ⇒ same node AND same chips with
+    the same mem/cores on every placed pod (ISSUE 6's parity gate)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_batched_fifo_equals_serial_decisions(self, seed):
+        rng = random.Random(1000 + seed)
+        (kube_s, s_serial, names), (kube_b, s_batched, _) = build_pair(
+            n_nodes=rng.randint(2, 6), chips=rng.randint(2, 5),
+            topology=False)
+        pods = random_pod_stream(rng, 40, multi_ok=True)
+        items = []
+        for pod in pods:
+            kube_s.create_pod(copy.deepcopy(pod))
+            kube_b.create_pod(copy.deepcopy(pod))
+            items.append((copy.deepcopy(pod), names))
+        serial_results = [s_serial.filter(copy.deepcopy(p), names)
+                          for p in pods]
+        batched_results = s_batched.filter_many(items)
+        for i, (rs, rb) in enumerate(zip(serial_results,
+                                         batched_results)):
+            assert (rs.node is None) == (rb.node is None), \
+                f"seed {seed} pod {i}: serial={rs.node!r} " \
+                f"batched={rb.node!r} ({rb.error})"
+            if rs.node is None:
+                continue
+            assert rb.node == rs.node, f"seed {seed} pod {i}"
+            gs = s_serial.pods.get(f"u{i}").devices
+            gb = s_batched.pods.get(f"u{i}").devices
+            assert gb == gs, f"seed {seed} pod {i}: grants diverged"
+        s_serial.close()
+        s_batched.close()
+
+    def test_regret_mode_places_everything_serial_places(self):
+        """The regret solver may pick different (better) assignments but
+        must never over-book and, with ample capacity, places every pod
+        the sequential path places."""
+        rng = random.Random(7)
+        (kube_s, s_serial, names), (kube_b, s_batched, _) = build_pair(
+            n_nodes=6, chips=4, topology=False, solver="regret")
+        pods = random_pod_stream(rng, 30)
+        items = []
+        for pod in pods:
+            kube_s.create_pod(copy.deepcopy(pod))
+            kube_b.create_pod(copy.deepcopy(pod))
+            items.append((copy.deepcopy(pod), names))
+        placed_serial = sum(
+            1 for p in pods
+            if s_serial.filter(copy.deepcopy(p), names).node)
+        batched_results = s_batched.filter_many(items)
+        placed_batched = sum(1 for r in batched_results if r.node)
+        assert placed_batched >= placed_serial
+        from tests.test_scheduler_concurrency import \
+            assert_no_overallocation
+        assert_no_overallocation(s_batched)
+        s_serial.close()
+        s_batched.close()
+
+    def test_regret_beats_sequential_argmax_under_contention(self):
+        """The joint-solver headline: a flexible pod must yield the
+        contended node to a pod with no alternative.  Sequential argmax
+        sends the flexible pod (arriving first) to the big node and
+        strands the picky pod; greedy-with-regret places both."""
+        def mk(solver):
+            kube = FakeKube()
+            s = Scheduler(kube, Config(filter_batch=True,
+                                       batch_solver=solver))
+            # node-big: one 12000 MiB chip; node-small: one 4000 MiB
+            # chip.  Both idle (equal spread score 2.0); the flexible
+            # pod's smaller fraction makes node-big its argmax.
+            s.nodes.add_node("node-big", NodeInfo(
+                name="node-big",
+                devices=[DeviceInfo(id="big-chip", count=10,
+                                    devmem=12000, type="TPU-v5e",
+                                    health=True, coords=(0, 0))],
+                topology=None))
+            s.nodes.add_node("node-small", NodeInfo(
+                name="node-small",
+                devices=[DeviceInfo(id="small-chip", count=10,
+                                    devmem=4000, type="TPU-v5e",
+                                    health=True, coords=(0, 0))],
+                topology=None))
+            kube.watch_pods(s.on_pod_event)
+            names = ["node-big", "node-small"]
+            # flexible first (sequential argmax sends it to node-big),
+            # then the pod that ONLY fits node-big.
+            flexible = tpu_pod("flex", uid="flex", mem="3500")
+            picky = tpu_pod("picky", uid="picky", mem="9000")
+            for p in (flexible, picky):
+                kube.create_pod(p)
+            results = s.filter_many([(flexible, names), (picky, names)])
+            s.close()
+            return results
+
+        fifo = mk("fifo")
+        assert fifo[0].node == "node-big"      # argmax: most free wins
+        assert fifo[1].node is None            # stranded
+        regret = mk("regret")
+        assert regret[1].node == "node-big"    # regret serves picky first
+        assert regret[0].node == "node-small"  # flexible yields
+        assert all(r.node for r in regret)
+
+
+class TestBatchProtocol:
+    def _env(self, n_nodes=4, **cfg):
+        kube = FakeKube()
+        s = Scheduler(kube, Config(filter_batch=True, **cfg))
+        names = [f"node-{i}" for i in range(n_nodes)]
+        for n in names:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n, chips=4)
+        kube.watch_pods(s.on_pod_event)
+        return kube, s, names
+
+    def test_lost_group_commit_falls_back_and_places(self):
+        """A node whose generation moves between the batch snapshot and
+        its group commit must conflict — the group re-decides through
+        the per-pod optimistic path, nothing double-books."""
+        kube, s, names = self._env(n_nodes=2)
+        from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+        real_solve = batch_mod.solve
+        fired = {"n": 0}
+
+        def racing_solve(fleet, cohorts, n_jobs, solver):
+            plan = real_solve(fleet, cohorts, n_jobs, solver)
+            if fired["n"] == 0 and any(plan):
+                fired["n"] = 1
+                row = next(p[0] for p in plan if p)
+                node = fleet.names[row]
+                # Rival grant lands on the winning node post-snapshot.
+                s.pods.add_pod(PodInfo(
+                    uid="rival", name="rival", namespace="default",
+                    node=node,
+                    devices=[[ContainerDevice(
+                        uuid=f"{node}-chip-0", type="TPU-v5e",
+                        usedmem=1000, usedcores=0)]]))
+            return plan
+
+        batch_mod.solve, saved = racing_solve, batch_mod.solve
+        try:
+            items = []
+            for i in range(4):
+                p = tpu_pod(f"p{i}", uid=f"u{i}", mem="2000")
+                kube.create_pod(p)
+                items.append((p, names))
+            results = s.filter_many(items)
+        finally:
+            batch_mod.solve = saved
+        assert all(r.node for r in results), \
+            [r.error for r in results if not r.node]
+        assert s.commit_conflicts >= 1
+        assert s.batch.stats.conflicts >= 1
+        from tests.test_scheduler_concurrency import \
+            assert_no_overallocation
+        assert_no_overallocation(s)
+        # The phantom in-batch grants of the conflicted group must have
+        # been rolled back from the columnar view: total granted mem in
+        # the registry equals what the snapshot-of-record reports.
+        got = s.inspect_all_nodes_usage()
+        total = sum(u.used_mem for usage in got.values()
+                    for u in usage.values())
+        assert total == 4 * 2000 + 1000
+        s.close()
+
+    def test_suspect_node_takes_no_batched_placements(self):
+        kube, s, names = self._env(n_nodes=2, lease_ttl_s=0.001,
+                                   lease_grace_beats=0)
+        import time as _t
+        s.leases.beat(names[0])
+        _t.sleep(0.01)   # names[0] lease expires; names[1] has no lease
+        p = tpu_pod("p", uid="u", mem="1000")
+        kube.create_pod(p)
+        r, = s.filter_many([(p, names)])
+        assert r.node == names[1]
+        s.close()
+
+    def test_gate_aggregates_concurrent_filters(self):
+        """Concurrent filter() calls in batch mode must share cycles
+        (batch size > 1 observed) and all place correctly."""
+        kube, s, names = self._env(n_nodes=4, batch_tick_ms=20)
+        n = 12
+        pods = []
+        for i in range(n):
+            p = tpu_pod(f"p{i}", uid=f"u{i}", mem="1000")
+            kube.create_pod(p)
+            pods.append(p)
+        results = [None] * n
+        barrier = threading.Barrier(n)
+
+        def submit(i):
+            barrier.wait()
+            results[i] = s.filter(pods[i], names)
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "filter wedged in the batch gate"
+        assert all(r is not None and r.node for r in results)
+        assert s.batch.stats.pods == n
+        assert s.batch.stats.cycles < n, "gate never aggregated"
+        s.close()
+
+    def test_non_batchable_shapes_use_per_pod_path(self):
+        """Gang members and multi-container pods must keep the per-pod
+        path even with --filter-batch on — and still place."""
+        kube, s, names = self._env(n_nodes=2)
+        gang_pod = tpu_pod("g0", uid="g0u", mem="1000")
+        gang_pod["metadata"]["annotations"].update({
+            "vtpu.dev/pod-group": "team", "vtpu.dev/pod-group-total": "1"})
+        kube.create_pod(gang_pod)
+        r = s.filter(gang_pod, names)
+        assert r.node is not None, r.error
+        assert s.batch.stats.pods == 0   # never entered the batch
+        multi = {
+            "metadata": {"name": "mc", "namespace": "default",
+                         "uid": "mcu", "annotations": {}},
+            "spec": {"containers": [
+                {"name": "a", "resources": {"limits": {
+                    "google.com/tpu": "1",
+                    "google.com/tpumem": "1000"}}},
+                {"name": "b", "resources": {"limits": {
+                    "google.com/tpu": "1",
+                    "google.com/tpumem": "1000"}}},
+            ]},
+        }
+        kube.create_pod(multi)
+        r = s.filter(multi, names)
+        assert r.node is not None, r.error
+        assert s.batch.stats.pods == 0
+        assert len(s.pods.get("mcu").devices) == 2
+        s.close()
+
+    def test_multichip_on_topology_fleet_uses_slice_engine(self):
+        """nums>1 on an ICI fleet must route to the per-pod path (the
+        closed-form slice engine) — contiguity is not vectorized."""
+        kube, s, names = self._env(n_nodes=2)
+        p = tpu_pod("p", uid="u", mem="1000", nums="2")
+        kube.create_pod(p)
+        r, = s.filter_many([(p, names)])
+        assert r.node is not None, r.error
+        assert s.batch.stats.fallbacks == 1
+        # The grant's chips are ICI neighbors (register_node coords).
+        grant = s.pods.get("u").devices[0]
+        coords = []
+        for d in grant:
+            info = s.nodes.get_node(r.node)
+            coords.extend(dev.coords for dev in info.devices
+                          if dev.id == d.uuid)
+        assert len(coords) == 2
+        s.close()
+
+    def test_fair_share_release_order_respected_in_drain(self):
+        """Governed pods in one drained batch must be solved in the
+        admission loop's release order, not arrival order."""
+        quota = ({"name": "q", "namespaces": ["default"], "weight": 1,
+                  "quota": {"chips": 100}},)
+        kube, s, names = self._env(n_nodes=1, quota_queues=quota)
+        # Two governed pods arrive; the admission loop releases u1
+        # BEFORE u0 (simulate by releasing manually in that order).
+        p0 = tpu_pod("p0", uid="u0", mem="1000")
+        p1 = tpu_pod("p1", uid="u1", mem="1000")
+        for p in (p0, p1):
+            kube.create_pod(p)
+            from k8s_vgpu_scheduler_tpu.util.resources import \
+                container_requests
+            assert s.quota.gate(p, container_requests(p, s.cfg)) \
+                is not None   # held on first sight
+        s.quota.release("u1")
+        s.quota.release("u0")
+        jobs = []
+        for p in (p0, p1):    # arrival order: u0 first
+            jobs.append(s._route_batch(p, names))
+        assert all(isinstance(j, batch_mod.BatchJob) for j in jobs)
+        ranks = s.batch.fair_share_ranks(jobs)
+        # u1 released first → it outranks u0 despite arriving second.
+        assert ranks[1] < ranks[0]
+        s.close()
+
+    def test_batch_metrics_exported(self):
+        from prometheus_client import CollectorRegistry, generate_latest
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import \
+            ClusterCollector
+
+        kube, s, names = self._env(n_nodes=2)
+        p = tpu_pod("p", uid="u", mem="1000")
+        kube.create_pod(p)
+        assert s.filter_many([(p, names)])[0].node
+        registry = CollectorRegistry()
+        registry.register(ClusterCollector(s))
+        text = generate_latest(registry).decode()
+        assert 'vtpu_filter_batch_size_bucket{le="1.0"} 1.0' in text
+        assert "vtpu_filter_batch_cycle_seconds_sum" in text
+        s.close()
+
+    def test_filter_many_mirrors_filter_for_held_and_alien_pods(self):
+        quota = ({"name": "q", "namespaces": ["default"], "weight": 1,
+                  "quota": {"chips": 1}},)
+        kube, s, names = self._env(n_nodes=1, quota_queues=quota)
+        held = tpu_pod("held", uid="heldu", mem="1000")
+        alien = {"metadata": {"name": "alien", "namespace": "default",
+                              "uid": "alienu", "annotations": {}},
+                 "spec": {"containers": [{"name": "c", "resources": {}}]}}
+        kube.create_pod(held)
+        r_held, r_alien = s.filter_many([(held, names), (alien, names)])
+        assert r_held.node is None and "queue" in r_held.error
+        assert r_alien.node is None and not r_alien.error
+        s.close()

@@ -12,6 +12,7 @@ caching, the decision-write group commit, and the conflict-retry path
 itself (forced deterministically, since a lost race is rare in-process).
 """
 
+import os
 import threading
 
 import pytest
@@ -32,6 +33,13 @@ CORES_PER_CHIP = 100
 
 
 def make_env(n_nodes=8, **cfg_kwargs):
+    # `make batch-protocol` re-runs this whole suite with the batched
+    # Filter on: same invariants, decisions taken by batched cycles
+    # (scheduler/batch.py) instead of per-pod evaluation.  Tests that
+    # pin per-pod mechanics (forced conflicts, fit-cache behavior) set
+    # filter_batch explicitly and are unaffected by the knob.
+    if os.environ.get("VTPU_TEST_FILTER_BATCH") == "1":
+        cfg_kwargs.setdefault("filter_batch", True)
     kube = FakeKube()
     s = Scheduler(kube, Config(**cfg_kwargs))
     names = [f"node-{i}" for i in range(n_nodes)]
@@ -141,8 +149,11 @@ class TestOptimisticCommitProtocol:
         """Deterministically lose the first commit: a competing grant
         lands on the winning node between snapshot and commit.  The
         filter must count the conflict, re-evaluate, and still place —
-        with both pods' grants intact (no double-booking)."""
-        kube, s, names = make_env(n_nodes=2)
+        with both pods' grants intact (no double-booking).  Pinned to
+        the per-pod path (the forced race hooks _evaluate_candidates;
+        the batch path's equivalent is
+        test_scheduler_batch.test_lost_group_commit_falls_back)."""
+        kube, s, names = make_env(n_nodes=2, filter_batch=False)
         real_eval = s._evaluate_candidates
         fired = {"n": 0}
 
