@@ -160,6 +160,21 @@ def parse_args(argv=None):
                    help="never reclaim borrowed grants for starved "
                         "in-quota tenants (fair-share ordering and "
                         "borrowing stay on)")
+    p.add_argument("--enable-defrag", action="store_true",
+                   help="background fleet defragmentation: compact "
+                        "fragmented nodes by checkpoint-migrating "
+                        "movable pods so blocked large slice/mesh "
+                        "demands can admit (docs/placement.md)")
+    p.add_argument("--defrag-interval", type=float, default=10.0,
+                   help="defrag loop period, seconds")
+    p.add_argument("--defrag-checkpoint-grace", type=float, default=120.0,
+                   help="seconds an asked migration victim gets to "
+                        "checkpoint and exit before the plan aborts")
+    p.add_argument("--defrag-reservation-ttl", type=float, default=300.0,
+                   help="seconds an assembled (reserved) slice waits "
+                        "for its beneficiary before returning to the pool")
+    p.add_argument("--defrag-max-victims", type=int, default=8,
+                   help="largest victim set a compaction plan may ask")
     p.add_argument("--no-rescue", action="store_true",
                    help="disable the background rescue sweep (failure "
                         "detection and quarantine gating stay on; grants "
@@ -275,6 +290,11 @@ def build_config(args) -> Config:
         queue_fleet_headroom=args.queue_fleet_headroom,
         enable_queue_backfill=not args.no_queue_backfill,
         enable_reclaim=not args.no_reclaim,
+        enable_defrag=args.enable_defrag,
+        defrag_interval_s=args.defrag_interval,
+        defrag_checkpoint_grace_s=args.defrag_checkpoint_grace,
+        defrag_reservation_ttl_s=args.defrag_reservation_ttl,
+        defrag_max_victims=args.defrag_max_victims,
     )
 
 
@@ -335,6 +355,11 @@ def main(argv=None):
     # quota config.  After the boot reconcile, so held/admitted state was
     # already re-learned from the queue-state annotations (WAL).
     scheduler.admission.start()
+    # Fleet defragmentation: the compaction loop runs from here (same
+    # embedders-own-their-cadence rule as the rescuer); inert without
+    # --enable-defrag.
+    if scheduler.cfg.enable_defrag:
+        scheduler.defrag.start()
 
     watch_stop = threading.Event()
     if watch_enabled:
@@ -382,6 +407,7 @@ def main(argv=None):
         watch_stop.set()
         scheduler.rescuer.stop()
         scheduler.admission.stop()
+        scheduler.defrag.stop()
         http_server.stop()
         grpc_server.stop(grace=2)
 

@@ -145,12 +145,19 @@ class AdmissionLoop:
     def _fleet_chip_cap(self) -> Optional[float]:
         """Whole chips registered fleet-wide (None = no inventory yet —
         quota-only gating, so a cold-booting control plane or a pure
-        embedder never deadlocks its queues on an empty node registry)."""
+        embedder never deadlocks its queues on an empty node registry).
+        Chips a defrag compaction holds in reservation are subtracted:
+        they are real capacity nobody but the beneficiary can use, so
+        releasing (or backfilling) against them would just move pods
+        into the Filter to bounce off the stripped snapshot — and, for
+        the backfill rule, fill the very hole compaction opened."""
         nodes = self.s.nodes.list_nodes()
         if not nodes:
             return None
         chips = sum(len(info.devices) for info in nodes.values())
-        return chips * self.cfg.fleet_headroom
+        reservations = getattr(self.s, "reservations", None)
+        reserved = reservations.total_chips() if reservations else 0
+        return max(0.0, chips * self.cfg.fleet_headroom - reserved)
 
     def _fits_fleet(self, chips: int, fleet_cap: Optional[float],
                     state: dict) -> bool:

@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..placement.mesh import MESH_ANNOTATION
 from ..util import trace
 from ..util.types import ContainerDevice
 from . import score as score_mod
@@ -724,9 +725,17 @@ class BatchStats:
         self.pods = 0
         self.fallbacks = 0      # jobs resolved via the per-pod path
         self.conflicts = 0      # group-commit members that lost a rev race
+        #: Per-cause fallback counts (vtpu_filter_batch_fallbacks_total
+        #: {reason=...}): "slice-no-fit" (a topology/mesh job the
+        #: in-cycle slice stage could not place), "no-fit" (a vector job
+        #: the solver found no node for), "commit-conflict" (lost a rev
+        #: race in the group commit), "error" (a cycle-internal failure
+        #: resolved per-pod).  Bounded, fixed label set.
+        self.fallback_reasons: Dict[str, int] = {}
 
     def record(self, size: int, seconds: float, fallbacks: int,
-               conflicts: int) -> None:
+               conflicts: int,
+               reasons: Optional[Dict[str, int]] = None) -> None:
         with self._lock:
             self.cycles += 1
             self.pods += size
@@ -734,6 +743,9 @@ class BatchStats:
             self.lat_sum += seconds
             self.fallbacks += fallbacks
             self.conflicts += conflicts
+            for reason, n in (reasons or {}).items():
+                self.fallback_reasons[reason] = \
+                    self.fallback_reasons.get(reason, 0) + n
             for i, b in enumerate(self.SIZE_BUCKETS):
                 if size <= b:
                     self._size_counts[i] += 1
@@ -756,6 +768,10 @@ class BatchStats:
             out.append((str(float(b)), cum))
         out.append(("+Inf", cum + counts[-1]))
         return out
+
+    def fallback_reason_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.fallback_reasons)
 
     def size_histogram(self) -> Tuple[List[Tuple[str, float]], float]:
         with self._lock:
@@ -856,6 +872,7 @@ class BatchEngine:
         ranks = self.fair_share_ranks(jobs)
         results: List[Optional[object]] = [None] * len(jobs)
         fallback: set = set()
+        reasons: Dict[str, int] = {}
         conflicts = 0
         with self._cycle_lock, \
                 tr.span("batch-cycle", pods=len(jobs)) as sp:
@@ -863,22 +880,54 @@ class BatchEngine:
             self.fleet.refresh(snap)
             self._gate_rows()
             vector: List[int] = []
+            slices: List[int] = []
             for i, job in enumerate(jobs):
                 req = job.requests[0]
-                if req.nums > 1 and self.fleet.any_topology:
-                    # Slice placements need the ICI engine — per-pod path.
-                    fallback.add(i)
+                if req.nums > 1 and (self.fleet.any_topology
+                                     or MESH_ANNOTATION in job.anns):
+                    # Slice/mesh placements need the closed-form ICI
+                    # engine — placed sequentially in-cycle against
+                    # copy-on-write snapshot views, then group-committed
+                    # with everyone else (ISSUE 8: no more
+                    # unconditional per-pod fallback).  Mesh pods route
+                    # here even on a topology-less fleet: fit_pod then
+                    # rejects them (topology-unverifiable) exactly like
+                    # the per-pod path, instead of the vector stage
+                    # silently scattering a declared mesh.
+                    slices.append(i)
                 else:
                     vector.append(i)
+            plan: List[Optional[Tuple[int, List[int], List[int]]]] = \
+                [None] * len(jobs)
+            if slices:
+                self._place_slices(jobs, slices, ranks, plan)
+                for i in slices:
+                    if plan[i] is None:
+                        fallback.add(i)
+                        reasons["slice-no-fit"] = \
+                            reasons.get("slice-no-fit", 0) + 1
+            # Vector evaluation runs AFTER the slice stage: the slice
+            # grants are charged into the columnar fleet, so the class
+            # matrices already price them in.
             cohorts = self._build_cohorts(jobs, vector, ranks)
-            plan = solve(self.fleet, cohorts, len(jobs),
-                         self.s.cfg.batch_solver)
-            committed, lost = self._commit(snap, jobs, vector, plan)
+            vplan = solve(self.fleet, cohorts, len(jobs),
+                          self.s.cfg.batch_solver)
+            for i in vector:
+                plan[i] = vplan[i]
+            committed, lost = self._commit(
+                snap, jobs, vector + slices, plan)
             conflicts = len(lost)
+            if lost:
+                reasons["commit-conflict"] = \
+                    reasons.get("commit-conflict", 0) + len(lost)
             for i, res in committed.items():
                 results[i] = res
             fallback.update(lost)
-            fallback.update(i for i in vector if results[i] is None)
+            unfit_vector = [i for i in vector if results[i] is None
+                            and i not in fallback]
+            if unfit_vector:
+                reasons["no-fit"] = len(unfit_vector)
+            fallback.update(unfit_vector)
             sp.set("committed", len(committed))
             sp.set("fallback", len(fallback))
         # Per-pod fallback OUTSIDE the cycle lock: these run the normal
@@ -896,10 +945,11 @@ class BatchEngine:
                     # must not poison the cycle's other decisions.
                     log.exception("batch fallback for %s failed", job.name)
                     fsp.set("error", str(e))
+                    reasons["error"] = reasons.get("error", 0) + 1
                     results[i] = FilterResult(
                         error=f"batch fallback failed: {e}")
         self.stats.record(len(jobs), time.monotonic() - t0,
-                          len(fallback), conflicts)
+                          len(fallback), conflicts, reasons)
         return [r if r is not None
                 else FilterResult(error="batch cycle produced no decision")
                 for r in results]
@@ -940,6 +990,59 @@ class BatchEngine:
                 for row, name in enumerate(fleet.names)]
         else:
             fleet.bonus = [0.0] * fleet.N
+
+    def _place_slices(self, jobs: List[BatchJob], slices: List[int],
+                      ranks: List[int], plan: List) -> None:
+        """In-cycle placement for multi-chip slice/mesh jobs: the
+        closed-form ICI engine (score.fit_pod → topology/torus.py,
+        placement/mesh.py) runs per candidate over copy-on-write views
+        of the SAME snapshot entries the columnar fleet mirrors, the
+        winner is charged into the columnar state (apply_grant — the
+        vector stage prices it in; a lost commit rolls the row back via
+        the touched-set on the next refresh), and the grant joins the
+        per-node group commit as a regular plan entry.  Jobs that fit
+        nowhere leave plan[i] None — the per-pod fallback re-checks
+        against the live fleet and produces reasons + the defrag demand
+        signal."""
+        fleet = self.fleet
+        policy = self.s.cfg.node_scheduler_policy
+        cows: Dict[int, score_mod.CowUsage] = {}
+        uuid_col: Dict[int, Dict[str, int]] = {}
+        for i in sorted(slices, key=lambda i: ranks[i]):
+            job = jobs[i]
+            best = None   # (score, offer_pos, row, placement, probe)
+            for pos, name in enumerate(job.node_names):
+                row = fleet.row_of.get(name)
+                if row is None or not fleet.alive[row]:
+                    continue
+                entry = fleet.entry_of(name)
+                if entry is None:
+                    continue
+                base = cows.get(row)
+                if base is None:
+                    base = cows[row] = score_mod.CowUsage(entry.usage)
+                probe = score_mod.CowUsage(base)
+                got = score_mod.fit_pod(
+                    job.requests, probe, entry.info.topology, job.anns,
+                    self.s.cfg.topology_policy)
+                if got is None:
+                    continue
+                s = score_mod.node_score(probe, policy) \
+                    + fleet.bonus[row]
+                if best is None or s > best[0]:
+                    best = (s, pos, row, got, probe)
+            if best is None:
+                continue
+            _s, _pos, row, placement, probe = best
+            cows[row] = probe  # later slice jobs see this grant
+            cols = uuid_col.get(row)
+            if cols is None:
+                cols = uuid_col[row] = {
+                    cid: c for c, cid in enumerate(fleet.chip_ids[row])}
+            chips = [cols[d.uuid] for d in placement[0]]
+            mems = [d.usedmem for d in placement[0]]
+            plan[i] = (row, chips, mems)
+            fleet.apply_grant(row, chips, mems, job.requests[0].coresreq)
 
     def _build_cohorts(self, jobs: List[BatchJob], vector: List[int],
                        ranks: List[int]) -> List[_Cohort]:

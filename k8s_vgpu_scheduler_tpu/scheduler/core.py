@@ -34,6 +34,9 @@ from ..k8s.client import (
     pod_namespace,
     pod_uid,
 )
+from ..placement.defrag import Defragmenter, DefragConfig
+from ..placement.mesh import MESH_ANNOTATION, local_mesh_for, parse_mesh
+from ..placement.reserve import SliceReservations
 from ..quota.admission import AdmissionConfig, AdmissionLoop
 from ..quota.queues import QuotaManager
 from ..tpulib.types import TopologyDesc
@@ -181,6 +184,29 @@ class Scheduler:
         # admission loop is started by the daemon entrypoint like the
         # rescuer; embedders/tests call admission.tick() directly.
         self.quota = QuotaManager(self.cfg.quota_queues, clock=clock)
+        # Placement subsystem (placement/; docs/placement.md).  Slice
+        # reservations ride the revision protocol exactly like
+        # quarantine: every change bumps the node's inventory rev
+        # (nodes.touch), so reserved chips leave/rejoin the schedulable
+        # set atomically with respect to optimistic commits.  The
+        # defragmenter is inert unless --enable-defrag (its demand
+        # registry and the availability metrics still work); the loop
+        # thread is started by the daemon entrypoint — embedders/tests
+        # call defrag.tick() directly, the rescuer/admission shape.
+        self.reservations = SliceReservations(
+            clock=clock, on_change=self.nodes.touch,
+            ttl_s=self.cfg.defrag_reservation_ttl_s)
+        self.defrag = Defragmenter(
+            self,
+            DefragConfig(
+                enabled=self.cfg.enable_defrag,
+                interval_s=self.cfg.defrag_interval_s,
+                demand_fresh_s=self.cfg.defrag_demand_fresh_s,
+                checkpoint_grace_s=self.cfg.defrag_checkpoint_grace_s,
+                reservation_ttl_s=self.cfg.defrag_reservation_ttl_s,
+                min_victim_priority=self.cfg.defrag_min_victim_priority,
+                max_victims_per_plan=self.cfg.defrag_max_victims),
+            clock=clock)
         self.admission = AdmissionLoop(
             self,
             AdmissionConfig(
@@ -632,6 +658,14 @@ class Scheduler:
                 # the key above already reflects the current set.
                 usage = {cid: u for cid, u in usage.items()
                          if cid not in quarantined}
+            reserved = self.reservations.reserved_on(name)
+            if reserved:
+                # Reserved chips (a defrag compaction's assembled box)
+                # are stripped the same way: no fit path can squat in
+                # the hole the migration opened.  Same staleness safety:
+                # every reserve/release bumped this node's rev.
+                usage = {cid: u for cid, u in usage.items()
+                         if cid not in reserved}
             cached = (key, usage)
             self._usage_cache[name] = cached
         return SnapEntry(key, info, cached[1])
@@ -658,6 +692,17 @@ class Scheduler:
         shallow per-node dict copies share the immutable DeviceUsage
         entries; collectors only read)."""
         return {n: dict(e.usage) for n, e in self.snapshot().items()}
+
+    def known_topologies(self) -> List[TopologyDesc]:
+        """Distinct ICI topologies registered in the fleet — the
+        webhook's mesh-feasibility check reads these (deduped: the check
+        is per-shape, and large fleets repeat a handful of shapes)."""
+        seen = {}
+        for info in self.nodes.list_nodes().values():
+            t = info.topology
+            if t is not None:
+                seen[(t.mesh, t.wrap())] = t
+        return list(seen.values())
 
     def grant_efficiency(self, now: Optional[float] = None
                          ) -> "eff_mod.FleetEfficiency":
@@ -822,6 +867,7 @@ class Scheduler:
         hold = self.quota.gate(pod, requests)
         if hold is not None:
             return FilterResult(error=hold)
+        self._release_reservation_for(pod)
         if gang_of(pod) is not None or not self.cfg.optimistic_commit \
                 or not self._batchable(requests):
             return None
@@ -867,6 +913,7 @@ class Scheduler:
                 tr.event(pod_uid(pod), "filter-rejected", trace_id=tid,
                          pod=pod_name(pod), error=result.error,
                          preempting=result.preempt is not None)
+            self._note_slice_rejection(pod, result)
             if result.failed:
                 # A RELEASED governed pod that found no seat is the
                 # reclaim trigger's signal (admission loop: borrowers may
@@ -877,6 +924,9 @@ class Scheduler:
             return result
         tr.event(pod_uid(pod), "filter-assigned", trace_id=tid,
                  pod=pod_name(pod), node=result.node)
+        # A placement settles any slice demand this pod (or its gang)
+        # had recorded — the defragmenter must not compact for it.
+        self.defrag.demand_satisfied(self._reservation_key(pod))
         if self._preempt_by_requester.get(pod_uid(pod)):
             # The pod found a seat after all (capacity freed elsewhere):
             # its outstanding eviction requests are now pointless.
@@ -911,6 +961,101 @@ class Scheduler:
                          trace_id=tid, error=str(e))
                 return FilterResult(error=f"writing decision failed: {e}")
         return result
+
+    # -- placement subsystem hooks (placement/; docs/placement.md) -------------
+    @staticmethod
+    def _reservation_key(pod: dict) -> str:
+        """Identity a slice demand / reservation is recorded under: the
+        gang key for gang members (any member's arrival delivers the
+        whole gang's box), else the pod uid."""
+        g = gang_of(pod)
+        if g is not None:
+            return f"{pod_namespace(pod)}/{g[0]}"
+        return pod_uid(pod)
+
+    def _release_reservation_for(self, pod: dict) -> None:
+        """If the defragmenter assembled a box for this pod/gang,
+        return its chips to the snapshot before deciding (the release
+        bumps the node's rev, so the decision's snapshot() rebuild sees
+        them)."""
+        key = self._reservation_key(pod)
+        if self.reservations.holds_for(key):
+            if not self.defrag.ready_for(key):
+                # Mid-compaction (or a gang still short of boxes):
+                # releasing now would let bystanders squat in the
+                # partially-assembled hole.  The pod fails this Filter
+                # and retries; the defrag loop keeps assembling.
+                return
+            released = self.reservations.release_for(key)
+            log.info("placement: released reserved slice on %s for %s",
+                     ",".join(sorted({r.node for r in released})), key)
+            trace.tracer().event(pod_uid(pod), "slice-reservation-released",
+                                 trace_id=trace.trace_id_of(pod),
+                                 pod=pod_name(pod),
+                                 chips=sum(len(r.chips) for r in released))
+
+    def _note_slice_rejection(self, pod: dict,
+                              result: "FilterResult") -> None:
+        """Feed the defragmenter's demand registry: a multi-chip pod
+        that fit nowhere because no contiguous box exists (per-node
+        ``no-ici-slice``/``no-mesh-slice`` reasons, or a gang whose
+        atomic placement failed on a topology fleet) is exactly the
+        blocked demand compaction can unblock."""
+        try:
+            requests = container_requests(pod, self.cfg)
+        except ValueError:
+            return
+        chips = max((r.nums for r in requests), default=0)
+        if chips <= 1:
+            return
+        gang = gang_of(pod)
+        slice_blocked = False
+        if result.failed:
+            # A real candidate sweep rejected every node.  Explicit
+            # slice tokens are certain fragmentation; chip-availability
+            # tokens (too-few-chips, exclusive-chip-busy,
+            # slots-exhausted) are how fragmentation presents when
+            # eligible whole chips run short.  Resource-shaped tokens
+            # (insufficient-hbm/-cores, type-mismatch, unhealthy) are
+            # NOT demand — compaction assembles free chips, it cannot
+            # mint HBM or chip types, and evicting workloads for such a
+            # pod would waste checkpoints for nothing.
+            # cores-exhausted / slots-exhausted are whole-busy chips
+            # (chip availability); insufficient-cores/-hbm are partial
+            # shortfalls on chips that ARE available — still excluded.
+            frag_tokens = ("no-ici-slice", "no-mesh-slice",
+                           "too-few-chips", "exclusive-chip-busy",
+                           "slots-exhausted", "cores-exhausted")
+            slice_blocked = any(
+                r.startswith(frag_tokens)
+                for r in result.failed.values())
+        elif gang is not None and result.error \
+                and "no atomic placement" in result.error:
+            # Gang admission reports no per-node reasons.  Quota holds
+            # and waiting-for-quorum gangs never reach here (their
+            # results carry no failed map and no atomic-placement
+            # error), so they cannot masquerade as demand.
+            slice_blocked = any(
+                e.info.topology is not None
+                for e in self.snapshot().values())
+        if not slice_blocked:
+            return
+        # A declared mesh travels with the demand: the defragmenter
+        # must assemble a box REALIZING its axes, not just its volume.
+        mesh_local = None
+        mesh_value = pod.get("metadata", {}).get(
+            "annotations", {}).get(MESH_ANNOTATION, "")
+        if mesh_value:
+            try:
+                mesh_local, _why = local_mesh_for(
+                    parse_mesh(mesh_value), chips)
+            except ValueError:
+                mesh_local = None
+        self.defrag.observe_rejection(
+            self._reservation_key(pod), pod_namespace(pod),
+            pod_name(pod), chips,
+            count=gang[1] if gang is not None else 1,
+            mesh=mesh_local)
 
     def _request_preemptions(self, pod: dict, plan: "PreemptionPlan") -> None:
         """Annotate the plan's victims (apiserver writes, so outside the
@@ -988,6 +1133,12 @@ class Scheduler:
         hold = self.quota.gate(pod, requests)
         if hold is not None:
             return FilterResult(error=hold)
+
+        # Compaction beneficiary: chips the defragmenter assembled for
+        # THIS pod/gang rejoin the snapshot before the decision, so the
+        # slice-aware fit lands on the freed box (the "pin" — it is the
+        # only contiguous run large enough).
+        self._release_reservation_for(pod)
 
         gang = gang_of(pod)
         if gang is not None:
@@ -1348,6 +1499,7 @@ class Scheduler:
         instance leaks its pool threads until exit."""
         self.rescuer.stop()
         self.admission.stop()
+        self.defrag.stop()
         with self._pool_lock:
             pool, self._pool = self._pool, None
             self._pool_unavailable = False

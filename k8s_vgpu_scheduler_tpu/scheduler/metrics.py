@@ -24,6 +24,13 @@ from .core import Scheduler
 class ClusterCollector(Collector):
     def __init__(self, scheduler: Scheduler) -> None:
         self.scheduler = scheduler
+        # Per-node slice-availability memo keyed on snapshot-entry
+        # IDENTITY (entries are immutable and replaced exactly when a
+        # node's generation moves): contiguous-box searches are the one
+        # expensive reduction in this collector, and an unchanged fleet
+        # must scrape for free.  Scrapes are serialized per registry,
+        # so plain dict swap is safe.
+        self._frag_cache: Dict[str, tuple] = {}
 
     def collect(self) -> Iterable[GaugeMetricFamily]:
         mem_limit = GaugeMetricFamily(
@@ -252,6 +259,106 @@ class ClusterCollector(Collector):
         else:
             q_reclaims.add_metric([], 0)
 
+        # Placement subsystem (placement/; docs/placement.md).  All
+        # families emitted even when defrag is off / the fleet has no
+        # topology (zero-valued) so dashboards never reference a
+        # vanishing series.  Guarded getattr: collector test stubs
+        # predate the placement surface.
+        slice_avail = GaugeMetricFamily(
+            "vtpu_slice_availability",
+            "Disjoint contiguous free boxes of one slice size (chips) "
+            "admissible fleet-wide right now without any eviction — "
+            "the fragmentation number large gangs live and die by",
+            labels=["shape"],
+        )
+        max_box = GaugeMetricFamily(
+            "vtpu_fleet_max_free_box",
+            "Largest contiguous free box in the fleet (chips): the "
+            "biggest slice/mesh grant that can admit without the "
+            "defragmenter compacting",
+        )
+        reserved = GaugeMetricFamily(
+            "vtpu_reserved_chips",
+            "Chips held in slice reservations (a defrag compaction's "
+            "assembled box awaiting its beneficiary; excluded from the "
+            "schedulable set and the quota release throttle)",
+        )
+        defrag_plans = CounterMetricFamily(
+            "vtpu_defrag_plans",
+            "Compaction plans issued by the defragmenter (each migrates "
+            "checkpointable victims to assemble a contiguous slice)",
+        )
+        defrag_migrations = CounterMetricFamily(
+            "vtpu_defrag_migrations",
+            "Victims asked to checkpoint-migrate by defrag plans (each "
+            "one is a checkpoint/restore cycle imposed on a workload)",
+        )
+        defrag_completed = CounterMetricFamily(
+            "vtpu_defrag_completed",
+            "Compaction plans whose victims all checkpointed and "
+            "exited (the assembled slice went to reservation)",
+        )
+        defrag_aborted = CounterMetricFamily(
+            "vtpu_defrag_aborted",
+            "Compaction plans aborted (a victim missed the checkpoint "
+            "grace; requests rescinded, reservation returned)",
+        )
+        snap_fn = getattr(self.scheduler, "snapshot", None)
+        if snap_fn is not None:
+            from ..placement import frag as frag_mod
+
+            totals = {n: 0 for n in frag_mod.CANONICAL_SIZES}
+            biggest = 0
+            fresh: Dict[str, tuple] = {}
+            snap = snap_fn()
+            for name in sorted(snap):
+                entry = snap[name]
+                cached = self._frag_cache.get(name)
+                if cached is not None and cached[0] is entry:
+                    stats = cached[1]
+                else:
+                    view = frag_mod.node_free_view(name, entry)
+                    stats = None if view is None else (
+                        view.max_box,
+                        frag_mod.box_availability(
+                            view.topo, frozenset(view.free),
+                            frag_mod.CANONICAL_SIZES))
+                fresh[name] = (entry, stats)
+                if stats is not None:
+                    biggest = max(biggest, stats[0])
+                    for size, count in stats[1].items():
+                        totals[size] += count
+            self._frag_cache = fresh
+            for size, count in sorted(totals.items()):
+                slice_avail.add_metric([str(size)], count)
+            max_box.add_metric([], biggest)
+        reservations = getattr(self.scheduler, "reservations", None)
+        reserved.add_metric(
+            [], reservations.total_chips() if reservations else 0)
+        defrag = getattr(self.scheduler, "defrag", None)
+        defrag_plans.add_metric(
+            [], defrag.plans_total if defrag else 0)
+        defrag_migrations.add_metric(
+            [], defrag.migrations_total if defrag else 0)
+        defrag_completed.add_metric(
+            [], defrag.completed_total if defrag else 0)
+        defrag_aborted.add_metric(
+            [], defrag.aborted_total if defrag else 0)
+
+        batch_fallbacks = CounterMetricFamily(
+            "vtpu_filter_batch_fallbacks",
+            "Batched-cycle jobs resolved via the per-pod path, by cause "
+            "(slice-no-fit: the in-cycle slice stage found no box; "
+            "no-fit: the joint solver found no node; commit-conflict: "
+            "lost a revision race in the group commit; error: cycle-"
+            "internal failure)",
+            labels=["reason"],
+        )
+        if engine is not None:
+            for reason, n in sorted(
+                    engine.stats.fallback_reason_counts().items()):
+                batch_fallbacks.add_metric([reason], n)
+
         fleet = self.scheduler.grant_efficiency()
         by_uid = {p.uid: p for p in fleet.pods}
         # Aggregate by label pair BEFORE emitting: two retained accounts
@@ -282,10 +389,12 @@ class ClusterCollector(Collector):
 
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
                 pod_mem, pod_cores, preempts, conflicts, batch_size,
-                batch_lat, pool_size, busy_peak, lease_state,
-                leases_unhealthy, chips_quar, quarantines, rescued,
-                q_pending, q_admitted, q_share, q_borrowed, q_reclaims,
-                u_chip, u_hbm, eff_ratio,
+                batch_lat, batch_fallbacks, pool_size, busy_peak,
+                lease_state, leases_unhealthy, chips_quar, quarantines,
+                rescued, q_pending, q_admitted, q_share, q_borrowed,
+                q_reclaims, slice_avail, max_box, reserved,
+                defrag_plans, defrag_migrations, defrag_completed,
+                defrag_aborted, u_chip, u_hbm, eff_ratio,
                 idle_grants] + list(phase_metrics())
 
 

@@ -160,7 +160,13 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path == "/bind":
                 self._reply(200, bind_endpoint(self.scheduler, body))
             elif self.path == "/webhook":
-                self._reply(200, handle_admission_review(body, self.cfg))
+                # The live registry's topologies back the mesh
+                # annotation's fleet-feasibility validation (deferred
+                # callable: the registry is read only for pods that
+                # actually declare a mesh).
+                self._reply(200, handle_admission_review(
+                    body, self.cfg,
+                    topologies=self.scheduler.known_topologies))
             else:
                 self._reply(404, {"error": "not found"})
         except Exception as e:  # noqa: BLE001 — extender must answer, not die

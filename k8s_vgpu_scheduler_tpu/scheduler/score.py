@@ -27,6 +27,12 @@ import dataclasses
 import logging
 from typing import Dict, List, Optional, Tuple
 
+from ..placement.mesh import (
+    MESH_ANNOTATION,
+    find_mesh_slice,
+    local_mesh_for,
+    parse_mesh,
+)
 from ..topology import find_slice
 from ..tpulib.types import TopologyDesc
 from ..util.types import (
@@ -329,7 +335,16 @@ def fit_container(
         return None
 
     chosen: Optional[List[DeviceUsage]] = None
-    if topo is not None and req.nums > 1:
+    mesh_value = annotations.get(MESH_ANNOTATION, "")
+    if mesh_value and req.nums > 1:
+        # Mesh-declared placement (placement/mesh.py): the pod asked for
+        # axis STRUCTURE, not just contiguous chips — the grant must be
+        # a physical box realizing its ICI-local mesh, under every
+        # policy (a mesh is a contract; there is no scattered fallback).
+        chosen = _fit_mesh(req, eligible, topo, mesh_value, reasons)
+        if chosen is None:
+            return None
+    elif topo is not None and req.nums > 1:
         # Slice placement needs trustworthy coords: unique and present on
         # every eligible chip.  Agents that don't report coords fall through
         # to plain selection (and can't promise contiguity).
@@ -373,6 +388,48 @@ def fit_container(
             )
         )
     return grants
+
+
+def _fit_mesh(
+    req: ContainerDeviceRequest,
+    eligible: List[DeviceUsage],
+    topo: Optional[TopologyDesc],
+    mesh_value: str,
+    reasons: Optional[Dict[str, str]],
+) -> Optional[List[DeviceUsage]]:
+    """Choose chips for a ``vtpu.dev/mesh`` request: a physical box
+    realizing the pod's ICI-local mesh, placed fragmentation-aware
+    (placement/mesh.find_mesh_slice).  Returns the chosen chips or None
+    with a reject reason.  The webhook validates the annotation at
+    admission; re-deriving here keeps embedders/simulator callers (no
+    webhook in the path) honest rather than silently degrading a
+    malformed mesh to scatter."""
+    def reject(token: str, detail: str):
+        if reasons is not None:
+            reasons["reason"] = f"{token}: {detail}"
+        return None
+
+    try:
+        mesh = parse_mesh(mesh_value)
+    except ValueError as e:
+        return reject("bad-mesh", str(e))
+    local, why = local_mesh_for(mesh, req.nums)
+    if local is None:
+        return reject("bad-mesh", why)
+    if topo is None:
+        return reject("topology-unverifiable",
+                      "mesh declared but node advertises no ICI topology")
+    coord_map = {u.coords: u for u in eligible if u.coords != ()}
+    if len(coord_map) != len(eligible):
+        return reject("topology-unverifiable",
+                      "mesh declared but chip coords missing")
+    coords = find_mesh_slice(topo, coord_map.keys(), local)
+    if coords is None:
+        return reject(
+            "no-mesh-slice",
+            f"no free box realizes local mesh "
+            f"{'x'.join(map(str, local))} ({req.nums} chips)")
+    return [coord_map[c] for c in coords]
 
 
 def fit_pod(

@@ -28,6 +28,7 @@ import json
 import logging
 from typing import List, Optional
 
+from ..placement.mesh import MESH_ANNOTATION, validate_mesh
 from ..quota.queues import (
     QUEUE_ANNOTATION,
     QUEUE_STATE_ANNOTATION,
@@ -224,17 +225,70 @@ def _podinfo_patches(pod: dict, container_idxs: List[int],
     return patches
 
 
-def handle_admission_review(body: dict, cfg: Config) -> dict:
-    """AdmissionReview in → AdmissionReview out (always allowed; mutation is
-    advisory — failurePolicy decides what a webhook outage means).  Only
-    TPU-requesting pods get a trace id + webhook span: the webhook sees
-    every pod CREATE cluster-wide, and tracing them all would let
-    ordinary churn evict the scheduling traces the ring exists to keep."""
+def validate_pod_mesh(pod: dict, cfg: Config,
+                      topologies=None) -> Optional[str]:
+    """Admission-time ``vtpu.dev/mesh`` validation: the shape parses,
+    its volume matches the requested chips (× gang members, with axis 0
+    dividing across them), and the per-pod local mesh is realizable on
+    at least one node topology in the fleet.  Returns the user-facing
+    rejection message, or None.  ``topologies`` is an iterable of
+    TopologyDesc or a zero-arg callable yielding them (the serving
+    layer passes the live registry's; None/empty skips the fleet-fit
+    check — validation must not reject the first pod of a cold-booting
+    cluster)."""
+    from .gang import gang_of
+
+    anns = pod.get("metadata", {}).get("annotations") or {}
+    mesh_value = anns.get(MESH_ANNOTATION, "")
+    if not mesh_value:
+        return None
+    try:
+        requests = container_requests(pod, cfg)
+    except ValueError as e:
+        return (f"{MESH_ANNOTATION} {mesh_value!r}: cannot validate "
+                f"against unparseable resources: {e}")
+    nums = max((r.nums for r in requests), default=0)
+    gang = gang_of(pod)
+    gang_total = gang[1] if gang is not None else 1
+    topos = list(topologies() if callable(topologies)
+                 else (topologies or ()))
+    why = validate_mesh(mesh_value, nums, gang_total, topos)
+    if why is None:
+        return None
+    return f"{MESH_ANNOTATION}: {why}"
+
+
+def handle_admission_review(body: dict, cfg: Config,
+                            topologies=None) -> dict:
+    """AdmissionReview in → AdmissionReview out.  Mutation is advisory
+    (failurePolicy decides what a webhook outage means), but a pod
+    declaring an INVALID ``vtpu.dev/mesh`` is rejected outright — it
+    could never place, and admitting it would park an unschedulable pod
+    whose rejection reason lives in scheduler logs instead of the
+    kubectl error the user actually sees.  Only TPU-requesting pods get
+    a trace id + webhook span: the webhook sees every pod CREATE
+    cluster-wide, and tracing them all would let ordinary churn evict
+    the scheduling traces the ring exists to keep."""
     req = body.get("request", {})
     uid = req.get("uid", "")
     response = {"uid": uid, "allowed": True}
     pod = req.get("object")
     if isinstance(pod, dict) and req.get("operation", "CREATE") == "CREATE":
+        why = validate_pod_mesh(pod, cfg, topologies)
+        if why is not None:
+            meta = pod.get("metadata", {})
+            log.warning("webhook: rejecting pod %s: %s",
+                        meta.get("name", "?"), why)
+            return {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "response": {
+                    "uid": uid,
+                    "allowed": False,
+                    "status": {"code": 422, "reason": "Invalid",
+                               "message": why},
+                },
+            }
         trace_id = trace.trace_id_of(pod) or trace.new_trace_id()
         info: dict = {}
         # The span is registered only if mutate_pod says the pod is ours

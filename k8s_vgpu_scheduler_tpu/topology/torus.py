@@ -40,7 +40,12 @@ def factor_shapes(n: int, mesh: Sequence[int]) -> List[Tuple[int, ...]]:
 
     if n >= 1:
         rec((), n, 0)
-    return sorted(shapes, key=_surface_area)
+    # Tie-break equal-surface-area shapes by the shape tuple itself: the
+    # candidate set comes out of a set(), and set iteration order is an
+    # implementation detail — an unpinned tie would let two Python
+    # builds (or two scheduler replicas) enumerate, and therefore PLACE,
+    # differently on identical fleets.
+    return sorted(shapes, key=lambda s: (_surface_area(s), s))
 
 
 def _surface_area(shape: Tuple[int, ...]) -> int:

@@ -168,6 +168,30 @@ class Config:
     # fleets — quota/admission.py AdmissionConfig.fleet_headroom).
     queue_fleet_headroom: float = 1.0
 
+    # Placement subsystem (placement/; docs/placement.md).  The
+    # defragmenter compacts fragmented nodes by checkpoint-migrating
+    # movable pods so blocked large slice/mesh demands can admit.  Off
+    # by default — migration imposes checkpoint/restore cycles, so the
+    # operator opts in (--enable-defrag); the mesh-aware fit, the
+    # demand registry and the slice-availability metrics are always on.
+    enable_defrag: bool = False
+    # Background compaction-loop period (cmd/scheduler --defrag-interval).
+    defrag_interval_s: float = 10.0
+    # A demand with no fresh slice rejection for this long is forgotten
+    # (the pod stopped retrying: placed, deleted, or gave up).
+    defrag_demand_fresh_s: float = 120.0
+    # How long an asked migration victim gets to checkpoint and exit
+    # before the plan aborts and its reservation is returned.
+    defrag_checkpoint_grace_s: float = 120.0
+    # How long an assembled (reserved) slice waits for its beneficiary.
+    defrag_reservation_ttl_s: float = 300.0
+    # Only pods at this priority or lower (numerically >=) are movable —
+    # priority >= 1 is the tier the webhook wires the checkpoint watch
+    # into (docs/preemption.md).
+    defrag_min_victim_priority: int = 1
+    # A plan asking more victims than this is not "minimal compaction".
+    defrag_max_victims: int = 8
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

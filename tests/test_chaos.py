@@ -205,6 +205,127 @@ class TestCheckpointedRescueTrajectory:
         s.close()
 
 
+class TestDefragMigrationTrajectory:
+    def test_migrated_victim_resumes_bit_identically(self):
+        """ISSUE 8 acceptance: a defrag compaction's migration is the
+        SAME lossless checkpoint-first eviction the rescue path proved —
+        the victim checkpoints at a step boundary when the compaction
+        asks, exits, re-places on the remaining capacity, resumes, and
+        its final parameters are bit-identical to an uninterrupted
+        run, while the blocked 2-chip demand lands on the assembled
+        contiguous box."""
+        import tempfile
+
+        import jax
+        import numpy as np
+
+        from k8s_vgpu_scheduler_tpu.models.checkpoint import (
+            CheckpointManager)
+        from k8s_vgpu_scheduler_tpu.models.llama import llama_tiny
+        from k8s_vgpu_scheduler_tpu.models.train import (
+            init_sharded_state, jit_train_step, run_preemptible)
+        from k8s_vgpu_scheduler_tpu.parallel.mesh import (
+            MeshShape, make_mesh)
+
+        clock = SimClock()
+        kube, s, names, clock = make_env(
+            n_nodes=2, chips=2, clock=clock, enable_defrag=True,
+            topology_policy="guaranteed")
+
+        def exclusive(name, uid, nums="1", prio=None):
+            p = tpu_pod(name, uid=uid, mem="4000", nums=nums,
+                        cores="100")
+            if prio is not None:
+                p["spec"]["containers"][0]["resources"]["limits"][
+                    "vtpu.dev/task-priority"] = str(prio)
+            return p
+
+        # node-0: the (movable, priority-1) training victim.
+        # node-1: a pinned priority-0 resident.  Both nodes' largest
+        # free box is 1 chip — a contiguous 2-chip demand is blocked
+        # everywhere until defrag migrates the victim.
+        train = exclusive("train", "u-train", prio=1)
+        r = place(kube, s, train, [names[0]])
+        assert r.node == names[0]
+        pinned = exclusive("pinned", "u-pin", prio=0)
+        assert place(kube, s, pinned, [names[1]]).node == names[1]
+
+        big = exclusive("big", "u-big", nums="2")
+        kube.create_pod(big)
+
+        def migration_requested():
+            anns = kube.get_pod(
+                "default", "train")["metadata"]["annotations"]
+            return anns.get(PREEMPT_ANNOTATION, "").startswith(
+                "rescue:defrag:")
+
+        # -- the "in-container" side (identical to the rescue test) ---
+        cfg = dataclasses.replace(llama_tiny(), dtype="float32")
+        mesh = make_mesh(MeshShape(1, 1, 1), devices=jax.devices()[:1])
+        batch, seq, n_steps = 2, 32, 6
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab)
+
+        def fresh():
+            model, opt, state, _ = init_sharded_state(
+                cfg, mesh, jax.random.PRNGKey(0), batch=batch, seq=seq)
+            return jit_train_step(model, opt, mesh, state), state
+
+        step, state = fresh()
+        with tempfile.TemporaryDirectory() as d:
+            ref, done, preempted = run_preemptible(
+                step, state, tokens, n_steps, CheckpointManager(d),
+                lambda: False)
+        assert (done, preempted) == (n_steps, False)
+
+        calls = {"n": 0}
+
+        def stop_check():
+            calls["n"] += 1
+            if calls["n"] == 4:                  # after 3 clean steps
+                assert s.filter(big, names).node is None
+                actions = s.defrag.tick()
+                assert any(a["kind"] == "defrag-plan"
+                           for a in actions), actions
+                assert migration_requested()
+            return migration_requested()
+
+        with tempfile.TemporaryDirectory() as d:
+            ckpt = CheckpointManager(d)
+            step2, state2 = fresh()
+            mid, done, preempted = run_preemptible(
+                step2, state2, tokens, n_steps, ckpt, stop_check)
+            assert preempted is True and done == 3
+            assert_no_overallocation(s)
+
+            # The victim exits at the step boundary; the compaction
+            # completes and the assembled box goes to reservation.
+            kube.delete_pod("default", "train")
+            clock.advance(5.0)
+            s.defrag.tick()
+            assert s.reservations.total_chips() == 2
+
+            # The blocked demand lands on the assembled contiguous box.
+            rb = s.filter(big, names)
+            assert rb.node == names[0], (rb.error, rb.failed)
+            assert_no_overallocation(s)
+
+            # The controller's replacement re-places on the remaining
+            # capacity and resumes from the checkpoint.
+            train_r = exclusive("train-r", "u-train-r", prio=1)
+            r2 = place(kube, s, train_r, names)
+            assert r2.node == names[1]
+            step3, state3 = fresh()
+            res, done, preempted = run_preemptible(
+                step3, state3, tokens, n_steps, ckpt, lambda: False)
+            assert (done, preempted) == (n_steps, False)
+
+        for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                        jax.tree_util.tree_leaves(res.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        s.close()
+
+
 class TestPartitionRecovery:
     def test_partition_heal_before_death_changes_nothing(self):
         """A partition shorter than the lease deadline is a non-event:

@@ -1,0 +1,598 @@
+"""Defragmenter tests (placement/defrag.py; ISSUE 8).
+
+The planner is pure, so its guarantees are pinned as seeded-random
+property tests (hypothesis-free — they must run in tier-1 everywhere):
+
+- victims are always checkpointable (priority >= the preemptible tier)
+  and never protected (gang members, rescuer queue, in-flight
+  evictions) — the no-double-evict / no-deadlock-with-quota-reclaim
+  contract;
+- a plan's predicted post-migration largest contiguous box is at least
+  the demand AND strictly larger than the node's current one (no move
+  that frees nothing new);
+- plans are deterministic (same inputs → same plan).
+
+The loop tests drive the real Defragmenter on a SimClock through the
+full lifecycle: demand → plan → checkpoint-first eviction → reservation
+→ pinned beneficiary placement, plus the abort and readiness edges.
+"""
+
+import random
+
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.placement import plan_compaction
+from k8s_vgpu_scheduler_tpu.placement.mesh import max_free_box_volume
+from k8s_vgpu_scheduler_tpu.scheduler import (
+    DeviceInfo,
+    NodeInfo,
+    Scheduler,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.core import SnapEntry
+from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+from k8s_vgpu_scheduler_tpu.scheduler.preempt import PREEMPT_ANNOTATION
+from k8s_vgpu_scheduler_tpu.scheduler.score import DeviceUsage
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+from tests.test_scheduler_concurrency import assert_no_overallocation
+
+
+# -- pure-planner property harness --------------------------------------------
+
+def random_node(rng, name, mesh=(4, 2)):
+    """One node's snapshot entry + resident pods: every chip either
+    free, or held by a single exclusive pod of random priority."""
+    topo = TopologyDesc(generation="v5e", mesh=mesh)
+    usage = {}
+    pods = []
+    n = mesh[0] * mesh[1]
+    for i in range(n):
+        cid = f"{name}-chip-{i}"
+        coords = (i % mesh[0], i // mesh[0])
+        state = rng.choice(["free", "movable", "pinned", "gang"])
+        used = state != "free"
+        usage[cid] = DeviceUsage(
+            id=cid, type="v5e", health=True, coords=coords,
+            total_slots=10, used_slots=1 if used else 0,
+            total_mem=16384, used_mem=4000 if used else 0,
+            total_cores=100, used_cores=100 if used else 0)
+        if used:
+            prio = {"movable": rng.choice([1, 2, 3]),
+                    "pinned": 0, "gang": 1}[state]
+            pods.append((state, PodInfo(
+                uid=f"u-{cid}", name=f"p-{cid}", namespace="default",
+                node=name, priority=prio,
+                devices=[[ContainerDevice(uuid=cid, type="v5e",
+                                          usedmem=4000,
+                                          usedcores=100)]])))
+    info = NodeInfo(name=name, devices=[
+        DeviceInfo(id=cid, count=10, devmem=16384, type="v5e",
+                   health=True, coords=u.coords)
+        for cid, u in usage.items()], topology=topo)
+    entry = SnapEntry(key=(0, 0), info=info, usage=usage)
+    return entry, pods
+
+
+def random_fleet(rng, n_nodes=3):
+    snapshot = {}
+    pods_by_node = {}
+    protected = set()
+    priorities = {}
+    for i in range(n_nodes):
+        name = f"n{i}"
+        entry, pods = random_node(rng, name)
+        snapshot[name] = entry
+        pods_by_node[name] = [p for _state, p in pods]
+        for state, p in pods:
+            priorities[p.uid] = p.priority
+            if state == "gang":
+                protected.add(p.uid)
+    return snapshot, pods_by_node, protected, priorities
+
+
+class TestPlannerProperties:
+    def test_never_evicts_protected_or_pinned(self):
+        for seed in range(40):
+            rng = random.Random(seed)
+            snapshot, pods_by_node, protected, priorities = \
+                random_fleet(rng)
+            demand = rng.choice([2, 4, 8])
+            plan = plan_compaction(
+                demand, snapshot, pods_by_node,
+                protected_uids=protected, min_victim_priority=1)
+            if plan is None:
+                continue
+            for v in plan.victims:
+                assert v.uid not in protected, seed
+                assert priorities[v.uid] >= 1, seed
+
+    def test_strict_improvement_and_demand_reached(self):
+        for seed in range(40):
+            rng = random.Random(seed)
+            snapshot, pods_by_node, protected, _prio = random_fleet(rng)
+            demand = rng.choice([2, 4, 8])
+            plan = plan_compaction(
+                demand, snapshot, pods_by_node,
+                protected_uids=protected, min_victim_priority=1)
+            if plan is None:
+                continue
+            assert plan.max_box_after >= demand, seed
+            assert plan.max_box_after > plan.max_box_before, seed
+            assert plan.victims, seed
+            # Recompute the prediction independently: evict the victims
+            # and measure.
+            entry = snapshot[plan.node]
+            victim_uids = {v.uid for v in plan.victims}
+            remaining = [p for p in pods_by_node[plan.node]
+                         if p.uid not in victim_uids]
+            held = {d.uuid for p in remaining
+                    for c in p.devices for d in c}
+            free = frozenset(
+                u.coords for cid, u in entry.usage.items()
+                if cid not in held)
+            got = max_free_box_volume(entry.info.topology, free)
+            assert got == plan.max_box_after, seed
+
+    def test_deterministic(self):
+        for seed in range(10):
+            rng1, rng2 = random.Random(seed), random.Random(seed)
+            f1 = random_fleet(rng1)
+            f2 = random_fleet(rng2)
+            p1 = plan_compaction(4, f1[0], f1[1], protected_uids=f1[2])
+            p2 = plan_compaction(4, f2[0], f2[1], protected_uids=f2[2])
+            if p1 is None:
+                assert p2 is None
+                continue
+            assert (p1.node, sorted(p1.box), [v.uid for v in p1.victims]) \
+                == (p2.node, sorted(p2.box), [v.uid for v in p2.victims])
+
+    def test_unattributed_used_chip_does_not_crash_planner(self):
+        """Review regression: a used-but-unattributed chip (unhealthy
+        idle, or usage reported ahead of the pod cache) inside the
+        vacated-set sweep must not raise — and never counts as
+        vacatable."""
+        usage = {}
+        pods = []
+        for i in range(8):
+            cid = f"n0-chip-{i}"
+            used = i in (1, 3, 5)
+            usage[cid] = DeviceUsage(
+                id=cid, type="v5e", health=True, coords=(i % 4, i // 4),
+                total_slots=10, used_slots=1 if used else 0,
+                total_mem=16384, used_mem=4000 if used else 0,
+                total_cores=100, used_cores=100 if used else 0)
+        # chip-1/chip-3 movable; chip-5 used but NO resident attributed.
+        for i in (1, 3):
+            pods.append(PodInfo(
+                uid=f"u{i}", name=f"p{i}", namespace="default",
+                node="n0", priority=1,
+                devices=[[ContainerDevice(uuid=f"n0-chip-{i}",
+                                          type="v5e", usedmem=4000,
+                                          usedcores=100)]]))
+        info = NodeInfo(name="n0", devices=[
+            DeviceInfo(id=cid, count=10, devmem=16384, type="v5e",
+                       health=True, coords=u.coords)
+            for cid, u in usage.items()],
+            topology=TopologyDesc(generation="v5e", mesh=(4, 2)))
+        snapshot = {"n0": SnapEntry(key=(0, 0), info=info, usage=usage)}
+        plan = plan_compaction(6, snapshot, {"n0": pods},
+                               protected_uids=set())
+        if plan is not None:
+            assert "n0-chip-5" not in plan.box.values()
+
+    def test_mesh_shaped_planning(self):
+        """Review regression: a mesh demand's volume may be free as a
+        non-realizing strip — planning must target REALIZING shapes.
+        Free row (4x1) on a 4x2 node; demand mesh 2x2: the plan evicts
+        to assemble a 2x2 even though a 4-box already exists."""
+        usage = {}
+        pods = []
+        for i in range(8):
+            cid = f"n0-chip-{i}"
+            coords = (i % 4, i // 4)
+            used = coords[1] == 1          # row y=1 occupied, y=0 free
+            usage[cid] = DeviceUsage(
+                id=cid, type="v5e", health=True, coords=coords,
+                total_slots=10, used_slots=1 if used else 0,
+                total_mem=16384, used_mem=4000 if used else 0,
+                total_cores=100, used_cores=100 if used else 0)
+            if used:
+                pods.append(PodInfo(
+                    uid=f"u{i}", name=f"p{i}", namespace="default",
+                    node="n0", priority=1,
+                    devices=[[ContainerDevice(uuid=cid, type="v5e",
+                                              usedmem=4000,
+                                              usedcores=100)]]))
+        info = NodeInfo(name="n0", devices=[
+            DeviceInfo(id=cid, count=10, devmem=16384, type="v5e",
+                       health=True, coords=u.coords)
+            for cid, u in usage.items()],
+            topology=TopologyDesc(generation="v5e", mesh=(4, 2)))
+        snapshot = {"n0": SnapEntry(key=(0, 0), info=info, usage=usage)}
+        # Shapeless 4-chip demand: already satisfiable (the free row).
+        assert plan_compaction(4, snapshot, {"n0": pods},
+                               protected_uids=set()) is None
+        # Mesh 2x2 demand: the row cannot realize it — plan fires.
+        plan = plan_compaction(4, snapshot, {"n0": pods},
+                               protected_uids=set(), mesh=(2, 2))
+        assert plan is not None
+        assert len(plan.victims) == 2   # minimal: one 2x2 needs 2 evictions
+
+    def test_cheapest_by_sunk_chip_seconds(self):
+        """Two symmetric compaction options — the ledger cost must pick
+        the victims with the least sunk work."""
+        topo_mesh = (4, 1)
+        # Hand-build: chips 0,3 free; chips 1,2 hold one movable each.
+        usage = {}
+        pods = []
+        for i in range(4):
+            cid = f"n0-chip-{i}"
+            used = i in (1, 2)
+            usage[cid] = DeviceUsage(
+                id=cid, type="v5e", health=True, coords=(i, 0),
+                total_slots=10, used_slots=1 if used else 0,
+                total_mem=16384, used_mem=4000 if used else 0,
+                total_cores=100, used_cores=100 if used else 0)
+            if used:
+                pods.append(PodInfo(
+                    uid=f"u{i}", name=f"p{i}", namespace="default",
+                    node="n0", priority=1,
+                    devices=[[ContainerDevice(uuid=cid, type="v5e",
+                                              usedmem=4000,
+                                              usedcores=100)]]))
+        info = NodeInfo(name="n0", devices=[
+            DeviceInfo(id=cid, count=10, devmem=16384, type="v5e",
+                       health=True, coords=u.coords)
+            for cid, u in usage.items()],
+            topology=TopologyDesc(generation="v5e", mesh=topo_mesh))
+        snapshot = {"n0": SnapEntry(key=(0, 0), info=info, usage=usage)}
+        sunk = {"u1": 500.0, "u2": 10.0}
+        plan = plan_compaction(
+            2, snapshot, {"n0": pods}, protected_uids=set(),
+            chip_seconds_of=lambda uid: sunk[uid])
+        assert plan is not None
+        # Freeing chip 2 joins chip 3 → a 2-box at cost 10; freeing
+        # chip 1 joins chip 0 at cost 500.
+        assert [v.uid for v in plan.victims] == ["u2"]
+
+
+# -- loop lifecycle over the real scheduler -----------------------------------
+
+def defrag_env(n_nodes=1, mesh=(4, 2), **cfg):
+    clock = SimClock()
+    kube = FakeKube()
+    cfg.setdefault("enable_defrag", True)
+    # Contiguity demanded: best-effort would scatter the big request
+    # over the checkerboard and nothing would ever block.
+    cfg.setdefault("topology_policy", "guaranteed")
+    s = Scheduler(kube, Config(**cfg), clock=clock)
+    names = [f"node-{i}" for i in range(n_nodes)]
+    for name in names:
+        kube.add_node({"metadata": {"name": name, "annotations": {}}})
+        n = mesh[0] * mesh[1]
+        devices = [DeviceInfo(id=f"{name}-chip-{i}", count=10,
+                              devmem=16384, type="TPU-v5e", health=True,
+                              coords=(i % mesh[0], i // mesh[0]))
+                   for i in range(n)]
+        s.nodes.add_node(name, NodeInfo(
+            name=name, devices=devices,
+            topology=TopologyDesc(generation="v5e", mesh=mesh)))
+    kube.watch_pods(s.on_pod_event)
+    return kube, s, names, clock
+
+
+def exclusive_pod(name, uid, tpu=1, prio=None, anns=None):
+    limits = {"google.com/tpu": str(tpu), "google.com/tpumem": "4000",
+              "google.com/tpucores": "100"}
+    if prio is not None:
+        limits["vtpu.dev/task-priority"] = str(prio)
+    return {"metadata": {"name": name, "namespace": "default",
+                         "uid": uid, "annotations": dict(anns or {})},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "limits": limits}}]}}
+
+
+def fragment(kube, s, node, prio=1):
+    """Fill with exclusive singles, free the even checkerboard."""
+    info = s.nodes.get_node(node)
+    for i, _d in enumerate(info.devices):
+        p = exclusive_pod(f"churn-{i}", f"uc{i}", prio=prio)
+        kube.create_pod(p)
+        r = s.filter(p, [node])
+        assert r.node == node, (r.error, r.failed)
+    for i, d in enumerate(info.devices):
+        if sum(d.coords) % 2 == 0:
+            kube.delete_pod("default", f"churn-{i}")
+
+
+class TestDefragLoop:
+    def test_full_lifecycle_checkpoint_first(self):
+        kube, s, names, clock = defrag_env()
+        fragment(kube, s, names[0])
+        big = exclusive_pod("big", "ubig", tpu=4)
+        kube.create_pod(big)
+        assert s.filter(big, names).node is None
+        assert s.defrag.pending_demand()[0].chips == 4
+
+        actions = s.defrag.tick()
+        assert [a["kind"] for a in actions] == ["defrag-plan"]
+        flagged = [p for p in kube.list_pods()
+                   if p["metadata"]["annotations"].get(PREEMPT_ANNOTATION,
+                                                       "").startswith("rescue:defrag:")]
+        assert flagged
+        # Checkpoint-first: the flag precedes any teardown; victims are
+        # still granted until they exit on their own.
+        for p in flagged:
+            assert s.pods.get(p["metadata"]["uid"]) is not None
+        for p in flagged:
+            kube.delete_pod("default", p["metadata"]["name"])
+        clock.advance(5.0)
+        actions = s.defrag.tick()
+        assert [a["kind"] for a in actions] == ["defrag-complete"]
+        assert s.reservations.total_chips() == 4
+
+        r = s.filter(big, names)
+        assert r.node == names[0], (r.error, r.failed)
+        assert s.reservations.total_chips() == 0
+        assert_no_overallocation(s)
+        assert s.defrag.pending_demand() == []
+        s.close()
+
+    def test_resource_blocked_pod_records_no_demand(self):
+        """Review regression: a multi-chip pod blocked by RESOURCES
+        (HBM beyond any chip) is not fragmentation demand — compaction
+        cannot mint HBM, and migrating workloads for it would waste
+        checkpoints."""
+        kube, s, names, clock = defrag_env()
+        fragment(kube, s, names[0])
+        p = {"metadata": {"name": "fat", "namespace": "default",
+                          "uid": "ufat", "annotations": {}},
+             "spec": {"containers": [{"name": "c", "resources": {
+                 "limits": {"google.com/tpu": "2",
+                            "google.com/tpumem": "99999"}}}]}}
+        kube.create_pod(p)
+        assert s.filter(p, names).node is None
+        assert s.defrag.pending_demand() == []
+        assert s.defrag.tick() == []
+        s.close()
+
+    def test_unmovable_fleet_plans_nothing(self):
+        # Priority 0 residents: checkpointable tier never reached.
+        kube, s, names, clock = defrag_env()
+        fragment(kube, s, names[0], prio=0)
+        big = exclusive_pod("big", "ubig", tpu=4)
+        kube.create_pod(big)
+        assert s.filter(big, names).node is None
+        assert s.defrag.tick() == []
+        assert s.defrag.plans_total == 0
+        s.close()
+
+    def test_no_deadlock_with_reclaim_in_flight(self):
+        """A victim already carrying an in-flight eviction (quota
+        reclaim / priority preemption wrote _preempt_requested) is
+        protected — defrag never stacks a second checkpoint request on
+        it (and its own victims enter the same ledger, so reclaim
+        reciprocates)."""
+        kube, s, names, clock = defrag_env()
+        fragment(kube, s, names[0])
+        occupied = [u for u in ("uc1", "uc3", "uc4", "uc6")
+                    if s.pods.get(u) is not None]
+        with s._preempt_lock:
+            for uid in occupied:
+                s._preempt_requested[uid] = clock()
+        big = exclusive_pod("big", "ubig", tpu=4)
+        kube.create_pod(big)
+        assert s.filter(big, names).node is None
+        assert s.defrag.tick() == []   # every movable chip is in flight
+        # Clear the in-flight set: planning resumes.
+        with s._preempt_lock:
+            s._preempt_requested.clear()
+        actions = s.defrag.tick()
+        assert [a["kind"] for a in actions] == ["defrag-plan"]
+        # And the defrag victims are now themselves in the ledger —
+        # visible to reclaim's protected set.
+        with s._preempt_lock:
+            assert s._preempt_requested
+        s.close()
+
+    def test_mesh_demand_compacts_past_a_non_realizing_strip(self):
+        """Loop-level mesh-currency check: a free 4x1 row satisfies a
+        plain 4-chip demand but not mesh 2x2 — the loop must plan for
+        the mesh pod and the delivered box must realize it."""
+        kube, s, names, clock = defrag_env(mesh=(4, 2))
+        info = s.nodes.get_node(names[0])
+        for i, _d in enumerate(info.devices):
+            p = exclusive_pod(f"churn-{i}", f"uc{i}", prio=1)
+            kube.create_pod(p)
+            assert s.filter(p, [names[0]]).node == names[0]
+        for i, d in enumerate(info.devices):
+            if d.coords[1] == 0:          # free the y=0 row: a 4x1 strip
+                kube.delete_pod("default", f"churn-{i}")
+        big = exclusive_pod("big", "ubig", tpu=4,
+                            anns={"vtpu.dev/mesh": "2x2"})
+        kube.create_pod(big)
+        r = s.filter(big, names)
+        assert r.node is None
+        assert any(v.startswith("no-mesh-slice")
+                   for v in r.failed.values()), r.failed
+        assert s.defrag.pending_demand()[0].mesh == (2, 2)
+        actions = s.defrag.tick()
+        assert [a["kind"] for a in actions] == ["defrag-plan"], actions
+        _drain_victims(kube, s)
+        clock.advance(5.0)
+        s.defrag.tick()
+        r = s.filter(big, names)
+        assert r.node == names[0], (r.error, r.failed)
+        ids = {d.uuid for c in s.pods.get("ubig").devices for d in c}
+        cs = [tuple(d.coords) for d in info.devices if d.id in ids]
+        assert {len({c[0] for c in cs}), len({c[1] for c in cs})} == {2}
+        s.close()
+
+    def test_abort_keeps_sibling_reservations(self):
+        """Review regression: aborting one plan must return ITS box
+        only — a gang's previously assembled reservations stand."""
+        kube, s, names, clock = defrag_env(
+            n_nodes=2, defrag_checkpoint_grace_s=30.0)
+        for node in names:
+            fragment_node(kube, s, node)
+        members = [
+            exclusive_pod(f"g-{i}", f"ug{i}", tpu=4,
+                          anns={"vtpu.dev/pod-group": "g",
+                                "vtpu.dev/pod-group-total": "2"})
+            for i in range(2)
+        ]
+        for p in members:
+            kube.create_pod(p)
+        for p in members:
+            assert s.filter(p, names).node is None
+        s.defrag.tick()               # plan box 1
+        _drain_victims(kube, s)       # box 1's victims exit cleanly
+        clock.advance(5.0)
+        s.defrag.tick()               # box 1 complete; box 2 planned
+        assert s.reservations.count_for("default/g") == 2
+        # Box 2's victims never exit: the abort must drop exactly one.
+        clock.advance(31.0)
+        actions = s.defrag.tick()
+        assert any(a["kind"] == "defrag-abort" for a in actions), actions
+        assert s.reservations.count_for("default/g") == 1
+        s.close()
+
+    def test_gang_with_preexisting_free_box_delivers(self):
+        """Review regression: a gang needing 2 boxes where 1 is ALREADY
+        free must compact only the missing one, and readiness counts
+        the free box — no stall until reservation TTL."""
+        kube, s, names, clock = defrag_env(n_nodes=2)
+        fragment_node(kube, s, names[0])
+        # node-1: pin (priority-0, unmovable) the y=1 row — exactly ONE
+        # free 4-box (the y=0 strip) remains there.
+        info1 = s.nodes.get_node(names[1])
+        for i, d in enumerate(info1.devices):
+            if d.coords[1] == 1:
+                p = exclusive_pod(f"pin-{i}", f"up{i}", prio=0)
+                kube.create_pod(p)
+                assert s.filter(p, [names[1]]).node == names[1]
+        members = [
+            exclusive_pod(f"g-{i}", f"ug{i}", tpu=4,
+                          anns={"vtpu.dev/pod-group": "g",
+                                "vtpu.dev/pod-group-total": "2"})
+            for i in range(2)
+        ]
+        for p in members:
+            kube.create_pod(p)
+        for p in members:
+            assert s.filter(p, names).node is None
+        actions = s.defrag.tick()          # one compaction on node-0
+        assert [a["kind"] for a in actions] == ["defrag-plan"], actions
+        assert actions[0]["node"] == names[0]
+        _drain_victims(kube, s)
+        clock.advance(5.0)
+        s.defrag.tick()                    # complete; 1 reserved box
+        assert s.reservations.count_for("default/g") == 1
+        # held(1) + free realizing boxes on node-1 (2) >= need(2):
+        # the members' filters release and the gang admits atomically.
+        placed = {}
+        for _ in range(2):
+            for p in members:
+                r = s.filter(p, names)
+                if r.node:
+                    placed[p["metadata"]["uid"]] = r.node
+        assert len(placed) == 2, placed
+        assert_no_overallocation(s)
+        s.close()
+
+    def test_overdue_victim_aborts_and_rescinds(self):
+        kube, s, names, clock = defrag_env(
+            defrag_checkpoint_grace_s=30.0)
+        fragment(kube, s, names[0])
+        big = exclusive_pod("big", "ubig", tpu=4)
+        kube.create_pod(big)
+        assert s.filter(big, names).node is None
+        s.defrag.tick()
+        assert s.reservations.total_chips() == 4
+        clock.advance(31.0)           # victims never exit
+        actions = s.defrag.tick()
+        assert any(a["kind"] == "defrag-abort" for a in actions)
+        assert s.reservations.total_chips() == 0
+        assert s.defrag.aborted_total == 1
+        # Rescission cleared the victims' annotations (empty value).
+        for p in kube.list_pods():
+            assert not p["metadata"]["annotations"].get(
+                PREEMPT_ANNOTATION)
+        s.close()
+
+    def test_gang_release_waits_for_all_boxes(self):
+        """A gang needing two boxes must not release (and lose) its
+        first reservation while the second compaction is in flight."""
+        kube, s, names, clock = defrag_env(n_nodes=2)
+        for node in names:
+            fragment_node(kube, s, node)
+        members = [
+            exclusive_pod(f"g-{i}", f"ug{i}", tpu=4,
+                          anns={"vtpu.dev/pod-group": "g",
+                                "vtpu.dev/pod-group-total": "2"})
+            for i in range(2)
+        ]
+        for p in members:
+            kube.create_pod(p)
+        for p in members:
+            assert s.filter(p, names).node is None
+        d = s.defrag.pending_demand()
+        assert d and d[0].count == 2 and d[0].chips == 4
+        s.defrag.tick()               # plan box 1
+        _drain_victims(kube, s)
+        clock.advance(5.0)
+        s.defrag.tick()               # box 1 complete; box 2 planned
+        assert s.reservations.count_for("default/g") == 2
+        assert s.defrag.in_flight()   # box 2's victims still exiting
+        # Member filters mid-assembly: reservations must SURVIVE (a
+        # release now would let bystanders squat in box 1 while box 2
+        # is still being evicted).
+        for p in members:
+            assert s.filter(p, names).node is None
+        assert s.reservations.count_for("default/g") == 2
+        _drain_victims(kube, s)
+        clock.advance(5.0)
+        s.defrag.tick()               # box 2 complete
+        assert s.reservations.count_for("default/g") == 2
+        assert not s.defrag.in_flight()
+        placed = {}
+        for _ in range(2):
+            for p in members:
+                r = s.filter(p, names)
+                if r.node:
+                    placed[p["metadata"]["uid"]] = r.node
+        assert len(placed) == 2, placed
+        # Each member's stripe is a contiguous box on its node (two
+        # stripes may share a node — the DCN axis is then intra-host).
+        from k8s_vgpu_scheduler_tpu.topology import is_contiguous
+
+        for uid, node in placed.items():
+            info = s.nodes.get_node(node)
+            ids = {d.uuid for c in s.pods.get(uid).devices for d in c}
+            cs = [tuple(d.coords) for d in info.devices if d.id in ids]
+            assert is_contiguous(
+                cs, TopologyDesc(generation="v5e", mesh=(4, 2)))
+        assert_no_overallocation(s)
+        s.close()
+
+
+def fragment_node(kube, s, node):
+    info = s.nodes.get_node(node)
+    for i, _d in enumerate(info.devices):
+        p = exclusive_pod(f"churn-{node}-{i}", f"uc-{node}-{i}", prio=1)
+        kube.create_pod(p)
+        r = s.filter(p, [node])
+        assert r.node == node, (r.error, r.failed)
+    for i, d in enumerate(info.devices):
+        if sum(d.coords) % 2 == 0:
+            kube.delete_pod("default", f"churn-{node}-{i}")
+
+
+def _drain_victims(kube, s):
+    for p in list(kube.list_pods()):
+        if p["metadata"]["annotations"].get(
+                PREEMPT_ANNOTATION, "").startswith("rescue:defrag:"):
+            kube.delete_pod(p["metadata"]["namespace"],
+                            p["metadata"]["name"])

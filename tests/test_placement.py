@@ -1,0 +1,389 @@
+"""Placement subsystem units: mesh mapping (``vtpu.dev/mesh``), the
+fragmentation math, slice reservations, and the webhook's admission-time
+mesh validation (ISSUE 8; docs/placement.md)."""
+
+import itertools
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.placement import (
+    SliceReservations,
+    assign_axes,
+    find_mesh_slice,
+    fleet_views,
+    local_mesh_for,
+    max_free_box_volume,
+    mesh_box_shapes,
+    mesh_fits_topology,
+    parse_mesh,
+    slice_availability,
+    validate_mesh,
+)
+from k8s_vgpu_scheduler_tpu.placement.mesh import MESH_ANNOTATION
+from k8s_vgpu_scheduler_tpu.scheduler import (
+    DeviceInfo,
+    NodeInfo,
+    Scheduler,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.gang import (
+    GANG_GROUP_ANNOTATION,
+    GANG_TOTAL_ANNOTATION,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.webhook import (
+    handle_admission_review,
+    validate_pod_mesh,
+)
+from k8s_vgpu_scheduler_tpu.topology import is_contiguous
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+V5E_4x2 = TopologyDesc(generation="v5e", mesh=(4, 2))
+V5E_4x4 = TopologyDesc(generation="v5e", mesh=(4, 4))
+
+
+def coords(topo):
+    return [tuple(c) for c in
+            itertools.product(*(range(d) for d in topo.mesh))]
+
+
+def mesh_pod(name="m", uid="um", tpu=4, mesh="2x2", gang=None,
+             gang_total=0, cores=None, mem="4000"):
+    limits = {"google.com/tpu": str(tpu), "google.com/tpumem": mem}
+    if cores is not None:
+        limits["google.com/tpucores"] = str(cores)
+    anns = {MESH_ANNOTATION: mesh} if mesh else {}
+    if gang:
+        anns[GANG_GROUP_ANNOTATION] = gang
+        anns[GANG_TOTAL_ANNOTATION] = str(gang_total)
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": anns},
+        "spec": {"containers": [
+            {"name": "main", "resources": {"limits": limits}}]},
+    }
+
+
+class TestMeshParsing:
+    def test_parse(self):
+        assert parse_mesh("2x4") == (2, 4)
+        assert parse_mesh("2X2x2") == (2, 2, 2)
+
+    @pytest.mark.parametrize("bad", ["", "x", "2x", "ax4", "0x4",
+                                     "2x2x2x2x2", "-1x4"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_mesh(bad)
+
+
+class TestAxisAssignment:
+    def test_permutations_and_folding(self):
+        assert assign_axes((2, 4), (4, 2)) == [[1], [0]]
+        assert assign_axes((4,), (2, 2)) == [[0, 1]]   # fold one axis
+        assert assign_axes((2, 4), (2, 4)) == [[0], [1]]
+
+    def test_a_line_cannot_realize_a_2d_mesh(self):
+        # The whole point: 8 contiguous chips on a line have the right
+        # volume for 2x4 but one logical axis would hop at stride 4.
+        assert assign_axes((2, 4), (8, 1)) is None
+        assert mesh_box_shapes((2, 4), (8, 1)) == []
+
+    def test_spare_nontrivial_axis_rejected(self):
+        assert assign_axes((2,), (2, 2)) is None   # volume mismatch
+
+    def test_trivial_axes_attach_anywhere(self):
+        assert assign_axes((1, 8), (4, 2)) is not None
+        assert mesh_fits_topology((1, 8), V5E_4x4)
+
+
+class TestLocalMesh:
+    def test_single_pod_is_whole_mesh(self):
+        assert local_mesh_for((2, 4), 8) == ((2, 4), "")
+
+    def test_gang_splits_axis0_over_dcn(self):
+        # 4x8 mesh, members of 16 chips: 2 members, stripe 2.
+        assert local_mesh_for((4, 8), 16) == ((2, 8), "")
+        # Stripe of 1 drops the DCN axis: ICI-local mesh only.
+        assert local_mesh_for((2, 4), 4) == ((4,), "")
+
+    def test_indivisible_rejected(self):
+        local, why = local_mesh_for((3, 4), 4)   # 3 members? 12/4=3; 3%3=0 ok
+        assert local == (4,)
+        local, why = local_mesh_for((4, 4), 3)   # 16 not divisible by 3
+        assert local is None and "multiple" in why
+        local, why = local_mesh_for((3, 8), 12)  # 2 members, 3 % 2 != 0
+        assert local is None and "axis 0" in why
+
+
+class TestFindMeshSlice:
+    def test_prefers_realizing_box(self):
+        got = find_mesh_slice(V5E_4x4, coords(V5E_4x4), (2, 4))
+        assert got is not None and len(got) == 8
+        assert is_contiguous(got, V5E_4x4)
+        xs = {c[0] for c in got}
+        ys = {c[1] for c in got}
+        assert sorted((len(xs), len(ys))) == [2, 4]
+
+    def test_no_scatter_fallback_ever(self):
+        # Diagonal free set: volume is there, no box — a mesh refuses.
+        free = [(0, 0), (1, 1), (2, 2), (3, 3)]
+        assert find_mesh_slice(V5E_4x4, free, (2, 2)) is None
+
+    def test_fragmentation_aware_position(self):
+        # L-shaped free set: a 4x2 block plus a 2x2 ear.  Carving the
+        # 2x2 out of the middle of the L (origin (0,0)) would shatter
+        # the remainder into two 4-boxes; the frag-aware key places it
+        # so the largest remaining contiguous box stays 8.
+        free = [(x, y) for x in range(4) for y in range(2)] \
+            + [(0, 2), (1, 2), (0, 3), (1, 3)]
+        got = find_mesh_slice(V5E_4x4, free, (2, 2))
+        rest = frozenset(free) - set(got)
+        assert max_free_box_volume(V5E_4x4, rest) == 8
+        assert sorted(got) != [(0, 0), (0, 1), (1, 0), (1, 1)]
+
+
+class TestAvailabilityMath:
+    def test_max_free_box(self):
+        assert max_free_box_volume(V5E_4x2, frozenset(coords(V5E_4x2))) == 8
+        checker = frozenset(c for c in coords(V5E_4x2)
+                            if sum(c) % 2 == 0)
+        assert max_free_box_volume(V5E_4x2, checker) == 1
+        assert max_free_box_volume(V5E_4x2, frozenset()) == 0
+
+    def test_disjoint_box_counts(self):
+        free = frozenset(coords(V5E_4x4))
+        counts = slice_availability(
+            [_view("n", V5E_4x4, free)], [2, 4, 8, 16])
+        assert counts == {2: 8, 4: 4, 8: 2, 16: 1}
+
+
+def _view(name, topo, free):
+    from k8s_vgpu_scheduler_tpu.placement import NodeFreeView
+
+    return NodeFreeView(node=name, topo=topo,
+                        free={c: f"{name}-{i}" for i, c in
+                              enumerate(sorted(free))},
+                        max_box=max_free_box_volume(topo, frozenset(free)))
+
+
+# -- scheduler-integration fixtures -------------------------------------------
+
+def register_mesh_node(s, kube, name, mesh=(4, 2)):
+    kube.add_node({"metadata": {"name": name, "annotations": {}}})
+    n = mesh[0] * mesh[1]
+    devices = [DeviceInfo(id=f"{name}-chip-{i}", count=10, devmem=16384,
+                          type="TPU-v5e", health=True,
+                          coords=(i % mesh[0], i // mesh[0]))
+               for i in range(n)]
+    s.nodes.add_node(name, NodeInfo(
+        name=name, devices=devices,
+        topology=TopologyDesc(generation="v5e", mesh=mesh)))
+
+
+def mesh_env(n_nodes=2, mesh=(4, 2), **cfg_kwargs):
+    clock = SimClock()
+    kube = FakeKube()
+    s = Scheduler(kube, Config(**cfg_kwargs), clock=clock)
+    names = [f"node-{i}" for i in range(n_nodes)]
+    for n in names:
+        register_mesh_node(s, kube, n, mesh)
+    kube.watch_pods(s.on_pod_event)
+    return kube, s, names, clock
+
+
+class TestMeshFilter:
+    def test_mesh_grant_is_a_realizing_box(self):
+        kube, s, names, _ = mesh_env(n_nodes=1)
+        p = mesh_pod(tpu=4, mesh="2x2")
+        kube.create_pod(p)
+        r = s.filter(p, names)
+        assert r.node == names[0], (r.error, r.failed)
+        grant = s.pods.get("um").devices[0]
+        cs = sorted(_grant_coords(s, r.node, grant))
+        assert is_contiguous(cs, V5E_4x2)
+        assert {len({c[0] for c in cs}), len({c[1] for c in cs})} == {2}
+
+    def test_mesh_never_degrades_to_scatter(self):
+        kube, s, names, _ = mesh_env(n_nodes=1)
+        # Occupy a checkerboard with exclusive singles: plenty of chips
+        # free, but no 2x2 box — a best-effort PLAIN request would
+        # scatter; a mesh request must refuse.
+        _fragment_checkerboard(kube, s, names[0])
+        p = mesh_pod(tpu=4, mesh="2x2", cores=100)
+        kube.create_pod(p)
+        r = s.filter(p, names)
+        assert r.node is None
+        assert any(v.startswith("no-mesh-slice")
+                   for v in r.failed.values()), r.failed
+        s.close()
+
+    def test_malformed_mesh_rejects_not_scatters(self):
+        kube, s, names, _ = mesh_env(n_nodes=1)
+        p = mesh_pod(tpu=4, mesh="3x")
+        kube.create_pod(p)
+        r = s.filter(p, names)
+        assert r.node is None
+        assert any(v.startswith("bad-mesh") for v in r.failed.values())
+        s.close()
+
+    def test_gang_mesh_never_spans_slice_boundary(self):
+        """ISSUE 8 acceptance: a 2-member gang declaring mesh 2x4 over
+        two 4x2 hosts — each member's 4-chip ICI-local stripe must be a
+        contiguous box INSIDE one node; only the DCN axis (axis 0)
+        crosses nodes."""
+        kube, s, names, _ = mesh_env(n_nodes=2)
+        members = [
+            mesh_pod(name=f"g-{i}", uid=f"ug-{i}", tpu=4, mesh="2x4",
+                     gang="ring", gang_total=2, cores=100)
+            for i in range(2)
+        ]
+        for p in members:
+            kube.create_pod(p)
+        placed = {}
+        for _ in range(2):                      # co-scheduling barrier
+            for p in members:
+                r = s.filter(p, names)
+                if r.node:
+                    placed[p["metadata"]["uid"]] = r.node
+        assert len(placed) == 2, placed
+        for uid, node in placed.items():
+            grant = s.pods.get(uid).devices[0]
+            cs = sorted(_grant_coords(s, node, grant))
+            assert len(cs) == 4
+            assert is_contiguous(cs, V5E_4x2), (uid, cs)
+        s.close()
+
+
+def _grant_coords(s, node, grant):
+    info = s.nodes.get_node(node)
+    ids = {d.uuid for d in grant}
+    return [tuple(d.coords) for d in info.devices if d.id in ids]
+
+
+def _fragment_checkerboard(kube, s, node):
+    """Fill ``node`` with exclusive singles, then delete the even-parity
+    ones: scattered free chips, max contiguous box 1."""
+    info = s.nodes.get_node(node)
+    for i, d in enumerate(info.devices):
+        p = {
+            "metadata": {"name": f"churn-{node}-{i}",
+                         "namespace": "default",
+                         "uid": f"uc-{node}-{i}", "annotations": {}},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "limits": {"google.com/tpu": "1",
+                           "google.com/tpumem": "4000",
+                           "google.com/tpucores": "100",
+                           "vtpu.dev/task-priority": "1"}}}]},
+        }
+        kube.create_pod(p)
+        r = s.filter(p, [node])
+        assert r.node == node, (r.error, r.failed)
+    for d in info.devices:
+        if sum(d.coords) % 2 == 0:
+            i = info.devices.index(d)
+            kube.delete_pod("default", f"churn-{node}-{i}")
+
+
+class TestReservations:
+    def test_reserved_chips_leave_the_snapshot(self):
+        kube, s, names, _ = mesh_env(n_nodes=1)
+        node = names[0]
+        s.reservations.reserve(node, {f"{node}-chip-0"}, "who")
+        assert f"{node}-chip-0" not in s.snapshot()[node].usage
+        # And nothing can place on them: fill the node; 8 chips but
+        # only 7 schedulable.
+        got = 0
+        for i in range(8):
+            p = mesh_pod(name=f"x{i}", uid=f"ux{i}", tpu=1, mesh=None,
+                         cores=100)
+            kube.create_pod(p)
+            if s.filter(p, names).node:
+                got += 1
+        assert got == 7
+        s.close()
+
+    def test_release_returns_chips_and_bumps_rev(self):
+        kube, s, names, _ = mesh_env(n_nodes=1)
+        node = names[0]
+        s.reservations.reserve(node, {f"{node}-chip-0"}, "who")
+        assert f"{node}-chip-0" not in s.snapshot()[node].usage
+        s.reservations.release_for("who")
+        assert f"{node}-chip-0" in s.snapshot()[node].usage
+        s.close()
+
+    def test_ttl_expiry(self):
+        clock = SimClock()
+        calls = []
+        res = SliceReservations(clock=clock, on_change=calls.append,
+                                ttl_s=10.0)
+        res.reserve("n", {"c1", "c2"}, "k")
+        assert res.total_chips() == 2
+        clock.advance(11.0)
+        expired = res.sweep()
+        assert len(expired) == 1 and res.total_chips() == 0
+        assert calls == ["n", "n"]   # reserve + expiry both notify
+
+
+class TestWebhookMeshValidation:
+    CFG = Config()
+
+    def _review(self, pod, topologies=None):
+        body = {"request": {"uid": "rq", "operation": "CREATE",
+                            "object": pod}}
+        return handle_admission_review(body, self.CFG,
+                                       topologies=topologies)
+
+    def test_valid_mesh_admits_and_mutates(self):
+        out = self._review(mesh_pod(tpu=4, mesh="2x2"),
+                           topologies=[V5E_4x2])
+        assert out["response"]["allowed"] is True
+        assert out["response"].get("patch")   # schedulerName mutation
+
+    def test_bad_shape_rejected(self):
+        out = self._review(mesh_pod(tpu=4, mesh="2x"))
+        r = out["response"]
+        assert r["allowed"] is False
+        assert r["status"]["code"] == 422
+        assert "2x" in r["status"]["message"]
+
+    def test_volume_mismatch_rejected(self):
+        out = self._review(mesh_pod(tpu=4, mesh="2x4"))
+        assert out["response"]["allowed"] is False
+        assert "volume 8" in out["response"]["status"]["message"]
+
+    def test_gang_volume_counts_members(self):
+        ok = self._review(mesh_pod(tpu=4, mesh="2x4", gang="g",
+                                   gang_total=2), topologies=[V5E_4x2])
+        assert ok["response"]["allowed"] is True
+        bad = self._review(mesh_pod(tpu=4, mesh="2x4", gang="g",
+                                    gang_total=3))
+        assert bad["response"]["allowed"] is False
+        assert "3 members" in bad["response"]["status"]["message"]
+
+    def test_fleet_fit_rejection_names_topologies(self):
+        line = TopologyDesc(generation="v5e", mesh=(8, 1))
+        out = self._review(mesh_pod(tpu=8, mesh="2x4"),
+                           topologies=[line])
+        r = out["response"]
+        assert r["allowed"] is False
+        assert "fits no node topology" in r["status"]["message"]
+        assert "8x1" in r["status"]["message"]
+
+    def test_empty_fleet_skips_fit_check(self):
+        out = self._review(mesh_pod(tpu=8, mesh="2x4"), topologies=[])
+        assert out["response"]["allowed"] is True
+
+    def test_mesh_without_tpus_rejected(self):
+        p = mesh_pod(tpu=4, mesh="2x2")
+        p["spec"]["containers"][0]["resources"]["limits"] = {}
+        out = self._review(p)
+        assert out["response"]["allowed"] is False
+
+    def test_no_mesh_is_untouched(self):
+        assert validate_pod_mesh(mesh_pod(mesh=None), self.CFG) is None
+
+    def test_callable_topologies(self):
+        why = validate_pod_mesh(mesh_pod(tpu=4, mesh="2x2"), self.CFG,
+                                topologies=lambda: [V5E_4x2])
+        assert why is None

@@ -438,14 +438,17 @@ class TestBatchProtocol:
         s.close()
 
     def test_multichip_on_topology_fleet_uses_slice_engine(self):
-        """nums>1 on an ICI fleet must route to the per-pod path (the
-        closed-form slice engine) — contiguity is not vectorized."""
+        """nums>1 on an ICI fleet routes through the in-cycle slice
+        stage (the closed-form engine over CoW snapshot views) and
+        group-commits with the rest of the batch — no per-pod fallback
+        (ISSUE 8), and contiguity still holds."""
         kube, s, names = self._env(n_nodes=2)
         p = tpu_pod("p", uid="u", mem="1000", nums="2")
         kube.create_pod(p)
         r, = s.filter_many([(p, names)])
         assert r.node is not None, r.error
-        assert s.batch.stats.fallbacks == 1
+        assert s.batch.stats.fallbacks == 0
+        assert s.batch.stats.pods == 1
         # The grant's chips are ICI neighbors (register_node coords).
         grant = s.pods.get("u").devices[0]
         coords = []
@@ -454,6 +457,75 @@ class TestBatchProtocol:
             coords.extend(dev.coords for dev in info.devices
                           if dev.id == d.uuid)
         assert len(coords) == 2
+        s.close()
+
+    def test_slice_jobs_group_commit_with_vector_jobs(self):
+        """One cycle, mixed shapes: the slice job places through the
+        in-cycle ICI stage, the single through the vector solver, and
+        both ride the same per-node group commit — zero fallbacks."""
+        kube, s, names = self._env(n_nodes=2)
+        slice_pod = tpu_pod("sl", uid="usl", mem="1000", nums="2")
+        single = tpu_pod("sg", uid="usg", mem="1000")
+        for p in (slice_pod, single):
+            kube.create_pod(p)
+        rs = s.filter_many([(slice_pod, names), (single, names)])
+        assert all(r.node for r in rs), [(r.node, r.error) for r in rs]
+        assert s.batch.stats.fallbacks == 0
+        assert s.batch.stats.fallback_reason_counts() == {}
+        # The slice grant saw the columnar state and vice versa: no
+        # chip got both grants beyond capacity.
+        from tests.test_scheduler_concurrency import (
+            assert_no_overallocation)
+
+        assert_no_overallocation(s)
+        s.close()
+
+    def test_fallback_reasons_counted_and_exported(self):
+        """ISSUE 8 satellite: the per-pod fallback rate is visible by
+        cause via vtpu_filter_batch_fallbacks_total{reason=...}."""
+        kube, s, names = self._env(n_nodes=1)
+        too_many = tpu_pod("big", uid="ub", mem="1000", nums="64")
+        too_fat = tpu_pod("fat", uid="uf", mem="999999")
+        for p in (too_many, too_fat):
+            kube.create_pod(p)
+        rs = s.filter_many([(too_many, names), (too_fat, names)])
+        assert all(r.node is None for r in rs)
+        counts = s.batch.stats.fallback_reason_counts()
+        assert counts.get("slice-no-fit") == 1, counts
+        assert counts.get("no-fit") == 1, counts
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import (
+            ClusterCollector)
+
+        reg = CollectorRegistry()
+        reg.register(ClusterCollector(s))
+        text = generate_latest(reg).decode()
+        assert ('vtpu_filter_batch_fallbacks_total{'
+                'reason="slice-no-fit"} 1.0') in text
+        s.close()
+
+    def test_mesh_on_topologyless_fleet_rejects_not_scatters(self):
+        """Review regression: a declared mesh on a fleet advertising no
+        ICI topology must reject (topology-unverifiable) through the
+        batch front too — the vector stage must never silently scatter
+        a mesh contract."""
+        kube = FakeKube()
+        s = Scheduler(kube, Config(filter_batch=True))
+        kube.add_node({"metadata": {"name": "n0", "annotations": {}}})
+        devices = [DeviceInfo(id=f"n0-chip-{i}", count=10, devmem=16384,
+                              type="v5e", health=True, coords=())
+                   for i in range(4)]
+        s.nodes.add_node("n0", NodeInfo(name="n0", devices=devices,
+                                        topology=None))
+        kube.watch_pods(s.on_pod_event)
+        p = tpu_pod("m", uid="um", mem="1000", nums="2")
+        p["metadata"]["annotations"]["vtpu.dev/mesh"] = "1x2"
+        kube.create_pod(p)
+        r, = s.filter_many([(p, ["n0"])])
+        assert r.node is None, r.node
+        blob = (r.error or "") + " ".join(r.failed.values())
+        assert "topology-unverifiable" in blob, (r.error, r.failed)
         s.close()
 
     def test_fair_share_release_order_respected_in_drain(self):

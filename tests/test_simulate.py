@@ -281,3 +281,42 @@ def test_queueing_replay_is_deterministic():
     a = run_simulation(QUEUEING, nodes=2, chips=4, hbm=16384, mesh=(4, 1))
     b = run_simulation(QUEUEING, nodes=2, chips=4, hbm=16384, mesh=(4, 1))
     assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+FRAGMENTATION = {"fragmentation": {
+    "churn": {"name": "churn", "tpu": 1, "tpumem": 4000,
+              "tpucores": 100, "priority": 1},
+    "release_pattern": "checkerboard",
+    "gang": {"name": "big", "count": 2, "tpu": 4, "tpumem": 4000,
+             "tpucores": 100, "gang": "big", "mesh": "2x4"},
+    "horizon_s": 150, "tick_s": 5, "checkpoint_delay_s": 5,
+}}
+
+
+def test_fragmentation_ab_defrag_unblocks_gang():
+    """ISSUE 8 acceptance: on the virtual clock, contiguous-slice
+    availability and large-gang admission latency are strictly better
+    with defrag on than off, zero chips double-book, and every migrated
+    victim was checkpoint-first and re-placed."""
+    r = run_simulation(dict(FRAGMENTATION), nodes=2, chips=8,
+                       hbm=16384, mesh=(4, 2))["fragmentation"]
+    v = r["verdict"]
+    on, off = r["defrag_on"], r["defrag_off"]
+    assert on["admitted"] and not off["admitted"]
+    assert v["admission_latency_better"] and v["availability_better"]
+    assert v["no_overbooking"] and v["ok"]
+    # Checkpoint-first migration: every victim carried the eviction
+    # flag before exiting, and its replacement re-placed.
+    assert on["victims_migrated"] == on["victims_checkpoint_first"]
+    assert len(on["victims_replaced"]) == len(on["victims_migrated"])
+    assert on["migrations"] > 0
+    # The fragmented fleet really had no contiguous home before.
+    assert on["availability_before"]["max_free_box"] < 4
+
+
+def test_fragmentation_replay_is_deterministic():
+    a = run_simulation(dict(FRAGMENTATION), nodes=2, chips=8,
+                       hbm=16384, mesh=(4, 2))
+    b = run_simulation(dict(FRAGMENTATION), nodes=2, chips=8,
+                       hbm=16384, mesh=(4, 2))
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)

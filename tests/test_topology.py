@@ -94,6 +94,65 @@ class TestFindSlice:
         assert is_contiguous(got, V5E)
 
 
+class TestDeterministicEnumeration:
+    def test_factor_shapes_order_is_pinned(self):
+        # Equal-surface-area shapes must order by the shape tuple itself
+        # (the set they come out of has no portable iteration order):
+        # two replicas enumerating differently would place differently.
+        assert factor_shapes(4, (4, 4)) == [(2, 2), (1, 4), (4, 1)]
+        assert factor_shapes(8, (4, 4)) == [(2, 4), (4, 2)]
+        assert factor_shapes(8, (4, 4, 4)) == [
+            (2, 2, 2), (1, 2, 4), (1, 4, 2), (2, 1, 4),
+            (2, 4, 1), (4, 1, 2), (4, 2, 1)]
+
+    def test_find_slice_is_reproducible(self):
+        free = [c for c in all_coords(V5E) if c not in {(1, 1), (2, 2)}]
+        runs = [find_slice(V5E, list(free), 4) for _ in range(3)]
+        assert runs[0] == runs[1] == runs[2]
+
+
+class TestWrapVsOpenMesh:
+    def test_open_mesh_never_wraps_the_seam(self):
+        # Same free set as the torus seam case, but NO wraparound: the
+        # {x=3, x=0} pair is not adjacent on an open mesh.
+        line = TopologyDesc(generation="v5e", mesh=(4, 1))
+        free = [(3, 0), (0, 0)]
+        assert find_slice(line, free, 2, GUARANTEED) is None
+        assert not is_contiguous(free, line)
+
+    def test_wraparound_axis_wraps_only_that_axis(self):
+        # Wrap on x only: a box may cross the x seam but never the y edge.
+        topo = TopologyDesc(generation="v5p", mesh=(4, 4),
+                            wraparound=(True, False))
+        x_seam = [(3, 0), (0, 0)]
+        y_edge = [(0, 3), (0, 0)]
+        assert find_slice(topo, x_seam, 2, GUARANTEED) is not None
+        assert is_contiguous(x_seam, topo)
+        assert find_slice(topo, y_edge, 2, GUARANTEED) is None
+        assert not is_contiguous(y_edge, topo)
+
+    def test_full_wrap_box_equals_whole_axis(self):
+        # A wrapped box the full length of the axis is the axis itself —
+        # it must not double-count cells (box_coords dedup via modulo).
+        ring = TopologyDesc(generation="v5p", mesh=(4, 1),
+                            wraparound=(True, False))
+        got = find_slice(ring, all_coords(ring), 4, GUARANTEED)
+        assert got is not None and sorted(got) == all_coords(ring)
+
+    def test_oversize_wrap_rejected(self):
+        # s <= dim guard: a 5-cell box cannot wrap a 4-wide torus axis.
+        from k8s_vgpu_scheduler_tpu.topology import box_coords
+
+        ring = TopologyDesc(generation="v5p", mesh=(4, 1),
+                            wraparound=(True, False))
+        assert box_coords((0, 0), (5, 1), ring) is None
+
+    def test_link_groups_open_mesh_edge_does_not_connect(self):
+        line = TopologyDesc(generation="v5e", mesh=(4, 1))
+        groups = link_groups(line, [(0, 0), (3, 0)])
+        assert len(groups) == 2
+
+
 class TestLinkGroups:
     def test_healthy_mesh_is_one_group(self):
         groups = link_groups(V5E, all_coords(V5E))
