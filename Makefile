@@ -2,8 +2,8 @@
 # hack/build.sh + a Makefile; here each surface is one target).
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
-        defrag-sim batch-protocol lint-dashboards dryrun scenarios \
-        controlplane bench-controlplane bench wheel clean
+        defrag-sim ha-sim batch-protocol shard-protocol lint-dashboards \
+        dryrun scenarios controlplane bench-controlplane bench wheel clean
 
 all: native
 
@@ -50,6 +50,19 @@ defrag-sim:                   ## fragmentation/defrag A/B in the simulator
 	    --nodes 2 --chips 8 --mesh 4x2 --json \
 	  | python -c "import json,sys; v = json.load(sys.stdin)['fragmentation']['verdict']; assert v['ok'], v; print('defrag-sim:', v)"
 
+# Active-active HA failover through the REAL shard layer on the virtual
+# clock (docs/scheduler-concurrency.md "Sharded control plane"): three
+# replicas converge on a shard map, a seeded replica is killed
+# mid-storm, survivors bump the epoch and adopt the orphaned shards,
+# and every pod that pended through the window re-places.  Deterministic
+# (SimClock, seeded kill, rendezvous hashing); the verdict gates CI:
+# all shards adopted, all pending pods re-placed, no grant lost or
+# duplicated, zero overbooked chips.
+ha-sim:                       ## replica-kill failover A/B in the simulator
+	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
+	    --workload examples/workload-ha.json --nodes 6 --chips 4 --json \
+	  | python -c "import json,sys; v = json.load(sys.stdin)['ha']['verdict']; assert v['ok'], v; print('ha-sim:', v)"
+
 # The scheduler-concurrency protocol suite (racing filter/bind/delete,
 # zero over-grant, conflict convergence) re-run with the batched Filter
 # on (--filter-batch; scheduler/batch.py), plus the batch-specific
@@ -58,6 +71,18 @@ defrag-sim:                   ## fragmentation/defrag A/B in the simulator
 batch-protocol:               ## concurrency protocol suite, batched Filter on
 	VTPU_TEST_FILTER_BATCH=1 python -m pytest \
 	    tests/test_scheduler_concurrency.py tests/test_scheduler_batch.py -q
+
+# The multi-replica shard protocol suite (two replicas racing one shard
+# map, epoch fencing, seeded-kill adoption determinism, no-double-evict
+# across handoffs), plus the EXISTING concurrency stress suite re-run
+# with the shard layer active (VTPU_TEST_SHARD_FENCE=1: every decision
+# passes the epoch fence and commits via pod-resourceVersion CAS) —
+# proves the sharded commit keeps every invariant of
+# docs/scheduler-concurrency.md under the same racing load.
+shard-protocol:               ## shard suite + concurrency stress, CAS commit on
+	python -m pytest tests/test_shard.py -q
+	VTPU_TEST_SHARD_FENCE=1 python -m pytest \
+	    tests/test_scheduler_concurrency.py -q
 
 # Dashboard/alert ↔ code pinning, standalone (the same tests also run in
 # the default tier): every panel/alert expression must name a metric a

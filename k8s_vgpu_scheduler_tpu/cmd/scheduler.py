@@ -175,6 +175,37 @@ def parse_args(argv=None):
                         "for its beneficiary before returning to the pool")
     p.add_argument("--defrag-max-victims", type=int, default=8,
                    help="largest victim set a compaction plan may ask")
+    # Active-active scheduler HA (shard/; docs/scheduler-concurrency.md,
+    # "Sharded control plane").
+    p.add_argument("--shard-replica", default="",
+                   help="this replica's name in the active-active "
+                        "scheduler fleet (the chart passes the pod "
+                        "name); empty = the shard layer is inert and "
+                        "this is a plain single-replica scheduler")
+    p.add_argument("--shard-ttl", type=float, default=15.0,
+                   help="seconds without a coordination beat before a "
+                        "peer replica is Suspect (keeps its shards)")
+    p.add_argument("--shard-grace-beats", type=int, default=2,
+                   help="additional shard-ttl periods a Suspect replica "
+                        "gets before it is Dead and its shards are "
+                        "adopted by survivors (epoch bump)")
+    p.add_argument("--shard-tick", type=float, default=3.0,
+                   help="coordination tick period: heartbeat + shard-"
+                        "map poll + adoption progress")
+    p.add_argument("--shard-stale-ttl", type=float, default=10.0,
+                   help="a decision commit whose shard map was read "
+                        "more than this long ago fails closed (the "
+                        "fence half of the adoption handshake)")
+    p.add_argument("--shard-adoption-grace", type=float, default=12.0,
+                   help="seconds an adopted shard stays unplaceable "
+                        "after an epoch bump while the previous "
+                        "owner's in-flight commits drain into the "
+                        "staleness fence; must be >= --shard-stale-ttl")
+    p.add_argument("--shard-coord-object",
+                   default="vtpu-shard-coordination",
+                   help="name of the coordination Node object the "
+                        "shard map is CASed on (one per scheduler "
+                        "fleet)")
     p.add_argument("--no-rescue", action="store_true",
                    help="disable the background rescue sweep (failure "
                         "detection and quarantine gating stay on; grants "
@@ -295,6 +326,13 @@ def build_config(args) -> Config:
         defrag_checkpoint_grace_s=args.defrag_checkpoint_grace,
         defrag_reservation_ttl_s=args.defrag_reservation_ttl,
         defrag_max_victims=args.defrag_max_victims,
+        shard_replica=args.shard_replica,
+        shard_ttl_s=args.shard_ttl,
+        shard_grace_beats=args.shard_grace_beats,
+        shard_tick_s=args.shard_tick,
+        shard_stale_ttl_s=args.shard_stale_ttl,
+        shard_adoption_grace_s=args.shard_adoption_grace,
+        shard_coord_object=args.shard_coord_object,
     )
 
 
@@ -302,11 +340,14 @@ class DryRunKube(FakeKube):
     """FakeKube that upserts pods on patch, so `--fake-kube` dry-runs can
     POST /filter with pods that were never created (BASELINE config #1)."""
 
-    def patch_pod_annotations(self, namespace, name, annotations):
+    def patch_pod_annotations(self, namespace, name, annotations,
+                              resource_version=None):
         from ..k8s.client import NotFound
 
         try:
-            return super().patch_pod_annotations(namespace, name, annotations)
+            return super().patch_pod_annotations(
+                namespace, name, annotations,
+                resource_version=resource_version)
         except NotFound:
             self.create_pod(
                 {"metadata": {"name": name, "namespace": namespace,
@@ -360,6 +401,13 @@ def main(argv=None):
     # --enable-defrag.
     if scheduler.cfg.enable_defrag:
         scheduler.defrag.start()
+    # Active-active HA: join the shard map SYNCHRONOUSLY before any
+    # server accepts traffic (an unfenced replica serving /filter could
+    # place on shards it does not own), then keep coordinating on the
+    # background tick.  Inert without --shard-replica.
+    if scheduler.cfg.shard_replica:
+        scheduler.shards.tick()
+        scheduler.shards.start(scheduler.cfg.shard_tick_s)
 
     watch_stop = threading.Event()
     if watch_enabled:
@@ -408,6 +456,7 @@ def main(argv=None):
         scheduler.rescuer.stop()
         scheduler.admission.stop()
         scheduler.defrag.stop()
+        scheduler.shards.stop()
         http_server.stop()
         grpc_server.stop(grace=2)
 

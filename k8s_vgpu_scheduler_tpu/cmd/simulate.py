@@ -84,6 +84,21 @@ victims until the gang admits:
                 "tpucores": 100, "gang": "big", "mesh": "2x4"},
        "horizon_s": 300, "tick_s": 5, "checkpoint_delay_s": 5}}
 
+A workload may instead carry an ``ha`` section — an active-active
+multi-replica run (shard/; docs/scheduler-concurrency.md "Sharded
+control plane") on the virtual clock with a seeded replica kill
+mid-storm: N replica Schedulers share one fake apiserver, converge on a
+shard map, place a pod storm routed the way kube-scheduler's retries
+would route it, one replica is killed, survivors bump the epoch and
+adopt its shards, and every pod that pended through the orphan window
+re-places.  The report carries the adoption latency, the re-placed
+pods, and the overbooking / grant-conservation audit:
+
+    {"ha": {"replicas": 3, "seed": 7, "kill_after": 8,
+            "storm": {"name": "train", "tpu": 1, "tpumem": 2000,
+                      "count": 24},
+            "storm_interval_s": 2, "settle_s": 120}}
+
 Usage:
     vtpu-simulate --nodes 4 --chips 8 --hbm 16384 --mesh 4x2 \
                   --workload workload.json [--policy binpack] [--json]
@@ -224,6 +239,25 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
             "hbm_allocated_fraction": 0.0,
             "fits": bool(result["verdict"]["ok"]),
             "fragmentation": result,
+        }
+
+    ha = workload.get("ha")
+    if ha:
+        # An HA scenario is a self-contained multi-replica run (it
+        # builds its own replica Schedulers over one fake apiserver on
+        # the virtual clock); the plain placement replay below is
+        # single-replica by construction.
+        result = run_ha_phase(
+            ha, nodes=nodes, chips=chips, hbm=hbm, mesh=mesh,
+            generation=generation, policy=policy or "spread")
+        return {
+            "fleet": {"nodes": nodes, "chips_per_node": chips,
+                      "hbm_mib": hbm, "mesh": list(mesh),
+                      "policy": policy or "spread"},
+            "placed": [], "pending": [], "chips": {},
+            "hbm_allocated_fraction": 0.0,
+            "fits": bool(result["verdict"]["ok"]),
+            "ha": result,
         }
 
     queueing = workload.get("queueing")
@@ -1039,6 +1073,224 @@ def run_chaos_phase(s: Scheduler, kube: FakeKube, names: List[str],
     }
 
 
+def run_ha_phase(spec: dict, *, nodes: int, chips: int, hbm: int,
+                 mesh, generation: str, policy: str) -> dict:
+    """Active-active HA scenario (docs/scheduler-concurrency.md,
+    "Sharded control plane"): N replica Schedulers over ONE fake
+    apiserver converge on a shard map, a pod storm is routed across
+    them the way kube-scheduler retries route it (offer every replica
+    until one accepts), a seeded replica is killed mid-storm, and the
+    survivors' lease detectors drive the epoch bump, shard adoption and
+    re-placement.  Everything runs on SimClock, so the whole failover
+    replays bit-identically for a given seed."""
+    import random as random_mod
+
+    clock = SimClock()
+    kube = FakeKube()
+    n_rep = int(spec.get("replicas", 3))
+    seed = int(spec.get("seed", 0))
+    rng = random_mod.Random(seed)
+    # Tight coordination timings: the scenario is about the PROTOCOL
+    # (death → bump → adopt), not production TTLs — virtual seconds are
+    # free but the report reads better in tens than hundreds.
+    ttl = float(spec.get("replica_ttl_s", 10.0))
+    reps: List[Scheduler] = []
+    for i in range(n_rep):
+        reps.append(Scheduler(kube, Config(
+            node_scheduler_policy=policy,
+            shard_replica=f"replica-{i}",
+            shard_ttl_s=ttl, shard_grace_beats=1,
+            shard_stale_ttl_s=ttl / 2,
+            shard_adoption_grace_s=ttl / 2 + 1.0), clock=clock))
+    names = build_fleet(reps[0], kube, nodes, chips, hbm, mesh, generation)
+    for s in reps[1:]:
+        for n in names:
+            info = reps[0].nodes.get_node(n)
+            s.nodes.add_node(n, NodeInfo(
+                name=n, devices=list(info.devices),
+                topology=info.topology))
+    for s in reps:
+        kube.watch_pods(s.on_pod_event)
+
+    alive = list(range(n_rep))
+
+    def tick_all() -> None:
+        for i in alive:
+            reps[i].shards.tick()
+
+    # Converge the boot partition (epoch stabilizes once every replica
+    # has seen every other's beats).
+    for _ in range(4):
+        tick_all()
+        clock.advance(1.0)
+    epoch_before = reps[0].shards.epoch()
+
+    storm_spec = dict(spec.get("storm") or
+                      {"name": "train", "tpu": 1, "tpumem": 2000,
+                       "count": 24})
+    count = int(storm_spec.get("count", 24))
+    interval = float(spec.get("storm_interval_s", 2.0))
+    kill_after = int(spec.get("kill_after", max(1, count // 3)))
+    pods = [spec_pod(storm_spec, i) for i in range(count)]
+    for pod in pods:
+        kube.create_pod(pod)
+
+    placed: List[dict] = []
+    pending: List[dict] = []
+    killed: Optional[int] = None
+    placed_before_kill = 0
+
+    def try_place(pod) -> Optional[dict]:
+        # kube-scheduler retry model: offer the pod to each live
+        # replica in turn; non-owners reject (shard-not-owned) and the
+        # retry lands on the owner.  Start position rotates so the
+        # routing itself is not owner-aware.
+        start = rng.randrange(len(alive))
+        last_err = ""
+        for k in range(len(alive)):
+            i = alive[(start + k) % len(alive)]
+            r = reps[i].filter(pod, names)
+            if r.node:
+                return {"pod": pod["metadata"]["name"], "node": r.node,
+                        "replica": reps[i].shards.replica}
+            last_err = r.error or next(iter(r.failed.values()), "no fit")
+        return {"pod": pod["metadata"]["name"], "reason": last_err,
+                "placed": None}
+
+    for idx, pod in enumerate(pods):
+        if killed is None and idx == kill_after:
+            # Seeded mid-storm kill: the victim stops beating (its tick
+            # never runs again) and the router stops offering it —
+            # exactly what a SIGKILLed replica looks like from outside.
+            killed = rng.choice(alive)
+            alive.remove(killed)
+            # Snapshot NOW, not placed[:kill_after] afterward: if any
+            # pre-kill pod pended, slicing later would silently count
+            # post-kill placements as pre-kill.
+            placed_before_kill = len(placed)
+        got = try_place(pod)
+        if got.get("node"):
+            placed.append(got)
+        else:
+            pending.append({"pod": got["pod"], "reason": got["reason"]})
+        clock.advance(interval)
+        tick_all()
+
+    grants_at_storm_end = {
+        p["metadata"]["name"]:
+            p.get("metadata", {}).get("annotations", {}).get(
+                "vtpu.dev/assigned-node", "")
+        for p in kube.list_pods()}
+
+    # Settle: survivors' replica-lease detectors declare the victim
+    # Dead, bump the epoch, serve the adoption grace and replay the
+    # WAL.  Done when no survivor has a pending adoption AND every node
+    # is placeable by its (surviving) owner.
+    settle_s = float(spec.get("settle_s", 120.0))
+    settle_t0 = clock()
+    while clock() - settle_t0 < settle_s:
+        tick_all()
+        owners_live = True
+        adopting = False
+        for n in names:
+            m = reps[alive[0]].shards.map
+            owner = m.owner_of(n) if m is not None else None
+            if owner not in {reps[i].shards.replica for i in alive}:
+                owners_live = False
+                break
+            oi = next(i for i in alive
+                      if reps[i].shards.replica == owner)
+            if reps[oi].shards.reject_reason(n) is not None:
+                adopting = True
+                break
+        if owners_live and not adopting and all(
+                not reps[i].shards.rebalancer.pending_nodes()
+                for i in alive):
+            break
+        clock.advance(2.0)
+    adoption_latencies = [
+        lat for i in alive
+        for lat in reps[i].shards.rebalancer.last_adoption_latency_s]
+    epoch_after = reps[alive[0]].shards.epoch()
+
+    # Re-place pass: every pod that pended through the orphan window
+    # retries against the survivors (kube-scheduler's backoff retry).
+    replaced: List[dict] = []
+    still_pending: List[dict] = []
+    for entry in pending:
+        pod = kube.get_pod("sim", entry["pod"])
+        got = try_place(pod)
+        if got.get("node"):
+            replaced.append({"pod": got["pod"], "node": got["node"],
+                             "replica": got["replica"]})
+        else:
+            still_pending.append({"pod": got["pod"],
+                                  "reason": got["reason"]})
+
+    # Audits.  Grant conservation: every pod placed BEFORE the kill
+    # still carries exactly the decision it had at storm end (nothing
+    # lost, nothing re-assigned behind the WAL's back); registry
+    # agreement: no replica accounts a pod on a different node than the
+    # annotation WAL says; overbooking: per-surviving-replica chip
+    # audit over the fully converged registries.
+    lost, duplicated = [], []
+    for p in kube.list_pods():
+        pname = p["metadata"]["name"]
+        node_now = p.get("metadata", {}).get("annotations", {}).get(
+            "vtpu.dev/assigned-node", "")
+        was = grants_at_storm_end.get(pname, "")
+        if was and not node_now:
+            lost.append(pname)
+        uid = p["metadata"]["uid"]
+        seen = {reps[i].pods.get(uid).node for i in alive
+                if reps[i].pods.get(uid) is not None}
+        if node_now:
+            seen.add(node_now)
+        if len(seen) > 1:
+            duplicated.append({"pod": pname, "nodes": sorted(seen)})
+    overbooked = sorted({c for i in alive
+                         for c in overbooked_chips(reps[i])})
+
+    verdict = {
+        "adopted_all": all(
+            not reps[i].shards.rebalancer.pending_nodes()
+            for i in alive) and epoch_after > epoch_before,
+        "replaced_all": not still_pending,
+        "no_grant_lost": not lost,
+        "no_grant_duplicated": not duplicated,
+        "no_overbooking": not overbooked,
+    }
+    verdict["ok"] = all(verdict.values())
+    result = {
+        "seed": seed,
+        "replicas": n_rep,
+        "killed": f"replica-{killed}" if killed is not None else None,
+        "epoch_before": epoch_before,
+        "epoch_after": epoch_after,
+        "placed_before_kill": placed_before_kill,
+        "placed_total": len(placed) + len(replaced),
+        "pending_during_window": len(pending),
+        "replaced": replaced,
+        "still_pending": still_pending,
+        "adoption_latency_s": round(max(adoption_latencies), 1)
+        if adoption_latencies else 0.0,
+        "shards_adopted": sum(
+            reps[i].shards.rebalancer.adopted_total for i in alive),
+        "rebalances": sum(
+            reps[i].shards.rebalances_total for i in alive),
+        "cas_failures": {
+            reps[i].shards.replica: dict(reps[i].shards.cas_failures)
+            for i in range(n_rep)},
+        "grants_lost": lost,
+        "grants_duplicated": duplicated,
+        "overbooked_chips": overbooked,
+        "verdict": verdict,
+    }
+    for s in reps:
+        s.close()
+    return result
+
+
 def format_report(result: dict) -> str:
     f = result["fleet"]
     if "source" in f:
@@ -1115,6 +1367,35 @@ def format_report(result: dict) -> str:
                                      + off["overbooked_chips"]))
         lines.append("  verdict: " + ("PASS" if v["ok"] else
                                       f"FAIL {v}"))
+        return "\n".join(lines)
+    hr = result.get("ha")
+    if hr:
+        v = hr["verdict"]
+        lines = [
+            "active-active HA: {} replica(s), seed {}; killed {} "
+            "mid-storm".format(hr["replicas"], hr["seed"], hr["killed"]),
+            "  epoch {} → {}; {} shard(s) adopted in {:.1f}s; "
+            "{} rebalance transition(s)".format(
+                hr["epoch_before"], hr["epoch_after"],
+                hr["shards_adopted"], hr["adoption_latency_s"],
+                hr["rebalances"]),
+            "  {} placed before kill, {} pended through the orphan "
+            "window, {} re-placed on survivors".format(
+                hr["placed_before_kill"], hr["pending_during_window"],
+                len(hr["replaced"])),
+        ]
+        for r in hr["replaced"]:
+            lines.append(f"  {r['pod']:<24s} ↻ {r['node']} "
+                         f"(via {r['replica']})")
+        for p in hr["still_pending"]:
+            lines.append(f"  {p['pod']:<24s} STRANDED: {p['reason']}")
+        if hr["grants_lost"] or hr["grants_duplicated"]:
+            lines.append("  GRANTS lost: {} duplicated: {}".format(
+                hr["grants_lost"], hr["grants_duplicated"]))
+        if hr["overbooked_chips"]:
+            lines.append("  OVERBOOKED: "
+                         + ", ".join(hr["overbooked_chips"]))
+        lines.append("  verdict: " + ("PASS" if v["ok"] else f"FAIL {v}"))
         return "\n".join(lines)
     qr = result.get("queueing")
     if qr:

@@ -140,9 +140,26 @@ class Rescuer:
         now = self._clock()
         actions: List[dict] = []
         tr = trace.tracer()
+        # Sharded control plane (shard/): every destructive action below
+        # is OWNERSHIP-GATED — exactly one replica rescues a node's
+        # grants, so a shard handoff can never double-evict.  With the
+        # shard layer inert, owns() is uniformly True and this sweep is
+        # the single-replica behavior unchanged; enabled with no map
+        # observed yet, owns() is uniformly False — a blind replica
+        # rescinds nothing (fail closed).
+        shards = getattr(self.s, "shards", None)
+        sharded = shards is not None and shards.enabled
 
         # 1. Lease transitions (reported exactly once per edge).
         for node, old, new in self.s.leases.sweep(now):
+            if sharded and not shards.owns(node):
+                # Handed off: the node's failure story belongs to its
+                # owner replica now.  Forget our stale lease — keeping
+                # it would eventually declare a node Dead that simply
+                # stopped heartbeating US after the shard moved.
+                self.s.leases.forget(node)
+                actions.append({"kind": "lease-handoff", "node": node})
+                continue
             actions.append({"kind": "lease", "node": node,
                             "from": old.name, "to": new.name})
             tr.event(node, f"lease-{new.name.lower()}",
@@ -189,6 +206,12 @@ class Rescuer:
 
         # 3. Stranded-grant scan.
         for info in self.s.pods.list_pods():
+            if sharded and not shards.owns(info.node):
+                # Another replica owns this node (the registry still
+                # tracks its pods — every replica mirrors the whole
+                # fleet's grants for capacity accounting); rescuing
+                # them from here would race the owner's sweep.
+                continue
             state = self.s.leases.state_of(info.node)
             if state is LeaseState.DEAD:
                 self.enqueue(info.uid, "node-dead")
@@ -231,6 +254,10 @@ class Rescuer:
             idle_now = set()
             for pe in grant_eff(now).idle:
                 if not pe.oversubscribe:
+                    continue
+                if sharded and not shards.owns(pe.node):
+                    # The owner replica's ledger has the node's usage
+                    # reports; ours would flag unmetered grants as idle.
                     continue
                 idle_now.add(pe.uid)
                 if pe.uid in self.idle_flagged:

@@ -58,9 +58,17 @@ class KubeClient:
         raise NotImplementedError
 
     def patch_pod_annotations(
-        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+        self, namespace: str, name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
     ) -> dict:
-        """Merge-patch metadata.annotations; a None value deletes the key."""
+        """Merge-patch metadata.annotations; a None value deletes the key.
+        When ``resource_version`` is given it rides in the patch body,
+        turning the write into a compare-and-swap: the apiserver rejects
+        it with 409 (:class:`Conflict`) if the pod changed since that
+        version — the sharded decision commit (shard/commit.py) depends
+        on this, exactly like the node-lock CAS depends on the node
+        variant below."""
         raise NotImplementedError
 
     def patch_pod_annotations_many(
@@ -97,6 +105,13 @@ class KubeClient:
 
     # -- nodes ----------------------------------------------------------------
     def list_nodes(self) -> List[dict]:
+        raise NotImplementedError
+
+    def create_node(self, node: dict) -> dict:
+        """POST a v1.Node.  Raises :class:`Conflict` when it already
+        exists (the apiserver's AlreadyExists is a 409).  Used only for
+        the shard-coordination object (shard/shardmap.py) — real nodes
+        register themselves via the kubelet."""
         raise NotImplementedError
 
     def get_node(self, name: str) -> dict:

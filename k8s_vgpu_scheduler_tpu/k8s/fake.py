@@ -124,6 +124,17 @@ class FakeKube(KubeClient):
         for p in existing:
             fn("ADDED", p)
 
+    def unwatch_pods(self, fn: Callable[[str, dict], None]) -> None:
+        """Detach a watch_pods subscriber (a disconnecting informer).
+        The multi-replica benchmark uses this to scope whose informer
+        runs on whose clock; missed events are re-learned by resync,
+        exactly like a real watch disconnect."""
+        with self._lock:
+            try:
+                self._pod_watchers.remove(fn)
+            except ValueError:
+                pass
+
     # -- KubeClient -----------------------------------------------------------
     def list_pods(self, namespace: Optional[str] = None,
                   node_name: Optional[str] = None) -> List[dict]:
@@ -179,12 +190,26 @@ class FakeKube(KubeClient):
             return _copy(pod)
 
     def patch_pod_annotations(
-        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+        self, namespace: str, name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
     ) -> dict:
         with self._lock:
             pod = self._pods.get(f"{namespace}/{name}")
             if pod is None:
                 raise NotFound(f"pod {namespace}/{name}")
+            if (
+                resource_version is not None
+                and pod["metadata"].get("resourceVersion")
+                != resource_version
+            ):
+                # True CAS semantics (apiserver optimistic concurrency):
+                # a stale resourceVersion is a 409, NOT last-writer-wins
+                # — the sharded commit protocol tests exercise real
+                # contention through this path.
+                raise Conflict(
+                    f"pod {namespace}/{name}: resourceVersion "
+                    f"{resource_version} is stale")
             _apply_annotation_patch(pod, annotations)
             pod["metadata"]["resourceVersion"] = self._next_rv()
             snapshot = _copy(pod)
@@ -216,6 +241,17 @@ class FakeKube(KubeClient):
     def list_nodes(self) -> List[dict]:
         with self._lock:
             return [_copy(n) for n in self._nodes.values()]
+
+    def create_node(self, node: dict) -> dict:
+        with self._lock:
+            name = node.get("metadata", {}).get("name", "")
+            if name in self._nodes:
+                raise Conflict(f"node {name} already exists")
+            node = _copy(node)
+            node.setdefault("metadata", {}).setdefault(
+                "resourceVersion", self._next_rv())
+            self._nodes[name] = node
+            return _copy(node)
 
     def get_node(self, name: str) -> dict:
         with self._lock:

@@ -156,12 +156,20 @@ class RestKube(KubeClient):
         return self._request("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
 
     def patch_pod_annotations(
-        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+        self, namespace: str, name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
     ) -> dict:
+        meta: dict = {"annotations": annotations}
+        if resource_version is not None:
+            # Same CAS convention as patch_node_annotations: the
+            # apiserver enforces optimistic concurrency (409 on
+            # mismatch) when the merge patch carries a resourceVersion.
+            meta["resourceVersion"] = resource_version
         return self._request(
             "PATCH",
             f"/api/v1/namespaces/{namespace}/pods/{name}",
-            {"metadata": {"annotations": annotations}},
+            {"metadata": meta},
             content_type="application/merge-patch+json",
         )
 
@@ -214,6 +222,12 @@ class RestKube(KubeClient):
     # -- nodes ----------------------------------------------------------------
     def list_nodes(self) -> List[dict]:
         return self._request("GET", "/api/v1/nodes").get("items", [])
+
+    def create_node(self, node: dict) -> dict:
+        body = dict(node)
+        body.setdefault("apiVersion", "v1")
+        body.setdefault("kind", "Node")
+        return self._request("POST", "/api/v1/nodes", body)
 
     def get_node(self, name: str) -> dict:
         return self._request("GET", f"/api/v1/nodes/{name}")

@@ -182,8 +182,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif method == "GET":
                 self._reply(200, self.kube.get_pod(ns, name))
             elif method == "PATCH":
-                anns = self._body().get("metadata", {}).get("annotations", {})
-                self._reply(200, self.kube.patch_pod_annotations(ns, name, anns))
+                meta = self._body().get("metadata", {})
+                self._reply(200, self.kube.patch_pod_annotations(
+                    ns, name, meta.get("annotations", {}),
+                    resource_version=meta.get("resourceVersion")))
             elif method == "DELETE":
                 self.kube.delete_pod(ns, name)
                 self._reply(200, {"kind": "Status", "status": "Success"})

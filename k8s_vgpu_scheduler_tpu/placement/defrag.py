@@ -422,6 +422,18 @@ class Defragmenter:
         self._progress_in_flight(now, actions)
         if not self.cfg.enabled:
             return actions
+        shards = getattr(self.s, "shards", None)
+        if shards is not None and not shards.leads("defrag"):
+            # Sharded control plane: compaction plans span the whole
+            # fleet's movable set, so the single-writer rule becomes a
+            # single-OWNER rule — one elected replica PLANS new
+            # compactions (shard/shardmap.py); the election moves with
+            # the epoch if the leader dies.  The sweeps above stay
+            # replica-local and always run: a demoted ex-leader must
+            # still expire its reservations and drive its in-flight
+            # plan to completion or checkpoint-grace abort, or the
+            # reserved chips never return to the pool.
+            return actions
         if self._in_flight:
             return actions  # one compaction at a time
         demand = self._blocked_demand()

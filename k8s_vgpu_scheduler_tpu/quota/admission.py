@@ -88,6 +88,16 @@ class AdmissionLoop:
         mgr = self.s.quota
         if not mgr.enabled:
             return []
+        shards = getattr(self.s, "shards", None)
+        if shards is not None and not shards.leads("quota-admission"):
+            # Sharded control plane: fair-share ordering is fleet-wide
+            # state, so exactly ONE live replica runs the admission loop
+            # (single-owner election over the shard map's replica set —
+            # shard/shardmap.py).  Followers keep their QuotaManagers in
+            # step through the queue-state annotation WAL the informer
+            # already replays; on leader death the election moves with
+            # the next epoch and the new leader resumes from that WAL.
+            return []
         now = self._clock() if now is None else now
         actions: List[dict] = []
         pods = self.s.pods.list_pods()

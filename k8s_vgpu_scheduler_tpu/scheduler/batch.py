@@ -977,11 +977,22 @@ class BatchEngine:
 
     def _gate_rows(self) -> None:
         """Per-cycle node gates: the lease reject (Suspect/Dead nodes
-        take no new placements) and the measured-utilization bonus."""
+        take no new placements), the shard-ownership gate (another
+        replica owns the node's placements), and the measured-
+        utilization bonus."""
         fleet = self.fleet
         leases = self.s.leases
-        fleet.alive = [leases.reject_reason(name) is None
-                       for name in fleet.names]
+        shards = self.s.shards
+        if shards.enabled:
+            # placeable() fails closed when no shard map has been
+            # observed yet — an enabled-but-blind replica gates out the
+            # whole fleet, same as the per-pod paths' shard-no-map.
+            fleet.alive = [shards.placeable(name)
+                           and leases.reject_reason(name) is None
+                           for name in fleet.names]
+        else:
+            fleet.alive = [leases.reject_reason(name) is None
+                           for name in fleet.names]
         if self.s.cfg.score_by_actual:
             from ..accounting import efficiency as eff_mod
             fleet.bonus = [
@@ -1049,11 +1060,20 @@ class BatchEngine:
         fleet = self.fleet
         binpack = self.s.cfg.node_scheduler_policy == "binpack"
         cohorts: Dict[tuple, _Cohort] = {}
+        # Per-cycle offer-tuple memo keyed on list identity: a backlog
+        # drain passes the SAME candidate list object for every pod, and
+        # re-tupling a 10k-node offer per job would dominate the cycle
+        # at control-plane scale.  Safe within this call: the jobs hold
+        # references, so an id() cannot be recycled mid-cycle.
+        offers: Dict[int, tuple] = {}
         for i in sorted(vector, key=lambda i: ranks[i]):
             job = jobs[i]
             fp = class_fingerprint(job.requests, job.anns,
                                    self.s.cfg.topology_policy)
-            key = (fp, tuple(job.node_names))
+            offer = offers.get(id(job.node_names))
+            if offer is None:
+                offer = offers[id(job.node_names)] = tuple(job.node_names)
+            key = (fp, offer)
             cohort = cohorts.get(key)
             if cohort is None:
                 ce = _ClassEval(job.requests[0],

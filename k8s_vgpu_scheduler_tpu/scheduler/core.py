@@ -39,6 +39,8 @@ from ..placement.mesh import MESH_ANNOTATION, local_mesh_for, parse_mesh
 from ..placement.reserve import SliceReservations
 from ..quota.admission import AdmissionConfig, AdmissionLoop
 from ..quota.queues import QuotaManager
+from ..shard import commit as shard_commit
+from ..shard.shardmap import ShardConfig, ShardManager
 from ..tpulib.types import TopologyDesc
 from ..util import codec, trace
 from ..util.config import Config
@@ -206,6 +208,24 @@ class Scheduler:
                 reservation_ttl_s=self.cfg.defrag_reservation_ttl_s,
                 min_victim_priority=self.cfg.defrag_min_victim_priority,
                 max_victims_per_plan=self.cfg.defrag_max_victims),
+            clock=clock)
+        # Active-active HA shard layer (shard/; docs/scheduler-
+        # concurrency.md "Sharded control plane").  Inert without
+        # Config.shard_replica: candidate_gate() resolves to None, no gate
+        # runs on any hot path and decision writes keep the group-commit
+        # batcher — the single-replica behavior, bit-for-bit (pinned by
+        # tests/test_shard.py's parity test).  The coordination tick is
+        # started by the daemon entrypoint; embedders/tests/simulator
+        # call shards.tick() directly, the rescuer/admission shape.
+        self.shards = ShardManager(
+            self,
+            ShardConfig(
+                replica=self.cfg.shard_replica,
+                ttl_s=self.cfg.shard_ttl_s,
+                grace_beats=self.cfg.shard_grace_beats,
+                stale_ttl_s=self.cfg.shard_stale_ttl_s,
+                adoption_grace_s=self.cfg.shard_adoption_grace_s,
+                coord_object=self.cfg.shard_coord_object),
             clock=clock)
         self.admission = AdmissionLoop(
             self,
@@ -914,10 +934,16 @@ class Scheduler:
                          pod=pod_name(pod), error=result.error,
                          preempting=result.preempt is not None)
             self._note_slice_rejection(pod, result)
-            if result.failed:
+            if result.failed and any(
+                    not r.startswith("shard-")
+                    for r in result.failed.values()):
                 # A RELEASED governed pod that found no seat is the
                 # reclaim trigger's signal (admission loop: borrowers may
                 # hold the chips this in-quota pod is entitled to).
+                # Shard-ownership rejections alone are NOT that signal —
+                # the pod's next retry lands on the owning replica; a
+                # reclaim here would evict borrowers for a pod another
+                # replica can place.
                 self.quota.note_unplaced(pod_uid(pod))
             if result.preempt is not None:
                 self._request_preemptions(pod, result.preempt)
@@ -945,21 +971,38 @@ class Scheduler:
             patch[GANG_RANK_ANNOTATION] = str(rank)
         with tr.span("decision-write", trace_id=tid, pod=pod_name(pod),
                      node=result.node) as wsp:
-            try:
-                batched = self._decisions.write(
-                    pod_namespace(pod), pod_name(pod), patch)
-                if batched > 1:
-                    # Rode a group commit with batched-1 concurrent
-                    # Filters' decisions (amortized apiserver I/O).
-                    wsp.set("batch_size", batched)
-            except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
-                log.error("failed to write decision for %s: %s",
-                          pod_name(pod), e)
+            err: Optional[str] = None
+            if self.shards.enabled:
+                # Sharded control plane: the write is a fenced CAS keyed
+                # by (shard epoch, pod resourceVersion) — a stale map,
+                # lost ownership or a concurrent peer decision fails
+                # closed and the pod requeues (shard/commit.py).  It
+                # bypasses the group-commit batcher: a CAS carries its
+                # own resourceVersion and cannot ride a shared batch.
+                err = shard_commit.cas_commit(
+                    self.client, self.shards, pod, result.node, patch)
+                if err is not None:
+                    log.warning("decision for %s not committed: %s",
+                                pod_name(pod), err)
+                    wsp.set("error", err)
+            else:
+                try:
+                    batched = self._decisions.write(
+                        pod_namespace(pod), pod_name(pod), patch)
+                    if batched > 1:
+                        # Rode a group commit with batched-1 concurrent
+                        # Filters' decisions (amortized apiserver I/O).
+                        wsp.set("batch_size", batched)
+                except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
+                    err = f"writing decision failed: {e}"
+                    log.error("failed to write decision for %s: %s",
+                              pod_name(pod), e)
+                    wsp.set("error", str(e))
+            if err is not None:
                 self.pods.del_pod(pod_uid(pod))
-                wsp.set("error", str(e))
                 tr.event(pod_uid(pod), "decision-write-failed",
-                         trace_id=tid, error=str(e))
-                return FilterResult(error=f"writing decision failed: {e}")
+                         trace_id=tid, error=err)
+                return FilterResult(error=err)
         return result
 
     # -- placement subsystem hooks (placement/; docs/placement.md) -------------
@@ -1288,6 +1331,12 @@ class Scheduler:
             # don't refit onto it — fail to the outer retry, which
             # re-evaluates with the lease gate applied.
             return None
+        if self.shards.enabled \
+                and self.shards.reject_reason(node) is not None:
+            # Shard ownership moved between snapshot and commit (an
+            # epoch bump): same rule — fail to the outer retry, which
+            # re-evaluates with the new map applied.
+            return None
         with self._usage_cache_lock:
             entry = self._refresh_entry_locked(node)
         if entry is None:
@@ -1367,6 +1416,10 @@ class Scheduler:
                           self.cfg.topology_policy)
         failed: Dict[str, str] = {}
         candidates: List[str] = []
+        # Shard gate resolved ONCE per decision: None when the shard
+        # layer is inert (the single-replica hot path bit-for-bit);
+        # fail-closed shard-no-map rejections when enabled but blind.
+        shard_gate = self.shards.candidate_gate()
         for name in node_names:
             entry = snap.get(name)
             if entry is None:
@@ -1379,6 +1432,13 @@ class Scheduler:
             if why is not None:
                 failed[name] = why
                 continue
+            # Shard gate: another replica owns this node's placements
+            # (docs/scheduler-concurrency.md "Sharded control plane").
+            if shard_gate is not None:
+                why = shard_gate(name)
+                if why is not None:
+                    failed[name] = why
+                    continue
             # Prune before clone: a white/blacklist that excludes every
             # chip type on the node is decided on the shared snapshot —
             # no per-candidate copy, no fit scan.
@@ -1500,6 +1560,7 @@ class Scheduler:
         self.rescuer.stop()
         self.admission.stop()
         self.defrag.stop()
+        self.shards.stop()
         with self._pool_lock:
             pool, self._pool = self._pool, None
             self._pool_unavailable = False
@@ -1550,11 +1611,14 @@ class Scheduler:
         offered = set(node_names)
         # Suspect/Dead nodes are excluded here too: evicting victims to
         # make room on a node that takes no new placements frees nothing
-        # the requester can use.
+        # the requester can use.  Same rule for nodes another shard
+        # replica owns — we could not commit the beneficiary there.
+        shard_gate = self.shards.candidate_gate()
         entries = {name: (e.info, e.usage)
                    for name, e in snap.items()
                    if name in offered
-                   and self.leases.reject_reason(name) is None}
+                   and self.leases.reject_reason(name) is None
+                   and (shard_gate is None or shard_gate(name) is None)}
         return plan_preemption(
             requests, pod_priority(pod, self.cfg), entries,
             pods_by_node, anns, self.cfg.topology_policy,
@@ -1576,6 +1640,7 @@ class Scheduler:
         clone = score_mod.clone_usage
         failed: Dict[str, str] = {}
         best: Optional[Tuple[float, str, List]] = None
+        shard_gate = self.shards.candidate_gate()
         for name in node_names:
             entry = snap.get(name)
             if entry is None:
@@ -1585,6 +1650,11 @@ class Scheduler:
             if why_l is not None:
                 failed[name] = why_l
                 continue
+            if shard_gate is not None:
+                why_s = shard_gate(name)
+                if why_s is not None:
+                    failed[name] = why_s
+                    continue
             # Prune before clone (the type white/blacklist reads no
             # usage — rejecting here skips the whole-chip-map copy).
             why_t = score_mod.type_excluded(affinity, entry.usage)
@@ -1681,10 +1751,12 @@ class Scheduler:
         # the offered candidates (an empty offer means all, matching the
         # pre-snapshot behavior).
         offered = set(node_names) if node_names else None
+        shard_gate = self.shards.candidate_gate()
         usage = {n: (e.info, e.usage)
                  for n, e in self.snapshot().items()
                  if (offered is None or n in offered)
-                 and self.leases.reject_reason(n) is None}
+                 and self.leases.reject_reason(n) is None
+                 and (shard_gate is None or shard_gate(n) is None)}
         # For an admitted gang a quorum here means replacement members
         # filled freed slots: place ONLY them — the placed peers' grants
         # are already charged in the snapshot, and re-placing bound

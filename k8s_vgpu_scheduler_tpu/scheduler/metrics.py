@@ -345,6 +345,53 @@ class ClusterCollector(Collector):
         defrag_aborted.add_metric(
             [], defrag.aborted_total if defrag else 0)
 
+        # Active-active HA shard layer (shard/; docs/scheduler-
+        # concurrency.md "Sharded control plane").  All families emitted
+        # with the layer inert (epoch 0, owned = whole fleet, zero
+        # counters) so dashboards never reference a vanishing series.
+        # Guarded getattr: collector test stubs predate the shard layer.
+        shard_epoch = GaugeMetricFamily(
+            "vtpu_shard_epoch",
+            "Shard-map epoch this replica operates under (replicas "
+            "disagreeing for more than a tick means the coordination "
+            "object is unreachable; 0 = shard layer inert)",
+        )
+        shards_owned = GaugeMetricFamily(
+            "vtpu_shards_owned",
+            "Registered nodes this replica owns placements for under "
+            "the current shard map (the whole fleet when the shard "
+            "layer is inert)",
+        )
+        shards_orphaned = GaugeMetricFamily(
+            "vtpu_shards_orphaned",
+            "Registered nodes whose owner replica's lease is Dead but "
+            "whose shards have not been reassigned yet — nonzero for "
+            "longer than an epoch bump + adoption grace means "
+            "rebalancing is stuck (VtpuShardOrphaned)",
+        )
+        shard_rebalances = CounterMetricFamily(
+            "vtpu_shard_rebalances",
+            "Epoch transitions this replica adopted shards on (each "
+            "one replays the adopted nodes' decision-annotation WAL)",
+        )
+        cas_failures = CounterMetricFamily(
+            "vtpu_commit_cas_failures",
+            "Sharded decision commits that failed closed, by reason "
+            "(stale-map / lost-ownership / adopting: the epoch fence; "
+            "rv-conflict / already-decided: a concurrent peer decision "
+            "on the same pod; pod-gone / read-failed / write-failed: "
+            "apiserver I/O) — every one requeues its pod",
+            labels=["reason"],
+        )
+        shards = getattr(self.scheduler, "shards", None)
+        if shards is not None:
+            shard_epoch.add_metric([], shards.epoch())
+            shards_owned.add_metric([], shards.owned_count())
+            shards_orphaned.add_metric([], len(shards.orphaned_nodes()))
+            shard_rebalances.add_metric([], shards.rebalances_total)
+            for reason, n in sorted(dict(shards.cas_failures).items()):
+                cas_failures.add_metric([reason], n)
+
         batch_fallbacks = CounterMetricFamily(
             "vtpu_filter_batch_fallbacks",
             "Batched-cycle jobs resolved via the per-pod path, by cause "
@@ -394,7 +441,9 @@ class ClusterCollector(Collector):
                 rescued, q_pending, q_admitted, q_share, q_borrowed,
                 q_reclaims, slice_avail, max_box, reserved,
                 defrag_plans, defrag_migrations, defrag_completed,
-                defrag_aborted, u_chip, u_hbm, eff_ratio,
+                defrag_aborted, shard_epoch, shards_owned,
+                shards_orphaned, shard_rebalances, cas_failures,
+                u_chip, u_hbm, eff_ratio,
                 idle_grants] + list(phase_metrics())
 
 

@@ -1,0 +1,31 @@
+"""Active-active scheduler HA: the lease-sharded control plane.
+
+N scheduler replicas run simultaneously against one apiserver.  Node
+ownership is partitioned by an epoch-numbered **shard map** maintained
+through replica leases (the same deadline failure detector that watches
+node agents, health/lease.py) and published as an apiserver object every
+replica converges on (shardmap.py).  A decision commit becomes an
+apiserver **compare-and-swap** on the pod's decision annotation, fenced
+by the shard epoch (commit.py) — a replica holding a stale map fails
+closed and the pod requeues.  When a replica dies, survivors bump the
+epoch and **adopt** its orphaned shards through the rescuer path:
+re-seed the node leases, replay the decision annotations as the WAL to
+reconstruct the registry slice, then resume (rebalance.py).
+
+With no ``--shard-replica`` configured the whole layer is inert and the
+scheduler is bit-for-bit the single-replica hot path
+(docs/scheduler-concurrency.md, "Sharded control plane").
+"""
+
+from .commit import (  # noqa: F401
+    SHARD_EPOCH_ANNOTATION,
+    SHARD_OWNER_ANNOTATION,
+    cas_commit,
+)
+from .shardmap import (  # noqa: F401
+    COORD_OBJECT,
+    SHARD_MAP_ANNOTATION,
+    ShardConfig,
+    ShardManager,
+    ShardMap,
+)

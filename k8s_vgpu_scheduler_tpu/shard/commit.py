@@ -1,0 +1,114 @@
+"""CAS decision commit: the cross-replica half of the rev-chain invariant.
+
+Within one replica, docs/scheduler-concurrency.md's optimistic protocol
+already guarantees a grant is only recorded against a validated (pod
+rev, inventory rev) generation.  Across replicas the apiserver itself is
+the shared store, so the decision WRITE becomes the transaction: a
+merge-patch of the pod's decision annotations carrying the pod's
+``metadata.resourceVersion`` — the apiserver (and FakeKube, which
+mirrors the semantics) rejects it with 409 when the pod changed since
+that version.  Combined with the shard fence this makes a commit a
+compare-and-swap keyed by (shard epoch, pod resourceVersion):
+
+- **epoch fence** (``ShardManager.commit_fence``): the replica's map
+  must be fresh and it must still own the winning node — a stale-epoch
+  or disowned commit fails closed before any I/O;
+- **pod CAS**: two replicas deciding the SAME pod concurrently (each on
+  its own shard — both placements may be individually valid) race on
+  the resourceVersion; exactly one patch lands, the loser rolls its
+  tentative grant back and the pod requeues.
+
+Every failure path requeues rather than retries in place: the next
+Filter re-evaluates against a fresh map and a fresh pod — fail closed,
+never fail open.  Failures are counted by reason
+(``vtpu_commit_cas_failures_total{reason}``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from ..k8s.client import Conflict, NotFound, pod_name, pod_namespace
+from ..util.types import ASSIGNED_NODE_ANNOTATION
+
+log = logging.getLogger(__name__)
+
+#: Stamped on every sharded decision: the epoch the commit was fenced
+#: at, and the replica that wrote it.  The adoption replay and the HA
+#: simulator's no-grant-lost audit read these back.
+SHARD_EPOCH_ANNOTATION = "vtpu.dev/shard-epoch"
+SHARD_OWNER_ANNOTATION = "vtpu.dev/shard-owner"
+
+
+def _decision_of(pod: dict):
+    """(assigned node, shard owner) already on a pod — read-only (no
+    setdefault mutation of the caller's dict)."""
+    anns = pod.get("metadata", {}).get("annotations", {})
+    return (anns.get(ASSIGNED_NODE_ANNOTATION, ""),
+            anns.get(SHARD_OWNER_ANNOTATION, ""))
+
+
+def cas_commit(client, shards, pod: dict, node: str,
+               patch: Dict[str, str]) -> Optional[str]:
+    """Write ``patch`` (the decision annotations) as a fenced CAS.
+    Returns None on success, else the requeue reason (the caller rolls
+    the tentative grant back, exactly like a failed plain write)."""
+    fence, epoch = shards.commit_fence(node)
+    if fence is not None:
+        shards.note_cas_failure(fence)
+        return (f"shard-fence: {fence} — decision on {node} not "
+                f"committed, pod requeued")
+    namespace, name = pod_namespace(pod), pod_name(pod)
+    full = dict(patch)
+    full[SHARD_EPOCH_ANNOTATION] = str(epoch)
+    full[SHARD_OWNER_ANNOTATION] = shards.replica
+    assigned, owner = _decision_of(pod)
+    if assigned and owner and owner != shards.replica:
+        # The offered pod already carries a PEER's committed decision.
+        # Re-deciding our OWN earlier assignment is legitimate (the
+        # Filter drops the stale grant first, single-replica semantics);
+        # stealing a peer's is not — even with a fresh resourceVersion
+        # the CAS would "succeed" at overwriting a valid placement.  A
+        # pod that must genuinely move owners goes through rescission
+        # (the annotations are cleared first) or shard adoption.
+        shards.note_cas_failure("already-decided")
+        return (f"shard-cas: {namespace}/{name} already assigned to "
+                f"{assigned} by {owner}")
+    rv = pod.get("metadata", {}).get("resourceVersion")
+    if rv is None:
+        # The Filter payload carried no resourceVersion (in-process
+        # embedders and the fakes): read-then-CAS — the read linearizes
+        # the race at the apiserver just the same.
+        try:
+            current = client.get_pod(namespace, name)
+        except NotFound:
+            shards.note_cas_failure("pod-gone")
+            return f"shard-cas: {namespace}/{name} gone before commit"
+        except Exception as e:  # noqa: BLE001 — requeue, next Filter retries
+            shards.note_cas_failure("read-failed")
+            return f"shard-cas: cannot read {namespace}/{name}: {e}"
+        assigned, owner = _decision_of(current)
+        if assigned and owner and owner != shards.replica:
+            # Same rule against the LIVE pod: a peer's decision landed
+            # since the view we decided on — don't race the patch.
+            shards.note_cas_failure("already-decided")
+            return (f"shard-cas: {namespace}/{name} already assigned to "
+                    f"{assigned} by {owner}")
+        rv = current.get("metadata", {}).get("resourceVersion")
+    try:
+        client.patch_pod_annotations(namespace, name, full,
+                                     resource_version=rv)
+    except Conflict:
+        # The pod moved under us — a peer's decision, a deletion
+        # mid-flight, any write.  Which one doesn't matter: fail closed.
+        shards.note_cas_failure("rv-conflict")
+        return (f"shard-cas: {namespace}/{name} changed since rv {rv}; "
+                "decision not committed, pod requeued")
+    except NotFound:
+        shards.note_cas_failure("pod-gone")
+        return f"shard-cas: {namespace}/{name} gone before commit"
+    except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
+        shards.note_cas_failure("write-failed")
+        return f"shard-cas: writing decision failed: {e}"
+    return None

@@ -1,0 +1,150 @@
+"""Replica-kill rebalancing: surviving replicas adopt orphaned shards.
+
+When an epoch bump hands this replica nodes it did not own before, each
+adopted node goes through a three-step handoff before it is placeable:
+
+1. **Grace** — the node stays unplaceable for ``adoption_grace_s`` after
+   the new map was published.  The dead (or demoted) previous owner may
+   still hold in-flight decisions computed under the old epoch; by the
+   end of the grace its commits either landed (and the annotation WAL
+   below picks them up) or fail the commit fence's staleness check
+   (shardmap.py) — so the replay observes a quiescent node.
+2. **WAL replay** — the decision annotations ARE the write-ahead log
+   (the same annotation-as-WAL discipline quota's queue-state and the
+   preemption ledger already rely on): list the pods assigned to the
+   adopted nodes and feed them through ``Scheduler.on_pod_event``, which
+   rebuilds the registry slice — grants, gang memberships, priorities —
+   exactly as a restart's resync would, but scoped to the shard.
+3. **Lease adoption** — reset the node's lease to UNTRACKED (forget any
+   stale record), the same state a restarted scheduler boots with: the
+   node is placeable, and the failure detector's deadline starts fresh
+   from the agent's first reconnect beat.  A node whose agent then goes
+   silent decays Healthy→Suspect→Dead on THIS replica and the normal
+   rescuer path takes its grants.  (Seeding a synthetic beat instead
+   would brick agent-less embedders: the fake beat decays to Suspect
+   with nobody to refresh it.)
+
+Orphaned *pending* pods need no adoption: they carry no decision yet,
+so the next kube-scheduler retry simply lands on a surviving replica —
+the simulator's HA scenario (cmd/simulate.py) drives that loop and
+asserts every one re-places with zero double-booked chips.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..k8s.client import pod_uid
+from ..util.types import ASSIGNED_NODE_ANNOTATION
+
+log = logging.getLogger(__name__)
+
+
+class Rebalancer:
+    def __init__(self, scheduler, shards,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.s = scheduler
+        self.shards = shards
+        self._clock = clock or time.monotonic
+        self._lock = threading.Lock()
+        # node -> (placeable_at, orphaned_at): pending adoptions.
+        self._pending: Dict[str, tuple] = {}
+        #: Nodes adopted over this replica's lifetime, and the per-node
+        #: handoff latencies (orphan → placeable) the HA report publishes.
+        self.adopted_total = 0
+        self.last_adoption_latency_s: List[float] = []
+
+    # -- gates -----------------------------------------------------------------
+    def adopting_reason(self, node: str) -> Optional[str]:
+        """Non-None while ``node`` is mid-handoff (grace not elapsed or
+        WAL not replayed yet) — both the Filter gate and the commit
+        fence consult this.  The no-pending fast path is one dict read."""
+        if not self._pending:
+            return None
+        with self._lock:
+            entry = self._pending.get(node)
+        if entry is None:
+            return None
+        return (f"shard-adopting: {node} mid-handoff "
+                f"({max(0.0, entry[0] - self._clock()):.1f}s grace left)")
+
+    # -- transitions -----------------------------------------------------------
+    def on_map_change(self, old, new, now: float) -> Set[str]:
+        """Epoch transition: compute the nodes this replica GAINED and
+        queue their handoff.  The very first map (epoch 1, no previous)
+        is the boot partition — nobody else ever owned those nodes, so
+        they are placeable immediately."""
+        me = self.shards.replica
+        gained: Set[str] = set()
+        for node in self.s.nodes.list_nodes():
+            if new.owner_of(node) != me:
+                continue
+            if old is None:
+                if new.epoch <= 1:
+                    continue        # boot partition: no previous owner
+                gained.add(node)    # unknown history: conservative grace
+            elif old.owner_of(node) != me:
+                gained.add(node)
+        if not gained:
+            return gained
+        grace = self.shards.cfg.adoption_grace_s
+        with self._lock:
+            for node in gained:
+                if node not in self._pending:
+                    self._pending[node] = (now + grace, now)
+        sample = sorted(gained)[:8]
+        log.warning("epoch %d: adopting %d orphaned shard(s): %s%s",
+                    new.epoch, len(gained), sample,
+                    "…" if len(gained) > len(sample) else "")
+        return gained
+
+    def adopt_due(self, now: float) -> List[dict]:
+        """Finish handoffs whose grace elapsed: one pod list, replay the
+        decision-annotation WAL for every due node, seed the node
+        leases, mark placeable."""
+        with self._lock:
+            due = [n for n, (ready_at, _t0) in self._pending.items()
+                   if now >= ready_at]
+        if not due:
+            return []
+        actions: List[dict] = []
+        try:
+            pods = self.s.client.list_pods()
+        except Exception as e:  # noqa: BLE001 — next tick retries
+            log.warning("adoption WAL list failed: %s", e)
+            return []
+        due_set = set(due)
+        replayed = 0
+        for pod in pods:
+            anns = pod.get("metadata", {}).get("annotations", {})
+            if anns.get(ASSIGNED_NODE_ANNOTATION, "") in due_set:
+                # The informer usually delivered these already
+                # (refresh_if_unchanged makes the replay a no-op); a
+                # replica running without a watch rebuilds here.
+                self.s.on_pod_event("ADDED", pod)
+                replayed += 1
+        for node in due:
+            self.s.leases.forget(node)
+            with self._lock:
+                entry = self._pending.pop(node, None)
+                if entry is None:
+                    continue
+                self.adopted_total += 1
+                latency = now - entry[1]
+                self.last_adoption_latency_s.append(latency)
+                if len(self.last_adoption_latency_s) > 256:
+                    del self.last_adoption_latency_s[:-256]
+            actions.append({"kind": "shard-adopted", "node": node,
+                            "latency_s": round(latency, 3)})
+        if actions:
+            log.warning("adopted %d shard(s) (last %.1fs after "
+                        "orphaning); %d WAL pod(s) replayed this pass",
+                        len(actions), actions[-1]["latency_s"], replayed)
+        return actions
+
+    def pending_nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._pending)

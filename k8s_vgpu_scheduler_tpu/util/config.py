@@ -192,6 +192,29 @@ class Config:
     # A plan asking more victims than this is not "minimal compaction".
     defrag_max_victims: int = 8
 
+    # Active-active scheduler HA (shard/; docs/scheduler-concurrency.md,
+    # "Sharded control plane").  shard_replica is this replica's name
+    # (the chart passes the pod name); EMPTY = the shard layer is inert
+    # and the scheduler is bit-for-bit the single-replica hot path.
+    shard_replica: str = ""
+    # Replica-lease deadline detector (same shape as node leases):
+    # seconds without a coordination beat before a replica is Suspect,
+    # and how many MORE ttl periods before it is Dead and its shards
+    # are adopted by survivors.
+    shard_ttl_s: float = 15.0
+    shard_grace_beats: int = 2
+    # Coordination tick period (heartbeat + map poll + adoption).
+    shard_tick_s: float = 3.0
+    # Commit fence: a decision write whose shard map was read more than
+    # this long ago fails closed (the pod requeues).
+    shard_stale_ttl_s: float = 10.0
+    # How long an adopted shard stays unplaceable after an epoch bump
+    # while the previous owner's in-flight commits drain into the
+    # staleness fence.  Must be >= shard_stale_ttl_s.
+    shard_adoption_grace_s: float = 12.0
+    # Name of the coordination object (a Node) the map is CASed on.
+    shard_coord_object: str = "vtpu-shard-coordination"
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

@@ -40,6 +40,18 @@ def make_env(n_nodes=8, **cfg_kwargs):
     # filter_batch explicitly and are unaffected by the knob.
     if os.environ.get("VTPU_TEST_FILTER_BATCH") == "1":
         cfg_kwargs.setdefault("filter_batch", True)
+    # `make shard-protocol` re-runs the suite with the shard layer
+    # ACTIVE as a single replica owning the whole fleet: every decision
+    # passes the epoch fence and commits via pod-resourceVersion CAS
+    # (shard/commit.py) under the same racing load.  A large stale-TTL
+    # keeps the fence green for the suite's wall-clock (nothing here
+    # bumps epochs; the fencing-under-transition races live in
+    # tests/test_shard.py).
+    sharded = os.environ.get("VTPU_TEST_SHARD_FENCE") == "1"
+    if sharded:
+        cfg_kwargs.setdefault("shard_replica", "stress-replica")
+        cfg_kwargs.setdefault("shard_stale_ttl_s", 3600.0)
+        cfg_kwargs.setdefault("shard_adoption_grace_s", 3600.0)
     kube = FakeKube()
     s = Scheduler(kube, Config(**cfg_kwargs))
     names = [f"node-{i}" for i in range(n_nodes)]
@@ -47,6 +59,9 @@ def make_env(n_nodes=8, **cfg_kwargs):
         kube.add_node({"metadata": {"name": n, "annotations": {}}})
         register_node(s, n, chips=CHIPS_PER_NODE, devmem=CHIP_MIB)
     kube.watch_pods(s.on_pod_event)
+    if sharded and s.shards.enabled:
+        s.shards.tick()
+        assert s.shards.active, "shard map must converge before the test"
     return kube, s, names
 
 

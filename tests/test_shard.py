@@ -1,0 +1,614 @@
+"""Active-active HA shard layer: multi-replica protocol suite.
+
+What is pinned here (docs/scheduler-concurrency.md "Sharded control
+plane"):
+
+- FakeKube's pod-annotation CAS is a REAL compare-and-swap (409 on a
+  stale resourceVersion, not last-writer-wins) — the substrate every
+  contention test below relies on;
+- rendezvous ownership is deterministic and minimally disruptive
+  (removing a replica moves only its nodes);
+- two in-process replicas racing one shard map: the epoch fence and the
+  pod CAS reject exactly the loser, and no chip is ever double-booked;
+- seeded replica-kill adoption is deterministic (same seed → identical
+  report) and replays the decision-annotation WAL;
+- downstream loops are shard-aware: quota admission and defrag run on
+  exactly one elected replica, and the rescuer never double-evicts
+  across a shard handoff;
+- single-replica mode is bit-for-bit the pre-shard path: with no shard
+  map the gates are never consulted, decisions ride the group-commit
+  batcher, and no shard annotations are written.
+"""
+
+import json
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.health.lease import LeaseState
+from k8s_vgpu_scheduler_tpu.k8s.client import Conflict
+from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+from k8s_vgpu_scheduler_tpu.shard import (
+    SHARD_EPOCH_ANNOTATION,
+    SHARD_OWNER_ANNOTATION,
+)
+from k8s_vgpu_scheduler_tpu.shard.shardmap import (
+    SHARD_MAP_ANNOTATION,
+    ShardConfig,
+    ShardMap,
+)
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import ASSIGNED_NODE_ANNOTATION
+
+from tests.test_scheduler_core import register_node, tpu_pod
+
+TTL = 10.0          # replica-lease ttl used throughout (grace_beats=1
+#                     ⇒ a silent replica is Dead after 2*TTL)
+STALE = 5.0
+GRACE = 6.0
+
+
+def shard_cfg(i, **kw):
+    kw.setdefault("shard_replica", f"r{i}")
+    kw.setdefault("shard_ttl_s", TTL)
+    kw.setdefault("shard_grace_beats", 1)
+    kw.setdefault("shard_stale_ttl_s", STALE)
+    kw.setdefault("shard_adoption_grace_s", GRACE)
+    return Config(**kw)
+
+
+def make_fleet(n_rep=2, n_nodes=4, chips=4, watch=True, **cfg_kw):
+    """N replica Schedulers over ONE FakeKube, converged on a shard map."""
+    kube = FakeKube()
+    clock = SimClock()
+    reps = []
+    for i in range(n_rep):
+        reps.append(Scheduler(kube, shard_cfg(i, **cfg_kw), clock=clock))
+    names = [f"node-{i}" for i in range(n_nodes)]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        for s in reps:
+            register_node(s, n, chips=chips)
+    if watch:
+        for s in reps:
+            kube.watch_pods(s.on_pod_event)
+    converge(reps, clock, names)
+    return kube, reps, names, clock
+
+
+def converge(reps, clock, names, rounds=20):
+    """Tick everyone until the epoch is shared and every node is
+    placeable by its owner (boot adoptions served their grace)."""
+    for _ in range(rounds):
+        for s in reps:
+            s.shards.tick()
+        if all(s.shards.active for s in reps) and len(
+                {s.shards.epoch() for s in reps}) == 1:
+            m = reps[0].shards.map
+            if set(m.replicas) == {s.shards.replica for s in reps} and all(
+                    owner_of(reps, n).shards.reject_reason(n) is None
+                    for n in names):
+                return
+        clock.advance(1.0)
+    raise AssertionError(
+        f"shard map never converged: "
+        f"{[(s.shards.replica, s.shards.epoch()) for s in reps]}")
+
+
+def owner_of(reps, node):
+    m = next(s for s in reps if s.shards.active).shards.map
+    owner = m.owner_of(node)
+    return next(s for s in reps if s.shards.replica == owner)
+
+
+def close_all(reps):
+    for s in reps:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# FakeKube CAS semantics (the satellite fix + regression test)
+# ---------------------------------------------------------------------------
+class TestFakeKubePodCas:
+    def test_stale_resource_version_is_conflict_not_last_writer_wins(self):
+        kube = FakeKube()
+        pod = kube.create_pod(tpu_pod("p", uid="u"))
+        rv = pod["metadata"]["resourceVersion"]
+        # A concurrent writer lands first...
+        kube.patch_pod_annotations("default", "p", {"x": "peer"})
+        # ...so the CAS with the pre-write rv must 409 and change NOTHING.
+        with pytest.raises(Conflict):
+            kube.patch_pod_annotations("default", "p", {"x": "loser"},
+                                       resource_version=rv)
+        assert kube.get_pod("default", "p")["metadata"]["annotations"][
+            "x"] == "peer"
+
+    def test_matching_resource_version_applies(self):
+        kube = FakeKube()
+        kube.create_pod(tpu_pod("p", uid="u"))
+        rv = kube.get_pod("default", "p")["metadata"]["resourceVersion"]
+        out = kube.patch_pod_annotations("default", "p", {"x": "winner"},
+                                         resource_version=rv)
+        assert out["metadata"]["annotations"]["x"] == "winner"
+        assert out["metadata"]["resourceVersion"] != rv
+
+    def test_no_resource_version_keeps_plain_merge_semantics(self):
+        kube = FakeKube()
+        kube.create_pod(tpu_pod("p", uid="u"))
+        kube.patch_pod_annotations("default", "p", {"x": "a"})
+        kube.patch_pod_annotations("default", "p", {"x": "b"})
+        assert kube.get_pod("default", "p")["metadata"]["annotations"][
+            "x"] == "b"
+
+    def test_create_node_conflicts_on_existing(self):
+        kube = FakeKube()
+        kube.create_node({"metadata": {"name": "coord"}})
+        with pytest.raises(Conflict):
+            kube.create_node({"metadata": {"name": "coord"}})
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous ownership
+# ---------------------------------------------------------------------------
+class TestShardMap:
+    NODES = [f"node-{i}" for i in range(64)]
+
+    def test_deterministic_across_instances(self):
+        a = ShardMap(1, ("r0", "r1", "r2"))
+        b = ShardMap(1, ("r0", "r1", "r2"))
+        assert [a.owner_of(n) for n in self.NODES] \
+            == [b.owner_of(n) for n in self.NODES]
+
+    def test_every_replica_owns_something(self):
+        m = ShardMap(1, ("r0", "r1", "r2", "r3"))
+        owners = {m.owner_of(n) for n in self.NODES}
+        assert owners == set(m.replicas)
+
+    def test_removing_a_replica_moves_only_its_nodes(self):
+        before = ShardMap(1, ("r0", "r1", "r2"))
+        after = ShardMap(2, ("r0", "r2"))
+        for n in self.NODES:
+            if before.owner_of(n) != "r1":
+                assert after.owner_of(n) == before.owner_of(n)
+            else:
+                assert after.owner_of(n) in ("r0", "r2")
+
+    def test_singleton_owner_is_one_live_replica(self):
+        m = ShardMap(3, ("r0", "r1", "r2"))
+        for role in ("quota-admission", "defrag"):
+            assert m.singleton_owner(role) in m.replicas
+
+    def test_codec_roundtrip(self):
+        m = ShardMap(7, ("a", "b"))
+        assert ShardMap.decode(m.encode()) == m
+        assert ShardMap.decode("") is None
+        assert ShardMap.decode("not json") is None
+
+    def test_adoption_grace_must_cover_stale_ttl(self):
+        with pytest.raises(ValueError):
+            ShardConfig(replica="r0", stale_ttl_s=10.0,
+                        adoption_grace_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# Two replicas, one map: fencing + CAS under contention
+# ---------------------------------------------------------------------------
+class TestTwoReplicaProtocol:
+    def test_replicas_converge_and_partition_is_disjoint(self):
+        kube, reps, names, clock = make_fleet()
+        assert reps[0].shards.epoch() == reps[1].shards.epoch()
+        for n in names:
+            gates = [s.shards.reject_reason(n) is None for s in reps]
+            assert gates.count(True) == 1, (n, gates)
+        close_all(reps)
+
+    def test_decisions_stay_on_owned_shards_and_are_stamped(self):
+        kube, reps, names, clock = make_fleet()
+        for i in range(8):
+            pod = tpu_pod(f"p{i}", uid=f"u{i}", mem="2000")
+            kube.create_pod(pod)
+            placed = None
+            for s in reps:
+                r = s.filter(pod, names)
+                if r.node:
+                    placed = (s, r.node)
+                    break
+            assert placed is not None
+            s, node = placed
+            assert s.shards.map.owner_of(node) == s.shards.replica
+            anns = kube.get_pod("default", f"p{i}")["metadata"][
+                "annotations"]
+            assert anns[SHARD_OWNER_ANNOTATION] == s.shards.replica
+            assert anns[SHARD_EPOCH_ANNOTATION] == str(s.shards.epoch())
+        close_all(reps)
+
+    def test_pod_cas_rejects_the_racing_loser(self):
+        """Two replicas decide the SAME pod 'concurrently': the loser's
+        commit CASes against the resourceVersion it decided at and must
+        fail closed — one decision survives, the loser's tentative
+        grant is rolled back."""
+        kube, reps, names, clock = make_fleet()
+        a, b = reps
+        kube.create_pod(tpu_pod("race", uid="race-u", mem="2000"))
+        # A captures the pod (WITH its resourceVersion) before B decides
+        # — the stale view a slow replica would race with.
+        stale = kube.get_pod("default", "race")
+        r_b = b.filter(kube.get_pod("default", "race"), names)
+        assert r_b.node, (r_b.error, r_b.failed)
+        r_a = a.filter(stale, names)
+        assert r_a.node is None
+        assert "shard-cas" in r_a.error
+        assert a.shards.cas_failures.get("rv-conflict", 0) \
+            + a.shards.cas_failures.get("already-decided", 0) == 1
+        # Exactly one decision stands, and the loser holds no grant.
+        anns = kube.get_pod("default", "race")["metadata"]["annotations"]
+        assert anns[ASSIGNED_NODE_ANNOTATION] == r_b.node
+        assert anns[SHARD_OWNER_ANNOTATION] == b.shards.replica
+        assert a.pods.get("race-u") is None \
+            or a.pods.get("race-u").node == r_b.node
+        close_all(reps)
+
+    def test_peer_cannot_steal_a_decided_pod_even_with_fresh_rv(self):
+        """Regression (caught by the process-level e2e drive): a pod
+        already carrying a PEER's committed decision must not be
+        re-decided by another replica even when the offered view's
+        resourceVersion is CURRENT — a fresh rv makes the raw CAS
+        'succeed' at overwriting a valid placement, so the foreign-
+        decision check must run on the offered pod itself, not only on
+        the read-back path."""
+        kube, reps, names, clock = make_fleet()
+        a, b = reps
+        kube.create_pod(tpu_pod("steal", uid="steal-u", mem="2000"))
+        r_b = b.filter(kube.get_pod("default", "steal"), names)
+        assert r_b.node
+        fresh = kube.get_pod("default", "steal")   # rv AFTER b's commit
+        r_a = a.filter(fresh, names)
+        assert r_a.node is None
+        assert a.shards.cas_failures.get("already-decided") == 1
+        anns = kube.get_pod("default", "steal")["metadata"]["annotations"]
+        assert anns[ASSIGNED_NODE_ANNOTATION] == r_b.node
+        assert anns[SHARD_OWNER_ANNOTATION] == b.shards.replica
+        # B re-deciding its OWN pod stays legitimate (single-replica
+        # re-filter semantics).
+        r_b2 = b.filter(kube.get_pod("default", "steal"), names)
+        assert r_b2.node
+        close_all(reps)
+
+    def test_stale_map_commit_fails_closed(self):
+        kube, reps, names, clock = make_fleet()
+        a = reps[0]
+        mine = next(n for n in names
+                    if a.shards.reject_reason(n) is None)
+        # The map goes stale (no tick for > stale_ttl): the fence must
+        # refuse the commit even though ownership never changed.
+        clock.advance(STALE + 1.0)
+        pod = tpu_pod("stale", uid="stale-u", mem="2000")
+        kube.create_pod(pod)
+        r = a.filter(pod, [mine])
+        assert r.node is None and "stale-map" in r.error
+        assert a.shards.cas_failures.get("stale-map") == 1
+        assert a.pods.get("stale-u") is None
+        close_all(reps)
+
+    def test_epoch_fence_rejects_lost_ownership(self):
+        """Ownership moves between decision and commit (the
+        coordination thread observes an epoch bump mid-decision): the
+        commit fence rejects the loser and the grant rolls back.  The
+        swap is injected at the exact decision/commit boundary by
+        wrapping the REAL fence — only the timing is simulated, the
+        fencing logic under test is untouched."""
+        kube, reps, names, clock = make_fleet()
+        a, b = reps
+        mine = next(n for n in names
+                    if a.shards.reject_reason(n) is None)
+        usurped = ShardMap(epoch=a.shards.epoch() + 1,
+                           replicas=(b.shards.replica,))
+        real_fence = a.shards.commit_fence
+
+        def racing_fence(node):
+            a.shards._map = usurped
+            a.shards._map_read_at = clock()
+            return real_fence(node)
+
+        a.shards.commit_fence = racing_fence
+        pod = tpu_pod("fenced", uid="fenced-u", mem="2000")
+        kube.create_pod(pod)
+        r = a.filter(pod, [mine])
+        assert r.node is None and "lost-ownership" in r.error
+        assert a.shards.cas_failures.get("lost-ownership") == 1
+        assert a.pods.get("fenced-u") is None
+        anns = kube.get_pod("default", "fenced")["metadata"][
+            "annotations"]
+        assert not anns.get(ASSIGNED_NODE_ANNOTATION)
+        close_all(reps)
+
+
+class TestFailClosedBeforeMap:
+    def test_enabled_without_map_rejects_everything(self):
+        """Sharding enabled but no map observed yet (boot, or the
+        coordination object unreachable): the replica must fail CLOSED
+        — reject every candidate, own nothing, lead nothing — not
+        place unfenced on the whole fleet."""
+        kube = FakeKube()
+        clock = SimClock()
+        s = Scheduler(kube, shard_cfg(0), clock=clock)
+        kube.add_node({"metadata": {"name": "node-0", "annotations": {}}})
+        register_node(s, "node-0")
+        kube.watch_pods(s.on_pod_event)
+        assert s.shards.enabled and not s.shards.active
+        pod = tpu_pod("blind", uid="blind-u", mem="2000")
+        kube.create_pod(pod)
+        r = s.filter(pod, ["node-0"])
+        assert r.node is None
+        assert "shard-no-map" in r.failed["node-0"]
+        assert not s.shards.owns("node-0")
+        assert not s.shards.leads("quota-admission")
+        assert not s.shards.placeable("node-0")
+        assert s.shards.commit_fence("node-0")[0] == "no-map"
+        # Batched front door fails closed the same way.
+        batched = s.filter_many([(pod, ["node-0"])])
+        assert batched[0].node is None
+        # First successful tick unbricks placement.
+        s.shards.tick()
+        assert s.shards.active
+        assert s.filter(pod, ["node-0"]).node == "node-0"
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# Replica kill → epoch bump → adoption
+# ---------------------------------------------------------------------------
+class TestReplicaKillRebalance:
+    def kill_and_settle(self, kube, reps, names, clock, victim):
+        alive = [s for s in reps if s is not victim]
+        for _ in range(60):
+            for s in alive:
+                s.shards.tick()
+            if all(s.shards.replica not in
+                   (s2.shards.map.replicas if s2.shards.map else ())
+                   for s in (victim,) for s2 in alive) and all(
+                    not s.shards.rebalancer.pending_nodes()
+                    for s in alive):
+                break
+            clock.advance(2.0)
+        return alive
+
+    def test_survivors_adopt_all_orphans(self):
+        kube, reps, names, clock = make_fleet(n_rep=3, n_nodes=6)
+        victim = reps[1]
+        orphans = [n for n in names
+                   if victim.shards.reject_reason(n) is None]
+        assert orphans, "victim must own something for the test to bite"
+        alive = self.kill_and_settle(kube, reps, names, clock, victim)
+        m = alive[0].shards.map
+        assert victim.shards.replica not in m.replicas
+        for n in names:
+            assert owner_of(alive, n).shards.reject_reason(n) is None
+        adopted = sum(s.shards.rebalancer.adopted_total for s in alive)
+        assert adopted >= len(orphans)
+        close_all(reps)
+
+    def test_orphaned_gauge_flags_the_window(self):
+        """vtpu_shards_orphaned covers the window between a replica's
+        lease death and the epoch bump that reassigns its shards."""
+        kube, reps, names, clock = make_fleet(n_rep=3, n_nodes=6)
+        victim, observer = reps[2], reps[0]
+        orphans = [n for n in names
+                   if victim.shards.reject_reason(n) is None]
+        assert orphans
+        # The victim went silent dead_after ago; the observer still has
+        # the old map (no tick since), so the gauge must see exactly
+        # the victim's shards as ownerless.
+        dead_after = observer.shards.leases.cfg.dead_after_s
+        observer.shards.leases.beat(victim.shards.replica,
+                                    now=clock() - dead_after - 1.0)
+        assert set(observer.shards.orphaned_nodes()) == set(orphans)
+        # After the bump + adoption the gauge clears.
+        alive = self.kill_and_settle(kube, reps, names, clock, victim)
+        for s in alive:
+            assert s.shards.orphaned_nodes() == []
+        close_all(reps)
+
+    def test_seeded_kill_adoption_is_deterministic(self):
+        from k8s_vgpu_scheduler_tpu.cmd.simulate import run_ha_phase
+
+        spec = {"replicas": 3, "seed": 11, "kill_after": 4,
+                "storm": {"name": "t", "tpu": 1, "tpumem": 16384,
+                          "count": 14},
+                "storm_interval_s": 1, "settle_s": 120}
+        runs = [run_ha_phase(spec, nodes=4, chips=4, hbm=16384,
+                             mesh=(4, 1), generation="v5e",
+                             policy="spread")
+                for _ in range(2)]
+        assert runs[0]["verdict"]["ok"], runs[0]["verdict"]
+        assert json.dumps(runs[0], sort_keys=True) \
+            == json.dumps(runs[1], sort_keys=True)
+
+    def test_dead_replica_beat_annotation_is_gced(self):
+        """A Dead replica's beat-counter annotation leaves the
+        coordination object with the epoch bump that drops it —
+        Deployment pod names are unique per rollout, so without the GC
+        the object grows one stale key per restart forever."""
+        from k8s_vgpu_scheduler_tpu.shard.shardmap import (
+            COORD_OBJECT,
+            REPLICA_BEAT_PREFIX,
+        )
+
+        kube, reps, names, clock = make_fleet(n_rep=2)
+        a, b = reps
+        anns = kube.get_node(COORD_OBJECT)["metadata"]["annotations"]
+        assert REPLICA_BEAT_PREFIX + b.shards.replica in anns
+        self.kill_and_settle(kube, reps, names, clock, victim=b)
+        anns = kube.get_node(COORD_OBJECT)["metadata"]["annotations"]
+        assert REPLICA_BEAT_PREFIX + b.shards.replica not in anns
+        assert REPLICA_BEAT_PREFIX + a.shards.replica in anns
+        assert b.shards.replica not in a.shards.map.replicas
+        assert a.shards.leases.state_of(b.shards.replica) is None
+        close_all(reps)
+
+    def test_adoption_replays_decision_wal_without_watch(self):
+        """A survivor that never saw the informer events rebuilds the
+        adopted shard's registry slice from the decision annotations —
+        the WAL replay half of the rescuer path."""
+        kube, reps, names, clock = make_fleet(n_rep=2, n_nodes=4,
+                                              watch=False)
+        a, b = reps
+        # A places a pod on a node IT owns.
+        a_node = next(n for n in names
+                      if a.shards.reject_reason(n) is None)
+        pod = tpu_pod("wal", uid="wal-u", mem="2000")
+        kube.create_pod(pod)
+        r = a.filter(pod, [a_node])
+        assert r.node == a_node
+        assert b.pods.get("wal-u") is None       # no watch: B is blind
+        # A dies; B adopts and must re-learn the grant from the WAL.
+        alive = TestReplicaKillRebalance().kill_and_settle(
+            kube, reps, names, clock, victim=a)
+        assert alive == [b]
+        got = b.pods.get("wal-u")
+        assert got is not None and got.node == a_node
+        close_all(reps)
+
+
+# ---------------------------------------------------------------------------
+# Shard-aware downstream loops
+# ---------------------------------------------------------------------------
+QA = {"name": "qa", "namespaces": ["team-a"], "weight": 1,
+      "quota": {"chips": 4}}
+
+
+class TestDownstreamShardAwareness:
+    def test_quota_admission_runs_on_exactly_one_replica(self):
+        kube, reps, names, clock = make_fleet(
+            n_rep=3, quota_queues=(QA,), queue_reclaim_grace_s=0.0)
+        leaders = [s for s in reps if s.shards.leads("quota-admission")]
+        assert len(leaders) == 1
+        # A governed pod held on every replica's manager is released by
+        # the LEADER's tick only (no double-release across the fleet).
+        pod = tpu_pod("held", uid="held-u", mem="2000")
+        pod["metadata"]["namespace"] = "team-a"
+        kube.create_pod(pod)
+        for s in reps:
+            r = s.filter(pod, names)
+            assert r.node is None and "queue" in r.error
+        acted = [s for s in reps if s.admission.tick()]
+        assert acted == leaders
+        close_all(reps)
+
+    def test_quota_leadership_moves_with_the_epoch(self):
+        kube, reps, names, clock = make_fleet(
+            n_rep=2, quota_queues=(QA,), queue_reclaim_grace_s=0.0)
+        leader = next(s for s in reps
+                      if s.shards.leads("quota-admission"))
+        alive = TestReplicaKillRebalance().kill_and_settle(
+            kube, reps, names, clock, victim=leader)
+        assert all(s.shards.leads("quota-admission") for s in alive)
+        close_all(reps)
+
+    def test_defrag_tick_is_leader_gated(self):
+        kube, reps, names, clock = make_fleet(n_rep=2)
+        followers = [s for s in reps if not s.shards.leads("defrag")]
+        assert len(followers) == 1
+        assert followers[0].defrag.tick() == []
+        close_all(reps)
+
+    def test_rescuer_never_double_evicts_across_a_handoff(self):
+        """A node's lease dies while BOTH replicas track it (the shard
+        moved after the grants landed): only the owner rescues; the
+        non-owner hands its stale lease off without touching grants."""
+        kube, reps, names, clock = make_fleet(n_rep=2, n_nodes=4)
+        a, b = reps
+        node = next(n for n in names
+                    if a.shards.reject_reason(n) is None)
+        pod = tpu_pod("victim", uid="victim-u", mem="2000")
+        kube.create_pod(pod)
+        assert a.filter(pod, [node]).node == node
+        # Both replicas heard the node's agent once, then it went silent.
+        a.leases.beat(node)
+        b.leases.beat(node)
+        clock.advance(a.leases.cfg.dead_after_s + 1.0)
+        a_actions = a.rescuer.sweep()
+        b_actions = b.rescuer.sweep()
+        a_kinds = [x["kind"] for x in a_actions if x.get("node") == node
+                   or x.get("uid") == "victim-u"]
+        b_kinds = [x["kind"] for x in b_actions if x.get("node") == node
+                   or x.get("uid") == "victim-u"]
+        # The owner (a) declared the death and queued the rescue...
+        assert "lease" in a_kinds
+        # ...the non-owner (b) only handed the lease off.
+        assert b_kinds == ["lease-handoff"]
+        assert b.rescuer.pending() == {}
+        close_all(reps)
+
+
+# ---------------------------------------------------------------------------
+# Single-replica parity: the shard layer is INERT by default
+# ---------------------------------------------------------------------------
+class TestSingleReplicaParity:
+    def build(self):
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        names = ["node-0", "node-1"]
+        for n in names:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n)
+        kube.watch_pods(s.on_pod_event)
+        return kube, s, names
+
+    def test_inert_layer_is_never_consulted(self):
+        """No shard map ⇒ the PR 6 hot path bit-for-bit: the gates are
+        never called, the commit fence is never called, and the
+        decision write rides the group-commit batcher."""
+        kube, s, names = self.build()
+        assert not s.shards.active
+
+        def boom(*_a, **_k):  # pragma: no cover - the assert IS the test
+            raise AssertionError("shard layer consulted while inert")
+
+        s.shards.reject_reason = boom
+        s.shards.commit_fence = boom
+        for i in range(4):
+            pod = tpu_pod(f"p{i}", uid=f"u{i}", mem="2000")
+            kube.create_pod(pod)
+            assert s.filter(pod, names).node
+        results = s.filter_many([
+            (kube.create_pod(tpu_pod(f"b{i}", uid=f"bu{i}", mem="500")),
+             names)
+            for i in range(4)])
+        assert all(r.node for r in results)
+        assert s._decisions.writes > 0      # batcher path, not CAS
+        for p in kube.list_pods():
+            anns = p["metadata"]["annotations"]
+            assert SHARD_EPOCH_ANNOTATION not in anns
+            assert SHARD_OWNER_ANNOTATION not in anns
+        s.close()
+
+    def test_inert_tick_is_a_noop(self):
+        kube, s, names = self.build()
+        assert s.shards.tick() == []
+        assert s.shards.owns("node-0")
+        assert s.shards.leads("quota-admission")
+        assert s.shards.reject_reason("node-0") is None
+        assert s.shards.commit_fence("node-0") == (None, 0)
+        s.close()
+
+    def test_shard_metrics_emitted_inert_and_active(self):
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import (
+            ClusterCollector,
+        )
+
+        kube, s, names = self.build()
+        fams = {f.name: f for f in ClusterCollector(s).collect()}
+        assert fams["vtpu_shard_epoch"].samples[0].value == 0
+        assert fams["vtpu_shards_owned"].samples[0].value == len(names)
+        assert fams["vtpu_shards_orphaned"].samples[0].value == 0
+        s.close()
+        kube2, reps, names2, clock = make_fleet(n_rep=2)
+        fams = {f.name: f
+                for f in ClusterCollector(reps[0]).collect()}
+        assert fams["vtpu_shard_epoch"].samples[0].value \
+            == reps[0].shards.epoch() > 0
+        owned = fams["vtpu_shards_owned"].samples[0].value
+        assert 0 < owned < len(names2)
+        close_all(reps)
