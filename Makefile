@@ -2,8 +2,9 @@
 # hack/build.sh + a Makefile; here each surface is one target).
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
-        defrag-sim ha-sim batch-protocol shard-protocol lint-dashboards \
-        dryrun scenarios controlplane bench-controlplane bench wheel clean
+        defrag-sim ha-sim qos-sim batch-protocol shard-protocol \
+        lint-dashboards dryrun scenarios controlplane bench-controlplane \
+        bench wheel clean
 
 all: native
 
@@ -62,6 +63,19 @@ ha-sim:                       ## replica-kill failover A/B in the simulator
 	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
 	    --workload examples/workload-ha.json --nodes 6 --chips 4 --json \
 	  | python -c "import json,sys; v = json.load(sys.stdin)['ha']['verdict']; assert v['ok'], v; print('ha-sim:', v)"
+
+# SLO-tiered co-residency A/B through the REAL native limiters + monitor
+# feedback loop on virtual clocks (docs/serving.md): a latency-critical
+# serve-decode stream next to a best-effort training neighbor, flat
+# duty-cycle limiter vs QoS tiers.  Deterministic (manual clocks, fixed
+# schedule, no RNG); the verdict gates CI: burst credit beats the flat
+# p99 in every bursty phase, the re-weighting loop beats the flat mean
+# under sustained overload, duty shifted AND returned (hysteresis),
+# best-effort goodput within tolerance, zero grant-limit violations.
+qos-sim: native               ## serving-QoS tiered-vs-flat A/B in the simulator
+	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
+	    --workload examples/workload-serving.json --json \
+	  | python -c "import json,sys; v = json.load(sys.stdin)['serving']['verdict']; assert v['ok'], v; print('qos-sim:', v)"
 
 # The scheduler-concurrency protocol suite (racing filter/bind/delete,
 # zero over-grant, conflict convergence) re-run with the batched Filter

@@ -525,6 +525,138 @@ def bench_watch_latency(rounds: int = 20) -> dict:
         sim.stop()
 
 
+def _measure_serve_decode_cost_us() -> "tuple[float, str]":
+    """One REAL int4 TP serve-decode dispatch cost on the CPU tier (the
+    models/serve.py serve leg, quantized + tensor-parallel — ISSUE 10's
+    workload shape), grounding the co-residency schedule in a measured
+    dispatch size.  Falls back to the canonical 10 ms when the model
+    tier is unavailable (the A/B itself runs on virtual clocks either
+    way, so the verdict stays deterministic)."""
+    try:
+        import dataclasses
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4").strip()
+        import jax
+        import jax.numpy as jnp
+
+        from k8s_vgpu_scheduler_tpu.models.llama import Llama, LlamaConfig
+        from k8s_vgpu_scheduler_tpu.models.quant import quantize_params
+        from k8s_vgpu_scheduler_tpu.models.serve import ServingEngine
+        from k8s_vgpu_scheduler_tpu.parallel.mesh import (
+            MeshShape, make_mesh, param_shardings)
+
+        cfg = LlamaConfig(vocab=64, dim=64, n_layers=2, n_heads=4,
+                          n_kv_heads=2, ffn_hidden=128, dtype="float32")
+        params = Llama(cfg).init(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 8), jnp.int32))
+        qcfg = dataclasses.replace(cfg, quant="int4")
+        qparams = quantize_params(params, bits=4)
+        tp = 4 if len(jax.devices()) >= 4 else 1
+        if tp > 1:
+            mesh = make_mesh(MeshShape(dp=1, sp=1, tp=tp, ep=1),
+                             devices=jax.devices()[:tp])
+            qparams = jax.device_put(qparams,
+                                     param_shardings(mesh, qparams))
+        eng = ServingEngine(qcfg, qparams, max_slots=2, max_len=64)
+        eng.submit([3, 1, 4, 1], 48)
+        eng.step()  # compile + first dispatch (excluded)
+        samples = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            eng.step()
+            samples.append((time.perf_counter() - t0) * 1e6)
+        samples.sort()
+        return samples[len(samples) // 2], f"measured int4 tp={tp} cpu"
+    except Exception as e:  # noqa: BLE001 — model tier is optional here
+        return 10_000.0, f"canonical (model tier unavailable: {e})"
+
+
+def bench_coresidency() -> dict:
+    """ISSUE 10 A/B: a latency-critical serve-decode stream (chunk size
+    derived from a measured int4 TP decode step) contending against a
+    best-effort training neighbor on one chip — flat duty-cycle limiter
+    vs SLO-tiered QoS, through the REAL native limiters + monitor
+    feedback loop on virtual clocks (shim/simlab.py; deterministic).
+    Acceptance: critical dispatch-wait p99 improves ≥3x while the
+    best-effort neighbor's goodput stays within 15% of flat, with zero
+    grant-limit violations in either mode.  Emits the COSCHED-style
+    CORESIDENCY_<round>.json artifact."""
+    import shutil
+    import tempfile
+
+    from k8s_vgpu_scheduler_tpu.shim import simlab
+    from k8s_vgpu_scheduler_tpu.util.nativebuild import build_native
+
+    build_native(check=True)
+    measured_us, source = _measure_serve_decode_cost_us()
+    # Schedule derived from the measured step: each chunk NET-drains
+    # 300 ms of tokens (past the flat bucket's 200 ms cap, inside the
+    # tiered 600 ms tokens+credit pool) at 30% average duty against a
+    # 50% share.  Clamped so a degenerate measurement cannot produce a
+    # schedule the bucket constants trivialize.
+    cost_us = int(min(50_000, max(2_000, measured_us)))
+    burst = max(1, round(300_000 / (0.5 * cost_us)))
+    period_us = round(burst * cost_us / 0.3)
+    phases = [{"name": "bursty", "duration_s": 60.0,
+               "serve": {"period_us": period_us, "burst": burst,
+                         "cost_us": cost_us},
+               "train": {"cost_us": 20_000}}]
+    legs = {}
+    for tiered in (False, True):
+        root = tempfile.mkdtemp(prefix="vtpu-cosched-")
+        try:
+            legs["tiered" if tiered else "flat"] = simlab.drive_serving(
+                root, tiered, phases,
+                qos_cfg=simlab.serving_qos_config(),
+                monitor_interval_s=0.25)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+    flat, tiered_leg = legs["flat"], legs["tiered"]
+    p99_flat = flat["critical"]["wait_p99_us"]
+    p99_tiered = tiered_leg["critical"]["wait_p99_us"]
+    improvement = p99_flat / max(p99_tiered, 1.0)
+    be_flat = flat["best_effort"]["admitted_device_s"]
+    be_tiered = tiered_leg["best_effort"]["admitted_device_s"]
+    goodput_ratio = be_tiered / be_flat if be_flat else 1.0
+    violations = (simlab.serving_violations(flat)
+                  + simlab.serving_violations(tiered_leg))
+    passed = (improvement >= 3.0 and goodput_ratio >= 0.85
+              and not violations and p99_flat > 0)
+    artifact = {
+        "serve_decode_cost_us": cost_us,
+        "serve_decode_cost_source": source,
+        "serve_burst_steps": burst,
+        "serve_period_us": period_us,
+        "serve_duty_demand": round(burst * cost_us / period_us, 3),
+        "serve_share_pct": 50,
+        "train_share_pct": 50,
+        "critical_wait_p99_us": {"flat": p99_flat,
+                                 "tiered": p99_tiered},
+        "critical_wait_p50_us": {
+            "flat": flat["critical"]["wait_p50_us"],
+            "tiered": tiered_leg["critical"]["wait_p50_us"]},
+        "critical_p99_improvement": round(min(improvement, 1e6), 1),
+        "best_effort_goodput_device_s": {
+            "flat": round(be_flat, 2), "tiered": round(be_tiered, 2)},
+        "best_effort_goodput_ratio": round(goodput_ratio, 4),
+        "grant_violations": violations,
+        "duty_weights_tiered": tiered_leg["duty_weights"],
+        "platform": "cpu (limiter A/B on virtual clocks)",
+        "passed": passed,
+    }
+    emit("coresidency", artifact)
+    return {"coresidency": {
+        "critical_p99_improvement": artifact["critical_p99_improvement"],
+        "best_effort_goodput_ratio": artifact["best_effort_goodput_ratio"],
+        "grant_violations": len(violations),
+        "passed": passed,
+    }}
+
+
 def main() -> None:
     result = {"scenario": "controlplane", "round": ROUND,
               "platform": "cpu (control plane is chip-free)",
@@ -537,6 +669,7 @@ def main() -> None:
     result.update(bench_batch_cycle())
     result.update(bench_sharded())
     result.update(bench_watch_latency())
+    result.update(bench_coresidency())
     cf = result["concurrent_filter"]
     bc = result["batch_cycle"]
     sh = result["sharded"]
@@ -559,6 +692,9 @@ def main() -> None:
         and all(sh[leg]["double_booked_chips"] == 0
                 and sh[leg]["undecided_pods"] == 0
                 for leg in ("single", "quad"))
+        # SLO-tiered co-residency (ISSUE 10): ≥3x critical p99 with the
+        # best-effort neighbor within 15% and zero grant violations.
+        and result["coresidency"]["passed"]
     )
     emit("controlplane", result)
 

@@ -55,6 +55,15 @@ class PodAccount:
     #: Last time the pod was seen dispatching (active flag, or any
     #: chip-second accrual) — the idle-grant detector's input.
     last_active_at: float = 0.0
+    #: QoS plane (docs/serving.md): class/weight are last-observed; the
+    #: wait totals/histogram are stored as the node's latest monotonic
+    #: values (the sampler already absorbed container restarts, so these
+    #: only move forward within one monitor lifetime — Prometheus-style
+    #: counter semantics fleet-side).
+    qos_class: str = ""
+    qos_weight_pct: int = 100
+    qos_wait_seconds_total: float = 0.0
+    qos_wait_hist: List[int] = dataclasses.field(default_factory=list)
     #: Raw cumulative values of the previous report (reset detection).
     _raw: Dict[str, float] = dataclasses.field(default_factory=dict)
     #: Ring of (t, chip_seconds_total, hbm_byte_seconds_total) samples.
@@ -73,6 +82,12 @@ class UsageLedger:
         #: Lifetime count of counter resets observed (a monitor restart
         #: per pod per field batch — visible for debugging feeds).
         self.resets_observed = 0
+        #: class → (hist, wait_seconds) folded in from PRUNED accounts:
+        #: the fleet-wide per-class dispatch-wait series are sums over
+        #: accounts, and dropping a retired pod's contribution would
+        #: make a Prometheus counter go backwards (rate() then reads
+        #: the dip as a reset and reports a spurious spike).
+        self._qos_retired: Dict[str, tuple] = {}
 
     def now(self) -> float:
         return self._clock()
@@ -119,6 +134,14 @@ class UsageLedger:
                         if field == "chip_seconds":
                             accrued = True
                 acct.chips = int(row.get("chips", acct.chips))
+                if row.get("qos_class"):
+                    acct.qos_class = row["qos_class"]
+                    acct.qos_weight_pct = int(
+                        row.get("qos_weight_pct", 100) or 100)
+                    acct.qos_wait_seconds_total = float(
+                        row.get("qos_wait_seconds_total", 0.0))
+                    acct.qos_wait_hist = list(
+                        row.get("qos_wait_hist", ()))
                 acct.active = bool(row.get("active", False))
                 acct.oversubscribe = bool(row.get("oversubscribe",
                                                   acct.oversubscribe))
@@ -134,7 +157,25 @@ class UsageLedger:
     def _prune_locked(self, now: float) -> None:
         for uid in [u for u, a in self._accounts.items()
                     if now - a.last_recorded > self.retention_s]:
-            del self._accounts[uid]
+            acct = self._accounts.pop(uid)
+            if acct.qos_class:
+                hist, s = self._qos_retired.get(acct.qos_class,
+                                                ([], 0.0))
+                hist = list(hist)
+                if len(hist) < len(acct.qos_wait_hist):
+                    hist += [0] * (len(acct.qos_wait_hist) - len(hist))
+                for i, n in enumerate(acct.qos_wait_hist):
+                    hist[i] += n
+                self._qos_retired[acct.qos_class] = (
+                    hist, s + acct.qos_wait_seconds_total)
+
+    def qos_retired(self) -> Dict[str, tuple]:
+        """class → (hist bucket counts, wait_seconds) of pruned
+        accounts — the base the fleet-wide per-class histograms add so
+        they stay monotonic across account GC."""
+        with self._lock:
+            return {cls: (list(h), s)
+                    for cls, (h, s) in self._qos_retired.items()}
 
     # -- queries ---------------------------------------------------------------
     def get(self, uid: str) -> Optional[PodAccount]:
@@ -219,6 +260,11 @@ def decode_usage(usage_msgs) -> List[dict]:
             "throttled_seconds": m.throttled_seconds,
             "oversub_spill_seconds": m.oversub_spill_seconds,
             "window_s": m.window_s,
+            "qos_class": getattr(m, "qos_class", ""),
+            "qos_weight_pct": int(getattr(m, "qos_weight_pct", 0) or 100),
+            "qos_wait_seconds_total": getattr(
+                m, "qos_wait_seconds_total", 0.0),
+            "qos_wait_hist": list(getattr(m, "qos_wait_hist", ())),
         }
         for m in usage_msgs
     ]

@@ -51,6 +51,14 @@ def _usage_counters_fields():
         _field("throttled_seconds", 7, _TYPE.TYPE_DOUBLE),
         _field("oversub_spill_seconds", 8, _TYPE.TYPE_DOUBLE),
         _field("window_s", 9, _TYPE.TYPE_DOUBLE),
+        # QoS plane (docs/serving.md): class + current duty weight are
+        # instantaneous; wait seconds and the log2-us dispatch-wait
+        # histogram are sampler-side monotonic counters.  "" class =
+        # container without a vtpu.dev/qos annotation (flat limiter).
+        _field("qos_class", 10, _TYPE.TYPE_STRING),
+        _field("qos_weight_pct", 11, _TYPE.TYPE_INT32),
+        _field("qos_wait_seconds_total", 12, _TYPE.TYPE_DOUBLE),
+        _field("qos_wait_hist", 13, _TYPE.TYPE_UINT64, _REP),
     ]
 
 

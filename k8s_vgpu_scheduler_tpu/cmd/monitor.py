@@ -12,7 +12,7 @@ import os
 import time
 
 from ..accounting.sampler import UsageSampler
-from ..monitor.feedback import FeedbackLoop
+from ..monitor.feedback import FeedbackLoop, QosConfig
 from ..monitor.metrics import start_metrics_server
 from ..tpulib import detect
 from ..util import trace
@@ -30,6 +30,19 @@ def parse_args(argv=None):
                         "(node-local tooling) — widen to [::] explicitly "
                         "and add a NetworkPolicy if peers need it")
     p.add_argument("--interval", type=float, default=2.0)
+    # SLO-tiered co-residency feedback (docs/serving.md; QosController).
+    p.add_argument("--qos-target-p99-ms", type=float, default=20.0,
+                   help="critical-class dispatch-wait p99 target; above "
+                        "it duty shifts from best-effort to critical")
+    p.add_argument("--qos-step-pct", type=int, default=15,
+                   help="duty-weight percentage points shifted per tick")
+    p.add_argument("--qos-min-weight", type=int, default=25,
+                   help="best-effort duty-weight floor (never starved)")
+    p.add_argument("--qos-max-weight", type=int, default=175,
+                   help="latency-critical duty-weight ceiling")
+    p.add_argument("--qos-recover-ticks", type=int, default=3,
+                   help="consecutive good ticks before duty returns and "
+                        "the best-effort yield flag clears (hysteresis)")
     p.add_argument("--debug-port", type=int, default=0,
                    help="loopback /debug profiling endpoints (0 = off)")
     p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""))
@@ -52,7 +65,13 @@ def main(argv=None):
             backend = detect()
         except Exception:
             logging.exception("chip backend unavailable; continuing without")
-    loop = FeedbackLoop(args.container_root)
+    loop = FeedbackLoop(args.container_root, qos=QosConfig(
+        target_p99_us=int(args.qos_target_p99_ms * 1000),
+        step_pct=args.qos_step_pct,
+        min_weight_pct=args.qos_min_weight,
+        max_weight_pct=args.qos_max_weight,
+        recover_ticks=args.qos_recover_ticks,
+    ))
     node = args.node_name or os.uname().nodename
     # Usage metering rides the same tick as the feedback loop; its
     # counters feed the :9394 exporter, the noderpc ReportUsage piggyback,

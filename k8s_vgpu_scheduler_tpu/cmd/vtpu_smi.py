@@ -55,12 +55,20 @@ def region_info(region) -> dict:
             "memory_used_pct": round(100.0 * used / limit, 1) if limit else 0.0,
             "core_limit_pct": region.sm_limit(i) or 100,
         })
+    from ..util.types import QOS_CLASS_NAMES
+
+    cls = getattr(region, "qos_class", -1)
     return {
         "devices": devs,
         "priority": region.priority,
         "throttled": bool(region.utilization_switch),
         "oversubscribe": bool(region.oversubscribe),
         "processes": region.proc_pids(),
+        # SLO-tiered co-residency (docs/serving.md): class + the duty
+        # weight the monitor's p99 feedback loop currently applies.
+        "qos_class": QOS_CLASS_NAMES.get(cls),
+        "qos_duty_weight_pct": (region.qos_weight if cls >= 0 else None),
+        "qos_yield": bool(region.qos_yield) if cls >= 0 else False,
     }
 
 
@@ -80,6 +88,10 @@ def format_info(info: dict, title: str) -> str:
         flags.append("THROTTLED(priority sharer active)")
     if info["oversubscribe"]:
         flags.append("OVERSUBSCRIBED(host-RAM swap)")
+    if info.get("qos_class"):
+        flags.append(f"QOS({info['qos_class']} "
+                     f"duty={info['qos_duty_weight_pct']}%"
+                     + (" YIELD" if info.get("qos_yield") else "") + ")")
     lines.append(
         f"| prio={info['priority']} procs={len(info['processes'])} "
         + " ".join(flags)
@@ -204,7 +216,8 @@ def top_info(metrics: dict) -> dict:
         return pods.setdefault(key, {
             "chips": 0, "granted_mib": 0, "granted_cores": 0,
             "chip_seconds": 0.0, "hbm_byte_seconds": 0.0,
-            "efficiency": None,
+            "efficiency": None, "qos_class": None,
+            "qos_duty_weight_pct": None,
         })
 
     for labels, v in metrics.get("vtpu_pod_device_allocated_mib", []):
@@ -219,6 +232,10 @@ def top_info(metrics: dict) -> dict:
         pod(labels)["hbm_byte_seconds"] = v
     for labels, v in metrics.get("vtpu_grant_efficiency_ratio", []):
         pod(labels)["efficiency"] = round(v, 4)
+    for labels, v in metrics.get("vtpu_pod_qos_duty_weight", []):
+        p = pod(labels)
+        p["qos_class"] = labels.get("class")
+        p["qos_duty_weight_pct"] = int(v)
 
     rows = []
     for (ns, name), p in pods.items():
@@ -241,18 +258,22 @@ def format_top(info: dict) -> str:
     lines = [
         f"+ fleet: {info['idle_grants']} idle grant(s)",
         "| pod                                chips  granted    eff%  "
-        "waste  chip-s     |",
+        "waste  chip-s     qos           duty |",
     ]
     for r in info["pods"]:
         eff = (f"{100 * r['efficiency']:5.1f}"
                if r["efficiency"] is not None else "    -")
         waste = (f"{r['waste_chips']:5.2f}"
                  if r["waste_chips"] is not None else "    -")
+        qos = (r.get("qos_class") or "-")[:16]
+        duty = (f"{r['qos_duty_weight_pct']:>3d}%"
+                if r.get("qos_duty_weight_pct") is not None else "   -")
         lines.append(
-            "| {pn:<34s} {c:>5d} {g:>6d}MiB {e}% {w} {cs:>9.1f} |".format(
+            "| {pn:<34s} {c:>5d} {g:>6d}MiB {e}% {w} {cs:>9.1f} "
+            "{q:<13s} {d} |".format(
                 pn=f"{r['namespace']}/{r['name']}"[:34], c=r["chips"],
                 g=r["granted_mib"], e=eff, w=waste,
-                cs=r["chip_seconds"]))
+                cs=r["chip_seconds"], q=qos, d=duty))
     return "\n".join(lines)
 
 

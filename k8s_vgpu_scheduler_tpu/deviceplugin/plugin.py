@@ -62,9 +62,13 @@ from ..util.types import (
     ENV_MEMORY_LIMIT_PREFIX,
     ENV_OVERSUBSCRIBE,
     ENV_PHYSICAL_MEMORY_PREFIX,
+    ENV_QOS_CLASS,
+    ENV_QOS_DUTY_SPLIT,
     ENV_SHARED_CACHE,
     ENV_VISIBLE_CHIPS,
     ENV_VISIBLE_DEVICES,
+    QOS_ANNOTATION,
+    QOS_DUTY_SPLIT_ANNOTATION,
     TPU_DEVICE,
 )
 
@@ -342,6 +346,17 @@ class TpuDevicePlugin:
             resp.envs[ENV_VISIBLE_DEVICES] = ",".join(indices)
         if anns.get(OVERSUBSCRIBE_ANNOTATION, "") in ("true", "1"):
             resp.envs[ENV_OVERSUBSCRIBE] = "true"
+        # SLO-tiered co-residency (docs/serving.md): the webhook-validated
+        # QoS class reaches the shim's region init through this env; the
+        # scheduler's placement-time duty split rides along for
+        # introspection (vtpu-smi inside the container).  No annotation →
+        # no env → the region stays on the flat limiter path.
+        qos = anns.get(QOS_ANNOTATION, "")
+        if qos:
+            resp.envs[ENV_QOS_CLASS] = qos
+            split = anns.get(QOS_DUTY_SPLIT_ANNOTATION, "")
+            if split:
+                resp.envs[ENV_QOS_DUTY_SPLIT] = split
         # Multi-host gang wiring: surface the scheduler-assigned process
         # rank + group size so parallel/multihost.py can call
         # jax.distributed.initialize without any in-container discovery

@@ -153,6 +153,15 @@ def pod_uid(pod: dict) -> str:
     return pod_meta(pod).get("uid", "")
 
 
+def pod_qos(pod: dict) -> str:
+    """The pod's ``vtpu.dev/qos`` class ("" = unclassed: flat limiter).
+    Values are webhook-validated at admission (scheduler/webhook.py)."""
+    from ..util.types import QOS_ANNOTATION
+
+    return pod.get("metadata", {}).get(
+        "annotations", {}).get(QOS_ANNOTATION, "") or ""
+
+
 def pod_phase(pod: dict) -> str:
     return pod.get("status", {}).get("phase", "")
 

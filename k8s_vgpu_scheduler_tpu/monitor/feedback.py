@@ -10,7 +10,14 @@ Reference: cmd/vGPUmonitor/feedback.go:161–248.  Every tick the monitor:
    sharer is active on any chip this region holds — the in-container rate
    limiter then confines low-priority processes to their core grant, and
    lets them borrow idle compute otherwise (reference CheckPriority);
-5. GCs proc slots whose pid is gone (SIGKILLed workloads leak slots — the
+5. runs the :class:`QosController` — the GRADED generalization of the
+   binary switch for SLO-tiered co-residency (docs/serving.md): per chip,
+   it computes the latency-critical class's dispatch-wait p99 from the
+   regions' wait histograms, shifts duty weight from best-effort to
+   critical while that p99 breaches its target (returning it with
+   hysteresis once it recovers), and raises best-effort regions'
+   ``qos_yield`` while a co-resident critical slot has queued work;
+6. GCs proc slots whose pid is gone (SIGKILLed workloads leak slots — the
    reference recovers these via shared-region status flags).
 """
 
@@ -32,6 +39,198 @@ class ContainerState:
     key: str  # "<podUID>_<podName>"
     region: Region
     active: bool = False
+
+
+
+
+@dataclasses.dataclass(frozen=True)
+class QosConfig:
+    """Knobs of the per-class duty re-weighting loop (cmd/monitor.py
+    --qos-* flags; chart scheduler.qos.*)."""
+
+    #: Critical-class dispatch-wait p99 target.  Above it, duty shifts
+    #: from best-effort to critical every tick.
+    target_p99_us: int = 20_000
+    #: Duty-weight step per breach/recovery tick (percentage points).
+    step_pct: int = 15
+    #: Best-effort weight floor — backfill neighbors are squeezed, never
+    #: starved outright (their hard-duty grant keeps this fraction).
+    min_weight_pct: int = 25
+    #: Latency-critical weight ceiling.
+    max_weight_pct: int = 175
+    #: Hysteresis: consecutive "good" ticks (p99 under target ×
+    #: recover_frac, or no critical dispatches at all) before a step of
+    #: duty is handed back, and consecutive queue-free ticks before the
+    #: best-effort yield flag clears.
+    recover_ticks: int = 3
+    #: "Good" means p99 below target × this fraction — the dead band
+    #: between breach and recovery that stops weight oscillation.
+    recover_frac: float = 0.5
+
+
+def hist_p99_us(delta: List[int]) -> Optional[float]:
+    """p99 dispatch wait from a log2-us bucket-count delta (bucket 0 =
+    zero-wait; bucket k covers [2^(k-1), 2^k) us — the p99 is the upper
+    bound of the bucket holding the 99th percentile).  None when the
+    delta holds no dispatches."""
+    total = sum(delta)
+    if total <= 0:
+        return None
+    rank = max(1, int(total * 0.99 + 0.999999))
+    seen = 0
+    for k, n in enumerate(delta):
+        seen += n
+        if seen >= rank:
+            return 0.0 if k == 0 else float(1 << k)
+    return float(1 << (len(delta) - 1))
+
+
+class QosController:
+    """Per-chip, per-class duty re-weighting from observed dispatch-wait
+    p99 — closes the monitor's feedback loop on the latency signal
+    instead of raw utilization.  Pure region-side state machine: all
+    inputs are read from and all outputs written to the shared regions,
+    so it composes with any data plane (Python shim or PJRT interposer)
+    and replays deterministically in the simulator."""
+
+    def __init__(self, cfg: Optional[QosConfig] = None) -> None:
+        self.cfg = cfg or QosConfig()
+        #: container key → last cumulative wait histogram (delta basis).
+        self._last_hist: Dict[str, List[int]] = {}
+        #: chip uuid → consecutive good ticks (recovery hysteresis).
+        self._good: Dict[str, int] = {}
+        #: chip uuid → consecutive ticks without critical queued work.
+        self._quiet: Dict[str, int] = {}
+        #: chip uuid → critical-class wait p99 (us) of the last tick with
+        #: critical dispatches (metrics/debug surface).
+        self.critical_p99_us: Dict[str, float] = {}
+        #: Lifetime weight-shift actions (observability).
+        self.reweights_total = 0
+
+    # -- one tick --------------------------------------------------------------
+    def observe(self, containers: Dict[str, ContainerState]) -> None:
+        qos: List[tuple] = []  # (key, region, class, wait-hist delta)
+        seen_keys = set()
+        for c in containers.values():
+            # getattr: duck-typed regions (simulator fakes, pre-QoS test
+            # stubs) need not carry the QoS plane.
+            cls = getattr(c.region, "qos_class", -1)
+            if cls < 0:
+                continue
+            seen_keys.add(c.key)
+            hist = c.region.qos_wait_hist()
+            prev = self._last_hist.get(c.key)
+            if prev is None or len(prev) != len(hist) or any(
+                    h < p for h, p in zip(hist, prev)):
+                # First sight, or the container restarted in place and
+                # its counters began again: the full value is new.
+                delta = list(hist)
+            else:
+                delta = [h - p for h, p in zip(hist, prev)]
+            self._last_hist[c.key] = hist
+            qos.append((c.key, c.region, cls, delta))
+        for key in [k for k in self._last_hist if k not in seen_keys]:
+            del self._last_hist[key]
+        if not qos:
+            # Last QoS container gone: drop every per-chip memory too —
+            # a later tenant on the same chip must start from fresh
+            # hysteresis state, not the dead pod's counters.
+            self._good.clear()
+            self._quiet.clear()
+            self.critical_p99_us.clear()
+            return
+
+        # Phase 1: per-chip signals (breach / ready-to-return / yield),
+        # with the hysteresis counters living per chip.
+        by_chip: Dict[str, Dict[int, List[tuple]]] = {}
+        for key, region, cls, delta in qos:
+            for uuid in region.uuids():
+                if uuid:
+                    by_chip.setdefault(uuid, {}).setdefault(cls, []).append(
+                        (key, region, delta))
+        signals = {uuid: self._chip_signals(uuid, classes)
+                   for uuid, classes in by_chip.items()}
+        for uuid in [u for u in list(self._good) if u not in by_chip]:
+            self._good.pop(uuid, None)
+            self._quiet.pop(uuid, None)
+            self.critical_p99_us.pop(uuid, None)
+
+        # Phase 2: ONE write decision per REGION across all its chips —
+        # a multi-chip grant must never get conflicting per-chip writes
+        # in one tick (last-chip-wins yield, weight stepped once per
+        # chip).  Conservative folds: yield/shift-toward-critical on ANY
+        # chip's signal, return duty only when EVERY chip is ready.
+        cfg = self.cfg
+        moved = False
+        for key, region, cls, _delta in qos:
+            uuids = [u for u in region.uuids() if u in signals]
+            if not uuids:
+                continue
+            breach_any = any(signals[u]["breach"] for u in uuids)
+            ready_all = all(signals[u]["ready"] for u in uuids)
+            if cls == 0:
+                yield_on = any(signals[u]["yield"] for u in uuids)
+                if bool(region.qos_yield) != yield_on:
+                    log.info("qos: best-effort %s yield -> %s",
+                             key, yield_on)
+                    region.set_qos_yield(yield_on)
+            w = region.qos_weight
+            if breach_any:
+                nw = (max(cfg.min_weight_pct, w - cfg.step_pct)
+                      if cls == 0
+                      else min(cfg.max_weight_pct, w + cfg.step_pct))
+            elif ready_all:
+                nw = (min(100, w + cfg.step_pct) if cls == 0
+                      else max(100, w - cfg.step_pct))
+            else:
+                nw = w
+            if nw != w:
+                region.set_qos_weight(nw)
+                moved = True
+                log.info("qos: %s duty weight %d%% -> %d%% (%s)", key,
+                         w, nw, "critical p99 breach" if breach_any
+                         else "recovered")
+        if moved:
+            self.reweights_total += 1
+
+    def _chip_signals(self, uuid: str, classes: Dict[int, List[tuple]]
+                      ) -> Dict[str, bool]:
+        cfg = self.cfg
+        critical = classes.get(1, [])
+        merged: List[int] = []
+        for _key, _region, delta in critical:
+            if len(delta) > len(merged):
+                merged += [0] * (len(delta) - len(merged))
+            for i, n in enumerate(delta):
+                merged[i] += n
+        p99 = hist_p99_us(merged)
+        if p99 is not None:
+            self.critical_p99_us[uuid] = p99
+        # "Queued work": critical dispatches that actually waited at the
+        # gate this tick (nonzero-wait buckets) — the signal best-effort
+        # neighbors must stop borrowing idle duty on.
+        queued = sum(merged[1:]) > 0
+        quiet = self._quiet.get(uuid)
+        if queued:
+            quiet = 0
+        elif quiet is None:
+            quiet = cfg.recover_ticks  # no queued work ever seen: no yield
+        else:
+            quiet += 1
+        self._quiet[uuid] = quiet
+        breach = p99 is not None and p99 > cfg.target_p99_us
+        good = p99 is None or p99 <= cfg.target_p99_us * cfg.recover_frac
+        if breach or not good:
+            # Breach, or the dead band between recovery and breach:
+            # either way the recovery streak restarts.
+            self._good[uuid] = 0
+        else:
+            self._good[uuid] = self._good.get(uuid, 0) + 1
+        return {
+            "breach": breach,
+            "ready": self._good[uuid] >= cfg.recover_ticks,
+            "yield": bool(critical) and quiet < cfg.recover_ticks,
+        }
 
 
 def build_nspid_index(proc_root: str = "/proc") -> Dict[int, List[int]]:
@@ -112,9 +311,11 @@ def find_host_pid(region_path: str, container_pid: int,
 
 class FeedbackLoop:
     def __init__(self, container_root: str,
-                 reader: Optional[RegionReader] = None) -> None:
+                 reader: Optional[RegionReader] = None,
+                 qos: Optional[QosConfig] = None) -> None:
         self.container_root = container_root
         self.reader = reader or RegionReader()
+        self.qos = QosController(qos)
         self.containers: Dict[str, ContainerState] = {}
         # (container key, container pid) -> confirmed host pid
         self._hostpid_cache: Dict[tuple, int] = {}
@@ -177,6 +378,10 @@ class FeedbackLoop:
                     log.info("container %s: utilization_switch -> %s",
                              c.key, want_on)
                     c.region.set_switch(want_on)
+            # Graded plane on top of the binary switch: per-class duty
+            # re-weighting + best-effort yield from observed critical
+            # dispatch-wait p99 (no-op on fleets without QoS regions).
+            self.qos.observe(self.containers)
 
     def gc_dead_procs(self, pid_alive=None) -> int:
         """Clear slots of dead processes and record host pids of live ones.

@@ -20,9 +20,47 @@ from prometheus_client.registry import Collector
 
 from ..tpulib.backend import Backend
 from ..util import trace
+from ..util.types import QOS_CLASS_NAMES, QOS_CLASSES
 from .feedback import FeedbackLoop
 
 log = logging.getLogger(__name__)
+
+
+def _fold_hist(per_class: dict, cls: str, hist, wait_s: float) -> None:
+    """Accumulate one (hist, wait_seconds) contribution into the
+    class-keyed aggregation both exporters build."""
+    counts, s = per_class.get(cls, ([], 0.0))
+    if len(counts) < len(hist):
+        counts = counts + [0] * (len(hist) - len(counts))
+    for i, n in enumerate(hist):
+        counts[i] += n
+    per_class[cls] = (counts, s + wait_s)
+
+
+def qos_wait_family(per_class) -> HistogramMetricFamily:
+    """Build the per-class dispatch-wait histogram family from
+    ``class name → (log2-us bucket counts, wait_seconds_sum)``.  Bucket k
+    of the native histogram covers [2^(k-1), 2^k) us (bucket 0 = zero
+    wait), so the Prometheus ``le`` bound of bucket k is 2^k / 1e6 s."""
+    fam = HistogramMetricFamily(
+        "vtpu_dispatch_wait_seconds",
+        "Time one dispatch waited at the QoS admission gate, by class "
+        "(from the shared regions' wait histograms; the critical-class "
+        "p99 here is the signal the duty re-weighting loop closes on)",
+        labels=["class"],
+    )
+    for cls in QOS_CLASSES:
+        counts, wait_sum = per_class.get(cls, ([], 0.0))
+        buckets = []
+        cum = 0
+        for k in range(max(len(counts), 1)):
+            cum += counts[k] if k < len(counts) else 0
+            if k == max(len(counts), 1) - 1:
+                buckets.append(("+Inf", cum))  # saturating last bucket
+            else:
+                buckets.append((repr((1 << k) / 1e6), cum))
+        fam.add_metric([cls], buckets, wait_sum)
+    return fam
 
 
 class NodeCollector(Collector):
@@ -94,6 +132,20 @@ class NodeCollector(Collector):
             "(virtual device memory; spills to host RAM under pressure)",
             labels=["container"],
         )
+        c_qos_weight = GaugeMetricFamily(
+            "vtpu_qos_duty_weight",
+            "Current duty-cycle weight of one QoS-classed container "
+            "(percent of its core grant; 100 = neutral, shifted by the "
+            "monitor's p99 feedback loop)",
+            labels=["container", "class"],
+        )
+        c_qos_yield = GaugeMetricFamily(
+            "vtpu_qos_yield",
+            "1 when this best-effort container must not borrow idle "
+            "duty (a co-resident latency-critical slot has queued work)",
+            labels=["container"],
+        )
+        qos_by_class: dict = {}
         # Under the loop lock: rescan() munmaps regions, and reading a closed
         # handle from the scrape thread would crash the monitor.
         with self.loop.lock:
@@ -107,12 +159,47 @@ class NodeCollector(Collector):
                 c_switch.add_metric([c.key], r.utilization_switch)
                 c_procs.add_metric([c.key], len(r.proc_pids()))
                 c_oversub.add_metric([c.key], r.oversubscribe)
+                # getattr: duck-typed regions (simulator fakes, pre-QoS
+                # test stubs) need not carry the QoS plane.
+                name = QOS_CLASS_NAMES.get(getattr(r, "qos_class", -1))
+                if name is not None:
+                    c_qos_weight.add_metric([c.key, name], r.qos_weight)
+                    c_qos_yield.add_metric([c.key], r.qos_yield)
+
+        # Per-class dispatch-wait histograms: prefer the sampler's
+        # monotonic accumulation (restart-tolerant) over raw region
+        # values, so the series keep Prometheus counter semantics across
+        # in-place container restarts.
+        if self.sampler is not None:
+            # GC'd containers' folded-in totals first, so the per-class
+            # sums never go backwards when the sampler prunes a key.
+            retired = getattr(self.sampler, "qos_retired",
+                              lambda: {})()
+            for cls, (hist, s) in retired.items():
+                _fold_hist(qos_by_class, cls, hist, s)
+            for row in self.sampler.snapshot():
+                if not row.get("qos_class"):
+                    continue
+                _fold_hist(qos_by_class, row["qos_class"],
+                           row["qos_wait_hist"],
+                           row["qos_wait_seconds_total"])
+        else:
+            with self.loop.lock:
+                for c in self.loop.containers.values():
+                    r = c.region
+                    name = QOS_CLASS_NAMES.get(
+                        getattr(r, "qos_class", -1))
+                    if name is None:
+                        continue
+                    _fold_hist(qos_by_class, name, r.qos_wait_hist(),
+                               r.qos_wait_us_total() / 1e6)
 
         # Accounting counters (accounting/sampler.py): monotonic usage
         # integrals — the node-side face of the fleet-wide showback layer
         # (the scheduler exporter carries the per-pod/namespace join).
         families = [host_mem, c_usage, c_limit, c_sm, c_switch, c_procs,
-                    c_oversub]
+                    c_oversub, c_qos_weight, c_qos_yield,
+                    qos_wait_family(qos_by_class)]
         if self.sampler is not None:
             u_chip = CounterMetricFamily(
                 "vtpu_usage_chip_seconds",

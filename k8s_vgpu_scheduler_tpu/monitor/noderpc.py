@@ -56,6 +56,10 @@ def usage_report(node_name: str, rows) -> pb.ReportUsage:
             throttled_seconds=row["throttled_seconds"],
             oversub_spill_seconds=row["oversub_spill_seconds"],
             window_s=row["window_s"],
+            qos_class=row.get("qos_class", ""),
+            qos_weight_pct=int(row.get("qos_weight_pct", 100)),
+            qos_wait_seconds_total=row.get("qos_wait_seconds_total", 0.0),
+            qos_wait_hist=[int(b) for b in row.get("qos_wait_hist", ())],
         )
     return report
 

@@ -78,6 +78,43 @@ class Region:
     def uuids(self) -> List[str]:
         return [self.uuid(i) for i in range(self.num_devices)]
 
+    # -- QoS plane (docs/serving.md) -------------------------------------------
+    @property
+    def qos_class(self) -> int:
+        """-1 = no vtpu.dev/qos annotation (flat limiter), 0 =
+        best-effort, 1 = latency-critical."""
+        return self._lib.vtpu_r_qos_class(self._h)
+
+    @property
+    def qos_weight(self) -> int:
+        return self._lib.vtpu_r_qos_weight(self._h)
+
+    def set_qos_weight(self, pct: int) -> None:
+        self._lib.vtpu_r_set_qos_weight(self._h, int(pct))
+
+    @property
+    def qos_yield(self) -> int:
+        return self._lib.vtpu_r_qos_yield(self._h)
+
+    def set_qos_yield(self, on: bool) -> None:
+        self._lib.vtpu_r_set_qos_yield(self._h, 1 if on else 0)
+
+    def qos_wait_count(self) -> int:
+        return self._lib.vtpu_r_qos_wait_count(self._h)
+
+    def qos_wait_us_total(self) -> int:
+        return self._lib.vtpu_r_qos_wait_us_total(self._h)
+
+    def qos_cost_us_total(self) -> int:
+        return self._lib.vtpu_r_qos_cost_us_total(self._h)
+
+    def qos_wait_hist(self) -> List[int]:
+        """Cumulative dispatch-wait histogram: log2-us buckets (bucket 0
+        = zero-wait admissions, bucket k covers [2^(k-1), 2^k) us)."""
+        buf = (ctypes.c_uint64 * 32)()
+        n = self._lib.vtpu_r_qos_wait_hist(self._h, buf, 32)
+        return list(buf[:n])
+
 
 class RegionReader:
     def __init__(self, library_path: Optional[str] = None) -> None:
@@ -117,6 +154,22 @@ class RegionReader:
         lib.vtpu_r_gc.restype = ctypes.c_int
         lib.vtpu_r_generation.argtypes = [ctypes.c_void_p]
         lib.vtpu_r_generation.restype = ctypes.c_uint64
+        for fn, res in (
+            ("vtpu_r_qos_class", ctypes.c_int),
+            ("vtpu_r_qos_weight", ctypes.c_int),
+            ("vtpu_r_qos_yield", ctypes.c_int),
+            ("vtpu_r_qos_wait_count", ctypes.c_uint64),
+            ("vtpu_r_qos_wait_us_total", ctypes.c_uint64),
+            ("vtpu_r_qos_cost_us_total", ctypes.c_uint64),
+        ):
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+            getattr(lib, fn).restype = res
+        lib.vtpu_r_set_qos_weight.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_set_qos_yield.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vtpu_r_qos_wait_hist.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+        ]
+        lib.vtpu_r_qos_wait_hist.restype = ctypes.c_int
         self.lib = lib
 
     def open(self, path: str) -> Optional[Region]:

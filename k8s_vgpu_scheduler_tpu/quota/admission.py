@@ -174,6 +174,40 @@ class AdmissionLoop:
         return fleet_cap is None or \
             state["outstanding"] + chips <= fleet_cap
 
+    # -- QoS/backfill interlock (docs/serving.md) ------------------------------
+    def _measured_idle_chips(self) -> Optional[float]:
+        """Fleet chips with NO currently-dispatching container, from the
+        accounting ledger's fresh usage reports (node_busy_chips) — the
+        measured idle duty best-effort backfill is allowed to soak.
+        Nodes without fresh reports contribute nothing either way; None =
+        no node measured anywhere (unmonitored fleet: the interlock
+        stands down rather than starving backfill on missing data)."""
+        ledger = getattr(self.s, "ledger", None)
+        if ledger is None:
+            return None
+        idle: Optional[float] = None
+        for name, info in self.s.nodes.list_nodes().items():
+            busy = ledger.node_busy_chips(name)
+            if busy is None:
+                continue
+            idle = (idle or 0.0) + max(0.0, len(info.devices) - busy)
+        return idle
+
+    def _backfill_idle_ok(self, entry: QueueEntry, state: dict) -> bool:
+        """Gate a best-effort backfill candidate on measured idle duty:
+        a backfilled best-effort pod lands NOW next to running critical
+        pods, so it must fit inside duty nobody is using — otherwise it
+        is admitted straight into the contention the QoS limiter will
+        then have to squeeze it out of (critical p99 pays the transient).
+        Non-best-effort candidates and unmeasured fleets pass through
+        unchanged."""
+        if entry.qos != "best-effort":
+            return True
+        if "qos_idle" not in state:
+            state["qos_idle"] = self._measured_idle_chips()
+        idle = state["qos_idle"]
+        return idle is None or idle >= entry.chips
+
     # -- release ---------------------------------------------------------------
     def _held_fifo(self, mgr, queue: str) -> List[QueueEntry]:
         return sorted((e for e in mgr.entries()
@@ -253,9 +287,13 @@ class AdmissionLoop:
                 and state["outstanding"] + footprint + e.chips <= fleet_cap)
             short_lived = 0.0 < e.runtime_estimate_s <= window_left
             if (fits_hole or short_lived) and \
-                    self._fits_fleet(e.chips, fleet_cap, state):
+                    self._fits_fleet(e.chips, fleet_cap, state) and \
+                    self._backfill_idle_ok(e, state):
                 self._release_one(q, e, held, usage, state, actions,
                                   backfilled=True)
+                if e.qos == "best-effort" and state.get("qos_idle") \
+                        is not None:
+                    state["qos_idle"] -= e.chips
                 return True
         blocked.setdefault(
             q.name, (head, f"gang {head.gang} accumulating "

@@ -123,6 +123,11 @@ class QueueEntry:
     gang: Optional[str] = None
     gang_total: int = 0
     runtime_estimate_s: float = 0.0
+    #: vtpu.dev/qos class ("" = unclassed).  Best-effort entries admitted
+    #: via the backfill rule additionally consult the fleet's MEASURED
+    #: idle duty (admission.py), so backfill soaks real slack instead of
+    #: stacking demand onto chips whose critical class is already busy.
+    qos: str = ""
     enqueued_at: float = 0.0
     last_seen: float = 0.0
     state: str = STATE_HELD
@@ -259,6 +264,8 @@ class QuotaManager:
             runtime = float(anns.get(RUNTIME_ESTIMATE_ANNOTATION, "0"))
         except ValueError:
             runtime = 0.0
+        from ..util.types import QOS_ANNOTATION
+
         return QueueEntry(
             uid=pod_uid(pod), name=pod_name(pod),
             namespace=pod_namespace(pod), queue=q.name,
@@ -266,6 +273,7 @@ class QuotaManager:
             gang=gang[0] if gang else None,
             gang_total=gang[1] if gang else 0,
             runtime_estimate_s=max(0.0, runtime),
+            qos=anns.get(QOS_ANNOTATION, "") or "",
             enqueued_at=now, last_seen=now)
 
     def _position_locked(self, e: QueueEntry) -> Tuple[int, int]:

@@ -58,7 +58,7 @@ import numpy as np
 
 from ..placement.mesh import MESH_ANNOTATION
 from ..util import trace
-from ..util.types import ContainerDevice
+from ..util.types import QOS_ANNOTATION, ContainerDevice
 from . import score as score_mod
 
 log = logging.getLogger(__name__)
@@ -1130,7 +1130,8 @@ class BatchEngine:
                         uid=job.uid, name=job.name,
                         namespace=job.namespace, node=node,
                         devices=placement, priority=job.priority,
-                        trace_id=job.trace_id))
+                        trace_id=job.trace_id,
+                        qos=job.anns.get(QOS_ANNOTATION, "") or ""))
                     if rev != expected + 1:
                         # An informer event interleaved inside the held
                         # lock (it doesn't exclude the watch thread): the

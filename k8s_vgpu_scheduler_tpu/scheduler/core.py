@@ -32,6 +32,7 @@ from ..k8s.client import (
     pod_annotations,
     pod_name,
     pod_namespace,
+    pod_qos,
     pod_uid,
 )
 from ..placement.defrag import Defragmenter, DefragConfig
@@ -61,6 +62,9 @@ from ..util.types import (
     BIND_PHASE_ANNOTATION,
     BIND_SUCCESS,
     BIND_TIME_ANNOTATION,
+    QOS_ANNOTATION,
+    QOS_BEST_EFFORT,
+    QOS_DUTY_SPLIT_ANNOTATION,
     TO_ALLOCATE_ANNOTATION,
     ContainerDevice,
 )
@@ -460,6 +464,7 @@ class Scheduler:
             devices=devices,
             priority=prio,
             trace_id=anns.get(trace.TRACE_ID_ANNOTATION, ""),
+            qos=pod_qos(pod),
         )
         # The MODIFIED event for the scheduler's own decision-write (or a
         # resync replay) carries exactly the grant already registered:
@@ -964,6 +969,15 @@ class Scheduler:
             TO_ALLOCATE_ANNOTATION: encoded,
             ASSIGNED_TIME_ANNOTATION: str(int(time.time())),
         }
+        if pod_qos(pod):
+            # Record the placement-time per-class duty split on the grant
+            # (docs/serving.md): what fraction of compute on this node is
+            # granted to each class as of this decision.  Informational —
+            # the runtime split is the monitor's re-weighting loop; this
+            # is the shape the scheduler admitted, for audit and for the
+            # device plugin to surface into the container env.
+            patch[QOS_DUTY_SPLIT_ANNOTATION] = \
+                self._qos_duty_split(result.node)
         rank = self.gangs.rank_of(pod_uid(pod))
         if rank is not None:
             # The member's jax.distributed process rank (stable across
@@ -1004,6 +1018,18 @@ class Scheduler:
                          trace_id=tid, error=err)
                 return FilterResult(error=err)
         return result
+
+    def _qos_duty_split(self, node: str) -> str:
+        """Per-class granted-compute split on ``node`` right now, from
+        the pod registry: ``latency-critical=40,best-effort=120`` (sums
+        of usedcores per class; unclassed grants count as best-effort —
+        that is the runtime default the region init applies)."""
+        split: Dict[str, int] = {}
+        for info in self.pods.pods_on_node(node):
+            cls = info.qos or QOS_BEST_EFFORT
+            cores = sum(d.usedcores for ctr in info.devices for d in ctr)
+            split[cls] = split.get(cls, 0) + cores
+        return ",".join(f"{cls}={split[cls]}" for cls in sorted(split))
 
     # -- placement subsystem hooks (placement/; docs/placement.md) -------------
     @staticmethod
@@ -1268,6 +1294,7 @@ class Scheduler:
                             devices=placement,
                             priority=pod_priority(pod, self.cfg),
                             trace_id=tid,
+                            qos=pod_qos(pod),
                         ))
                         if pod_rev == entry.key[0] + 1:
                             self._publish_grant(node, entry, placement,
@@ -1695,6 +1722,7 @@ class Scheduler:
                 devices=placement,
                 priority=pod_priority(pod, self.cfg),
                 trace_id=trace.trace_id_of(pod),
+                qos=pod_qos(pod),
             )
         )
         return FilterResult(node=node, failed=failed)
@@ -1734,7 +1762,8 @@ class Scheduler:
                             namespace=pod_namespace(pod), node=node,
                             devices=devices,
                             priority=pod_priority(pod, self.cfg),
-                            trace_id=trace.trace_id_of(pod))
+                            trace_id=trace.trace_id_of(pod),
+                            qos=pod_qos(pod))
                 )
             return FilterResult(node=node)
 
@@ -1787,7 +1816,8 @@ class Scheduler:
                 PodInfo(uid=member_uid, name=m.name, namespace=m.namespace,
                         node=node, devices=devices,
                         trace_id=m.annotations.get(
-                            trace.TRACE_ID_ANNOTATION, ""))
+                            trace.TRACE_ID_ANNOTATION, ""),
+                        qos=m.annotations.get(QOS_ANNOTATION, "") or "")
             )
         log.info("gang %s admitted: %s", group,
                  {u: n for u, (n, _) in placements.items()})

@@ -17,6 +17,7 @@ from prometheus_client.core import (
 )
 from prometheus_client.registry import Collector
 
+from ..monitor.metrics import _fold_hist, qos_wait_family
 from ..util import trace
 from .core import Scheduler
 
@@ -406,8 +407,45 @@ class ClusterCollector(Collector):
                     engine.stats.fallback_reason_counts().items()):
                 batch_fallbacks.add_metric([reason], n)
 
+        # Serving QoS (docs/serving.md): fleet-wide per-class dispatch-
+        # wait histograms + per-pod duty weights, from the qos fields the
+        # usage reports carry.  Families are always emitted (zero-valued
+        # without QoS pods) so dashboards never reference a vanishing
+        # series.
+        pod_qos_weight = GaugeMetricFamily(
+            "vtpu_pod_qos_duty_weight",
+            "Current duty-cycle weight of one QoS-classed pod (percent "
+            "of its core grant; 100 = neutral, shifted by the node "
+            "monitor's p99 feedback loop — vtpu-smi top shows this next "
+            "to the waste view)",
+            labels=["podnamespace", "podname", "class"],
+        )
+
         fleet = self.scheduler.grant_efficiency()
         by_uid = {p.uid: p for p in fleet.pods}
+        qos_by_class: Dict[str, tuple] = {}
+        qos_weights: Dict[tuple, float] = {}
+        # Pruned accounts' folded-in totals first: the per-class sums
+        # must never go backwards when the ledger GCs a retired pod
+        # (Prometheus would read the dip as a counter reset).
+        retired = getattr(self.scheduler.ledger, "qos_retired",
+                          lambda: {})()
+        for cls, (hist, s) in retired.items():
+            _fold_hist(qos_by_class, cls, hist, s)
+        for acct in self.scheduler.ledger.accounts():
+            if not acct.qos_class:
+                continue
+            _fold_hist(qos_by_class, acct.qos_class,
+                       acct.qos_wait_hist, acct.qos_wait_seconds_total)
+            pe = by_uid.get(acct.uid)
+            namespace = pe.namespace if pe is not None else "(unresolved)"
+            name = pe.name if pe is not None else acct.name
+            # Latest wins on (ns, name, class) collisions — same dedup
+            # discipline as the efficiency gauges below.
+            qos_weights[(namespace, name, acct.qos_class)] = \
+                acct.qos_weight_pct
+        for (namespace, name, cls), w in sorted(qos_weights.items()):
+            pod_qos_weight.add_metric([namespace, name, cls], w)
         # Aggregate by label pair BEFORE emitting: two retained accounts
         # can resolve to the same (namespace, name) — successive
         # incarnations of a restarted pod, both "(unresolved)" — and
@@ -443,8 +481,9 @@ class ClusterCollector(Collector):
                 defrag_plans, defrag_migrations, defrag_completed,
                 defrag_aborted, shard_epoch, shards_owned,
                 shards_orphaned, shard_rebalances, cas_failures,
-                u_chip, u_hbm, eff_ratio,
-                idle_grants] + list(phase_metrics())
+                u_chip, u_hbm, eff_ratio, idle_grants,
+                qos_wait_family(qos_by_class),
+                pod_qos_weight] + list(phase_metrics())
 
 
 def phase_metrics():

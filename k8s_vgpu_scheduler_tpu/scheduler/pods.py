@@ -31,6 +31,10 @@ class PodInfo:
     # only namespace/name/uid, no pod object) can stamp its span without
     # an apiserver read.
     trace_id: str = ""
+    # vtpu.dev/qos class ("" = unclassed) — lets the decision record the
+    # placement-time per-class duty split without re-reading co-resident
+    # pods from the apiserver (docs/serving.md).
+    qos: str = ""
     # Monotonic time of the most recent add/refresh: a full-list resync
     # must not prune a grant recorded AFTER its list snapshot was taken
     # (the pod simply didn't exist yet in that stale list).
@@ -92,6 +96,8 @@ class PodManager:
             prev.priority = info.priority
             if info.trace_id:
                 prev.trace_id = info.trace_id
+            if info.qos:
+                prev.qos = info.qos
             prev.touched_at = info.touched_at
             return True
 

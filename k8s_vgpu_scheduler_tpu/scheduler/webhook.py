@@ -37,7 +37,7 @@ from ..quota.queues import (
 from ..util import trace
 from ..util.config import Config
 from ..util.resources import container_requests
-from ..util.types import ENV_TASK_PRIORITY
+from ..util.types import ENV_TASK_PRIORITY, QOS_ANNOTATION, QOS_CLASSES
 
 log = logging.getLogger(__name__)
 
@@ -258,6 +258,21 @@ def validate_pod_mesh(pod: dict, cfg: Config,
     return f"{MESH_ANNOTATION}: {why}"
 
 
+def validate_pod_qos(pod: dict) -> Optional[str]:
+    """Admission-time ``vtpu.dev/qos`` validation (docs/serving.md): the
+    value must be a known QoS class.  Same discipline as the mesh check —
+    an unknown class would silently run as best-effort (the region-init
+    default), which is exactly the quiet misconfiguration a serving
+    owner cannot afford; reject it where the user sees the error.
+    Returns the user-facing rejection message, or None."""
+    anns = pod.get("metadata", {}).get("annotations") or {}
+    value = anns.get(QOS_ANNOTATION)
+    if value is None or value in QOS_CLASSES:
+        return None
+    return (f"{QOS_ANNOTATION}: unknown QoS class {value!r} "
+            f"(expected one of: {', '.join(QOS_CLASSES)})")
+
+
 def handle_admission_review(body: dict, cfg: Config,
                             topologies=None) -> dict:
     """AdmissionReview in → AdmissionReview out.  Mutation is advisory
@@ -274,7 +289,8 @@ def handle_admission_review(body: dict, cfg: Config,
     response = {"uid": uid, "allowed": True}
     pod = req.get("object")
     if isinstance(pod, dict) and req.get("operation", "CREATE") == "CREATE":
-        why = validate_pod_mesh(pod, cfg, topologies)
+        why = validate_pod_mesh(pod, cfg, topologies) \
+            or validate_pod_qos(pod)
         if why is not None:
             meta = pod.get("metadata", {})
             log.warning("webhook: rejecting pod %s: %s",

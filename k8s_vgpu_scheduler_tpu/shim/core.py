@@ -86,6 +86,21 @@ class Native:
         L.vtpu_rate_feedback.argtypes = [ctypes.c_int, ctypes.c_uint64]
         L.vtpu_rate_feedback.restype = None
         L.vtpu_region_path.restype = ctypes.c_char_p
+        # QoS plane (docs/serving.md): the reader accessors work on our
+        # OWN region too (vtpu_region()), giving in-process visibility
+        # of the class, the monitor-written duty weight, and the
+        # dispatch-wait accounting the limiter records.
+        L.vtpu_region.restype = ctypes.c_void_p
+        for fn, res in (
+            ("vtpu_r_qos_class", ctypes.c_int),
+            ("vtpu_r_qos_weight", ctypes.c_int),
+            ("vtpu_r_qos_yield", ctypes.c_int),
+            ("vtpu_r_qos_wait_count", ctypes.c_uint64),
+            ("vtpu_r_qos_wait_us_total", ctypes.c_uint64),
+            ("vtpu_r_qos_cost_us_total", ctypes.c_uint64),
+        ):
+            getattr(L, fn).argtypes = [ctypes.c_void_p]
+            getattr(L, fn).restype = res
 
     def init(self, path: Optional[str] = None) -> None:
         rc = self.lib.vtpu_init_path(path.encode() if path else None)
@@ -175,6 +190,24 @@ class Shim:
         return {
             "total": int(self.native.lib.vtpu_get_limit(dev)),
             "used": int(self.native.lib.vtpu_get_used(dev)),
+        }
+
+    def qos_info(self) -> Dict[str, Any]:
+        """This container's QoS view (docs/serving.md): the class the
+        grant carried, the duty weight the monitor currently applies,
+        and the dispatch-wait accounting the limiter has recorded.
+        ``class`` is None for unclassed (flat-limiter) containers."""
+        lib = self.native.lib
+        r = lib.vtpu_region()
+        cls = int(lib.vtpu_r_qos_class(r))
+        return {
+            "class": {0: "best-effort", 1: "latency-critical"}.get(cls),
+            "duty_weight_pct": (int(lib.vtpu_r_qos_weight(r))
+                                if cls >= 0 else None),
+            "yield": bool(lib.vtpu_r_qos_yield(r)) if cls >= 0 else False,
+            "wait_count": int(lib.vtpu_r_qos_wait_count(r)),
+            "wait_us_total": int(lib.vtpu_r_qos_wait_us_total(r)),
+            "cost_us_total": int(lib.vtpu_r_qos_cost_us_total(r)),
         }
 
     # -- compute throttling ----------------------------------------------------

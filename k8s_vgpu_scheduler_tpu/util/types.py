@@ -31,6 +31,25 @@ BIND_PHASE_ANNOTATION = "vtpu.dev/bind-phase"
 TPU_USE_TYPE_ANNOTATION = "vtpu.dev/use-tputype"
 TPU_NOUSE_TYPE_ANNOTATION = "vtpu.dev/nouse-tputype"
 
+# SLO-tiered co-residency (docs/serving.md).  ``vtpu.dev/qos`` is user-set
+# (validated by the webhook: unknown values are rejected with a 422, same
+# discipline as vtpu.dev/mesh); the scheduler records the placement-time
+# per-class duty split in ``vtpu.dev/qos-duty-split`` on the decision, and
+# the device plugin carries the class into the container env
+# (ENV_QOS_CLASS) where the shim's region init picks it up.  No annotation
+# = the flat limiter path, bit-for-bit (parity-pinned).
+QOS_ANNOTATION = "vtpu.dev/qos"
+QOS_DUTY_SPLIT_ANNOTATION = "vtpu.dev/qos-duty-split"
+QOS_LATENCY_CRITICAL = "latency-critical"
+QOS_BEST_EFFORT = "best-effort"
+QOS_CLASSES = (QOS_LATENCY_CRITICAL, QOS_BEST_EFFORT)
+#: Region qos_class int (shared_region.h VTPU_QOS_*) → annotation value.
+#: -1 (no annotation, flat limiter) is deliberately absent: consumers
+#: use .get() and treat None as "unclassed".  The one copy every Python
+#: consumer maps through (shim/core.py keeps an inline copy only
+#: because that file ships standalone into containers).
+QOS_CLASS_NAMES = {0: QOS_BEST_EFFORT, 1: QOS_LATENCY_CRITICAL}
+
 # Node annotation used as a cluster-wide mutex for the bind/allocate two-phase
 # commit (reference: 4pd.io/mutex.lock, types.go:57; nodelock.go:144–230).
 NODE_LOCK_ANNOTATION = "vtpu.dev/mutex.lock"
@@ -73,6 +92,8 @@ ENV_TASK_PRIORITY = "TPU_TASK_PRIORITY"
 ENV_CORE_POLICY = "TPU_CORE_UTILIZATION_POLICY"
 ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"    # granted chip uuids (shim bookkeeping)
 ENV_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"  # granted chip indices (libtpu)
+ENV_QOS_CLASS = "VTPU_QOS_CLASS"           # vtpu.dev/qos → region qos_class
+ENV_QOS_DUTY_SPLIT = "VTPU_QOS_DUTY_SPLIT"  # placement-time per-class split
 
 
 @dataclasses.dataclass

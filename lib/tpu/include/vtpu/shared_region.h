@@ -31,6 +31,17 @@ extern "C" {
 #define VTPU_MAX_PROCS 1024
 #define VTPU_UUID_LEN 64
 
+/* QoS classes (vtpu.dev/qos annotation -> VTPU_QOS_CLASS env).
+ * VTPU_QOS_OFF keeps the flat limiter path bit-for-bit (no-annotation
+ * fleets; pinned by tests/test_shim.py parity tests). */
+#define VTPU_QOS_OFF (-1)
+#define VTPU_QOS_BEST_EFFORT 0
+#define VTPU_QOS_LATENCY_CRITICAL 1
+/* Dispatch-wait histogram: log2 microsecond buckets.  Bucket 0 counts
+ * zero-wait admissions; bucket k>=1 covers [2^(k-1), 2^k) us; the last
+ * bucket saturates (+Inf). */
+#define VTPU_QOS_WAIT_BUCKETS 20
+
 /* Per-process accounting slot. */
 typedef struct {
   int32_t pid;          /* in-container pid; 0 = slot free */
@@ -74,6 +85,31 @@ typedef struct {
   int32_t proc_num; /* high-water mark of used slots */
   int32_t pad2_;
   vtpu_proc_slot_t procs[VTPU_MAX_PROCS];
+
+  /* -- QoS plane (SLO-tiered co-residency; docs/serving.md) ----------------
+   * Appended AFTER procs so every pre-QoS field keeps its offset: an ABI v1
+   * reader simply never looks past procs.  Writers created by older
+   * libraries produce a smaller file, which vtpu_open_region rejects and
+   * vtpu_init_path re-initializes (size check), so mixed-version access
+   * never reads garbage.
+   *
+   * qos_class is set once at init from VTPU_QOS_CLASS (device plugin env,
+   * from the vtpu.dev/qos pod annotation); qos_weight_pct / qos_yield are
+   * the monitor's graded feedback plane — the tiered generalization of the
+   * binary utilization_switch above: the node monitor re-weights each
+   * class's duty share from observed per-class dispatch-wait p99 and tells
+   * best-effort sharers to stop borrowing idle duty while a co-resident
+   * latency-critical slot has queued work.  The wait/cost counters and the
+   * log2 wait histogram are written by the rate limiter on every gated
+   * dispatch so the split is observable from the host side. */
+  int32_t qos_class;      /* VTPU_QOS_OFF | BEST_EFFORT | LATENCY_CRITICAL */
+  int32_t qos_weight_pct; /* duty re-weight, percent of sm_limit; 100 = neutral */
+  int32_t qos_yield;      /* 1: best-effort must not borrow idle duty */
+  int32_t qos_pad_;
+  uint64_t qos_wait_count;    /* dispatches that passed the QoS gate */
+  uint64_t qos_wait_us_total; /* total us spent blocked at the gate */
+  uint64_t qos_cost_us_total; /* total device-us charged through the gate */
+  uint64_t qos_wait_hist[VTPU_QOS_WAIT_BUCKETS];
 } vtpu_region_t;
 
 #ifdef __cplusplus

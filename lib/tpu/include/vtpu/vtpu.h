@@ -84,6 +84,22 @@ void vtpu_r_set_monitor_used(vtpu_region_t* r, int32_t pid, int dev,
 int vtpu_r_gc(vtpu_region_t* r, const int32_t* live_pids, int n_live);
 uint64_t vtpu_r_generation(vtpu_region_t* r);
 
+/* -- QoS plane (SLO-tiered co-residency; docs/serving.md) ----------------- */
+/* Class is set once at init (VTPU_QOS_CLASS env); weight/yield are the
+ * monitor's graded feedback writes; the wait/cost counters and log2-us
+ * wait histogram are written by the rate limiter per gated dispatch. */
+int vtpu_r_qos_class(vtpu_region_t* r); /* VTPU_QOS_OFF/BEST_EFFORT/LATENCY_CRITICAL */
+int vtpu_r_qos_weight(vtpu_region_t* r);
+void vtpu_r_set_qos_weight(vtpu_region_t* r, int pct);
+int vtpu_r_qos_yield(vtpu_region_t* r);
+void vtpu_r_set_qos_yield(vtpu_region_t* r, int on);
+uint64_t vtpu_r_qos_wait_count(vtpu_region_t* r);
+uint64_t vtpu_r_qos_wait_us_total(vtpu_region_t* r);
+uint64_t vtpu_r_qos_cost_us_total(vtpu_region_t* r);
+/* Copy up to `max` histogram buckets into `out`; returns buckets copied
+ * (VTPU_QOS_WAIT_BUCKETS when max allows). */
+int vtpu_r_qos_wait_hist(vtpu_region_t* r, uint64_t* out, int max);
+
 #ifdef __cplusplus
 }
 #endif

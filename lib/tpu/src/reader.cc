@@ -145,4 +145,49 @@ int vtpu_r_gc(vtpu_region_t* r, const int32_t* live_pids, int n_live) {
 
 uint64_t vtpu_r_generation(vtpu_region_t* r) { return r ? r->generation : 0; }
 
+/* -- QoS plane ------------------------------------------------------------- */
+
+int vtpu_r_qos_class(vtpu_region_t* r) {
+  return r ? __atomic_load_n(&r->qos_class, __ATOMIC_RELAXED) : VTPU_QOS_OFF;
+}
+
+int vtpu_r_qos_weight(vtpu_region_t* r) {
+  if (!r) return 100;
+  int w = __atomic_load_n(&r->qos_weight_pct, __ATOMIC_RELAXED);
+  return w > 0 ? w : 100;
+}
+
+void vtpu_r_set_qos_weight(vtpu_region_t* r, int pct) {
+  if (r && pct > 0)
+    __atomic_store_n(&r->qos_weight_pct, pct, __ATOMIC_RELAXED);
+}
+
+int vtpu_r_qos_yield(vtpu_region_t* r) {
+  return r ? __atomic_load_n(&r->qos_yield, __ATOMIC_RELAXED) : 0;
+}
+
+void vtpu_r_set_qos_yield(vtpu_region_t* r, int on) {
+  if (r) __atomic_store_n(&r->qos_yield, on ? 1 : 0, __ATOMIC_RELAXED);
+}
+
+uint64_t vtpu_r_qos_wait_count(vtpu_region_t* r) {
+  return r ? __atomic_load_n(&r->qos_wait_count, __ATOMIC_RELAXED) : 0;
+}
+
+uint64_t vtpu_r_qos_wait_us_total(vtpu_region_t* r) {
+  return r ? __atomic_load_n(&r->qos_wait_us_total, __ATOMIC_RELAXED) : 0;
+}
+
+uint64_t vtpu_r_qos_cost_us_total(vtpu_region_t* r) {
+  return r ? __atomic_load_n(&r->qos_cost_us_total, __ATOMIC_RELAXED) : 0;
+}
+
+int vtpu_r_qos_wait_hist(vtpu_region_t* r, uint64_t* out, int max) {
+  if (!r || !out || max <= 0) return 0;
+  int n = max < VTPU_QOS_WAIT_BUCKETS ? max : VTPU_QOS_WAIT_BUCKETS;
+  for (int i = 0; i < n; i++)
+    out[i] = __atomic_load_n(&r->qos_wait_hist[i], __ATOMIC_RELAXED);
+  return n;
+}
+
 }  // extern "C"

@@ -136,6 +136,20 @@ void apply_env_limits(vtpu_region_t* r) {
   r->priority = (int32_t)env_long("TPU_TASK_PRIORITY", 0);
   const char* ov = getenv("TPU_OVERSUBSCRIBE");
   r->oversubscribe = (ov && (!strcmp(ov, "true") || !strcmp(ov, "1"))) ? 1 : 0;
+  /* QoS class (vtpu.dev/qos -> device plugin VTPU_QOS_CLASS).  Absent or
+   * unrecognized -> VTPU_QOS_OFF: the limiter takes the flat path
+   * bit-for-bit (no-annotation fleets must be unchanged).  The webhook
+   * rejects unknown values at admission, so "unrecognized" here only
+   * means a hand-set env outside the managed path. */
+  r->qos_class = VTPU_QOS_OFF;
+  const char* qos = getenv("VTPU_QOS_CLASS");
+  if (qos && *qos) {
+    if (!strcmp(qos, "latency-critical"))
+      r->qos_class = VTPU_QOS_LATENCY_CRITICAL;
+    else if (!strcmp(qos, "best-effort"))
+      r->qos_class = VTPU_QOS_BEST_EFFORT;
+  }
+  r->qos_weight_pct = 100;
 }
 
 }  // namespace

@@ -66,11 +66,16 @@ class FakeLoop:
 
 
 def counter_row(ctrkey, chip_seconds=0.0, hbm=0.0, chips=1, active=True,
-                oversub=False, throttled=0.0, spill=0.0, window=0.0):
+                oversub=False, throttled=0.0, spill=0.0, window=0.0,
+                qos_class="", qos_weight=100, qos_wait_s=0.0,
+                qos_hist=()):
     return {"ctrkey": ctrkey, "chips": chips, "active": active,
             "oversubscribe": oversub, "chip_seconds": chip_seconds,
             "hbm_byte_seconds": hbm, "throttled_seconds": throttled,
-            "oversub_spill_seconds": spill, "window_s": window}
+            "oversub_spill_seconds": spill, "window_s": window,
+            "qos_class": qos_class, "qos_weight_pct": qos_weight,
+            "qos_wait_seconds_total": qos_wait_s,
+            "qos_wait_hist": list(qos_hist)}
 
 
 def register_node(s, name, chips=4, devmem=16384):

@@ -36,7 +36,7 @@ def template_files():
 
 def argparse_flags(module_path):
     src = read(os.path.join(REPO, module_path))
-    return set(re.findall(r"add_argument\(\s*\"(--[a-z-]+)\"", src))
+    return set(re.findall(r"add_argument\(\s*\"(--[a-z0-9-]+)\"", src))
 
 
 def template_flags(path, command_marker):
@@ -48,7 +48,7 @@ def template_flags(path, command_marker):
     flags = set()
     block = src[src.index(command_marker):]
     for line in block.splitlines():
-        m = re.search(r"-\s+(--[a-z-]+)", line)
+        m = re.search(r"-\s+(--[a-z0-9-]+)", line)
         if m:
             flags.add(m.group(1))
         if line.strip().startswith(("ports:", "env:", "volumeMounts:")):

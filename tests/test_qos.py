@@ -1,0 +1,438 @@
+"""SLO-tiered co-residency control plane (docs/serving.md).
+
+Covers the QoS class's path through the cluster side: webhook
+validation (422 on unknown classes, mesh-validation discipline), the
+placement-time duty split recorded on the grant, the device plugin's
+container env, the monitor's per-class duty re-weighting loop
+(QosController on fake regions — the native limiter side lives in
+test_shim.py), and the quota backfill ↔ measured-idle-duty interlock.
+"""
+
+import dataclasses
+from types import SimpleNamespace
+
+from k8s_vgpu_scheduler_tpu.monitor.feedback import (
+    ContainerState,
+    QosConfig,
+    QosController,
+    hist_p99_us,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo, PodManager
+from k8s_vgpu_scheduler_tpu.scheduler.webhook import (
+    handle_admission_review,
+    validate_pod_qos,
+)
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from k8s_vgpu_scheduler_tpu.util.types import (
+    ContainerDevice,
+    QOS_ANNOTATION,
+    QOS_DUTY_SPLIT_ANNOTATION,
+)
+from tests.test_quota import QA, build, mkpod
+
+
+def qos_pod(qos=None, name="s", tpu=1):
+    anns = {} if qos is None else {QOS_ANNOTATION: qos}
+    return {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}", "annotations": anns},
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {"google.com/tpu": str(tpu),
+                                     "google.com/tpumem": "3000"}}}]},
+    }
+
+
+# ---------------------------------------------------------------------------
+# webhook validation
+# ---------------------------------------------------------------------------
+
+class TestWebhookQosValidation:
+    CFG = Config()
+
+    def _review(self, pod):
+        body = {"request": {"uid": "rq", "operation": "CREATE",
+                            "object": pod}}
+        return handle_admission_review(body, self.CFG)
+
+    def test_unknown_class_rejected_422(self):
+        out = self._review(qos_pod("gold"))
+        r = out["response"]
+        assert r["allowed"] is False
+        assert r["status"]["code"] == 422
+        assert "gold" in r["status"]["message"]
+        assert "latency-critical" in r["status"]["message"]
+
+    def test_known_classes_admit(self):
+        for cls in ("latency-critical", "best-effort"):
+            out = self._review(qos_pod(cls))
+            assert out["response"]["allowed"] is True, cls
+            assert out["response"].get("patch")  # schedulerName mutation
+
+    def test_no_annotation_untouched(self):
+        assert validate_pod_qos(qos_pod()) is None
+        assert self._review(qos_pod())["response"]["allowed"] is True
+
+    def test_empty_value_rejected(self):
+        # "" is not a class; running it silently as best-effort is the
+        # quiet misconfiguration the validation exists to stop.
+        assert validate_pod_qos(qos_pod("")) is not None
+
+
+# ---------------------------------------------------------------------------
+# duty split recorded on the grant
+# ---------------------------------------------------------------------------
+
+def _grant(cores):
+    return [[ContainerDevice(uuid="c0", type="v5e", usedmem=100,
+                             usedcores=cores)]]
+
+
+class TestDutySplit:
+    def test_split_sums_usedcores_by_class(self):
+        mgr = PodManager()
+        mgr.add_pod(PodInfo(uid="u1", name="serve", namespace="d",
+                            node="n0", devices=_grant(40),
+                            qos="latency-critical"))
+        mgr.add_pod(PodInfo(uid="u2", name="train", namespace="d",
+                            node="n0", devices=_grant(40),
+                            qos="best-effort"))
+        # Unclassed grants count as best-effort (the runtime default).
+        mgr.add_pod(PodInfo(uid="u3", name="legacy", namespace="d",
+                            node="n0", devices=_grant(20)))
+        mgr.add_pod(PodInfo(uid="u4", name="other-node", namespace="d",
+                            node="n1", devices=_grant(90),
+                            qos="best-effort"))
+        s = SimpleNamespace(pods=mgr)
+        assert Scheduler._qos_duty_split(s, "n0") == \
+            "best-effort=60,latency-critical=40"
+
+    def test_decision_records_split_for_qos_pods_only(self):
+        s, kube, names, clock = build(queues=())
+        plain = mkpod("plain", "team-a", chips=1)
+        kube.create_pod(plain)
+        r = s.filter(plain, names)
+        assert r.node, r.error
+        anns = kube.get_pod("team-a", "plain")["metadata"]["annotations"]
+        assert QOS_DUTY_SPLIT_ANNOTATION not in anns
+
+        lc = mkpod("svc", "team-a", chips=1,
+                   extra_anns={QOS_ANNOTATION: "latency-critical"})
+        kube.create_pod(lc)
+        r = s.filter(lc, names)
+        assert r.node, r.error
+        anns = kube.get_pod("team-a", "svc")["metadata"]["annotations"]
+        split = anns[QOS_DUTY_SPLIT_ANNOTATION]
+        assert "latency-critical=" in split
+
+
+# ---------------------------------------------------------------------------
+# device plugin env
+# ---------------------------------------------------------------------------
+
+class TestDevicePluginQosEnv:
+    def _alloc(self, tmp_path, extra_anns):
+        from k8s_vgpu_scheduler_tpu.deviceplugin.plugin import (
+            TpuDevicePlugin)
+        from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+        from k8s_vgpu_scheduler_tpu.tpulib import MockBackend
+        from k8s_vgpu_scheduler_tpu.util import codec
+        from k8s_vgpu_scheduler_tpu.util.types import (
+            TO_ALLOCATE_ANNOTATION)
+        from tests.test_deviceplugin import (
+            V5E_FIXTURE, allocating_pod, make_cfg)
+
+        inv = MockBackend(dict(V5E_FIXTURE)).inventory()
+        plugin = TpuDevicePlugin(FakeKube(), inv, make_cfg(tmp_path),
+                                 socket_dir=str(tmp_path))
+        pod = allocating_pod(inv)
+        pod["metadata"]["annotations"].update(extra_anns)
+        resp = plugin.build_container_response(
+            pod, codec.decode_pod_devices(
+                pod["metadata"]["annotations"][TO_ALLOCATE_ANNOTATION])[0])
+        return dict(resp.envs)
+
+    def test_qos_class_and_split_reach_container_env(self, tmp_path):
+        envs = self._alloc(tmp_path, {
+            QOS_ANNOTATION: "latency-critical",
+            QOS_DUTY_SPLIT_ANNOTATION:
+                "best-effort=30,latency-critical=30"})
+        assert envs["VTPU_QOS_CLASS"] == "latency-critical"
+        assert envs["VTPU_QOS_DUTY_SPLIT"] == \
+            "best-effort=30,latency-critical=30"
+
+    def test_no_annotation_no_env(self, tmp_path):
+        envs = self._alloc(tmp_path, {})
+        assert "VTPU_QOS_CLASS" not in envs
+        assert "VTPU_QOS_DUTY_SPLIT" not in envs
+
+
+# ---------------------------------------------------------------------------
+# monitor re-weighting loop (fake regions; native side in test_shim.py)
+# ---------------------------------------------------------------------------
+
+class FakeQosRegion:
+    def __init__(self, cls, uuids=("chipX",)):
+        self.qos_class = cls
+        self.qos_weight = 100
+        self.qos_yield = 0
+        self.hist = [0] * 20
+        self._uuids = list(uuids)
+
+    def uuids(self):
+        return self._uuids
+
+    def qos_wait_hist(self):
+        return list(self.hist)
+
+    def set_qos_weight(self, pct):
+        self.qos_weight = pct
+
+    def set_qos_yield(self, on):
+        self.qos_yield = 1 if on else 0
+
+    def waited(self, us, n=1):
+        """Record n dispatches that waited ``us`` microseconds."""
+        idx = 0
+        w = us
+        while w > 0 and idx < len(self.hist) - 1:
+            w >>= 1
+            idx += 1
+        self.hist[idx] += n
+
+
+def containers(**kv):
+    return {k: ContainerState(key=k, region=r) for k, r in kv.items()}
+
+
+class TestQosController:
+    def test_p99_from_log2_buckets(self):
+        delta = [0] * 20
+        delta[0] = 98   # zero-wait
+        delta[14] = 2   # waits in [8.2ms, 16.4ms): ranks 99-100
+        assert hist_p99_us(delta) == float(1 << 14)
+        assert hist_p99_us([0] * 20) is None
+        assert hist_p99_us([5] + [0] * 19) == 0.0
+
+    def test_breach_shifts_duty_and_raises_yield(self):
+        lc, be = FakeQosRegion(1), FakeQosRegion(0)
+        ctl = QosController(QosConfig(target_p99_us=5000, step_pct=15))
+        lc.waited(50000, n=10)  # p99 well above 5ms
+        ctl.observe(containers(a=lc, b=be))
+        assert lc.qos_weight == 115 and be.qos_weight == 85
+        assert be.qos_yield == 1
+        assert ctl.reweights_total == 1
+
+    def test_weights_clamped_at_floor_and_ceiling(self):
+        lc, be = FakeQosRegion(1), FakeQosRegion(0)
+        cfg = QosConfig(target_p99_us=5000, step_pct=50,
+                        min_weight_pct=25, max_weight_pct=175)
+        ctl = QosController(cfg)
+        for _ in range(5):
+            lc.waited(50000, n=10)
+            ctl.observe(containers(a=lc, b=be))
+        assert lc.qos_weight == 175 and be.qos_weight == 25
+
+    def test_recovery_returns_duty_with_hysteresis(self):
+        lc, be = FakeQosRegion(1), FakeQosRegion(0)
+        ctl = QosController(QosConfig(target_p99_us=5000, step_pct=15,
+                                      recover_ticks=2))
+        lc.waited(50000, n=10)
+        ctl.observe(containers(a=lc, b=be))
+        assert (lc.qos_weight, be.qos_weight) == (115, 85)
+        # One quiet tick: hysteresis holds; second returns one step.
+        ctl.observe(containers(a=lc, b=be))
+        assert (lc.qos_weight, be.qos_weight) == (115, 85)
+        ctl.observe(containers(a=lc, b=be))
+        assert (lc.qos_weight, be.qos_weight) == (100, 100)
+        assert be.qos_yield == 0
+
+    def test_dead_band_holds_weights(self):
+        lc, be = FakeQosRegion(1), FakeQosRegion(0)
+        ctl = QosController(QosConfig(target_p99_us=5000, step_pct=15,
+                                      recover_ticks=1,
+                                      recover_frac=0.5))
+        lc.waited(50000, n=10)
+        ctl.observe(containers(a=lc, b=be))
+        assert be.qos_weight == 85
+        # p99 ~4ms: under target but above target/2 — hold, no return.
+        lc.waited(4000, n=100)
+        ctl.observe(containers(a=lc, b=be))
+        assert be.qos_weight == 85
+
+    def test_container_restart_counter_reset_tolerated(self):
+        lc = FakeQosRegion(1)
+        ctl = QosController(QosConfig(target_p99_us=5000))
+        lc.waited(50000, n=10)
+        ctl.observe(containers(a=lc))
+        # In-place restart: counters start over, smaller than last seen.
+        lc.hist = [0] * 20
+        lc.waited(0, n=5)
+        ctl.observe(containers(a=lc))  # must not underflow / mis-shift
+        assert ctl.critical_p99_us["chipX"] == 0.0
+
+    def test_no_qos_regions_noop(self):
+        flat = FakeQosRegion(-1)
+        ctl = QosController()
+        ctl.observe(containers(a=flat))
+        assert flat.qos_weight == 100 and flat.qos_yield == 0
+        assert ctl.reweights_total == 0
+
+    def test_multichip_region_gets_one_consistent_write_per_tick(self):
+        """A region spanning several chips must get ONE decision per
+        tick: yield if ANY of its chips has critical queued work (not
+        last-chip-wins over dict order), and its weight stepped once
+        even when every chip breaches (not once per chip)."""
+        lc = FakeQosRegion(1, uuids=("chipA",))
+        be = FakeQosRegion(0, uuids=("chipA", "chipB"))
+        ctl = QosController(QosConfig(target_p99_us=5000, step_pct=15))
+        lc.waited(50000, n=10)
+        ctl.observe(containers(a=lc, b=be))
+        # chipB has no critical at all; chipA's queued work must still
+        # win the fold.
+        assert be.qos_yield == 1
+        # One step, not one per chip.
+        assert be.qos_weight == 85
+        lc2 = FakeQosRegion(1, uuids=("chipA", "chipB"))
+        ctl2 = QosController(QosConfig(target_p99_us=5000, step_pct=15))
+        lc2.waited(50000, n=10)  # breaches on BOTH of its chips
+        ctl2.observe(containers(a=lc2))
+        assert lc2.qos_weight == 115
+
+    def test_multichip_region_returns_only_when_all_chips_ready(self):
+        """Duty returns only when EVERY chip of the region recovered —
+        a breach-on-A / quiet-on-B split must not oscillate the weight
+        up and back within one tick."""
+        lc_a = FakeQosRegion(1, uuids=("chipA",))
+        be = FakeQosRegion(0, uuids=("chipA", "chipB"))
+        ctl = QosController(QosConfig(target_p99_us=5000, step_pct=15,
+                                      recover_ticks=1))
+        lc_a.waited(50000, n=10)
+        ctl.observe(containers(a=lc_a, b=be))
+        assert be.qos_weight == 85
+        # chipB is instantly "ready" (no critical) but chipA still
+        # breaches: the region must keep shifting down, never bounce.
+        lc_a.waited(50000, n=10)
+        ctl.observe(containers(a=lc_a, b=be))
+        assert be.qos_weight == 70
+
+    def test_state_cleared_when_last_qos_container_leaves(self):
+        lc = FakeQosRegion(1)
+        ctl = QosController(QosConfig(target_p99_us=5000))
+        lc.waited(50000, n=10)
+        ctl.observe(containers(a=lc))
+        assert ctl.critical_p99_us
+        ctl.observe({})  # pod gone: chip memory must not outlive it
+        assert not ctl.critical_p99_us
+        assert not ctl._good and not ctl._quiet
+
+    def test_critical_only_chip_never_yields_anyone(self):
+        lc = FakeQosRegion(1)
+        ctl = QosController(QosConfig(target_p99_us=5000))
+        lc.waited(50000, n=10)
+        ctl.observe(containers(a=lc))
+        assert lc.qos_weight == 115  # credit grows even with no donor
+
+
+# ---------------------------------------------------------------------------
+# quota backfill ↔ measured idle duty
+# ---------------------------------------------------------------------------
+
+GANG_ANNS = {"vtpu.dev/pod-group": "ring", "vtpu.dev/pod-group-total": "2"}
+
+
+def seed_busy(s, node, chips, uid="busy1"):
+    """Ledger report: ``chips`` actively-dispatching chips on ``node``."""
+    s.ledger.record(node, [{
+        "ctrkey": f"{uid}_{uid}", "chips": chips, "active": True,
+        "oversubscribe": False, "chip_seconds": 1.0,
+        "hbm_byte_seconds": 0.0, "throttled_seconds": 0.0,
+        "oversub_spill_seconds": 0.0, "window_s": 2.0,
+    }])
+
+
+class TestBackfillIdleInterlock:
+    def _fleet_with_accumulating_gang(self, kube, clock):
+        kube.create_pod(mkpod("ring-0", "team-a", queue="a",
+                              extra_anns=GANG_ANNS))
+        clock.advance(1)
+
+    def test_best_effort_backfill_needs_measured_idle(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 8}),), nodes=2, chips=4)
+        self._fleet_with_accumulating_gang(kube, clock)
+        kube.create_pod(mkpod(
+            "filler", "team-a", chips=2, queue="a",
+            extra_anns={QOS_ANNOTATION: "best-effort"}))
+        # Every chip measured busy: no idle duty to soak — held.
+        seed_busy(s, "n0", 4, uid="t0")
+        seed_busy(s, "n1", 4, uid="t1")
+        acts = s.admission.tick()
+        assert not [a for a in acts if a["kind"] == "admit"]
+        # Usage reports now show 3 idle chips on n1: backfill admits.
+        seed_busy(s, "n1", 1, uid="t1")
+        acts = s.admission.tick()
+        assert [a["pod"] for a in acts if a["kind"] == "admit"] == \
+            ["team-a/filler"]
+
+    def test_unmeasured_fleet_backfills_unchanged(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 8}),), nodes=2, chips=4)
+        self._fleet_with_accumulating_gang(kube, clock)
+        kube.create_pod(mkpod(
+            "filler", "team-a", chips=2, queue="a",
+            extra_anns={QOS_ANNOTATION: "best-effort"}))
+        acts = s.admission.tick()  # no monitor anywhere: interlock off
+        assert [a["pod"] for a in acts if a["kind"] == "admit"] == \
+            ["team-a/filler"]
+
+    def test_non_best_effort_backfill_not_gated(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 8}),), nodes=2, chips=4)
+        self._fleet_with_accumulating_gang(kube, clock)
+        kube.create_pod(mkpod("filler", "team-a", chips=2, queue="a"))
+        seed_busy(s, "n0", 4, uid="t0")
+        seed_busy(s, "n1", 4, uid="t1")
+        acts = s.admission.tick()
+        assert [a["pod"] for a in acts if a["kind"] == "admit"] == \
+            ["team-a/filler"]
+
+    def test_pruned_ledger_account_folds_into_qos_retired_base(self):
+        """The fleet-wide per-class histograms are sums over accounts;
+        a pruned (retired) pod's contribution must move into the
+        retired base, never vanish — a sum going backwards reads as a
+        Prometheus counter reset and rate() reports a spurious spike."""
+        from k8s_vgpu_scheduler_tpu.accounting.ledger import UsageLedger
+
+        t = [0.0]
+        ledger = UsageLedger(clock=lambda: t[0], retention_s=10.0)
+        ledger.record("n0", [{
+            "ctrkey": "uA_pA", "chips": 1, "active": True,
+            "chip_seconds": 1.0, "qos_class": "latency-critical",
+            "qos_weight_pct": 120, "qos_wait_seconds_total": 2.5,
+            "qos_wait_hist": [5, 0, 2]}])
+        t[0] = 100.0  # past retention: next record prunes pA
+        ledger.record("n0", [{
+            "ctrkey": "uB_pB", "chips": 1, "active": True,
+            "chip_seconds": 1.0, "qos_class": "latency-critical",
+            "qos_weight_pct": 100, "qos_wait_seconds_total": 0.5,
+            "qos_wait_hist": [3]}])
+        assert ledger.get("uA") is None  # pruned
+        hist, s = ledger.qos_retired()["latency-critical"]
+        assert hist == [5, 0, 2] and s == 2.5
+        # Live + retired together: the exporter's sum never shrank.
+        live = ledger.get("uB")
+        assert live.qos_wait_hist == [3]
+
+    def test_queue_entry_carries_qos(self):
+        s, kube, names, clock = build(
+            queues=(dict(QA, quota={"chips": 8}),), nodes=2, chips=4)
+        pod = mkpod("svc", "team-a", chips=1, queue="a",
+                    extra_anns={QOS_ANNOTATION: "latency-critical"})
+        kube.create_pod(pod)
+        from k8s_vgpu_scheduler_tpu.util.resources import (
+            container_requests)
+        s.quota.gate(pod, container_requests(pod, s.cfg))
+        e = s.quota.entry("uid-svc")
+        assert e is not None and e.qos == "latency-critical"

@@ -77,7 +77,10 @@ class _SchedulerStub:
                "oversub_spill_seconds": 0.0, "window_s": 120.0}
         self.ledger.record("node-a", [row])
         self._now[0] += 60.0
-        self.ledger.record("node-a", [dict(row, chip_seconds=120.0)])
+        self.ledger.record("node-a", [dict(
+            row, chip_seconds=120.0, qos_class="latency-critical",
+            qos_weight_pct=130, qos_wait_seconds_total=0.25,
+            qos_wait_hist=[40, 0, 2])])
         self.pods = _Pods([
             PodInfo(uid="u1", name="train-a", namespace="default",
                     node="node-a",
@@ -199,8 +202,13 @@ def test_top_view_joins_actual_against_granted():
     assert info["pods"][-1]["efficiency"] is None
     assert info["pods"][-1]["waste_chips"] is None
     assert info["idle_grants"] == 0
+    # QoS columns (docs/serving.md): class + current duty weight ride
+    # the waste view via vtpu_pod_qos_duty_weight.
+    assert t["qos_class"] == "latency-critical"
+    assert t["qos_duty_weight_pct"] == 130
     text = format_top(info)
     assert "default/train-a" in text and "idle grant(s)" in text
+    assert "latency-critical" in text and "130%" in text
 
 
 def test_grafana_dashboard_uses_real_metric_names():
@@ -215,7 +223,7 @@ def test_grafana_dashboard_uses_real_metric_names():
         referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
     # promql functions + aggregation labels, not metrics
     referenced -= {"rate", "label_values", "node", "histogram_quantile",
-                   "phase", "reason", "clamp_min"}
+                   "phase", "reason", "clamp_min", "class"}
 
     missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
@@ -332,8 +340,10 @@ def test_alert_rules_use_real_metric_names():
         referenced |= set(re.findall(r"[a-z][a-z0-9_]{3,}", r["expr"]))
         assert r["alert"] and r["annotations"]["summary"]
     # promql fns + the scrape-level `up` series' label matcher, whose
-    # hyphenated job name tokenizes as "vtpu"/"monitor".
+    # hyphenated job name tokenizes as "vtpu"/"monitor" — plus the QoS
+    # class label and its hyphenated "latency-critical" value.
     referenced -= {"rate", "absent", "clamp_min", "min_over_time",
-                   "vtpu", "monitor"}
+                   "vtpu", "monitor", "histogram_quantile", "sum",
+                   "class", "latency", "critical"}
     missing = referenced - _emitted_metrics()
     assert not missing, f"alerts reference unknown metrics: {missing}"
