@@ -243,10 +243,54 @@ def _guarded_run(label, argv, env, fuse):
     return False, rc, out, err
 
 
+def snapshot_capacity_scenario() -> None:
+    """Capacity-trace capture (docs/observability.md "Capacity
+    planning"): when a healthy window appears, snapshot a LIVE
+    scheduler's /capacityz demand series into a replayable capacity
+    scenario file (accounting/planner.py scenario_from_capacityz), so
+    the same pinned verdicts the synthetic bursty/diurnal/flash-crowd
+    patterns carry can later replay real captured demand.  Pure HTTP +
+    JSON — never touches the chip or the pool claim; skips loudly when
+    no scheduler URL is configured or reachable."""
+    url = os.environ.get("VTPU_SCHED_URL", "")
+    if not url:
+        log("capacity snapshot: VTPU_SCHED_URL unset; skipping")
+        return
+    import urllib.request
+
+    from k8s_vgpu_scheduler_tpu.accounting.planner import (
+        scenario_from_capacityz)
+
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    try:
+        with urllib.request.urlopen(base + "/capacityz", timeout=10) as r:
+            doc = json.load(r)
+    except Exception as e:  # noqa: BLE001 — capture is best-effort
+        log(f"capacity snapshot: cannot fetch {base}/capacityz: {e!r}")
+        return
+    spec = scenario_from_capacityz(doc)
+    if not spec["capacity"]["streams"]:
+        log("capacity snapshot: no demand series recorded yet; skipping")
+        return
+    out = os.path.join(REPO, "benchmarks",
+                       f"captured-capacity-{round_id()}.json")
+    with open(out, "w") as f:
+        json.dump(spec, f, indent=1)
+    log(f"capacity snapshot: wrote {out} "
+        f"({len(spec['capacity']['streams'])} stream(s))")
+
+
 def run_queue(kinds) -> bool:
     """Run the queue sequentially; False if a child overran or left a
     detached claim-holder (stop — the pool claim may still be held)."""
     import bench
+
+    # First thing in any healthy window, before anything can wedge the
+    # queue: the ledger-window capacity snapshot (claim-free).
+    if "capacity" in kinds:
+        snapshot_capacity_scenario()
 
     tmpdir = tempfile.mkdtemp(prefix="poolwatch-")
     env = bench.shim_env(tmpdir)
@@ -355,7 +399,8 @@ def main() -> None:
                     help="seconds between probes while wedged")
     ap.add_argument("--probe-window", type=float, default=300.0)
     ap.add_argument("--max-hours", type=float, default=6.0)
-    ap.add_argument("--tasks", default="bench,model,micro,scen,oversub")
+    ap.add_argument("--tasks",
+                    default="bench,model,micro,scen,oversub,capacity")
     a = ap.parse_args()
     # One round identity for the whole run: model_tasks' per-round retry
     # markers and run_queue's scenario children both read SCENARIO_ROUND,

@@ -1354,8 +1354,133 @@ def scenario_gang() -> None:
     })
 
 
+# --- predictive capacity: named trace-driven arrival scenarios ---------------
+#
+# The three NAMED arrival scenarios (ROADMAP item 1's scenario-diversity
+# play): each pins an arrival pattern (accounting/planner.py synth),
+# queue entitlements, a fleet shape, and the forecaster settings, and
+# carries its own verdict through the REAL admission loop on the virtual
+# clock (cmd/simulate.py run_capacity_phase).  `make capacity-sim` (and
+# the `capacity` scenario here) replays all three and emits
+# CAPACITY_<round>.json — deterministic and CPU-only by construction
+# (SimClock, no RNG), so it runs identically on a wedged-pool day.
+# These are also roadmap items 4/5's arrival-pattern substrate.
+CAPACITY_FLEET = {"nodes": 2, "chips": 4, "hbm": 16384, "mesh": (4, 1)}
+ARRIVAL_SCENARIOS: dict = {
+    # Periodic bursts on a small base; the victim queue's backlog
+    # (long-running pods, entitlement = the whole fleet) crosses
+    # capacity mid-horizon.  Verdict: starvation ETA predicted within
+    # one forecast bucket of actual.
+    "bursty": {
+        "pattern": "bursty",
+        "pattern_params": {"base_chips": 0.5, "burst_chips": 2.0,
+                           "period_buckets": 8, "burst_buckets": 2},
+        "streams": [{"name": "train", "namespace": "tenant-a", "tpu": 1,
+                     "runtime_s": 100000}],
+        "queues": [{"name": "tenant-a", "namespaces": ["tenant-a"],
+                    "quota": {"chips": 8}}],
+        "bucket_s": 30, "history_buckets": 48, "horizon_buckets": 16,
+        "season_buckets": 8, "alpha": 0.05, "gamma": 0.7, "beta": 0.0,
+        "tick_s": 5, "starve_after_s": 60,
+        "require_starvation": ["tenant-a"],
+    },
+    # A day-shaped (raised-cosine) arrival rate whose crest outruns the
+    # fleet; seasonality recovery times the crest.  Same verdict bar.
+    "diurnal": {
+        "pattern": "diurnal",
+        "pattern_params": {"base_chips": 0.5, "amplitude_chips": 3.0,
+                           "period_buckets": 16},
+        "streams": [{"name": "web", "namespace": "tenant-day", "tpu": 1,
+                     "runtime_s": 100000}],
+        "queues": [{"name": "tenant-day", "namespaces": ["tenant-day"],
+                    "quota": {"chips": 8}}],
+        "bucket_s": 30, "history_buckets": 48, "horizon_buckets": 16,
+        "season_buckets": 16, "alpha": 0.05, "gamma": 0.7, "beta": 0.0,
+        "tick_s": 5, "starve_after_s": 60,
+        "require_starvation": ["tenant-day"],
+    },
+    # A latency-critical serving queue hit by a flash crowd (the ramp
+    # begins in the history tail, so the level term sees it), next to a
+    # best-effort batch filler whose grants are all borrowed.  Verdict:
+    # the node-sweep scale recommendation, applied in the ACTUAL-trace
+    # replay, keeps `serve` unstarved with zero overbooking — and the
+    # replica-loss what-if (HA storm sized from the forecast peak)
+    # keeps every shard-protocol invariant.
+    "flash-crowd": {
+        "pattern": "flash-crowd",
+        "pattern_params": {"base_chips": 0.5, "surge_chips": 6.0,
+                           "surge_at_bucket": 40, "ramp_buckets": 4},
+        "streams": [
+            {"name": "serve", "namespace": "serve", "tpu": 1,
+             "runtime_s": 50},
+            {"name": "batch", "namespace": "batch", "tpu": 1,
+             "runtime_s": 100000,
+             "pattern": "bursty",
+             "pattern_params": {"base_chips": 0.3, "burst_chips": 0.0,
+                                "period_buckets": 8,
+                                "burst_buckets": 1}}],
+        "queues": [
+            {"name": "serve", "namespaces": ["serve"], "cohort": "main",
+             "weight": 3, "quota": {"chips": 20}},
+            {"name": "batch", "namespaces": ["batch"], "cohort": "main",
+             "weight": 1, "quota": {"chips": 0},
+             "borrow_limit_chips": 20}],
+        "bucket_s": 30, "history_buckets": 48, "horizon_buckets": 16,
+        "season_buckets": 1, "alpha": 0.5, "gamma": 0.5, "beta": 0.1,
+        "tick_s": 5, "starve_after_s": 60,
+        "recommend": True, "critical_queue": "serve",
+        "max_extra_nodes": 6,
+        "replica_loss": {"replicas": 3, "kill_after": 8},
+    },
+}
+
+
+def scenario_capacity() -> None:
+    """Predictive-capacity verdicts over the three named arrival
+    scenarios, entirely on the virtual clock (no device, no degraded
+    mode — the chip-outage-proof tier by design)."""
+    import logging
+
+    from k8s_vgpu_scheduler_tpu.cmd.simulate import run_simulation
+
+    logging.disable(logging.CRITICAL)  # reclaim churn logs by design
+    try:
+        results = {}
+        ok = True
+        for name, spec in ARRIVAL_SCENARIOS.items():
+            log(f"capacity scenario {name}")
+            r = run_simulation({"capacity": spec},
+                               nodes=CAPACITY_FLEET["nodes"],
+                               chips=CAPACITY_FLEET["chips"],
+                               hbm=CAPACITY_FLEET["hbm"],
+                               mesh=CAPACITY_FLEET["mesh"])
+            cp = r["capacity"]
+            ok = ok and cp["verdict"]["ok"]
+            results[name] = {
+                "verdict": cp["verdict"],
+                "forecast_error_ratio": cp["forecast_error_ratio"],
+                "starvation": cp["starvation"],
+                "recommendation": (
+                    None if cp["recommendation"] is None else {
+                        k: cp["recommendation"][k]
+                        for k in ("critical_queue", "nodes_current",
+                                  "nodes_recommended", "nodes_to_add")}),
+                "replica_loss": cp["replica_loss"],
+            }
+    finally:
+        logging.disable(logging.NOTSET)
+    emit("capacity", {
+        "fleet": {k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in CAPACITY_FLEET.items()},
+        "scenarios": results,
+        "degraded": False,
+        "passed": ok,
+    })
+
+
 SCENARIOS = {
     "enforce": scenario_enforce,
+    "capacity": scenario_capacity,
     "cosched": scenario_cosched,
     "throttle": scenario_throttle,
     "priority": scenario_priority,

@@ -15,18 +15,31 @@ is the Borg/Autopilot-style usage-vs-request loop:
   ring-buffered time series for windowed showback;
 - :mod:`efficiency` — the join: ledger actuals against live grants in the
   registry → per-pod efficiency scores, idle-grant findings, and the
-  optional ``--score-by-actual`` placement signal.
+  optional ``--score-by-actual`` placement signal;
+- :mod:`forecast` — looking forward: Holt-Winters (EWMA level +
+  additive seasonality) demand forecasting over the ledger series, with
+  confidence bands and self-reported drift;
+- :mod:`planner` — capacity planning on the forecasts: the /capacityz
+  assessment (starvation ETAs, scale recommendation), the named
+  arrival-pattern synthesis the simulator's what-if replays use, and
+  live-trace capture into replayable scenario files.
 """
 
 from .efficiency import EfficiencyConfig, FleetEfficiency, PodEfficiency
+from .forecast import DemandForecaster, ForecastConfig, SeriesForecaster
 from .ledger import PodAccount, UsageLedger
+from .planner import CapacityTracker
 from .sampler import USAGE_FIELDS, UsageSampler
 
 __all__ = [
+    "CapacityTracker",
+    "DemandForecaster",
     "EfficiencyConfig",
     "FleetEfficiency",
+    "ForecastConfig",
     "PodAccount",
     "PodEfficiency",
+    "SeriesForecaster",
     "USAGE_FIELDS",
     "UsageLedger",
     "UsageSampler",

@@ -184,11 +184,14 @@ def showback(pods, ledger: UsageLedger,
         })
 
     seen = set()
+    ages = []
     for acct in ledger.accounts():
         chip_s, hbm_s, covered = ledger.window_usage(acct.uid, window,
                                                      now=now)
         pe = by_uid.get(acct.uid)
         namespace = pe.namespace if pe is not None else "(unresolved)"
+        age = max(0.0, now - acct.last_recorded)
+        ages.append(age)
         row = {
             "uid": acct.uid,
             "pod": pe.name if pe is not None else acct.name,
@@ -197,6 +200,11 @@ def showback(pods, ledger: UsageLedger,
             "chip_seconds": round(chip_s, 3),
             "hbm_byte_seconds": round(hbm_s, 3),
             "window_covered_s": round(covered, 3),
+            # Freshness stamp: totals above are frozen at the newest
+            # ledger sample — a consumer printing them must mark rows
+            # STALE past its threshold instead of silently reporting
+            # old numbers (vtpu-report / vtpu-smi staleness guard).
+            "last_sample_age_s": round(age, 3),
             "granted_chips": pe.granted_chips if pe is not None else 0,
             "efficiency": (round(pe.efficiency, 4)
                            if pe is not None and pe.efficiency is not None
@@ -234,6 +242,7 @@ def showback(pods, ledger: UsageLedger,
             "uid": pe.uid, "pod": pe.name, "namespace": pe.namespace,
             "node": pe.node, "chip_seconds": 0.0, "hbm_byte_seconds": 0.0,
             "window_covered_s": 0.0, "granted_chips": pe.granted_chips,
+            "last_sample_age_s": None,  # never reported ≠ stale
             "efficiency": None, "idle": False, "live": True,
         })
     for agg in ns_rows.values():
@@ -247,6 +256,11 @@ def showback(pods, ledger: UsageLedger,
     return {
         "window_s": window,
         "generated_at": now,
+        # Fleet-level freshness: newest/oldest sample ages across every
+        # retained account (None = no usage reports at all).  The CLIs'
+        # staleness guard reads these before trusting any total.
+        "newest_sample_age_s": round(min(ages), 3) if ages else None,
+        "oldest_sample_age_s": round(max(ages), 3) if ages else None,
         "pods": sorted(pod_rows,
                        key=lambda r: (r["namespace"], r["pod"])),
         "namespaces": [ns_rows[k] for k in sorted(ns_rows)],

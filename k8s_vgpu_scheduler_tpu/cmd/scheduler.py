@@ -131,6 +131,28 @@ def parse_args(argv=None):
                    help="seconds a grant must accrue ~no chip-seconds "
                         "before it is surfaced as an idle grant "
                         "(vtpu_idle_grants; flagged, never evicted)")
+    # Predictive capacity (accounting/forecast.py + planner.py;
+    # docs/observability.md "Capacity planning").
+    p.add_argument("--capacity-interval", type=float, default=30.0,
+                   help="demand-sampling period (seconds) for the "
+                        "capacity forecaster behind GET /capacityz and "
+                        "the vtpu_capacity_* gauges; 0 disables the "
+                        "sampling thread (the endpoint still samples "
+                        "on each export)")
+    p.add_argument("--capacity-bucket", type=float, default=60.0,
+                   help="forecast bucket size in seconds (demand is "
+                        "aggregated and predicted per bucket)")
+    p.add_argument("--capacity-season-buckets", type=int, default=24,
+                   help="buckets per seasonal cycle of the demand "
+                        "forecaster (1 = no seasonality; 24 x 3600s "
+                        "buckets = diurnal)")
+    p.add_argument("--capacity-horizon", type=float, default=1800.0,
+                   help="default forecast horizon (seconds) for "
+                        "/capacityz (?horizon= overrides per request)")
+    p.add_argument("--capacity-starve-after", type=float, default=300.0,
+                   help="a queue counts as starving once a pod has "
+                        "waited this long unplaced — the ETA the "
+                        "starvation forecast predicts toward")
     # Multi-tenant capacity queues (quota/; docs/quota.md).
     p.add_argument("--quota-config", default="",
                    help="path to the capacity-queue config JSON "
@@ -314,6 +336,11 @@ def build_config(args) -> Config:
         score_by_actual=args.score_by_actual,
         efficiency_window_s=args.efficiency_window,
         idle_grant_grace_s=args.idle_grant_grace,
+        capacity_interval_s=args.capacity_interval,
+        capacity_bucket_s=args.capacity_bucket,
+        capacity_season_buckets=args.capacity_season_buckets,
+        capacity_horizon_s=args.capacity_horizon,
+        capacity_starve_after_s=args.capacity_starve_after,
         quota_queues=load_quota_config(args.quota_config),
         fair_share_usage_informed=args.fair_share_usage_informed,
         admission_interval_s=args.admission_interval,
@@ -401,6 +428,20 @@ def main(argv=None):
     # --enable-defrag.
     if scheduler.cfg.enable_defrag:
         scheduler.defrag.start()
+    # Predictive capacity: periodic demand sampling into the forecaster
+    # (same embedders-own-their-cadence rule — /capacityz also samples
+    # on each export, so the thread only densifies the series).
+    if scheduler.cfg.capacity_interval_s > 0:
+        def _capacity_loop():
+            while True:
+                time.sleep(scheduler.cfg.capacity_interval_s)
+                try:
+                    scheduler.observe_capacity()
+                except Exception:  # noqa: BLE001 — sampling never dies
+                    logging.getLogger(__name__).exception(
+                        "capacity demand sample failed")
+        threading.Thread(target=_capacity_loop,
+                         name="capacity-observe", daemon=True).start()
     # Active-active HA: join the shard map SYNCHRONOUSLY before any
     # server accepts traffic (an unfenced replica serving /filter could
     # place on shards it does not own), then keep coordinating on the

@@ -84,6 +84,32 @@ victims until the gang admits:
                 "tpucores": 100, "gang": "big", "mesh": "2x4"},
        "horizon_s": 300, "tick_s": 5, "checkpoint_delay_s": 5}}
 
+A workload may instead carry a ``capacity`` section — predictive
+capacity planning (docs/observability.md "Capacity planning"): a named
+trace-driven arrival pattern (bursty / diurnal / flash-crowd;
+benchmarks/scenarios.py pins full scenarios) or an explicit captured
+demand trace is split into history + horizon; the forecaster
+(accounting/forecast.py) learns the history, and BOTH the forecast and
+the actual horizon arrivals replay through the REAL admission loop
+(Filter/quota/gang, the batched filter_many path, the defragmenter
+loop) on the virtual clock.  The report answers "when does queue X
+starve?" (predicted vs actual, within one forecast bucket), "how many
+nodes does this demand need?" (a node sweep re-replayed until the
+latency-critical queue stays unstarved with zero overbooking) and
+"what does losing a replica cost?" (an HA what-if storm sized from the
+forecast peak):
+
+    {"capacity": {
+       "pattern": "bursty", "pattern_params": {"burst_chips": 4},
+       "streams": [{"name": "train", "namespace": "tenant-a", "tpu": 1,
+                    "runtime_s": 100000}],
+       "queues": [{"name": "tenant-a", "namespaces": ["tenant-a"],
+                   "quota": {"chips": 8}}],
+       "bucket_s": 30, "history_buckets": 48, "horizon_buckets": 16,
+       "tick_s": 5, "starve_after_s": 60,
+       "require_starvation": ["tenant-a"],
+       "recommend": false}}
+
 A workload may instead carry an ``ha`` section — an active-active
 multi-replica run (shard/; docs/scheduler-concurrency.md "Sharded
 control plane") on the virtual clock with a seeded replica kill
@@ -113,11 +139,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import threading
 from typing import Dict, List, Optional
 
 from ..accounting import efficiency as eff_mod
+from ..accounting import planner as planner_mod
 from ..accounting.sampler import UsageSampler
 from ..health.faults import FaultEvent, FaultInjector, SimClock
 from ..k8s import FakeKube
@@ -239,6 +267,24 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
             "hbm_allocated_fraction": 0.0,
             "fits": bool(result["verdict"]["ok"]),
             "fragmentation": result,
+        }
+
+    capacity = workload.get("capacity")
+    if capacity is not None:
+        # A capacity scenario is a self-contained forecast-vs-actual
+        # replay on the virtual clock (docs/observability.md "Capacity
+        # planning"); it builds its own schedulers per replay leg.
+        result = run_capacity_phase(
+            capacity, nodes=nodes, chips=chips, hbm=hbm, mesh=mesh,
+            generation=generation, policy=policy or "spread")
+        return {
+            "fleet": {"nodes": nodes, "chips_per_node": chips,
+                      "hbm_mib": hbm, "mesh": list(mesh),
+                      "policy": policy or "spread"},
+            "placed": [], "pending": [], "chips": {},
+            "hbm_allocated_fraction": 0.0,
+            "fits": bool(result["verdict"]["ok"]),
+            "capacity": result,
         }
 
     serving = workload.get("serving")
@@ -1136,6 +1182,352 @@ def run_serving_phase(spec: dict) -> dict:
     }
 
 
+# --- predictive capacity planning (accounting/forecast.py + planner.py) ------
+
+def _capacity_demand_series(spec: dict, stream: dict,
+                            total_buckets: int,
+                            bucket_s: float) -> List[float]:
+    """One stream's chips-of-new-demand-per-bucket over history+horizon:
+    an explicit captured trace (``series`` rows, resampled into buckets)
+    or a named deterministic pattern (accounting/planner.py)."""
+    rows = stream.get("series")
+    if rows:
+        sums = [0.0] * total_buckets
+        ns = [0] * total_buckets
+        for t, v in rows:
+            b = int(t // bucket_s)
+            if 0 <= b < total_buckets:
+                sums[b] += float(v)
+                ns[b] += 1
+        return [sums[b] / ns[b] if ns[b] else 0.0
+                for b in range(total_buckets)]
+    pattern = stream.get("pattern") or spec.get("pattern")
+    params = dict(spec.get("pattern_params") or {})
+    params.update(stream.get("pattern_params") or {})
+    return planner_mod.synth_demand(pattern, params, total_buckets)
+
+
+def _run_capacity_sim(arrivals: List[dict], queues: tuple, *,
+                      nodes: int, chips: int, hbm: int, mesh,
+                      generation: str, policy: str, horizon_s: float,
+                      tick_s: float, starve_after_s: float) -> dict:
+    """One time-stepped replay of an arrival schedule through the REAL
+    admission loop on a SimClock: quota gate + fair-share release, the
+    batched ``filter_many`` drain (the production batch path), and the
+    defrag loop ticking alongside.  Starvation is per queue: the first
+    moment any of its pods has waited ``starve_after_s`` unplaced.
+    Reclaim/defrag victims checkpoint and exit after one tick (the
+    in-container watch's role, played by the harness, exactly as in the
+    queueing phase)."""
+    from ..quota.queues import queue_for_namespace
+    from ..scheduler.preempt import PREEMPT_ANNOTATION
+
+    clock = SimClock()
+    kube = FakeKube()
+    cfg = Config(node_scheduler_policy=policy,
+                 quota_queues=queues,
+                 enable_defrag=True,
+                 defrag_interval_s=tick_s,
+                 queue_reclaim_grace_s=2 * tick_s)
+    s = Scheduler(kube, cfg, clock=clock)
+    names = build_fleet(s, kube, nodes, chips, hbm, mesh, generation)
+    fleet_chips = nodes * chips
+    kube.watch_pods(s.on_pod_event)
+
+    schedule = [{"entry": e, "idx": i, "name": f"{e['name']}-{i}",
+                 "namespace": e.get("namespace", "sim"),
+                 "at_s": float(e.get("at_s", 0.0))
+                 + i * float(e.get("every_s", 0.0)),
+                 "runtime_s": float(e.get("runtime_s", 60.0))}
+                for e in arrivals for i in range(int(e.get("count", 1)))]
+    schedule.sort(key=lambda a: (a["at_s"], a["name"]))
+    ns_queue = {}
+    for a in schedule:
+        ns = a["namespace"]
+        if ns not in ns_queue:
+            q = queue_for_namespace(queues, ns) if queues else None
+            ns_queue[ns] = q.name if q is not None else None
+
+    next_arrival = 0
+    live: Dict[str, dict] = {}
+    created_at: Dict[str, float] = {}
+    placed_at: Dict[str, float] = {}
+    preempt_seen: Dict[str, float] = {}
+    starved_at: Dict[str, float] = {}
+    busy_seconds = 0.0
+    overbooked: List[str] = []
+    steps = int(round(horizon_s / tick_s))
+    t0 = clock()
+    for _step in range(steps):
+        now = clock() - t0
+        while next_arrival < len(schedule) \
+                and schedule[next_arrival]["at_s"] <= now:
+            a = schedule[next_arrival]
+            next_arrival += 1
+            kube.create_pod(_queue_spec_pod(a, ns_queue[a["namespace"]]))
+            live[a["name"]] = a
+            created_at[a["name"]] = now
+        for name in [n for n, t in placed_at.items()
+                     if t + live[n]["runtime_s"] <= now]:
+            a = live.pop(name)
+            placed_at.pop(name)
+            kube.delete_pod(a["namespace"], name)
+        # Reclaim/defrag victims checkpoint and exit after the delay.
+        for pod in kube.list_pods():
+            anns = pod.get("metadata", {}).get("annotations", {})
+            name = pod["metadata"]["name"]
+            if anns.get(PREEMPT_ANNOTATION):
+                first = preempt_seen.setdefault(name, now)
+                if now - first >= tick_s and name in live:
+                    a = live.pop(name)
+                    placed_at.pop(name, None)
+                    kube.delete_pod(a["namespace"], name)
+            else:
+                preempt_seen.pop(name, None)
+        if queues:
+            s.admission.tick()
+        s.defrag.tick()
+        # Batched drain: every unplaced pod retries through filter_many
+        # (scheduler/batch.py — the PR 6 production path), one cycle per
+        # tick, exactly like kube-scheduler re-queuing unschedulables.
+        items = []
+        order = []
+        for name, a in sorted(live.items()):
+            if name in placed_at:
+                continue
+            try:
+                pod = kube.get_pod(a["namespace"], name)
+            except Exception:  # noqa: BLE001 — deleted this tick
+                continue
+            items.append((pod, names))
+            order.append((name, a, pod))
+        if items:
+            results = s.filter_many(items)
+            for (name, a, pod), r in zip(order, results):
+                if r.node:
+                    s.bind(a["namespace"], name,
+                           pod["metadata"]["uid"], r.node)
+                    nodelock.release_node(kube, r.node)
+                    placed_at[name] = now
+        # Starvation census: a queue starves the instant one of its pods
+        # has waited starve_after_s unplaced (held in the queue or
+        # released but unplaceable both count — the tenant cannot tell
+        # the difference).
+        for name, a in sorted(live.items()):
+            if name in placed_at:
+                continue
+            waited = now - created_at[name]
+            if waited >= starve_after_s:
+                q = ns_queue[a["namespace"]] or a["namespace"]
+                starved_at.setdefault(
+                    q, created_at[name] + starve_after_s)
+        busy_seconds += sum(
+            sum(len(c) for c in p.devices)
+            for p in s.pods.list_pods()) * tick_s
+        bad = overbooked_chips(s)
+        if bad:
+            overbooked = sorted(set(overbooked) | set(bad))
+        clock.advance(tick_s)
+    still_pending = sorted(n for n in live if n not in placed_at)
+    s.close()
+    return {
+        "nodes": nodes,
+        "placed": len(placed_at) + sum(
+            1 for n in created_at if n not in live and n not in placed_at),
+        "arrived": len(created_at),
+        "still_pending": still_pending,
+        "starved_at": {q: round(t, 3)
+                       for q, t in sorted(starved_at.items())},
+        "utilization": round(
+            busy_seconds / (fleet_chips * horizon_s), 4)
+        if fleet_chips and horizon_s else 0.0,
+        "overbooked_chips": overbooked,
+    }
+
+
+def run_capacity_phase(spec: dict, *, nodes: int, chips: int, hbm: int,
+                       mesh, generation: str, policy: str) -> dict:
+    """Forecast-vs-actual capacity planning (docs/observability.md):
+
+    1. each stream's demand trace is split into history + horizon;
+    2. the forecaster learns the history and projects the horizon;
+    3. the FORECAST arrivals replay through the real admission loop →
+       predicted starvation ETA per queue;
+    4. the ACTUAL horizon arrivals replay identically → actual
+       starvation;
+    5. verdict: predicted within one forecast bucket of actual for
+       every queue the scenario requires to starve, forecast error
+       reported, zero overbooking in every replay — and, when the
+       scenario asks for a scale recommendation, a node sweep over the
+       forecast until the latency-critical queue stays unstarved, then
+       verified against the ACTUAL arrivals at the recommended size.
+    """
+    from ..accounting.forecast import ForecastConfig, SeriesForecaster
+
+    bucket_s = float(spec.get("bucket_s", 30.0))
+    history_buckets = int(spec.get("history_buckets", 48))
+    horizon_buckets = int(spec.get("horizon_buckets", 16))
+    tick_s = float(spec.get("tick_s", 5.0))
+    starve_after_s = float(spec.get("starve_after_s", 60.0))
+    horizon_s = horizon_buckets * bucket_s
+    total = history_buckets + horizon_buckets
+    queues = tuple(spec.get("queues", ()))
+    fcfg = ForecastConfig(
+        bucket_s=bucket_s,
+        season_buckets=int(spec.get("season_buckets", 8)),
+        alpha=float(spec.get("alpha", 0.1)),
+        beta=float(spec.get("beta", 0.05)),
+        gamma=float(spec.get("gamma", 0.5)))
+
+    streams = spec.get("streams") or []
+    per_stream = []
+    err_num = err_den = 0.0
+    for stream in streams:
+        series = _capacity_demand_series(spec, stream, total, bucket_s)
+        fc = SeriesForecaster(fcfg)
+        for b in range(history_buckets):
+            fc.observe(b * bucket_s, series[b])
+        fc.observe(history_buckets * bucket_s, 0.0)  # close the last one
+        points = fc.forecast(horizon_buckets)
+        actual = series[history_buckets:total]
+        predicted = [p.mean for p in points]
+        err_num += sum(abs(p - a) for p, a in zip(predicted, actual))
+        err_den += sum(abs(a) for a in actual)
+        per_stream.append({
+            "stream": stream, "actual": actual, "predicted": predicted,
+            "upper": [p.upper for p in points],
+            "error_ratio": (round(fc.error_ratio(), 4)
+                            if fc.error_ratio() is not None else None),
+        })
+    forecast_error_ratio = round(err_num / err_den, 4) if err_den else 0.0
+
+    def entries_of(kind: str) -> List[dict]:
+        out = []
+        for ps in per_stream:
+            out.extend(planner_mod.arrival_entries(
+                ps["stream"], ps[kind], bucket_s))
+        return out
+
+    sim_kw = dict(chips=chips, hbm=hbm, mesh=mesh,
+                  generation=generation, policy=policy,
+                  horizon_s=horizon_s, tick_s=tick_s,
+                  starve_after_s=starve_after_s)
+    predicted_run = _run_capacity_sim(entries_of("predicted"), queues,
+                                      nodes=nodes, **sim_kw)
+    actual_run = _run_capacity_sim(entries_of("actual"), queues,
+                                   nodes=nodes, **sim_kw)
+
+    require = list(spec.get("require_starvation", ()))
+    eta_rows = []
+    eta_ok = True
+    starvation_observed = True
+    for q in sorted(set(predicted_run["starved_at"])
+                    | set(actual_run["starved_at"]) | set(require)):
+        pred = predicted_run["starved_at"].get(q)
+        act = actual_run["starved_at"].get(q)
+        within = (pred is not None and act is not None
+                  and abs(pred - act) <= bucket_s)
+        eta_rows.append({"queue": q, "predicted_eta_s": pred,
+                         "actual_eta_s": act,
+                         "within_one_bucket": within})
+        if q in require:
+            starvation_observed = starvation_observed and act is not None
+            eta_ok = eta_ok and within
+
+    recommendation = None
+    rec_ok = True
+    if spec.get("recommend"):
+        critical = spec.get("critical_queue", "")
+        max_extra = int(spec.get("max_extra_nodes", 8))
+        chosen = None
+        sweep = []
+        for extra in range(max_extra + 1):
+            leg = _run_capacity_sim(entries_of("predicted"), queues,
+                                    nodes=nodes + extra, **sim_kw)
+            starved = critical in leg["starved_at"] if critical \
+                else bool(leg["starved_at"])
+            sweep.append({"nodes": nodes + extra,
+                          "critical_starved": starved,
+                          "overbooked": bool(leg["overbooked_chips"])})
+            if not starved and not leg["overbooked_chips"]:
+                chosen = nodes + extra
+                break
+        applied = None
+        if chosen is not None:
+            applied = _run_capacity_sim(entries_of("actual"), queues,
+                                        nodes=chosen, **sim_kw)
+        recommendation = {
+            "critical_queue": critical,
+            "nodes_current": nodes,
+            "nodes_recommended": chosen,
+            "nodes_to_add": (chosen - nodes)
+            if chosen is not None else None,
+            "sweep": sweep,
+            "applied": applied,
+        }
+        rec_ok = (chosen is not None and applied is not None
+                  and critical not in applied["starved_at"]
+                  and not applied["overbooked_chips"])
+
+    replica_loss = None
+    if spec.get("replica_loss"):
+        # "What does losing a replica cost?" — an HA what-if through the
+        # real shard layer (run_ha_phase), storm sized from the forecast
+        # peak so the orphan window is contended the way the forecast
+        # says next week will be.  Cost = adoption latency + pods pended
+        # through the window (re-placement churn) + rebalances.
+        rl = dict(spec["replica_loss"])
+        peak = max((max(ps["predicted"]) for ps in per_stream),
+                   default=1.0)
+        storm = rl.pop("storm", None) or {
+            "name": "whatif", "tpu": 1, "tpumem": 2000,
+            "count": max(8, int(math.ceil(peak)) * 4)}
+        rl.setdefault("replicas", 3)
+        rl.setdefault("seed", 7)
+        ha = run_ha_phase(dict(rl, storm=storm), nodes=max(nodes, 3),
+                          chips=chips, hbm=hbm, mesh=mesh,
+                          generation=generation, policy=policy)
+        replica_loss = {
+            "replicas": ha["replicas"],
+            "killed": ha["killed"],
+            "adoption_latency_s": ha["adoption_latency_s"],
+            "pods_pended_through_window": ha["pending_during_window"],
+            "replacement_churn": len(ha["replaced"]),
+            "shard_rebalances": ha["rebalances"],
+            "protocol_ok": ha["verdict"]["ok"],
+        }
+
+    verdict = {
+        "starvation_observed": starvation_observed,
+        "eta_within_one_bucket": eta_ok,
+        "forecast_error_reported": forecast_error_ratio is not None,
+        "recommendation_protects_critical": rec_ok,
+        "no_overbooking": not (predicted_run["overbooked_chips"]
+                               or actual_run["overbooked_chips"]),
+    }
+    if replica_loss is not None:
+        verdict["replica_loss_protocol_ok"] = replica_loss["protocol_ok"]
+    verdict["ok"] = all(verdict.values())
+    return {
+        "bucket_s": bucket_s,
+        "history_buckets": history_buckets,
+        "horizon_buckets": horizon_buckets,
+        "tick_s": tick_s,
+        "starve_after_s": starve_after_s,
+        "pattern": spec.get("pattern"),
+        "forecast_error_ratio": forecast_error_ratio,
+        "stream_error_ratios": {
+            ps["stream"]["name"]: ps["error_ratio"]
+            for ps in per_stream},
+        "predicted": predicted_run,
+        "actual": actual_run,
+        "starvation": eta_rows,
+        "recommendation": recommendation,
+        "replica_loss": replica_loss,
+        "verdict": verdict,
+    }
+
+
 def overbooked_chips(s: Scheduler) -> List[str]:
     """Chips whose granted slots/HBM/cores exceed advertised totals — the
     invariant the rescue must never break (empty = healthy)."""
@@ -1452,7 +1844,50 @@ def format_serving(sv: dict) -> str:
     return "\n".join(lines)
 
 
+def format_capacity(cp: dict) -> str:
+    v = cp["verdict"]
+    lines = [
+        "capacity planning ({} pattern; {} history + {} horizon buckets "
+        "of {:.0f}s):".format(cp.get("pattern") or "captured trace",
+                              cp["history_buckets"],
+                              cp["horizon_buckets"], cp["bucket_s"]),
+        f"  forecast-vs-actual error: {cp['forecast_error_ratio']:.1%} "
+        "of demand",
+    ]
+    for row in cp["starvation"]:
+        def eta(x):
+            return f"{x:.0f}s" if x is not None else "never"
+        lines.append(
+            "  queue {:<14s} starves: predicted {:<7s} actual {:<7s} {}"
+            .format(row["queue"], eta(row["predicted_eta_s"]),
+                    eta(row["actual_eta_s"]),
+                    "✓" if row["within_one_bucket"] else
+                    ("-" if row["actual_eta_s"] is None else "OFF")))
+    rec = cp.get("recommendation")
+    if rec:
+        lines.append(
+            "  scale recommendation: {} → {} node(s) to keep '{}' "
+            "unstarved{}".format(
+                rec["nodes_current"], rec["nodes_recommended"],
+                rec["critical_queue"],
+                "" if rec["applied"] is None else
+                " (verified against the actual trace)"))
+    rl = cp.get("replica_loss")
+    if rl:
+        lines.append(
+            "  losing a replica costs: {:.1f}s adoption, {} pod(s) "
+            "pended, {} re-placed, {} rebalance(s)".format(
+                rl["adoption_latency_s"],
+                rl["pods_pended_through_window"],
+                rl["replacement_churn"], rl["shard_rebalances"]))
+    lines.append("  verdict: " + ("PASS" if v["ok"] else f"FAIL {v}"))
+    return "\n".join(lines)
+
+
 def format_report(result: dict) -> str:
+    cp = result.get("capacity")
+    if cp:
+        return format_capacity(cp)
     sv = result.get("serving")
     if sv:
         return format_serving(sv)

@@ -31,8 +31,11 @@ NAMESPACE_COLUMNS = ["namespace", "pods", "chip_seconds",
                      "queue", "nominal_chips", "held_chips",
                      "borrowed_chips"]
 POD_COLUMNS = ["namespace", "pod", "node", "granted_chips", "chip_seconds",
-               "hbm_byte_seconds", "window_covered_s", "efficiency",
-               "idle", "live"]
+               "hbm_byte_seconds", "window_covered_s", "last_sample_age_s",
+               "efficiency", "idle", "live"]
+#: A ledger series older than this is reported with an explicit STALE
+#: marker instead of silently presenting frozen totals (--stale-after).
+DEFAULT_STALE_AFTER_S = 120.0
 
 
 def _base_url(cluster: str) -> str:
@@ -70,6 +73,21 @@ def fetch_queues(cluster: str) -> Optional[dict]:
     return doc if doc.get("enabled") else None
 
 
+def fetch_capacity(cluster: str) -> Optional[dict]:
+    """GET /capacityz, or None when the scheduler predates the
+    predictive-capacity surface (the report degrades gracefully)."""
+    import urllib.request
+
+    url = _base_url(cluster)
+    if not url.endswith("/capacityz"):
+        url += "/capacityz"
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            return json.load(r)
+    except Exception:  # noqa: BLE001 — capacity surface is optional
+        return None
+
+
 def join_quota(export: dict, queues: Optional[dict]) -> dict:
     """Annotate each namespace showback row with its governing queue's
     quota utilization (nominal vs held vs borrowed) — the 'measured'
@@ -101,13 +119,55 @@ def to_csv(rows: List[dict], columns: List[str]) -> str:
     return buf.getvalue()
 
 
-def format_report(export: dict, pods: bool = False) -> str:
+def stale_marker(age_s: Optional[float],
+                 stale_after_s: float) -> str:
+    """`` STALE (last sample Xs ago)`` when the series age is over the
+    threshold, else empty — the explicit freshness guard both CLIs
+    print instead of silently reporting frozen totals."""
+    if age_s is None or age_s <= stale_after_s:
+        return ""
+    return f" STALE (last sample {age_s:.0f}s ago)"
+
+
+def format_capacity(cap: dict) -> str:
+    """The ``vtpu-report`` capacity section: scale recommendation,
+    per-queue starvation ETAs and forecast drift (GET /capacityz)."""
+    lines = [
+        "+ capacity ({} horizon {:.0f}s, buckets {:.0f}s)".format(
+            cap.get("method", "analytic"), cap.get("horizon_s", 0.0),
+            cap.get("bucket_s", 0.0)),
+        "| scale: {} node(s) now, {} recommended (+{}); peak forecast "
+        "demand {:.1f} chips".format(
+            cap.get("nodes_current", 0), cap.get("nodes_recommended", 0),
+            cap.get("nodes_to_add", 0),
+            cap.get("peak_forecast_demand_chips", 0.0)),
+        "| {:<14s} {:>7s} {:>9s} {:>9s} {:>12s} {:>7s} |".format(
+            "queue", "demand", "forecast", "upper", "starves-in",
+            "drift"),
+    ]
+    for q in cap.get("queues", []):
+        eta = q.get("starvation_eta_s")
+        err = q.get("forecast_error_ratio")
+        lines.append(
+            "| {:<14s} {:>7.1f} {:>9.1f} {:>9.1f} {:>12s} {:>7s} |"
+            .format(q["queue"][:14], q["demand_chips"],
+                    q["forecast_demand_chips"],
+                    q["forecast_upper_chips"],
+                    f"{eta:.0f}s" if eta is not None else "never",
+                    f"{100 * err:.0f}%" if err is not None else "-"))
+    return "\n".join(lines)
+
+
+def format_report(export: dict, pods: bool = False,
+                  stale_after_s: float = DEFAULT_STALE_AFTER_S) -> str:
     fleet = export.get("fleet", {})
     eff = fleet.get("efficiency")
     lines = [
-        "showback over the last {:.0f}s — fleet efficiency: {}".format(
+        "showback over the last {:.0f}s — fleet efficiency: {}{}".format(
             export.get("window_s", 0.0),
-            f"{eff:.1%}" if eff is not None else "n/a (no usage reports)"),
+            f"{eff:.1%}" if eff is not None else "n/a (no usage reports)",
+            stale_marker(export.get("newest_sample_age_s"),
+                         stale_after_s)),
         "| {:<20s} {:>5s} {:>12s} {:>16s} {:>12s} {:>6s} {:>5s} |".format(
             "namespace", "pods", "chip-s", "hbm-byte-s", "granted-s",
             "eff%", "idle"),
@@ -146,11 +206,13 @@ def format_report(export: dict, pods: bool = False) -> str:
             flags = "IDLE" if row.get("idle") else (
                 "" if row.get("live") else "gone")
             lines.append(
-                "| {:<34s} {:>2d} chips {:>10.1f} chip-s {:>6s}% {} |"
+                "| {:<34s} {:>2d} chips {:>10.1f} chip-s {:>6s}% {}{} |"
                 .format(f"{row['namespace']}/{row['pod']}"[:34],
                         row["granted_chips"], row["chip_seconds"],
                         f"{100 * e:.1f}" if e is not None else "-",
-                        flags))
+                        flags,
+                        stale_marker(row.get("last_sample_age_s"),
+                                     stale_after_s)))
     idle = export.get("idle_grants", [])
     if idle:
         lines.append(f"IDLE GRANTS: {len(idle)} pod(s) holding unused "
@@ -160,6 +222,8 @@ def format_report(export: dict, pods: bool = False) -> str:
                 "  {:<34s} {} chip(s) on {}, idle {:.0f}s".format(
                     f"{p['namespace']}/{p['name']}"[:34],
                     p["granted_chips"], p["node"], p["idle_for_s"]))
+    if export.get("capacity"):
+        lines.append(format_capacity(export["capacity"]))
     return "\n".join(lines)
 
 
@@ -173,6 +237,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "scheduler's --efficiency-window)")
     p.add_argument("--pods", action="store_true",
                    help="include per-pod rows, not just namespaces")
+    p.add_argument("--stale-after", type=float,
+                   default=DEFAULT_STALE_AFTER_S,
+                   help="mark rows whose newest ledger sample is older "
+                        "than this many seconds STALE instead of "
+                        "silently reporting frozen totals")
+    p.add_argument("--no-capacity", action="store_true",
+                   help="skip the GET /capacityz capacity section")
     fmt = p.add_mutually_exclusive_group()
     fmt.add_argument("--json", action="store_true", dest="as_json")
     fmt.add_argument("--csv", action="store_true", dest="as_csv")
@@ -184,6 +255,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"vtpu-report: cannot fetch usage: {e}", file=sys.stderr)
         return 2
     export = join_quota(export, fetch_queues(args.cluster))
+    if not args.no_capacity:
+        cap = fetch_capacity(args.cluster)
+        if cap is not None:
+            export["capacity"] = cap
     if args.as_json:
         print(json.dumps(export, indent=1))
     elif args.as_csv:
@@ -193,7 +268,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(to_csv(export.get("namespaces", []), NAMESPACE_COLUMNS),
                   end="")
     else:
-        print(format_report(export, pods=args.pods))
+        print(format_report(export, pods=args.pods,
+                            stale_after_s=args.stale_after))
     return 0
 
 

@@ -203,12 +203,21 @@ def cluster_info(metrics: dict) -> dict:
     }
 
 
-def top_info(metrics: dict) -> dict:
+# One staleness contract for both CLIs: threshold and marker wording
+# come from vtpu_report so the two surfaces can never drift apart.
+from .vtpu_report import DEFAULT_STALE_AFTER_S as STALE_AFTER_S  # noqa: E402
+from .vtpu_report import stale_marker  # noqa: E402
+
+
+def top_info(metrics: dict, stale_after_s: float = STALE_AFTER_S) -> dict:
     """Per-pod actual-vs-granted join from the extender's accounting
     metrics (scheduler/metrics.py) — the data behind ``vtpu-smi top``.
     ``waste_chips`` = granted chips × (1 - efficiency): the capacity the
     pod holds but does not use; None when the pod has no usage reports
-    (node without a monitor — unknown is not the same as idle)."""
+    (node without a monitor — unknown is not the same as idle).  Rows
+    whose newest ledger sample (vtpu_usage_series_age_seconds) is older
+    than ``stale_after_s`` carry ``stale`` — frozen totals must not
+    read as live ones."""
     pods: dict = {}
 
     def pod(labels):
@@ -217,7 +226,7 @@ def top_info(metrics: dict) -> dict:
             "chips": 0, "granted_mib": 0, "granted_cores": 0,
             "chip_seconds": 0.0, "hbm_byte_seconds": 0.0,
             "efficiency": None, "qos_class": None,
-            "qos_duty_weight_pct": None,
+            "qos_duty_weight_pct": None, "series_age_s": None,
         })
 
     for labels, v in metrics.get("vtpu_pod_device_allocated_mib", []):
@@ -236,6 +245,8 @@ def top_info(metrics: dict) -> dict:
         p = pod(labels)
         p["qos_class"] = labels.get("class")
         p["qos_duty_weight_pct"] = int(v)
+    for labels, v in metrics.get("vtpu_usage_series_age_seconds", []):
+        pod(labels)["series_age_s"] = round(v, 1)
 
     rows = []
     for (ns, name), p in pods.items():
@@ -243,7 +254,9 @@ def top_info(metrics: dict) -> dict:
         waste = (round(p["chips"] * (1.0 - min(1.0, eff)), 3)
                  if eff is not None and p["chips"] else None)
         rows.append({"namespace": ns, "name": name, **p,
-                     "waste_chips": waste})
+                     "waste_chips": waste,
+                     "stale": (p["series_age_s"] is not None
+                               and p["series_age_s"] > stale_after_s)})
     # Sorted by waste, worst first; pods with unknown efficiency sink to
     # the bottom (they may be fine — there is just no monitor data).
     rows.sort(key=lambda r: (r["waste_chips"] is None,
@@ -268,12 +281,16 @@ def format_top(info: dict) -> str:
         qos = (r.get("qos_class") or "-")[:16]
         duty = (f"{r['qos_duty_weight_pct']:>3d}%"
                 if r.get("qos_duty_weight_pct") is not None else "   -")
+        # The row's stale flag already applied the threshold (top_info);
+        # -1 here just forces the shared marker text on.
+        stale = (stale_marker(r["series_age_s"], -1.0)
+                 if r.get("stale") else "")
         lines.append(
             "| {pn:<34s} {c:>5d} {g:>6d}MiB {e}% {w} {cs:>9.1f} "
-            "{q:<13s} {d} |".format(
+            "{q:<13s} {d}{st} |".format(
                 pn=f"{r['namespace']}/{r['name']}"[:34], c=r["chips"],
                 g=r["granted_mib"], e=eff, w=waste,
-                cs=r["chip_seconds"], q=qos, d=duty))
+                cs=r["chip_seconds"], q=qos, d=duty, st=stale))
     return "\n".join(lines)
 
 

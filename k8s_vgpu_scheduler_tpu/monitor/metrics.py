@@ -8,6 +8,7 @@ regions (vs the scheduler's :9395 which reports *granted* amounts).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Iterable, Optional
 
@@ -73,6 +74,8 @@ class NodeCollector(Collector):
     def __init__(self, loop: FeedbackLoop, backend: Optional[Backend] = None,
                  node_name: str = "", now=time.monotonic,
                  sampler=None) -> None:
+        from ..accounting.forecast import ForecastConfig, SeriesForecaster
+
         self.loop = loop
         self.backend = backend
         self.node_name = node_name
@@ -80,6 +83,19 @@ class NodeCollector(Collector):
         self._now = now
         self._inv_cache: Optional[list] = None
         self._inv_at = float("-inf")
+        # Node-local busy-chip forecast (docs/observability.md "Capacity
+        # planning"): the same EWMA machinery the scheduler runs fleet-
+        # wide, over THIS node's dispatching-chip count, observed at
+        # scrape cadence.  Seasonality off — a single node's schedule is
+        # dominated by its current tenants, not a daily cycle.  Own
+        # lock: concurrent scrapes both reach observe(), whose
+        # bucket-close path is a multi-step read-modify-write (same
+        # guard CapacityTracker holds scheduler-side).
+        self._busy_forecast = SeriesForecaster(
+            ForecastConfig(bucket_s=60.0, season_buckets=1,
+                           alpha=0.3, beta=0.05))
+        self._busy_forecast_lock = threading.Lock()
+        self._busy_observed_at: Optional[float] = None
 
     def _chips(self) -> list:
         now = self._now()
@@ -234,14 +250,50 @@ class NodeCollector(Collector):
                 u_spill.add_metric(key, row["oversub_spill_seconds"])
             families += [u_chip, u_hbm, u_throttled, u_spill]
 
+        # Node-local capacity forecast: busy chips this node will want
+        # next bucket (the node face of the fleet-wide vtpu_capacity_*
+        # surface on the scheduler exporter).
+        busy_fc = GaugeMetricFamily(
+            "vtpu_capacity_node_busy_chips_forecast",
+            "One-bucket-ahead forecast of this node's dispatching chip "
+            "count (EWMA over the sampler's active-chip census; 0 until "
+            "a bucket of observations has closed)",
+            labels=["node"],
+        )
+        if self.sampler is not None:
+            from ..accounting.forecast import SeriesForecaster as _SF
+
+            busy = sum(int(row.get("chips", 0))
+                       for row in self.sampler.snapshot()
+                       if row.get("active"))
+            now = self._now()
+            with self._busy_forecast_lock:
+                # Samples arrive at SCRAPE cadence: a scrape outage is
+                # unobserved time, not zero demand — backfilling the
+                # gap as empty buckets would teach the model a busy
+                # node was idle.  Cold-restart the forecaster instead
+                # (honest re-learning from the first fresh sample).
+                cfg = self._busy_forecast.cfg
+                if self._busy_observed_at is not None and \
+                        now - self._busy_observed_at > 3 * cfg.bucket_s:
+                    self._busy_forecast = _SF(cfg)
+                self._busy_observed_at = now
+                self._busy_forecast.observe(now, float(busy))
+                pts = self._busy_forecast.forecast(1)
+            busy_fc.add_metric([self.node_name], round(pts[0].mean, 4))
+        else:
+            busy_fc.add_metric([self.node_name], 0.0)
+        families.append(busy_fc)
+
         phase_latency = HistogramMetricFamily(
             "vtpu_monitor_phase_latency_seconds",
-            "Wall-clock latency of one monitor phase (region-scan tick)",
-            labels=["phase"],
+            "Wall-clock latency of one monitor phase (region-scan "
+            "tick), by QoS class where a phase is class-scoped",
+            labels=["phase", "qos"],
         )
-        for phase, (buckets, _count, sum_s) in \
+        for (phase, qos), (buckets, _count, sum_s) in \
                 trace.tracer().histogram_snapshot().items():
-            phase_latency.add_metric([phase], buckets, sum_s)
+            phase_latency.add_metric([phase, qos], buckets, sum_s)
 
         return families + [phase_latency]
 

@@ -20,7 +20,10 @@ from contextlib import nullcontext
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from ..accounting import efficiency as eff_mod
+from ..accounting import planner as planner_mod
+from ..accounting.forecast import ForecastConfig
 from ..accounting.ledger import UsageLedger, decode_usage
+from ..accounting.planner import CapacityTracker
 from ..health.lease import LeaseConfig, LeaseState, LeaseTracker
 from ..health.quarantine import ChipQuarantine, QuarantineConfig
 from ..health.rescuer import RESCUE_VALUE_PREFIX, RescueConfig, Rescuer
@@ -157,6 +160,16 @@ class Scheduler:
         self.efficiency_cfg = eff_mod.EfficiencyConfig(
             window_s=self.cfg.efficiency_window_s,
             idle_grace_s=self.cfg.idle_grant_grace_s)
+        # Predictive capacity (accounting/forecast.py + planner.py;
+        # docs/observability.md "Capacity planning"): per-queue demand
+        # forecasting behind /capacityz and the vtpu_capacity_* gauges.
+        # Fed by observe_capacity() — the daemon entrypoint runs it on a
+        # thread; embedders/tests/the simulator call it on their clocks.
+        self.capacity = CapacityTracker(
+            ForecastConfig(
+                bucket_s=self.cfg.capacity_bucket_s,
+                season_buckets=self.cfg.capacity_season_buckets),
+            starve_after_s=self.cfg.capacity_starve_after_s)
         # Fleet health subsystem (health/; docs/fault-tolerance.md).
         # ``clock`` is injectable (time.monotonic by default) so the
         # simulator and tests drive minutes-long failure scenarios
@@ -505,7 +518,8 @@ class Scheduler:
         extra: Dict[str, object] = {}
         if 0.0 < start <= end and end - start < 300.0:
             trace.tracer().record("allocate", tid, start, end,
-                                  pod=pod_name(pod), node=node, phase=phase)
+                                  pod=pod_name(pod), node=node,
+                                  phase=phase, qos=pod_qos(pod))
         elif start > 0.0:
             # Over the staleness cutoff (a restart's resync re-listing a
             # long-bound pod is indistinguishable from a 5-minute
@@ -761,6 +775,75 @@ class Scheduler:
         stats["enabled"] = self.quota.enabled
         return stats
 
+    def observe_capacity(self, now: Optional[float] = None,
+                         quota_stats: Optional[dict] = None
+                         ) -> Dict[str, float]:
+        """One demand sample per queue into the capacity forecaster:
+        chips the tenant wants right now — held (placed) plus pending
+        (queued/unplaced requests).  Ungoverned fleets sample granted
+        chips per namespace instead (no quota layer = no pending-side
+        visibility; the forecast then tracks standing usage).  Off every
+        scheduler lock (registry list + the quota manager's own).
+        ``quota_stats`` lets export_capacity share one stats snapshot
+        instead of walking the registry twice per export."""
+        now = self._clock() if now is None else now
+        samples: Dict[str, float] = {}
+        if self.quota.enabled:
+            if quota_stats is None:
+                quota_stats = self.quota.stats(self.pods.list_pods())
+            for row in quota_stats["queues"]:
+                pending = sum(p["chips"] for p in row["pending_pods"])
+                samples[row["queue"]] = float(row["held_chips"] + pending)
+        else:
+            for p in self.pods.list_pods():
+                chips = sum(len(c) for c in p.devices)
+                if chips:
+                    samples[p.namespace] = \
+                        samples.get(p.namespace, 0.0) + chips
+        self.capacity.observe_queues(samples, now)
+        return samples
+
+    def export_capacity(self, horizon_s: Optional[float] = None,
+                        quota_stats: Optional[dict] = None,
+                        detail: bool = True) -> dict:
+        """Predictive-capacity assessment (``GET /capacityz`` →
+        ``vtpu-report`` and the vtpu_capacity_* gauges): per-queue
+        demand forecasts with bands, starvation ETAs against admissible
+        capacity, a fleet scale recommendation, and forecast-vs-actual
+        drift.  Analytic — the replay-verified what-if answers come from
+        ``vtpu-simulate`` capacity scenarios (docs/observability.md).
+        ``quota_stats`` lets the metrics collector (which already
+        computed the same snapshot for the queue gauges) avoid a second
+        registry walk per scrape."""
+        now = self._clock()
+        stats = quota_stats if quota_stats is not None else (
+            self.quota.stats(self.pods.list_pods())
+            if self.quota.enabled else None)
+        self.observe_capacity(now, quota_stats=stats)
+        snap = self.snapshot()
+        fleet_chips = sum(len(e.usage) for e in snap.values())
+        free_chips = sum(1 for e in snap.values()
+                         for u in e.usage.values()
+                         if u.used_slots == 0)
+        chips_per_node = max((len(e.usage) for e in snap.values()),
+                             default=1)
+        rows = []
+        if stats is not None:
+            # Same snapshot the demand sample above read — one registry
+            # walk per export, and sampled demand vs reported
+            # entitlements stay mutually consistent.
+            rows = [{"queue": r["queue"],
+                     "nominal_chips": r["nominal_chips"],
+                     "borrow_limit_chips": r["borrow_limit_chips"]}
+                    for r in stats["queues"]]
+        return planner_mod.assess(
+            self.capacity, fleet_chips=fleet_chips,
+            free_chips=free_chips, chips_per_node=chips_per_node,
+            nodes_current=len(snap), queue_rows=rows, now=now,
+            horizon_s=horizon_s
+            if horizon_s is not None else self.cfg.capacity_horizon_s,
+            detail=detail)
+
     def export_fleet(self) -> dict:
         """Read-only fleet snapshot for capacity tooling (``GET /fleetz``
         → ``vtpu-simulate --from-cluster``): node inventory INCLUDING ICI
@@ -831,7 +914,8 @@ class Scheduler:
         if self.gangs.groups():
             self._release_expired_gangs()
         with tr.span("filter", trace_id=tid, pod=pod_name(pod),
-                     candidates=len(node_names)) as sp:
+                     candidates=len(node_names),
+                     qos=pod_qos(pod)) as sp:
             result = self._decide(pod, node_names, sp)
             if result.failed:
                 # Count every per-node rejection by its dominant token
@@ -984,7 +1068,7 @@ class Scheduler:
             # replacements) — surfaced to the container as VTPU_GANG_RANK.
             patch[GANG_RANK_ANNOTATION] = str(rank)
         with tr.span("decision-write", trace_id=tid, pod=pod_name(pod),
-                     node=result.node) as wsp:
+                     node=result.node, qos=pod_qos(pod)) as wsp:
             err: Optional[str] = None
             if self.shards.enabled:
                 # Sharded control plane: the write is a fenced CAS keyed
@@ -1871,7 +1955,8 @@ class Scheduler:
         info = self.pods.get(uid)
         tid = info.trace_id if info is not None else ""
         tr = trace.tracer()
-        with tr.span("bind", trace_id=tid, pod=name, node=node) as sp:
+        with tr.span("bind", trace_id=tid, pod=name, node=node,
+                     qos=info.qos if info is not None else "") as sp:
             try:
                 lock_node(self.client, node)
             except NodeLockError as e:

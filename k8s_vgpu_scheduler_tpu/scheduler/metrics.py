@@ -247,8 +247,10 @@ class ClusterCollector(Collector):
             "one checkpoint-evicts borrowed grants)",
         )
         quota = getattr(self.scheduler, "quota", None)
+        quota_stats = None
         if quota is not None and quota.enabled:
-            stats = quota.stats(self.scheduler.pods.list_pods())
+            quota_stats = stats = quota.stats(
+                self.scheduler.pods.list_pods())
             for row in stats["queues"]:
                 q_pending.add_metric([row["queue"]], row["pending"])
                 q_admitted.add_metric([row["queue"]],
@@ -421,6 +423,99 @@ class ClusterCollector(Collector):
             labels=["podnamespace", "podname", "class"],
         )
 
+        # Predictive capacity (accounting/forecast.py + planner.py;
+        # docs/observability.md "Capacity planning").  Metric names come
+        # from planner.CAPACITY_FIELD_METRICS — the one mapping the
+        # /capacityz JSON, this exporter, the Grafana "Capacity" row and
+        # the consistency test all share.  Families always emitted
+        # (empty without observations) so dashboards never reference a
+        # vanishing series.  Guarded getattr: collector test stubs may
+        # predate the capacity surface.
+        cap_demand = GaugeMetricFamily(
+            "vtpu_capacity_queue_demand_chips",
+            "Chips one capacity queue (or namespace, when ungoverned) "
+            "wants right now: held grants plus pending requests — the "
+            "demand series the forecaster learns",
+            labels=["queue"],
+        )
+        cap_forecast = GaugeMetricFamily(
+            "vtpu_capacity_forecast_demand_chips",
+            "Forecast demand of one queue at the horizon end (EWMA "
+            "level + additive seasonality over the ledger-tick demand "
+            "series; GET /capacityz carries the full per-bucket curve)",
+            labels=["queue"],
+        )
+        cap_upper = GaugeMetricFamily(
+            "vtpu_capacity_forecast_upper_chips",
+            "Upper confidence band of one queue's forecast demand at "
+            "the horizon end (the conservative bound starvation ETAs "
+            "and scale recommendations read)",
+            labels=["queue"],
+        )
+        cap_eta = GaugeMetricFamily(
+            "vtpu_capacity_queue_starvation_eta_seconds",
+            "Seconds until this queue's forecast demand (upper band) "
+            "exceeds what it can admit — 0 = starving now, +Inf = the "
+            "horizon stays clear (VtpuQueueStarvationForecast pages on "
+            "a finite ETA)",
+            labels=["queue"],
+        )
+        cap_err = GaugeMetricFamily(
+            "vtpu_capacity_forecast_error_ratio",
+            "Forecast-vs-actual drift of one queue's demand series: "
+            "EWMA |one-bucket-ahead error| / EWMA |actual| (~0 = the "
+            "model tracks the tenant; sustained high = forecasts are "
+            "noise and capacity answers should not be trusted — "
+            "VtpuCapacityForecastDrift)",
+            labels=["queue"],
+        )
+        cap_nodes_cur = GaugeMetricFamily(
+            "vtpu_capacity_nodes_current",
+            "Nodes currently registered (the scale recommendation's "
+            "baseline)",
+        )
+        cap_nodes_rec = GaugeMetricFamily(
+            "vtpu_capacity_nodes_recommended",
+            "Nodes the demand forecast needs: peak of the summed "
+            "per-queue upper bands over the horizon, in whole nodes "
+            "(analytic; verify with a vtpu-simulate capacity replay "
+            "before buying hardware — docs/observability.md)",
+        )
+        cap_fn = getattr(self.scheduler, "export_capacity", None)
+        if cap_fn is not None:
+            # Reuse the quota-stats snapshot computed for the queue
+            # gauges above (one registry walk per scrape, not two), and
+            # skip the per-bucket curves/series this exporter never
+            # reads (detail=False — they would be built per scrape
+            # while holding the tracker lock).
+            doc = cap_fn(quota_stats=quota_stats, detail=False)
+            for row in doc["queues"]:
+                q = [row["queue"]]
+                cap_demand.add_metric(q, row["demand_chips"])
+                cap_forecast.add_metric(q, row["forecast_demand_chips"])
+                cap_upper.add_metric(q, row["forecast_upper_chips"])
+                cap_eta.add_metric(
+                    q, row["starvation_eta_s"]
+                    if row["starvation_eta_s"] is not None
+                    else float("inf"))
+                if row["forecast_error_ratio"] is not None:
+                    cap_err.add_metric(q, row["forecast_error_ratio"])
+            cap_nodes_cur.add_metric([], doc["nodes_current"])
+            cap_nodes_rec.add_metric([], doc["nodes_recommended"])
+
+        # Usage-series freshness (the vtpu-report / vtpu-smi staleness
+        # guard's fleet-side face): age of each pod's newest ledger
+        # sample.  A CLI reporting totals off a stale series marks the
+        # row STALE; the VtpuUsageSeriesStale alert pages when a whole
+        # fleet's reports go quiet.
+        series_age = GaugeMetricFamily(
+            "vtpu_usage_series_age_seconds",
+            "Seconds since the ledger last absorbed a usage report for "
+            "one pod (high = its node's monitor stopped reporting; "
+            "totals for it are frozen, not zero)",
+            labels=["podnamespace", "podname"],
+        )
+
         fleet = self.scheduler.grant_efficiency()
         by_uid = {p.uid: p for p in fleet.pods}
         qos_by_class: Dict[str, tuple] = {}
@@ -452,6 +547,8 @@ class ClusterCollector(Collector):
         # duplicate series would invalidate the whole exposition.
         # Summing is correct for lifetime counters.
         sums: Dict[tuple, list] = {}
+        ages: Dict[tuple, float] = {}
+        ledger_now = self.scheduler.ledger.now()
         for acct in self.scheduler.ledger.accounts():
             pe = by_uid.get(acct.uid)
             namespace = pe.namespace if pe is not None else "(unresolved)"
@@ -459,9 +556,17 @@ class ClusterCollector(Collector):
             agg = sums.setdefault((namespace, name), [0.0, 0.0])
             agg[0] += acct.chip_seconds
             agg[1] += acct.hbm_byte_seconds
+            # Freshest incarnation wins on (ns, name) collisions: the
+            # age gauge answers "is anything still reporting here".
+            age = max(0.0, ledger_now - acct.last_recorded)
+            prev = ages.get((namespace, name))
+            if prev is None or age < prev:
+                ages[(namespace, name)] = age
         for (namespace, name), (chip_s, hbm_s) in sorted(sums.items()):
             u_chip.add_metric([namespace, name], chip_s)
             u_hbm.add_metric([namespace, name], hbm_s)
+        for (namespace, name), age in sorted(ages.items()):
+            series_age.add_metric([namespace, name], age)
         # Same dedup discipline: a delete/recreate race can briefly hold
         # two uids under one (namespace, name); latest registry entry wins.
         ratios: Dict[tuple, float] = {}
@@ -481,6 +586,8 @@ class ClusterCollector(Collector):
                 defrag_plans, defrag_migrations, defrag_completed,
                 defrag_aborted, shard_epoch, shards_owned,
                 shards_orphaned, shard_rebalances, cas_failures,
+                cap_demand, cap_forecast, cap_upper, cap_eta, cap_err,
+                cap_nodes_cur, cap_nodes_rec, series_age,
                 u_chip, u_hbm, eff_ratio, idle_grants,
                 qos_wait_family(qos_by_class),
                 pod_qos_weight] + list(phase_metrics())
@@ -493,12 +600,14 @@ def phase_metrics():
     latency = HistogramMetricFamily(
         "vtpu_scheduling_phase_latency_seconds",
         "Wall-clock latency of one scheduling phase (webhook, filter, "
-        "decision-write, bind, allocate)",
-        labels=["phase"],
+        "decision-write, bind, allocate), by the pod's QoS class "
+        "(empty = unclassed) so tiered scheduling latency slices the "
+        "same way vtpu.dev/qos slices traces",
+        labels=["phase", "qos"],
     )
-    for phase, (buckets, _count, sum_s) in \
+    for (phase, qos), (buckets, _count, sum_s) in \
             trace.tracer().histogram_snapshot().items():
-        latency.add_metric([phase], buckets, sum_s)
+        latency.add_metric([phase, qos], buckets, sum_s)
     rejections = CounterMetricFamily(
         "vtpu_filter_rejections",
         "Nodes rejected during Filter, by dominant reason token "

@@ -15,6 +15,11 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
 - ``GET  /queuez``  capacity-queue state (quota, held/borrowed usage,
                     fair shares, pending pods + positions) for
                     ``vtpu-report --queues`` and operators
+- ``GET  /capacityz``  predictive capacity: per-queue demand forecasts
+                    with confidence bands, starvation ETAs, scale
+                    recommendation and forecast drift
+                    (``?horizon=<s>`` overrides the horizon) for
+                    ``vtpu-report`` and operators
 """
 
 from __future__ import annotations
@@ -110,6 +115,31 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, self.scheduler.export_queues())
             except Exception as e:  # noqa: BLE001 — 500, not a hangup
                 log.exception("queuez export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        elif self.path.startswith("/capacityz"):
+            # Predictive capacity (accounting/planner.py): forecasts,
+            # starvation ETAs, scale recommendation, forecast drift.
+            from urllib.parse import parse_qsl, urlsplit
+
+            import math
+
+            query = dict(parse_qsl(urlsplit(self.path).query))
+            try:
+                horizon = (float(query["horizon"])
+                           if "horizon" in query else None)
+                # float() accepts nan/inf, which would 500 deep inside
+                # the assessment — the contract is 400 on bad input.
+                if horizon is not None and (
+                        not math.isfinite(horizon) or horizon <= 0):
+                    raise ValueError(f"not a positive finite number: "
+                                     f"{query['horizon']!r}")
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad horizon: {e}"})
+                return
+            try:
+                self._reply(200, self.scheduler.export_capacity(horizon))
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("capacityz export failed")
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         elif self.path.startswith("/usagez"):
             # Per-namespace showback over a trailing window (accounting/

@@ -316,6 +316,9 @@ def handle_admission_review(body: dict, cfg: Config,
             meta = pod.get("metadata", {})
             sp.set("pod", meta.get("name", "?"))
             sp.set("patch_ops", len(patches))
+            qos = meta.get("annotations", {}).get(QOS_ANNOTATION, "")
+            if qos:
+                sp.set("qos", qos)
             trace.tracer().finish(sp)
             if patches:
                 trace.tracer().event(

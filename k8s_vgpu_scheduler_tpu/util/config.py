@@ -145,6 +145,22 @@ class Config:
     # actual-based placement deliberately (--score-by-actual).
     score_by_actual: bool = False
 
+    # Predictive capacity (accounting/forecast.py + planner.py;
+    # docs/observability.md "Capacity planning").  Demand per queue (or
+    # per namespace when ungoverned) is sampled every
+    # capacity_interval_s, bucketed for the Holt-Winters forecaster, and
+    # served on GET /capacityz + the vtpu_capacity_* gauges.
+    capacity_interval_s: float = 30.0
+    capacity_bucket_s: float = 60.0
+    # Buckets per seasonal cycle (24 x 60s = hourly seasonality by
+    # default; set bucket_s=3600 season_buckets=24 for diurnal).
+    capacity_season_buckets: int = 24
+    # Default forecast horizon for /capacityz (?horizon= overrides).
+    capacity_horizon_s: float = 1800.0
+    # A queue "starves" when a pod has waited this long unplaced — the
+    # ETA the starvation forecast predicts toward.
+    capacity_starve_after_s: float = 300.0
+
     # Multi-tenant capacity queues (quota/; docs/quota.md).  Tuple of
     # queue config dicts ({"name", "namespaces", "cohort", "weight",
     # "quota": {"chips", "hbm_mib"}, "borrow_limit_chips", ...} — the

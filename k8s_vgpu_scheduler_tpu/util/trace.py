@@ -52,6 +52,11 @@ ENV_TRACE_ID = "VTPU_TRACE_ID"
 DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# Admissible values of the histogram qos label — the webhook-validated
+# classes (util/types.py), the only values that may become Prometheus
+# label values via the phase histograms.
+from .types import QOS_CLASSES as _QOS_LABELS  # noqa: E402
+
 
 def new_trace_id() -> str:
     """OTLP-compatible 16-byte trace id as 32 hex chars.  uuid4 is fine
@@ -202,7 +207,9 @@ class Tracer:
         # end-start stays the true elapsed time even across a clock step.
         sp.end = sp.start + max(0.0, time.monotonic() - sp._mono)
         self._spans.append(sp)
-        self.histogram(sp.name).observe(sp.duration_s)
+        self.histogram(sp.name,
+                       str(sp.attrs.get("qos") or "")).observe(
+            sp.duration_s)
 
     def record(self, name: str, trace_id: str, start_s: float,
                end_s: float, **attrs) -> Span:
@@ -213,7 +220,8 @@ class Tracer:
         sp.attrs.update(attrs)
         sp.end = end_s
         self._spans.append(sp)
-        self.histogram(name).observe(max(0.0, end_s - start_s))
+        self.histogram(name, str(attrs.get("qos") or "")).observe(
+            max(0.0, end_s - start_s))
         return sp
 
     def event(self, pod_uid: str, what: str, trace_id: str = "",
@@ -228,11 +236,22 @@ class Tracer:
         with self._rej_lock:
             self._rejections[reason] += n
 
-    def histogram(self, phase: str) -> PhaseHistogram:
-        h = self._hist.get(phase)
+    def histogram(self, phase: str, qos: str = "") -> PhaseHistogram:
+        """Per-(phase, QoS class) latency histogram — the class label
+        lets tiered latency be sliced in the exported histograms the
+        same way ``vtpu.dev/qos`` slices it in traces (unclassed pods
+        aggregate under the empty class).  The label set is CLAMPED to
+        the known classes: the annotation reaches here unvalidated when
+        the webhook is bypassed, and keying histograms (and Prometheus
+        series) on a tenant-controlled string would grow both without
+        bound — unknown values aggregate under "invalid"."""
+        if qos and qos not in _QOS_LABELS:
+            qos = "invalid"
+        key = (phase, qos)
+        h = self._hist.get(key)
         if h is None:
             with self._hist_lock:
-                h = self._hist.setdefault(phase, PhaseHistogram())
+                h = self._hist.setdefault(key, PhaseHistogram())
         return h
 
     # -- reading ---------------------------------------------------------------
@@ -252,11 +271,14 @@ class Tracer:
         ]
         return out[-limit:] if limit else out
 
-    def histogram_snapshot(self) -> Dict[str, Tuple[List[Tuple[str, int]],
-                                                    int, float]]:
+    def histogram_snapshot(self) -> Dict[Tuple[str, str],
+                                         Tuple[List[Tuple[str, int]],
+                                               int, float]]:
+        """``(phase, qos class)`` → Prometheus-shaped snapshot.  Both
+        exporters render the pair as ``{phase=..., qos=...}`` labels."""
         with self._hist_lock:
             phases = dict(self._hist)
-        return {phase: h.snapshot() for phase, h in phases.items()}
+        return {key: h.snapshot() for key, h in phases.items()}
 
     def rejection_snapshot(self) -> Dict[str, int]:
         with self._rej_lock:

@@ -1,0 +1,441 @@
+"""Predictive capacity (accounting/planner.py + the /capacityz surface):
+the live tracker/assessment, the field↔metric consistency contract
+(CAPACITY_FIELD_METRICS pins the /capacityz JSON, both exporters, the
+Grafana "Capacity" row and the alert rules to ONE name set), the
+staleness guard in vtpu-report / vtpu-smi, and the arrival-pattern /
+trace-capture helpers the simulator scenarios are built on."""
+
+import json
+import os
+import re
+import urllib.request
+
+import pytest
+from prometheus_client import CollectorRegistry, generate_latest
+
+from k8s_vgpu_scheduler_tpu.accounting import planner
+from k8s_vgpu_scheduler_tpu.accounting.forecast import (
+    ForecastConfig,
+    ForecastPoint,
+)
+from k8s_vgpu_scheduler_tpu.accounting.planner import (
+    CAPACITY_FIELD_METRICS,
+    CAPACITY_ROOT_FIELDS,
+    CapacityTracker,
+)
+from k8s_vgpu_scheduler_tpu.cmd.simulate import build_fleet
+from k8s_vgpu_scheduler_tpu.cmd.vtpu_smi import parse_prom, top_info
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.quota.queues import (
+    QUEUE_ANNOTATION,
+    QUEUE_STATE_ANNOTATION,
+    STATE_HELD,
+)
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.metrics import ClusterCollector
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+QUEUES = ({"name": "tenant-a", "namespaces": ["tenant-a"],
+           "quota": {"chips": 4}},)
+
+
+def governed_pod(i: int, chips: int = 1) -> dict:
+    return {
+        "metadata": {
+            "name": f"p{i}", "namespace": "tenant-a",
+            "uid": f"uid-p{i}",
+            "annotations": {QUEUE_ANNOTATION: "tenant-a",
+                            QUEUE_STATE_ANNOTATION: STATE_HELD},
+        },
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {"google.com/tpu": str(chips)}}}]},
+    }
+
+
+@pytest.fixture
+def sched():
+    clock = SimClock()
+    kube = FakeKube()
+    s = Scheduler(kube, Config(
+        quota_queues=QUEUES,
+        capacity_bucket_s=30.0, capacity_season_buckets=1,
+        capacity_horizon_s=300.0, capacity_starve_after_s=60.0),
+        clock=clock)
+    build_fleet(s, kube, 1, 4, 16384, (4, 1), "v5e")
+    kube.watch_pods(s.on_pod_event)
+    yield kube, s, clock
+    s.close()
+
+
+def drive_demand(kube, s, clock, buckets: int = 8) -> None:
+    """One held governed pod arriving per 30s bucket — a rising demand
+    ramp the tracker samples every bucket."""
+    for b in range(buckets):
+        kube.create_pod(governed_pod(b))
+        s.observe_capacity()
+        clock.advance(30.0)
+    s.observe_capacity()
+
+
+# -- the consistency contract --------------------------------------------------
+
+def test_capacityz_fields_match_the_metric_mapping(sched):
+    """Every field named in CAPACITY_FIELD_METRICS exists in the
+    /capacityz document exactly where the mapping says (root vs queue
+    row) — a renamed JSON field without a matching metric rename fails
+    here before an operator's dashboard quietly splits from the CLI."""
+    kube, s, clock = sched
+    drive_demand(kube, s, clock)
+    doc = s.export_capacity()
+    for field in CAPACITY_ROOT_FIELDS:
+        assert field in doc, f"/capacityz root missing {field}"
+    row_fields = [f for f in CAPACITY_FIELD_METRICS
+                  if f not in CAPACITY_ROOT_FIELDS]
+    assert doc["queues"], "no queue rows despite governed demand"
+    for row in doc["queues"]:
+        for field in row_fields:
+            assert field in row, f"/capacityz queue row missing {field}"
+
+
+def test_exporter_emits_every_capacity_metric(sched):
+    """The scheduler exporter renders every CAPACITY_FIELD_METRICS
+    metric through the real prometheus encoder, with the queue label
+    carrying the queue name and +Inf for 'horizon clear'."""
+    kube, s, clock = sched
+    drive_demand(kube, s, clock)
+    registry = CollectorRegistry()
+    registry.register(ClusterCollector(s))
+    metrics = parse_prom(generate_latest(registry).decode())
+    for metric in CAPACITY_FIELD_METRICS.values():
+        assert metric in metrics, f"exporter missing {metric}"
+    labels, _v = metrics["vtpu_capacity_queue_demand_chips"][0]
+    assert labels == {"queue": "tenant-a"}
+    # demand_chips in the exposition equals the /capacityz field.
+    doc = s.export_capacity()
+    row = doc["queues"][0]
+    got = metrics["vtpu_capacity_queue_demand_chips"][0][1]
+    assert got == pytest.approx(row["demand_chips"], abs=1.0)
+
+
+def test_dashboard_and_alerts_cover_the_capacity_row():
+    """Reverse pinning, scoped to the new surface: every capacity
+    metric (both exporters) and the staleness gauge appears in the
+    Grafana dashboard or the alert rules — the 'Capacity' row cannot
+    silently drop a panel while the collector keeps emitting."""
+    with open(os.path.join(REPO, "charts", "vtpu", "dashboards",
+                           "vtpu-overview.json")) as f:
+        text = f.read()
+    with open(os.path.join(REPO, "charts", "vtpu", "dashboards",
+                           "vtpu-alerts.yaml")) as f:
+        alerts = f.read()
+    text += alerts
+    wanted = set(CAPACITY_FIELD_METRICS.values()) | {
+        "vtpu_capacity_node_busy_chips_forecast",
+        "vtpu_usage_series_age_seconds",
+    }
+    for metric in sorted(wanted):
+        assert re.search(rf"\b{re.escape(metric)}\b", text), (
+            f"dashboard/alerts never reference {metric}")
+    # The two new alert rules exist and read the right signals.
+    assert "VtpuQueueStarvationForecast" in alerts
+    assert "VtpuCapacityForecastDrift" in alerts
+    assert "VtpuUsageSeriesStale" in alerts
+
+
+def test_capacityz_http_roundtrip(sched):
+    from k8s_vgpu_scheduler_tpu.scheduler.routes import ExtenderServer
+
+    kube, s, clock = sched
+    drive_demand(kube, s, clock)
+    srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/capacityz?horizon=120",
+                timeout=10) as r:
+            doc = json.load(r)
+        assert doc["horizon_s"] == 120.0
+        assert doc["queues"][0]["queue"] == "tenant-a"
+        # Every malformed horizon is a 400, never a 500 deep in the
+        # assessment: unparsable, non-finite, and non-positive alike.
+        for bad in ("bogus", "nan", "inf", "-60", "0"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/capacityz"
+                    f"?horizon={bad}", timeout=10)
+            assert ei.value.code == 400, bad
+    finally:
+        srv.stop()
+
+
+# -- the live assessment -------------------------------------------------------
+
+def test_starvation_eta_reads_the_upper_band():
+    pts = [ForecastPoint(at_s=60.0 * (h + 1), mean=2.0 + h,
+                         lower=1.0, upper=3.0 + h) for h in range(5)]
+    # upper strictly exceeds 6 chips first at at_s=300 (240's band
+    # touches 6.0 exactly — "at capacity" is not yet starving).
+    assert planner._starvation_eta(pts, 1.0, 6.0) == 300.0
+    assert planner._starvation_eta(pts, 1.0, 5.5) == 240.0
+    # Starvation = crossing + the unplaced-wait threshold (the same
+    # definition the simulator measures; --capacity-starve-after).
+    assert planner._starvation_eta(pts, 1.0, 5.5, 60.0) == 300.0
+    # current demand already over: starving now.
+    assert planner._starvation_eta(pts, 9.0, 6.0) == 0.0
+    # horizon clear.
+    assert planner._starvation_eta(pts, 1.0, 100.0) is None
+
+
+def test_assess_scale_recommendation_is_peak_over_chips_per_node():
+    tracker = CapacityTracker(ForecastConfig(bucket_s=30.0,
+                                             season_buckets=1))
+    for b in range(12):
+        tracker.observe_queues({"q": 9.0}, b * 30.0)
+    doc = planner.assess(tracker, fleet_chips=4, free_chips=0,
+                         chips_per_node=4, nodes_current=1,
+                         queue_rows=[{"queue": "q", "nominal_chips": 0,
+                                      "borrow_limit_chips": 0}],
+                         now=12 * 30.0, horizon_s=120.0)
+    # Steady 9 chips of demand on 4-chip nodes → at least 3 nodes.
+    assert doc["nodes_recommended"] >= 3
+    assert doc["nodes_to_add"] == doc["nodes_recommended"] - 1
+    assert doc["method"] == "analytic"
+
+
+def test_admissible_capacity_clamped_to_physical_fleet():
+    """A queue whose quota exceeds the deployed fleet starves on
+    HARDWARE: entitlement must clamp to fleet chips or the ETA stays
+    'horizon clear' while pods already pend (review finding)."""
+    tracker = CapacityTracker(ForecastConfig(bucket_s=30.0,
+                                             season_buckets=1))
+    for b in range(12):
+        tracker.observe_queues({"serve": 10.0}, b * 30.0)
+    doc = planner.assess(tracker, fleet_chips=8, free_chips=0,
+                         chips_per_node=4, nodes_current=2,
+                         queue_rows=[{"queue": "serve",
+                                      "nominal_chips": 20,
+                                      "borrow_limit_chips": 0}],
+                         now=12 * 30.0, horizon_s=300.0)
+    (row,) = doc["queues"]
+    assert row["admissible_chips"] == 8
+    assert row["starvation_eta_s"] == 0.0  # 10 chips wanted, 8 exist
+
+
+def test_borrow_only_queue_is_governed_not_fleetwide():
+    """A zero-nominal, borrow-only queue (the flash-crowd 'batch'
+    shape) is capped at its borrow limit by quota admission — its
+    starvation forecast must read that cap, not the whole fleet
+    (review finding: the nominal>0 guard conflated 'no entitlement
+    row' with 'zero-nominal borrow queue')."""
+    tracker = CapacityTracker(ForecastConfig(bucket_s=30.0,
+                                             season_buckets=1))
+    for b in range(12):
+        tracker.observe_queues({"batch": 10.0}, b * 30.0)
+    doc = planner.assess(tracker, fleet_chips=64, free_chips=54,
+                         chips_per_node=8, nodes_current=8,
+                         queue_rows=[{"queue": "batch",
+                                      "nominal_chips": 0,
+                                      "borrow_limit_chips": 4}],
+                         now=12 * 30.0, horizon_s=300.0)
+    (row,) = doc["queues"]
+    assert row["admissible_chips"] == 4
+    assert row["starvation_eta_s"] == 0.0  # 10 wanted, 4 admissible
+
+
+def test_horizon_is_clamped_against_unbounded_requests():
+    """?horizon= is unauthenticated input; the assessment must bound
+    its O(buckets)-sized allocations (review finding)."""
+    tracker = CapacityTracker(ForecastConfig(bucket_s=60.0,
+                                             season_buckets=1))
+    tracker.observe_queues({"q": 1.0}, 0.0)
+    tracker.observe_queues({"q": 1.0}, 60.0)
+    doc = planner.assess(tracker, fleet_chips=4, free_chips=4,
+                         chips_per_node=4, nodes_current=1,
+                         queue_rows=[], now=120.0, horizon_s=1e9)
+    assert doc["horizon_s"] == planner.MAX_HORIZON_BUCKETS * 60.0
+    (row,) = doc["queues"]
+    assert len(row["forecast"]) == planner.MAX_HORIZON_BUCKETS
+
+
+def test_vanished_queue_demand_decays_to_zero():
+    tracker = CapacityTracker(ForecastConfig(bucket_s=30.0,
+                                             season_buckets=1,
+                                             alpha=0.5))
+    for b in range(6):
+        tracker.observe_queues({"gone": 4.0}, b * 30.0)
+    for b in range(6, 30):
+        tracker.observe_queues({}, b * 30.0)  # tenant left
+    pts = tracker.demand.forecast("gone", 1)
+    assert pts[0].mean < 0.5
+
+
+def test_vanished_key_retired_after_retention():
+    """Churned ungoverned namespaces must not grow the tracker (and the
+    vtpu_capacity_* cardinality) forever: a key absent past the
+    retention horizon is dropped outright (review finding)."""
+    tracker = CapacityTracker(
+        ForecastConfig(bucket_s=30.0, season_buckets=1),
+        retention_s=120.0)
+    tracker.observe_queues({"ci-job-123": 2.0}, 0.0)
+    tracker.observe_queues({}, 60.0)    # inside retention: zero-fed
+    assert "ci-job-123" in tracker.demand.keys()
+    tracker.observe_queues({}, 200.0)   # past retention: retired
+    assert "ci-job-123" not in tracker.demand.keys()
+    doc = planner.assess(tracker, fleet_chips=4, free_chips=4,
+                         chips_per_node=4, nodes_current=1,
+                         queue_rows=[], now=200.0, horizon_s=60.0)
+    assert doc["queues"] == []
+
+
+def test_ungoverned_fleet_samples_namespace_demand():
+    from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+    from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+    s = Scheduler(FakeKube(), Config())  # no quota layer at all
+    try:
+        s.pods.add_pod(PodInfo(
+            uid="u1", name="w", namespace="team-x", node="n0",
+            devices=[[ContainerDevice(uuid="c0", type="v5e",
+                                      usedmem=100, usedcores=10)]]))
+        samples = s.observe_capacity()
+        assert samples == {"team-x": 1}
+    finally:
+        s.close()
+
+
+# -- arrival patterns / trace capture ------------------------------------------
+
+def test_integerize_conserves_cumulative_demand():
+    series = [0.3, 0.3, 0.3, 2.5, 0.1, 0.7, 1.9]
+    pods = planner.integerize(series, 1)
+    assert abs(sum(pods) - sum(series)) < 1.0
+    # Prefix sums never drift by a full pod either (error diffusion).
+    acc = 0.0
+    got = 0
+    for chips, n in zip(series, pods):
+        acc += chips
+        got += n
+        assert abs(got - acc) < 1.0
+
+
+def test_synth_patterns_are_deterministic_and_named():
+    a = planner.synth_demand("bursty", {}, 32)
+    b = planner.synth_demand("bursty", {}, 32)
+    assert a == b
+    assert len(planner.synth_demand("diurnal", {}, 24)) == 24
+    assert len(planner.synth_demand("flash-crowd", {}, 30)) == 30
+    with pytest.raises(ValueError):
+        planner.synth_demand("tsunami", {}, 8)
+
+
+def test_scenario_from_capacityz_roundtrips_into_the_simulator(sched):
+    kube, s, clock = sched
+    drive_demand(kube, s, clock, buckets=6)
+    doc = s.export_capacity()
+    spec = planner.scenario_from_capacityz(doc)
+    cap = spec["capacity"]
+    assert cap["source"] == "capacityz-snapshot"
+    # The replay window covers the WHOLE captured trace (the simulator's
+    # 48+16 defaults would silently drop any tail beyond 64 buckets).
+    n_rows = max(len(st["series"]) for st in cap["streams"])
+    assert cap["history_buckets"] + cap["horizon_buckets"] >= n_rows
+    (stream,) = [st for st in cap["streams"]
+                 if st["name"] == "tenant-a"]
+    assert stream["series"], "captured stream carries no demand rows"
+    assert stream["series"][0][0] == 0.0  # re-based to t0
+    (queue,) = cap["queues"]
+    assert queue["quota"]["chips"] == 4
+    # The captured trace feeds the simulator's series resampler.
+    from k8s_vgpu_scheduler_tpu.cmd.simulate import (
+        _capacity_demand_series)
+
+    series = _capacity_demand_series(cap, stream, 8, cap["bucket_s"])
+    assert len(series) == 8 and max(series) > 0
+
+
+def test_arrival_entries_spread_within_buckets():
+    entries = planner.arrival_entries(
+        {"name": "s", "namespace": "ns", "tpu": 1, "runtime_s": 10},
+        [2.0, 0.0, 1.0], 30.0)
+    assert [e["at_s"] for e in entries] == [0.0, 60.0]
+    assert entries[0]["count"] == 2
+    assert entries[0]["every_s"] == 15.0
+    assert "tpumem" not in entries[0]
+
+
+# -- the staleness guard -------------------------------------------------------
+
+def test_showback_stamps_series_age_and_report_marks_stale():
+    from k8s_vgpu_scheduler_tpu.accounting.efficiency import showback
+    from k8s_vgpu_scheduler_tpu.accounting.ledger import UsageLedger
+    from k8s_vgpu_scheduler_tpu.cmd.vtpu_report import (
+        format_report,
+        stale_marker,
+    )
+    from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+    from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+    now = [1000.0]
+    ledger = UsageLedger(clock=lambda: now[0], retention_s=10000.0)
+    ledger.record("node-a", [{
+        "ctrkey": "u1_pod-a", "chips": 1, "active": True,
+        "oversubscribe": False, "chip_seconds": 5.0,
+        "hbm_byte_seconds": 0.0, "throttled_seconds": 0.0,
+        "oversub_spill_seconds": 0.0, "window_s": 30.0}])
+    pods = [PodInfo(uid="u1", name="pod-a", namespace="ns",
+                    node="node-a",
+                    devices=[[ContainerDevice(uuid="c0", type="v5e",
+                                              usedmem=1, usedcores=1)]])]
+    now[0] += 400.0  # monitor goes quiet for 400s
+    export = showback(pods, ledger)
+    assert export["newest_sample_age_s"] == 400.0
+    (row,) = [r for r in export["pods"] if r["pod"] == "pod-a"]
+    assert row["last_sample_age_s"] == 400.0
+    text = format_report(export, pods=True, stale_after_s=120.0)
+    assert "STALE (last sample 400s ago)" in text
+    # Fresh series: no marker.
+    assert stale_marker(30.0, 120.0) == ""
+    # Never-reported pods are unknown, not stale.
+    assert stale_marker(None, 120.0) == ""
+
+
+def test_smi_top_marks_stale_rows_from_the_age_gauge():
+    from k8s_vgpu_scheduler_tpu.cmd.vtpu_smi import format_top
+
+    metrics = parse_prom(
+        'vtpu_pod_device_allocated_mib{podnamespace="ns",podname="a",'
+        'deviceuuid="c0"} 100\n'
+        'vtpu_usage_series_age_seconds{podnamespace="ns",podname="a"}'
+        ' 500\n'
+        'vtpu_pod_device_allocated_mib{podnamespace="ns",podname="b",'
+        'deviceuuid="c1"} 100\n'
+        'vtpu_usage_series_age_seconds{podnamespace="ns",podname="b"}'
+        ' 5\n')
+    info = top_info(metrics, stale_after_s=120.0)
+    rows = {r["name"]: r for r in info["pods"]}
+    assert rows["a"]["stale"] and rows["a"]["series_age_s"] == 500.0
+    assert not rows["b"]["stale"]
+    text = format_top(info)
+    assert "STALE (last sample 500s ago)" in text
+
+
+def test_report_capacity_section_renders():
+    from k8s_vgpu_scheduler_tpu.cmd.vtpu_report import format_capacity
+
+    text = format_capacity({
+        "method": "analytic", "horizon_s": 1800.0, "bucket_s": 60.0,
+        "nodes_current": 2, "nodes_recommended": 4, "nodes_to_add": 2,
+        "peak_forecast_demand_chips": 11.5,
+        "queues": [
+            {"queue": "serve", "demand_chips": 6.0,
+             "forecast_demand_chips": 10.0, "forecast_upper_chips": 11.5,
+             "starvation_eta_s": 540.0, "forecast_error_ratio": 0.07},
+            {"queue": "batch", "demand_chips": 2.0,
+             "forecast_demand_chips": 2.0, "forecast_upper_chips": 2.4,
+             "starvation_eta_s": None, "forecast_error_ratio": None}]})
+    assert "2 node(s) now, 4 recommended (+2)" in text
+    assert "540s" in text and "never" in text and "7%" in text

@@ -1,0 +1,198 @@
+"""accounting/forecast.py property tests (hand-rolled, seeded — no
+hypothesis dependency in tier-1): non-negativity, EWMA convergence on a
+constant series, seasonality recovery on a synthetic diurnal signal,
+forecast-error monotone in noise, band shape, and gap handling."""
+
+import math
+import random
+
+from k8s_vgpu_scheduler_tpu.accounting.forecast import (
+    DemandForecaster,
+    ForecastConfig,
+    SeriesForecaster,
+)
+from k8s_vgpu_scheduler_tpu.accounting.planner import synth_demand
+
+BUCKET = 30.0
+
+
+def feed(fc: SeriesForecaster, series) -> None:
+    for b, v in enumerate(series):
+        fc.observe(b * fc.cfg.bucket_s, v)
+    # One sample into the next bucket closes the last one.
+    fc.observe(len(series) * fc.cfg.bucket_s, 0.0)
+
+
+class TestNonNegativity:
+    def test_forecast_never_negative_on_random_series(self):
+        """Demand is chips: whatever the input (including series that
+        crash to zero, where a raw level+trend extrapolation would go
+        negative), every emitted mean/lower/upper is >= 0."""
+        for seed in range(8):
+            rng = random.Random(seed)
+            series = [max(0.0, rng.uniform(-2.0, 8.0))
+                      for _ in range(40)]
+            series += [0.0] * 10  # hard crash to zero: trend goes down
+            fc = SeriesForecaster(ForecastConfig(
+                bucket_s=BUCKET, season_buckets=8, beta=0.3))
+            feed(fc, series)
+            for p in fc.forecast(24):
+                assert p.mean >= 0.0
+                assert p.lower >= 0.0
+                assert p.upper >= 0.0
+
+    def test_bands_bracket_the_mean(self):
+        rng = random.Random(3)
+        fc = SeriesForecaster(ForecastConfig(bucket_s=BUCKET,
+                                             season_buckets=4))
+        feed(fc, [2.0 + rng.random() for _ in range(30)])
+        for p in fc.forecast(12):
+            assert p.lower <= p.mean <= p.upper
+
+
+class TestConvergence:
+    def test_constant_series_converges_to_the_constant(self):
+        fc = SeriesForecaster(ForecastConfig(bucket_s=BUCKET,
+                                             season_buckets=8))
+        feed(fc, [5.0] * 60)
+        for p in fc.forecast(16):
+            assert abs(p.mean - 5.0) < 1e-6
+        # One-step error decays to ~0 on a constant series.
+        assert fc.error_ratio() is not None
+        assert fc.error_ratio() < 0.01
+
+    def test_constant_series_bands_collapse(self):
+        fc = SeriesForecaster(ForecastConfig(bucket_s=BUCKET,
+                                             season_buckets=1))
+        feed(fc, [3.0] * 50)
+        p = fc.forecast(1)[0]
+        assert p.upper - p.lower < 0.1
+
+
+class TestSeasonalityRecovery:
+    def test_diurnal_signal_recovered_out_of_sample(self):
+        """Train on 3 full periods of the diurnal pattern, forecast the
+        4th: the per-bucket prediction must track the raised-cosine
+        shape, not its mean (total error under 10% of total demand)."""
+        period = 16
+        series = synth_demand(
+            "diurnal", {"base_chips": 0.5, "amplitude_chips": 3.0,
+                        "period_buckets": period}, 4 * period)
+        fc = SeriesForecaster(ForecastConfig(
+            bucket_s=BUCKET, season_buckets=period,
+            alpha=0.05, gamma=0.7, beta=0.0))
+        feed(fc, series[:3 * period])
+        pred = [p.mean for p in fc.forecast(period)]
+        actual = series[3 * period:]
+        err = sum(abs(p - a) for p, a in zip(pred, actual))
+        assert err / sum(actual) < 0.10
+        # The crest and the trough land in the right buckets.
+        assert abs(pred.index(max(pred)) - actual.index(max(actual))) <= 1
+        assert abs(pred.index(min(pred)) - actual.index(min(actual))) <= 1
+
+    def test_bursty_phase_alignment(self):
+        """Forecast bursts land on the burst buckets, not the base."""
+        period, width = 8, 2
+        series = synth_demand(
+            "bursty", {"base_chips": 0.5, "burst_chips": 2.0,
+                       "period_buckets": period, "burst_buckets": width},
+            6 * period)
+        fc = SeriesForecaster(ForecastConfig(
+            bucket_s=BUCKET, season_buckets=period,
+            alpha=0.05, gamma=0.7, beta=0.0))
+        feed(fc, series[:5 * period])
+        pred = [p.mean for p in fc.forecast(period)]
+        actual = series[5 * period:]
+        for b in range(period):
+            if actual[b] > 1.0:  # burst bucket
+                assert pred[b] > 1.0
+            else:
+                assert pred[b] < 1.5
+
+
+class TestErrorMonotoneInNoise:
+    def test_drift_ratio_increases_with_noise(self):
+        """The self-reported forecast error must be an honest noise
+        meter: averaged over seeds, more observation noise = larger
+        error_ratio.  (This is what makes the drift alert meaningful.)"""
+        def mean_err(sigma: float) -> float:
+            out = []
+            for seed in range(6):
+                rng = random.Random(seed)
+                fc = SeriesForecaster(ForecastConfig(
+                    bucket_s=BUCKET, season_buckets=1))
+                feed(fc, [max(0.0, 4.0 + rng.gauss(0.0, sigma))
+                          for _ in range(80)])
+                out.append(fc.error_ratio())
+            return sum(out) / len(out)
+
+        e0, e1, e2 = mean_err(0.0), mean_err(0.8), mean_err(2.4)
+        assert e0 < e1 < e2
+        assert e0 < 0.01
+
+    def test_error_ratio_none_until_scored(self):
+        fc = SeriesForecaster(ForecastConfig(bucket_s=BUCKET))
+        assert fc.error_ratio() is None
+        fc.observe(0.0, 1.0)
+        assert fc.error_ratio() is None  # open bucket, nothing scored
+
+
+class TestBucketing:
+    def test_gap_buckets_close_as_zero_demand(self):
+        """No sample in a bucket IS an observation (zero demand) — a
+        tenant that went quiet must decay, not freeze at its last
+        nonzero level."""
+        fc = SeriesForecaster(ForecastConfig(bucket_s=BUCKET,
+                                             season_buckets=1,
+                                             alpha=0.5))
+        fc.observe(0.0, 6.0)
+        fc.observe(10 * BUCKET, 0.0)  # 9 empty buckets closed as 0
+        assert fc.buckets_observed == 10
+        assert fc.forecast(1)[0].mean < 1.0
+
+    def test_within_bucket_samples_average(self):
+        fc = SeriesForecaster(ForecastConfig(bucket_s=BUCKET,
+                                             season_buckets=1))
+        fc.observe(0.0, 2.0)
+        fc.observe(1.0, 4.0)
+        fc.observe(BUCKET, 0.0)
+        assert fc.history_rows() == [[0.0, 3.0]]
+
+    def test_history_ring_bounded(self):
+        fc = SeriesForecaster(ForecastConfig(bucket_s=BUCKET,
+                                             history_len=8))
+        feed(fc, [1.0] * 40)
+        assert len(fc.history_rows()) == 8
+
+
+class TestDemandForecaster:
+    def test_keyed_series_are_independent(self):
+        d = DemandForecaster(ForecastConfig(bucket_s=BUCKET,
+                                            season_buckets=1))
+        for b in range(20):
+            d.observe("a", b * BUCKET, 4.0)
+            d.observe("b", b * BUCKET, 1.0)
+        d.observe("a", 20 * BUCKET, 0.0)
+        d.observe("b", 20 * BUCKET, 0.0)
+        assert d.forecast("a", 1)[0].mean > 2.0
+        assert d.forecast("b", 1)[0].mean < 2.0
+
+    def test_unknown_key_forecasts_zero(self):
+        d = DemandForecaster()
+        p = d.forecast("never-seen", 3)
+        assert [x.mean for x in p] == [0.0, 0.0, 0.0]
+
+
+class TestDampedTrend:
+    def test_trend_is_damped_at_long_horizon(self):
+        """A rising series extrapolates, but the damped trend keeps the
+        long-horizon forecast bounded (phi < 1 ⇒ the trend sum converges
+        to trend * phi / (1 - phi))."""
+        cfg = ForecastConfig(bucket_s=BUCKET, season_buckets=1,
+                             alpha=0.3, beta=0.3, phi=0.9)
+        fc = SeriesForecaster(cfg)
+        feed(fc, [float(i) for i in range(30)])
+        far = fc.forecast(500)[-1].mean
+        bound = fc.level + fc.trend * cfg.phi / (1 - cfg.phi)
+        assert far <= bound + 1e-6
+        assert not math.isinf(far)

@@ -64,14 +64,42 @@ class TestHistogram:
         assert count == 4
         assert abs(sum_s - 5.06) < 1e-9
 
-    def test_tracer_histograms_keyed_by_phase(self):
+    def test_tracer_histograms_keyed_by_phase_and_qos(self):
         t = Tracer()
         t.record("filter", "tid", 100.0, 100.5)
         t.record("bind", "tid", 100.0, 100.001)
+        # A QoS-classed pod's phases slice under its own class label —
+        # tiered latency must be separable in the exported histograms.
+        t.record("filter", "tid2", 100.0, 100.25,
+                 qos="latency-critical")
         snap = t.histogram_snapshot()
-        assert set(snap) == {"filter", "bind"}
-        _, count, sum_s = snap["filter"]
+        assert set(snap) == {("filter", ""), ("bind", ""),
+                             ("filter", "latency-critical")}
+        _, count, sum_s = snap[("filter", "")]
         assert count == 1 and abs(sum_s - 0.5) < 1e-9
+        _, count, sum_s = snap[("filter", "latency-critical")]
+        assert count == 1 and abs(sum_s - 0.25) < 1e-9
+
+    def test_unknown_qos_values_clamp_to_one_label(self):
+        """The annotation reaches the tracer unvalidated when the
+        webhook is bypassed; tenant-controlled strings must not mint
+        histogram keys (and Prometheus series) without bound."""
+        t = Tracer()
+        for i in range(10):
+            t.record("filter", "x", 100.0, 100.1, qos=f"gold-{i}")
+        snap = t.histogram_snapshot()
+        assert set(snap) == {("filter", "invalid")}
+        assert snap[("filter", "invalid")][1] == 10
+
+    def test_span_qos_attr_labels_the_histogram(self):
+        t = Tracer()
+        with t.span("filter", trace_id="x", qos="latency-critical"):
+            pass
+        with t.span("filter", trace_id="y"):
+            pass
+        snap = t.histogram_snapshot()
+        assert snap[("filter", "latency-critical")][1] == 1
+        assert snap[("filter", "")][1] == 1
 
     def test_prometheus_collector_renders_buckets(self, fresh):
         from prometheus_client import CollectorRegistry, generate_latest
@@ -80,6 +108,8 @@ class TestHistogram:
         from k8s_vgpu_scheduler_tpu.scheduler.metrics import phase_metrics
 
         fresh.record("filter", "tid", 10.0, 10.0005)
+        fresh.record("filter", "tid2", 10.0, 10.0005,
+                     qos="latency-critical")
         fresh.reject("insufficient-hbm", 3)
 
         class _C(Collector):
@@ -90,10 +120,13 @@ class TestHistogram:
         registry.register(_C())
         text = generate_latest(registry).decode()
         assert ('vtpu_scheduling_phase_latency_seconds_bucket'
-                '{le="0.001",phase="filter"} 1.0') in text
+                '{le="0.001",phase="filter",qos=""} 1.0') in text
         assert ('vtpu_scheduling_phase_latency_seconds_bucket'
-                '{le="+Inf",phase="filter"} 1.0') in text
-        assert 'vtpu_scheduling_phase_latency_seconds_count{phase="filter"} 1.0' in text
+                '{le="+Inf",phase="filter",qos=""} 1.0') in text
+        assert ('vtpu_scheduling_phase_latency_seconds_count'
+                '{phase="filter",qos=""} 1.0') in text
+        assert ('vtpu_scheduling_phase_latency_seconds_count'
+                '{phase="filter",qos="latency-critical"} 1.0') in text
         assert ('vtpu_filter_rejections_total'
                 '{reason="insufficient-hbm"} 3.0') in text
 

@@ -223,7 +223,7 @@ def test_grafana_dashboard_uses_real_metric_names():
         referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
     # promql functions + aggregation labels, not metrics
     referenced -= {"rate", "label_values", "node", "histogram_quantile",
-                   "phase", "reason", "clamp_min", "class"}
+                   "phase", "reason", "clamp_min", "class", "queue"}
 
     missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
