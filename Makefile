@@ -2,9 +2,9 @@
 # hack/build.sh + a Makefile; here each surface is one target).
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
-        defrag-sim ha-sim qos-sim capacity-sim batch-protocol \
+        defrag-sim ha-sim qos-sim capacity-sim steady-sim batch-protocol \
         shard-protocol lint-dashboards dryrun scenarios controlplane \
-        bench-controlplane bench wheel clean
+        bench-controlplane bench-steady bench wheel clean
 
 all: native
 
@@ -90,6 +90,24 @@ qos-sim: native               ## serving-QoS tiered-vs-flat A/B in the simulator
 # and the replica-loss what-if keeps every shard-protocol invariant.
 capacity-sim:                 ## forecast + what-if capacity verdicts (simulator)
 	python benchmarks/scenarios.py capacity --strict
+
+# Short deterministic CPU-only variant of bench_steady_state (ISSUE 12):
+# a sustained storm — open-loop arrivals, completions, heartbeats, quota
+# + defrag + capacity ticks live — over a small sharded 2-replica fleet
+# with a pinned mid-run replica kill.  No RNG (fixed schedule, FIFO
+# completions, round-robin routing); the verdict gates CI on the
+# protocol invariants: zero double-booking, no grant lost, every pod
+# placed, all shards adopted by the survivor, admission p99 bounded
+# through the kill.  Throughput ratios are NOT gated here (CI noise);
+# the full-scale gate lives in `make bench-steady` → STEADY_<round>.json.
+steady-sim:                   ## sustained-storm invariants through a replica kill
+	python benchmarks/controlplane.py steady-ci
+
+# Full-scale sustained-storm proof (10k nodes / 100k live pods, replica
+# kill mid-run, /perfz breakdown embedded) + the ≤2% instrumentation-
+# overhead A/B → STEADY_<round>.json.  Minutes of CPU; not in CI.
+bench-steady:                 ## steady-state storm artifact (full scale)
+	python benchmarks/controlplane.py steady
 
 # The scheduler-concurrency protocol suite (racing filter/bind/delete,
 # zero over-grant, conflict convergence) re-run with the batched Filter

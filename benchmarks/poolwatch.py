@@ -282,15 +282,54 @@ def snapshot_capacity_scenario() -> None:
         f"({len(spec['capacity']['streams'])} stream(s))")
 
 
+def snapshot_perf() -> None:
+    """Performance-observatory capture (docs/observability.md
+    "Performance observatory"): during any healthy chip window, snapshot
+    a LIVE scheduler's /perfz — phase quantiles, lock table, informer
+    lag, slow-tick splits — into benchmarks/captured-perf-<round>.json,
+    alongside the capacity capture.  Real-fleet phase breakdowns are the
+    ground truth the synthetic steady-state bench is calibrated against.
+    Pure HTTP + JSON — never touches the chip or the pool claim; skips
+    loudly when no scheduler URL is configured or reachable."""
+    url = os.environ.get("VTPU_SCHED_URL", "")
+    if not url:
+        log("perf snapshot: VTPU_SCHED_URL unset; skipping")
+        return
+    import urllib.request
+
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    try:
+        with urllib.request.urlopen(base + "/perfz?ticks=16",
+                                    timeout=10) as r:
+            doc = json.load(r)
+    except Exception as e:  # noqa: BLE001 — capture is best-effort
+        log(f"perf snapshot: cannot fetch {base}/perfz: {e!r}")
+        return
+    if not doc.get("phases"):
+        log("perf snapshot: no phase samples recorded yet; skipping")
+        return
+    out = os.path.join(REPO, "benchmarks",
+                       f"captured-perf-{round_id()}.json")
+    with open(out, "w") as f:
+        json.dump({"captured_at": time.time(), "perfz": doc}, f,
+                  indent=1)
+    log(f"perf snapshot: wrote {out} "
+        f"({len(doc['phases'])} phase(s), {len(doc['locks'])} lock(s))")
+
+
 def run_queue(kinds) -> bool:
     """Run the queue sequentially; False if a child overran or left a
     detached claim-holder (stop — the pool claim may still be held)."""
     import bench
 
     # First thing in any healthy window, before anything can wedge the
-    # queue: the ledger-window capacity snapshot (claim-free).
+    # queue: the ledger-window capacity + /perfz snapshots (claim-free).
     if "capacity" in kinds:
         snapshot_capacity_scenario()
+    if "perf" in kinds:
+        snapshot_perf()
 
     tmpdir = tempfile.mkdtemp(prefix="poolwatch-")
     env = bench.shim_env(tmpdir)
@@ -400,7 +439,7 @@ def main() -> None:
     ap.add_argument("--probe-window", type=float, default=300.0)
     ap.add_argument("--max-hours", type=float, default=6.0)
     ap.add_argument("--tasks",
-                    default="bench,model,micro,scen,oversub,capacity")
+                    default="bench,model,micro,scen,oversub,capacity,perf")
     a = ap.parse_args()
     # One round identity for the whole run: model_tasks' per-round retry
     # markers and run_queue's scenario children both read SCENARIO_ROUND,

@@ -241,6 +241,27 @@ def parse_args(argv=None):
                         "watch, 30 without)")
     p.add_argument("--no-watch", action="store_true",
                    help="disable the pod watch stream; rely on resync only")
+    p.add_argument("--gc-threshold0", type=int, default=0,
+                   help="raise Python's gen-0 GC threshold for this "
+                        "long-running process (0 = interpreter default "
+                        "700).  At fleet scale the default walks a "
+                        "large, mostly-immortal heap thousands of "
+                        "times per minute — the steady-state bench "
+                        "measured gc-pause at over half the tick "
+                        "budget before tuning; the gc-pause phase on "
+                        "GET /perfz shows what your fleet pays")
+    p.add_argument("--no-perf", action="store_true",
+                   help="disable the control-plane performance "
+                        "observatory (phase rings, lock wait/hold "
+                        "telemetry, /perfz quantiles; the instrumented "
+                        "overhead budget is <=2%% on bench_batch_cycle "
+                        "— this is the escape hatch and the overhead "
+                        "A/B's baseline)")
+    p.add_argument("--perf-tracemalloc", action="store_true",
+                   help="opt-in tracemalloc allocation tracking: "
+                        "/perfz then carries the top allocation sites "
+                        "(costs memory + CPU on every allocation — a "
+                        "diagnosis tool, not an always-on default)")
     p.add_argument("--debug", action="store_true",
                    help="enable the /debug endpoints (stacks, wall-clock "
                         "profile, vars, tracez, events); unauthenticated — "
@@ -317,6 +338,8 @@ def build_config(args) -> Config:
         node_scheduler_policy=args.node_scheduler_policy,
         enable_preemption=args.enable_preemption,
         enable_debug=args.debug,
+        perf_enabled=not args.no_perf,
+        perf_tracemalloc=args.perf_tracemalloc,
         optimistic_commit=not args.serial_filter,
         filter_workers=args.filter_workers,
         commit_retries=args.commit_retries,
@@ -390,6 +413,9 @@ def main(argv=None):
     if args.gil_switch_interval > 0:
         import sys
         sys.setswitchinterval(args.gil_switch_interval)
+    if args.gc_threshold0 > 0:
+        import gc
+        gc.set_threshold(args.gc_threshold0)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",

@@ -28,9 +28,10 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+from ..util import perf
 
 
 class LeaseState(enum.IntEnum):
@@ -74,7 +75,11 @@ class LeaseTracker:
                  clock: Optional[Callable[[], float]] = None) -> None:
         self.cfg = cfg or LeaseConfig()
         self._clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        # TimedLock (util/perf.py): wait/hold telemetry under
+        # lock="leases" on /perfz.  reject_reason runs per candidate per
+        # decision, so hold samples are 1-in-64 — contention (the
+        # register-stream beats racing Filters) is always counted.
+        self._lock = perf.TimedLock("leases", sample_shift=6)
         self._leases: Dict[str, NodeLease] = {}
         # Last state reported by sweep(), per node — the transition edge
         # detector.  Distinct from the live state: between sweeps a node
@@ -151,6 +156,20 @@ class LeaseTracker:
         return (f"lease-{st.name.lower()}: no heartbeat for "
                 f"{now - lease.last_beat:.1f}s "
                 f"(ttl {self.cfg.ttl_s:.0f}s)")
+
+    def alive_map(self, names) -> List[bool]:
+        """Bulk gate for the batched cycle (ISSUE 12): one lock
+        acquisition answers ``reject_reason(n) is None`` for every name
+        — the per-node call cost N acquires per cycle at fleet scale.
+        Untracked nodes pass, exactly like reject_reason."""
+        now = self._clock()
+        with self._lock:
+            leases = self._leases
+            return [
+                (lease := leases.get(n)) is None
+                or self._state(lease, now) is LeaseState.HEALTHY
+                for n in names
+            ]
 
     def states(self) -> Dict[str, LeaseState]:
         """Per-node live states (the vtpu_node_lease_state gauge)."""

@@ -83,6 +83,23 @@ class ChipQuarantine:
         self._on_change = on_change
         self._lock = threading.Lock()
         self._chips: Dict[Tuple[str, str], _ChipRecord] = {}
+        #: node -> currently-quarantined chip ids.  Maintained by the
+        #: quarantine/release transitions so ``quarantined_on`` — which
+        #: the snapshot refresh calls PER DIRTY NODE — is O(that node's
+        #: quarantined chips).  The sustained-storm bench caught the
+        #: previous full-table scan: once heartbeats populate a record
+        #: per chip, an O(all chips) read per node refresh turns a 10k-
+        #: node fleet's completion churn into minutes per cycle
+        #: (STEADY_r07 / ISSUE 12).
+        self._active: Dict[str, Set[str]] = {}
+        #: node -> chip ids whose record currently holds last_health
+        #: True.  A keepalive beat whose every chip is healthy AND
+        #: already recorded healthy provably mutates nothing (observe()
+        #: only re-writes last_health True over True), so observe_node
+        #: short-circuits on this index — at 10k nodes × 8 chips per
+        #: storm round the per-chip lock/record walk was a measurable
+        #: slice of the register-apply phase (ISSUE 12).
+        self._healthy: Dict[str, Set[str]] = {}
         #: Lifetime count of quarantine entries (vtpu_chip_quarantines_total).
         self.quarantines_total = 0
 
@@ -98,7 +115,12 @@ class ChipQuarantine:
             flipped = (rec.last_health is not None
                        and healthy != rec.last_health)
             rec.last_health = healthy
-            if not healthy:
+            if healthy:
+                self._healthy.setdefault(node, set()).add(chip)
+            else:
+                healthy_set = self._healthy.get(node)
+                if healthy_set is not None:
+                    healthy_set.discard(chip)
                 rec.last_bad = now
             if flipped:
                 rec.flips.append(now)
@@ -117,9 +139,27 @@ class ChipQuarantine:
 
     def observe_node(self, node: str, health: Dict[str, bool],
                      now: Optional[float] = None) -> bool:
+        with self._lock:
+            if self._healthy.get(node) == health.keys() \
+                    and all(health.values()):
+                # Keepalive: every chip in this beat is healthy and its
+                # record already says so — observe() per chip would be a
+                # bit-for-bit no-op (True over True, no flip, no
+                # last_bad), so skip the per-chip walk.  Any chip id
+                # drift (added/renamed inventory) fails the keys
+                # comparison and takes the full path.
+                return False
         changed = False
         for chip, healthy in health.items():
             changed |= self.observe(node, chip, healthy, now=now)
+        with self._lock:
+            healthy_set = self._healthy.get(node)
+            if healthy_set is not None:
+                # Evict ids that left the inventory (device replacement
+                # renames a chip): a stale id would fail the keys
+                # comparison forever, permanently disabling the
+                # keepalive short-circuit for this node.
+                healthy_set.intersection_update(health.keys())
         return changed
 
     def observe_errors(self, node: str, chip: str, delta: int,
@@ -198,23 +238,22 @@ class ChipQuarantine:
 
     def quarantined_on(self, node: str) -> Set[str]:
         """Chip ids currently quarantined on ``node`` — the snapshot
-        refresh strips exactly this set.  Pure read."""
+        refresh strips exactly this set, once per dirty node, so this
+        read must be O(the node's quarantined chips), never O(every
+        chip record in the fleet).  Pure read off the maintained
+        node index."""
         with self._lock:
-            return {rec.chip for (n, _), rec in self._chips.items()
-                    if n == node and rec.quarantined_at is not None}
+            chips = self._active.get(node)
+            return set(chips) if chips else set()
 
     def active(self) -> Dict[str, Set[str]]:
         with self._lock:
-            out: Dict[str, Set[str]] = {}
-            for (node, _), rec in self._chips.items():
-                if rec.quarantined_at is not None:
-                    out.setdefault(node, set()).add(rec.chip)
-            return out
+            return {node: set(chips)
+                    for node, chips in self._active.items()}
 
     def count(self) -> int:
         with self._lock:
-            return sum(1 for rec in self._chips.values()
-                       if rec.quarantined_at is not None)
+            return sum(len(chips) for chips in self._active.values())
 
     # -- internals -------------------------------------------------------------
     def _record(self, node: str, chip: str) -> _ChipRecord:
@@ -232,6 +271,7 @@ class ChipQuarantine:
         rec.quarantined_at = now
         rec.last_bad = now
         rec.reason = reason
+        self._active.setdefault(rec.node, set()).add(rec.chip)
         self.quarantines_total += 1
         log.warning("quarantined chip %s on %s: %s", rec.chip, rec.node,
                     reason)
@@ -243,6 +283,11 @@ class ChipQuarantine:
         rec.reason = ""
         rec.flips.clear()
         rec.errors.clear()
+        chips = self._active.get(rec.node)
+        if chips is not None:
+            chips.discard(rec.chip)
+            if not chips:
+                del self._active[rec.node]
 
     def _notify(self, node: str) -> None:
         if self._on_change is not None:

@@ -69,13 +69,17 @@ class FakeKube(KubeClient):
         self._rv += 1
         return str(self._rv)
 
-    def _journal_append(self, event: str, pod: dict) -> None:
-        """Under self._lock: stamp the pod's rv, journal the event, wake
-        watchers.  The journal keeps its OWN copy — watchers and callers
-        receive separate snapshots they are free to mutate; a shared
-        dict would let them rewrite journal history retroactively."""
-        rv = int(pod.setdefault("metadata", {}).get("resourceVersion", "0"))
-        self._journal.append((rv, event, _copy(pod)))
+    def _journal_append(self, event: str, snapshot: dict) -> None:
+        """Under self._lock: journal the event, wake watchers.
+        ``snapshot`` must be a copy already detached from the stored
+        object — the journal keeps that same snapshot, and direct
+        watch_pods subscribers receive it too (informers treat events as
+        read-only, like a real client's decoded response); a caller that
+        needs a mutable copy owns making one.  watch_pods_events
+        replayers still get per-yield copies, so journal history cannot
+        be rewritten through the REST-shaped surface."""
+        rv = int(snapshot.get("metadata", {}).get("resourceVersion", "0"))
+        self._journal.append((rv, event, snapshot))
         if len(self._journal) > JOURNAL_LIMIT:
             drop = len(self._journal) - JOURNAL_LIMIT
             self._compacted_below = self._journal[drop - 1][0]
@@ -101,21 +105,23 @@ class FakeKube(KubeClient):
             self._pods[key] = pod
             watchers = list(self._pod_watchers)
             snapshot = _copy(pod)
-            self._journal_append("ADDED", pod)
+            self._journal_append("ADDED", snapshot)
         for w in watchers:
             w("ADDED", snapshot)
         return snapshot
 
     def delete_pod(self, namespace: str, name: str) -> None:
+        snapshot = None
         with self._lock:
             pod = self._pods.pop(f"{namespace}/{name}", None)
             watchers = list(self._pod_watchers)
             if pod is not None:
                 pod["metadata"]["resourceVersion"] = self._next_rv()
-                self._journal_append("DELETED", pod)
-        if pod is not None:
+                snapshot = _copy(pod)
+                self._journal_append("DELETED", snapshot)
+        if snapshot is not None:
             for w in watchers:
-                w("DELETED", _copy(pod))
+                w("DELETED", snapshot)
 
     def watch_pods(self, fn: Callable[[str, dict], None]) -> None:
         with self._lock:
@@ -214,7 +220,7 @@ class FakeKube(KubeClient):
             pod["metadata"]["resourceVersion"] = self._next_rv()
             snapshot = _copy(pod)
             watchers = list(self._pod_watchers)
-            self._journal_append("MODIFIED", pod)
+            self._journal_append("MODIFIED", snapshot)
         for w in watchers:
             w("MODIFIED", snapshot)
         return snapshot

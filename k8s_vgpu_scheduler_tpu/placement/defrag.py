@@ -411,7 +411,14 @@ class Defragmenter:
         """One defrag pass: expire reservations, progress in-flight
         plans, then plan at most ONE new compaction (single-writer over
         the fleet's movable set keeps plans from fighting each other).
-        Returns the actions taken (tests, the simulator report)."""
+        Returns the actions taken (tests, the simulator report).
+        Timed into the ``defrag-tick`` perf ring (util/perf.py)."""
+        from ..util import perf
+
+        with perf.phase_timer("defrag-tick"):
+            return self._tick()
+
+    def _tick(self) -> List[dict]:
         now = self._clock()
         actions: List[dict] = []
         res = self.s.reservations

@@ -84,7 +84,15 @@ class AdmissionLoop:
     def tick(self, now: Optional[float] = None) -> List[dict]:
         """One full admission pass; returns the actions taken (the
         observable record for tests, /queuez consumers and the
-        simulator's queueing report)."""
+        simulator's queueing report).  Timed into the ``quota-tick``
+        perf ring (util/perf.py) — part of the per-tick breakdown the
+        performance observatory reports on /perfz."""
+        from ..util import perf
+
+        with perf.phase_timer("quota-tick"):
+            return self._tick(now)
+
+    def _tick(self, now: Optional[float] = None) -> List[dict]:
         mgr = self.s.quota
         if not mgr.enabled:
             return []
@@ -100,16 +108,36 @@ class AdmissionLoop:
             return []
         now = self._clock() if now is None else now
         actions: List[dict] = []
-        pods = self.s.pods.list_pods()
-        granted_uids = {p.uid for p in pods}
-        mgr.prune(granted_uids, now)
+        # Usage and the fleet throttle come from the registries'
+        # incremental aggregates — at 100k live pods the former
+        # list_pods + per-pod grant_chips walk made every tick a 0.2s
+        # stall in the steady-storm phase breakdown (/perfz quota-tick,
+        # ISSUE 12).  The reclaim pass still lists pods, but only on the
+        # rare tick where a reclaim trigger actually fires.
+        registry = self.s.pods
+        is_granted = registry.get
+        mgr.prune_with(lambda uid: is_granted(uid) is not None, now)
         self._retry_unwritten_releases(mgr, actions)
 
-        usage = mgr.usage(pods)
+        # One-instant snapshot: aggregates AND granted membership under
+        # a single lock hold (ns_usage_snapshot).  A live is_granted
+        # probe here would race the watch thread — a grant recorded
+        # between the aggregate read and the probe lands in neither
+        # term and transiently understates the queue's usage.
+        # Membership is only ever asked about ADMITTED entries, so only
+        # their uids are probed — O(entries), not an O(pods) set copy.
+        # An entry admitted after this entries() snapshot probes False
+        # (counted as admitted-not-granted: conservative, self-heals
+        # next tick — same direction as before).
+        entries = mgr.entries()
+        admitted_uids = [e.uid for e in entries
+                         if e.state == STATE_ADMITTED]
+        ns_usage, granted = registry.ns_usage_snapshot(admitted_uids)
+        usage = mgr.usage_from(ns_usage, granted.__contains__)
         fleet_cap = self._fleet_chip_cap()
-        outstanding = sum(grant_chips(p)[0] for p in pods)
-        for e in mgr.entries():
-            if e.state == STATE_ADMITTED and e.uid not in granted_uids:
+        outstanding = registry.total_chips()
+        for e in entries:
+            if e.state == STATE_ADMITTED and e.uid not in granted:
                 outstanding += e.chips
 
         effs = None
@@ -146,7 +174,7 @@ class AdmissionLoop:
                 break
 
         if self.cfg.reclaim:
-            self._reclaim_pass(usage, blocked, pods, actions, now)
+            self._reclaim_pass(usage, blocked, actions, now)
 
         self._publish_positions(actions)
         return actions
@@ -161,10 +189,9 @@ class AdmissionLoop:
         releasing (or backfilling) against them would just move pods
         into the Filter to bounce off the stripped snapshot — and, for
         the backfill rule, fill the very hole compaction opened."""
-        nodes = self.s.nodes.list_nodes()
-        if not nodes:
+        if self.s.nodes.count() == 0:
             return None
-        chips = sum(len(info.devices) for info in nodes.values())
+        chips = self.s.nodes.total_chips()
         reservations = getattr(self.s, "reservations", None)
         reserved = reservations.total_chips() if reservations else 0
         return max(0.0, chips * self.cfg.fleet_headroom - reserved)
@@ -364,7 +391,7 @@ class AdmissionLoop:
                 pass
 
     # -- reclaim ---------------------------------------------------------------
-    def _reclaim_pass(self, usage, blocked, pods, actions,
+    def _reclaim_pass(self, usage, blocked, actions,
                       now: float) -> None:
         """Starved in-quota queues take back borrowed grants.  Two
         triggers: the release loop could not admit an entitled head
@@ -373,8 +400,11 @@ class AdmissionLoop:
         needs).  Victim selection is reclaim.plan_reclaim; execution
         reuses the scheduler's preemption request path, so throttling,
         the requester→victims ledger and rescission on placement all
-        come for free."""
+        come for free.  The full pod list (victim candidates) is fetched
+        only once a trigger actually fires — the common no-reclaim tick
+        never walks the registry."""
         mgr = self.s.quota
+        pods = None
         for qname, q in mgr.queues.items():
             u = usage.get(qname, QueueUsage())
             if now - self._last_reclaim.get(qname, float("-inf")) \
@@ -410,6 +440,8 @@ class AdmissionLoop:
                 held_excl -= entry.chips
             if held_excl + demand > q.nominal_chips:
                 continue  # the pod itself would borrow; not a reclaim case
+            if pods is None:
+                pods = self.s.pods.list_pods()
             protected = {
                 uid for g in self.s.gangs.groups().values()
                 for uid in (*g.members, *g.placements)

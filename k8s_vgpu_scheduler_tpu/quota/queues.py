@@ -16,11 +16,11 @@ wrote, and granted usage from the registry like everything else).
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
 from ..k8s.client import pod_annotations, pod_name, pod_namespace, pod_uid
+from ..util import perf
 from ..util.types import ASSIGNED_NODE_ANNOTATION
 
 #: Written by the webhook on governed pods: the capacity queue name.
@@ -199,7 +199,10 @@ class QuotaManager:
             for ns in q.namespaces:
                 self._by_ns[ns] = q
         self._clock = clock or time.monotonic
-        self._lock = threading.Lock()
+        # TimedLock (util/perf.py): wait/hold telemetry under
+        # lock="quota" on /perfz — the gate rides every governed
+        # decision and races the admission tick.
+        self._lock = perf.TimedLock("quota")
         self._entries: Dict[str, QueueEntry] = {}
         #: Lifetime released count per queue (vtpu_queue_admitted_total).
         self.admitted_total: Dict[str, int] = {
@@ -395,10 +398,17 @@ class QuotaManager:
         """Drop entries whose pod placed (now charged via the registry)
         or that went stale (no sight past ENTRY_TTL_S — no-watch mode's
         unobservable deletes)."""
+        self.prune_with(granted_uids.__contains__, now)
+
+    def prune_with(self, is_granted, now: Optional[float] = None) -> None:
+        """:meth:`prune` with a membership test instead of a
+        materialized uid set — the admission tick probes the pod
+        registry directly (entries are few; building a 100k-uid set per
+        tick was measurable in the steady-storm phase breakdown)."""
         now = self._clock() if now is None else now
         with self._lock:
             for uid in [u for u, e in self._entries.items()
-                        if (e.state == STATE_ADMITTED and u in granted_uids)
+                        if (e.state == STATE_ADMITTED and is_granted(u))
                         or now - e.last_seen > ENTRY_TTL_S]:
                 del self._entries[uid]
                 self._release_unwritten.discard(uid)
@@ -422,6 +432,26 @@ class QuotaManager:
         with self._lock:
             for e in self._entries.values():
                 if e.state == STATE_ADMITTED and e.uid not in granted:
+                    out[e.queue].chips += e.chips
+                    out[e.queue].mem_mib += e.mem_mib
+        return out
+
+    def usage_from(self, ns_usage, is_granted) -> Dict[str, QueueUsage]:
+        """:meth:`usage` from the pod registry's incremental
+        per-namespace aggregates (PodManager.ns_usage_snapshot) plus a
+        granted-uid probe, instead of a full pod-list walk — same
+        accounting, O(live namespaces + entries) per tick.  The
+        steady-storm bench's quota-tick phase ring is what priced the
+        O(pods) version out (ISSUE 12)."""
+        out = {name: QueueUsage() for name in self.queues}
+        for ns, (chips, mem) in ns_usage.items():
+            q = self._by_ns.get(ns)
+            if q is not None:
+                out[q.name].chips += chips
+                out[q.name].mem_mib += mem
+        with self._lock:
+            for e in self._entries.values():
+                if e.state == STATE_ADMITTED and not is_granted(e.uid):
                     out[e.queue].chips += e.chips
                     out[e.queue].mem_mib += e.mem_mib
         return out

@@ -57,7 +57,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..placement.mesh import MESH_ANNOTATION
-from ..util import trace
+from ..util import perf, trace
 from ..util.types import QOS_ANNOTATION, ContainerDevice
 from . import score as score_mod
 
@@ -88,6 +88,9 @@ class BatchJob:
     #: Created lazily by the gate (filter_many resolves synchronously).
     done: Optional[threading.Event] = None
     result: Optional[object] = None   # FilterResult, set by the leader
+    #: Monotonic stamp at routing time — the cycle's drain-age gauge
+    #: (how long the oldest pod waited for its tick) reads these.
+    enqueued_at: float = 0.0
 
 
 class ColumnarFleet:
@@ -112,6 +115,10 @@ class ColumnarFleet:
         #: the commit never happened (a lost revision race must not
         #: leave phantom grants in the columnar view).
         self.touched: Set[int] = set()
+        #: Lifetime full-rebuild count (node-set membership changes or a
+        #: chip-pad overflow); decide_many reads the delta to split the
+        #: columnar-refresh phase into full-rebuild vs incremental.
+        self.rebuilds = 0
         #: row -> the snapshot generation key the last group commit
         #: published for it.  When the next snapshot's entry carries
         #: exactly this key, the entry's usage IS the columnar state
@@ -192,6 +199,7 @@ class ColumnarFleet:
         return reloaded
 
     def _rebuild(self, snap: Dict[str, object]) -> None:
+        self.rebuilds += 1
         names = sorted(snap)
         c = max((len(e.usage) for e in snap.values()), default=1)
         self._alloc(len(names), max(1, c))
@@ -822,12 +830,14 @@ class BatchEngine:
         job.done = threading.Event()
         with self._qlock:
             self._queue.append(job)
+            depth = len(self._queue)
             lead = not self._leader_active
             if lead:
                 self._leader_active = True
                 self._full.clear()
-            elif len(self._queue) >= cfg.batch_max:
+            elif depth >= cfg.batch_max:
                 self._full.set()
+        perf.registry().set_gauge("pending_queue_depth", depth)
         if not lead:
             job.done.wait()
             return job.result
@@ -841,6 +851,11 @@ class BatchEngine:
                     del self._queue[:len(batch)]
                     if not batch:
                         self._leader_active = False
+                        # Queue drained: nothing is waiting, so the
+                        # drain-age figure (a CURRENT wait) is zero.
+                        reg = perf.registry()
+                        reg.set_gauge("pending_queue_depth", 0)
+                        reg.set_gauge("drain_age_s", 0.0)
                         break
                 results = self.decide_many(batch)
                 for j, r in zip(batch, results):
@@ -869,6 +884,18 @@ class BatchEngine:
 
         t0 = time.monotonic()
         tr = trace.tracer()
+        reg = perf.registry()
+        # Drain age: how long the oldest pod of this cycle waited
+        # between routing and its tick (the gate wait + backlog depth
+        # made visible — a growing age means ticks can't keep up).
+        # The figure is a CURRENT wait, so /perfz must not report the
+        # last storm's age next to an empty queue indefinitely: the
+        # gate leader zeroes it when its queue drains, and filter_many
+        # zeroes it after its batched chunks complete.
+        oldest = min((j.enqueued_at for j in jobs if j.enqueued_at),
+                     default=0.0)
+        reg.set_gauge("drain_age_s", t0 - oldest if oldest else 0.0)
+        phases: Dict[str, float] = {}
         ranks = self.fair_share_ranks(jobs)
         results: List[Optional[object]] = [None] * len(jobs)
         fallback: set = set()
@@ -876,9 +903,22 @@ class BatchEngine:
         conflicts = 0
         with self._cycle_lock, \
                 tr.span("batch-cycle", pods=len(jobs)) as sp:
+            pt = time.monotonic()
             snap = self.s.snapshot()
-            self.fleet.refresh(snap)
+            phases["snapshot"] = time.monotonic() - pt
+            # Columnar refresh, split full-rebuild vs incremental (the
+            # roadmap's "rebuilds must stay O(changed rows)" watchpoint:
+            # a steady state spending its ticks in columnar-rebuild is
+            # the regression this phase exists to catch).
+            pt = time.monotonic()
+            rebuilds_before = self.fleet.rebuilds
+            reloaded = self.fleet.refresh(snap)
             self._gate_rows()
+            refresh_s = time.monotonic() - pt
+            full = self.fleet.rebuilds != rebuilds_before
+            phases["columnar-rebuild" if full
+                   else "columnar-refresh"] = refresh_s
+            reg.set_gauge("columnar_rows_reloaded", reloaded)
             vector: List[int] = []
             slices: List[int] = []
             for i, job in enumerate(jobs):
@@ -900,7 +940,9 @@ class BatchEngine:
             plan: List[Optional[Tuple[int, List[int], List[int]]]] = \
                 [None] * len(jobs)
             if slices:
+                pt = time.monotonic()
                 self._place_slices(jobs, slices, ranks, plan)
+                phases["slice-stage"] = time.monotonic() - pt
                 for i in slices:
                     if plan[i] is None:
                         fallback.add(i)
@@ -909,13 +951,19 @@ class BatchEngine:
             # Vector evaluation runs AFTER the slice stage: the slice
             # grants are charged into the columnar fleet, so the class
             # matrices already price them in.
+            pt = time.monotonic()
             cohorts = self._build_cohorts(jobs, vector, ranks)
+            phases["vector-eval"] = time.monotonic() - pt
+            pt = time.monotonic()
             vplan = solve(self.fleet, cohorts, len(jobs),
                           self.s.cfg.batch_solver)
+            phases["solve"] = time.monotonic() - pt
             for i in vector:
                 plan[i] = vplan[i]
+            pt = time.monotonic()
             committed, lost = self._commit(
                 snap, jobs, vector + slices, plan)
+            phases["group-commit"] = time.monotonic() - pt
             conflicts = len(lost)
             if lost:
                 reasons["commit-conflict"] = \
@@ -934,6 +982,7 @@ class BatchEngine:
         # optimistic protocol (fresh snapshot — which already includes
         # this cycle's grants — conflict retries, preemption planning,
         # per-node failure reasons).
+        ft = time.monotonic()
         for i in sorted(fallback, key=lambda i: ranks[i]):
             job = jobs[i]
             with tr.span("batch-fallback", trace_id=job.trace_id,
@@ -948,8 +997,21 @@ class BatchEngine:
                     reasons["error"] = reasons.get("error", 0) + 1
                     results[i] = FilterResult(
                         error=f"batch fallback failed: {e}")
-        self.stats.record(len(jobs), time.monotonic() - t0,
-                          len(fallback), conflicts, reasons)
+        if fallback:
+            phases["fallback"] = time.monotonic() - ft
+        total = time.monotonic() - t0
+        self.stats.record(len(jobs), total, len(fallback), conflicts,
+                          reasons)
+        # Per-cycle breakdown into the performance observatory: each
+        # phase's ring (cross-cycle quantiles) + the tick journal (the
+        # /perfz slow-tick table with this cycle's split), plus the
+        # cycle total the VtpuSchedulerTickStall alert watches.
+        if reg.enabled:
+            for name, seconds in phases.items():
+                reg.phase(name).record(seconds)
+            reg.phase("cycle-total").record(total)
+            reg.note_tick("batch-cycle", total, phases, pods=len(jobs),
+                          fallbacks=len(fallback), conflicts=conflicts)
         return [r if r is not None
                 else FilterResult(error="batch cycle produced no decision")
                 for r in results]
@@ -983,16 +1045,18 @@ class BatchEngine:
         fleet = self.fleet
         leases = self.s.leases
         shards = self.s.shards
+        # Bulk lease gate: one lock acquisition for the whole row set
+        # (the per-node reject_reason call cost N acquires per cycle at
+        # fleet scale — ISSUE 12's overhead budget).
+        lease_ok = leases.alive_map(fleet.names)
         if shards.enabled:
             # placeable() fails closed when no shard map has been
             # observed yet — an enabled-but-blind replica gates out the
             # whole fleet, same as the per-pod paths' shard-no-map.
-            fleet.alive = [shards.placeable(name)
-                           and leases.reject_reason(name) is None
-                           for name in fleet.names]
+            fleet.alive = [ok and shards.placeable(name)
+                           for ok, name in zip(lease_ok, fleet.names)]
         else:
-            fleet.alive = [leases.reject_reason(name) is None
-                           for name in fleet.names]
+            fleet.alive = lease_ok
         if self.s.cfg.score_by_actual:
             from ..accounting import efficiency as eff_mod
             fleet.bonus = [
@@ -1087,14 +1151,24 @@ class BatchEngine:
             cohort.jobs.append((ranks[i], i))
         return list(cohorts.values())
 
+    #: Node groups committed per commit-lock acquire.  One acquire per
+    #: GROUP made the instrumented commit + usage-cache locks the
+    #: largest line of the ISSUE 12 overhead A/B at one-pod-per-node
+    #: shapes; chunking amortizes both to 1/16 per group while keeping
+    #: each hold short enough not to convoy the optimistic path.
+    COMMIT_CHUNK = 16
+
     def _commit(self, snap, jobs: List[BatchJob], vector: List[int],
                 plan) -> Tuple[Dict[int, object], List[int]]:
         """Per-node-group optimistic commit: one rev validation per node,
-        then the group's grants inserted as an unbroken pod-rev chain and
-        published as a single usage delta.  A node whose generation moved
-        (or whose chain an interleaved informer event broke) sends its
-        whole remaining group to the per-pod fallback — the protocol's
-        conflict semantics, amortized."""
+        then the group's grants inserted as an unbroken pod-rev chain
+        (``PodManager.add_pods_group`` — the whole group under one
+        registry acquire, so an informer event can never break the chain
+        mid-group) and published as a single usage delta.  A node whose
+        generation moved sends its whole group to the per-pod fallback —
+        the protocol's conflict semantics, amortized.  Groups commit in
+        chunks of :data:`COMMIT_CHUNK` per commit-lock acquire with one
+        usage-cache publish per chunk."""
         from .core import FilterResult
         from .pods import PodInfo
 
@@ -1105,56 +1179,55 @@ class BatchEngine:
                 groups.setdefault(plan[i][0], []).append(i)
         committed: Dict[int, object] = {}
         lost: List[int] = []
-        for row, members in groups.items():
-            node = self.fleet.names[row]
-            entry = snap[node]
-            placed: List[int] = []
-            placements: List[list] = []
+        group_items = list(groups.items())
+        for at in range(0, len(group_items), self.COMMIT_CHUNK):
+            chunk = group_items[at:at + self.COMMIT_CHUNK]
+            publishes: List[tuple] = []
             with s._commit_lock:
-                live = (s.pods.rev_of(node), s.nodes.rev_of(node))
-                if live != entry.key:
-                    lost.extend(members)
-                    continue
-                expected = entry.key[0]
-                for i in members:
-                    job = jobs[i]
-                    _row, chips, mems = plan[i]
-                    placement = [[
-                        ContainerDevice(
-                            uuid=self.fleet.chip_ids[row][c],
-                            type=self.fleet.chip_types[row][c],
-                            usedmem=m,
-                            usedcores=job.requests[0].coresreq)
-                        for c, m in zip(chips, mems)]]
-                    rev = s.pods.add_pod(PodInfo(
-                        uid=job.uid, name=job.name,
-                        namespace=job.namespace, node=node,
-                        devices=placement, priority=job.priority,
-                        trace_id=job.trace_id,
-                        qos=job.anns.get(QOS_ANNOTATION, "") or ""))
-                    if rev != expected + 1:
-                        # An informer event interleaved inside the held
-                        # lock (it doesn't exclude the watch thread): the
-                        # chain is broken — undo this grant and conflict
-                        # the rest of the group.
-                        s.pods.del_pod(job.uid)
-                        done = set(placed)
-                        lost.extend(m for m in members if m not in done)
-                        break
-                    expected = rev
-                    placed.append(i)
-                    placements.append(placement)
-                if placements:
-                    s._publish_grants(node, entry, placements, expected)
-                    if len(placed) == len(members):
-                        # Every planned grant on this row committed: the
-                        # columnar mirrors equal the usage the publish
-                        # just cached under this generation, so the next
-                        # refresh can adopt the new entry reload-free.
-                        self.fleet.expected_key[row] = (expected,
-                                                        entry.key[1])
-            for i in placed:
-                committed[i] = FilterResult(node=node)
+                for row, members in chunk:
+                    node = self.fleet.names[row]
+                    entry = snap[node]
+                    live = (s.pods.rev_of(node), s.nodes.rev_of(node))
+                    if live != entry.key:
+                        lost.extend(members)
+                        continue
+                    infos: List[PodInfo] = []
+                    placements: List[list] = []
+                    for i in members:
+                        job = jobs[i]
+                        _row, chips, mems = plan[i]
+                        placement = [[
+                            ContainerDevice(
+                                uuid=self.fleet.chip_ids[row][c],
+                                type=self.fleet.chip_types[row][c],
+                                usedmem=m,
+                                usedcores=job.requests[0].coresreq)
+                            for c, m in zip(chips, mems)]]
+                        infos.append(PodInfo(
+                            uid=job.uid, name=job.name,
+                            namespace=job.namespace, node=node,
+                            devices=placement, priority=job.priority,
+                            trace_id=job.trace_id,
+                            qos=job.anns.get(QOS_ANNOTATION, "") or ""))
+                        placements.append(placement)
+                    final = s.pods.add_pods_group(infos, node,
+                                                  entry.key[0])
+                    if final is None:
+                        # An informer event bumped the node between the
+                        # rev check and the bulk insert: nothing was
+                        # added — conflict the whole group.
+                        lost.extend(members)
+                        continue
+                    publishes.append((node, entry, placements, final))
+                    # Every planned grant on this row committed: the
+                    # columnar mirrors equal the usage the publish
+                    # caches under this generation, so the next refresh
+                    # can adopt the new entry reload-free.
+                    self.fleet.expected_key[row] = (final, entry.key[1])
+                    for i in members:
+                        committed[i] = FilterResult(node=node)
+                if publishes:
+                    s._publish_grants_many(publishes)
         if lost:
             with s._busy_lock:
                 s.commit_conflicts += len(lost)

@@ -46,7 +46,7 @@ from ..quota.queues import QuotaManager
 from ..shard import commit as shard_commit
 from ..shard.shardmap import ShardConfig, ShardManager
 from ..tpulib.types import TopologyDesc
-from ..util import codec, trace
+from ..util import codec, perf, trace
 from ..util.config import Config
 from ..util.decisionwriter import DecisionBatcher
 from ..util.nodelock import NodeLockError, lock_node, release_node
@@ -147,6 +147,14 @@ class Scheduler:
                  clock=None) -> None:
         self.client = client
         self.cfg = cfg or Config()
+        # Performance observatory (util/perf.py; docs/observability.md
+        # "Performance observatory"): process-global like the tracer —
+        # phase rings, lock wait/hold telemetry, /perfz.  The enable
+        # switch is config-driven so the bench A/B (and --no-perf) can
+        # run the uninstrumented baseline.
+        perf.registry().enabled = self.cfg.perf_enabled
+        if self.cfg.perf_tracemalloc:
+            perf.registry().enable_tracemalloc()
         self.nodes = NodeManager()
         self.pods = PodManager()
         self.gangs = GangManager()
@@ -259,13 +267,19 @@ class Scheduler:
         # the still-serialized gang admissions and the serial-baseline
         # decide).  Never held across apiserver I/O, candidate
         # evaluation, preemption planning or gang-expiry sweeps.
-        self._commit_lock = threading.Lock()
+        # TimedLock: wait/hold telemetry on /perfz and
+        # vtpu_lock_wait_seconds{lock="commit"} — the one lock whose
+        # hold time bounds every concurrent decision's tail.  1-in-4
+        # sampled: it is acquired once per decision, and the sample
+        # keeps the distribution while shaving the per-acquire clocks.
+        self._commit_lock = perf.TimedLock("commit", sample_shift=2)
         # get_nodes_usage per-node base-usage cache, keyed on (pod rev,
         # inventory rev); its own lock because the watch thread's pod
         # events race Filter calls.  The cached usage maps are IMMUTABLE
         # once published (rebuilds replace, never mutate) — that is what
         # lets snapshot() hand them out lock-free.
-        self._usage_cache_lock = threading.Lock()
+        self._usage_cache_lock = perf.TimedLock("snapshot-cache",
+                                                sample_shift=2)
         self._usage_cache: Dict[str, tuple] = {}
         # Published full-fleet snapshot dict (name -> SnapEntry), replaced
         # wholesale whenever drain_dirty reports changed nodes — readers
@@ -309,6 +323,7 @@ class Scheduler:
         self._deleted_uids: Dict[str, float] = {}
         self._deleted_lock = threading.Lock()
         self._deleted_horizon_s = 900.0
+        self._deleted_pruned_at = 0.0
         # victim uid -> monotonic time of the last preempt annotation
         # (throttles re-patching while the victim checkpoints).
         self._preempt_requested: Dict[str, float] = {}
@@ -328,12 +343,27 @@ class Scheduler:
         # long run, never unbounded growth.
         self._alloc_traced: set = set()
         self._alloc_traced_lock = threading.Lock()
+        # Informer event counter (1-in-8 sampling for the
+        # informer-apply timing — see on_pod_event).  Benign races on
+        # the increment cost a sample, never correctness.
+        self._informer_events = 0
 
     def _note_deleted(self, uid: str) -> None:
+        """Tombstone one deleted uid.  The prune is throttled to once
+        per minute: under a sustained completion storm nothing in the
+        map is older than the horizon anyway, and the previous
+        scan-on-every-insert made each DELETE O(tombstones) — a
+        quadratic blowup the steady-state bench caught (completions
+        alone ate the round budget at 4k deletes/round; STEADY_r07 /
+        ISSUE 12).  Entries younger than the horizon must be kept
+        regardless, so throttling the scan changes peak memory only by
+        one minute's deletes."""
         now = time.monotonic()
-        cutoff = now - self._deleted_horizon_s
         with self._deleted_lock:
-            if len(self._deleted_uids) > 4096:
+            if len(self._deleted_uids) > 4096 and \
+                    now - self._deleted_pruned_at >= 60.0:
+                self._deleted_pruned_at = now
+                cutoff = now - self._deleted_horizon_s
                 for u in [u for u, t in self._deleted_uids.items()
                           if t < cutoff]:
                     del self._deleted_uids[u]
@@ -359,6 +389,7 @@ class Scheduler:
         the usage snapshot fleet-wide every beat interval.  ``usage`` is
         the message's piggybacked accounting counters (USAGE_FIELDS rows)
         — absorbed into the ledger, never touching the snapshot path."""
+        t0 = time.monotonic()
         self.leases.beat(node_name)
         self.quarantine.observe_node(
             node_name, {d.id: d.health for d in info.devices})
@@ -368,6 +399,7 @@ class Scheduler:
             self.nodes.add_node(node_name, info)
             log.info("registered node %s with %d chips", node_name,
                      len(info.devices))
+        perf.registry().record("register-apply", time.monotonic() - t0)
 
     def handle_register_stream(self, request_iterator, context=None) -> str:
         """Consume one node agent's stream; on disconnect, drop the node
@@ -392,7 +424,27 @@ class Scheduler:
     # -- pod informer ----------------------------------------------------------
     def on_pod_event(self, event: str, pod: dict) -> None:
         """Rebuildable state: decode assigned-ids of every scheduled pod
-        (reference onAddPod, scheduler.go:66–86)."""
+        (reference onAddPod, scheduler.go:66–86).  Timed into the
+        ``informer-apply`` perf ring, 1-in-perf.INFORMER_SAMPLE_EVERY
+        sampled (the event path runs per apiserver event — clocks on
+        every one would be the single largest instrumentation cost; the
+        ring wants a recent latency distribution, which a thinned
+        sample preserves): its recent p99 is the exported informer
+        apply-latency figure (vtpu_informer_lag_seconds — see
+        perf.informer_lag_s for what is and is not included)."""
+        n = self._informer_events
+        self._informer_events = n + 1
+        reg = perf.registry()
+        if not reg.enabled or n & (perf.INFORMER_SAMPLE_EVERY - 1):
+            self._apply_pod_event(event, pod)
+            return
+        t0 = time.monotonic()
+        try:
+            self._apply_pod_event(event, pod)
+        finally:
+            reg.record("informer-apply", time.monotonic() - t0)
+
+    def _apply_pod_event(self, event: str, pod: dict) -> None:
         uid = pod_uid(pod)
         if not uid:
             return
@@ -482,9 +534,9 @@ class Scheduler:
         # The MODIFIED event for the scheduler's own decision-write (or a
         # resync replay) carries exactly the grant already registered:
         # refresh liveness in place so the no-op does not invalidate the
-        # node's usage snapshot.
-        if not self.pods.refresh_if_unchanged(info):
-            self.pods.add_pod(info)
+        # node's usage snapshot.  One combined acquire (upsert), not a
+        # probe-then-add pair — this path runs per apiserver event.
+        self.pods.upsert(info)
         if event == "ADDED" and self._deleted_since(uid) is not None:
             # Closes the check-then-add race with the watch thread: a
             # DELETE that landed between the pre-check above and add_pod
@@ -544,6 +596,15 @@ class Scheduler:
         gang member, tombstone a live uid.  Hence the ``touched_at`` guard,
         and no tombstone from this path (tombstones are for real informer
         DELETEs, where the uid can never return)."""
+        resync_t0 = time.monotonic()
+        try:
+            return self._resync_from_apiserver()
+        finally:
+            cost = time.monotonic() - resync_t0
+            perf.registry().record("informer-resync", cost)
+            perf.registry().set_gauge("informer_resync_last_s", cost)
+
+    def _resync_from_apiserver(self) -> str:
         list_started = time.monotonic()
         try:
             pods, rv = self.client.list_pods_with_rv()
@@ -786,6 +847,7 @@ class Scheduler:
         scheduler lock (registry list + the quota manager's own).
         ``quota_stats`` lets export_capacity share one stats snapshot
         instead of walking the registry twice per export."""
+        tick_t0 = time.monotonic()
         now = self._clock() if now is None else now
         samples: Dict[str, float] = {}
         if self.quota.enabled:
@@ -801,6 +863,8 @@ class Scheduler:
                     samples[p.namespace] = \
                         samples.get(p.namespace, 0.0) + chips
         self.capacity.observe_queues(samples, now)
+        perf.registry().record("capacity-tick",
+                               time.monotonic() - tick_t0)
         return samples
 
     def export_capacity(self, horizon_s: Optional[float] = None,
@@ -843,6 +907,32 @@ class Scheduler:
             horizon_s=horizon_s
             if horizon_s is not None else self.cfg.capacity_horizon_s,
             detail=detail)
+
+    def export_perf(self, top_ticks: int = 8) -> dict:
+        """Control-plane performance observatory (``GET /perfz`` →
+        operators and the steady-state bench artifact): per-phase
+        p50/p99/max over recent ring windows, the lock wait/hold table,
+        informer lag/resync cost, pending-queue depth and drain age, GC
+        pressure, decision-write group-commit amortization, and the
+        top-N slowest recent ticks with their phase splits
+        (docs/observability.md "Performance observatory").  Reads only
+        the process-global perf registry and this instance's counters —
+        never a scheduler lock."""
+        doc = perf.registry().export(top_ticks=top_ticks)
+        batcher = self._decisions
+        doc["decision_writer"] = {
+            "batches": batcher.batches,
+            "writes": batcher.writes,
+            "amortization": round(batcher.writes / batcher.batches, 3)
+            if batcher.batches else 0.0,
+        }
+        doc["queue"]["pending_depth"] = len(self.batch._queue)
+        doc["counters"] = {
+            "commit_conflicts": self.commit_conflicts,
+            "batch_cycles": self.batch.stats.cycles,
+            "batch_fallbacks": self.batch.stats.fallbacks,
+        }
+        return doc
 
     def export_fleet(self) -> dict:
         """Read-only fleet snapshot for capacity tooling (``GET /fleetz``
@@ -946,20 +1036,46 @@ class Scheduler:
             self._release_expired_gangs()
         results: List[Optional[FilterResult]] = [None] * len(items)
         batched: List[Tuple[int, "BatchJob"]] = []
+        # Stale decisions of batch-routed pods drop in BULK (one lock
+        # acquisition) instead of per pod — but always BEFORE the next
+        # decision that could read them: flushed ahead of every inline
+        # per-pod filter in the drain (a later pod must not see an
+        # earlier routed pod's stale grant still charged, or a full
+        # node reads as fuller and can trigger spurious preemption),
+        # and once after routing for the all-batchable common case.
+        stale_uids: List[str] = []
+        drain_t0 = time.monotonic()
         for i, (pod, node_names) in enumerate(items):
             routed = self._route_batch(pod, node_names)
             if isinstance(routed, FilterResult):
                 results[i] = self._finish_decision(pod, routed)
             elif routed is None:
+                if stale_uids:
+                    self.pods.del_pods(stale_uids)
+                    stale_uids.clear()
                 results[i] = self.filter(pod, node_names)
             else:
                 batched.append((i, routed))
+                stale_uids.append(routed.uid)
+        if stale_uids:
+            self.pods.del_pods(stale_uids)
+        # The drain phase: parsing + routing the backlog into batch
+        # jobs (includes any inline per-pod decisions the router made).
+        perf.registry().record("drain", time.monotonic() - drain_t0)
         step = max(1, self.cfg.batch_max)
         for at in range(0, len(batched), step):
             chunk = batched[at:at + step]
             decided = self.batch.decide_many([j for _i, j in chunk])
             for (i, job), res in zip(chunk, decided):
                 results[i] = self._finish_decision(job.pod, res)
+        if batched:
+            # Drain complete: every job of this backlog is decided, so
+            # the drain-age figure (a CURRENT wait) is zero again.  The
+            # per-cycle gauge set in decide_many covers mid-drain
+            # /perfz reads; without this an idle scheduler would serve
+            # the last storm's final-cycle age indefinitely (the gate
+            # leader's reset only runs on the submit path).
+            perf.registry().set_gauge("drain_age_s", 0.0)
         return results
 
     def _route_batch(self, pod: dict, node_names: List[str]):
@@ -981,7 +1097,7 @@ class Scheduler:
                 or not self._batchable(requests):
             return None
         return self._make_batch_job(pod, requests, node_names,
-                                    priority=priority)
+                                    priority=priority, del_stale=False)
 
     @staticmethod
     def _batchable(requests) -> bool:
@@ -992,22 +1108,27 @@ class Scheduler:
         return len(requests) == 1 and requests[0].nums >= 1
 
     def _make_batch_job(self, pod: dict, requests, node_names: List[str],
-                        priority: Optional[int] = None
+                        priority: Optional[int] = None,
+                        del_stale: bool = True
                         ) -> Optional["BatchJob"]:
         if priority is None:
             try:
                 priority = pod_priority(pod, self.cfg)
             except Exception:  # noqa: BLE001 — per-pod path decides
                 return None
-        # Drop any stale decision before re-placing (reference Filter
-        # calls delPod first) — same as the per-pod paths do.
-        self.pods.del_pod(pod_uid(pod))
+        if del_stale:
+            # Drop any stale decision before re-placing (reference
+            # Filter calls delPod first) — same as the per-pod paths
+            # do.  filter_many defers this to ONE bulk del_pods per
+            # drain instead (same effect before any batched decide).
+            self.pods.del_pod(pod_uid(pod))
         return BatchJob(
             pod=pod, uid=pod_uid(pod), name=pod_name(pod),
             namespace=pod_namespace(pod), trace_id=trace.trace_id_of(pod),
             requests=requests,
             anns=pod.get("metadata", {}).get("annotations", {}),
-            node_names=node_names, priority=priority)
+            node_names=node_names, priority=priority,
+            enqueued_at=time.monotonic())
 
     def _finish_decision(self, pod: dict,
                          result: FilterResult) -> FilterResult:
@@ -1067,6 +1188,13 @@ class Scheduler:
             # The member's jax.distributed process rank (stable across
             # replacements) — surfaced to the container as VTPU_GANG_RANK.
             patch[GANG_RANK_ANNOTATION] = str(rank)
+        # 1-in-4 sampled perf timing (the trace span keeps recording
+        # every write into the phase histograms; this ring only feeds
+        # /perfz's recent-window quantiles).
+        reg = perf.registry()
+        write_rec = reg.enabled and (self._decisions.writes & 3) == 0
+        if write_rec:
+            write_t0 = time.monotonic()
         with tr.span("decision-write", trace_id=tid, pod=pod_name(pod),
                      node=result.node, qos=pod_qos(pod)) as wsp:
             err: Optional[str] = None
@@ -1096,6 +1224,9 @@ class Scheduler:
                     log.error("failed to write decision for %s: %s",
                               pod_name(pod), e)
                     wsp.set("error", str(e))
+            if write_rec:
+                reg.record("decision-write",
+                           time.monotonic() - write_t0)
             if err is not None:
                 self.pods.del_pod(pod_uid(pod))
                 tr.event(pod_uid(pod), "decision-write-failed",
@@ -1345,15 +1476,19 @@ class Scheduler:
                                   pod=pod_name(pod), attempt=attempt)
                           if attempt else nullcontext())
             with retry_span:
+                eval_t0 = time.monotonic()
                 snap = self.snapshot()
                 best, failed = self._evaluate_candidates(
                     uid, requests, anns, node_names, snap)
+                perf.registry().record("opt-evaluate",
+                                       time.monotonic() - eval_t0)
             if best is None:
                 plan = self._plan_preemption(pod, requests, anns,
                                              node_names, snap)
                 return FilterResult(error="no node fits TPU request",
                                     failed=failed, preempt=plan)
             _, node, placement = best
+            commit_t0 = time.monotonic()
             with tr.span("commit", trace_id=tid, pod=pod_name(pod),
                          node=node, attempt=attempt):
                 with self._commit_lock:
@@ -1398,6 +1533,8 @@ class Scheduler:
                         conflicted = True
                         entry, placement = self._commit_refit(
                             node, requests, anns, sp)
+            perf.registry().record("opt-commit",
+                                   time.monotonic() - commit_t0)
             if conflicted:
                 with self._busy_lock:
                     self.commit_conflicts += 1
@@ -1485,6 +1622,30 @@ class Scheduler:
             # validation and add_pod; its delta is not in entry.usage —
             # leave its dirty mark to trigger the full rebuild.
             return
+        new_usage = self._grants_delta(entry, placements)
+        if new_usage is None:
+            # Unknown chip (inventory shrank mid-flight): let the dirty
+            # rebuild recompute from scratch.
+            return
+        with self._usage_cache_lock:
+            self._publish_usage_locked(node, entry, final_rev, new_usage)
+
+    def _publish_usage_locked(self, node: str, entry: SnapEntry,
+                              final_rev: int, new_usage: dict) -> None:
+        cached = self._usage_cache.get(node)
+        # Publish only if the cache still holds the exact map the
+        # grants were computed against; if a concurrent snapshot()
+        # rebuilt it meanwhile, that rebuild either already includes
+        # them or the node's dirty mark is still pending —
+        # overwriting would resurrect a superseded view.
+        if cached is not None and cached[1] is entry.usage:
+            self._usage_cache[node] = ((final_rev, entry.key[1]),
+                                       new_usage)
+
+    def _grants_delta(self, entry: SnapEntry, placements: List):
+        """The grants' combined usage delta over ``entry.usage`` (pure
+        read — no lock), or None when a chip is unknown (inventory
+        shrank mid-flight; the dirty rebuild recomputes from scratch)."""
         touched: Dict[str, score_mod.DeviceUsage] = {}
         for placement in placements:
             for container in placement:
@@ -1493,9 +1654,7 @@ class Scheduler:
                     if u is None:
                         base = entry.usage.get(d.uuid)
                         if base is None:
-                            # Unknown chip (inventory shrank mid-flight):
-                            # let the dirty rebuild recompute from scratch.
-                            return
+                            return None
                         u = score_mod.clone_usage(base)
                         touched[d.uuid] = u
                     u.used_slots += 1
@@ -1503,15 +1662,27 @@ class Scheduler:
                     u.used_cores += d.usedcores
         new_usage = dict(entry.usage)
         new_usage.update(touched)
+        return new_usage
+
+    def _publish_grants_many(self, publishes: List[Tuple]) -> None:
+        """Batched-cycle publish: every node group of one commit chunk
+        under ONE usage-cache acquire (the per-group acquire was
+        measurable against the ISSUE 12 instrumentation budget).  Each
+        item is ``(node, entry, placements, final_rev)`` with the same
+        chain proof as :meth:`_publish_grants` — the bulk
+        ``add_pods_group`` insert guarantees ``final_rev`` is the
+        validated rev plus the group size.  Deltas are computed OUTSIDE
+        the lock (pure reads of the immutable entry usage)."""
+        staged = []
+        for node, entry, placements, final_rev in publishes:
+            new_usage = self._grants_delta(entry, placements)
+            if new_usage is not None:
+                staged.append((node, entry, final_rev, new_usage))
+        if not staged:
+            return
         with self._usage_cache_lock:
-            cached = self._usage_cache.get(node)
-            # Publish only if the cache still holds the exact map the
-            # grants were computed against; if a concurrent snapshot()
-            # rebuilt it meanwhile, that rebuild either already includes
-            # them or the node's dirty mark is still pending —
-            # overwriting would resurrect a superseded view.
-            if cached is not None and cached[1] is entry.usage:
-                self._usage_cache[node] = ((final_rev, entry.key[1]),
+            for node, entry, final_rev, new_usage in staged:
+                self._publish_usage_locked(node, entry, final_rev,
                                            new_usage)
 
     def _evaluate_candidates(self, uid: str, requests, anns: Dict[str, str],

@@ -18,7 +18,7 @@ from prometheus_client.core import (
 from prometheus_client.registry import Collector
 
 from ..monitor.metrics import _fold_hist, qos_wait_family
-from ..util import trace
+from ..util import perf, trace
 from .core import Scheduler
 
 
@@ -395,6 +395,98 @@ class ClusterCollector(Collector):
             for reason, n in sorted(dict(shards.cas_failures).items()):
                 cas_failures.add_metric([reason], n)
 
+        # Control-plane performance observatory (util/perf.py;
+        # docs/observability.md "Performance observatory").  Families
+        # always emitted (zero-valued before any tick) so dashboards
+        # never reference a vanishing series; GET /perfz carries the
+        # windowed quantiles, the lock table and the slow-tick journal
+        # these cumulative series can't.
+        cycle_phase = HistogramMetricFamily(
+            "vtpu_cycle_phase_seconds",
+            "Wall-clock cost of one control-plane phase per tick "
+            "(drain, snapshot, columnar-refresh vs -rebuild, "
+            "vector-eval, solve, slice-stage, group-commit, "
+            "decision-write, decision-flush, opt-evaluate/commit, "
+            "informer-apply/-resync, register-apply, "
+            "quota/defrag/shard/capacity ticks, gc-pause, cycle-total "
+            "— where a tick's time goes; see GET /perfz for windowed "
+            "p50/p99 and the slow-tick table)",
+            labels=["phase"],
+        )
+        lock_wait = HistogramMetricFamily(
+            "vtpu_lock_wait_seconds",
+            "Time spent WAITING for a contended control-plane lock "
+            "(commit / pods / nodes / quota / leases / snapshot-cache; "
+            "uncontended acquires record nothing, and the hottest "
+            "locks observe 1-in-N sampled acquires — the count is the "
+            "sampled contention count)",
+            labels=["lock"],
+        )
+        lock_hold = HistogramMetricFamily(
+            "vtpu_lock_hold_seconds",
+            "Time a control-plane lock was HELD per acquire (sampled "
+            "1-in-N on the hottest locks; a hold distribution moving "
+            "up is the convoy precursor the wait histogram confirms)",
+            labels=["lock"],
+        )
+        lock_acquires = CounterMetricFamily(
+            "vtpu_lock_acquires",
+            "Acquires of one control-plane lock (exact; the hottest "
+            "locks observe wait/hold on 1-in-N sampled acquires, so "
+            "the contention ratio is vtpu_lock_wait_seconds_count "
+            "over the SAMPLED count — GET /perfz computes it)",
+            labels=["lock"],
+        )
+        lock_sampled = CounterMetricFamily(
+            "vtpu_lock_sampled_acquires",
+            "Acquires on which one control-plane lock's wait/hold "
+            "telemetry was observed (ceil(acquires / 2**sample_shift) — "
+            "the sampled acquire is the first of each block; the "
+            "contention-ratio denominator — dividing the wait count by "
+            "RAW acquires understates contention by the per-lock "
+            "sampling factor)",
+            labels=["lock"],
+        )
+        informer_lag = GaugeMetricFamily(
+            "vtpu_informer_lag_seconds",
+            "Pod-informer apply latency: p99 of the recent event-apply "
+            "window (callback entry -> registries updated).  The "
+            "dispatch loop is synchronous, so growth here is what "
+            "backs the watch up; transport-side queueing upstream of "
+            "the callback is not included",
+        )
+        pending_depth = GaugeMetricFamily(
+            "vtpu_pending_queue_depth",
+            "Pods queued at the batch gate awaiting their scheduling "
+            "cycle (sustained growth = ticks can't keep up with "
+            "arrivals; see drain_age_s on GET /perfz)",
+        )
+        gc_collections = CounterMetricFamily(
+            "vtpu_gc_collections",
+            "Python garbage collections in this scheduler process, by "
+            "generation (gen2 spikes stall every scheduling thread; "
+            "pause durations are the gc-pause phase of "
+            "vtpu_cycle_phase_seconds)",
+            labels=["generation"],
+        )
+        reg = perf.registry()
+        for name, ring in sorted(reg.phase_rings().items()):
+            buckets, sum_s = ring.prom()
+            cycle_phase.add_metric([name], buckets, sum_s)
+        for name, st in sorted(reg.lock_tables().items()):
+            buckets, sum_s = st.wait.prom()
+            lock_wait.add_metric([name], buckets, sum_s)
+            buckets, sum_s = st.hold.prom()
+            lock_hold.add_metric([name], buckets, sum_s)
+            lock_acquires.add_metric([name], st.acquires)
+            lock_sampled.add_metric([name], st.sampled_acquires())
+        informer_lag.add_metric([], reg.informer_lag_s())
+        pending_depth.add_metric(
+            [], len(engine._queue) if engine is not None
+            else reg.gauge("pending_queue_depth"))
+        for gen, n in enumerate(reg.gc.collections):
+            gc_collections.add_metric([str(gen)], n)
+
         batch_fallbacks = CounterMetricFamily(
             "vtpu_filter_batch_fallbacks",
             "Batched-cycle jobs resolved via the per-pod path, by cause "
@@ -579,7 +671,10 @@ class ClusterCollector(Collector):
 
         return [mem_limit, mem_alloc, shared_num, core_alloc, mem_pct,
                 pod_mem, pod_cores, preempts, conflicts, batch_size,
-                batch_lat, batch_fallbacks, pool_size, busy_peak,
+                batch_lat, batch_fallbacks, cycle_phase, lock_wait,
+                lock_hold, lock_acquires, lock_sampled, informer_lag,
+                pending_depth,
+                gc_collections, pool_size, busy_peak,
                 lease_state, leases_unhealthy, chips_quar, quarantines,
                 rescued, q_pending, q_admitted, q_share, q_borrowed,
                 q_reclaims, slice_avail, max_box, reserved,

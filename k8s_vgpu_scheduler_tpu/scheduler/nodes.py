@@ -9,10 +9,10 @@ placement.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ..tpulib.types import TopologyDesc
+from ..util import perf
 
 
 @dataclasses.dataclass
@@ -38,12 +38,19 @@ class NodeInfo:
 
 class NodeManager:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # TimedLock (util/perf.py): wait/hold telemetry under
+        # lock="nodes" on /perfz.  rev_of rides the per-commit hot path,
+        # so hold samples are 1-in-16 — contention is always counted.
+        self._lock = perf.TimedLock("nodes", sample_shift=4)
         self._nodes: Dict[str, NodeInfo] = {}
         self._rev: Dict[str, int] = {}
         # Nodes whose inventory changed since the last drain_dirty()
         # (same incremental-snapshot contract as PodManager._dirty).
         self._dirty: Set[str] = set()
+        # Fleet-wide registered chips, maintained incrementally — the
+        # admission tick's fleet-throttle read without copying the node
+        # map and re-summing 10k device lists per tick (ISSUE 12).
+        self._total_chips: int = 0
 
     def add_node(self, name: str, info: NodeInfo) -> None:
         """Each registration message carries the node's FULL inventory, so it
@@ -56,8 +63,11 @@ class NodeManager:
             self._dirty.add(name)
             existing = self._nodes.get(name)
             if existing is None or not existing.devices:
+                self._total_chips += len(info.devices) - (
+                    len(existing.devices) if existing is not None else 0)
                 self._nodes[name] = info
                 return
+            self._total_chips += len(info.devices) - len(existing.devices)
             existing.devices = list(info.devices)
             if info.topology is not None:
                 existing.topology = info.topology
@@ -91,13 +101,15 @@ class NodeManager:
         with self._lock:
             self._rev[name] = self._rev.get(name, 0) + 1
             self._dirty.add(name)
-            self._nodes.pop(name, None)
+            dropped = self._nodes.pop(name, None)
+            if dropped is not None:
+                self._total_chips -= len(dropped.devices)
 
     def rev_of(self, name: str) -> int:
-        """One node's inventory rev (same rev-before-data contract as
+        """One node's inventory rev (same rev-before-data contract —
+        and the same lock-free single-read rationale — as
         PodManager.rev_of)."""
-        with self._lock:
-            return self._rev.get(name, 0)
+        return self._rev.get(name, 0)
 
     def drain_dirty(self) -> Set[str]:
         """Return-and-clear the inventory-changed node set (see
@@ -111,9 +123,17 @@ class NodeManager:
             self._dirty.update(names)
 
     def get_node(self, name: str) -> Optional[NodeInfo]:
-        with self._lock:
-            return self._nodes.get(name)
+        # Lock-free single dict read (see PodManager.get).
+        return self._nodes.get(name)
 
     def list_nodes(self) -> Dict[str, NodeInfo]:
         with self._lock:
             return dict(self._nodes)
+
+    def count(self) -> int:
+        return len(self._nodes)
+
+    def total_chips(self) -> int:
+        """Registered chips fleet-wide (incremental; lock-free int
+        read — same single-read rationale as rev_of)."""
+        return self._total_chips

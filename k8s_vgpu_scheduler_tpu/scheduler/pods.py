@@ -9,10 +9,10 @@ the apiserver.
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
-from typing import Dict, Iterable, List, Optional, Set
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+from ..util import perf
 from ..util.types import PodDevices
 
 
@@ -49,7 +49,11 @@ class PodManager:
     flagged in SURVEY §3.1), a cost this index removes."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # TimedLock (util/perf.py): wait/hold telemetry under
+        # lock="pods" on /perfz.  add_pod/rev_of ride every decision's
+        # hot path, so hold samples are 1-in-16 — contention (the watch
+        # thread racing Filters) is always counted.
+        self._lock = perf.TimedLock("pods", sample_shift=4)
         self._pods: Dict[str, PodInfo] = {}
         self._by_node: Dict[str, Dict[str, PodInfo]] = {}
         self._rev: Dict[str, int] = {}
@@ -58,10 +62,50 @@ class PodManager:
         # incrementally from this instead of re-scanning every node's rev
         # per decision (docs/scheduler-concurrency.md).
         self._dirty: Set[str] = set()
+        # Incremental chip accounting: fleet-total granted chips and
+        # per-namespace (chips, mem_mib) sums, maintained on every
+        # add/refresh/delete.  The quota admission tick reads these
+        # instead of walking the whole registry — at 100k live pods the
+        # per-tick list + grant_chips() walk was 0.2s of the steady-storm
+        # round budget (ISSUE 12's /perfz quota-tick phase measured it).
+        self._total_chips: int = 0
+        self._ns_usage: Dict[str, List[int]] = {}
 
     def _bump(self, node: str) -> None:
         self._rev[node] = self._rev.get(node, 0) + 1
         self._dirty.add(node)
+
+    def _charge(self, info: PodInfo, sign: int) -> None:
+        chips = mem = 0
+        for container in info.devices:
+            for d in container:
+                chips += 1
+                mem += d.usedmem
+        self._total_chips += sign * chips
+        row = self._ns_usage.get(info.namespace)
+        if row is None:
+            row = self._ns_usage[info.namespace] = [0, 0]
+        row[0] += sign * chips
+        row[1] += sign * mem
+        if sign < 0 and row[0] == 0 and row[1] == 0:
+            # Bounded cardinality: a namespace whose pods all left stops
+            # occupying a row (vanished tenants must not accumulate).
+            del self._ns_usage[info.namespace]
+
+    def _add_locked(self, info: PodInfo) -> int:
+        prev = self._pods.get(info.uid)
+        if prev is not None:
+            self._charge(prev, -1)
+            if prev.node != info.node:
+                bucket = self._by_node.get(prev.node)
+                if bucket:
+                    bucket.pop(info.uid, None)
+                self._bump(prev.node)
+        self._pods[info.uid] = info
+        self._by_node.setdefault(info.node, {})[info.uid] = info
+        self._charge(info, 1)
+        self._bump(info.node)
+        return self._rev[info.node]
 
     def add_pod(self, info: PodInfo) -> int:
         """Record (or move) a grant; returns ``info.node``'s new rev —
@@ -69,16 +113,40 @@ class PodManager:
         usage under exactly this generation, so a concurrent change
         landing after it (a newer rev) always forces a rebuild."""
         with self._lock:
-            prev = self._pods.get(info.uid)
-            if prev is not None and prev.node != info.node:
-                bucket = self._by_node.get(prev.node)
-                if bucket:
-                    bucket.pop(info.uid, None)
-                self._bump(prev.node)
-            self._pods[info.uid] = info
-            self._by_node.setdefault(info.node, {})[info.uid] = info
-            self._bump(info.node)
-            return self._rev[info.node]
+            return self._add_locked(info)
+
+    def add_pods_group(self, infos: List[PodInfo], node: str,
+                       expected_rev: int) -> Optional[int]:
+        """Group commit: one node's whole grant group added under ONE
+        acquire.  The node's rev is validated against ``expected_rev``
+        INSIDE the lock — the commit lock does not exclude the watch
+        thread, so a per-pod add chain could be broken by an informer
+        event slipping between adds; holding the registry lock across
+        the group makes the chain unbreakable and replaces per-pod
+        chain-break rollback with one up-front check.  Returns the
+        final rev (``expected_rev + len(infos)``) or None with NOTHING
+        added when the rev moved.  One instrumented acquire per GROUP
+        instead of per pod was measurable against the ISSUE 12
+        instrumentation budget."""
+        with self._lock:
+            if self._rev.get(node, 0) != expected_rev:
+                return None
+            for info in infos:
+                self._add_locked(info)
+            return self._rev[node]
+
+    def _refresh_locked(self, info: PodInfo) -> bool:
+        prev = self._pods.get(info.uid)
+        if prev is None or prev.node != info.node \
+                or prev.devices != info.devices:
+            return False
+        prev.priority = info.priority
+        if info.trace_id:
+            prev.trace_id = info.trace_id
+        if info.qos:
+            prev.qos = info.qos
+        prev.touched_at = info.touched_at
+        return True
 
     def refresh_if_unchanged(self, info: PodInfo) -> bool:
         """Informer-reconciliation no-op detection: when the decoded
@@ -89,37 +157,80 @@ class PodManager:
         entry for a state that did not change, putting an O(pods × chips)
         rebuild back on the per-decision path."""
         with self._lock:
-            prev = self._pods.get(info.uid)
-            if prev is None or prev.node != info.node \
-                    or prev.devices != info.devices:
-                return False
-            prev.priority = info.priority
-            if info.trace_id:
-                prev.trace_id = info.trace_id
-            if info.qos:
-                prev.qos = info.qos
-            prev.touched_at = info.touched_at
-            return True
+            return self._refresh_locked(info)
+
+    def upsert(self, info: PodInfo) -> None:
+        """Informer apply: :meth:`refresh_if_unchanged` OR
+        :meth:`add_pod` under ONE acquire — the separate probe-then-add
+        pair cost a second instrumented acquire on every new-pod event
+        (ISSUE 12 instrumentation budget)."""
+        with self._lock:
+            if not self._refresh_locked(info):
+                self._add_locked(info)
 
     def del_pod(self, uid: str) -> None:
         with self._lock:
-            info = self._pods.pop(uid, None)
-            if info is None:
-                return
-            bucket = self._by_node.get(info.node)
-            if bucket is not None:
-                bucket.pop(uid, None)
-                if not bucket:
-                    del self._by_node[info.node]
-            self._bump(info.node)
+            self._del_locked(uid)
+
+    def del_pods(self, uids: Iterable[str]) -> None:
+        """Bulk delete under ONE lock acquisition — the batched drain
+        drops every routed pod's stale decision per tick, and paying an
+        acquire per pod there was measurable against the ISSUE 12
+        instrumentation budget."""
+        with self._lock:
+            for uid in uids:
+                self._del_locked(uid)
+
+    def _del_locked(self, uid: str) -> None:
+        info = self._pods.pop(uid, None)
+        if info is None:
+            return
+        self._charge(info, -1)
+        bucket = self._by_node.get(info.node)
+        if bucket is not None:
+            bucket.pop(uid, None)
+            if not bucket:
+                del self._by_node[info.node]
+        self._bump(info.node)
 
     def get(self, uid: str) -> Optional[PodInfo]:
-        with self._lock:
-            return self._pods.get(uid)
+        # Lock-free: one GIL-atomic dict read.  The lock never made
+        # this fresher (a writer could land right after release); the
+        # steady-state bench showed the per-decision acquire cost of
+        # single-read getters to be pure overhead (ISSUE 12).
+        return self._pods.get(uid)
 
     def list_pods(self) -> List[PodInfo]:
         with self._lock:
             return list(self._pods.values())
+
+    def total_chips(self) -> int:
+        """Fleet-wide granted chips, maintained incrementally — the
+        admission loop's outstanding-grants read without an O(pods)
+        walk.  Lock-free: one GIL-atomic int read (same reasoning as
+        :meth:`get`)."""
+        return self._total_chips
+
+    def ns_usage_snapshot(self, uids: "Iterable[str]"
+                          ) -> "Tuple[Dict[str, Tuple[int, int]], Set[str]]":
+        """Per-namespace ``(chips, mem_mib)`` aggregates of granted pods
+        (O(live namespaces), the quota usage_from input) plus the
+        granted subset of ``uids``, captured under ONE lock hold.  The
+        quota tick needs both views of the same instant: with a live
+        ``get`` probe taken after the aggregate snapshot, a grant
+        recorded between the two is counted in NEITHER term (the
+        admitted entry is skipped as "granted" while the aggregates
+        predate its chips) and the release loop can admit past nominal
+        on the transiently understated usage.  Membership is probed only
+        for the caller's uids (the ADMITTED entries — O(entries)): a
+        full ``set(self._pods)`` copy here stalled every concurrent
+        add/del/upsert for a 100k-key build per tick at target scale,
+        the very O(pods) tick work this snapshot replaced."""
+        with self._lock:
+            pods = self._pods
+            return ({ns: (row[0], row[1])
+                     for ns, row in self._ns_usage.items()},
+                    {u for u in uids if u in pods})
 
     def pods_on_node(self, node: str) -> List[PodInfo]:
         with self._lock:
@@ -136,9 +247,14 @@ class PodManager:
         Callers must read revs BEFORE the data they key (pods_on_node):
         data fetched after the rev is at least as new as the rev, so a
         cache keyed on it can only be transiently conservative (rebuild),
-        never silently stale."""
-        with self._lock:
-            return self._rev.get(node, 0)
+        never silently stale.
+
+        Lock-free: a single GIL-atomic dict read.  The lock never
+        ordered this against anything — a writer could bump the rev the
+        instant after release, and the commit protocol already absorbs
+        that via the add_pod rev-chain check — so the acquire was pure
+        per-decision cost (ISSUE 12's steady-state bench measured it)."""
+        return self._rev.get(node, 0)
 
     def drain_dirty(self) -> Set[str]:
         """Return-and-clear the set of nodes whose pod set changed since

@@ -20,6 +20,11 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
                     recommendation and forecast drift
                     (``?horizon=<s>`` overrides the horizon) for
                     ``vtpu-report`` and operators
+- ``GET  /perfz``   control-plane performance observatory: per-phase
+                    p50/p99/max over ring windows, the lock wait/hold
+                    table, informer lag, queue depth, GC pressure and
+                    the top-N slowest recent ticks with their phase
+                    splits (``?ticks=<n>`` sizes the slow-tick table)
 """
 
 from __future__ import annotations
@@ -115,6 +120,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, self.scheduler.export_queues())
             except Exception as e:  # noqa: BLE001 — 500, not a hangup
                 log.exception("queuez export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        elif self.path.startswith("/perfz"):
+            # Control-plane performance observatory (util/perf.py):
+            # phase timings, lock table, informer lag, slow ticks.
+            from urllib.parse import parse_qsl, urlsplit
+
+            query = dict(parse_qsl(urlsplit(self.path).query))
+            try:
+                ticks = int(query.get("ticks", "8"))
+                if not 0 <= ticks <= 64:
+                    raise ValueError(f"out of range [0, 64]: {ticks}")
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad ticks: {e}"})
+                return
+            try:
+                self._reply(200, self.scheduler.export_perf(ticks))
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("perfz export failed")
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         elif self.path.startswith("/capacityz"):
             # Predictive capacity (accounting/planner.py): forecasts,

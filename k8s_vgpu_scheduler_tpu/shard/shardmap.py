@@ -337,9 +337,16 @@ class ShardManager:
         """One coordination pass; returns the actions taken (tests, the
         simulator's HA report).  Safe to call concurrently with Filters:
         the hot paths read ``_map`` by reference and the fence re-checks
-        under ``_lock``."""
+        under ``_lock``.  Timed into the ``shard-tick`` perf ring
+        (util/perf.py; inert replicas record nothing)."""
         if not self.enabled:
             return []
+        from ..util import perf
+
+        with perf.phase_timer("shard-tick"):
+            return self._tick()
+
+    def _tick(self) -> list:
         actions: list = []
         now = self._clock()
         coord = self._publish_beat()

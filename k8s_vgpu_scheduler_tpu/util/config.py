@@ -231,6 +231,17 @@ class Config:
     # Name of the coordination object (a Node) the map is CASed on.
     shard_coord_object: str = "vtpu-shard-coordination"
 
+    # Control-plane performance observatory (util/perf.py;
+    # docs/observability.md "Performance observatory").  On by default —
+    # the instrumentation budget is ≤2% on bench_batch_cycle, enforced
+    # by the A/B inside bench_steady_state — with --no-perf as the
+    # operational escape hatch (and the A/B's baseline leg).
+    perf_enabled: bool = True
+    # Opt-in tracemalloc allocation tracking: /perfz then carries the
+    # top allocation sites.  Costs real memory + CPU (every allocation
+    # is traced) — a diagnosis tool, never an always-on default.
+    perf_tracemalloc: bool = False
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

@@ -29,9 +29,11 @@ it was writing, which is exactly the amortization — then resigns.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..k8s.client import KubeClient
+from . import perf
 
 
 class _Pending:
@@ -76,7 +78,15 @@ class DecisionBatcher:
         callers'.  Returns the size of the batch it rode in (1 = wrote
         alone); raises this entry's failure."""
         if self._passthrough:
+            # 1-in-4 sampled flush timing (per-write on this path; the
+            # grouped path below times every real batch flush).
+            reg = perf.registry()
+            rec = reg.enabled and (self.writes & 3) == 0
+            if rec:
+                t0 = time.monotonic()
             self._client.patch_pod_annotations(namespace, name, patch)
+            if rec:
+                reg.record("decision-flush", time.monotonic() - t0)
             with self._lock:
                 self.batches += 1
                 self.writes += 1
@@ -126,6 +136,11 @@ class DecisionBatcher:
     def _write_batch(self, batch: List[_Pending]) -> None:
         self.batches += 1
         self.writes += len(batch)
+        # Flush telemetry (util/perf.py → /perfz, the "decision-flush"
+        # phase): per-flush latency ring + the last flush size gauge.
+        reg = perf.registry()
+        reg.set_gauge("decision_flush_last_size", len(batch))
+        t0 = time.monotonic()
         entries: List[Tuple[str, str, Dict[str, Optional[str]]]] = [
             (p.namespace, p.name, p.patch) for p in batch
         ]
@@ -137,6 +152,7 @@ class DecisionBatcher:
                     f"outcomes for {len(batch)} patches")
         except Exception as e:  # noqa: BLE001 — wholesale transport failure
             results = [e] * len(batch)
+        reg.record("decision-flush", time.monotonic() - t0)
         for p, err in zip(batch, results):
             p.error = err
             p.batch_size = len(batch)

@@ -47,9 +47,14 @@ TRACE_ID_ANNOTATION = "vtpu.dev/trace-id"
 # device plugin next to the enforcement env; read by the shim).
 ENV_TRACE_ID = "VTPU_TRACE_ID"
 
-# Latency buckets (seconds) sized for a control plane whose full
-# filter→bind cycle is ~1 ms and whose apiserver writes are ~10 ms.
-DEFAULT_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+# Latency buckets (seconds) sized for a control plane whose BATCHED
+# per-pod decision is single-digit microseconds, whose full filter→bind
+# cycle is ~1 ms, and whose apiserver writes are ~10 ms.  The sub-100µs
+# bounds exist because batched cycles moved the per-decision cost under
+# the old first bucket (0.0001): every observation landed there and p99
+# was unreadable (ISSUE 12 satellite; pinned by tests/test_trace.py).
+DEFAULT_BUCKETS = (0.000005, 0.00001, 0.000025, 0.00005,
+                   0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
                    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 # Admissible values of the histogram qos label — the webhook-validated
@@ -262,14 +267,25 @@ class Tracer:
         return out[-limit:] if limit else out
 
     def events(self, pod_uid: Optional[str] = None,
-               limit: int = 0) -> List[dict]:
+               limit: int = 0, after_seq: int = -1) -> List[dict]:
+        """Journal read with reader-side pagination: ``after_seq``
+        returns only entries newer than a previously-seen sequence
+        number (the cursor a poller carries between reads — under storm
+        load the ring moves while you read, and seq is the only stable
+        ordering).  With a cursor (``after_seq >= 0``) ``limit`` pages
+        from the OLDEST end, so a tailing poller walks forward without
+        silently skipping the entries between its cursor and the newest
+        page; without one it caps from the newest end (the "show me
+        recent" view)."""
         out = [
             {"time_s": t, "seq": seq, "pod_uid": uid, "event": what,
              "trace_id": tid, "attributes": attrs}
             for (t, seq, uid, what, tid, attrs) in list(self._events)
-            if pod_uid is None or uid == pod_uid
+            if (pod_uid is None or uid == pod_uid) and seq > after_seq
         ]
-        return out[-limit:] if limit else out
+        if not limit:
+            return out
+        return out[:limit] if after_seq >= 0 else out[-limit:]
 
     def histogram_snapshot(self) -> Dict[Tuple[str, str],
                                          Tuple[List[Tuple[str, int]],
@@ -376,7 +392,20 @@ def render_tracez(query: Dict[str, str]) -> Tuple[int, str, str]:
 
 
 def render_events(query: Dict[str, str]) -> Tuple[int, str, str]:
+    """``/debug/events[?pod=<uid>&limit=<n>&after_seq=<seq>]`` — the
+    pagination params let a poller tail the journal under storm load
+    without re-downloading the whole ring per poll (next_seq in the
+    reply is the cursor to pass back)."""
     t = tracer()
-    events = t.events(query.get("pod") or None)
+    try:
+        limit = int(query.get("limit", "0"))
+        after_seq = int(query.get("after_seq", "-1"))
+    except ValueError as e:
+        return 400, "application/json", json.dumps(
+            {"error": f"bad pagination param: {e}"})
+    events = t.events(query.get("pod") or None, limit=limit,
+                      after_seq=after_seq)
     return 200, "application/json", json.dumps(
-        {"service": t.service, "events": events}, indent=1)
+        {"service": t.service, "events": events,
+         "next_seq": events[-1]["seq"] if events else after_seq},
+        indent=1)

@@ -628,3 +628,59 @@ class TestRescueConcurrencyInvariant:
             assert info.node != names[0]
         assert_no_overallocation(s)
         s.close()
+
+
+class TestQuarantineNodeIndex:
+    """ISSUE 12: quarantined_on is the snapshot refresh's per-dirty-node
+    read — it must be served from the maintained node index, stay exact
+    across quarantine/release, and healthy fleet-wide heartbeats must
+    never populate it (the pre-fix full-table scan turned a 10k-node
+    storm's completion churn into minutes per cycle)."""
+
+    def test_index_tracks_transitions(self):
+        from k8s_vgpu_scheduler_tpu.health.quarantine import (
+            ChipQuarantine, QuarantineConfig)
+
+        clock = [0.0]
+        q = ChipQuarantine(QuarantineConfig(flap_threshold=2,
+                                            flap_window_s=60.0,
+                                            probation_s=10.0),
+                           clock=lambda: clock[0])
+        # A healthy fleet's heartbeats create records but no index.
+        for n in range(50):
+            q.observe_node(f"node-{n}", {f"c{i}": True for i in range(8)})
+        assert q.count() == 0
+        assert q.quarantined_on("node-0") == set()
+        assert q.active() == {}
+        # Flap one chip into quarantine.
+        for healthy in (False, True, False):
+            clock[0] += 1.0
+            q.observe("node-3", "c2", healthy)
+        assert q.quarantined_on("node-3") == {"c2"}
+        assert q.quarantined_on("node-4") == set()
+        assert q.active() == {"node-3": {"c2"}}
+        assert q.count() == 1
+        # Direct quarantine on another node joins the index.
+        q.quarantine("node-7", "c0", "operator")
+        assert q.count() == 2
+        assert q.quarantined_on("node-7") == {"c0"}
+        # Release empties the node's index entry entirely.
+        q.release("node-7", "c0")
+        assert q.quarantined_on("node-7") == set()
+        assert q.active() == {"node-3": {"c2"}}
+        # Probation sweep releases the flapper and clears the index.
+        clock[0] += 1.0
+        q.observe("node-3", "c2", True)
+        clock[0] += 20.0
+        released = q.sweep()
+        assert ("node-3", "c2") in released
+        assert q.active() == {} and q.count() == 0
+
+    def test_index_read_is_a_copy(self):
+        from k8s_vgpu_scheduler_tpu.health.quarantine import ChipQuarantine
+
+        q = ChipQuarantine()
+        q.quarantine("n", "c", "x")
+        got = q.quarantined_on("n")
+        got.add("tampered")
+        assert q.quarantined_on("n") == {"c"}

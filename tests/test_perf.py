@@ -1,0 +1,573 @@
+"""util/perf.py — the control-plane performance observatory — plus its
+integration seams: the batched-cycle phase decomposition, the lock
+telemetry on the real scheduler locks, GET /perfz over the real HTTP
+server, the Prometheus families, and the debugz ring-journal storm
+coverage (ISSUE 12).  Tier-1: no sleeps, no chip, deterministic."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+from k8s_vgpu_scheduler_tpu.util import debugz, perf, trace
+from k8s_vgpu_scheduler_tpu.util.config import Config
+from tests.test_scheduler_core import register_node, tpu_pod
+
+
+@pytest.fixture
+def fresh():
+    """Reset the process-global perf registry around each test (shared
+    across every Scheduler in the process, like the tracer)."""
+    reg = perf.registry()
+    reg.reset()
+    reg.enabled = True
+    yield reg
+    reg.reset()
+    reg.enabled = True
+
+
+def make_scheduler(n_nodes=2, **cfg_kw):
+    kube = FakeKube()
+    s = Scheduler(kube, Config(**cfg_kw))
+    names = [f"node-{i}" for i in range(n_nodes)]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        register_node(s, n, chips=4)
+    kube.watch_pods(s.on_pod_event)
+    return kube, s, names
+
+
+class TestPhaseRing:
+    def test_window_quantiles_and_lifetime(self, fresh):
+        ring = perf.PhaseRing("x", capacity=16)
+        for ms in (1, 2, 3, 4, 100):
+            ring.record(ms / 1000.0)
+        w = ring.window()
+        assert w["n"] == 5
+        assert w["max_s"] == pytest.approx(0.1)
+        assert w["p50_s"] == pytest.approx(0.003)
+        assert ring.count == 5
+        assert ring.lifetime_max_s == pytest.approx(0.1)
+
+    def test_ring_is_bounded_and_window_forgets(self, fresh):
+        ring = perf.PhaseRing("x", capacity=8)
+        ring.record(9.0)               # old outlier
+        for _ in range(64):
+            ring.record(0.001)
+        w = ring.window()
+        assert w["n"] == 8             # bounded: preallocated slots only
+        assert w["max_s"] == pytest.approx(0.001)   # outlier aged out
+        assert ring.lifetime_max_s == pytest.approx(9.0)  # lifetime kept
+
+    def test_prom_buckets_cumulative_with_inf(self, fresh):
+        ring = perf.PhaseRing("x", bounds=(0.001, 0.01))
+        for v in (0.0005, 0.005, 5.0):
+            ring.record(v)
+        buckets, sum_s = ring.prom()
+        assert buckets == [("0.001", 1), ("0.01", 2), ("+Inf", 3)]
+        assert sum_s == pytest.approx(5.0055)
+
+    def test_negative_durations_clamp(self, fresh):
+        ring = perf.PhaseRing("x")
+        ring.record(-1.0)              # a clock oddity must not corrupt
+        assert ring.window()["max_s"] == 0.0
+
+
+class TestTimedLock:
+    def test_wait_recorded_only_when_contended(self, fresh):
+        lk = perf.TimedLock("t-contend")
+        with lk:
+            pass
+        st = lk.stats
+        assert st.acquires == 1
+        assert st.contended == 0 and st.wait.count == 0
+        assert st.hold.count == 1      # sample_shift 0: every release
+
+        holding = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                holding.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=holder)
+        th.start()
+        holding.wait(5.0)
+        got = lk.acquire(timeout=0.001)   # contended, times out
+        assert not got
+        release.set()
+        th.join()
+        assert st.contended == 1
+        assert st.wait.count == 1
+
+    def test_hold_sampling_shift(self, fresh):
+        lk = perf.TimedLock("t-sample", sample_shift=2)   # 1 in 4
+        for _ in range(8):
+            with lk:
+                pass
+        assert lk.stats.acquires == 8
+        assert lk.stats.hold.count == 2
+
+    def test_sampled_acquires_rounds_up(self, fresh):
+        """The sampled acquire is the FIRST of each 2**shift block, so
+        the observed-acquire count is ceil(acquires / 2**shift): with
+        fewer than a full block of acquires a floor would export
+        contention_ratio 0.0 (or a division by zero) next to the
+        non-empty wait/hold rings the first acquire just recorded."""
+        lk = perf.TimedLock("t-ceil", sample_shift=2)
+        with lk:                      # acquire 1: the sampled one
+            pass
+        st = lk.stats
+        assert st.acquires == 1 and st.hold.count == 1
+        assert st.sampled_acquires() == 1
+        doc = fresh.export()
+        assert doc["locks"]["t-ceil"]["sampled_1_in"] == 4
+        # A contended first acquire must yield a finite, <=1.0 ratio.
+        st.contended = 1
+        assert fresh.export()["locks"]["t-ceil"]["contention_ratio"] == 1.0
+        for _ in range(7):            # 8 total -> exactly 2 blocks
+            with lk:
+                pass
+        assert st.sampled_acquires() == 2
+
+    def test_disabled_registry_bypasses_telemetry(self, fresh):
+        fresh.enabled = False
+        lk = perf.TimedLock("t-off")
+        with lk:
+            pass
+        assert lk.stats.acquires == 0
+        assert lk.stats.hold.count == 0
+
+    def test_nonblocking_contended_returns_false(self, fresh):
+        lk = perf.TimedLock("t-nb")
+        assert lk.acquire()
+        assert lk.acquire(blocking=False) is False
+        lk.release()
+
+    def test_locked_passthrough(self, fresh):
+        lk = perf.TimedLock("t-locked")
+        assert not lk.locked()
+        with lk:
+            assert lk.locked()
+
+
+class TestRegistry:
+    def test_note_tick_and_slow_ticks_ranked(self, fresh):
+        fresh.note_tick("batch-cycle", 0.002, {"solve": 0.001}, pods=3)
+        fresh.note_tick("batch-cycle", 0.050, {"solve": 0.049}, pods=9)
+        top = fresh.slow_ticks(top=1)
+        assert len(top) == 1
+        assert top[0]["pods"] == 9
+        assert top[0]["total_ms"] == pytest.approx(50.0)
+        assert top[0]["phases_ms"]["solve"] == pytest.approx(49.0)
+
+    def test_tick_journal_bounded(self, fresh):
+        for i in range(perf.PerfRegistry.TICK_RING * 3):
+            fresh.note_tick("t", 0.001, {}, i=i)
+        assert len(fresh.slow_ticks(top=1000)) == perf.PerfRegistry.TICK_RING
+
+    def test_export_shape(self, fresh):
+        fresh.record("cycle-total", 0.01)
+        fresh.set_gauge("pending_queue_depth", 7)
+        perf.TimedLock("t-export").acquire()
+        doc = fresh.export()
+        assert doc["enabled"] is True
+        assert doc["phases"]["cycle-total"]["window"]["p99_s"] == \
+            pytest.approx(0.01)
+        assert "gc-pause" in doc["phases"]
+        assert doc["locks"]["t-export"]["acquires"] == 1
+        assert doc["queue"]["pending_depth"] == 7
+        assert doc["gc"]["tracemalloc_top"] is None
+        assert isinstance(doc["gc"]["collections"], list)
+
+    def test_informer_lag_is_window_p99(self, fresh):
+        for _ in range(10):
+            fresh.record("informer-apply", 0.001)
+        fresh.record("informer-apply", 0.2)
+        assert fresh.informer_lag_s() == pytest.approx(0.2)
+
+    def test_informer_lag_decays_when_stale(self, fresh):
+        """A ring window never ages out on its own: once no sample has
+        arrived for the horizon, the lag gauge reads 0.0 ("no recent
+        informer activity") instead of serving the last storm's p99
+        next to a zero event rate indefinitely — the drain_age_s
+        discipline applied to the informer figure."""
+        fresh.record("informer-apply", 0.3)
+        assert fresh.informer_lag_s() == pytest.approx(0.3)
+        ring = fresh.phase_rings()["informer-apply"]
+        ring.last_at = time.monotonic() - perf.INFORMER_LAG_HORIZON_S - 1
+        assert fresh.informer_lag_s() == 0.0
+        # Activity resumes: the gauge reports again (window p99 —
+        # older ring samples still count; recency only gates staleness).
+        fresh.record("informer-apply", 0.1)
+        assert fresh.informer_lag_s() == pytest.approx(0.3)
+
+    def test_informer_export_names_sampled_count(self, fresh):
+        """The informer-apply ring holds a 1-in-N sample: /perfz must
+        publish it AS a sampled count next to its factor, never as the
+        total event count (dividing the phase total by it would
+        overstate per-event cost by the sampling factor)."""
+        for _ in range(3):
+            fresh.record("informer-apply", 0.001)
+        doc = fresh.export()
+        assert doc["informer"]["apply_sampled_count"] == 3
+        assert doc["informer"]["apply_sample_1_in"] == \
+            perf.INFORMER_SAMPLE_EVERY
+        assert "apply_count" not in doc["informer"]
+
+    def test_phase_buckets_track_trace_histograms(self):
+        """vtpu_cycle_phase_seconds and the trace-span histograms share
+        one bucket table (perf derives from trace.DEFAULT_BUCKETS) so a
+        re-tuning can never land in one and not the other."""
+        assert perf.PHASE_BUCKETS == trace.DEFAULT_BUCKETS[:-1]
+
+    def test_gc_pause_ring_survives_collection(self, fresh):
+        import gc
+
+        gc.collect()
+        assert fresh.gc.collections[2] >= 1
+        assert fresh.gc.pause.count >= 1
+
+
+class TestSchedulerIntegration:
+    def test_batch_cycle_phase_decomposition(self, fresh):
+        kube, s, names = make_scheduler(filter_batch=True)
+        items = []
+        for i in range(6):
+            pod = tpu_pod(f"p{i}", uid=f"u{i}", mem="500")
+            kube.create_pod(pod)
+            items.append((pod, names))
+        results = s.filter_many(items)
+        assert all(r.node for r in results)
+        doc = s.export_perf()
+        # One cycle recorded: the per-phase rings and the tick journal.
+        for phase in ("cycle-total", "vector-eval", "solve",
+                      "group-commit", "drain"):
+            assert doc["phases"][phase]["count"] >= 1, phase
+        # First cycle over a new node set is a full columnar rebuild.
+        assert doc["phases"]["columnar-rebuild"]["count"] >= 1
+        ticks = [t for t in doc["slow_ticks"] if t["name"] == "batch-cycle"]
+        assert ticks and ticks[0]["pods"] >= 1
+        assert "solve" in ticks[0]["phases_ms"]
+        # Informer timing: FakeKube delivers create events inline
+        # (1-in-8 sampled; the first event always records).
+        assert doc["phases"]["informer-apply"]["count"] >= 1
+        # Decision writes happened (1-in-4 sampled; first records).
+        assert doc["phases"]["decision-write"]["count"] >= 1
+        assert doc["counters"]["batch_cycles"] >= 1
+        s.close()
+
+    def test_incremental_refresh_after_steady_cycle(self, fresh):
+        kube, s, names = make_scheduler(filter_batch=True)
+        for i in range(2):
+            pod = tpu_pod(f"w{i}", uid=f"wu{i}", mem="500")
+            kube.create_pod(pod)
+            assert s.filter_many([(pod, names)])[0].node
+        doc = s.export_perf()
+        # Second cycle adopted/refreshed rows — no second full rebuild.
+        assert doc["phases"]["columnar-rebuild"]["count"] == 1
+        assert doc["phases"]["columnar-refresh"]["count"] >= 1
+        s.close()
+
+    def test_optimistic_path_records_phases_and_locks(self, fresh):
+        kube, s, names = make_scheduler()
+        pod = tpu_pod("o1", uid="ou1", mem="500")
+        kube.create_pod(pod)
+        assert s.filter(pod, names).node
+        doc = s.export_perf()
+        assert doc["phases"]["opt-evaluate"]["count"] == 1
+        assert doc["phases"]["opt-commit"]["count"] == 1
+        assert doc["phases"]["decision-write"]["count"] == 1
+        assert doc["phases"]["decision-flush"]["count"] >= 1
+        assert doc["locks"]["commit"]["acquires"] >= 1
+        assert doc["locks"]["pods"]["acquires"] >= 1
+        assert doc["decision_writer"]["writes"] >= 1
+        s.close()
+
+    def test_resync_and_register_timed(self, fresh):
+        kube, s, _names = make_scheduler()
+        s.resync_from_apiserver()
+        # A register-stream heartbeat (the keepalive shape: unchanged
+        # inventory) is timed into the register-apply ring.
+        s.observe_registration("node-0", s.nodes.get_node("node-0"))
+        doc = s.export_perf()
+        assert doc["phases"]["informer-resync"]["count"] == 1
+        assert doc["informer"]["resync_last_s"] >= 0.0
+        assert doc["phases"]["register-apply"]["count"] == 1
+        s.close()
+
+    def test_background_ticks_timed(self, fresh):
+        kube, s, _names = make_scheduler()
+        s.admission.tick()     # quota disabled -> still timed
+        s.defrag.tick()
+        s.observe_capacity()
+        doc = s.export_perf()
+        assert doc["phases"]["quota-tick"]["count"] == 1
+        assert doc["phases"]["defrag-tick"]["count"] == 1
+        assert doc["phases"]["capacity-tick"]["count"] == 1
+        # Inert shard layer records nothing.
+        s.shards.tick()
+        assert "shard-tick" not in s.export_perf()["phases"]
+        s.close()
+
+    def test_drain_age_resets_when_queue_drains(self, fresh):
+        """drain_age_s is a CURRENT wait: after the gate's queue drains
+        (and on cycles with no gate-enqueued jobs) the gauge returns to
+        zero instead of reporting the last storm's age forever."""
+        kube, s, names = make_scheduler(filter_batch=True)
+        fresh.set_gauge("drain_age_s", 4.2)     # a past storm's figure
+        pod = tpu_pod("da1", uid="dau1", mem="500")
+        kube.create_pod(pod)
+        assert s.filter(pod, names).node        # gate path: drain empties
+        assert fresh.gauge("drain_age_s") == 0.0
+        fresh.set_gauge("drain_age_s", 4.2)
+        pod2 = tpu_pod("da2", uid="dau2", mem="500")
+        kube.create_pod(pod2)
+        # A tick-drain (filter_many) measures per cycle and then zeroes
+        # the gauge once its whole backlog is decided — an idle
+        # scheduler after a storm must not keep serving the final
+        # cycle's age (those jobs always carry enqueued_at, so the
+        # per-cycle reset alone never fires on this path).
+        assert s.filter_many([(pod2, names)])[0].node
+        assert fresh.gauge("drain_age_s") == 0.0
+        s.close()
+
+    def test_no_perf_config_disables_instrumentation(self, fresh):
+        kube, s, names = make_scheduler(perf_enabled=False)
+        pod = tpu_pod("d1", uid="du1", mem="500")
+        kube.create_pod(pod)
+        assert s.filter(pod, names).node
+        doc = s.export_perf()
+        assert doc["enabled"] is False
+        assert doc["phases"] == {} or all(
+            p["count"] == 0 for p in doc["phases"].values())
+        s.close()
+
+
+class TestPerfzHttp:
+    def test_perfz_roundtrip_over_real_server(self, fresh):
+        import urllib.request
+
+        from k8s_vgpu_scheduler_tpu.scheduler.routes import ExtenderServer
+
+        kube, s, names = make_scheduler(filter_batch=True)
+        pod = tpu_pod("h1", uid="hu1", mem="500")
+        kube.create_pod(pod)
+        assert s.filter_many([(pod, names)])[0].node
+        srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/perfz?ticks=2") as r:
+                doc = json.load(r)
+            assert doc["enabled"] is True
+            assert "cycle-total" in doc["phases"]
+            assert len(doc["slow_ticks"]) <= 2
+            assert "commit" in doc["locks"]
+            # Bad pagination param -> 400, not 500.
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/perfz?ticks=nope")
+            assert ei.value.code == 400
+        finally:
+            srv.stop()
+            s.close()
+
+
+class TestPrometheusFamilies:
+    def _exposition(self, s):
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import (
+            ClusterCollector)
+
+        registry = CollectorRegistry()
+        registry.register(ClusterCollector(s))
+        return generate_latest(registry).decode()
+
+    def test_perf_metrics_rendered(self, fresh):
+        kube, s, names = make_scheduler(filter_batch=True)
+        pod = tpu_pod("m1", uid="mu1", mem="500")
+        kube.create_pod(pod)
+        assert s.filter_many([(pod, names)])[0].node
+        text = self._exposition(s)
+        assert 'vtpu_cycle_phase_seconds_bucket{le="+Inf",' \
+            'phase="cycle-total"} 1.0' in text
+        assert 'vtpu_lock_acquires_total{lock="commit"}' in text
+        assert 'vtpu_lock_sampled_acquires_total{lock="commit"}' in text
+        assert 'vtpu_lock_hold_seconds_count{lock="pods"}' in text
+        assert "vtpu_informer_lag_seconds" in text
+        assert "vtpu_pending_queue_depth" in text
+        assert 'vtpu_gc_collections_total{generation="2"}' in text
+        s.close()
+
+    def test_families_emitted_cold(self, fresh):
+        """Zero state still emits every family (dashboards must never
+        reference a vanishing series)."""
+        kube, s, _names = make_scheduler()
+        text = self._exposition(s)
+        for name in ("vtpu_informer_lag_seconds",
+                     "vtpu_pending_queue_depth",
+                     "vtpu_gc_collections_total",
+                     "vtpu_cycle_phase_seconds"):
+            assert name in text, name
+        s.close()
+
+
+class TestJournalStorm:
+    """ISSUE 12 satellite: the debugz ring journal under storm load —
+    concurrent writers + a paginating reader, bounded memory, no torn
+    events."""
+
+    def test_concurrent_writers_reader_pagination(self, monkeypatch):
+        t = trace.Tracer(capacity=256, event_capacity=256, service="storm")
+        monkeypatch.setattr(trace, "_GLOBAL", t)
+        stop = threading.Event()
+        errors = []
+
+        def writer(w):
+            i = 0
+            while not stop.is_set():
+                t.event(f"u{w}-{i}", "stormed", trace_id="x" * 32,
+                        node=f"node-{w}", i=i)
+                with t.span("storm-span", trace_id="y" * 32):
+                    pass
+                i += 1
+                if i >= 400:
+                    break
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(4)]
+        for th in threads:
+            th.start()
+
+        # Reader paginates while the writers hammer the ring.
+        seen_seq = -1
+        pages = 0
+        try:
+            for _ in range(50):
+                code, _ctype, body = debugz.handle(
+                    "/debug/events",
+                    {"limit": "64", "after_seq": str(seen_seq)})
+                assert code == 200
+                doc = json.loads(body)
+                events = doc["events"]
+                assert len(events) <= 64            # limit respected
+                # No torn events: every entry carries the full shape,
+                # and seq strictly increases within a page.
+                seqs = [e["seq"] for e in events]
+                assert seqs == sorted(seqs)
+                assert all(q > seen_seq for q in seqs)
+                for e in events:
+                    assert {"time_s", "seq", "pod_uid", "event",
+                            "trace_id", "attributes"} <= set(e)
+                    assert e["event"] == "stormed"
+                    assert e["attributes"]["node"].startswith("node-")
+                if events:
+                    seen_seq = doc["next_seq"]
+                    pages += 1
+                # tracez stays readable under the storm too.
+                code, _c, body = debugz.handle("/debug/tracez",
+                                               {"format": "json"})
+                assert code == 200
+                json.loads(body)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join()
+        assert pages >= 1
+
+        # Bounded memory: the rings never exceed their caps.
+        assert len(t.events()) <= 256
+        assert len(t.spans()) <= 256
+
+    def test_pagination_cursor_semantics(self, monkeypatch):
+        t = trace.Tracer(event_capacity=32)
+        monkeypatch.setattr(trace, "_GLOBAL", t)
+        for i in range(10):
+            t.event(f"u{i}", "e")
+        _code, _c, body = debugz.handle("/debug/events", {"limit": "4"})
+        page1 = json.loads(body)
+        assert len(page1["events"]) == 4
+        cursor = page1["next_seq"]
+        # Nothing new past the cursor of the newest page.
+        _code, _c, body = debugz.handle(
+            "/debug/events", {"after_seq": str(cursor)})
+        assert json.loads(body)["events"] == []
+        t.event("u-new", "e")
+        _code, _c, body = debugz.handle(
+            "/debug/events", {"after_seq": str(cursor)})
+        newer = json.loads(body)["events"]
+        assert [e["pod_uid"] for e in newer] == ["u-new"]
+
+    def test_pagination_with_limit_pages_oldest_first(self, monkeypatch):
+        """A cursor page must be the OLDEST entries after the cursor —
+        newest-first paging would jump next_seq past everything in
+        between and a tailing poller would silently lose exactly the
+        storm's events (the regression this pins)."""
+        t = trace.Tracer(event_capacity=64)
+        monkeypatch.setattr(trace, "_GLOBAL", t)
+        for i in range(30):
+            t.event(f"u{i}", "e")
+        cursor = t.events()[9]["seq"]
+        _code, _c, body = debugz.handle(
+            "/debug/events", {"after_seq": str(cursor), "limit": "5"})
+        doc = json.loads(body)
+        assert [e["pod_uid"] for e in doc["events"]] == \
+            [f"u{i}" for i in range(10, 15)]
+        assert doc["next_seq"] == doc["events"][-1]["seq"]
+        # Following that cursor forward reaches the newest entry with
+        # no gap.
+        seen, cursor = 15, doc["next_seq"]
+        while True:
+            _code, _c, body = debugz.handle(
+                "/debug/events", {"after_seq": str(cursor), "limit": "5"})
+            doc = json.loads(body)
+            if not doc["events"]:
+                break
+            for e in doc["events"]:
+                assert e["pod_uid"] == f"u{seen}"
+                seen += 1
+            cursor = doc["next_seq"]
+        assert seen == 30
+
+    def test_bad_pagination_params_400(self):
+        code, _c, body = debugz.handle("/debug/events",
+                                       {"after_seq": "wat"})
+        assert code == 400
+        assert "pagination" in json.loads(body)["error"]
+
+
+class TestTombstoneThrottle:
+    """ISSUE 12: the delete-tombstone prune is throttled — a sustained
+    completion storm must not pay an O(tombstones) scan per DELETE
+    (the pre-fix quadratic ate the steady bench's round budget)."""
+
+    def test_prune_throttled_but_correct(self, fresh, monkeypatch):
+        kube, s, _names = make_scheduler()
+        # Fill past the prune threshold; the throttle means inserts
+        # stay O(1) (no scan per call once one ran this minute).
+        for i in range(5000):
+            s._note_deleted(f"u{i}")
+        assert len(s._deleted_uids) == 5000
+        # Age everything past the horizon, then allow one prune.
+        old = time.monotonic() - s._deleted_horizon_s - 1.0
+        with s._deleted_lock:
+            for u in list(s._deleted_uids):
+                s._deleted_uids[u] = old
+            s._deleted_pruned_at = 0.0
+        s._note_deleted("fresh-1")
+        assert len(s._deleted_uids) == 1      # expired swept, fresh kept
+        assert s._deleted_since("fresh-1") is not None
+        # An expired uid is still treated as un-tombstoned on read even
+        # if a throttled prune has not swept it yet.
+        with s._deleted_lock:
+            s._deleted_uids["stale-1"] = old
+        assert s._deleted_since("stale-1") is None
+        s.close()

@@ -291,3 +291,54 @@ class TestRunQueue:
         # The overrunning worker (2nd call) must be the last attempted —
         # the queue stops to protect the serialized pool claim.
         assert len(calls) == 2
+
+
+class TestPerfSnapshot:
+    """ISSUE 12 satellite: the poolwatch "perf" task snapshots a live
+    /perfz into benchmarks/captured-perf-<round>.json during any
+    healthy window (claim-free, beside the capacity capture)."""
+
+    def test_skips_without_scheduler_url(self, sandbox, monkeypatch):
+        monkeypatch.delenv("VTPU_SCHED_URL", raising=False)
+        poolwatch.snapshot_perf()      # must not raise, must not write
+        assert not list(sandbox.glob("benchmarks/captured-perf-*"))
+
+    def test_captures_live_perfz(self, sandbox, monkeypatch):
+        from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+        from k8s_vgpu_scheduler_tpu.scheduler.routes import ExtenderServer
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        from tests.test_scheduler_core import register_node, tpu_pod
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config(filter_batch=True))
+        kube.add_node({"metadata": {"name": "node-a", "annotations": {}}})
+        register_node(s, "node-a")
+        kube.watch_pods(s.on_pod_event)
+        pod = tpu_pod("pp1", uid="ppu1", mem="500")
+        kube.create_pod(pod)
+        assert s.filter_many([(pod, ["node-a"])])[0].node
+        srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+        srv.start()
+        (sandbox / "benchmarks").mkdir(exist_ok=True)
+        try:
+            monkeypatch.setenv("VTPU_SCHED_URL",
+                               f"127.0.0.1:{srv.port}")
+            poolwatch.snapshot_perf()
+        finally:
+            srv.stop()
+            s.close()
+        out = sandbox / "benchmarks" / "captured-perf-rt.json"
+        assert out.exists()
+        doc = json.loads(out.read_text())
+        assert "cycle-total" in doc["perfz"]["phases"]
+        assert "commit" in doc["perfz"]["locks"]
+
+    def test_perf_in_default_task_list(self):
+        import re
+
+        src = open(os.path.join(REPO, "benchmarks",
+                                "poolwatch.py")).read()
+        m = re.search(r'default="([a-z,]+)"\)', src)
+        assert m and "perf" in m.group(1).split(",")
+        assert "capacity" in m.group(1).split(",")

@@ -28,7 +28,9 @@ from k8s_vgpu_scheduler_tpu.quota.queues import (
     STATE_ADMITTED,
     STATE_HELD,
     QueueConfig,
+    QueueEntry,
     QueueUsage,
+    QuotaManager,
     parse_quota_config,
     queue_for_namespace,
 )
@@ -676,6 +678,54 @@ class TestConcurrency:
                                         for p in pods)
         assert not errors
         s.close()
+
+
+# ---------------------------------------------------------------------------
+# usage accounting from the registry aggregates
+# ---------------------------------------------------------------------------
+
+class TestUsageSnapshot:
+    def test_usage_from_counts_race_window_grant_exactly_once(self):
+        """The quota tick's usage must come from ONE instant: aggregates
+        and granted-uid membership captured under a single lock hold
+        (PodManager.ns_usage_snapshot).  With a live is_granted probe, a
+        grant recorded between the aggregate read and the entry walk was
+        counted in NEITHER term — the admitted entry skipped as granted,
+        the chips absent from the stale aggregates — transiently
+        understating usage past nominal."""
+        from k8s_vgpu_scheduler_tpu.scheduler.pods import PodManager
+
+        mgr = QuotaManager([QueueConfig(
+            name="a", namespaces=("team-a",), nominal_chips=4)])
+        reg = PodManager()
+        reg.add_pod(PodInfo(
+            uid="placed", name="p0", namespace="team-a", node="n0",
+            devices=[[ContainerDevice("c0", "v5e", 100, 0),
+                      ContainerDevice("c1", "v5e", 100, 0)]]))
+        mgr._entries["racing"] = QueueEntry(
+            uid="racing", name="p1", namespace="team-a", queue="a",
+            chips=2, mem_mib=100, state=STATE_ADMITTED)
+        # The tick probes membership only for its ADMITTED entries'
+        # uids (O(entries)) — plus "placed" here to pin the subset
+        # semantics; a full pod-table set copy per tick stalled writers.
+        ns_usage, granted = reg.ns_usage_snapshot(["racing", "placed"])
+        assert granted == {"placed"}
+        assert ns_usage == {"team-a": (2, 200)}
+        # The watch thread lands "racing"'s grant AFTER the snapshot —
+        # exactly the window the live probe miscounted.
+        reg.add_pod(PodInfo(
+            uid="racing", name="p1", namespace="team-a", node="n1",
+            devices=[[ContainerDevice("c0", "v5e", 50, 0),
+                      ContainerDevice("c1", "v5e", 50, 0)]]))
+        u = mgr.usage_from(ns_usage, granted.__contains__)
+        # Snapshot membership: the entry still counts (4 chips total),
+        # instead of vanishing from both terms (2 chips).
+        assert u["a"].chips == 4
+        # A live probe against the post-grant registry reproduces the
+        # undercount the snapshot exists to prevent.
+        live = mgr.usage_from(ns_usage,
+                              lambda uid: reg.get(uid) is not None)
+        assert live["a"].chips == 2
 
 
 # ---------------------------------------------------------------------------

@@ -101,6 +101,25 @@ class TestHistogram:
         assert snap[("filter", "latency-critical")][1] == 1
         assert snap[("filter", "")][1] == 1
 
+    def test_default_buckets_resolve_sub_millisecond(self):
+        """ISSUE 12 satellite: batched cycles put the per-pod decision
+        cost in the tens of microseconds; the phase histograms must
+        resolve that region or p99 is unreadable (pre-fix, everything
+        landed in the first 100µs bucket).  Pinned: the sub-100µs
+        bounds, and that a 20µs observation does NOT land in the first
+        bucket."""
+        from k8s_vgpu_scheduler_tpu.util.trace import DEFAULT_BUCKETS
+
+        assert DEFAULT_BUCKETS[:5] == (0.000005, 0.00001, 0.000025,
+                                       0.00005, 0.0001)
+        assert DEFAULT_BUCKETS == tuple(sorted(DEFAULT_BUCKETS))
+        h = PhaseHistogram()
+        h.observe(0.00002)     # a 20µs batched decision
+        buckets, count, _sum = h.snapshot()
+        assert count == 1
+        assert buckets[0] == ("5e-06", 0)          # not the first bucket
+        assert dict(buckets)["2.5e-05"] == 1       # resolved at 25µs
+
     def test_prometheus_collector_renders_buckets(self, fresh):
         from prometheus_client import CollectorRegistry, generate_latest
         from prometheus_client.registry import Collector

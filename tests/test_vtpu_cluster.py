@@ -223,7 +223,8 @@ def test_grafana_dashboard_uses_real_metric_names():
         referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
     # promql functions + aggregation labels, not metrics
     referenced -= {"rate", "label_values", "node", "histogram_quantile",
-                   "phase", "reason", "clamp_min", "class", "queue"}
+                   "phase", "reason", "clamp_min", "class", "queue",
+                   "lock", "generation"}
 
     missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
@@ -341,9 +342,12 @@ def test_alert_rules_use_real_metric_names():
         assert r["alert"] and r["annotations"]["summary"]
     # promql fns + the scrape-level `up` series' label matcher, whose
     # hyphenated job name tokenizes as "vtpu"/"monitor" — plus the QoS
-    # class label and its hyphenated "latency-critical" value.
+    # class label and its hyphenated "latency-critical" value, and the
+    # perf phase label with its hyphenated "cycle-total" value
+    # (VtpuSchedulerTickStall).
     referenced -= {"rate", "absent", "clamp_min", "min_over_time",
                    "vtpu", "monitor", "histogram_quantile", "sum",
-                   "class", "latency", "critical"}
+                   "class", "latency", "critical", "phase", "cycle",
+                   "total"}
     missing = referenced - _emitted_metrics()
     assert not missing, f"alerts reference unknown metrics: {missing}"
