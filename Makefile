@@ -2,9 +2,10 @@
 # hack/build.sh + a Makefile; here each surface is one target).
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
-        defrag-sim ha-sim qos-sim capacity-sim steady-sim batch-protocol \
-        shard-protocol lint-dashboards dryrun scenarios controlplane \
-        bench-controlplane bench-steady bench wheel clean
+        defrag-sim ha-sim qos-sim capacity-sim steady-sim explain-sim \
+        batch-protocol shard-protocol lint-dashboards dryrun scenarios \
+        controlplane bench-controlplane bench-steady bench-explain \
+        bench wheel clean
 
 all: native
 
@@ -102,6 +103,28 @@ capacity-sim:                 ## forecast + what-if capacity verdicts (simulator
 # the full-scale gate lives in `make bench-steady` → STEADY_<round>.json.
 steady-sim:                   ## sustained-storm invariants through a replica kill
 	python benchmarks/controlplane.py steady-ci
+
+# Decision-provenance chaos verdict through the REAL sharded control
+# plane on the virtual clock (docs/observability.md "Decision
+# provenance"): the ha-sim storm over a 48-node fleet with a seeded
+# mid-run replica kill, then an audit that EVERY terminal pod returns a
+# gap-free /explainz timeline from EVERY surviving replica whose final
+# record agrees with the grant on the annotation WAL — including pods
+# the survivors only know through WAL adoption — plus one deterministic
+# chaos eviction whose final record must carry the rescuer's requester
+# key.  Deterministic (SimClock, seeded kill, no wall-clock in the
+# verdict); gates CI next to ha-sim/steady-sim.
+explain-sim:                  ## gap-free explain timelines through a replica kill
+	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
+	    --workload examples/workload-explain.json --nodes 48 --chips 4 --json \
+	  | python -c "import json,sys; r = json.load(sys.stdin)['ha']; v = r['verdict']; e = r['explain']['verdict']; assert v['ok'] and e['ok'], (v, e); print('explain-sim:', e)"
+
+# The ISSUE 13 emit-overhead gate at full bench scale: decision
+# provenance ON vs --no-provenance, ABBA per-cycle alternation on
+# bench_batch_cycle's drain, pooled-median verdict asserted <2%.
+# Minutes of CPU; not in CI.
+bench-explain:                ## provenance emit-overhead A/B (<2% budget)
+	python benchmarks/controlplane.py provenance-overhead
 
 # Full-scale sustained-storm proof (10k nodes / 100k live pods, replica
 # kill mid-run, /perfz breakdown embedded) + the ≤2% instrumentation-

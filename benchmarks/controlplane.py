@@ -33,6 +33,7 @@ Run:  python benchmarks/controlplane.py        (≈30 s; no chip, no k8s)
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import threading
@@ -583,6 +584,181 @@ def bench_perf_overhead(n_nodes: int = 256, chunk_pods: int = 48,
         "overhead_fraction": round(overhead, 4),
         "budget_fraction": 0.02,
         "passed": overhead <= 0.02,
+    }
+
+
+def bench_provenance_overhead(n_nodes: int = 256, chunk_pods: int = 48,
+                              blocks: int = 96, trials: int = 4) -> dict:
+    """Decision-provenance emit-overhead A/B (ISSUE 13): bench_batch
+    _cycle's drain with the provenance store ON (the production
+    default — every placed pod pays one terminal emit plus the WAL
+    annotation, every no-fit pays the per-node reason capture) vs OFF
+    (ProvenanceStore.enabled=False — exactly what --no-provenance
+    disables).  Budget ≤2%, same as the perf observatory's.
+
+    Measurement design is bench_perf_overhead's, for the same reason
+    (shared-box noise swings whole-run legs 2x): ABBA per-cycle
+    alternation inside ONE warmed-up drain, short ~10ms chunks so
+    host-contention noise multiplies both legs of a block near-equally,
+    GC disabled across the measured window, verdict = pooled median
+    block ratio over all trials (closest-to-1 selection would
+    systematically underestimate — see bench_perf_overhead).
+
+    Refinements over bench_perf_overhead, each forced by null
+    experiments (identical legs, same harness) on a contended box:
+
+    - A FIXED leg order is biased at budget scale: with provenance
+      never touched at all, (x,y,y,x) blocks report the outer legs
+      ~1.5% slower — block-boundary state (allocator/cache, the
+      folder's wake) systematically lands on leg 0.  So each block
+      draws a balanced random on/off pattern (seeded, two of each) and
+      the position effect decorrelates from enabled-ness instead of
+      being booked as emit overhead.
+    - The folder thread folds an enabled leg's segment during the
+      FOLLOWING leg, charging enabled work to whichever leg comes
+      next.  Each leg is therefore fenced with a fold drain
+      (store.pods() folds pending segments synchronously), so a timed
+      leg never pays a neighbor's fold; the fold cost is timed in
+      those fences and gated as its OWN <2% line
+      (``fold_cost_fraction``) beside the decision-path ratio — the
+      emit path's budget is the decision path's (what ``--filter-batch``
+      throughput actually pays); the async folder is background
+      bookkeeping like the rescuer's sweep, measured here cache-cold
+      (conservative: in production it folds segments still warm,
+      overlapped with the drain's GIL-free numpy sections — a live-
+      folder variant of this harness measured the barrier GIL
+      ping-pong, 2x the fold itself, not the fold).
+    - STEADY-STATE legs: each leg's pods are deleted (untimed, after
+      the leg's fence so the fence still times the leg's own fold)
+      before the next leg runs.  Without this the fleet fills
+      monotonically through the run and leg cost drifts upward with
+      fill level — a systematic confound the same order of magnitude
+      as the budget.  The fence-then-delete order matters: a direct
+      informer-path emit drains the inbox inline, so deleting first
+      would silently move fold work into the untimed delete region.
+      The 1000-pod preload matches bench_batch_cycle's average
+      live-pod count, so the per-decision cost the overhead is
+      measured against is the gated bench's, not an empty-fleet best
+      case.
+    - Per-block ratio of leg MINIMA, not sums: host contention on a
+      shared box only ever ADDS time, multi-ms spikes hit single legs
+      (block ratio spread reaches 5x), and with two legs per side the
+      min discards the spiked one.  The pooled median across all
+      blocks/trials is then a far tighter estimator of the true
+      ratio."""
+    import statistics
+
+    def one_trial() -> List[float]:
+        kube = FakeKube()
+        s = Scheduler(kube, Config(filter_batch=True,
+                                   batch_max=chunk_pods))
+        names = [f"node-{i}" for i in range(n_nodes)]
+        for n in names:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n, chips=8, mesh=(4, 2))
+        kube.watch_pods(s.on_pod_event)
+        for i in range(1000):
+            pod = tpu_pod(f"pre{i}", uid=f"preu{i}", mem="200")
+            kube.create_pod(pod)
+            assert s.filter_many([(pod, names)])[0].node
+        import random as _random
+        rng = _random.Random(1309)   # deterministic leg schedule
+        base = [True, True, False, False]
+        ratios: List[float] = []
+        fold_s = [0.0]
+        leg_s = [0.0]
+        uid = [0]
+
+        def chunk():
+            items = []
+            for _ in range(chunk_pods):
+                i = uid[0]
+                uid[0] += 1
+                pod = tpu_pod(f"ab{i}", uid=f"abu{i}", mem="200")
+                kube.create_pod(pod)
+                items.append((pod, names))
+            return items
+
+        import gc as _gc
+
+        try:
+            _gc.collect()
+            _gc.disable()
+            # Park the folder for the measured window: with it live, a
+            # segment emitted mid-leg can fold DURING that or the next
+            # timed leg (GIL time charged to whichever leg is running).
+            # Parked, every fold happens inside a fence below and is
+            # booked to fold_cost_fraction instead of smeared.
+            s.provenance._closed = True
+
+            def fence():
+                # Fold fence, outside the leg clock: drain pending
+                # segments so no timed leg pays a neighbor's fold; the
+                # cost is accounted as fold_cost_fraction.
+                t0 = time.monotonic_ns()
+                s.provenance.pods()
+                fold_s[0] += (time.monotonic_ns() - t0) / 1e9
+
+            for _b in range(blocks):
+                pattern = base[:]
+                rng.shuffle(pattern)
+                cost = []
+                for enabled in pattern:
+                    items = chunk()
+                    s.provenance.enabled = enabled
+                    t0 = time.monotonic_ns()
+                    res = s.filter_many(items)
+                    cost.append((time.monotonic_ns() - t0) / 1e9)
+                    assert all(r.node for r in res), "A/B pod unplaced"
+                    # Fence FIRST (the leg's own fold, booked), then
+                    # restore steady state for the next leg (untimed).
+                    fence()
+                    for pod, _offers in items:
+                        kube.delete_pod(pod["metadata"]["namespace"],
+                                        pod["metadata"]["name"])
+                on = min(c for c, e in zip(cost, pattern) if e)
+                off = min(c for c, e in zip(cost, pattern) if not e)
+                ratios.append(on / off)
+                leg_s[0] += sum(cost)
+        finally:
+            _gc.enable()
+            s.provenance.enabled = True
+            s.close()
+        return ratios, fold_s[0], leg_s[0]
+
+    medians: List[float] = []
+    pooled: List[float] = []
+    fold_total = leg_total = 0.0
+    for _ in range(trials):
+        ratios, fold, legs = one_trial()
+        ratios = ratios[2:]
+        fold_total += fold
+        leg_total += legs
+        medians.append(statistics.median(ratios))
+        pooled.extend(ratios)
+    overhead = max(0.0, statistics.median(pooled) - 1.0)
+    # The async folder's bookkeeping, expressed against the ON legs'
+    # share of the measured time (half the legs are ON and only those
+    # emit) — gated under its own 2% line so a fold regression fails
+    # the bench even though it is off the decision path.
+    fold_fraction = fold_total / (leg_total / 2.0) if leg_total else 0.0
+    return {
+        "nodes": n_nodes, "chunk_pods": chunk_pods,
+        "blocks_per_trial": blocks - 2, "trials": trials,
+        "design": "per-cycle A/B, balanced random leg order per block "
+                  "(seeded), folder parked with fold fences booked to "
+                  "fold_cost_fraction (own <2% gate), steady-state "
+                  "legs (pods deleted untimed after each leg's fence), "
+                  "1000-pod preload, gc off, pooled median of "
+                  "per-block min(on)/min(off) leg ratios",
+        "trial_median_ratios": [round(m, 4) for m in medians],
+        "block_ratio_spread": [round(min(pooled), 3),
+                               round(max(pooled), 3)],
+        "decision_path_overhead_fraction": round(overhead, 4),
+        "fold_cost_fraction": round(fold_fraction, 4),
+        "overhead_fraction": round(overhead, 4),
+        "budget_fraction": 0.02,
+        "passed": overhead <= 0.02 and fold_fraction <= 0.02,
     }
 
 
@@ -1248,5 +1424,16 @@ if __name__ == "__main__":
         verdict = bench_steady_ci()
         print("steady-sim:", json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
+    elif mode == "provenance-overhead":
+        # The ISSUE 13 acceptance gate: the decision-provenance emit
+        # path stays under the established <2% budget on
+        # bench_batch_cycle's drain (instrumented vs --no-provenance,
+        # ABBA).  Minutes of CPU — `make bench-explain`, not CI.
+        out = bench_provenance_overhead()
+        print("provenance-overhead:", json.dumps(out, indent=1))
+        assert out["passed"], (
+            f"provenance emit overhead {out['overhead_fraction']:.2%} "
+            f"over the {out['budget_fraction']:.0%} budget")
+        sys.exit(0)
     else:
         main()

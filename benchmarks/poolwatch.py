@@ -319,6 +319,62 @@ def snapshot_perf() -> None:
         f"({len(doc['phases'])} phase(s), {len(doc['locks'])} lock(s))")
 
 
+def snapshot_explain() -> None:
+    """Decision-provenance capture (docs/observability.md "Decision
+    provenance"): during any healthy window, snapshot a LIVE
+    scheduler's /explainz for the OLDEST pending pod — the one whose
+    causal chain has accumulated the most real-fleet decision records —
+    into benchmarks/captured-explain-<round>.json.  The oldest pending
+    pod is position 1 of the lowest-fair-share queue on /queuez (the
+    admission loop releases in fair-share order, so the head that has
+    waited longest sits where shares are thinnest).  Pure HTTP + JSON —
+    never touches the chip or the pool claim; skips loudly when nothing
+    is pending or no scheduler is reachable."""
+    url = os.environ.get("VTPU_SCHED_URL", "")
+    if not url:
+        log("explain snapshot: VTPU_SCHED_URL unset; skipping")
+        return
+    import urllib.parse
+    import urllib.request
+
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    try:
+        with urllib.request.urlopen(base + "/queuez", timeout=10) as r:
+            queues = json.load(r)
+    except Exception as e:  # noqa: BLE001 — capture is best-effort
+        log(f"explain snapshot: cannot fetch {base}/queuez: {e!r}")
+        return
+    pending = [(row["fair_share"], row["queue"], p["pod"])
+               for row in queues.get("queues", [])
+               for p in row.get("pending_pods", [])
+               if p.get("position") == 1]
+    if not pending:
+        log("explain snapshot: no pending pods; skipping")
+        return
+    _share, queue, pod = min(pending)
+    try:
+        with urllib.request.urlopen(
+                base + "/explainz?pod="
+                + urllib.parse.quote(pod, safe=""), timeout=10) as r:
+            doc = json.load(r)
+    except Exception as e:  # noqa: BLE001 — capture is best-effort
+        log(f"explain snapshot: cannot fetch /explainz for {pod}: {e!r}")
+        return
+    if not doc.get("records"):
+        log(f"explain snapshot: no records for {pod}; skipping")
+        return
+    out = os.path.join(REPO, "benchmarks",
+                       f"captured-explain-{round_id()}.json")
+    with open(out, "w") as f:
+        json.dump({"captured_at": time.time(), "pod": pod,
+                   "queue": queue, "explainz": doc}, f, indent=1)
+    log(f"explain snapshot: wrote {out} ({pod}: "
+        f"{len(doc['records'])} record(s), "
+        f"dominant {doc.get('dominant_rejection')!r})")
+
+
 def run_queue(kinds) -> bool:
     """Run the queue sequentially; False if a child overran or left a
     detached claim-holder (stop — the pool claim may still be held)."""
@@ -330,6 +386,8 @@ def run_queue(kinds) -> bool:
         snapshot_capacity_scenario()
     if "perf" in kinds:
         snapshot_perf()
+    if "explain" in kinds:
+        snapshot_explain()
 
     tmpdir = tempfile.mkdtemp(prefix="poolwatch-")
     env = bench.shim_env(tmpdir)
@@ -438,8 +496,9 @@ def main() -> None:
                     help="seconds between probes while wedged")
     ap.add_argument("--probe-window", type=float, default=300.0)
     ap.add_argument("--max-hours", type=float, default=6.0)
-    ap.add_argument("--tasks",
-                    default="bench,model,micro,scen,oversub,capacity,perf")
+    ap.add_argument(
+        "--tasks",
+        default="bench,model,micro,scen,oversub,capacity,perf,explain")
     a = ap.parse_args()
     # One round identity for the whole run: model_tasks' per-round retry
     # markers and run_queue's scenario children both read SCENARIO_ROUND,

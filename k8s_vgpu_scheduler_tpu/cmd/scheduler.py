@@ -257,6 +257,26 @@ def parse_args(argv=None):
                         "overhead budget is <=2%% on bench_batch_cycle "
                         "— this is the escape hatch and the overhead "
                         "A/B's baseline)")
+    p.add_argument("--no-provenance", action="store_true",
+                   help="disable decision provenance (the per-pod "
+                        "explain timelines behind GET /explainz and "
+                        "vtpu-explain; emit budget is <2%% on "
+                        "bench_batch_cycle — this is the escape hatch "
+                        "and the overhead A/B's baseline)")
+    p.add_argument("--provenance-per-pod", type=int, default=64,
+                   help="records kept per pod timeline (a ring; older "
+                        "records retire and are counted as truncated)")
+    p.add_argument("--provenance-max-pods", type=int, default=8192,
+                   help="fleet-wide timeline cap with LRU retirement — "
+                        "the store never exceeds max-pods x per-pod "
+                        "records")
+    p.add_argument("--explain-event-grace", type=float, default=60.0,
+                   help="emit an Unschedulable kube Event (top "
+                        "rejection reasons with node counts) once a "
+                        "pod has stayed unplaced this long")
+    p.add_argument("--explain-event-throttle", type=float, default=300.0,
+                   help="at most one Unschedulable event per pod per "
+                        "this many seconds while it stays unplaced")
     p.add_argument("--perf-tracemalloc", action="store_true",
                    help="opt-in tracemalloc allocation tracking: "
                         "/perfz then carries the top allocation sites "
@@ -340,6 +360,11 @@ def build_config(args) -> Config:
         enable_debug=args.debug,
         perf_enabled=not args.no_perf,
         perf_tracemalloc=args.perf_tracemalloc,
+        provenance_enabled=not args.no_provenance,
+        provenance_per_pod=args.provenance_per_pod,
+        provenance_max_pods=args.provenance_max_pods,
+        explain_event_grace_s=args.explain_event_grace,
+        explain_event_throttle_s=args.explain_event_throttle,
         optimistic_commit=not args.serial_filter,
         filter_workers=args.filter_workers,
         commit_retries=args.commit_retries,

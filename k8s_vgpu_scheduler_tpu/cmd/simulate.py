@@ -1783,6 +1783,10 @@ def run_ha_phase(spec: dict, *, nodes: int, chips: int, hbm: int,
         "no_grant_duplicated": not duplicated,
         "no_overbooking": not overbooked,
     }
+    explain = None
+    if spec.get("explain"):
+        explain = _audit_explain(reps, alive, kube)
+        verdict["explain_ok"] = explain["verdict"]["ok"]
     verdict["ok"] = all(verdict.values())
     result = {
         "seed": seed,
@@ -1809,9 +1813,129 @@ def run_ha_phase(spec: dict, *, nodes: int, chips: int, hbm: int,
         "overbooked_chips": overbooked,
         "verdict": verdict,
     }
+    if explain is not None:
+        result["explain"] = explain
     for s in reps:
         s.close()
     return result
+
+
+def _audit_explain(reps: List[Scheduler], alive: List[int],
+                   kube: FakeKube) -> dict:
+    """The explain-sim verdict (ISSUE 13): after an ha storm with a
+    mid-run replica kill, EVERY terminal pod must return a gap-free
+    ``/explainz`` timeline from EVERY surviving replica, with a
+    terminal record agreeing with the actual grant on the annotation
+    WAL — including pods the replica never scheduled (adopted or
+    mirrored through the WAL).  Then one deterministic chaos eviction
+    proves the eviction side: the rescued pod's final record must name
+    the rescuer's requester key.  Deterministic by construction: the
+    report carries stages and counts, never wall-clock stamps."""
+    pods = sorted(kube.list_pods(),
+                  key=lambda p: p["metadata"]["name"])
+    total = 0
+    explained = 0
+    gap_free = 0
+    terminal_agree = 0
+    wal_adopted = 0
+    bad: List[dict] = []
+    terminal_stages = ("decision-committed", "wal-adopted")
+    for p in pods:
+        name = p["metadata"]["name"]
+        node_now = p.get("metadata", {}).get("annotations", {}).get(
+            "vtpu.dev/assigned-node", "")
+        if not node_now:
+            continue
+        total += 1
+        ok_everywhere = True
+        gaps = True
+        agrees = True
+        for i in alive:
+            doc = reps[i].export_explain(f"sim/{name}")
+            if doc is None or not doc.get("records"):
+                ok_everywhere = False
+                bad.append({"pod": name, "replica": i,
+                            "why": "no timeline"})
+                continue
+            if not doc["gap_free"]:
+                gaps = False
+                bad.append({"pod": name, "replica": i, "why": "gap"})
+            grant_recs = [r for r in doc["records"]
+                          if r["stage"] in terminal_stages]
+            if not grant_recs or \
+                    grant_recs[-1]["detail"].get("node") != node_now:
+                agrees = False
+                bad.append({"pod": name, "replica": i,
+                            "why": "terminal-mismatch",
+                            "expected": node_now,
+                            "records": [r["stage"]
+                                        for r in doc["records"]]})
+        owner_doc = None
+        for i in alive:
+            d = reps[i].export_explain(f"sim/{name}")
+            if d and d["records"] and \
+                    d["records"][0]["stage"] != "wal-adopted":
+                owner_doc = d
+                break
+        if owner_doc is None:
+            # Placed by the killed replica: every survivor knows it
+            # only through the WAL — the continuity the verdict exists
+            # to prove.
+            wal_adopted += 1
+        if ok_everywhere:
+            explained += 1
+        if gaps:
+            gap_free += 1
+        if agrees:
+            terminal_agree += 1
+    # Deterministic chaos eviction: rescue the first placed pod off a
+    # survivor-owned node and require its final record to carry the
+    # rescuer's requester key.
+    evict = {"pod": None, "final_stage": None, "requester": None,
+             "ok": False}
+    for p in pods:
+        name = p["metadata"]["name"]
+        node_now = p.get("metadata", {}).get("annotations", {}).get(
+            "vtpu.dev/assigned-node", "")
+        if not node_now:
+            continue
+        owner = next((i for i in alive
+                      if reps[i].shards.owns(node_now)), None)
+        if owner is None:
+            continue
+        uid = p["metadata"]["uid"]
+        reps[owner].rescuer.enqueue(uid, "chaos-explain")
+        reps[owner].rescuer.sweep()
+        doc = reps[owner].export_explain(uid)
+        final = doc["final"] if doc else None
+        evict = {
+            "pod": name,
+            "final_stage": final["stage"] if final else None,
+            "requester": (final["detail"].get("requester")
+                          if final else None),
+            "ok": bool(final and final["stage"] == "rescued"
+                       and final["detail"].get("requester")
+                       == "rescue:chaos-explain"),
+        }
+        break
+    verdict = {
+        "all_explained": explained == total and total > 0,
+        "all_gap_free": gap_free == total,
+        "all_terminal_agree": terminal_agree == total,
+        "wal_continuity_exercised": wal_adopted > 0,
+        "eviction_final_record_ok": evict["ok"],
+    }
+    verdict["ok"] = all(verdict.values())
+    return {
+        "terminal_pods": total,
+        "explained_on_every_survivor": explained,
+        "gap_free": gap_free,
+        "terminal_agree": terminal_agree,
+        "wal_adopted_only": wal_adopted,
+        "eviction": evict,
+        "failures": bad[:16],
+        "verdict": verdict,
+    }
 
 
 def format_serving(sv: dict) -> str:
@@ -1994,6 +2118,17 @@ def format_report(result: dict) -> str:
         if hr["overbooked_chips"]:
             lines.append("  OVERBOOKED: "
                          + ", ".join(hr["overbooked_chips"]))
+        ex = hr.get("explain")
+        if ex:
+            ev = ex["verdict"]
+            lines.append(
+                "  explain: {}/{} terminal pod(s) gap-free on every "
+                "survivor, {} known only via the WAL; eviction final "
+                "record {} — {}".format(
+                    ex["explained_on_every_survivor"],
+                    ex["terminal_pods"], ex["wal_adopted_only"],
+                    ex["eviction"]["final_stage"],
+                    "PASS" if ev["ok"] else f"FAIL {ev}"))
         lines.append("  verdict: " + ("PASS" if v["ok"] else f"FAIL {v}"))
         return "\n".join(lines)
     qr = result.get("queueing")

@@ -1,0 +1,200 @@
+"""vtpu-explain — render one pod's decision-provenance timeline.
+
+Fetches the scheduler's ``GET /explainz?pod=<namespace/name>`` export
+(provenance/store.py) and renders the machine-readable record timeline
+as a human-readable causal narrative: webhook stamp → quota hold/release
+→ shard gates → per-cycle filter verdicts with the concrete per-node
+rejection reasons → the batch solver's chosen-vs-runner-up → commit (or
+CAS failure) → eviction/rescue with the requester key.  The triage
+runbook in docs/operations.md ("pod stuck pending") walks this output.
+
+Usage:
+  vtpu-explain my-namespace/my-pod --cluster http://sched:9443
+  vtpu-explain --uid <pod uid> --cluster ...       # deleted pods too
+  vtpu-explain my-ns/my-pod --cluster ... --json   # the raw timeline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from ..provenance.store import reason_tally
+
+
+def fetch_explain(cluster: str, ref: str, by_uid: bool = False) -> dict:
+    """GET /explainz for one pod.  Raises on transport errors; a 404
+    comes back as the scheduler's JSON error document (the caller
+    renders it — "never seen" is itself an answer)."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    url = cluster.rstrip("/")
+    if "://" not in url:
+        url = "http://" + url
+    if not url.endswith("/explainz"):
+        url += "/explainz"
+    key = "uid" if by_uid else "pod"
+    url += f"?{key}={urllib.parse.quote(ref, safe='')}"
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return json.load(e)
+        raise
+
+
+def _score(x) -> str:
+    """Solver scores ride the record raw (the emit path must not pay
+    rounding); trim them for display only."""
+    return f"{x:.6g}" if isinstance(x, float) else str(x)
+
+
+#: stage -> one-line narrator.  Unknown stages fall back to a generic
+#: rendering, so a newer scheduler's records never crash an older CLI.
+def _narrate(stage: str, d: dict) -> str:
+    if stage == "webhook":
+        bits = [f"admitted by the webhook (trace {d.get('trace_id', '')[:8]})"]
+        if d.get("qos"):
+            bits.append(f"QoS class {d['qos']}")
+        if d.get("mesh"):
+            bits.append(f"declared mesh {d['mesh']}")
+        if d.get("queue"):
+            bits.append(f"governed by capacity queue {d['queue']}")
+        return "; ".join(bits)
+    if stage == "quota-hold":
+        return f"held by quota: {d.get('reason', '')}"
+    if stage == "quota-released":
+        out = (f"released from queue {d.get('queue')} by fair-share "
+               f"admission (share {d.get('fair_share')}, release "
+               f"#{d.get('release_seq')})")
+        if d.get("backfilled"):
+            out += " as gang backfill"
+        if d.get("borrowed_after"):
+            out += f"; queue now borrows {d['borrowed_after']} chip(s)"
+        return out
+    if stage in ("filter-rejected", "batch-no-fit"):
+        via = ("the batched cycle's eligibility matrix"
+               if stage == "batch-no-fit" else "the filter sweep")
+        reasons = d.get("reasons") or {}
+        if not reasons:
+            return f"rejected by {via}: {d.get('error', 'no fit')}"
+        top = ", ".join(f"{tok} on {n} node(s)"
+                        for tok, n in reason_tally(reasons)[:3])
+        lines = [f"rejected by {via}: {top}"]
+        for node, why in sorted(reasons.items()):
+            lines.append(f"      {node}: {why}")
+        if d.get("preempting"):
+            lines.append("      (a preemption plan was issued to make "
+                         "room)")
+        return "\n".join(lines)
+    if stage == "preemption-planned":
+        return (f"planned preemption of {len(d.get('victims', []))} "
+                f"pod(s) on {d.get('node')} to make room: "
+                f"{', '.join(d.get('victims', []))}")
+    if stage == "preempt-requested":
+        return (f"asked to checkpoint and exit: requester "
+                f"{d.get('requester_pod') or d.get('requester')} needs "
+                f"this capacity on {d.get('node')}")
+    if stage == "preempt-rescinded":
+        return (f"eviction rescinded (requester {d.get('requester')} "
+                "no longer needs the room)")
+    if stage == "unschedulable-event":
+        return (f"Unschedulable event emitted: {d.get('reasons_top')}")
+    if stage == "batch-solved":
+        return (f"batch solver chose this pod's node (score "
+                f"{_score(d.get('score'))}, runner-up "
+                f"{_score(d.get('runner_up'))})")
+    if stage == "decision-committed":
+        out = f"decision committed: placed on {d.get('node')}"
+        if d.get("solver") is not None:
+            ru = d.get("runner_up")
+            out += (f" by the {d['solver']} solver (score "
+                    f"{_score(d.get('score'))}"
+                    + (f", runner-up {_score(ru)})" if ru is not None
+                       else ", the only feasible node)"))
+        return out
+    if stage == "decision-write-failed":
+        return (f"decision on {d.get('node')} NOT committed: "
+                f"{d.get('error')} — pod requeued")
+    if stage == "wal-adopted":
+        by = d.get("decided_by") or "a previous scheduler"
+        return (f"adopted from the decision-annotation WAL: placed on "
+                f"{d.get('node')} by {by} (this replica never ran the "
+                "decision)")
+    if stage in ("rescue-queued", "rescue-checkpoint-requested",
+                 "rescued"):
+        verb = {"rescue-queued": "queued for rescue",
+                "rescue-checkpoint-requested":
+                    "asked to checkpoint for rescue",
+                "rescued": "grant rescinded by the rescuer"}[stage]
+        return (f"{verb} off {d.get('node')}: {d.get('reason')} "
+                f"(requester {d.get('requester')})")
+    if stage == "deleted":
+        return "pod deleted / terminated"
+    return ", ".join(f"{k}={v}" for k, v in d.items()) or stage
+
+
+def render_narrative(doc: dict) -> str:
+    """The human-readable causal narrative for one /explainz doc."""
+    if "records" not in doc:
+        extra = ("" if doc.get("enabled", True) else
+                 " (provenance is DISABLED on this scheduler: "
+                 "--no-provenance)")
+        return f"vtpu-explain: {doc.get('error', 'no data')}{extra}"
+    lines = [f"decision provenance for {doc['pod']} (uid {doc['uid']})"]
+    if not doc.get("gap_free", True):
+        lines.append(f"  ! timeline truncated: {doc.get('truncated')} "
+                     "older record(s) retired by the per-pod ring")
+    if doc.get("dominant_rejection"):
+        lines.append(f"  dominant rejection reason: "
+                     f"{doc['dominant_rejection']}")
+    for rec in doc.get("records", []):
+        stamp = time.strftime("%H:%M:%S",
+                              time.localtime(rec.get("t", 0)))
+        lines.append(f"  [{rec['seq']:>3}] {stamp} "
+                     f"{_narrate(rec['stage'], rec.get('detail', {}))}")
+    final = doc.get("final")
+    if final is not None:
+        lines.append(f"  => final: {final['stage']}"
+                     + (f" on {final['detail']['node']}"
+                        if final["detail"].get("node") else ""))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("vtpu-explain")
+    p.add_argument("pod", nargs="?", default="",
+                   help="namespace/name of the pod to explain")
+    p.add_argument("--uid", default="",
+                   help="explain by pod uid instead (works for deleted "
+                        "pods still in the store's retention)")
+    p.add_argument("--cluster", required=True,
+                   help="extender HTTP base URL (the /explainz "
+                        "endpoint), e.g. http://sched:9443")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="raw machine-readable timeline")
+    args = p.parse_args(argv)
+    if not args.pod and not args.uid:
+        p.error("need a namespace/name or --uid")
+    try:
+        doc = fetch_explain(args.cluster, args.uid or args.pod,
+                            by_uid=bool(args.uid))
+    except (OSError, ValueError) as e:
+        print(f"vtpu-explain: cannot fetch /explainz: {e}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(render_narrative(doc))
+    return 0 if "records" in doc else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,52 @@ def fetch_capacity(cluster: str) -> Optional[dict]:
         return None
 
 
+def fetch_explain(cluster: str, ref: str) -> Optional[dict]:
+    """GET /explainz for one pod, or None when the scheduler predates
+    decision provenance / runs --no-provenance / never saw the pod —
+    the pending table then shows '-' instead of a dominant reason."""
+    import urllib.parse
+    import urllib.request
+
+    url = _base_url(cluster)
+    if not url.endswith("/explainz"):
+        url += "/explainz"
+    url += f"?pod={urllib.parse.quote(ref, safe='')}"
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            doc = json.load(r)
+    except Exception:  # noqa: BLE001 — provenance surface is optional
+        return None
+    return doc if "records" in doc else None
+
+
+def join_pending_reasons(export: dict, cluster: str,
+                         fetch=fetch_explain) -> dict:
+    """The pending-pods table: every held entry from the /queuez rows,
+    annotated with its dominant rejection reason from /explainz —
+    'why exactly is each of these pods waiting' in one view.  One
+    /explainz fetch per pending pod (they are few by construction:
+    position-ordered queue heads, not the fleet)."""
+    rows = []
+    for q in export.get("queues", []):
+        for p in q.get("pending_pods", []):
+            doc = fetch(cluster, p["pod"])
+            reason = None
+            if doc is not None:
+                reason = doc.get("dominant_rejection")
+                if reason is None and doc.get("final"):
+                    # Never rejected: the newest stage IS the story
+                    # (quota-hold, rescue-queued, ...).
+                    reason = doc["final"]["stage"]
+            rows.append({"pod": p["pod"], "queue": q["queue"],
+                         "position": p["position"], "chips": p["chips"],
+                         "gang": p.get("gang"),
+                         "dominant_rejection": reason or "-"})
+    if rows:
+        export["pending_pods"] = rows
+    return export
+
+
 def join_quota(export: dict, queues: Optional[dict]) -> dict:
     """Annotate each namespace showback row with its governing queue's
     quota utilization (nominal vs held vs borrowed) — the 'measured'
@@ -199,6 +245,17 @@ def format_report(export: dict, pods: bool = False,
                     q["queue"][:14], q["weight"], q["nominal_chips"],
                     q["held_chips"], q["borrowed_chips"], q["pending"],
                     q["fair_share"], measured, over))
+    if export.get("pending_pods"):
+        lines.append("+ pending pods (dominant rejection from /explainz"
+                     "; vtpu-explain <ns/name> for the full timeline)")
+        lines.append(
+            "| {:<30s} {:<12s} {:>3s} {:>5s} {:<24s} |".format(
+                "pod", "queue", "pos", "chips", "why pending"))
+        for row in export["pending_pods"]:
+            lines.append(
+                "| {:<30s} {:<12s} {:>3d} {:>5d} {:<24s} |".format(
+                    row["pod"][:30], row["queue"][:12], row["position"],
+                    row["chips"], row["dominant_rejection"][:24]))
     if pods:
         lines.append("+ pods")
         for row in export.get("pods", []):
@@ -244,10 +301,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "silently reporting frozen totals")
     p.add_argument("--no-capacity", action="store_true",
                    help="skip the GET /capacityz capacity section")
+    p.add_argument("--explain", default="", metavar="NS/NAME",
+                   help="render one pod's decision-provenance timeline "
+                        "(the vtpu-explain narrative) instead of the "
+                        "showback report")
+    p.add_argument("--no-explain", action="store_true",
+                   help="skip the per-pending-pod GET /explainz joins "
+                        "in the pending-pods table")
     fmt = p.add_mutually_exclusive_group()
     fmt.add_argument("--json", action="store_true", dest="as_json")
     fmt.add_argument("--csv", action="store_true", dest="as_csv")
     args = p.parse_args(argv)
+
+    if args.explain:
+        # Passthrough to the decision-provenance surface: one pod's
+        # timeline, rendered by the same narrator vtpu-explain uses.
+        from .vtpu_explain import fetch_explain as fetch_full
+        from .vtpu_explain import render_narrative
+        try:
+            doc = fetch_full(args.cluster, args.explain)
+        except (OSError, ValueError) as e:
+            print(f"vtpu-report: cannot fetch /explainz: {e}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(doc, indent=1) if args.as_json
+              else render_narrative(doc))
+        return 0 if "records" in doc else 1
 
     try:
         export = fetch_usage(args.cluster, args.window)
@@ -255,6 +334,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"vtpu-report: cannot fetch usage: {e}", file=sys.stderr)
         return 2
     export = join_quota(export, fetch_queues(args.cluster))
+    if not args.no_explain:
+        export = join_pending_reasons(export, args.cluster)
     if not args.no_capacity:
         cap = fetch_capacity(args.cluster)
         if cap is not None:

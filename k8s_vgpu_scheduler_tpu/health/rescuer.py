@@ -122,6 +122,9 @@ class Rescuer:
             self._queue[uid] = RescueItem(
                 uid=uid, namespace=namespace, name=name, node=node,
                 reason=reason, enqueued_at=self._clock())
+        self.s.provenance.emit(uid, "rescue-queued", namespace=namespace,
+                               name=name, node=node, reason=reason,
+                               requester=RESCUE_VALUE_PREFIX + reason)
         log.warning("rescue queued for %s/%s (uid %s): %s", namespace,
                     name, uid, reason)
         return True
@@ -354,6 +357,11 @@ class Rescuer:
             if queued is not None:
                 queued.asked_at = self._clock()
         item.asked_at = self._clock()
+        self.s.provenance.emit(
+            item.uid, "rescue-checkpoint-requested",
+            namespace=item.namespace, name=item.name, node=item.node,
+            reason=item.reason,
+            requester=RESCUE_VALUE_PREFIX + item.reason)
         log.warning("rescue: asked %s/%s to checkpoint and exit (%s)",
                     item.namespace, item.name, item.reason)
         return True
@@ -392,6 +400,10 @@ class Rescuer:
                     item.node, item.reason)
         trace.tracer().event(item.uid, "rescued", pod=item.name,
                              node=item.node, reason=item.reason)
+        self.s.provenance.emit(
+            item.uid, "rescued", namespace=item.namespace,
+            name=item.name, node=item.node, reason=item.reason,
+            requester=RESCUE_VALUE_PREFIX + item.reason)
         return True
 
     def _done(self, item: RescueItem) -> None:

@@ -36,6 +36,7 @@ log = logging.getLogger(__name__)
 
 _POD_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods(?:/([^/]+))?(/binding)?$")
 _NODE_RE = re.compile(r"^/api/v1/nodes(?:/([^/]+))?$")
+_EVENTS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events$")
 
 
 def _apply_field_selector(items: list, query: dict) -> list:
@@ -215,6 +216,28 @@ class _Handler(BaseHTTPRequestHandler):
                         resource_version=meta.get("resourceVersion"),
                     ),
                 )
+            else:
+                self._reply(405, {"message": "method not allowed"})
+            return
+
+        m = _EVENTS_RE.match(path)
+        if m:
+            # core/v1 Events (RestKube.create_event's shape): store on
+            # the backing FakeKube so e2e drives can assert the
+            # Queued/Admitted/Unschedulable surfaces; GET lists them
+            # (kubectl-describe stand-in).
+            if method == "POST":
+                ev = self._body()
+                self.kube.create_event(
+                    m.group(1), ev.get("involvedObject", {}),
+                    ev.get("reason", ""), ev.get("message", ""),
+                    type_=ev.get("type", "Normal"))
+                self._reply(201, ev)
+            elif method == "GET":
+                with self.kube._lock:
+                    items = [e for e in self.kube.events
+                             if e["namespace"] == m.group(1)]
+                self._reply(200, {"kind": "EventList", "items": items})
             else:
                 self._reply(405, {"message": "method not allowed"})
             return

@@ -1,0 +1,19 @@
+"""Decision provenance: gap-free per-pod explain timelines.
+
+Every decision point in the control plane — webhook stamp, quota
+hold/release, shard gate, per-cycle filter verdicts, the batch solver's
+chosen-vs-runner-up, commit CAS failures, preemption/rescue/reclaim —
+emits one structured record into a bounded per-pod timeline store, so
+"why is my pod pending / why did it land on node X / why was it
+evicted?" has a machine-readable answer (``GET /explainz``) and a
+human-readable one (``vtpu-explain``) without reading six subsystems.
+
+See docs/observability.md "Decision provenance".
+"""
+
+from .store import (  # noqa: F401
+    TERMINAL_STAGES,
+    ProvenanceConfig,
+    ProvenanceStore,
+    reason_tally,
+)

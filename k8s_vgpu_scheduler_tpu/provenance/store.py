@@ -1,0 +1,572 @@
+"""Bounded per-pod decision-timeline store.
+
+Design constraints (docs/observability.md "Decision provenance"):
+
+- **Hot-path cheap.**  Provenance rides every scheduling decision; the
+  budget is <2% on bench_batch_cycle (``make bench-explain`` asserts
+  it), which at batched-cycle decision rates leaves only a couple of
+  microseconds per decision — less than the two dict probes a
+  synchronous per-pod timeline append costs.  So the batched front
+  door pays only for HANDING OVER a cycle's records: one list of
+  prebuilt tuples per cycle into :meth:`emit_many`, which enqueues the
+  segment (a GIL-atomic deque append + an event set) and returns.  A
+  background **folder thread** — the rescuer/admission-loop discipline
+  — does the timeline bookkeeping (per-pod rings, seq numbers, the LRU
+  cap) off the decision path.  Ordering and visibility stay exact:
+  every READ and every direct :meth:`emit` drains the inbox under the
+  store lock first, so causally-later records always fold later and a
+  reader can never observe a record the decision path has already
+  handed over as missing.  With the store disabled
+  (``--no-provenance``) an emit is a single attribute read — the
+  overhead A/B's baseline leg.
+- **Provably bounded.**  Per pod: a ring of ``per_pod`` records (a
+  plain list trimmed with hysteresis — the list may overshoot to
+  1.5×``per_pod`` before one bulk trim cuts it back, so the O(ring)
+  front-shift amortizes over ring/2 appends instead of recurring per
+  append; readers always see the newest ``per_pod``; older records
+  retire and the derived truncation count says what was lost).
+  Fleet-wide: at most ``max_pods`` timelines with second-chance
+  (CLOCK) retirement — LRU-approximating, chosen because an exact LRU
+  queue pays a tuple allocation and queue surgery per RECORD while the
+  clock hand pays one list store; a pod storm cannot grow the store
+  past ``max_pods × per_pod`` records and the clock queue holds
+  exactly one entry per live timeline.
+  The unfolded inbox is bounded too: past ``_INBOX_SEGMENTS`` pending
+  segments (folder thread stalled — never seen in practice),
+  ``emit_many`` folds inline instead of growing the queue, so no
+  record is ever silently dropped and the inbox can never exceed
+  ``_INBOX_SEGMENTS × batch size`` records.
+- **Gap-free by construction.**  Records carry a per-pod sequence
+  number assigned at fold time under the store lock (segments fold
+  FIFO, whole-segment-at-a-time, so fold order IS emit order); a
+  timeline is gap-free exactly when its surviving records are
+  contiguous and the ring dropped nothing.  The explain doc computes
+  and reports both, so the explain-sim chaos verdict can assert them.
+- **Replica-death continuity.**  A committed decision's terminal facts
+  already ride the decision-annotation WAL — ``vtpu.dev/assigned-node``
+  names the grant, ``vtpu.dev/shard-owner`` the replica that wrote it,
+  ``vtpu.dev/assigned-time`` when — so an adopting replica's informer
+  replay seeds a fresh timeline from the annotations it replays anyway
+  (:meth:`seed_from_wal`), and ``/explainz`` answers for pods this
+  process never scheduled.  No dedicated provenance annotation exists:
+  adding one would duplicate those three keys onto every decision
+  write for zero information.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+#: Stages that record a committed grant — the terminal the informer's
+#: WAL-seed guard and the explain-sim final-record audit key on.
+TERMINAL_STAGES = ("decision-committed", "wal-adopted")
+
+#: Timeline slots (a plain list — a class constructor per new pod costs
+#: more than the rest of the fold step together).  _TOUCH is bumped on
+#: every append after admission; _CHANCE is where the clock hand last
+#: considered the pod — _TOUCH > _CHANCE means "touched since", worth
+#: a second chance at retirement time.
+_NS, _NAME, _RECS, _SEQ, _TOUCH, _CHANCE = 0, 1, 2, 3, 4, 5
+
+#: Inline-fold backstop: emit_many stops enqueueing and folds inline
+#: once this many segments are pending (the folder thread would have to
+#: be wedged for seconds).  Bounds the unfolded inbox at
+#: _INBOX_SEGMENTS × batch size records with zero silent drops.
+_INBOX_SEGMENTS = 64
+
+
+class ProvenanceConfig:
+    """Bounds + enable switch (Config.provenance_* / --no-provenance)."""
+
+    __slots__ = ("per_pod", "max_pods", "enabled", "trim_at")
+
+    def __init__(self, per_pod: int = 64, max_pods: int = 8192,
+                 enabled: bool = True) -> None:
+        self.per_pod = max(4, per_pod)
+        self.max_pods = max(16, max_pods)
+        self.enabled = enabled
+        #: Ring-trim hysteresis: a timeline list may grow to this many
+        #: records before one bulk trim cuts it back to ``per_pod`` —
+        #: readers only ever see the newest ``per_pod``.
+        self.trim_at = self.per_pod + max(2, self.per_pod // 2)
+
+
+class ProvenanceStore:
+    """Per-process decision-timeline store (one per Scheduler)."""
+
+    def __init__(self, cfg: Optional[ProvenanceConfig] = None) -> None:
+        self.cfg = cfg or ProvenanceConfig()
+        #: Mutable enable switch — the overhead A/B toggles it per leg;
+        #: --no-provenance sets it False for the process lifetime.
+        self.enabled = self.cfg.enabled
+        self._lock = threading.Lock()
+        #: uid -> [namespace, name, records list, next_seq, touch,
+        #: chance].  A record is (seq, wall time, stage, detail dict) —
+        #: detail stored by reference; emitters hand over throwaway
+        #: dicts.  A PLAIN dict: an OrderedDict's per-insert
+        #: linked-list bookkeeping costs ~4x the rest of the fold step
+        #: on the admit-heavy path, and delete-first on a plain dict
+        #: walks an ever-growing tombstone prefix.  Recency lives in
+        #: the _clock queue instead (second-chance retirement).
+        self._timelines: Dict[str, list] = {}
+        #: Second-chance (CLOCK) retirement queue: exactly one uid per
+        #: live timeline, appended at admit.  A touch is ONE list store
+        #: on the timeline (_TOUCH = tick) — no queue surgery, no
+        #: tuple — and retirement pops the head, requeueing pods
+        #: touched since their last consideration (_TOUCH > _CHANCE)
+        #: instead of retiring them.  Bounded by construction: admits
+        #: append, forget leaves a stale entry the next retirement pass
+        #: discards, requeues conserve the one-entry-per-pod invariant.
+        self._clock: deque = deque()
+        #: Recency epoch: bumped once per fold call / direct emit, not
+        #: per record — second-chance granularity, not a total order.
+        self._tick = 0
+        #: Unfolded (wall time, records) segments from emit_many,
+        #: drained FIFO by the folder thread / any read / any direct
+        #: emit.  Appends are GIL-atomic; draining pops under _lock.
+        self._inbox: deque = deque()
+        self._wake = threading.Event()
+        self._folder: Optional[threading.Thread] = None
+        self._closed = False
+        #: "ns/name" -> uid, rebuilt lazily on the first resolve after
+        #: any admit/forget (reads are operator-path; the fold loop
+        #: must not pay an f-string + dict store per record).  Last
+        #: writer wins on rebuild — a reused pod name points at the
+        #: live incarnation; old uids stay queryable directly.
+        self._by_name: Dict[str, str] = {}
+        self._names_dirty = False
+        #: uid -> node of its newest terminal-grant record
+        #: (decision-committed / wal-adopted).  The informer's WAL-seed
+        #: guard reads it lock-free (GIL-atomic dict probe) to decide
+        #: whether a pod's committed decision is already in the
+        #: timeline — so a replica that earlier only REJECTED the pod
+        #: (shard-not-owned) still absorbs the peer's grant.  Updated
+        #: at fold time; the window between hand-over and fold can cost
+        #: one redundant (deduped, correctly-ordered) wal-adopted seed,
+        #: never a wrong answer.
+        self._last_grant: Dict[str, str] = {}
+        #: Solver name of the newest folded cycle segment — cycle
+        #: records carry raw hand-over tuples; the explain read path
+        #: stamps this into their normalized detail.
+        self._solver = ""
+        #: Lifetime counters (observable: /explainz meta, tests).
+        self.emitted_total = 0
+        self.retired_pods_total = 0
+
+    # -- recording -------------------------------------------------------------
+    def emit(self, uid: str, stage: str, namespace: str = "",
+             name: str = "", dedupe: bool = False, **detail) -> None:
+        """Append one record to ``uid``'s timeline (direct fold — the
+        slow-path emitters: rejections, quota, evictions, rescue).
+        Drains the inbox first so records enqueued by earlier batched
+        cycles keep their place before this one.  ``dedupe=True`` skips
+        the append when the pod's LAST record carries the same stage
+        and detail — the idiom for per-retry emitters (quota holds,
+        filter rejections) whose unchanged repeats would only churn the
+        ring."""
+        if not self.enabled or not uid:
+            return
+        t = time.time()
+        with self._lock:
+            if self._inbox:
+                self._fold_pending_locked()
+            tls = self._timelines
+            self._tick += 1
+            tl = tls.get(uid)
+            if tl is None:
+                tl = self._admit(uid, namespace, name)
+            else:
+                tl[_TOUCH] = self._tick
+                if name and not tl[_NAME]:
+                    # Identity arrived late (first emits carried only
+                    # the uid) — rare; renames never happen in k8s.
+                    tl[_NS] = namespace
+                    tl[_NAME] = name
+                    self._names_dirty = True
+            recs = tl[_RECS]
+            if dedupe and recs:
+                last = recs[-1]
+                if last[2] == stage and last[3] == detail:
+                    return
+            if len(recs) >= self.cfg.trim_at:
+                del recs[0:len(recs) - self.cfg.per_pod]
+            recs.append((tl[_SEQ], t, stage, detail))
+            tl[_SEQ] += 1
+            self.emitted_total += 1
+        if stage in TERMINAL_STAGES:
+            # GIL-atomic dict store, read lock-free by the informer's
+            # per-event guard.
+            self._last_grant[uid] = detail.get("node", "")
+
+    def emit_many(self, records: List[Tuple[str, str, str, str, dict]]
+                  ) -> None:
+        """Hand over a whole batched cycle's records — ``(uid, stage,
+        namespace, name, detail)`` tuples — for asynchronous folding.
+        The decision path pays one clock read, one GIL-atomic deque
+        append and one event set for the entire cycle; the folder
+        thread (or the next read) does the timeline work.  No dedupe
+        (cycle emitters never repeat a record within a cycle)."""
+        if not self.enabled or not records:
+            return
+        self._inbox.append((time.time(), records))
+        if self._folder is None and not self._closed:
+            self._start_folder()
+        if len(self._inbox) >= _INBOX_SEGMENTS:
+            # Folder stalled (or torn down) — fold inline rather than
+            # grow without bound.  Never hit with a live folder.
+            with self._lock:
+                self._fold_pending_locked()
+        else:
+            self._wake.set()
+
+    def emit_cycle(self, solver: str,
+                   records: List[Tuple[str, str, str, str, object]]
+                   ) -> None:
+        """Terminal hand-over for one batched cycle — ``(uid,
+        namespace, name, node, audit)`` per placed pod, where ``audit``
+        is the solver's raw ``(score, runner_up)`` pair (numpy scalars
+        welcome) or None.  The whole point versus :meth:`emit_many` is
+        what the decision path does NOT do: no detail dict, no float
+        boxing, no runner-up translation — one flat tuple per pod, and
+        the fold stores it by reference as the record's detail.  The
+        explain read path normalizes (``_cycle_detail``), stamping
+        ``solver`` from the store.  Records are terminal
+        (decision-committed) by definition."""
+        if not self.enabled or not records:
+            return
+        self._inbox.append((time.time(), (solver, records)))
+        if self._folder is None and not self._closed:
+            self._start_folder()
+        if len(self._inbox) >= _INBOX_SEGMENTS:
+            with self._lock:
+                self._fold_pending_locked()
+        else:
+            self._wake.set()
+
+    def _fold_pending_locked(self) -> None:
+        """Drain every pending segment into the timelines (caller holds
+        ``_lock``).  Segments fold FIFO and whole-segment-at-a-time
+        under one lock hold, so fold order is exactly hand-over order
+        — the seq numbers assigned here are the emit order."""
+        # Locals for everything the per-record loop touches — at fold
+        # rates a LOAD_GLOBAL or attribute probe per record is a
+        # measurable slice of the <2% budget.
+        tls_get = self._timelines.get
+        grants = self._last_grant
+        inbox = self._inbox
+        ring = self.cfg.per_pod
+        trim_at = self.cfg.trim_at
+        admit = self._admit
+        terminal = TERMINAL_STAGES
+        i_recs, i_seq, i_touch, i_name = _RECS, _SEQ, _TOUCH, _NAME
+        tick = self._tick + 1
+        self._tick = tick
+        folded = 0
+        while inbox:
+            t, records = inbox.popleft()
+            if type(records) is tuple:
+                # Cycle segment from emit_cycle: (solver, [(uid, ns,
+                # name, node, audit), ...]).  Specialized loop — stage
+                # is constant and always terminal, identity always
+                # present, detail is the hand-over tuple by reference:
+                # no per-record unpack of 5 names, no stage membership
+                # test, no dict probe into a cache-cold detail.
+                self._solver, cycle = records
+                for rec in cycle:
+                    uid = rec[0]
+                    tl = tls_get(uid)
+                    if tl is None:
+                        tl = admit(uid, rec[1], rec[2])
+                    else:
+                        tl[i_touch] = tick
+                    recs = tl[i_recs]
+                    if len(recs) >= trim_at:
+                        del recs[0:len(recs) - ring]
+                    recs.append((tl[i_seq], t, "decision-committed",
+                                 rec))
+                    tl[i_seq] += 1
+                    grants[uid] = rec[3]
+                folded += len(cycle)
+                continue
+            for uid, stage, namespace, name, detail in records:
+                tl = tls_get(uid)
+                if tl is None:
+                    tl = admit(uid, namespace, name)
+                else:
+                    tl[i_touch] = tick
+                    if name and not tl[i_name]:
+                        tl[_NS] = namespace
+                        tl[i_name] = name
+                        self._names_dirty = True
+                recs = tl[i_recs]
+                if len(recs) >= trim_at:
+                    del recs[0:len(recs) - ring]
+                recs.append((tl[i_seq], t, stage, detail))
+                tl[i_seq] += 1
+                if stage in terminal:
+                    grants[uid] = detail.get("node", "")
+            folded += len(records)
+        self.emitted_total += folded
+
+    def _start_folder(self) -> None:
+        with self._lock:
+            if self._folder is not None or self._closed:
+                return
+            self._folder = threading.Thread(
+                target=self._fold_loop, name="provenance-fold",
+                daemon=True)
+            self._folder.start()
+
+    def _fold_loop(self) -> None:
+        while not self._closed:
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            if self._inbox:
+                with self._lock:
+                    self._fold_pending_locked()
+
+    def close(self) -> None:
+        """Stop the folder thread and fold whatever is pending (the
+        store stays readable — post-mortem explains are the point)."""
+        self._closed = True
+        self._wake.set()
+        folder = self._folder
+        if folder is not None:
+            folder.join(timeout=2.0)
+        with self._lock:
+            self._fold_pending_locked()
+
+    def _admit(self, uid: str, namespace: str, name: str) -> list:
+        """Cold path of the folders (caller holds the lock): create a
+        timeline, enforce the fleet-wide cap.  The cap can only be
+        crossed by the admit itself, so one retirement restores it.
+        Retirement is second-chance: pop the clock head; a pod touched
+        since the hand last considered it is requeued (one chance per
+        touch epoch), a forgotten uid's stale entry is discarded, the
+        first pod with no new touches retires.  The pass terminates —
+        a requeued pod seen again in the same pass has _TOUCH ==
+        _CHANCE and retires — and visits each entry at most twice."""
+        tls = self._timelines
+        tick = self._tick
+        tl = [namespace, name, [], 1, tick, tick]
+        tls[uid] = tl
+        self._clock.append(uid)
+        self._names_dirty = True
+        if len(tls) > self.cfg.max_pods:
+            q = self._clock
+            while q:
+                old_uid = q.popleft()
+                if old_uid == uid:
+                    # Never retire the pod being admitted: when every
+                    # older timeline has been touched since its last
+                    # consideration, the hand wraps to the tail and
+                    # would otherwise evict the newcomer — losing the
+                    # very record this admit exists to keep.
+                    q.append(old_uid)
+                    continue
+                old = tls.get(old_uid)
+                if old is None:
+                    continue            # forgotten: stale entry
+                if old[_TOUCH] > old[_CHANCE]:
+                    old[_CHANCE] = old[_TOUCH]
+                    q.append(old_uid)   # touched since: second chance
+                    continue
+                del tls[old_uid]
+                self.retired_pods_total += 1
+                self._last_grant.pop(old_uid, None)
+                break
+        return tl
+
+    def last_grant_node(self, uid: str) -> Optional[str]:
+        """Node of the newest terminal-grant record for ``uid`` (None =
+        no grant recorded).  Lock-free — the informer's per-event WAL
+        guard; a benign race costs one redundant (deduped) seed."""
+        return self._last_grant.get(uid)
+
+    def note_pending_grant(self, uid: str, node: str) -> None:
+        """Pre-write suppression of WAL self-seeding: the decision path
+        publishes the grant it is ABOUT to commit before the apiserver
+        write, so the informer's echo of our own decision annotation
+        (which can arrive before the cycle's terminal record folds —
+        group-committed writes flush on their own thread) reads
+        ``last_grant_node == node`` and skips the redundant
+        ``wal-adopted`` seed.  One GIL-atomic dict store — cheaper than
+        the in-flight marker set it replaces.  The fold re-stores the
+        same value at terminal-record time (idempotent)."""
+        if self.enabled:
+            self._last_grant[uid] = node
+
+    def drop_pending_grant(self, uid: str, node: str) -> None:
+        """Failure twin of :meth:`note_pending_grant`: the decision
+        write did not land, so the advertised grant must not suppress a
+        FUTURE legitimate WAL seed (a peer may still place the pod on
+        that node).  Only drops the advertised value — a different
+        recorded grant stays."""
+        if self._last_grant.get(uid) == node:
+            self._last_grant.pop(uid, None)
+
+    def seed_from_wal(self, uid: str, namespace: str, name: str,
+                      node: str, decided_by: str = "",
+                      decided_t: str = "") -> bool:
+        """Cross-replica / cross-restart continuity: record a committed
+        decision this process never ran, from the terminal facts the
+        decision-annotation WAL already carries (assigned-node /
+        shard-owner / assigned-time).  No-op when the timeline already
+        carries this grant (our own decision-committed record is
+        strictly richer); a timeline holding only REJECTIONS — a
+        replica that gated the pod shard-not-owned while a peer placed
+        it — still absorbs the peer's grant.  Returns whether a record
+        was enqueued.
+
+        Asynchronous like the batched front door: the caller is the
+        informer thread — an adoption replay seeds HUNDREDS of pods in
+        one pass, and a locked per-pod emit there would stall the very
+        replica that just absorbed a dead peer's shards.  The grant
+        index is stored eagerly (GIL-atomic) so repeated seeds — resync
+        replays the same annotations every period — short-circuit
+        before enqueueing; two racing seeds for one pod can cost one
+        duplicate (same-node) record, never a wrong answer."""
+        if not self.enabled or not uid or not node:
+            return False
+        if self._last_grant.get(uid) == node:
+            return False
+        self._last_grant[uid] = node
+        self.emit_many([(uid, "wal-adopted", namespace, name,
+                         {"node": node, "decided_by": decided_by,
+                          "decided_t": decided_t})])
+        return True
+
+    def forget(self, uid: str) -> None:
+        """Drop one timeline (tests / explicit retirement; the informer
+        does NOT call this on pod deletion — a deleted pod's 'why' is
+        exactly what an operator asks for post-mortem)."""
+        with self._lock:
+            if self._inbox:
+                self._fold_pending_locked()
+            tl = self._timelines.pop(uid, None)
+            if tl is not None:
+                self._names_dirty = True
+                self._last_grant.pop(uid, None)
+
+    # -- reading ---------------------------------------------------------------
+    def resolve(self, ref: str) -> Optional[str]:
+        """'namespace/name' or a bare uid → uid (None = unknown)."""
+        with self._lock:
+            if self._inbox:
+                self._fold_pending_locked()
+            if ref in self._timelines:
+                return ref
+            if self._names_dirty:
+                self._by_name = {
+                    f"{tl[_NS]}/{tl[_NAME]}": u
+                    for u, tl in self._timelines.items() if tl[_NAME]}
+                self._names_dirty = False
+            return self._by_name.get(ref)
+
+    def has(self, uid: str) -> bool:
+        """Whether any record for ``uid`` is in the store (folds
+        pending segments first — callers gate informer-path emits on
+        it, off the decision path)."""
+        with self._lock:
+            if self._inbox:
+                self._fold_pending_locked()
+            return uid in self._timelines
+
+    def pods(self) -> int:
+        with self._lock:
+            if self._inbox:
+                self._fold_pending_locked()
+            return len(self._timelines)
+
+    def explain(self, ref: str) -> Optional[dict]:
+        """The ``/explainz`` document for one pod, or None when the
+        store has never seen it."""
+        uid = self.resolve(ref)
+        if uid is None:
+            return None
+        with self._lock:
+            tl = self._timelines.get(uid)
+            if tl is None:
+                return None
+            # The reader's view is the newest per_pod records — the
+            # list itself may hold up to trim_at (trim hysteresis).
+            records = tl[_RECS][-self.cfg.per_pod:]
+            namespace, name = tl[_NS], tl[_NAME]
+            #: Ring losses, derived: every folded record consumed one
+            #: seq, so folded − kept is exactly what the ring (or a
+            #: dedupe skip — which consumes no seq) did NOT keep.
+            truncated = (tl[_SEQ] - 1) - len(records)
+        solver = self._solver
+        recs = [{"seq": seq, "t": round(t, 3), "stage": stage,
+                 "detail": (dict(detail) if type(detail) is dict
+                            else _cycle_detail(detail, solver))}
+                for seq, t, stage, detail in records]
+        gap_free = truncated == 0 and all(
+            b["seq"] == a["seq"] + 1 for a, b in zip(recs, recs[1:]))
+        return {
+            "pod": f"{namespace}/{name}",
+            "uid": uid,
+            "records": recs,
+            "gap_free": gap_free,
+            "truncated": truncated,
+            "dominant_rejection": _dominant_rejection(recs),
+            "final": recs[-1] if recs else None,
+        }
+
+
+def _cycle_detail(rec: tuple, solver: str) -> dict:
+    """Normalize a raw cycle hand-over tuple — ``(uid, ns, name, node,
+    audit)`` with audit the solver's raw ``(score, runner_up)`` — into
+    the record-detail dict every other stage stores directly.  This is
+    where the float boxing and the -inf→None runner-up translation
+    live: once per READ of the rare explain path instead of twice per
+    placed pod on the decision path."""
+    d = {"node": rec[3]}
+    a = rec[4]
+    if a is not None:
+        d["solver"] = solver
+        d["score"] = float(a[0])
+        ru = float(a[1])
+        d["runner_up"] = None if ru == float("-inf") else ru
+    return d
+
+
+#: Stages whose detail carries per-node rejection reasons.
+_REJECT_STAGES = ("filter-rejected", "batch-no-fit")
+
+
+def _dominant_rejection(recs: List[dict]) -> Optional[str]:
+    """Most common leading rejection token across the NEWEST rejection
+    record's per-node reasons (score.py's dominant-token discipline) —
+    the one-word answer the vtpu-report pending table shows.  Prefers
+    the record's exact ``reason_counts`` tally (computed over the FULL
+    failed map at emit time); the per-node ``reasons`` field only
+    carries up to 8 example nodes."""
+    for rec in reversed(recs):
+        if rec["stage"] not in _REJECT_STAGES:
+            continue
+        tally: Dict[str, int] = rec["detail"].get("reason_counts") or {}
+        if not tally:
+            for why in (rec["detail"].get("reasons") or {}).values():
+                tok = str(why).split(":", 1)[0].strip()
+                tally[tok] = tally.get(tok, 0) + 1
+        if tally:
+            return max(sorted(tally), key=tally.get)
+        err = rec["detail"].get("error")
+        if err:
+            return str(err).split(":", 1)[0].strip()
+    return None
+
+
+def reason_tally(reasons: Dict[str, str]) -> List[tuple]:
+    """Per-node reason map → [(token, node count)] sorted most-common
+    first (deterministic tie-break by token) — shared by the
+    Unschedulable event summary and the vtpu-explain narrative."""
+    tally: Dict[str, int] = {}
+    for why in reasons.values():
+        tok = str(why).split(":", 1)[0].strip()
+        tally[tok] = tally.get(tok, 0) + 1
+    return sorted(tally.items(), key=lambda kv: (-kv[1], kv[0]))

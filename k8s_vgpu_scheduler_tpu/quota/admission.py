@@ -244,7 +244,7 @@ class AdmissionLoop:
     def _release_next(self, order, held_by_queue, usage, fleet_cap,
                       state, blocked, actions, now: float) -> bool:
         mgr = self.s.quota
-        for _share, qname in order:
+        for share, qname in order:
             q = mgr.queues[qname]
             held = held_by_queue[qname]
             if not held:
@@ -252,7 +252,8 @@ class AdmissionLoop:
             head = held[0]
             if head.gang is not None:
                 if self._release_gang(q, head, held, usage, fleet_cap,
-                                      state, blocked, actions, now):
+                                      state, blocked, actions, now,
+                                      share=share):
                     return True
                 continue
             ok, why = mgr.fits_quota(q, usage, head.chips, head.mem_mib)
@@ -261,13 +262,14 @@ class AdmissionLoop:
             if not ok:
                 blocked.setdefault(qname, (head, why))
                 continue
-            self._release_one(q, head, held, usage, state, actions)
+            self._release_one(q, head, held, usage, state, actions,
+                              share=share)
             return True
         return False
 
     def _release_gang(self, q, head: QueueEntry, held: List[QueueEntry],
                       usage, fleet_cap, state, blocked, actions,
-                      now: float) -> bool:
+                      now: float, share: float = 0.0) -> bool:
         """Head of queue is a gang member.  Ready gang (all members
         held): release every member atomically.  Accumulating gang: hold
         the head but try the backfill rule on the entries behind it."""
@@ -289,7 +291,7 @@ class AdmissionLoop:
                 return False
             for e in members:
                 self._release_one(q, e, held, usage, state, actions,
-                                  gang=head.gang)
+                                  gang=head.gang, share=share)
             return True
         # Accumulating: estimate the gang's eventual footprint from the
         # members already seen and backfill around the reservation.
@@ -317,7 +319,7 @@ class AdmissionLoop:
                     self._fits_fleet(e.chips, fleet_cap, state) and \
                     self._backfill_idle_ok(e, state):
                 self._release_one(q, e, held, usage, state, actions,
-                                  backfilled=True)
+                                  backfilled=True, share=share)
                 if e.qos == "best-effort" and state.get("qos_idle") \
                         is not None:
                     state["qos_idle"] -= e.chips
@@ -330,7 +332,8 @@ class AdmissionLoop:
     def _release_one(self, q, entry: QueueEntry, held: List[QueueEntry],
                      usage, state, actions,
                      gang: Optional[str] = None,
-                     backfilled: bool = False) -> None:
+                     backfilled: bool = False,
+                     share: float = 0.0) -> None:
         mgr = self.s.quota
         released = mgr.release(entry.uid, backfilled=backfilled)
         if released is None:
@@ -352,6 +355,17 @@ class AdmissionLoop:
                  f", gang {gang}" if gang else "",
                  ", backfilled" if backfilled else "",
                  usage[q.name].chips, borrowed)
+        # Decision provenance: the release record carries the queue's
+        # weighted-dominant fair-share standing AT THIS TICK plus the
+        # release ordinal — "why did I admit before/after my neighbor"
+        # in one record (docs/observability.md "Decision provenance").
+        self.s.provenance.emit(
+            entry.uid, "quota-released", namespace=entry.namespace,
+            name=entry.name, queue=q.name,
+            fair_share=round(share, 4),
+            release_seq=released.release_seq,
+            backfilled=backfilled, gang=gang,
+            borrowed_after=borrowed)
         self._write_release(mgr, released)
 
     def _write_release(self, mgr, entry: QueueEntry) -> None:

@@ -558,6 +558,59 @@ def choose_chips(fleet: ColumnarFleet, ce: _ClassEval,
     return _choose_multi(fleet, ce, row)
 
 
+def node_reject_reason(fleet: ColumnarFleet, req, affinity,
+                       row: int) -> str:
+    """Why this request class does not fit ``row`` — the SAME summary
+    string the scalar path produces (``score._reject_summary`` /
+    ``fit_container``'s reasons out-param), derived from the columnar
+    mirrors: per-chip first-failing rule in ``_chip_reject_reason``'s
+    exact rule order, tallied in chip order, dominant token first.
+    Parity is pinned by tests/test_scheduler_batch.py — a rule added to
+    score.py without its columnar twin fails the pin, so batched-path
+    rejections can never drift into coarser tokens than the per-pod
+    path's (ISSUE 13 satellite)."""
+    cores = req.coresreq
+    memreq = req.memreq
+    pct = req.mem_percentage_req if req.mem_percentage_req > 0 else 100
+    us = fleet.p_used_slots[row]
+    um = fleet.p_used_mem[row]
+    uc = fleet.p_used_cores[row]
+    ts = fleet.p_total_slots[row]
+    tm = fleet.p_total_mem[row]
+    tc = fleet.p_total_cores[row]
+    health = fleet.p_health[row]
+    types = fleet.p_type[row]
+    allowed = [score_mod.type_allows(affinity, t) for t in fleet._types]
+    exclusive = cores >= 100
+    tally: Dict[str, int] = {}
+    n = len(ts)
+    for c in range(n):
+        if not health[c]:
+            why = "unhealthy"
+        elif not allowed[types[c]]:
+            why = "type-mismatch"
+        elif ts[c] - us[c] <= 0:
+            why = "slots-exhausted"
+        elif uc[c] >= tc[c]:
+            why = "cores-exhausted"
+        elif exclusive and (us[c] > 0 or uc[c] > 0):
+            why = "exclusive-chip-busy"
+        elif cores > tc[c] - uc[c]:
+            why = "insufficient-cores"
+        elif (memreq if memreq > 0
+              else tm[c] * pct // 100) > tm[c] - um[c]:
+            why = "insufficient-hbm"
+        else:
+            continue
+        tally[why] = tally.get(why, 0) + 1
+    if not tally:
+        return (f"too-few-chips: node has {n} chips, "
+                f"request needs {req.nums}")
+    detail = ", ".join(f"{k}/{n} {why}" for why, k in
+                       sorted(tally.items(), key=lambda kv: -kv[1]))
+    return f"{max(tally, key=tally.get)}: {detail}"
+
+
 class _Cohort:
     """Jobs sharing (request class, offered-node set): they see identical
     score rows, so the solver evaluates once per cohort, not per pod.
@@ -641,7 +694,8 @@ class _Cohort:
 
 
 def solve(fleet: ColumnarFleet, cohorts: List[_Cohort], n_jobs: int,
-          solver: str) -> List[Optional[Tuple[int, List[int], List[int]]]]:
+          solver: str, audit: Optional[Dict[int, dict]] = None
+          ) -> List[Optional[Tuple[int, List[int], List[int]]]]:
     """Joint placement over the score matrix.  Returns, per ORIGINAL job
     index, ``(fleet row, chip indices, mems)`` or None (no fit).
 
@@ -658,9 +712,19 @@ def solve(fleet: ColumnarFleet, cohorts: List[_Cohort], n_jobs: int,
     results: List[Optional[Tuple[int, List[int], List[int]]]] = \
         [None] * n_jobs
 
-    def assign(cohort: _Cohort, job_idx: int, row: int) -> None:
+    def assign(cohort: _Cohort, job_idx: int, row: int,
+               best: float, second: float) -> None:
         chips, mems = choose_chips(fleet, cohort.ce, row)
         results[job_idx] = (row, chips, mems)
+        if audit is not None:
+            # Chosen-vs-runner-up provenance: what the solver saw at
+            # assignment time (docs/observability.md "Decision
+            # provenance") — the RAW (score, runner-up) pair, numpy
+            # scalars and -inf sentinels included.  Nothing on the
+            # decision path ever operates on these again; boxing and
+            # the -inf→None translation happen once per explain READ
+            # (store._cycle_detail), not twice per placed pod.
+            audit[job_idx] = (best, second)
         fleet.apply_grant(row, chips, mems, cohort.ce.req.coresreq)
         for c in cohorts:
             eval_class_row(fleet, c.ce, row)
@@ -670,10 +734,10 @@ def solve(fleet: ColumnarFleet, cohorts: List[_Cohort], n_jobs: int,
         ordered = sorted(((rank, idx, c) for c in cohorts
                           for rank, idx in c.jobs))
         for _rank, idx, cohort in ordered:
-            best, row, _second = cohort.best2()
+            best, row, second = cohort.best2()
             if best == _NEG_INF:
                 continue
-            assign(cohort, idx, row)
+            assign(cohort, idx, row, best, second)
         return results
 
     # Lazy greedy-with-regret: heap entries carry the version (number of
@@ -707,7 +771,14 @@ def solve(fleet: ColumnarFleet, cohorts: List[_Cohort], n_jobs: int,
             continue
         job_idx = cohort.jobs[cohort.head][1]
         cohort.head += 1
-        assign(cohort, job_idx, row)
+        # Runner-up for the provenance audit, recovered from the entry
+        # itself: ver == version means NO assignment landed since this
+        # entry was pushed, so no score anywhere changed and the
+        # push-time regret (= best − second) is still exact.  Zero
+        # extra heap work on the audited path.
+        regret = -_negr
+        second = _NEG_INF if math.isinf(regret) else best - regret
+        assign(cohort, job_idx, row, best, second)
         version += 1
         if cohort.head < len(cohort.jobs):
             push(ci)
@@ -955,8 +1026,10 @@ class BatchEngine:
             cohorts = self._build_cohorts(jobs, vector, ranks)
             phases["vector-eval"] = time.monotonic() - pt
             pt = time.monotonic()
+            audit: Optional[Dict[int, dict]] = (
+                {} if self.s.provenance.enabled else None)
             vplan = solve(self.fleet, cohorts, len(jobs),
-                          self.s.cfg.batch_solver)
+                          self.s.cfg.batch_solver, audit=audit)
             phases["solve"] = time.monotonic() - pt
             for i in vector:
                 plan[i] = vplan[i]
@@ -970,11 +1043,25 @@ class BatchEngine:
                     reasons.get("commit-conflict", 0) + len(lost)
             for i, res in committed.items():
                 results[i] = res
+                if audit is not None:
+                    # The terminal provenance emit (_finish_decision)
+                    # folds the solver's chosen-vs-runner-up audit
+                    # into the decision-committed record.
+                    res.audit = audit.get(i)
             fallback.update(lost)
             unfit_vector = [i for i in vector if results[i] is None
                             and i not in fallback]
             if unfit_vector:
                 reasons["no-fit"] = len(unfit_vector)
+                if self.s.provenance.enabled:
+                    # Vector-stage rejection provenance with FULL
+                    # per-node tokens (node_reject_reason — parity-
+                    # pinned against score.py), not the coarse no-fit
+                    # bucket: the per-pod fallback may still place the
+                    # pod elsewhere, but what the batched matrix saw is
+                    # part of its causal chain.
+                    for i in unfit_vector:
+                        self._note_batch_no_fit(jobs[i])
             fallback.update(unfit_vector)
             sp.set("committed", len(committed))
             sp.set("fallback", len(fallback))
@@ -1015,6 +1102,36 @@ class BatchEngine:
         return [r if r is not None
                 else FilterResult(error="batch cycle produced no decision")
                 for r in results]
+
+    def _note_batch_no_fit(self, job: BatchJob, limit: int = 8) -> None:
+        """Provenance for a vector job the solver found no node for:
+        per-node rejection tokens over the first ``limit`` offered
+        nodes, from the same rule set as the scalar path (parity-pinned
+        node_reject_reason), plus the lease/shard gate reasons for
+        gated rows — the batched twin of the per-pod failed map."""
+        fleet = self.fleet
+        req = job.requests[0]
+        affinity = score_mod.parse_affinity(job.anns)
+        reasons: Dict[str, str] = {}
+        for name in job.node_names:
+            if len(reasons) >= limit:
+                break
+            row = fleet.row_of.get(name)
+            if row is None:
+                reasons[name] = "no TPU inventory registered"
+                continue
+            if not fleet.alive[row]:
+                why = self.s.leases.reject_reason(name)
+                if why is None and self.s.shards.enabled:
+                    gate = self.s.shards.candidate_gate()
+                    why = gate(name) if gate is not None else None
+                reasons[name] = why or "gated"
+                continue
+            reasons[name] = node_reject_reason(fleet, req, affinity, row)
+        self.s.provenance.emit(
+            job.uid, "batch-no-fit", namespace=job.namespace,
+            name=job.name, dedupe=True, reasons=reasons,
+            offered=len(job.node_names))
 
     def fair_share_ranks(self, jobs: List[BatchJob]) -> List[int]:
         """Per-job priority rank for the solver: arrival order, except

@@ -39,6 +39,11 @@ from ..k8s.client import (
     pod_uid,
 )
 from ..placement.defrag import Defragmenter, DefragConfig
+from ..provenance.store import (
+    ProvenanceConfig,
+    ProvenanceStore,
+    reason_tally,
+)
 from ..placement.mesh import MESH_ANNOTATION, local_mesh_for, parse_mesh
 from ..placement.reserve import SliceReservations
 from ..quota.admission import AdmissionConfig, AdmissionLoop
@@ -91,7 +96,8 @@ log = logging.getLogger(__name__)
 class FilterResult:
     def __init__(self, node: Optional[str] = None,
                  failed: Optional[Dict[str, str]] = None, error: str = "",
-                 preempt: Optional["PreemptionPlan"] = None):
+                 preempt: Optional["PreemptionPlan"] = None,
+                 audit: Optional[dict] = None):
         self.node = node
         self.failed = failed or {}
         self.error = error
@@ -99,6 +105,10 @@ class FilterResult:
         # the annotation writes outside the lock and the pod pends until
         # the victims checkpoint and release.
         self.preempt = preempt
+        # Decision-site extras for the provenance record (the batch
+        # solver's chosen-vs-runner-up scores) — folded into the
+        # terminal emit so the happy path pays ONE emit per pod.
+        self.audit = audit
 
 
 def decode_register_request(req) -> NodeInfo:
@@ -159,6 +169,21 @@ class Scheduler:
         self.pods = PodManager()
         self.gangs = GangManager()
         self._clock = clock or time.monotonic
+        # Decision provenance (provenance/; docs/observability.md
+        # "Decision provenance"): every decision site below emits one
+        # structured record into this bounded per-pod timeline store —
+        # the /explainz and vtpu-explain surface.  Disabled
+        # (--no-provenance) every emit is one attribute read.
+        self.provenance = ProvenanceStore(ProvenanceConfig(
+            per_pod=self.cfg.provenance_per_pod,
+            max_pods=self.cfg.provenance_max_pods,
+            enabled=self.cfg.provenance_enabled))
+        # Sustained-unplaceability tracking for the Unschedulable kube
+        # Events: uid -> [first unplaced at, last event at] (monotonic).
+        # Own lock (the rejection paths race); bounded by the same
+        # prune-at-cap discipline as _preempt_requested.
+        self._unplaced: Dict[str, List[float]] = {}
+        self._unplaced_lock = threading.Lock()
         # Fleet utilization accounting (accounting/): per-pod actual-usage
         # accounts fed by the counters each node agent piggybacks on its
         # register-stream heartbeats, plus the granted-vs-actual join
@@ -479,7 +504,17 @@ class Scheduler:
                         uid, "deleted", trace_id=anns.get(
                             trace.TRACE_ID_ANNOTATION, ""),
                         pod=pod_name(pod), event=event)
+                    if self.provenance.enabled \
+                            and self.provenance.has(uid):
+                        # Close a known timeline once; a pod never seen
+                        # (pre-provenance grants) gets no record minted
+                        # from its tombstone.
+                        self.provenance.emit(
+                            uid, "deleted", namespace=pod_namespace(pod),
+                            name=pod_name(pod), event=event)
                 self._note_deleted(uid)
+                with self._unplaced_lock:
+                    self._unplaced.pop(uid, None)
                 # A deleted pod can be an outstanding preemption REQUESTER:
                 # rescind so its victims don't checkpoint for nothing.
                 if self._preempt_by_requester.get(uid):
@@ -537,6 +572,24 @@ class Scheduler:
         # node's usage snapshot.  One combined acquire (upsert), not a
         # probe-then-add pair — this path runs per apiserver event.
         self.pods.upsert(info)
+        if node and self.provenance.enabled \
+                and self.provenance.last_grant_node(uid) != node:
+            # A committed decision this process never ran (an adopting
+            # replica's WAL replay, a peer replica's informer mirror,
+            # or a restart's resync): seed the explain timeline from
+            # the terminal facts the decision annotations already carry
+            # — the assigned node, the shard owner that committed it,
+            # the assignment time (docs/observability.md "Decision
+            # provenance").  Cheap per-event guard: grant-less events
+            # short-circuit on the node check, and our own decision's
+            # echo matches the grant advertised by note_pending_grant
+            # BEFORE its write — one lock-free probe, no lock, no
+            # parsing, no redundant seed.
+            self.provenance.seed_from_wal(
+                uid, pod_namespace(pod), pod_name(pod), node,
+                decided_by=anns.get(
+                    shard_commit.SHARD_OWNER_ANNOTATION, ""),
+                decided_t=anns.get(ASSIGNED_TIME_ANNOTATION, ""))
         if event == "ADDED" and self._deleted_since(uid) is not None:
             # Closes the check-then-add race with the watch thread: a
             # DELETE that landed between the pre-check above and add_pod
@@ -1066,8 +1119,17 @@ class Scheduler:
         for at in range(0, len(batched), step):
             chunk = batched[at:at + step]
             decided = self.batch.decide_many([j for _i, j in chunk])
+            # One emit_cycle per cycle lands every decision's terminal
+            # provenance record — the store's amortization discipline
+            # (one flat hand-over tuple per pod, one clock read per
+            # cycle, zero locks on the decision path).
+            sink: Optional[list] = \
+                [] if self.provenance.enabled else None
             for (i, job), res in zip(chunk, decided):
-                results[i] = self._finish_decision(job.pod, res)
+                results[i] = self._finish_decision(job.pod, res,
+                                                   sink=sink)
+            if sink:
+                self.provenance.emit_cycle(self.cfg.batch_solver, sink)
         if batched:
             # Drain complete: every job of this backlog is decided, so
             # the drain-age figure (a CURRENT wait) is zero again.  The
@@ -1091,7 +1153,13 @@ class Scheduler:
             return FilterResult(node=None, failed={})
         hold = self.quota.gate(pod, requests)
         if hold is not None:
-            return FilterResult(error=hold)
+            self._note_quota_hold(pod, hold)
+            fr = FilterResult(error=hold)
+            # Marks the rejection as a quota hold so
+            # _note_rejection does not mint a filter-rejected
+            # twin of the quota-hold record.
+            fr.quota_hold = True
+            return fr
         self._release_reservation_for(pod)
         if gang_of(pod) is not None or not self.cfg.optimistic_commit \
                 or not self._batchable(requests):
@@ -1130,19 +1198,24 @@ class Scheduler:
             node_names=node_names, priority=priority,
             enqueued_at=time.monotonic())
 
-    def _finish_decision(self, pod: dict,
-                         result: FilterResult) -> FilterResult:
+    def _finish_decision(self, pod: dict, result: FilterResult,
+                         sink: Optional[list] = None) -> FilterResult:
         """Everything after the in-memory decision: rejection events and
         the reclaim/preemption signals on a no-fit, or the decision
         write (rolled back on failure) on a placement.  Shared by the
-        per-pod and batched front doors."""
+        per-pod and batched front doors.  ``sink`` (batched cycles
+        only) collects the terminal provenance record instead of
+        emitting it — the cycle lands them all through ONE
+        ``emit_many`` (the store's amortization discipline)."""
+        uid = pod_uid(pod)
         tid = trace.trace_id_of(pod)
         tr = trace.tracer()
         if result.node is None:
             if result.error or result.failed:
-                tr.event(pod_uid(pod), "filter-rejected", trace_id=tid,
+                tr.event(uid, "filter-rejected", trace_id=tid,
                          pod=pod_name(pod), error=result.error,
                          preempting=result.preempt is not None)
+                self._note_rejection(pod, result)
             self._note_slice_rejection(pod, result)
             if result.failed and any(
                     not r.startswith("shard-")
@@ -1154,20 +1227,28 @@ class Scheduler:
                 # the pod's next retry lands on the owning replica; a
                 # reclaim here would evict borrowers for a pod another
                 # replica can place.
-                self.quota.note_unplaced(pod_uid(pod))
+                self.quota.note_unplaced(uid)
             if result.preempt is not None:
                 self._request_preemptions(pod, result.preempt)
             return result
-        tr.event(pod_uid(pod), "filter-assigned", trace_id=tid,
+        tr.event(uid, "filter-assigned", trace_id=tid,
                  pod=pod_name(pod), node=result.node)
+        if self._unplaced:
+            # Truthiness probe first: the map is empty unless some pod
+            # is mid-rejection-streak, so the happy path never pays the
+            # lock (GIL-atomic read; a racing insert for THIS uid can't
+            # exist — its rejection and its placement are the same
+            # decision path).
+            with self._unplaced_lock:
+                self._unplaced.pop(uid, None)
         # A placement settles any slice demand this pod (or its gang)
         # had recorded — the defragmenter must not compact for it.
         self.defrag.demand_satisfied(self._reservation_key(pod))
-        if self._preempt_by_requester.get(pod_uid(pod)):
+        if self._preempt_by_requester.get(uid):
             # The pod found a seat after all (capacity freed elsewhere):
             # its outstanding eviction requests are now pointless.
-            self._rescind_preemptions(pod_uid(pod))
-        encoded = codec.encode_pod_devices(self.pods.get(pod_uid(pod)).devices)
+            self._rescind_preemptions(uid)
+        encoded = codec.encode_pod_devices(self.pods.get(uid).devices)
         patch = {
             ASSIGNED_NODE_ANNOTATION: result.node,
             ASSIGNED_IDS_ANNOTATION: encoded,
@@ -1183,7 +1264,7 @@ class Scheduler:
             # device plugin to surface into the container env.
             patch[QOS_DUTY_SPLIT_ANNOTATION] = \
                 self._qos_duty_split(result.node)
-        rank = self.gangs.rank_of(pod_uid(pod))
+        rank = self.gangs.rank_of(uid)
         if rank is not None:
             # The member's jax.distributed process rank (stable across
             # replacements) — surfaced to the container as VTPU_GANG_RANK.
@@ -1195,6 +1276,13 @@ class Scheduler:
         write_rec = reg.enabled and (self._decisions.writes & 3) == 0
         if write_rec:
             write_t0 = time.monotonic()
+        # Advertise the grant BEFORE the write: the informer's echo of
+        # our own decision annotation (synchronous under a CAS, or on
+        # the group-commit flush thread for batched writes) must read
+        # last_grant_node == node and skip the redundant wal-adopted
+        # seed.  One GIL-atomic dict store on the happy path; revoked
+        # on write failure.
+        self.provenance.note_pending_grant(uid, result.node)
         with tr.span("decision-write", trace_id=tid, pod=pod_name(pod),
                      node=result.node, qos=pod_qos(pod)) as wsp:
             err: Optional[str] = None
@@ -1206,7 +1294,8 @@ class Scheduler:
                 # bypasses the group-commit batcher: a CAS carries its
                 # own resourceVersion and cannot ride a shared batch.
                 err = shard_commit.cas_commit(
-                    self.client, self.shards, pod, result.node, patch)
+                    self.client, self.shards, pod, result.node, patch,
+                    provenance=self.provenance)
                 if err is not None:
                     log.warning("decision for %s not committed: %s",
                                 pod_name(pod), err)
@@ -1228,10 +1317,45 @@ class Scheduler:
                 reg.record("decision-write",
                            time.monotonic() - write_t0)
             if err is not None:
-                self.pods.del_pod(pod_uid(pod))
-                tr.event(pod_uid(pod), "decision-write-failed",
+                self.pods.del_pod(uid)
+                tr.event(uid, "decision-write-failed",
                          trace_id=tid, error=err)
+                # The write did not land: stop advertising the grant
+                # (a peer may still place the pod on that node, and
+                # THAT grant must be seedable) and record the failure
+                # — "my pod bounced off a shard fence" is exactly the
+                # question /explainz exists for.
+                self.provenance.drop_pending_grant(uid, result.node)
+                self.provenance.emit(
+                    uid, "decision-write-failed",
+                    namespace=pod_namespace(pod), name=pod_name(pod),
+                    node=result.node, error=err)
                 return FilterResult(error=err)
+        if self.provenance.enabled:
+            # ONE terminal record per placed pod (the happy path's
+            # whole provenance cost): the committed node, plus the
+            # batch solver's chosen-vs-runner-up audit when the
+            # decision came through a cycle.  Batched cycles append
+            # one flat hand-over tuple — no detail dict, no float
+            # boxing; the store's explain read path normalizes
+            # (store._cycle_detail) — and land the whole cycle through
+            # one emit_cycle.
+            a = result.audit
+            if sink is not None:
+                sink.append((uid, pod_namespace(pod), pod_name(pod),
+                             result.node, a))
+            else:
+                detail = {"node": result.node}
+                if a is not None:
+                    detail["solver"] = self.cfg.batch_solver
+                    detail["score"] = float(a[0])
+                    ru = float(a[1])
+                    detail["runner_up"] = \
+                        None if ru == float("-inf") else ru
+                self.provenance.emit(
+                    uid, "decision-committed",
+                    namespace=pod_namespace(pod), name=pod_name(pod),
+                    **detail)
         return result
 
     def _qos_duty_split(self, node: str) -> str:
@@ -1277,6 +1401,116 @@ class Scheduler:
                                  trace_id=trace.trace_id_of(pod),
                                  pod=pod_name(pod),
                                  chips=sum(len(r.chips) for r in released))
+
+    def _note_quota_hold(self, pod: dict, hold: str) -> None:
+        """Quota-hold provenance (deduped: the hold string carries the
+        queue position, so a record lands when the pod enters the queue
+        and again only when its standing moves)."""
+        self.provenance.emit(
+            pod_uid(pod), "quota-hold", namespace=pod_namespace(pod),
+            name=pod_name(pod), dedupe=True, reason=hold)
+
+    def _note_rejection(self, pod: dict, result: "FilterResult") -> None:
+        """One rejected decision's provenance: the full reason tally
+        plus up-to-8 example nodes in dominant-token order into the
+        pod's explain timeline (deduped — retries with unchanged
+        reasons don't churn the ring), plus the sustained-
+        unplaceability kube Event once the pod has pended past the
+        grace window (throttled like the queue-position patches: never
+        a per-retry apiserver write)."""
+        if getattr(result, "quota_hold", False):
+            # The hold already landed as a quota-hold record — a
+            # filter-rejected twin would halve the ring's effective
+            # retention per queue-position move and narrate a sweep
+            # that never ran.
+            return
+        uid = pod_uid(pod)
+        tally = reason_tally(result.failed) if result.failed else []
+        if self.provenance.enabled:
+            failed = result.failed
+            if len(failed) > 8:
+                # Example nodes chosen in dominant-token order, never
+                # alphabetically: 8 alphabetically-first nodes can all
+                # carry a minority token, making /explainz's
+                # dominant_rejection disagree with the Unschedulable
+                # event computed over the FULL map.  reason_counts
+                # carries the exact tally either way.
+                rank = {tok: i for i, (tok, _n) in enumerate(tally)}
+                keep = sorted(
+                    failed,
+                    key=lambda n: (rank[str(failed[n])
+                                        .split(":", 1)[0].strip()], n))
+                reasons = {n: failed[n] for n in sorted(keep[:8])}
+            else:
+                reasons = dict(sorted(failed.items()))
+            self.provenance.emit(
+                uid, "filter-rejected", namespace=pod_namespace(pod),
+                name=pod_name(pod), dedupe=True,
+                error=result.error, reasons=reasons,
+                reason_counts=dict(tally),
+                rejected_nodes=len(result.failed),
+                preempting=result.preempt is not None)
+        if not result.failed:
+            # Gang waits / shard-only gates carry no candidate sweep;
+            # their wait already has a user-visible story — the
+            # Unschedulable event is for pods the fleet REJECTED.
+            return
+        # The injected clock, not time.monotonic(): the simulator's
+        # virtual-clock replicas must be able to drive the grace and
+        # throttle deterministically like every other time-gated path.
+        now = self._clock()
+        with self._unplaced_lock:
+            entry = self._unplaced.get(uid)
+            if entry is None:
+                if len(self._unplaced) > 4096:
+                    cutoff = now - 3600.0
+                    for u in [u for u, e in self._unplaced.items()
+                              if e[0] < cutoff]:
+                        del self._unplaced[u]
+                # last_event = -inf, not 0.0: the first event must
+                # never be throttled, and a virtual clock's "now" can
+                # legitimately be smaller than the throttle window.
+                self._unplaced[uid] = [now, float("-inf")]
+                return
+            first, last_event = entry
+            if now - first < self.cfg.explain_event_grace_s or \
+                    now - last_event < self.cfg.explain_event_throttle_s:
+                return
+            entry[1] = now
+        summary = ", ".join(f"{tok} ({n} node{'s' if n > 1 else ''})"
+                            for tok, n in tally[:3])
+        try:
+            self.client.create_event(
+                pod_namespace(pod),
+                {"kind": "Pod", "name": pod_name(pod),
+                 "namespace": pod_namespace(pod), "uid": uid},
+                "Unschedulable",
+                f"no node fits after {now - first:.0f}s: {summary} — "
+                f"see vtpu-explain {pod_namespace(pod)}/{pod_name(pod)}",
+                type_="Warning")
+            self.provenance.emit(uid, "unschedulable-event",
+                                 namespace=pod_namespace(pod),
+                                 name=pod_name(pod), reasons_top=summary)
+        except NotImplementedError:
+            pass  # embedder clients without an events surface
+        except Exception as e:  # noqa: BLE001 — events are best-effort
+            log.debug("Unschedulable event for %s not written: %s",
+                      pod_name(pod), e)
+
+    def export_explain(self, ref: str) -> Optional[dict]:
+        """Decision-provenance timeline for one pod (``GET /explainz``
+        → ``vtpu-explain`` / ``vtpu-report --explain``).  ``ref`` is
+        ``namespace/name`` or a uid; None = never seen.  Reads only the
+        provenance store's own lock — never a scheduler lock."""
+        doc = self.provenance.explain(ref)
+        if doc is None:
+            return None
+        doc["enabled"] = self.provenance.enabled
+        doc["store"] = {"pods": self.provenance.pods(),
+                        "emitted_total": self.provenance.emitted_total,
+                        "retired_pods_total":
+                            self.provenance.retired_pods_total}
+        return doc
 
     def _note_slice_rejection(self, pod: dict,
                               result: "FilterResult") -> None:
@@ -1365,6 +1599,25 @@ class Scheduler:
                     self.preemptions_requested += 1
                     self._preempt_by_requester.setdefault(
                         pod_uid(pod), {})[v.uid] = (v.namespace, v.name)
+                # Both sides of the eviction carry provenance: the
+                # victim records WHO asked (the requester key kubectl
+                # describe shows), the requester records who it asked.
+                # Synthetic requesters (defrag compactions and quota
+                # reclaims carry a "rescue:"-prefixed uid, never a real
+                # pod) get no requester-side timeline — their victims'
+                # records already name them, and a fake uid must not
+                # occupy an LRU slot a real pod could use.
+                self.provenance.emit(
+                    v.uid, "preempt-requested", namespace=v.namespace,
+                    name=v.name, requester=pod_uid(pod),
+                    requester_pod=pod_name(pod), node=plan.node)
+                if not pod_uid(pod).startswith(RESCUE_VALUE_PREFIX):
+                    self.provenance.emit(
+                        pod_uid(pod), "preemption-planned",
+                        namespace=pod_namespace(pod), name=pod_name(pod),
+                        dedupe=True, node=plan.node,
+                        victims=[f"{x.namespace}/{x.name}"
+                                 for x in plan.victims])
                 log.warning(
                     "preemption: asked %s/%s (prio %d) to checkpoint and "
                     "release %s for pod %s", v.namespace, v.name, v.priority,
@@ -1391,6 +1644,9 @@ class Scheduler:
             try:
                 self.client.patch_pod_annotations(
                     namespace, name, {PREEMPT_ANNOTATION: ""})
+                self.provenance.emit(
+                    vuid, "preempt-rescinded", namespace=namespace,
+                    name=name, requester=requester_uid)
                 log.info("preemption rescinded for %s/%s (requester %s "
                          "no longer pending)", namespace, name,
                          requester_uid)
@@ -1416,7 +1672,13 @@ class Scheduler:
         # namespaces (or no quota config) pass straight through.
         hold = self.quota.gate(pod, requests)
         if hold is not None:
-            return FilterResult(error=hold)
+            self._note_quota_hold(pod, hold)
+            fr = FilterResult(error=hold)
+            # Marks the rejection as a quota hold so
+            # _note_rejection does not mint a filter-rejected
+            # twin of the quota-hold record.
+            fr.quota_hold = True
+            return fr
 
         # Compaction beneficiary: chips the defragmenter assembled for
         # THIS pod/gang rejoin the snapshot before the decision, so the
@@ -1843,6 +2105,9 @@ class Scheduler:
         self.admission.stop()
         self.defrag.stop()
         self.shards.stop()
+        # Folds whatever is pending and stops the folder thread; the
+        # store stays readable (post-mortem explains are the point).
+        self.provenance.close()
         with self._pool_lock:
             pool, self._pool = self._pool, None
             self._pool_unavailable = False

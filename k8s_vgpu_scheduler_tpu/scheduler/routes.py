@@ -25,6 +25,12 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
                     table, informer lag, queue depth, GC pressure and
                     the top-N slowest recent ticks with their phase
                     splits (``?ticks=<n>`` sizes the slow-tick table)
+- ``GET  /explainz``  decision provenance for ONE pod
+                    (``?pod=<namespace/name>`` or ``?uid=<uid>``): the
+                    gap-free record timeline from webhook stamp through
+                    quota, shard gates, filter verdicts, solver audit,
+                    commit and eviction — for ``vtpu-explain`` and
+                    ``vtpu-report --explain``
 """
 
 from __future__ import annotations
@@ -139,6 +145,29 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 — 500, not a hangup
                 log.exception("perfz export failed")
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        elif self.path.startswith("/explainz"):
+            # Decision provenance for one pod (provenance/store.py):
+            # the gap-free explain timeline vtpu-explain renders.
+            from urllib.parse import parse_qsl, urlsplit
+
+            query = dict(parse_qsl(urlsplit(self.path).query))
+            ref = query.get("pod") or query.get("uid") or ""
+            if not ref:
+                self._reply(400, {"error":
+                                  "need ?pod=<namespace/name> or ?uid="})
+                return
+            try:
+                doc = self.scheduler.export_explain(ref)
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("explainz export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            if doc is None:
+                self._reply(404, {
+                    "error": f"no provenance recorded for {ref!r}",
+                    "enabled": self.scheduler.provenance.enabled})
+            else:
+                self._reply(200, doc)
         elif self.path.startswith("/capacityz"):
             # Predictive capacity (accounting/planner.py): forecasts,
             # starvation ETAs, scale recommendation, forecast drift.
@@ -219,7 +248,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # actually declare a mesh).
                 self._reply(200, handle_admission_review(
                     body, self.cfg,
-                    topologies=self.scheduler.known_topologies))
+                    topologies=self.scheduler.known_topologies,
+                    provenance=self.scheduler.provenance))
             else:
                 self._reply(404, {"error": "not found"})
         except Exception as e:  # noqa: BLE001 — extender must answer, not die

@@ -274,7 +274,7 @@ def validate_pod_qos(pod: dict) -> Optional[str]:
 
 
 def handle_admission_review(body: dict, cfg: Config,
-                            topologies=None) -> dict:
+                            topologies=None, provenance=None) -> dict:
     """AdmissionReview in → AdmissionReview out.  Mutation is advisory
     (failurePolicy decides what a webhook outage means), but a pod
     declaring an INVALID ``vtpu.dev/mesh`` is rejected outright — it
@@ -324,6 +324,25 @@ def handle_admission_review(body: dict, cfg: Config,
                 trace.tracer().event(
                     meta.get("uid", ""), "webhook-mutated",
                     trace_id=trace_id, patch_ops=len(patches))
+            if provenance is not None and meta.get("uid"):
+                # First record of the pod's explain timeline: the
+                # webhook stamp — trace id, QoS class, declared mesh
+                # and the governing capacity queue (docs/observability
+                # .md "Decision provenance").  Pods admitted before the
+                # apiserver assigns a uid start their timeline at the
+                # first Filter instead.
+                anns = meta.get("annotations", {}) or {}
+                provenance.emit(
+                    meta["uid"], "webhook",
+                    namespace=req.get("namespace", "")
+                    or meta.get("namespace", "default"),
+                    name=meta.get("name", ""),
+                    trace_id=trace_id,
+                    qos=anns.get(QOS_ANNOTATION, ""),
+                    mesh=anns.get(MESH_ANNOTATION, ""),
+                    queue=_governing_queue(
+                        cfg, req.get("namespace", "")
+                        or meta.get("namespace", "default")) or "")
         if patches:
             response["patchType"] = "JSONPatch"
             response["patch"] = base64.b64encode(

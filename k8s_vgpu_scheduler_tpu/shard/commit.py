@@ -50,16 +50,33 @@ def _decision_of(pod: dict):
 
 
 def cas_commit(client, shards, pod: dict, node: str,
-               patch: Dict[str, str]) -> Optional[str]:
+               patch: Dict[str, str], provenance=None) -> Optional[str]:
     """Write ``patch`` (the decision annotations) as a fenced CAS.
     Returns None on success, else the requeue reason (the caller rolls
-    the tentative grant back, exactly like a failed plain write)."""
+    the tentative grant back, exactly like a failed plain write).
+
+    ``provenance`` (a ProvenanceStore, optional) receives one
+    ``commit-cas-failed`` record per failure carrying the SAME low-
+    cardinality token ``vtpu_commit_cas_failures_total`` counts
+    (stale-map / not-owned / already-decided / rv-conflict / …), so an
+    explain timeline distinguishes "fence rejected before any I/O" from
+    "the pod moved under the patch" without parsing the requeue string.
+    """
+    namespace, name = pod_namespace(pod), pod_name(pod)
+
+    def fail(token: str, reason: str) -> str:
+        shards.note_cas_failure(token)
+        if provenance is not None:
+            provenance.emit(pod.get("metadata", {}).get("uid", ""),
+                            "commit-cas-failed", namespace=namespace,
+                            name=name, node=node, token=token,
+                            epoch=shards.epoch())
+        return reason
+
     fence, epoch = shards.commit_fence(node)
     if fence is not None:
-        shards.note_cas_failure(fence)
-        return (f"shard-fence: {fence} — decision on {node} not "
-                f"committed, pod requeued")
-    namespace, name = pod_namespace(pod), pod_name(pod)
+        return fail(fence, f"shard-fence: {fence} — decision on {node} "
+                           "not committed, pod requeued")
     full = dict(patch)
     full[SHARD_EPOCH_ANNOTATION] = str(epoch)
     full[SHARD_OWNER_ANNOTATION] = shards.replica
@@ -72,9 +89,9 @@ def cas_commit(client, shards, pod: dict, node: str,
         # the CAS would "succeed" at overwriting a valid placement.  A
         # pod that must genuinely move owners goes through rescission
         # (the annotations are cleared first) or shard adoption.
-        shards.note_cas_failure("already-decided")
-        return (f"shard-cas: {namespace}/{name} already assigned to "
-                f"{assigned} by {owner}")
+        return fail("already-decided",
+                    f"shard-cas: {namespace}/{name} already assigned to "
+                    f"{assigned} by {owner}")
     rv = pod.get("metadata", {}).get("resourceVersion")
     if rv is None:
         # The Filter payload carried no resourceVersion (in-process
@@ -83,18 +100,19 @@ def cas_commit(client, shards, pod: dict, node: str,
         try:
             current = client.get_pod(namespace, name)
         except NotFound:
-            shards.note_cas_failure("pod-gone")
-            return f"shard-cas: {namespace}/{name} gone before commit"
+            return fail("pod-gone",
+                        f"shard-cas: {namespace}/{name} gone before "
+                        "commit")
         except Exception as e:  # noqa: BLE001 — requeue, next Filter retries
-            shards.note_cas_failure("read-failed")
-            return f"shard-cas: cannot read {namespace}/{name}: {e}"
+            return fail("read-failed",
+                        f"shard-cas: cannot read {namespace}/{name}: {e}")
         assigned, owner = _decision_of(current)
         if assigned and owner and owner != shards.replica:
             # Same rule against the LIVE pod: a peer's decision landed
             # since the view we decided on — don't race the patch.
-            shards.note_cas_failure("already-decided")
-            return (f"shard-cas: {namespace}/{name} already assigned to "
-                    f"{assigned} by {owner}")
+            return fail("already-decided",
+                        f"shard-cas: {namespace}/{name} already "
+                        f"assigned to {assigned} by {owner}")
         rv = current.get("metadata", {}).get("resourceVersion")
     try:
         client.patch_pod_annotations(namespace, name, full,
@@ -102,13 +120,13 @@ def cas_commit(client, shards, pod: dict, node: str,
     except Conflict:
         # The pod moved under us — a peer's decision, a deletion
         # mid-flight, any write.  Which one doesn't matter: fail closed.
-        shards.note_cas_failure("rv-conflict")
-        return (f"shard-cas: {namespace}/{name} changed since rv {rv}; "
-                "decision not committed, pod requeued")
+        return fail("rv-conflict",
+                    f"shard-cas: {namespace}/{name} changed since rv "
+                    f"{rv}; decision not committed, pod requeued")
     except NotFound:
-        shards.note_cas_failure("pod-gone")
-        return f"shard-cas: {namespace}/{name} gone before commit"
+        return fail("pod-gone",
+                    f"shard-cas: {namespace}/{name} gone before commit")
     except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
-        shards.note_cas_failure("write-failed")
-        return f"shard-cas: writing decision failed: {e}"
+        return fail("write-failed",
+                    f"shard-cas: writing decision failed: {e}")
     return None

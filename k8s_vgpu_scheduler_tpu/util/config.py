@@ -242,6 +242,27 @@ class Config:
     # is traced) — a diagnosis tool, never an always-on default.
     perf_tracemalloc: bool = False
 
+    # Decision provenance (provenance/; docs/observability.md "Decision
+    # provenance").  On by default — every decision site emits one
+    # structured record into the bounded per-pod timeline store behind
+    # GET /explainz and vtpu-explain; the emit budget is <2% on
+    # bench_batch_cycle (bench_provenance_overhead asserts it), with
+    # --no-provenance as the escape hatch and the A/B's baseline leg.
+    provenance_enabled: bool = True
+    # Records kept per pod (a ring; older records retire, counted).
+    provenance_per_pod: int = 64
+    # Fleet-wide timeline cap with LRU retirement — the store can never
+    # exceed provenance_max_pods x provenance_per_pod records.
+    provenance_max_pods: int = 8192
+    # Sustained-unplaceability kube Events: a pod still unplaced this
+    # long after its first rejection gets an Unschedulable event naming
+    # the top rejection reasons with node counts...
+    explain_event_grace_s: float = 60.0
+    # ...re-emitted at most once per throttle window while it stays
+    # unplaced (the queue-position patch discipline: never a per-retry
+    # apiserver write).
+    explain_event_throttle_s: float = 300.0
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

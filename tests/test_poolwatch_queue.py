@@ -342,3 +342,4 @@ class TestPerfSnapshot:
         m = re.search(r'default="([a-z,]+)"\)', src)
         assert m and "perf" in m.group(1).split(",")
         assert "capacity" in m.group(1).split(",")
+        assert "explain" in m.group(1).split(",")

@@ -1,0 +1,683 @@
+"""Decision-provenance store units (provenance/store.py).
+
+The ISSUE 13 tier-1 pins: the per-pod ring and fleet-wide LRU cap are
+provably bounded, timelines are gap-free by construction (and say so
+when the ring DID drop), the async emit_many inbox is always drained
+before any read (a reader can never observe a handed-over record as
+missing), concurrent emitters never corrupt a timeline, and the WAL
+seed path gives adopting replicas a terminal record without duplicating
+one the store already has.  The cross-subsystem emit sites are proven
+end-to-end by `make explain-sim`; these tests pin the store contract
+those sites rely on.
+"""
+
+import json
+import threading
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.provenance.store import (
+    ProvenanceConfig,
+    ProvenanceStore,
+    reason_tally,
+)
+
+
+def mk(per_pod=8, max_pods=16, enabled=True) -> ProvenanceStore:
+    return ProvenanceStore(ProvenanceConfig(
+        per_pod=per_pod, max_pods=max_pods, enabled=enabled))
+
+
+class TestBounds:
+    def test_per_pod_ring_retires_oldest_and_reports_truncation(self):
+        st = mk(per_pod=8)
+        try:
+            for i in range(20):
+                st.emit("u1", f"stage-{i}", namespace="ns", name="p")
+            doc = st.explain("ns/p")
+            assert len(doc["records"]) == 8
+            # The ring kept the NEWEST 8 of 20: seqs 13..20, contiguous.
+            assert [r["seq"] for r in doc["records"]] == \
+                list(range(13, 21))
+            assert doc["truncated"] == 12
+            # A timeline that lost history must say so, never present
+            # a trimmed window as the whole story.
+            assert doc["gap_free"] is False
+        finally:
+            st.close()
+
+    def test_fleet_cap_retires_lru_pod(self):
+        st = mk(max_pods=16)
+        try:
+            for i in range(40):
+                st.emit(f"u{i}", "webhook", namespace="ns", name=f"p{i}")
+            assert st.pods() == 16
+            assert st.retired_pods_total == 24
+            # Oldest timelines are the retired ones...
+            assert st.explain("u0") is None
+            assert st.explain("ns/p0") is None
+            # ...newest survive, still resolvable by name.
+            assert st.explain("ns/p39")["records"][0]["stage"] == "webhook"
+        finally:
+            st.close()
+
+    def test_touching_a_pod_refreshes_lru_recency(self):
+        st = mk(max_pods=16)
+        try:
+            for i in range(16):
+                st.emit(f"u{i}", "webhook", namespace="ns", name=f"p{i}")
+            st.emit("u0", "quota-hold", reason="over quota")  # refresh
+            st.emit("unew", "webhook", namespace="ns", name="pnew")
+            assert st.explain("u0") is not None   # refreshed: survived
+            assert st.explain("u1") is None       # became LRU: retired
+        finally:
+            st.close()
+
+    def test_admit_at_cap_never_retires_the_newcomer(self):
+        """When every older timeline was touched since its last clock
+        consideration (normal once the cap is first reached), the hand
+        wraps to the tail — it must give every older pod its second
+        chance and retire one of THEM, never the pod being admitted."""
+        st = mk(max_pods=16)
+        try:
+            for i in range(16):
+                st.emit(f"u{i}", "webhook", namespace="ns", name=f"p{i}")
+            for i in range(16):      # touch everyone: all get chances
+                st.emit(f"u{i}", "quota-hold", reason="over quota")
+            st.emit("unew", "decision-committed", namespace="ns",
+                    name="pnew", node="n1")
+            assert st.explain("unew") is not None
+            assert st.last_grant_node("unew") == "n1"
+            assert st.pods() == 16
+            assert st.retired_pods_total == 1
+        finally:
+            st.close()
+
+    def test_retired_pod_drops_last_grant_index(self):
+        st = mk(max_pods=16)
+        try:
+            st.emit("u0", "decision-committed", namespace="ns",
+                    name="p0", node="node-3")
+            assert st.last_grant_node("u0") == "node-3"
+            for i in range(1, 20):
+                st.emit(f"u{i}", "webhook", namespace="ns", name=f"p{i}")
+            assert st.last_grant_node("u0") is None
+        finally:
+            st.close()
+
+    def test_store_size_bounded_under_pod_storm(self):
+        st = mk(per_pod=4, max_pods=16)
+        try:
+            for i in range(500):
+                for j in range(10):
+                    st.emit(f"u{i}", f"s{j}", namespace="ns",
+                            name=f"p{i}")
+            assert st.pods() <= 16
+            total = sum(
+                len(st.explain(f"u{i}")["records"])
+                for i in range(500) if st.explain(f"u{i}"))
+            assert total <= 16 * 4
+        finally:
+            st.close()
+
+
+class TestGapFree:
+    def test_seq_contiguous_within_ring(self):
+        st = mk(per_pod=64)
+        try:
+            for i in range(10):
+                st.emit("u1", f"stage-{i}", namespace="ns", name="p")
+            doc = st.explain("u1")
+            assert doc["gap_free"] is True
+            assert [r["seq"] for r in doc["records"]] == \
+                list(range(1, 11))
+            assert doc["truncated"] == 0
+            assert doc["final"]["stage"] == "stage-9"
+        finally:
+            st.close()
+
+    def test_emit_many_then_emit_preserves_order(self):
+        """Async hand-over must not reorder: a direct emit after an
+        emit_many folds the pending segment FIRST, so causally-later
+        records always carry later seqs."""
+        st = mk()
+        try:
+            st.emit_many([("u1", "batch-no-fit", "ns", "p",
+                           {"reasons": {"n0": "insufficient-hbm"}})])
+            st.emit("u1", "decision-committed", node="n1")
+            recs = st.explain("u1")["records"]
+            assert [r["stage"] for r in recs] == \
+                ["batch-no-fit", "decision-committed"]
+            assert st.explain("u1")["gap_free"] is True
+        finally:
+            st.close()
+
+    def test_reads_drain_the_inbox(self):
+        """A record handed over via emit_many is visible to the very
+        next read, folder thread or not — the reader folds first."""
+        st = mk()
+        try:
+            st.emit_many([("u1", "webhook", "ns", "p", {"qos": "be"})])
+            assert st.has("u1")
+            assert st.resolve("ns/p") == "u1"
+            assert st.explain("ns/p")["records"][0]["detail"]["qos"] \
+                == "be"
+        finally:
+            st.close()
+
+    def test_dedupe_skips_identical_repeat_only(self):
+        st = mk()
+        try:
+            for _ in range(5):
+                st.emit("u1", "quota-hold", namespace="ns", name="p",
+                        dedupe=True, reason="over quota")
+            st.emit("u1", "quota-hold", dedupe=True, reason="throttled")
+            recs = st.explain("u1")["records"]
+            assert len(recs) == 2
+            # Dedupe consumes no seq — the timeline stays gap-free.
+            assert st.explain("u1")["gap_free"] is True
+        finally:
+            st.close()
+
+
+class TestInboxBackstop:
+    def test_inline_fold_bounds_unfolded_segments(self):
+        """With the folder wedged (never started), emit_many folds
+        inline at the segment cap instead of growing without bound —
+        no record is dropped."""
+        from k8s_vgpu_scheduler_tpu.provenance import store as mod
+        st = mk(per_pod=4096, max_pods=4096)
+        st._closed = True          # folder can never start
+        try:
+            n = mod._INBOX_SEGMENTS + 8
+            for i in range(n):
+                st.emit_many([(f"u{i % 4}", f"s{i}", "ns",
+                               f"p{i % 4}", {})])
+                assert len(st._inbox) < mod._INBOX_SEGMENTS
+            total = sum(len(st.explain(f"u{j}")["records"])
+                        for j in range(4))
+            assert total == n
+        finally:
+            st.close()
+
+    def test_close_folds_pending_and_stays_readable(self):
+        st = mk()
+        st.emit_many([("u1", "decision-committed", "ns", "p",
+                       {"node": "n1"})])
+        st.close()
+        doc = st.explain("u1")
+        assert doc["final"]["detail"]["node"] == "n1"
+        assert st.last_grant_node("u1") == "n1"
+
+
+class TestConcurrency:
+    def test_concurrent_emitters_never_corrupt_timelines(self):
+        """8 threads × direct emits + batched hand-overs over
+        overlapping pods: every record folds exactly once, every
+        timeline's surviving seqs are strictly increasing, and the
+        lifetime counter agrees with what readers can account for."""
+        st = mk(per_pod=4096, max_pods=4096)
+        threads, n_each = 8, 200
+        errs = []
+
+        def worker(t):
+            try:
+                for i in range(n_each):
+                    uid = f"u{(t + i) % 16}"
+                    if i % 3 == 0:
+                        st.emit_many([(uid, f"t{t}-i{i}", "ns", uid, {})])
+                    else:
+                        st.emit(uid, f"t{t}-i{i}", namespace="ns",
+                                name=uid)
+                    if i % 41 == 0:
+                        st.explain(uid)     # readers interleave
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        try:
+            assert not errs
+            assert st.emitted_total == threads * n_each
+            kept = 0
+            for i in range(16):
+                doc = st.explain(f"u{i}")
+                seqs = [r["seq"] for r in doc["records"]]
+                assert seqs == sorted(seqs)
+                assert len(set(seqs)) == len(seqs)
+                assert doc["truncated"] == 0
+                kept += len(seqs)
+            assert kept == threads * n_each
+        finally:
+            st.close()
+
+
+class TestCycleHandOver:
+    def test_emit_cycle_records_are_terminal_and_normalized(self):
+        """The batched front door's flat hand-over tuples — (uid, ns,
+        name, node, raw audit) — read back as normal decision-committed
+        records: node, solver, boxed score, -inf runner-up → None."""
+        st = mk()
+        try:
+            st.emit_cycle("regret", [
+                ("u1", "ns", "p1", "node-3", (3.25, 2.5)),
+                ("u2", "ns", "p2", "node-4", (1.5, float("-inf"))),
+                ("u3", "ns", "p3", "node-5", None),
+            ])
+            d1 = st.explain("ns/p1")["final"]["detail"]
+            assert d1 == {"node": "node-3", "solver": "regret",
+                          "score": 3.25, "runner_up": 2.5}
+            d2 = st.explain("u2")["final"]["detail"]
+            assert d2["runner_up"] is None     # only feasible node
+            d3 = st.explain("u3")["final"]["detail"]
+            assert d3 == {"node": "node-5"}    # fifo path: no audit
+            assert st.last_grant_node("u1") == "node-3"
+            assert st.explain("u1")["gap_free"] is True
+        finally:
+            st.close()
+
+    def test_emit_cycle_numpy_scores_box_at_read(self):
+        """Raw numpy solver scalars ride the hand-over; the explain
+        doc must still be json-serializable (boxed at read time)."""
+        np = pytest.importorskip("numpy")
+        st = mk()
+        try:
+            st.emit_cycle("regret", [
+                ("u1", "ns", "p", "n1",
+                 (np.float64(2.0), np.float64(1.0)))])
+            doc = st.explain("u1")
+            d = doc["final"]["detail"]
+            assert type(d["score"]) is float and d["score"] == 2.0
+            json.dumps(doc)
+        finally:
+            st.close()
+
+    def test_emit_cycle_interleaves_in_order_with_emit(self):
+        st = mk()
+        try:
+            st.emit("u1", "filter-rejected", namespace="ns", name="p",
+                    error="no fit")
+            st.emit_cycle("fifo", [("u1", "ns", "p", "n1", None)])
+            st.emit("u1", "deleted")
+            stages = [r["stage"] for r in st.explain("u1")["records"]]
+            assert stages == ["filter-rejected", "decision-committed",
+                              "deleted"]
+            assert st.explain("u1")["gap_free"] is True
+        finally:
+            st.close()
+
+    def test_ring_hysteresis_never_shows_more_than_per_pod(self):
+        """The timeline list may overshoot to trim_at internally; a
+        reader only ever sees the newest per_pod records, contiguous,
+        with the loss counted."""
+        st = mk(per_pod=8)
+        try:
+            for i in range(11):    # inside the hysteresis window
+                st.emit("u1", f"s{i}", namespace="ns", name="p")
+            doc = st.explain("u1")
+            assert len(doc["records"]) == 8
+            assert [r["seq"] for r in doc["records"]] == \
+                list(range(4, 12))
+            assert doc["truncated"] == 3
+        finally:
+            st.close()
+
+    def test_pending_grant_suppresses_wal_self_seed(self):
+        """The decision path advertises its grant BEFORE the write;
+        the informer's echo must not mint a wal-adopted record.  A
+        failed write revokes the advertisement so a peer's grant on
+        the same node can still seed later."""
+        st = mk()
+        try:
+            st.note_pending_grant("u1", "node-3")
+            assert st.seed_from_wal("u1", "ns", "p", "node-3") is False
+            assert st.explain("u1") is None    # nothing minted
+            st.drop_pending_grant("u1", "node-3")
+            assert st.seed_from_wal("u1", "ns", "p", "node-3") is True
+            assert st.explain("u1")["final"]["stage"] == "wal-adopted"
+            # Revoking must not clobber a DIFFERENT recorded grant.
+            st.note_pending_grant("u2", "node-9")
+            st.drop_pending_grant("u2", "node-8")
+            assert st.last_grant_node("u2") == "node-9"
+        finally:
+            st.close()
+
+
+class TestWalContinuity:
+    def test_seed_records_adopted_grant(self):
+        st = mk()
+        try:
+            assert st.seed_from_wal("u1", "ns", "p", "node-7",
+                                    decided_by="replica-0",
+                                    decided_t="123") is True
+            doc = st.explain("ns/p")
+            assert doc["final"]["stage"] == "wal-adopted"
+            assert doc["final"]["detail"]["node"] == "node-7"
+            assert doc["final"]["detail"]["decided_by"] == "replica-0"
+            assert st.last_grant_node("u1") == "node-7"
+        finally:
+            st.close()
+
+    def test_seed_noop_when_grant_already_recorded(self):
+        st = mk()
+        try:
+            st.emit("u1", "decision-committed", namespace="ns",
+                    name="p", node="node-7")
+            assert st.seed_from_wal("u1", "ns", "p", "node-7") is False
+            assert len(st.explain("u1")["records"]) == 1
+        finally:
+            st.close()
+
+    def test_rejection_only_timeline_absorbs_peer_grant(self):
+        """A replica that only ever gated the pod (shard-not-owned)
+        still absorbs the owning peer's committed grant from the WAL."""
+        st = mk()
+        try:
+            st.emit("u1", "filter-rejected", namespace="ns", name="p",
+                    error="shard-not-owned: node-3 owned by replica-1")
+            assert st.seed_from_wal("u1", "ns", "p", "node-3",
+                                    decided_by="replica-1") is True
+            stages = [r["stage"] for r in st.explain("u1")["records"]]
+            assert stages == ["filter-rejected", "wal-adopted"]
+        finally:
+            st.close()
+
+    def test_repeated_seeds_dedupe(self):
+        st = mk()
+        try:
+            st.seed_from_wal("u1", "ns", "p", "node-7")
+            # Informer replays (resync) repeat the same annotations.
+            st.seed_from_wal("u1", "ns", "p", "node-7")
+            st.seed_from_wal("u1", "ns", "p", "node-7")
+            assert len(st.explain("u1")["records"]) == 1
+        finally:
+            st.close()
+
+
+class TestResolveAndDisable:
+    def test_resolve_name_uid_and_reuse(self):
+        st = mk()
+        try:
+            st.emit("u-old", "webhook", namespace="ns", name="p")
+            st.emit("u-new", "webhook", namespace="ns", name="p")
+            # A reused pod name points at the LIVE incarnation; the old
+            # uid stays queryable directly.
+            assert st.resolve("ns/p") == "u-new"
+            assert st.resolve("u-old") == "u-old"
+            assert st.resolve("ns/ghost") is None
+        finally:
+            st.close()
+
+    def test_disabled_store_is_inert(self):
+        st = mk(enabled=False)
+        try:
+            st.emit("u1", "webhook", namespace="ns", name="p")
+            st.emit_many([("u1", "webhook", "ns", "p", {})])
+            assert st.seed_from_wal("u1", "ns", "p", "n1") is False
+            assert st.explain("u1") is None
+            assert st.pods() == 0
+            assert st.emitted_total == 0
+        finally:
+            st.close()
+
+    def test_forget_drops_one_timeline(self):
+        st = mk()
+        try:
+            st.emit("u1", "webhook", namespace="ns", name="p1")
+            st.emit("u2", "webhook", namespace="ns", name="p2")
+            st.forget("u1")
+            assert st.explain("u1") is None
+            assert st.resolve("ns/p1") is None
+            assert st.explain("u2") is not None
+        finally:
+            st.close()
+
+
+class TestUnschedulableEvent:
+    def test_sustained_rejection_emits_throttled_event(self):
+        """ISSUE 13 satellite: a pod rejected past the grace window
+        gets ONE Unschedulable kube Event naming the top rejection
+        reasons with node counts (and an unschedulable-event record),
+        throttled — further retries inside the throttle window write
+        nothing more to the apiserver."""
+        import time as _time
+
+        import tests.test_scheduler_concurrency as tc
+        from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config(explain_event_grace_s=0.05,
+                                   explain_event_throttle_s=3600.0))
+        try:
+            kube.add_node({"metadata": {"name": "node-0",
+                                        "annotations": {}}})
+            tc.register_node(s, "node-0", chips=tc.CHIPS_PER_NODE,
+                             devmem=tc.CHIP_MIB)
+            kube.watch_pods(s.on_pod_event)
+            pod = tc.tpu_pod("big", uid="u-big", mem="99999999")
+            kube.create_pod(pod)
+            assert s.filter(pod, ["node-0"]).node is None
+            assert kube.events == []     # first sight: grace running
+            _time.sleep(0.06)
+            for _ in range(3):           # retries past the grace
+                assert s.filter(pod, ["node-0"]).node is None
+            evs = [e for e in kube.events
+                   if e["reason"] == "Unschedulable"]
+            assert len(evs) == 1, kube.events   # throttled: exactly one
+            assert evs[0]["type"] == "Warning"
+            assert "insufficient-hbm" in evs[0]["message"]
+            assert "vtpu-explain default/big" in evs[0]["message"]
+            assert evs[0]["involvedObject"]["uid"] == "u-big"
+            doc = s.export_explain("default/big")
+            stages = [r["stage"] for r in doc["records"]]
+            assert "unschedulable-event" in stages
+            assert doc["dominant_rejection"] == "insufficient-hbm"
+        finally:
+            s.close()
+
+    def test_quota_holds_do_not_event(self):
+        """A held pod carries no candidate sweep — its wait already has
+        a user-visible story (Queued events, queue-position); the
+        Unschedulable event is only for pods the fleet REJECTED."""
+        import tests.test_scheduler_concurrency as tc
+        from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config(explain_event_grace_s=0.0))
+        try:
+            result = type("R", (), {"node": None, "failed": {},
+                                    "error": "held in capacity queue q "
+                                             "(position 1/1)",
+                                    "preempt": None})()
+            pod = tc.tpu_pod("held", uid="u-held")
+            for _ in range(3):
+                s._note_rejection(pod, result)
+            assert kube.events == []
+        finally:
+            s.close()
+
+    def test_grace_and_throttle_ride_the_injected_clock(self):
+        """The grace/throttle bookkeeping must use the Scheduler's
+        injected clock — the simulator's virtual-clock replicas drive
+        every other time-gated path deterministically and this one is
+        no exception."""
+        import tests.test_scheduler_concurrency as tc
+        from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+
+        t = [0.0]
+        kube = FakeKube()
+        s = Scheduler(kube, Config(explain_event_grace_s=60.0,
+                                   explain_event_throttle_s=300.0),
+                      clock=lambda: t[0])
+        try:
+            kube.add_node({"metadata": {"name": "node-0",
+                                        "annotations": {}}})
+            tc.register_node(s, "node-0", chips=tc.CHIPS_PER_NODE,
+                             devmem=tc.CHIP_MIB)
+            kube.watch_pods(s.on_pod_event)
+            pod = tc.tpu_pod("big", uid="u-big", mem="99999999")
+            kube.create_pod(pod)
+            s.filter(pod, ["node-0"])
+            t[0] = 59.0
+            s.filter(pod, ["node-0"])
+            assert kube.events == []     # inside the virtual grace
+            t[0] = 61.0
+            s.filter(pod, ["node-0"])
+            assert [e["reason"] for e in kube.events] == \
+                ["Unschedulable"]
+            t[0] = 300.0                 # inside the throttle window
+            s.filter(pod, ["node-0"])
+            assert len(kube.events) == 1
+            t[0] = 362.0
+            s.filter(pod, ["node-0"])
+            assert len(kube.events) == 2
+        finally:
+            s.close()
+
+    def test_quota_hold_results_do_not_mint_filter_rejected(self):
+        """A quota hold already landed as a quota-hold record; the
+        rejection path must not add a filter-rejected twin per
+        queue-position move (it would halve the ring's retention and
+        narrate a sweep that never ran)."""
+        from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler.core import (
+            FilterResult,
+            Scheduler,
+        )
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        import tests.test_scheduler_concurrency as tc
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        try:
+            pod = tc.tpu_pod("held", uid="u-held")
+            res = FilterResult(error="held in capacity queue q "
+                                     "(position 1/1)")
+            res.quota_hold = True
+            s._note_quota_hold(pod, res.error)
+            s._note_rejection(pod, res)
+            stages = [r["stage"]
+                      for r in s.export_explain("u-held")["records"]]
+            assert stages == ["quota-hold"]
+        finally:
+            s.close()
+
+    def test_rejection_examples_follow_dominant_token_order(self):
+        """With more nodes than the 8 stored examples, the examples
+        must represent the DOMINANT tokens and the record must carry
+        the exact full tally — 8 alphabetically-first nodes can all
+        hold a minority token, making /explainz disagree with the
+        Unschedulable event computed over the full map."""
+        from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler.core import (
+            FilterResult,
+            Scheduler,
+        )
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+        import tests.test_scheduler_concurrency as tc
+
+        kube = FakeKube()
+        s = Scheduler(kube, Config())
+        try:
+            # 6 alphabetically-FIRST nodes unhealthy, 20 later nodes
+            # insufficient-hbm: the dominant token is the majority one.
+            failed = {f"aa-{i:02d}": "unhealthy" for i in range(6)}
+            failed.update({f"zz-{i:02d}": "insufficient-hbm: 8/8"
+                           for i in range(20)})
+            pod = tc.tpu_pod("big", uid="u-big")
+            s._note_rejection(pod, FilterResult(failed=failed,
+                                                error="no node fits"))
+            doc = s.export_explain("u-big")
+            rec = doc["records"][0]["detail"]
+            assert rec["reason_counts"] == {"insufficient-hbm": 20,
+                                            "unhealthy": 6}
+            assert all(v.startswith("insufficient-hbm")
+                       for v in rec["reasons"].values()), rec["reasons"]
+            assert len(rec["reasons"]) == 8
+            assert rec["rejected_nodes"] == 26
+            assert doc["dominant_rejection"] == "insufficient-hbm"
+        finally:
+            s.close()
+
+    def test_scheduler_close_stops_the_folder_thread(self):
+        """Embedders/benchmarks/tests discard Scheduler instances;
+        close() must stop the provenance folder like every other
+        background worker (the store stays readable)."""
+        from k8s_vgpu_scheduler_tpu.k8s.fake import FakeKube
+        from k8s_vgpu_scheduler_tpu.scheduler.core import Scheduler
+        from k8s_vgpu_scheduler_tpu.util.config import Config
+
+        s = Scheduler(FakeKube(), Config())
+        s.provenance.emit_many([("u1", "webhook", "ns", "p", {})])
+        folder = s.provenance._folder
+        s.close()
+        assert s.provenance._closed
+        assert folder is None or not folder.is_alive()
+        assert s.provenance.explain("u1") is not None
+
+    def test_event_rides_rest_transport_to_simserver(self):
+        """The apisim accepts the core/v1 Events POST RestKube sends —
+        without this route the satellite is unprovable over real
+        process boundaries (events silently 404ed)."""
+        from k8s_vgpu_scheduler_tpu.k8s.rest import RestKube
+        from k8s_vgpu_scheduler_tpu.k8s.simserver import KubeSimServer
+
+        sim = KubeSimServer()
+        sim.start()
+        try:
+            rk = RestKube(sim.url)
+            rk.create_event(
+                "ns", {"kind": "Pod", "name": "p", "namespace": "ns",
+                       "uid": "u"},
+                "Unschedulable", "no node fits", type_="Warning")
+            assert sim.kube.events[0]["reason"] == "Unschedulable"
+            assert sim.kube.events[0]["involvedObject"]["uid"] == "u"
+        finally:
+            sim.stop()
+
+
+class TestExplainDoc:
+    def test_dominant_rejection_from_newest_rejection_record(self):
+        st = mk()
+        try:
+            st.emit("u1", "filter-rejected", namespace="ns", name="p",
+                    reasons={"n0": "insufficient-hbm: 8/8",
+                             "n1": "insufficient-hbm: 8/8",
+                             "n2": "slots-exhausted: 8/8"})
+            st.emit("u1", "batch-no-fit",
+                    reasons={"n0": "type-mismatch: 8/8",
+                             "n1": "type-mismatch: 8/8",
+                             "n2": "insufficient-hbm: 8/8"})
+            doc = st.explain("u1")
+            # Newest rejection wins; its dominant token is the answer.
+            assert doc["dominant_rejection"] == "type-mismatch"
+        finally:
+            st.close()
+
+    def test_dominant_rejection_falls_back_to_error(self):
+        st = mk()
+        try:
+            st.emit("u1", "filter-rejected", namespace="ns", name="p",
+                    error="quota: held in queue team-a")
+            assert st.explain("u1")["dominant_rejection"] == "quota"
+        finally:
+            st.close()
+
+    def test_reason_tally_orders_most_common_first(self):
+        tally = reason_tally({
+            "n0": "insufficient-hbm: detail", "n1": "insufficient-hbm",
+            "n2": "slots-exhausted", "n3": "unhealthy",
+            "n4": "slots-exhausted", "n5": "insufficient-hbm"})
+        assert tally[0] == ("insufficient-hbm", 3)
+        assert tally[1] == ("slots-exhausted", 2)
+        assert tally[2] == ("unhealthy", 1)

@@ -795,3 +795,43 @@ class TestObservability:
         assert "nominal_chips" in csv_text.splitlines()[0]
         text = format_report(joined)
         assert "capacity queues" in text and "OVER" in text
+
+    def test_vtpu_report_pending_table_joins_explainz(self):
+        """ISSUE 13 satellite: every held entry in the /queuez rows is
+        annotated with its dominant rejection reason from /explainz —
+        graceful ('-') for pods provenance never saw, newest-stage
+        fallback for pods that were never rejected (quota holds)."""
+        from k8s_vgpu_scheduler_tpu.cmd.vtpu_report import (
+            format_report,
+            join_pending_reasons,
+        )
+
+        export = {"window_s": 300.0, "fleet": {}, "namespaces": [],
+                  "pods": [], "idle_grants": [],
+                  "queues": [{"queue": "a", "weight": 1.0,
+                              "nominal_chips": 4, "held_chips": 4,
+                              "borrowed_chips": 0, "pending": 3,
+                              "fair_share": 1.0, "namespaces": ["ns"],
+                              "pending_pods": [
+                                  {"pod": "ns/p1", "position": 1,
+                                   "chips": 2, "gang": None},
+                                  {"pod": "ns/p2", "position": 2,
+                                   "chips": 1, "gang": None},
+                                  {"pod": "ns/p3", "position": 3,
+                                   "chips": 1, "gang": None}]}]}
+        docs = {
+            "ns/p1": {"records": [1], "dominant_rejection":
+                      "insufficient-hbm", "final": {"stage": "x"}},
+            "ns/p2": {"records": [1], "dominant_rejection": None,
+                      "final": {"stage": "quota-hold"}},
+            "ns/p3": None,    # --no-provenance / never seen
+        }
+        joined = join_pending_reasons(
+            export, "http://x", fetch=lambda _c, ref: docs[ref])
+        rows = {r["pod"]: r for r in joined["pending_pods"]}
+        assert rows["ns/p1"]["dominant_rejection"] == "insufficient-hbm"
+        assert rows["ns/p2"]["dominant_rejection"] == "quota-hold"
+        assert rows["ns/p3"]["dominant_rejection"] == "-"
+        text = format_report(joined)
+        assert "pending pods" in text and "insufficient-hbm" in text
+        assert "vtpu-explain" in text

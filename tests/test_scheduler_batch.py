@@ -146,6 +146,96 @@ class TestColumnarParity:
                     assert ce.mem[row] == vec_mem[row]
 
 
+class TestRejectionReasonParity:
+    """ISSUE 13 satellite: the vectorized eligibility matrix must
+    surface the SAME per-node rejection-reason strings as the scalar
+    path (score._reject_summary over _chip_reject_reason's rule order)
+    — batched-path rejections may never collapse into coarser tokens
+    than a per-pod Filter would report for the same node.  A rule added
+    to score.py without its columnar twin in batch.node_reject_reason
+    fails this pin."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_reason_strings_match_scalar_summary(self, seed):
+        rng = random.Random(9000 + seed)
+        snap = random_fleet(rng)
+        fleet = batch_mod.ColumnarFleet()
+        fleet.refresh(snap)
+        rejections = 0
+        for trial in range(16):
+            req = random_request(rng, multi=rng.random() < 0.3)
+            anns = random_anns(rng)
+            affinity = score_mod.parse_affinity(anns)
+            for row, name in enumerate(fleet.names):
+                entry = snap[name]
+                cow = score_mod.CowUsage(entry.usage)
+                placed = score_mod.fit_pod(
+                    [req], cow, None, anns, "best-effort")
+                if placed is not None:
+                    continue
+                rejections += 1
+                want = score_mod._reject_summary(
+                    req, entry.usage, affinity)
+                got = batch_mod.node_reject_reason(
+                    fleet, req, affinity, row)
+                assert got == want, (
+                    f"seed {seed} trial {trial} node {name}: "
+                    f"vector reason {got!r} != scalar {want!r}")
+        assert rejections > 0, "fleet too permissive to pin parity"
+
+    # One crafted node per scalar rule: (chip overrides, request
+    # overrides, annotations, expected dominant token).  Exercises the
+    # FULL rule chain in _chip_reject_reason's order — including the
+    # tokens random fleets cannot reach (fully-committed cores, busy
+    # chip under an exclusive request) — so every token the scalar
+    # path can put in front of an operator has its columnar twin
+    # pinned string-for-string.
+    RULE_CASES = [
+        ("unhealthy", dict(health=False), dict(), {}),
+        ("type-mismatch", dict(), dict(), {"vtpu.dev/use-tputype": "v4"}),
+        ("slots-exhausted", dict(used_slots=10), dict(), {}),
+        ("cores-exhausted", dict(used_slots=1, used_cores=100),
+         dict(), {}),
+        ("exclusive-chip-busy", dict(used_slots=1, used_cores=15),
+         dict(coresreq=100), {}),
+        ("insufficient-cores", dict(used_slots=1, used_cores=30),
+         dict(coresreq=80), {}),
+        ("insufficient-hbm", dict(used_slots=1, used_mem=15000),
+         dict(memreq=8000), {}),
+        ("too-few-chips", dict(), dict(nums=2), {}),
+    ]
+
+    @pytest.mark.parametrize(
+        "token,chip,reqkw,anns",
+        RULE_CASES, ids=[c[0] for c in RULE_CASES])
+    def test_each_scalar_rule_has_a_columnar_twin(self, token, chip,
+                                                  reqkw, anns):
+        usage = {"n0-chip-0": score_mod.DeviceUsage(
+            id="n0-chip-0", type="TPU-v5e", coords=(0, 0),
+            health=chip.get("health", True), total_slots=10,
+            used_slots=chip.get("used_slots", 0), total_mem=16384,
+            used_mem=chip.get("used_mem", 0), total_cores=100,
+            used_cores=chip.get("used_cores", 0))}
+        info = NodeInfo(name="n0", devices=[DeviceInfo(
+            id="n0-chip-0", count=10, devmem=16384, type="TPU-v5e",
+            health=chip.get("health", True), coords=(0, 0))],
+            topology=None)
+        snap = {"n0": SnapEntry((1, 1), info, usage)}
+        fleet = batch_mod.ColumnarFleet()
+        fleet.refresh(snap)
+        req = ContainerDeviceRequest(
+            nums=reqkw.get("nums", 1), type="TPU",
+            memreq=reqkw.get("memreq", 500), mem_percentage_req=0,
+            coresreq=reqkw.get("coresreq", 0))
+        affinity = score_mod.parse_affinity(anns)
+        assert score_mod.fit_pod([req], score_mod.CowUsage(usage),
+                                 None, anns, "best-effort") is None
+        want = score_mod._reject_summary(req, usage, affinity)
+        got = batch_mod.node_reject_reason(fleet, req, affinity, 0)
+        assert got == want
+        assert got.split(":", 1)[0] == token
+
+
 def build_pair(n_nodes=4, chips=4, devmem=16384, topology=True,
                **batched_cfg):
     """Two identical fleets: one serial per-pod scheduler, one batched
@@ -325,8 +415,9 @@ class TestBatchProtocol:
         real_solve = batch_mod.solve
         fired = {"n": 0}
 
-        def racing_solve(fleet, cohorts, n_jobs, solver):
-            plan = real_solve(fleet, cohorts, n_jobs, solver)
+        def racing_solve(fleet, cohorts, n_jobs, solver, audit=None):
+            plan = real_solve(fleet, cohorts, n_jobs, solver,
+                              audit=audit)
             if fired["n"] == 0 and any(plan):
                 fired["n"] = 1
                 row = next(p[0] for p in plan if p)

@@ -260,8 +260,13 @@ class TestOptimisticCommitProtocol:
         chain must be treated as a conflict (undo + refit against the
         live view that includes the interleaver), or the commit would
         keep a placement computed blind to the interleaved grant AND
-        publish a snapshot that hides it (double-booking both ways)."""
-        kube, s, names = make_env(n_nodes=1)
+        publish a snapshot that hides it (double-booking both ways).
+        Pins the PER-POD commit path explicitly (make_env discipline):
+        the batched group commit holds the registry lock across the
+        whole group, so this interleave is structurally excluded there
+        — its rev check is pinned by
+        test_interleaved_watch_add_conflicts_batch_group_commit."""
+        kube, s, names = make_env(n_nodes=1, filter_batch=False)
         from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
         from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
 
@@ -284,6 +289,49 @@ class TestOptimisticCommitProtocol:
         kube.create_pod(pod)
         assert s.filter(pod, names).node is not None
         assert s.commit_conflicts == 1  # the chain break is a conflict
+        got = s.inspect_all_nodes_usage()
+        total = sum(u.used_mem for usage in got.values()
+                    for u in usage.values())
+        assert total == 3000, f"interleaved grant hidden: {total}"
+        assert_no_overallocation(s)
+
+    def test_interleaved_watch_add_conflicts_batch_group_commit(self):
+        """The batched twin of the refit pin above: the group commit
+        validates the node's rev INSIDE the registry lock, so a watch
+        add landing between the cycle's snapshot and its publish moves
+        the rev and the WHOLE group must refuse (None) — the cycle
+        falls back rather than publishing a placement computed blind
+        to the interleaver."""
+        kube, s, names = make_env(n_nodes=1, filter_batch=True)
+        from k8s_vgpu_scheduler_tpu.scheduler.pods import PodInfo
+        from k8s_vgpu_scheduler_tpu.util.types import ContainerDevice
+
+        real_group = s.pods.add_pods_group
+        fired = {"n": 0}
+
+        def interleaved_group(infos, node, expected_rev):
+            if fired["n"] == 0:
+                fired["n"] = 1
+                # The watch thread wins the race: its grant lands
+                # first and occupies the expected rev.
+                s.pods.add_pod(PodInfo(
+                    uid="watch-rival", name="watch-rival",
+                    namespace="default", node=node,
+                    devices=[[ContainerDevice(
+                        uuid=f"{node}-chip-0", type="TPU-v5e",
+                        usedmem=1000, usedcores=0)]]))
+            return real_group(infos, node, expected_rev)
+
+        s.pods.add_pods_group = interleaved_group
+        pod = tpu_pod("p", uid="u", mem="2000")
+        kube.create_pod(pod)
+        res = s.filter_many([(pod, names)])[0]
+        assert res.node is not None
+        assert fired["n"] == 1
+        # The moved rev refused the group; the pod still placed (the
+        # cycle's conflict fallback), and BOTH grants are visible.
+        assert s.batch.stats.fallback_reason_counts().get(
+            "commit-conflict", 0) >= 1
         got = s.inspect_all_nodes_usage()
         total = sum(u.used_mem for usage in got.values()
                     for u in usage.values())

@@ -419,6 +419,42 @@ def test_ha_replay_is_deterministic():
     assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
 
 
+EXPLAIN = {"ha": {**HA["ha"], "explain": True}}
+
+
+def test_explain_sim_verdict():
+    """ISSUE 13 acceptance, asserted by the simulator verdict: after a
+    seeded replica kill mid-storm, EVERY terminal pod returns a
+    gap-free /explainz timeline from EVERY surviving replica whose
+    terminal record agrees with the grant on the annotation WAL —
+    including at least one pod the survivors know only through WAL
+    adoption — and a chaos-rescued pod's final record names the
+    rescuer's requester key."""
+    r = run_simulation(EXPLAIN, nodes=6, chips=4, hbm=16384,
+                       mesh=(4, 1))["ha"]
+    ex = r["explain"]
+    v = ex["verdict"]
+    assert v["all_explained"], ex["failures"]
+    assert v["all_gap_free"], ex["failures"]
+    assert v["all_terminal_agree"], ex["failures"]
+    assert v["wal_continuity_exercised"], ex
+    assert v["eviction_final_record_ok"], ex["eviction"]
+    assert v["ok"] and r["verdict"]["ok"]
+    assert ex["terminal_pods"] == EXPLAIN["ha"]["storm"]["count"]
+
+
+def test_explain_replay_is_deterministic():
+    """Same seed, bit-identical explain audit twice — the explain-sim
+    verdict can gate CI only if the timelines (stages, counts, WAL
+    adoption, the chaos eviction) replay without flake.  The audit
+    report carries no wall-clock stamps by construction."""
+    a = run_simulation(EXPLAIN, nodes=6, chips=4, hbm=16384,
+                       mesh=(4, 1))["ha"]["explain"]
+    b = run_simulation(EXPLAIN, nodes=6, chips=4, hbm=16384,
+                       mesh=(4, 1))["ha"]["explain"]
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
 SERVING = {"serving": {}}
 
 
