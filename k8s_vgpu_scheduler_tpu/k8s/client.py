@@ -72,18 +72,36 @@ class KubeClient:
         raise NotImplementedError
 
     def patch_pod_annotations_many(
-        self, patches: List[Tuple[str, str, Dict[str, Optional[str]]]]
+        self, patches: List[tuple]
     ) -> List[Optional[Exception]]:
         """Apply many annotation merge-patches; per-entry outcome (None =
         applied, else the exception) so one failed pod never poisons the
-        rest of a batch.  The base implementation loops; transports with a
-        cheaper amortized path (a pipelined connection, a server-side
-        batch endpoint) override it — util/decisionwriter.py feeds whole
-        decision-write batches through here."""
+        rest of a batch.  Each entry is ``(namespace, name, annotations)``
+        or ``(namespace, name, annotations, resource_version)`` — the
+        4-tuple form makes that entry a CAS exactly like the single-call
+        ``resource_version`` argument (a stale version yields a
+        :class:`Conflict` in that entry's slot), so the sharded bulk
+        commit (shard/commit.py cas_commit_many) can amortize a whole
+        cycle's fenced writes.  The base implementation loops; transports
+        with a cheaper amortized path (a pipelined connection, a
+        server-side batch endpoint, FakeKube's one-acquire bulk apply)
+        override it — util/decisionwriter.py feeds whole decision-write
+        batches through here."""
         out: List[Optional[Exception]] = []
-        for namespace, name, annotations in patches:
+        for entry in patches:
+            namespace, name, annotations = entry[:3]
+            rv = entry[3] if len(entry) > 3 else None
             try:
-                self.patch_pod_annotations(namespace, name, annotations)
+                if rv is None:
+                    # No kwarg on the plain form: test fakes (and thin
+                    # embedder clients) override patch_pod_annotations
+                    # without the resource_version parameter.
+                    self.patch_pod_annotations(namespace, name,
+                                               annotations)
+                else:
+                    self.patch_pod_annotations(namespace, name,
+                                               annotations,
+                                               resource_version=rv)
                 out.append(None)
             except Exception as e:  # noqa: BLE001 — per-entry isolation
                 out.append(e)

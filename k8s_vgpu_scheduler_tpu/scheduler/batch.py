@@ -52,6 +52,7 @@ import logging
 import math
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -106,6 +107,10 @@ class ColumnarFleet:
         self.row_of: Dict[str, int] = {}
         self.chip_ids: List[List[str]] = []
         self.chip_types: List[List[str]] = []
+        #: Per-row uuid -> column index (rebuilt with the row): the
+        #: delta-apply and slice-commit paths resolve chips through
+        #: this instead of building a fresh dict per row per use.
+        self.col_of: List[Dict[str, int]] = []
         self._types: List[str] = []
         self._type_id: Dict[str, int] = {}
         self.any_topology = False
@@ -126,6 +131,22 @@ class ColumnarFleet:
         #: the entry without a reload, so a steady-state cycle is O(rows
         #: changed by OTHERS), not O(rows we granted on).
         self.expected_key: Dict[int, tuple] = {}
+        #: Per-request-class cached evaluation columns, keyed on the
+        #: class fingerprint.  A cached class re-evaluates ONLY rows
+        #: dirtied since its last sync (completions, heartbeat flips,
+        #: in-batch grants, lease/shard-gate moves) — the steady-state
+        #: vector-eval cost becomes O(dirty rows × classes), not
+        #: O(fleet × classes) per cycle.  Bounded LRU; a full rebuild
+        #: (row indices move) drops it wholesale.
+        self._class_cache: "OrderedDict[tuple, _ClassEval]" = OrderedDict()
+        #: Lifetime telemetry for /perfz and the steady-state bench
+        #: gates: rows reloaded from snapshot entries, rows patched via
+        #: write-through deltas, cached-class rows re-evaluated scalar,
+        #: and whole-fleet class evaluations (cache misses / overflows).
+        self.rows_reloaded_total = 0
+        self.rows_patched_total = 0
+        self.class_rows_patched = 0
+        self.class_evals_full = 0
         self._alloc(0, 1)
 
     # -- storage ---------------------------------------------------------------
@@ -155,6 +176,12 @@ class ColumnarFleet:
         self.alive: List[bool] = [True] * n       # lease gate, set per cycle
         self.bonus: List[float] = [0.0] * n       # --score-by-actual
         self.base: List[float] = [0.0] * n        # spread-form node score
+        # Pooled numpy scratch for the vectorized class evaluation:
+        # buffers are reused across cycles (keyed by name, sized to the
+        # fleet shape) so a full class eval allocates nothing on the
+        # steady path — Python allocation pressure in the per-tick
+        # drain was a measured GC driver (STEADY_r07).
+        self._bufs: Dict[str, np.ndarray] = {}
 
     def _type_of(self, t: str) -> int:
         got = self._type_id.get(t)
@@ -165,16 +192,58 @@ class ColumnarFleet:
         return got
 
     # -- maintenance -----------------------------------------------------------
-    def refresh(self, snap: Dict[str, object]) -> int:
+    def _note_dirty(self, row: int) -> None:
+        """Mark ``row`` changed for every cached class evaluation — the
+        next sync re-evaluates exactly these rows (scalar, bit-identical
+        to the vectorized pass by the parity pin)."""
+        for ce in self._class_cache.values():
+            ce.pending.add(row)
+
+    def refresh(self, snap: Dict[str, object],
+                deltas: Optional[Dict[str, list]] = None,
+                changed: Optional[Set[str]] = None) -> int:
         """Bring the columnar view up to the snapshot; returns how many
-        rows were reloaded (0 on an unchanged fleet)."""
-        if snap.keys() != self._entries.keys():
-            self._rebuild(snap)
-            return self.N
+        rows were RELOADED from their entries (0 on an unchanged fleet).
+
+        ``deltas`` is the write-through queue the scheduler feeds from
+        the informer (pod completions/deletions and peer-replica grants,
+        each carrying the (pod rev, inventory rev) key it produced):
+        a row whose entry moved to exactly the key its queued deltas
+        chain to is PATCHED in place — O(chips touched) — instead of
+        reloaded, the same adoption rule the group commit's
+        ``expected_key`` already uses.  A chain that does not compose
+        (an event the queue never saw) falls back to the reload.
+
+        ``changed`` (Scheduler.snapshot_for_batch) is the exact set of
+        names whose entry was replaced since the last refresh: with it
+        the walk is O(changed + touched), not an O(fleet) identity scan
+        per cycle.  Every delta's node is in ``changed`` by
+        construction (its registry change marked the node dirty before
+        the snapshot that covers it).  None = legacy full scan."""
+        if changed is None:
+            if snap.keys() != self._entries.keys():
+                self._rebuild(snap)
+                return self.N
+            names = snap.keys()
+        else:
+            if len(snap) != len(self._entries):
+                self._rebuild(snap)
+                return self.N
+            names = changed
+            if self.touched:
+                names = set(changed)
+                names.update(self.names[r] for r in self.touched)
         touched, self.touched = self.touched, set()
         expected, self.expected_key = self.expected_key, {}
         reloaded = 0
-        for name, entry in snap.items():
+        patched = 0
+        for name in names:
+            entry = snap.get(name)
+            if entry is None or name not in self.row_of:
+                # Node-set membership moved (register/unregister with
+                # the fleet size coincidentally equal): rebuild.
+                self._rebuild(snap)
+                return self.N
             row = self.row_of[name]
             if self._entries.get(name) is entry:
                 if row in touched:
@@ -183,12 +252,27 @@ class ColumnarFleet:
                     self._load_row(row, name, entry)
                     reloaded += 1
                 continue
-            if entry.key == expected.get(row):
+            key = expected.get(row)
+            if key == entry.key:
                 # The entry moved to exactly the generation our group
                 # commit published — its usage equals the written-
                 # through columnar state; adopt without reloading.
                 self._entries[name] = entry
                 continue
+            if deltas is not None and (key is not None
+                                       or row not in touched):
+                # A touched row WITHOUT a published expected key lost
+                # its commit race: the mirrors hold phantom grants and
+                # only a reload squares them — deltas must not patch on
+                # top.  With the key published, every planned grant
+                # committed and the mirrors are exact.
+                pend = deltas.get(name)
+                if pend is not None and self._apply_deltas(
+                        row, name, entry,
+                        key if key is not None
+                        else self._entries[name].key, pend):
+                    patched += 1
+                    continue
             if len(entry.usage) > self.C:
                 self._rebuild(snap)
                 return self.N
@@ -196,10 +280,70 @@ class ColumnarFleet:
             reloaded += 1
         if reloaded:
             self.any_topology = bool(self.has_topology.any())
+        self.rows_reloaded_total += reloaded
+        self.rows_patched_total += patched
         return reloaded
+
+    def _apply_deltas(self, row: int, name: str, entry, start_key: tuple,
+                      pend: list) -> bool:
+        """Patch one row from its queued write-through deltas.  Each
+        delta is ``(sign, devices, key)``; the chain must step the pod
+        rev by exactly one per event from ``start_key`` to the entry's
+        key — any gap means an event the queue never captured, and the
+        caller reloads.  Validation runs BEFORE any mutation so a broken
+        chain leaves the row untouched."""
+        if pend[-1] is None:
+            return False    # poisoned queue (note_delta's cap): reload
+        if len(pend) > 1:
+            pend = sorted(pend, key=lambda d: d[2][0])
+        cur = start_key
+        for _sign, _devices, key in pend:
+            if key != (cur[0] + 1, cur[1]):
+                return False
+            cur = key
+        if cur != entry.key:
+            return False
+        cols = self.col_of[row]
+        us = self.p_used_slots[row]
+        um = self.p_used_mem[row]
+        uc = self.p_used_cores[row]
+        # Dry-run the chip lookups + underflow check first (mutating
+        # then failing would corrupt the row without a reload).
+        staged: List[Tuple[int, int, int, int]] = []
+        tallies: Dict[int, List[int]] = {}
+        for sign, devices, _key in pend:
+            for container in devices:
+                for d in container:
+                    c = cols.get(d.uuid)
+                    if c is None:
+                        return False
+                    t = tallies.get(c)
+                    if t is None:
+                        t = tallies[c] = [0, 0, 0]
+                    t[0] += sign
+                    t[1] += sign * d.usedmem
+                    t[2] += sign * d.usedcores
+                    staged.append((c, sign, d.usedmem, d.usedcores))
+        for c, t in tallies.items():
+            if us[c] + t[0] < 0 or um[c] + t[1] < 0 or uc[c] + t[2] < 0:
+                return False
+        for c, sign, mem, cores in staged:
+            us[c] += sign
+            um[c] += sign * mem
+            uc[c] += sign * cores
+            self.used_slots[row, c] += sign
+            self.used_mem[row, c] += sign * mem
+            self.used_cores[row, c] += sign * cores
+        self._recompute_base(row)
+        self._entries[name] = entry
+        self._note_dirty(row)
+        return True
 
     def _rebuild(self, snap: Dict[str, object]) -> None:
         self.rebuilds += 1
+        # Row indices move wholesale: every cached class evaluation is
+        # keyed by row and must go with them.
+        self._class_cache.clear()
         names = sorted(snap)
         c = max((len(e.usage) for e in snap.values()), default=1)
         self._alloc(len(names), max(1, c))
@@ -207,6 +351,7 @@ class ColumnarFleet:
         self.row_of = {n: i for i, n in enumerate(names)}
         self.chip_ids = [[] for _ in names]
         self.chip_types = [[] for _ in names]
+        self.col_of = [{} for _ in names]
         self._entries = {}
         self.touched = set()
         for row, name in enumerate(names):
@@ -256,6 +401,7 @@ class ColumnarFleet:
                 arr[row, n:] = 0
         self.chip_ids[row] = ids
         self.chip_types[row] = types
+        self.col_of[row] = {cid: c for c, cid in enumerate(ids)}
         self.p_used_slots[row] = p_us
         self.p_used_mem[row] = p_um
         self.p_used_cores[row] = p_uc
@@ -267,6 +413,7 @@ class ColumnarFleet:
         self.has_topology[row] = entry.info.topology is not None
         self._entries[name] = entry
         self._recompute_base(row)
+        self._note_dirty(row)
 
     def _recompute_base(self, row: int) -> None:
         """Node spread score = Σ over chips of free fractions, in the
@@ -308,37 +455,121 @@ class ColumnarFleet:
             self.used_cores[row, c] += coresreq
         self._recompute_base(row)
         self.touched.add(row)
+        self._note_dirty(row)
+
+    def set_gates(self, alive: List[bool], bonus: List[float]) -> None:
+        """Install the per-cycle row gates (lease/shard aliveness and
+        the measured-utilization bonus), dirtying exactly the rows whose
+        gate moved — a steady fleet pays an O(N) scalar compare, not a
+        fleet-wide class re-evaluation."""
+        old_a, old_b = self.alive, self.bonus
+        if len(old_a) == len(alive) and self._class_cache:
+            for r in range(len(alive)):
+                if alive[r] != old_a[r] or bonus[r] != old_b[r]:
+                    self._note_dirty(r)
+        self.alive = alive
+        self.bonus = bonus
+
+    #: Cached class evaluations kept live at once.  Small on purpose:
+    #: a storm has a handful of request shapes; an adversarial stream
+    #: of unique shapes degrades to the uncached full eval, never to
+    #: unbounded memory.
+    CLASS_CACHE_MAX = 32
+    #: Above this fraction of dirty rows the vectorized whole-fleet
+    #: pass is cheaper than scalar row patching (both produce the same
+    #: bits — the parity suite pins it).
+    PATCH_FRACTION = 4
+
+    def class_eval(self, fp: tuple, req, affinity,
+                   binpack: bool) -> "_ClassEval":
+        """Cached-or-built evaluation columns for one request class.
+        A hit re-evaluates only the rows dirtied since the class last
+        synced; a miss (or a dirty set too large to patch profitably)
+        runs the vectorized whole-fleet pass."""
+        ce = self._class_cache.get(fp)
+        if ce is not None and ce.binpack == binpack:
+            self._class_cache.move_to_end(fp)
+            if len(ce.allowed) < len(self._types):
+                # New chip types registered since the class was built:
+                # extend the affinity mask (type ids only ever append).
+                ce.allowed.extend(
+                    score_mod.type_allows(ce.affinity, t)
+                    for t in self._types[len(ce.allowed):])
+            pending = ce.pending
+            if len(pending) * self.PATCH_FRACTION > max(1, self.N):
+                eval_class_full(self, ce)
+                self.class_evals_full += 1
+            else:
+                for row in pending:
+                    eval_class_row(self, ce, row)
+                self.class_rows_patched += len(pending)
+            pending.clear()
+            return ce
+        ce = _ClassEval(req, affinity, binpack)
+        eval_class_full(self, ce)
+        self.class_evals_full += 1
+        while len(self._class_cache) >= self.CLASS_CACHE_MAX:
+            self._class_cache.popitem(last=False)
+        self._class_cache[fp] = ce
+        return ce
+
+    def _scratch(self, name: str, shape, dtype) -> np.ndarray:
+        """Reused numpy buffer (per name/shape/dtype) — the vectorized
+        evaluation's temporaries come from here instead of fresh
+        allocations every cycle."""
+        buf = self._bufs.get(name)
+        if buf is None or buf.shape != shape or buf.dtype != dtype:
+            buf = self._bufs[name] = np.empty(shape, dtype)
+        return buf
 
     # -- vectorized class evaluation (cycle start) -----------------------------
     def mem_need(self, req) -> np.ndarray:
         """Per-chip resolved HBM demand (score._resolve_mem semantics:
-        absolute wins, else percentage of the chip's advertised size)."""
+        absolute wins, else percentage of the chip's advertised size).
+        Returned from the scratch pool — valid until the next class
+        evaluation reuses the buffer."""
+        mem = self._scratch("mem", (self.N, self.C), np.int64)
         if req.memreq > 0:
-            return np.full((self.N, self.C), req.memreq, dtype=np.int64)
+            mem[...] = req.memreq
+            return mem
         pct = req.mem_percentage_req if req.mem_percentage_req > 0 else 100
-        return (self.total_mem * pct) // 100
+        np.multiply(self.total_mem, pct, out=mem)
+        np.floor_divide(mem, 100, out=mem)
+        return mem
 
     def eligibility(self, req, affinity) -> Tuple[np.ndarray, np.ndarray]:
         """Pods×chips fit mask (one request class at a time) + resolved
         mem demand — the full per-chip rule set of
-        score._chip_reject_reason, vectorized."""
+        score._chip_reject_reason, vectorized over pooled scratch
+        buffers (identical arithmetic, zero steady-state allocation)."""
+        shape = (self.N, self.C)
         allowed = np.fromiter(
             (score_mod.type_allows(affinity, t) for t in self._types),
             dtype=bool, count=len(self._types)) \
             if self._types else np.ones(1, dtype=bool)
         mem = self.mem_need(req)
-        free_slots = self.total_slots - self.used_slots
-        free_cores = self.total_cores - self.used_cores
-        free_mem = self.total_mem - self.used_mem
-        elig = (self.valid & self.health
-                & allowed[self.type_id]
-                & (free_slots > 0)
-                & (self.used_cores < self.total_cores)
-                & (req.coresreq <= free_cores)
-                & (mem <= free_mem))
+        elig = self._scratch("elig", shape, bool)
+        tmp = self._scratch("elig-tmp", shape, bool)
+        np.logical_and(self.valid, self.health, out=elig)
+        np.take(allowed, self.type_id, out=tmp)
+        elig &= tmp
+        np.less(self.used_slots, self.total_slots, out=tmp)
+        elig &= tmp
+        np.less(self.used_cores, self.total_cores, out=tmp)
+        elig &= tmp
+        free = self._scratch("free", shape, np.int64)
+        np.subtract(self.total_cores, self.used_cores, out=free)
+        np.less_equal(req.coresreq, free, out=tmp)
+        elig &= tmp
+        np.subtract(self.total_mem, self.used_mem, out=free)
+        np.less_equal(mem, free, out=tmp)
+        elig &= tmp
         if req.coresreq >= 100:
             # Exclusive wants a virgin chip (score.go:155–157).
-            elig &= (self.used_slots == 0) & (self.used_cores == 0)
+            np.equal(self.used_slots, 0, out=tmp)
+            elig &= tmp
+            np.equal(self.used_cores, 0, out=tmp)
+            elig &= tmp
         return elig, mem
 
 
@@ -351,7 +582,7 @@ class _ClassEval:
     lists — the solver reads and writes them scalar-at-a-time."""
 
     __slots__ = ("req", "affinity", "nums", "binpack", "allowed", "pct",
-                 "score", "chip", "mem")
+                 "score", "chip", "mem", "pending")
 
     def __init__(self, req, affinity, binpack: bool) -> None:
         self.req = req
@@ -364,6 +595,10 @@ class _ClassEval:
         self.score: List[float] = []
         self.chip: List[int] = []
         self.mem: List[int] = []
+        #: Rows dirtied since this class's columns last synced — the
+        #: fleet's class cache re-evaluates exactly these (see
+        #: ColumnarFleet.class_eval).
+        self.pending: Set[int] = set()
 
 
 def class_fingerprint(requests, anns, policy_default: str) -> tuple:
@@ -391,10 +626,12 @@ def eval_class_full(fleet: ColumnarFleet, ce: _ClassEval) -> None:
     k = ce.nums
     base = np.asarray(fleet.base)
     if k <= 1:
-        key = np.where(elig,
-                       fleet.used_slots * np.int64(_KEY_BASE)
-                       + fleet.used_mem,
-                       np.int64(-1))
+        key = fleet._scratch("key", (fleet.N, fleet.C), np.int64)
+        np.multiply(fleet.used_slots, np.int64(_KEY_BASE), out=key)
+        key += fleet.used_mem
+        notelig = fleet._scratch("elig-tmp", (fleet.N, fleet.C), bool)
+        np.logical_not(elig, out=notelig)
+        key[notelig] = np.int64(-1)
         chip = key.argmax(axis=1)
         sel = chip[:, None]
         ok = np.take_along_axis(key, sel, 1)[:, 0] >= 0
@@ -623,12 +860,22 @@ class _Cohort:
     __slots__ = ("ce", "rows", "rowset", "pos_of", "jobs", "head",
                  "heap")
 
-    def __init__(self, ce: _ClassEval, rows: Optional[List[int]]) -> None:
+    def __init__(self, ce: _ClassEval, rows: Optional[List[int]],
+                 rowset: Optional[Set[int]] = None,
+                 pos_of: Optional[Dict[int, int]] = None) -> None:
         self.ce = ce
         self.rows = rows        # fleet rows in OFFER order; None = all
         if rows is None:
             self.rowset = None
             self.pos_of = None
+        elif rowset is not None and pos_of is not None:
+            # Prebuilt offer structures (the engine's cross-cycle offer
+            # memo): a steady drain re-offers the same fleet-wide list
+            # every cycle, and rebuilding set+positions per cohort per
+            # cycle was O(fleet) Python the cached columns had just
+            # saved elsewhere.
+            self.rowset = rowset
+            self.pos_of = pos_of
         else:
             self.rowset = set(rows)
             self.pos_of: Dict[int, int] = {}
@@ -726,8 +973,21 @@ def solve(fleet: ColumnarFleet, cohorts: List[_Cohort], n_jobs: int,
             # (store._cycle_detail), not twice per placed pod.
             audit[job_idx] = (best, second)
         fleet.apply_grant(row, chips, mems, cohort.ce.req.coresreq)
+        # Cohorts sharing one request class share the cached _ClassEval:
+        # re-evaluate each distinct class once, then refresh every
+        # cohort's heap view.
+        seen: Set[int] = set()
         for c in cohorts:
-            eval_class_row(fleet, c.ce, row)
+            if id(c.ce) not in seen:
+                seen.add(id(c.ce))
+                eval_class_row(fleet, c.ce, row)
+                # This class is now CURRENT for the row (apply_grant's
+                # dirty mark just landed in pending): without the
+                # discard, every committed row would re-evaluate again
+                # next cycle for nothing — the expected-key adoption
+                # leaves the mirrors exactly as scored here.  A lost
+                # commit re-dirties via the reload.
+                c.ce.pending.discard(row)
             c.note_update(row)
 
     if solver == "fifo":
@@ -890,6 +1150,51 @@ class BatchEngine:
         self._queue: List[BatchJob] = []
         self._leader_active = False
         self._full = threading.Event()
+        # Write-through delta queue: the informer thread records pod
+        # completions/deletions (and peer-replica grants) here as
+        # (sign, devices, resulting key); the next cycle's refresh
+        # patches the affected rows in place instead of reloading them
+        # (ColumnarFleet.refresh).  Own small lock — the fleet itself
+        # is single-writer under the cycle lock.
+        self._delta_lock = threading.Lock()
+        self._pending_deltas: Dict[str, list] = {}
+        # Cross-cycle offer memo: offer tuple -> (rows, rowset, pos_of)
+        # against the CURRENT row layout.  Keyed on content (not list
+        # identity — ids recycle across cycles); invalidated wholesale
+        # when a rebuild moves row indices.  Bounded like the class
+        # cache.
+        self._offer_memo: Dict[tuple, tuple] = {}
+        self._offer_memo_rebuilds = -1
+
+    #: Queued deltas kept per node between cycles.  Past the cap the
+    #: node's queue is POISONED (a single None sentinel): the next
+    #: refresh falls back to the row reload, and the queue stays O(1)
+    #: — a scheduler whose batch path is idle (filter_batch off, or a
+    #: long arrival lull under a completion stream) must not retain an
+    #: unbounded tail of device lists.
+    DELTA_CAP = 128
+
+    def note_delta(self, node: str, devices, sign: int,
+                   key: tuple) -> None:
+        """Queue one write-through usage delta for ``node`` (called by
+        the scheduler's informer paths after the usage cache accepted
+        the same delta)."""
+        with self._delta_lock:
+            pend = self._pending_deltas.get(node)
+            if pend is None:
+                pend = self._pending_deltas[node] = []
+            elif pend and pend[-1] is None:
+                return          # already poisoned: reload will square it
+            elif len(pend) >= self.DELTA_CAP:
+                pend.clear()
+                pend.append(None)
+                return
+            pend.append((sign, devices, key))
+
+    def _drain_deltas(self) -> Dict[str, list]:
+        with self._delta_lock:
+            deltas, self._pending_deltas = self._pending_deltas, {}
+        return deltas
 
     # -- the gate (filter() path) ----------------------------------------------
     def submit(self, job: BatchJob):
@@ -975,7 +1280,12 @@ class BatchEngine:
         with self._cycle_lock, \
                 tr.span("batch-cycle", pods=len(jobs)) as sp:
             pt = time.monotonic()
-            snap = self.s.snapshot()
+            # Deltas drained BEFORE the snapshot: every drained event's
+            # registry change (and its dirty mark) precedes the
+            # snapshot, so the snapshot's entries cover the drained
+            # chain; an event landing after the drain waits one cycle.
+            deltas = self._drain_deltas()
+            snap, changed = self.s.snapshot_for_batch()
             phases["snapshot"] = time.monotonic() - pt
             # Columnar refresh, split full-rebuild vs incremental (the
             # roadmap's "rebuilds must stay O(changed rows)" watchpoint:
@@ -983,13 +1293,16 @@ class BatchEngine:
             # the regression this phase exists to catch).
             pt = time.monotonic()
             rebuilds_before = self.fleet.rebuilds
-            reloaded = self.fleet.refresh(snap)
+            patched_before = self.fleet.rows_patched_total
+            reloaded = self.fleet.refresh(snap, deltas, changed)
             self._gate_rows()
             refresh_s = time.monotonic() - pt
             full = self.fleet.rebuilds != rebuilds_before
             phases["columnar-rebuild" if full
                    else "columnar-refresh"] = refresh_s
             reg.set_gauge("columnar_rows_reloaded", reloaded)
+            reg.set_gauge("columnar_rows_patched",
+                          self.fleet.rows_patched_total - patched_before)
             vector: List[int] = []
             slices: List[int] = []
             for i, job in enumerate(jobs):
@@ -1170,18 +1483,21 @@ class BatchEngine:
             # placeable() fails closed when no shard map has been
             # observed yet — an enabled-but-blind replica gates out the
             # whole fleet, same as the per-pod paths' shard-no-map.
-            fleet.alive = [ok and shards.placeable(name)
-                           for ok, name in zip(lease_ok, fleet.names)]
+            alive = [ok and shards.placeable(name)
+                     for ok, name in zip(lease_ok, fleet.names)]
         else:
-            fleet.alive = lease_ok
+            alive = lease_ok
         if self.s.cfg.score_by_actual:
             from ..accounting import efficiency as eff_mod
-            fleet.bonus = [
+            bonus = [
                 eff_mod.actual_idle_bonus(self.s.ledger, name,
                                           len(fleet.chip_ids[row]))
                 for row, name in enumerate(fleet.names)]
         else:
-            fleet.bonus = [0.0] * fleet.N
+            bonus = [0.0] * fleet.N
+        # set_gates dirties exactly the rows whose gate moved, so the
+        # cached class columns re-evaluate O(changed rows), not O(fleet).
+        fleet.set_gates(alive, bonus)
 
     def _place_slices(self, jobs: List[BatchJob], slices: List[int],
                       ranks: List[int], plan: List) -> None:
@@ -1199,7 +1515,6 @@ class BatchEngine:
         fleet = self.fleet
         policy = self.s.cfg.node_scheduler_policy
         cows: Dict[int, score_mod.CowUsage] = {}
-        uuid_col: Dict[int, Dict[str, int]] = {}
         for i in sorted(slices, key=lambda i: ranks[i]):
             job = jobs[i]
             best = None   # (score, offer_pos, row, placement, probe)
@@ -1227,10 +1542,7 @@ class BatchEngine:
                 continue
             _s, _pos, row, placement, probe = best
             cows[row] = probe  # later slice jobs see this grant
-            cols = uuid_col.get(row)
-            if cols is None:
-                cols = uuid_col[row] = {
-                    cid: c for c, cid in enumerate(fleet.chip_ids[row])}
+            cols = fleet.col_of[row]
             chips = [cols[d.uuid] for d in placement[0]]
             mems = [d.usedmem for d in placement[0]]
             plan[i] = (row, chips, mems)
@@ -1257,14 +1569,37 @@ class BatchEngine:
             key = (fp, offer)
             cohort = cohorts.get(key)
             if cohort is None:
-                ce = _ClassEval(job.requests[0],
-                                score_mod.parse_affinity(job.anns), binpack)
-                eval_class_full(fleet, ce)
+                # Cached-or-built class columns: a cached class syncs
+                # only its dirty rows (ColumnarFleet.class_eval) — the
+                # steady-state vector-eval cost tracks churn, not fleet
+                # size.  Cohorts of one class share the _ClassEval.
+                ce = fleet.class_eval(fp, job.requests[0],
+                                      score_mod.parse_affinity(job.anns),
+                                      binpack)
                 # An empty offer means NO candidates (the per-pod paths
-                # iterate node_names), never the whole fleet.
-                rows = [fleet.row_of[n] for n in job.node_names
-                        if n in fleet.row_of]
-                cohort = cohorts[key] = _Cohort(ce, rows)
+                # iterate node_names), never the whole fleet.  The
+                # rows/rowset/positions of an offer are stable across
+                # cycles until a rebuild moves row indices — memoized
+                # so a steady fleet-wide offer costs one tuple hash,
+                # not three O(fleet) rebuilds per cohort per cycle.
+                if self._offer_memo_rebuilds != fleet.rebuilds:
+                    self._offer_memo.clear()
+                    self._offer_memo_rebuilds = fleet.rebuilds
+                ent = self._offer_memo.get(offer)
+                if ent is None:
+                    rows = [fleet.row_of[n] for n in offer
+                            if n in fleet.row_of]
+                    rowset = set(rows)
+                    pos_of: Dict[int, int] = {}
+                    for pos, r in enumerate(rows):
+                        pos_of.setdefault(r, pos)
+                    if len(self._offer_memo) >= 64:
+                        self._offer_memo.clear()
+                    ent = self._offer_memo[offer] = (rows, rowset,
+                                                     pos_of)
+                cohort = cohorts[key] = _Cohort(ce, ent[0],
+                                                rowset=ent[1],
+                                                pos_of=ent[2])
             cohort.jobs.append((ranks[i], i))
         return list(cohorts.values())
 

@@ -17,7 +17,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from contextlib import nullcontext
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
 
 from ..accounting import efficiency as eff_mod
 from ..accounting import planner as planner_mod
@@ -294,23 +294,32 @@ class Scheduler:
         # evaluation, preemption planning or gang-expiry sweeps.
         # TimedLock: wait/hold telemetry on /perfz and
         # vtpu_lock_wait_seconds{lock="commit"} — the one lock whose
-        # hold time bounds every concurrent decision's tail.  1-in-4
-        # sampled: it is acquired once per decision, and the sample
-        # keeps the distribution while shaving the per-acquire clocks.
-        self._commit_lock = perf.TimedLock("commit", sample_shift=2)
+        # hold time bounds every concurrent decision's tail.  1-in-8
+        # sampled: it is acquired per decision (or per batched commit
+        # chunk), and the sample keeps the distribution while shaving
+        # the per-acquire clocks (the delta-driven cycles made the
+        # decision path fast enough that 1-in-4 clocks showed against
+        # the ≤2% observatory budget).
+        self._commit_lock = perf.TimedLock("commit", sample_shift=3)
         # get_nodes_usage per-node base-usage cache, keyed on (pod rev,
         # inventory rev); its own lock because the watch thread's pod
         # events race Filter calls.  The cached usage maps are IMMUTABLE
         # once published (rebuilds replace, never mutate) — that is what
         # lets snapshot() hand them out lock-free.
         self._usage_cache_lock = perf.TimedLock("snapshot-cache",
-                                                sample_shift=2)
+                                                sample_shift=3)
         self._usage_cache: Dict[str, tuple] = {}
         # Published full-fleet snapshot dict (name -> SnapEntry), replaced
         # wholesale whenever drain_dirty reports changed nodes — readers
         # get it lock-free-after-publish and an unchanged fleet pays zero
         # copies per decision.
         self._snap: Dict[str, SnapEntry] = {}
+        # Names whose snapshot entry was replaced since the batch
+        # engine's last refresh (accumulated under the usage-cache
+        # lock): the columnar refresh walks exactly these instead of
+        # identity-scanning the whole fleet per cycle (ISSUE 14 — at
+        # 10k nodes the scan alone was milliseconds per tick).
+        self._changed_for_batch: Set[str] = set()
         # Equivalence cache for candidate evaluation: (node, request
         # fingerprint) -> (snapshot key, fit outcome).  A hit is valid
         # only while the node's generation matches, so any grant, delete
@@ -372,6 +381,23 @@ class Scheduler:
         # informer-apply timing — see on_pod_event).  Benign races on
         # the increment cost a sample, never correctness.
         self._informer_events = 0
+        # Delta-driven snapshot maintenance (ISSUE 14): lifetime counts
+        # of full per-node usage rebuilds (build_usage walks the node's
+        # pods — the O(pods-on-node) path churn must NOT take) vs
+        # write-through delta publishes.  The steady-state bench gates
+        # on the rebuild count staying flat through the storm.
+        self.usage_rebuilds = 0
+        self.usage_writethroughs = 0
+
+    def _del_pod_wt(self, uid: str) -> None:
+        """Drop a grant AND write its release through the usage cache +
+        columnar fleet (the delta-driven completion path).  A broken
+        rev chain inside degrades to the node's dirty rebuild — never
+        to a stale view."""
+        dropped = self.pods.del_pod(uid)
+        if dropped is not None:
+            info, rev = dropped
+            self._write_through(info.node, info.devices, rev, -1)
 
     def _note_deleted(self, uid: str) -> None:
         """Tombstone one deleted uid.  The prune is throttled to once
@@ -521,7 +547,11 @@ class Scheduler:
                     self._rescind_preemptions(uid)
             elif self.gangs.is_reserved(uid):
                 return
-            self.pods.del_pod(uid)
+            # Completion write-through (ISSUE 14): the release delta
+            # lands in the usage cache and the columnar fleet under the
+            # rev it produced — a 4k-completion round stays O(changed
+            # rows), not O(rows reloaded via build_usage).
+            self._del_pod_wt(uid)
             return
         if event == "ADDED" and self._deleted_since(uid) is not None:
             # Stale replay (a resync list taken before the watch processed
@@ -570,8 +600,14 @@ class Scheduler:
         # resync replay) carries exactly the grant already registered:
         # refresh liveness in place so the no-op does not invalidate the
         # node's usage snapshot.  One combined acquire (upsert), not a
-        # probe-then-add pair — this path runs per apiserver event.
-        self.pods.upsert(info)
+        # probe-then-add pair — this path runs per apiserver event.  A
+        # FRESH grant (a peer replica's decision mirrored by the
+        # informer) returns the rev it produced: write the delta
+        # through so the peer's steady decision traffic patches rows
+        # instead of forcing per-node rebuilds.
+        new_rev = self.pods.upsert(info)
+        if new_rev is not None:
+            self._write_through(node, devices, new_rev, 1)
         if node and self.provenance.enabled \
                 and self.provenance.last_grant_node(uid) != node:
             # A committed decision this process never ran (an adopting
@@ -657,14 +693,27 @@ class Scheduler:
             perf.registry().record("informer-resync", cost)
             perf.registry().set_gauge("informer_resync_last_s", cost)
 
+    #: Pods re-applied per resync slice before the thread yields — at
+    #: 100k live pods an unchunked replay is a multi-second
+    #: stop-the-world for every other scheduling thread contending the
+    #: same registries and the GIL (STEADY_r07 measured one 5.1s
+    #: event); chunked, scheduling cycles interleave between slices.
+    RESYNC_CHUNK = 2048
+
     def _resync_from_apiserver(self) -> str:
         list_started = time.monotonic()
         try:
             pods, rv = self.client.list_pods_with_rv()
         except NotImplementedError:
             pods, rv = self.client.list_pods(), "0"
-        for pod in pods:
-            self.on_pod_event("ADDED", pod)
+        for at in range(0, len(pods), self.RESYNC_CHUNK):
+            for pod in pods[at:at + self.RESYNC_CHUNK]:
+                self.on_pod_event("ADDED", pod)
+            if at + self.RESYNC_CHUNK < len(pods):
+                # Cooperative yield between slices: scheduling threads
+                # (and the watch) get the GIL and the registry locks
+                # instead of stalling behind the whole reconcile.
+                time.sleep(0)
         alive = {pod_uid(p) for p in pods}
         for info in self.pods.list_pods():
             if info.uid in alive:
@@ -741,6 +790,58 @@ class Scheduler:
                              "not written (%s)", pod_name(pod), e)
 
     # -- usage snapshot --------------------------------------------------------
+    def _write_through(self, node: str, devices, new_rev: int,
+                       sign: int) -> None:
+        """Publish one pod's grant delta (``sign`` +1 add / −1 release)
+        into the node's cached usage at generation ``new_rev`` — the
+        completion-side twin of :meth:`_publish_grants`.  Requires the
+        unbroken rev-chain proof: the cache must hold exactly the
+        generation BEFORE this event (``new_rev - 1``); any other state
+        means an unobserved event interleaved and the node's pending
+        dirty mark triggers the full rebuild instead.  On success the
+        same delta is queued for the columnar fleet
+        (BatchEngine.note_delta), so a 4,000-completion round patches
+        4,000 rows in place — no build_usage, no row reload."""
+        published = None
+        with self._usage_cache_lock:
+            cached = self._usage_cache.get(node)
+            if cached is not None:
+                (k0, k1), usage = cached
+                if new_rev == k0 + 1:
+                    new_usage = self._delta_usage(usage, devices, sign)
+                    if new_usage is not None:
+                        self._usage_cache[node] = ((new_rev, k1),
+                                                   new_usage)
+                        published = (new_rev, k1)
+        if published is not None:
+            self.usage_writethroughs += 1
+            self.batch.note_delta(node, devices, sign, published)
+
+    @staticmethod
+    def _delta_usage(usage: dict, devices, sign: int):
+        """``usage`` ± one pod's devices as a fresh immutable map (the
+        published maps are never mutated), or None when a chip is
+        unknown or a release would underflow — the dirty rebuild
+        recomputes from scratch in either case."""
+        touched: Dict[str, score_mod.DeviceUsage] = {}
+        for container in devices:
+            for d in container:
+                u = touched.get(d.uuid)
+                if u is None:
+                    base = usage.get(d.uuid)
+                    if base is None:
+                        return None
+                    u = touched[d.uuid] = score_mod.clone_usage(base)
+                u.used_slots += sign
+                u.used_mem += sign * d.usedmem
+                u.used_cores += sign * d.usedcores
+                if sign < 0 and (u.used_slots < 0 or u.used_mem < 0
+                                 or u.used_cores < 0):
+                    return None
+        new_usage = dict(usage)
+        new_usage.update(touched)
+        return new_usage
+
     def _pods_by_node(self) -> Dict[str, List[PodInfo]]:
         """Pod→node grouping for the preemption planner (the usage
         snapshot reads the registry's by-node index directly)."""
@@ -763,26 +864,66 @@ class Scheduler:
         restrict to an offered node_names list filter the result — extra
         entries are cheaper than per-call subset dicts on the hot path."""
         with self._usage_cache_lock:
-            dirty = self.pods.drain_dirty()
-            dirty |= self.nodes.drain_dirty()
-            if not dirty:
-                return self._snap
-            try:
-                snap = dict(self._snap)
-                for name in dirty:
-                    entry = self._refresh_entry_locked(name)
-                    if entry is None:
-                        snap.pop(name, None)
-                    else:
-                        snap[name] = entry
-                self._snap = snap
-                return snap
-            except BaseException:
-                # The drain was destructive; hand the unprocessed names
-                # back or the published view goes silently stale.
-                self.pods.mark_dirty(dirty)
-                self.nodes.mark_dirty(dirty)
-                raise
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> Dict[str, SnapEntry]:
+        dirty = self.pods.drain_dirty()
+        dirty |= self.nodes.drain_dirty()
+        if not dirty:
+            return self._snap
+        reg = perf.registry()
+        rebuilds_before = self.usage_rebuilds
+        t0 = time.monotonic()
+        try:
+            snap = dict(self._snap)
+            t_copy = time.monotonic()
+            for name in dirty:
+                entry = self._refresh_entry_locked(name)
+                if entry is None:
+                    snap.pop(name, None)
+                else:
+                    snap[name] = entry
+            self._snap = snap
+            self._changed_for_batch |= dirty
+            if reg.enabled:
+                # Snapshot-build decomposition (ISSUE 14 tentpole):
+                # the published-dict copy vs the per-dirty-node
+                # refresh, plus how many of those dirty nodes paid
+                # a FULL build_usage rebuild (the O(pods-on-node)
+                # path write-through exists to avoid) — /perfz
+                # shows where a 556ms snapshot p99 actually went.
+                now = time.monotonic()
+                reg.record("snapshot-publish", t_copy - t0)
+                reg.record("snapshot-refresh", now - t_copy)
+                reg.set_gauge("snapshot_dirty_nodes", len(dirty))
+                reg.set_gauge("snapshot_nodes_rebuilt",
+                              self.usage_rebuilds - rebuilds_before)
+            return snap
+        except BaseException:
+            # The drain was destructive; hand the unprocessed names
+            # back or the published view goes silently stale.
+            self.pods.mark_dirty(dirty)
+            self.nodes.mark_dirty(dirty)
+            raise
+
+    def snapshot_for_batch(self
+                           ) -> Tuple[Dict[str, SnapEntry], Set[str]]:
+        """The batch engine's snapshot read: the published dict PLUS
+        the names whose entries were replaced since the previous call
+        (drained atomically), so the columnar refresh can walk only
+        the changed entries instead of identity-scanning the fleet.
+
+        The refresh and the drain happen under ONE lock acquisition:
+        with a re-acquire, a concurrent per-pod snapshot() landing
+        between the two could publish a NEWER entry and its change
+        notification, which this drain would then consume against the
+        OLDER snap — the fleet row would skip (entry identity still
+        matches) and never hear about the change again."""
+        with self._usage_cache_lock:
+            snap = self._snapshot_locked()
+            changed, self._changed_for_batch = \
+                self._changed_for_batch, set()
+        return snap, changed
 
     def _refresh_entry_locked(self, name: str) -> Optional[SnapEntry]:
         """Cache-or-rebuild one node's snapshot entry at its LIVE revs
@@ -800,6 +941,7 @@ class Scheduler:
             return None
         cached = self._usage_cache.get(name)
         if cached is None or cached[0] != key:
+            self.usage_rebuilds += 1
             usage = score_mod.build_usage(info, self.pods.pods_on_node(name))
             quarantined = self.quarantine.quarantined_on(name)
             if quarantined:
@@ -980,10 +1122,21 @@ class Scheduler:
             if batcher.batches else 0.0,
         }
         doc["queue"]["pending_depth"] = len(self.batch._queue)
+        fleet = self.batch.fleet
         doc["counters"] = {
             "commit_conflicts": self.commit_conflicts,
             "batch_cycles": self.batch.stats.cycles,
             "batch_fallbacks": self.batch.stats.fallbacks,
+            # Delta-driven cycle health (ISSUE 14): steady state wants
+            # rebuild-shaped counters flat and the patched/write-through
+            # counters carrying the churn.
+            "columnar_full_rebuilds": fleet.rebuilds,
+            "columnar_rows_reloaded": fleet.rows_reloaded_total,
+            "columnar_rows_patched": fleet.rows_patched_total,
+            "class_evals_full": fleet.class_evals_full,
+            "class_rows_patched": fleet.class_rows_patched,
+            "snapshot_usage_rebuilds": self.usage_rebuilds,
+            "snapshot_usage_writethroughs": self.usage_writethroughs,
         }
         return doc
 
@@ -1098,23 +1251,37 @@ class Scheduler:
         # and once after routing for the all-batchable common case.
         stale_uids: List[str] = []
         drain_t0 = time.monotonic()
+        inline_s = 0.0
         for i, (pod, node_names) in enumerate(items):
             routed = self._route_batch(pod, node_names)
             if isinstance(routed, FilterResult):
                 results[i] = self._finish_decision(pod, routed)
             elif routed is None:
                 if stale_uids:
-                    self.pods.del_pods(stale_uids)
+                    self._del_pods_wt(stale_uids)
                     stale_uids.clear()
+                inline_t0 = time.monotonic()
                 results[i] = self.filter(pod, node_names)
+                # Inline per-pod decisions record their own phases
+                # (and, with the batch gate on, whole nested cycles):
+                # excluding them keeps the drain phase DISJOINT from
+                # snapshot/cycle-total in /perfz's accounting — the
+                # phase splits must sum to the wall total, not above it
+                # (ISSUE 14 satellite; pinned by the sums-to-total
+                # test).
+                inline_s += time.monotonic() - inline_t0
             else:
                 batched.append((i, routed))
                 stale_uids.append(routed.uid)
         if stale_uids:
-            self.pods.del_pods(stale_uids)
+            self._del_pods_wt(stale_uids)
         # The drain phase: parsing + routing the backlog into batch
-        # jobs (includes any inline per-pod decisions the router made).
-        perf.registry().record("drain", time.monotonic() - drain_t0)
+        # jobs.  Inline per-pod decisions are EXCLUDED — they record
+        # their own phases (opt-evaluate/commit, or a nested batch
+        # cycle's whole split), and charging them here too would
+        # double-count the same wall time across /perfz phases.
+        perf.registry().record(
+            "drain", max(0.0, time.monotonic() - drain_t0 - inline_s))
         step = max(1, self.cfg.batch_max)
         for at in range(0, len(batched), step):
             chunk = batched[at:at + step]
@@ -1125,9 +1292,11 @@ class Scheduler:
             # cycle, zero locks on the decision path).
             sink: Optional[list] = \
                 [] if self.provenance.enabled else None
-            for (i, job), res in zip(chunk, decided):
-                results[i] = self._finish_decision(job.pod, res,
-                                                   sink=sink)
+            finished = self._finish_decisions_bulk(
+                [(job.pod, res) for (_i, job), res in zip(chunk, decided)],
+                sink=sink)
+            for (i, _job), fr in zip(chunk, finished):
+                results[i] = fr
             if sink:
                 self.provenance.emit_cycle(self.cfg.batch_solver, sink)
         if batched:
@@ -1139,6 +1308,14 @@ class Scheduler:
             # leader's reset only runs on the submit path).
             perf.registry().set_gauge("drain_age_s", 0.0)
         return results
+
+    def _del_pods_wt(self, uids: List[str]) -> None:
+        """Bulk stale-decision drop with release write-through (the
+        filter_many drain's one-acquire discipline, now feeding the
+        delta path so re-placed pods' old rows patch instead of
+        reload)."""
+        for info, rev in self.pods.del_pods(uids):
+            self._write_through(info.node, info.devices, rev, -1)
 
     def _route_batch(self, pod: dict, node_names: List[str]):
         """filter_many's router — mirrors ``_decide``'s pre-checks in
@@ -1189,7 +1366,7 @@ class Scheduler:
             # Filter calls delPod first) — same as the per-pod paths
             # do.  filter_many defers this to ONE bulk del_pods per
             # drain instead (same effect before any batched decide).
-            self.pods.del_pod(pod_uid(pod))
+            self._del_pod_wt(pod_uid(pod))
         return BatchJob(
             pod=pod, uid=pod_uid(pod), name=pod_name(pod),
             namespace=pod_namespace(pod), trace_id=trace.trace_id_of(pod),
@@ -1207,6 +1384,69 @@ class Scheduler:
         only) collects the terminal provenance record instead of
         emitting it — the cycle lands them all through ONE
         ``emit_many`` (the store's amortization discipline)."""
+        patch = self._prepare_decision(pod, result)
+        if patch is None:
+            return result
+        err = self._write_decision_single(pod, result, patch)
+        return self._conclude_decision(pod, result, err, sink)
+
+    def _finish_decisions_bulk(self, pairs: List[Tuple[dict, FilterResult]],
+                               sink: Optional[list] = None
+                               ) -> List[FilterResult]:
+        """Batched-cycle epilogue (ISSUE 14): prepare every decision,
+        then land the annotation patches in ADAPTIVE chunks — one bulk
+        apiserver call per chunk (fenced per-entry CAS in shard mode via
+        cas_commit_many, ``patch_pod_annotations_many`` otherwise) with
+        the chunk size steered by observed flush latency
+        (util/decisionwriter.AdaptiveSizer).  Per-entry outcomes keep
+        the single-write contract: a failed write rolls ONLY its own
+        tentative grant back."""
+        out: List[Optional[FilterResult]] = [None] * len(pairs)
+        writes: List[tuple] = []   # (idx, pod, result, patch)
+        for i, (pod, result) in enumerate(pairs):
+            patch = self._prepare_decision(pod, result)
+            if patch is None:
+                out[i] = result
+            else:
+                writes.append((i, pod, result, patch))
+        reg = perf.registry()
+        sizer = self._decisions.sizer
+        at = 0
+        while at < len(writes):
+            chunk = writes[at:at + sizer.size()]
+            at += len(chunk)
+            write_t0 = time.monotonic()
+            if self.shards.enabled:
+                errs = shard_commit.cas_commit_many(
+                    self.client, self.shards,
+                    [(pod, result.node, patch)
+                     for _i, pod, result, patch in chunk],
+                    provenance=self.provenance)
+                seconds = time.monotonic() - write_t0
+                sizer.observe(len(chunk), seconds)
+            else:
+                outcomes = self._decisions.write_many(
+                    [(pod_namespace(pod), pod_name(pod), patch)
+                     for _i, pod, result, patch in chunk])
+                seconds = time.monotonic() - write_t0
+                errs = [None if e is None
+                        else f"writing decision failed: {e}"
+                        for e in outcomes]
+            if reg.enabled:
+                # One ring sample per FLUSH (the amortized unit), not
+                # per pod — /perfz's decision-write count now tells the
+                # amortization story directly.
+                reg.record("decision-write", seconds)
+                reg.set_gauge("decision_write_chunk", len(chunk))
+            for (i, pod, result, _patch), err in zip(chunk, errs):
+                out[i] = self._conclude_decision(pod, result, err, sink)
+        return out
+
+    def _prepare_decision(self, pod: dict,
+                          result: FilterResult) -> Optional[dict]:
+        """The pre-write half of :meth:`_finish_decision`: rejection
+        side effects (returns None — nothing to write), or the decision
+        annotation patch with the pending grant advertised."""
         uid = pod_uid(pod)
         tid = trace.trace_id_of(pod)
         tr = trace.tracer()
@@ -1230,7 +1470,7 @@ class Scheduler:
                 self.quota.note_unplaced(uid)
             if result.preempt is not None:
                 self._request_preemptions(pod, result.preempt)
-            return result
+            return None
         tr.event(uid, "filter-assigned", trace_id=tid,
                  pod=pod_name(pod), node=result.node)
         if self._unplaced:
@@ -1269,13 +1509,6 @@ class Scheduler:
             # The member's jax.distributed process rank (stable across
             # replacements) — surfaced to the container as VTPU_GANG_RANK.
             patch[GANG_RANK_ANNOTATION] = str(rank)
-        # 1-in-4 sampled perf timing (the trace span keeps recording
-        # every write into the phase histograms; this ring only feeds
-        # /perfz's recent-window quantiles).
-        reg = perf.registry()
-        write_rec = reg.enabled and (self._decisions.writes & 3) == 0
-        if write_rec:
-            write_t0 = time.monotonic()
         # Advertise the grant BEFORE the write: the informer's echo of
         # our own decision annotation (synchronous under a CAS, or on
         # the group-commit flush thread for batched writes) must read
@@ -1283,6 +1516,23 @@ class Scheduler:
         # seed.  One GIL-atomic dict store on the happy path; revoked
         # on write failure.
         self.provenance.note_pending_grant(uid, result.node)
+        return patch
+
+    def _write_decision_single(self, pod: dict, result: FilterResult,
+                               patch: dict) -> Optional[str]:
+        """One pod's decision write (the per-pod front door; batched
+        cycles use the bulk chunked path instead).  Returns the error
+        string or None."""
+        uid = pod_uid(pod)
+        tid = trace.trace_id_of(pod)
+        tr = trace.tracer()
+        # 1-in-4 sampled perf timing (the trace span keeps recording
+        # every write into the phase histograms; this ring only feeds
+        # /perfz's recent-window quantiles).
+        reg = perf.registry()
+        write_rec = reg.enabled and (self._decisions.writes & 3) == 0
+        if write_rec:
+            write_t0 = time.monotonic()
         with tr.span("decision-write", trace_id=tid, pod=pod_name(pod),
                      node=result.node, qos=pod_qos(pod)) as wsp:
             err: Optional[str] = None
@@ -1316,21 +1566,31 @@ class Scheduler:
             if write_rec:
                 reg.record("decision-write",
                            time.monotonic() - write_t0)
-            if err is not None:
-                self.pods.del_pod(uid)
-                tr.event(uid, "decision-write-failed",
-                         trace_id=tid, error=err)
-                # The write did not land: stop advertising the grant
-                # (a peer may still place the pod on that node, and
-                # THAT grant must be seedable) and record the failure
-                # — "my pod bounced off a shard fence" is exactly the
-                # question /explainz exists for.
-                self.provenance.drop_pending_grant(uid, result.node)
-                self.provenance.emit(
-                    uid, "decision-write-failed",
-                    namespace=pod_namespace(pod), name=pod_name(pod),
-                    node=result.node, error=err)
-                return FilterResult(error=err)
+        return err
+
+    def _conclude_decision(self, pod: dict, result: FilterResult,
+                           err: Optional[str],
+                           sink: Optional[list]) -> FilterResult:
+        """The post-write half shared by the single and bulk paths:
+        rollback on a failed write, terminal provenance on success."""
+        uid = pod_uid(pod)
+        tid = trace.trace_id_of(pod)
+        tr = trace.tracer()
+        if err is not None:
+            self._del_pod_wt(uid)
+            tr.event(uid, "decision-write-failed",
+                     trace_id=tid, error=err)
+            # The write did not land: stop advertising the grant
+            # (a peer may still place the pod on that node, and
+            # THAT grant must be seedable) and record the failure
+            # — "my pod bounced off a shard fence" is exactly the
+            # question /explainz exists for.
+            self.provenance.drop_pending_grant(uid, result.node)
+            self.provenance.emit(
+                uid, "decision-write-failed",
+                namespace=pod_namespace(pod), name=pod_name(pod),
+                node=result.node, error=err)
+            return FilterResult(error=err)
         if self.provenance.enabled:
             # ONE terminal record per placed pod (the happy path's
             # whole provenance cost): the committed node, plus the
@@ -1730,7 +1990,7 @@ class Scheduler:
         tr = trace.tracer()
         # Drop any stale decision for this pod before re-placing (reference
         # Filter calls delPod first, scheduler.go:284).
-        self.pods.del_pod(uid)
+        self._del_pod_wt(uid)
         retries = max(0, self.cfg.commit_retries)
         attempt = 0
         while True:

@@ -240,6 +240,10 @@ class GangManager:
     def is_reserved(self, uid: str) -> bool:
         """True while an admitted-but-unconfirmed placement exists for the
         pod (its tentative grant must survive informer churn)."""
+        if not self._groups:
+            # Gang-free fast path (GIL-atomic probe): the informer asks
+            # this for every grant-less pod event.
+            return False
         with self._lock:
             return any(uid in g.placements for g in self._groups.values())
 
@@ -250,6 +254,14 @@ class GangManager:
         additionally records the uid so replayed add-events are rejected;
         a resync prune passes False because its list snapshot may simply be
         stale about a live pod."""
+        if not self._groups and not self._dropped:
+            # Gang-free fleet fast path: the informer calls this for
+            # EVERY pod deletion — a sustained completion storm paid a
+            # lock + two dict rebuilds per delete for registries that
+            # are empty.  GIL-atomic probes; the rare race (a member
+            # observed concurrently with its own delete) is already
+            # covered by the gang expiry sweep.
+            return
         with self._lock:
             now = self._now()
             for key in list(self._groups):

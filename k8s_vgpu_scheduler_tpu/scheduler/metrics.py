@@ -455,6 +455,15 @@ class ClusterCollector(Collector):
             "backs the watch up; transport-side queueing upstream of "
             "the callback is not included",
         )
+        informer_resync = GaugeMetricFamily(
+            "vtpu_informer_resync_seconds",
+            "Wall-clock cost of the most recent full informer resync "
+            "(list + chunked re-apply + prune).  The reconcile yields "
+            "between chunks so cycles interleave, but a growing figure "
+            "still means the safety net is re-walking a fleet the watch "
+            "should be keeping current — see the informer-resync phase "
+            "on GET /perfz for history",
+        )
         pending_depth = GaugeMetricFamily(
             "vtpu_pending_queue_depth",
             "Pods queued at the batch gate awaiting their scheduling "
@@ -481,6 +490,8 @@ class ClusterCollector(Collector):
             lock_acquires.add_metric([name], st.acquires)
             lock_sampled.add_metric([name], st.sampled_acquires())
         informer_lag.add_metric([], reg.informer_lag_s())
+        informer_resync.add_metric(
+            [], reg.gauge("informer_resync_last_s"))
         pending_depth.add_metric(
             [], len(engine._queue) if engine is not None
             else reg.gauge("pending_queue_depth"))
@@ -673,7 +684,7 @@ class ClusterCollector(Collector):
                 pod_mem, pod_cores, preempts, conflicts, batch_size,
                 batch_lat, batch_fallbacks, cycle_phase, lock_wait,
                 lock_hold, lock_acquires, lock_sampled, informer_lag,
-                pending_depth,
+                informer_resync, pending_depth,
                 gc_collections, pool_size, busy_peak,
                 lease_state, leases_unhealthy, chips_quar, quarantines,
                 rescued, q_pending, q_admitted, q_share, q_borrowed,

@@ -51,9 +51,11 @@ class PodManager:
     def __init__(self) -> None:
         # TimedLock (util/perf.py): wait/hold telemetry under
         # lock="pods" on /perfz.  add_pod/rev_of ride every decision's
-        # hot path, so hold samples are 1-in-16 — contention (the watch
-        # thread racing Filters) is always counted.
-        self._lock = perf.TimedLock("pods", sample_shift=4)
+        # hot path, so hold samples are 1-in-32 (was 1-in-16 before
+        # the delta-driven cycles shrank the work each acquire
+        # amortizes against) — contention (the watch thread racing
+        # Filters) is still counted on every sampled acquire.
+        self._lock = perf.TimedLock("pods", sample_shift=5)
         self._pods: Dict[str, PodInfo] = {}
         self._by_node: Dict[str, Dict[str, PodInfo]] = {}
         self._rev: Dict[str, int] = {}
@@ -159,32 +161,50 @@ class PodManager:
         with self._lock:
             return self._refresh_locked(info)
 
-    def upsert(self, info: PodInfo) -> None:
+    def upsert(self, info: PodInfo) -> Optional[int]:
         """Informer apply: :meth:`refresh_if_unchanged` OR
         :meth:`add_pod` under ONE acquire — the separate probe-then-add
         pair cost a second instrumented acquire on every new-pod event
-        (ISSUE 12 instrumentation budget)."""
+        (ISSUE 12 instrumentation budget).  Returns the node's new rev
+        when this was a FRESH grant (a peer replica's decision, a WAL
+        replay of an unknown pod) so the caller can write the usage
+        delta through instead of rebuilding the node; None for the
+        no-op refresh and for moves (a move touches two nodes — the
+        dirty rebuild squares both)."""
         with self._lock:
-            if not self._refresh_locked(info):
-                self._add_locked(info)
+            if self._refresh_locked(info):
+                return None
+            fresh = info.uid not in self._pods
+            rev = self._add_locked(info)
+            return rev if fresh else None
 
-    def del_pod(self, uid: str) -> None:
+    def del_pod(self, uid: str) -> Optional[Tuple[PodInfo, int]]:
+        """Drop one grant; returns ``(dropped info, the node's new
+        rev)`` — the write-through release path
+        (Scheduler._write_through) publishes the usage delta under
+        exactly that generation — or None when the uid held no grant."""
         with self._lock:
-            self._del_locked(uid)
+            return self._del_locked(uid)
 
-    def del_pods(self, uids: Iterable[str]) -> None:
+    def del_pods(self, uids: Iterable[str]
+                 ) -> List[Tuple[PodInfo, int]]:
         """Bulk delete under ONE lock acquisition — the batched drain
         drops every routed pod's stale decision per tick, and paying an
         acquire per pod there was measurable against the ISSUE 12
-        instrumentation budget."""
+        instrumentation budget.  Returns the dropped (info, new rev)
+        pairs for write-through."""
+        dropped: List[Tuple[PodInfo, int]] = []
         with self._lock:
             for uid in uids:
-                self._del_locked(uid)
+                got = self._del_locked(uid)
+                if got is not None:
+                    dropped.append(got)
+        return dropped
 
-    def _del_locked(self, uid: str) -> None:
+    def _del_locked(self, uid: str) -> Optional[Tuple[PodInfo, int]]:
         info = self._pods.pop(uid, None)
         if info is None:
-            return
+            return None
         self._charge(info, -1)
         bucket = self._by_node.get(info.node)
         if bucket is not None:
@@ -192,6 +212,7 @@ class PodManager:
             if not bucket:
                 del self._by_node[info.node]
         self._bump(info.node)
+        return info, self._rev[info.node]
 
     def get(self, uid: str) -> Optional[PodInfo]:
         # Lock-free: one GIL-atomic dict read.  The lock never made

@@ -27,7 +27,7 @@ never fail open.  Failures are counted by reason
 from __future__ import annotations
 
 import logging
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..k8s.client import Conflict, NotFound, pod_name, pod_namespace
 from ..util.types import ASSIGNED_NODE_ANNOTATION
@@ -73,6 +73,35 @@ def cas_commit(client, shards, pod: dict, node: str,
                             epoch=shards.epoch())
         return reason
 
+    staged = _stage(client, shards, pod, node, patch, fail)
+    if isinstance(staged, str):
+        return staged
+    full, rv = staged
+    try:
+        client.patch_pod_annotations(namespace, name, full,
+                                     resource_version=rv)
+    except Conflict:
+        # The pod moved under us — a peer's decision, a deletion
+        # mid-flight, any write.  Which one doesn't matter: fail closed.
+        return fail("rv-conflict",
+                    f"shard-cas: {namespace}/{name} changed since rv "
+                    f"{rv}; decision not committed, pod requeued")
+    except NotFound:
+        return fail("pod-gone",
+                    f"shard-cas: {namespace}/{name} gone before commit")
+    except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
+        return fail("write-failed",
+                    f"shard-cas: writing decision failed: {e}")
+    return None
+
+
+def _stage(client, shards, pod: dict, node: str, patch: Dict[str, str],
+           fail):
+    """The pre-write half of one fenced CAS: fence check, epoch/owner
+    stamps, peer-decision guard, resourceVersion resolution.  Returns
+    ``(full patch, rv)`` ready to send, or the requeue reason string
+    (``fail`` already recorded it)."""
+    namespace, name = pod_namespace(pod), pod_name(pod)
     fence, epoch = shards.commit_fence(node)
     if fence is not None:
         return fail(fence, f"shard-fence: {fence} — decision on {node} "
@@ -114,19 +143,81 @@ def cas_commit(client, shards, pod: dict, node: str,
                         f"shard-cas: {namespace}/{name} already "
                         f"assigned to {assigned} by {owner}")
         rv = current.get("metadata", {}).get("resourceVersion")
+    return full, rv
+
+
+def cas_commit_many(client, shards, items: List[Tuple[dict, str, dict]],
+                    provenance=None) -> List[Optional[str]]:
+    """Bulk form of :func:`cas_commit` for a batched cycle's decisions:
+    every item is staged exactly like the single path (fence, stamps,
+    peer-decision guard, rv), then the stageable ones ride ONE
+    ``patch_pod_annotations_many`` call with per-entry CAS semantics —
+    the apiserver round-trips amortize while each pod keeps its own
+    409-fail-closed outcome.  Returns one requeue reason (or None) per
+    item, in order."""
+    results: List[Optional[str]] = [None] * len(items)
+    sendable: List[tuple] = []   # (idx, namespace, name, full, rv)
+
+    for idx, (pod, node, patch) in enumerate(items):
+        namespace, name = pod_namespace(pod), pod_name(pod)
+
+        def fail(token: str, reason: str,
+                 _ns=namespace, _n=name, _pod=pod, _node=node) -> str:
+            shards.note_cas_failure(token)
+            if provenance is not None:
+                provenance.emit(_pod.get("metadata", {}).get("uid", ""),
+                                "commit-cas-failed", namespace=_ns,
+                                name=_n, node=_node, token=token,
+                                epoch=shards.epoch())
+            return reason
+
+        staged = _stage(client, shards, pod, node, patch, fail)
+        if isinstance(staged, str):
+            results[idx] = staged
+            continue
+        full, rv = staged
+        sendable.append((idx, namespace, name, full, rv))
+
+    if not sendable:
+        return results
     try:
-        client.patch_pod_annotations(namespace, name, full,
-                                     resource_version=rv)
-    except Conflict:
-        # The pod moved under us — a peer's decision, a deletion
-        # mid-flight, any write.  Which one doesn't matter: fail closed.
-        return fail("rv-conflict",
-                    f"shard-cas: {namespace}/{name} changed since rv "
-                    f"{rv}; decision not committed, pod requeued")
-    except NotFound:
-        return fail("pod-gone",
-                    f"shard-cas: {namespace}/{name} gone before commit")
-    except Exception as e:  # noqa: BLE001 — decision must not outlive a failed write
-        return fail("write-failed",
-                    f"shard-cas: writing decision failed: {e}")
-    return None
+        outcomes = client.patch_pod_annotations_many(
+            [(ns, name, full, rv) for _i, ns, name, full, rv in sendable])
+        if len(outcomes) != len(sendable):
+            # Defensive against a malformed transport override: a short
+            # list would zip-truncate and mark unsent writes successful.
+            raise RuntimeError(
+                f"patch_pod_annotations_many returned {len(outcomes)} "
+                f"outcomes for {len(sendable)} patches")
+    except Exception as e:  # noqa: BLE001 — decisions must not outlive
+        # a failed write: a wholesale transport failure fails every
+        # staged entry closed (the single-path cas_commit contract).
+        outcomes = [e] * len(sendable)
+    for (idx, namespace, name, _full, rv), err in zip(sendable, outcomes):
+        if err is None:
+            continue
+        pod = items[idx][0]
+
+        def bfail(token: str, reason: str) -> str:
+            shards.note_cas_failure(token)
+            if provenance is not None:
+                provenance.emit(pod.get("metadata", {}).get("uid", ""),
+                                "commit-cas-failed", namespace=namespace,
+                                name=name, node=items[idx][1],
+                                token=token, epoch=shards.epoch())
+            return reason
+
+        if isinstance(err, Conflict):
+            results[idx] = bfail(
+                "rv-conflict",
+                f"shard-cas: {namespace}/{name} changed since rv "
+                f"{rv}; decision not committed, pod requeued")
+        elif isinstance(err, NotFound):
+            results[idx] = bfail(
+                "pod-gone",
+                f"shard-cas: {namespace}/{name} gone before commit")
+        else:
+            results[idx] = bfail(
+                "write-failed",
+                f"shard-cas: writing decision failed: {err}")
+    return results

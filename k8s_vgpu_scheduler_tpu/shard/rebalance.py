@@ -56,6 +56,18 @@ class Rebalancer:
         #: handoff latencies (orphan → placeable) the HA report publishes.
         self.adopted_total = 0
         self.last_adoption_latency_s: List[float] = []
+        #: WAL-replay accounting for the adoption pass: pods replayed
+        #: through on_pod_event vs pods SKIPPED because the live
+        #: informer already delivered exactly that grant — with a
+        #: healthy watch the replay is O(missed events), not O(pods on
+        #: the adopted shards) (ISSUE 14 satellite).
+        self.wal_replayed_total = 0
+        self.wal_skipped_total = 0
+
+    def has_pending(self) -> bool:
+        """Lock-free emptiness probe (the steady-state tick's fast
+        path: one dict-truthiness read)."""
+        return bool(self._pending)
 
     # -- gates -----------------------------------------------------------------
     def adopting_reason(self, node: str) -> Optional[str]:
@@ -117,15 +129,28 @@ class Rebalancer:
             log.warning("adoption WAL list failed: %s", e)
             return []
         due_set = set(due)
-        replayed = 0
+        replayed = skipped = 0
         for pod in pods:
             anns = pod.get("metadata", {}).get("annotations", {})
-            if anns.get(ASSIGNED_NODE_ANNOTATION, "") in due_set:
-                # The informer usually delivered these already
-                # (refresh_if_unchanged makes the replay a no-op); a
-                # replica running without a watch rebuilds here.
-                self.s.on_pod_event("ADDED", pod)
-                replayed += 1
+            node = anns.get(ASSIGNED_NODE_ANNOTATION, "")
+            if node not in due_set:
+                continue
+            # Skip-if-tracked: when the live informer already delivered
+            # exactly this grant, the full on_pod_event replay (decode,
+            # priority parse, registry upsert, provenance probe) buys
+            # nothing — at 10k-node scale the post-kill adoption used to
+            # replay ~half the fleet's pods inline in ONE tick, the
+            # multi-second shard-tick max STEADY_r07 measured.  A pod
+            # the registry does NOT hold (a watchless replica, a missed
+            # event) still replays in full.
+            tracked = self.s.pods.get(pod_uid(pod))
+            if tracked is not None and tracked.node == node:
+                skipped += 1
+                continue
+            self.s.on_pod_event("ADDED", pod)
+            replayed += 1
+        self.wal_replayed_total += replayed
+        self.wal_skipped_total += skipped
         for node in due:
             self.s.leases.forget(node)
             with self._lock:

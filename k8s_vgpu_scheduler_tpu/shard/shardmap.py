@@ -195,6 +195,12 @@ class ShardManager:
         self.rebalances_total = 0
         #: CAS-commit failures by reason (vtpu_commit_cas_failures_total).
         self.cas_failures: Dict[str, int] = {}
+        #: Lifetime count of tick passes that did O(fleet)-or-worse work
+        #: (an epoch change's node walk, an adoption's WAL replay).  The
+        #: STEADY-STATE tick is pinned to O(replicas) — beat patch, beat
+        #: observe, membership compare — by the regression test; this
+        #: counter is how the pin reads the difference.
+        self.tick_fleet_walks = 0
 
     # -- read surface (the hot-path gates) ------------------------------------
     @property
@@ -347,9 +353,19 @@ class ShardManager:
             return self._tick()
 
     def _tick(self) -> list:
+        from ..util import perf
+
+        reg = perf.registry()
         actions: list = []
         now = self._clock()
+        # Sub-split timing (ISSUE 14 satellite): the shard-tick ring
+        # said 1.3s p99 / 6.5s max in STEADY_r07 but not WHERE — these
+        # three rings separate the beat's read-modify-write round (which
+        # serializes behind the storm's apiserver traffic) from the CAS
+        # path and from adoption's WAL replay (the only O(fleet) piece).
+        t0 = time.monotonic()
         coord = self._publish_beat()
+        reg.record("shard-tick-beat", time.monotonic() - t0)
         if coord is None:
             return actions
         anns = coord.get("metadata", {}).get("annotations", {})
@@ -366,6 +382,7 @@ class ShardManager:
                    and self.leases.state_of(n) is LeaseState.DEAD]
         if current is None or tuple(current.replicas) != desired \
                 or dropped:
+            cas_t0 = time.monotonic()
             proposed = ShardMap(
                 epoch=(current.epoch + 1) if current is not None else 1,
                 replicas=desired)
@@ -400,6 +417,7 @@ class ShardManager:
                 actions.append({"kind": "epoch-bump-lost"})
             except Exception as e:  # noqa: BLE001 — next tick retries
                 log.warning("shard-map CAS failed: %s", e)
+            reg.record("shard-tick-cas", time.monotonic() - cas_t0)
         with self._lock:
             previous = self._map
             if current is not None:
@@ -414,13 +432,23 @@ class ShardManager:
                 self._map_read_at = now
         if current is not None and (previous is None
                                     or previous.epoch != current.epoch):
+            # Epoch transition: the ONE tick shape allowed an O(fleet)
+            # walk (computing the gained partition).
+            self.tick_fleet_walks += 1
             moved = self.rebalancer.on_map_change(previous, current, now)
             if moved:
                 with self._lock:
                     self.rebalances_total += 1
                 actions.append({"kind": "rebalance", "epoch": current.epoch,
                                 "adopting": sorted(moved)})
-        actions.extend(self.rebalancer.adopt_due(now))
+        if self.rebalancer.has_pending():
+            adopt_t0 = time.monotonic()
+            adopted = self.rebalancer.adopt_due(now)
+            if adopted:
+                self.tick_fleet_walks += 1
+                reg.record("shard-tick-adopt",
+                           time.monotonic() - adopt_t0)
+            actions.extend(adopted)
         return actions
 
     def _publish_beat(self) -> Optional[dict]:

@@ -571,3 +571,62 @@ class TestTombstoneThrottle:
             s._deleted_uids["stale-1"] = old
         assert s._deleted_since("stale-1") is None
         s.close()
+
+
+class TestPhaseDisjointness:
+    """ISSUE 14 satellite bugfix: /perfz phase splits must be DISJOINT —
+    a tick-drain that runs per-pod decisions inline used to charge that
+    wall time to `drain` AND to the inline decision's own phases, so
+    the phases of one storm summed above its wall clock."""
+
+    def test_drain_excludes_inline_per_pod_decisions(self, fresh):
+        kube, s, names = make_scheduler(filter_batch=True)
+        # A multi-container pod routes None (non-batchable) and is
+        # decided INLINE during the drain; its filter time is slowed
+        # artificially and must NOT land in the drain ring.
+        multi = {
+            "metadata": {"name": "mc", "namespace": "default",
+                         "uid": "mcu", "annotations": {}},
+            "spec": {"containers": [
+                {"name": "a", "resources": {"limits": {
+                    "google.com/tpu": "1",
+                    "google.com/tpumem": "500"}}},
+                {"name": "b", "resources": {"limits": {
+                    "google.com/tpu": "1",
+                    "google.com/tpumem": "500"}}},
+            ]},
+        }
+        single = tpu_pod("sg", uid="usg", mem="500")
+        for p in (multi, single):
+            kube.create_pod(p)
+        real_filter = s.filter
+
+        def slow_filter(pod, node_names):
+            time.sleep(0.05)
+            return real_filter(pod, node_names)
+
+        s.filter = slow_filter
+        results = s.filter_many([(multi, names), (single, names)])
+        assert all(r.node for r in results), \
+            [(r.node, r.error) for r in results]
+        drain = fresh.phase("drain").window()
+        assert drain["n"] >= 1
+        assert drain["max_s"] < 0.05, \
+            f"drain ring absorbed the inline decision: {drain}"
+        s.close()
+
+    def test_batch_cycle_phases_sum_to_total(self, fresh):
+        kube, s, names = make_scheduler(filter_batch=True)
+        items = []
+        for i in range(12):
+            pod = tpu_pod(f"p{i}", uid=f"u{i}", mem="500")
+            kube.create_pod(pod)
+            items.append((pod, names))
+        assert all(r.node for r in s.filter_many(items))
+        ticks = [t for t in fresh.slow_ticks(top=16)
+                 if t["name"] == "batch-cycle"]
+        assert ticks, "cycle never journaled"
+        for t in ticks:
+            assert sum(t["phases_ms"].values()) <= t["total_ms"] + 0.5, \
+                f"phase splits overlap: {t}"
+        s.close()

@@ -393,6 +393,173 @@ class TestDecisionParity:
         assert all(r.node for r in regret)
 
 
+class TestChurnParity:
+    """ISSUE 14 tentpole pin: after ANY interleaving of completions,
+    inventory (heartbeat) flips and commits, the CACHED class columns —
+    synced by dirty-row patching and write-through deltas — must match
+    a cold full rebuild bit-for-bit, and the refresh counters must
+    attribute every changed row to the path it actually took: a
+    completion-only node is PATCHED in place (write-through), an
+    inventory flip is RELOADED, a committed group is ADOPTED (neither
+    counter moves)."""
+
+    def _env(self, n_nodes=10, chips=4):
+        kube = FakeKube()
+        s = Scheduler(kube, Config(filter_batch=True))
+        names = [f"node-{i}" for i in range(n_nodes)]
+        for n in names:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n, chips=chips)
+        kube.watch_pods(s.on_pod_event)
+        return kube, s, names
+
+    def _place(self, kube, s, names, placed, seq, n):
+        items = []
+        for _ in range(n):
+            i = next(seq)
+            pod = tpu_pod(f"c{i}", uid=f"cu{i}", mem="500")
+            kube.create_pod(pod)
+            items.append((pod, names))
+        for (pod, _o), r in zip(items, s.filter_many(items)):
+            assert r.node, r.error
+            placed.append((pod["metadata"]["name"], r.node))
+
+    def _sync(self, s):
+        """Exactly what a cycle start does: drain write-through deltas,
+        snapshot, delta-driven columnar refresh, row gates.  Returns
+        (snapshot, rows reloaded, rows patched)."""
+        fleet = s.batch.fleet
+        deltas = s.batch._drain_deltas()
+        snap = s.snapshot()
+        r0 = fleet.rows_reloaded_total
+        p0 = fleet.rows_patched_total
+        fleet.refresh(snap, deltas)
+        s.batch._gate_rows()
+        return (snap, fleet.rows_reloaded_total - r0,
+                fleet.rows_patched_total - p0)
+
+    def _assert_cold_parity(self, s, snap, req, anns):
+        """Cached columns vs a cold fleet rebuilt from the same
+        snapshot: every row's score/chip/mem must agree BITWISE."""
+        fleet = s.batch.fleet
+        affinity = score_mod.parse_affinity(anns)
+        fp = batch_mod.class_fingerprint([req], anns,
+                                         s.cfg.topology_policy)
+        ce = fleet.class_eval(fp, req, affinity, binpack=False)
+        cold = batch_mod.ColumnarFleet()
+        cold.refresh(snap)
+        assert cold.names == fleet.names
+        cold.alive = list(fleet.alive)
+        cold.bonus = list(fleet.bonus)
+        cold_ce = batch_mod._ClassEval(req, affinity, binpack=False)
+        batch_mod.eval_class_full(cold, cold_ce)
+        for row in range(fleet.N):
+            assert ce.score[row] == cold_ce.score[row], \
+                f"row {row} ({fleet.names[row]}): cached " \
+                f"{ce.score[row]!r} != cold {cold_ce.score[row]!r}"
+            if ce.score[row] != float("-inf"):
+                assert ce.chip[row] == cold_ce.chip[row]
+                assert ce.mem[row] == cold_ce.mem[row]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_interleaved_churn_matches_cold_rebuild(self, seed):
+        import itertools
+
+        from k8s_vgpu_scheduler_tpu.scheduler.nodes import NodeInfo as NI
+
+        rng = random.Random(4000 + seed)
+        kube, s, names = self._env()
+        req = ContainerDeviceRequest(nums=1, type="TPU", memreq=500,
+                                     mem_percentage_req=0, coresreq=0)
+        placed = []
+        seq = itertools.count()
+        flipped = {n: False for n in names}
+        self._place(kube, s, names, placed, seq, n=8)
+        snap, _r, _p = self._sync(s)
+        self._assert_cold_parity(s, snap, req, {})
+        for _round in range(8):
+            action = rng.choice(["complete", "flip", "commit", "mixed"])
+            completion_nodes = set()
+            flip_nodes = set()
+            if action in ("complete", "mixed") and placed:
+                for _ in range(min(3, len(placed))):
+                    name, node = placed.pop(rng.randrange(len(placed)))
+                    kube.delete_pod("default", name)
+                    completion_nodes.add(node)
+            if action in ("flip", "mixed"):
+                node = rng.choice(names)
+                flipped[node] = not flipped[node]
+                devices = [
+                    DeviceInfo(id=f"{node}-chip-{i}", count=10,
+                               devmem=16384, type="TPU-v5e",
+                               health=not (flipped[node] and i == 0),
+                               coords=(i % 4, i // 4))
+                    for i in range(4)
+                ]
+                s.nodes.add_node(node, NI(name=node, devices=devices,
+                                          topology=None))
+                flip_nodes.add(node)
+            if action == "commit":
+                self._place(kube, s, names, placed, seq, n=4)
+            snap, reloaded, patched = self._sync(s)
+            # Counter attribution: flips reload, completion-only nodes
+            # patch, commits adopt (no counter).  A node that both
+            # completed and flipped reloads (the delta chain's
+            # inventory half no longer matches).
+            assert reloaded == len(flip_nodes), \
+                f"round {_round} {action}: reloaded {reloaded} != " \
+                f"flips {len(flip_nodes)}"
+            assert patched == len(completion_nodes - flip_nodes), \
+                f"round {_round} {action}: patched {patched} != " \
+                f"completions {len(completion_nodes - flip_nodes)}"
+            self._assert_cold_parity(s, snap, req, {})
+        s.close()
+
+    def test_commit_round_adopts_without_reload(self):
+        """A cycle's own grants must never force reloads at the next
+        refresh: the group commit published the usage the columnar
+        mirrors already hold (expected_key adoption), and the decision
+        write's informer echo is a refresh no-op."""
+        import itertools
+
+        kube, s, names = self._env(n_nodes=4)
+        placed = []
+        seq = itertools.count()
+        self._place(kube, s, names, placed, seq, n=6)
+        _snap, reloaded, patched = self._sync(s)
+        assert reloaded == 0
+        assert patched == 0
+        s.close()
+
+    def test_completion_write_through_counts_and_parity(self):
+        """4k-completion-round shape in miniature: deletes patch rows in
+        place — zero reloads, zero snapshot usage rebuilds — and the
+        patched columns equal a cold rebuild."""
+        import itertools
+
+        kube, s, names = self._env(n_nodes=6)
+        req = ContainerDeviceRequest(nums=1, type="TPU", memreq=500,
+                                     mem_percentage_req=0, coresreq=0)
+        placed = []
+        seq = itertools.count()
+        self._place(kube, s, names, placed, seq, n=12)
+        self._sync(s)
+        rebuilds_before = s.usage_rebuilds
+        nodes = set()
+        for _ in range(6):
+            name, node = placed.pop()
+            kube.delete_pod("default", name)
+            nodes.add(node)
+        snap, reloaded, patched = self._sync(s)
+        assert reloaded == 0
+        assert patched == len(nodes)
+        assert s.usage_rebuilds == rebuilds_before, \
+            "completions must write through the usage cache, not " \
+            "rebuild entries from pods_on_node"
+        self._assert_cold_parity(s, snap, req, {})
+        s.close()
+
+
 class TestBatchProtocol:
     def _env(self, n_nodes=4, **cfg):
         kube = FakeKube()

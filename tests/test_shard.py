@@ -612,3 +612,93 @@ class TestSingleReplicaParity:
         owned = fams["vtpu_shards_owned"].samples[0].value
         assert 0 < owned < len(names2)
         close_all(reps)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 14 satellite: the steady-state coordination tick is O(replicas)
+# ---------------------------------------------------------------------------
+class TestSteadyTickCost:
+    """STEADY_r07 measured a 1.3s shard-tick p99 / 6.5s max; the
+    regression pins the shape of the fix: a steady tick (no membership
+    change, nothing mid-adoption) touches the coordination object once
+    and NEVER lists pods or walks the fleet — O(replicas) work — while
+    the adoption pass replays only pods the live informer did not
+    already deliver."""
+
+    class CountingKube(FakeKube):
+        def __init__(self):
+            super().__init__()
+            self.pod_lists = 0
+            self.node_patches = 0
+
+        def list_pods(self, namespace=None, node_name=None):
+            self.pod_lists += 1
+            return super().list_pods(namespace, node_name)
+
+        def patch_node_annotations(self, name, annotations,
+                                   resource_version=None):
+            self.node_patches += 1
+            return super().patch_node_annotations(
+                name, annotations, resource_version)
+
+    def test_steady_tick_is_o_replicas(self):
+        kube = self.CountingKube()
+        clock = SimClock()
+        reps = [Scheduler(kube, shard_cfg(i), clock=clock)
+                for i in range(2)]
+        names = [f"node-{i}" for i in range(16)]
+        for n in names:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            for s in reps:
+                register_node(s, n, chips=2)
+        converge(reps, clock, names)
+        kube.pod_lists = 0
+        kube.node_patches = 0
+        walks_before = [s.shards.tick_fleet_walks for s in reps]
+        ticks = 10
+        for _ in range(ticks):
+            for s in reps:
+                s.shards.tick()
+            clock.advance(1.0)
+        # One coordination-object patch per tick (the beat), zero pod
+        # lists, zero fleet walks — the whole steady tick.
+        assert kube.pod_lists == 0
+        assert kube.node_patches == ticks * len(reps)
+        assert [s.shards.tick_fleet_walks for s in reps] == walks_before
+        close_all(reps)
+
+    def test_adoption_replay_skips_informer_tracked_pods(self):
+        kube, reps, names, clock = make_fleet(n_rep=2, n_nodes=6)
+        victim = reps[1]
+        survivor = reps[0]
+        victim_nodes = [n for n in names
+                        if victim.shards.reject_reason(n) is None]
+        assert victim_nodes
+        # Place pods on the victim's shards; the survivor's informer
+        # mirrors every decision (both replicas watch the fake).
+        items = []
+        for i, node in enumerate(victim_nodes):
+            pod = kube.create_pod(tpu_pod(f"v{i}", uid=f"vu{i}",
+                                          mem="500"))
+            items.append((pod, [node]))
+        results = victim.filter_many(items)
+        assert all(r.node for r in results), \
+            [r.error for r in results if not r.node]
+        for i in range(len(victim_nodes)):
+            assert survivor.pods.get(f"vu{i}") is not None, \
+                "survivor's informer must have mirrored the grant"
+        # Kill the victim; the survivor adopts and its WAL replay must
+        # SKIP every pod the informer already delivered.
+        for _ in range(60):
+            survivor.shards.tick()
+            if not survivor.shards.rebalancer.pending_nodes() \
+                    and survivor.shards.map is not None \
+                    and victim.shards.replica \
+                    not in survivor.shards.map.replicas:
+                break
+            clock.advance(2.0)
+        reb = survivor.shards.rebalancer
+        assert reb.adopted_total >= len(victim_nodes)
+        assert reb.wal_skipped_total >= len(victim_nodes)
+        assert reb.wal_replayed_total == 0
+        close_all(reps)
