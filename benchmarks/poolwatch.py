@@ -375,6 +375,49 @@ def snapshot_explain() -> None:
         f"dominant {doc.get('dominant_rejection')!r})")
 
 
+def snapshot_audit() -> None:
+    """Fleet-audit capture (docs/observability.md "Fleet audit"):
+    during any healthy window, snapshot a LIVE scheduler's /auditz —
+    open cross-plane findings with lifecycle, recent auto-clears,
+    sweep health — into benchmarks/captured-audit-<round>.json
+    alongside the perf/capacity/explain captures.  A real fleet's
+    finding mix (or its sustained emptiness) is the ground truth the
+    audit-sim's zero-false-positive contract is calibrated against.
+    Pure HTTP + JSON — never touches the chip or the pool claim; skips
+    loudly when no scheduler is reachable or audit is disabled."""
+    url = os.environ.get("VTPU_SCHED_URL", "")
+    if not url:
+        log("audit snapshot: VTPU_SCHED_URL unset; skipping")
+        return
+    import urllib.request
+
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    try:
+        with urllib.request.urlopen(base + "/auditz?limit=256",
+                                    timeout=10) as r:
+            doc = json.load(r)
+    except Exception as e:  # noqa: BLE001 — capture is best-effort
+        log(f"audit snapshot: cannot fetch {base}/auditz: {e!r}")
+        return
+    if "open_total" not in doc:
+        log("audit snapshot: /auditz disabled or pre-audit scheduler; "
+            "skipping")
+        return
+    if not doc.get("sweeps", {}).get("total"):
+        log("audit snapshot: no sweeps recorded yet; skipping")
+        return
+    out = os.path.join(REPO, "benchmarks",
+                       f"captured-audit-{round_id()}.json")
+    with open(out, "w") as f:
+        json.dump({"captured_at": time.time(), "auditz": doc}, f,
+                  indent=1)
+    log(f"audit snapshot: wrote {out} ({doc['open_total']} open "
+        f"finding(s), {doc['sweeps']['total']} sweep(s), last clean "
+        f"{doc['sweeps'].get('last_clean_age_s')!r}s ago)")
+
+
 def run_queue(kinds) -> bool:
     """Run the queue sequentially; False if a child overran or left a
     detached claim-holder (stop — the pool claim may still be held)."""
@@ -388,6 +431,8 @@ def run_queue(kinds) -> bool:
         snapshot_perf()
     if "explain" in kinds:
         snapshot_explain()
+    if "audit" in kinds:
+        snapshot_audit()
 
     tmpdir = tempfile.mkdtemp(prefix="poolwatch-")
     env = bench.shim_env(tmpdir)
@@ -498,7 +543,7 @@ def main() -> None:
     ap.add_argument("--max-hours", type=float, default=6.0)
     ap.add_argument(
         "--tasks",
-        default="bench,model,micro,scen,oversub,capacity,perf,explain")
+        default="bench,model,micro,scen,oversub,capacity,perf,explain,audit")
     a = ap.parse_args()
     # One round identity for the whole run: model_tasks' per-round retry
     # markers and run_queue's scenario children both read SCENARIO_ROUND,

@@ -1,0 +1,8 @@
+"""Fleet truth auditor: continuous cross-plane invariant verification
+(docs/observability.md "Fleet audit")."""
+
+from .auditor import AuditConfig, FleetAuditor
+from .findings import FINDING_TYPES, Finding, FindingStore
+
+__all__ = ["AuditConfig", "FleetAuditor", "FINDING_TYPES", "Finding",
+           "FindingStore"]

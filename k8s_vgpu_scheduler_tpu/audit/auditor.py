@@ -1,0 +1,689 @@
+"""Fleet truth auditor — continuous cross-plane invariant verification.
+
+The control plane holds five views of "who owns which chip": the grant
+registry (PodManager), the decision annotations on kube (the WAL), the
+per-node usage-snapshot cache + its columnar mirror, the node-agent
+shim regions (reaching the scheduler as ledger usage reports), and the
+quota/reservation ledgers.  Every simulator verdict proves they agree
+at the END of a run; a live fleet drifts silently between runs.  This
+auditor makes the checking continuous:
+
+- **delta sweeps** re-verify only nodes whose pod set or inventory
+  changed since the last sweep (a second subscriber on the same
+  rev-chain/dirty-set machinery the incremental snapshot uses), so the
+  steady-state cost tracks churn, not fleet size;
+- a **bounded-rate full sweep** (every Nth sweep) adds the planes a
+  delta cannot see: the kube pod list (annotation agreement, phantom
+  grants, WAL-plane double-booking, shard split-brain), the usage
+  ledger (orphaned region slots, silent usage series), quota
+  over-admission and reservation leaks.
+
+Every disagreement becomes a typed :mod:`finding <.findings>` with a
+first-seen/last-seen/auto-cleared lifecycle, surfaced on GET /auditz,
+``vtpu-audit``, and the ``vtpu_audit_*`` metrics.
+
+Zero-false-positive discipline (the auditor must never become an alarm
+generator): in-process planes are compared only at PROVEN-stable
+revision generations (revs re-read after the compare; churn requeues
+the node for the next sweep instead of guessing), kube-plane
+candidates are confirmed with a point re-read before opening (informer
+lag looks like corruption for exactly one event-delivery window), and
+region-slot findings require a usage report to have arrived AFTER the
+previous full sweep already knew the grant was gone.  ``make
+audit-sim`` gates both directions: every injected corruption class
+detected within one sweep AND a clean storm producing zero findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..k8s.client import (
+    NotFound,
+    is_pod_terminated,
+    pod_name,
+    pod_namespace,
+    pod_uid,
+)
+from ..shard.commit import SHARD_EPOCH_ANNOTATION, SHARD_OWNER_ANNOTATION
+from ..util import codec, perf
+from ..util.types import ASSIGNED_IDS_ANNOTATION, ASSIGNED_NODE_ANNOTATION
+from .findings import FINDING_TYPES, Finding, FindingStore
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditConfig:
+    enabled: bool = True
+    #: Background sweep period (cmd/scheduler --audit-interval).
+    interval_s: float = 30.0
+    #: Every Nth sweep is a full-fleet + cross-plane pass; the ones in
+    #: between are delta sweeps over dirty nodes only.
+    full_sweep_every: int = 8
+    #: A live grant whose usage series is older than this, on a node
+    #: whose OTHER series are fresh, is a usage-report-missing finding;
+    #: the same threshold bounds how fresh a dead uid's series must be
+    #: to count as an orphaned region slot.
+    usage_stale_s: float = 120.0
+    #: A reservation younger than this is never a leak candidate (the
+    #: defragmenter may still be assembling its siblings).
+    reservation_grace_s: float = 60.0
+    max_findings: int = 1024
+
+
+class FleetAuditor:
+    """One scheduler replica's auditor.  ``sweep()`` is reentrant-safe
+    (serialized by its own lock) and callable directly by embedders,
+    tests and the simulator; the daemon entrypoint runs it on a
+    background thread (the rescuer/admission shape)."""
+
+    def __init__(self, scheduler, cfg: Optional[AuditConfig] = None,
+                 clock=None) -> None:
+        self.s = scheduler
+        self.cfg = cfg or AuditConfig()
+        self._clock = clock or time.monotonic
+        self.store = FindingStore(max_open=self.cfg.max_findings)
+        self._sweep_lock = threading.Lock()
+        #: Nodes whose revs moved mid-check: re-audited next sweep
+        #: instead of opening a finding on a racing view.
+        self._requeue: Set[str] = set()
+        #: name -> (inventory rev, {uuid: (slots, mem, cores)}): the
+        #: advertised-capacity map is static per inventory rev, and
+        #: rebuilding it per sweep was the delta check's single
+        #: largest allocation (the audit-overhead A/B budget).
+        self._totals_cache: Dict[str, tuple] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: Sweep accounting (exported on /auditz and the vtpu_audit_*
+        #: families).
+        self.sweeps_total = 0
+        self.full_sweeps_total = 0
+        self.last_sweep_s = 0.0
+        self.last_full_sweep_s = 0.0
+        self.last_dirty_nodes = 0
+        self.kube_list_failures = 0
+        #: Injected-clock stamp of the last sweep that ended with ZERO
+        #: open findings (None = never), plus the wall-clock twin the
+        #: vtpu_audit_last_clean_timestamp gauge exports (alert math
+        #: needs `time()`-comparable seconds).
+        self.last_clean_at: Optional[float] = None
+        self.last_clean_wall = 0.0
+        #: Clock stamp of the previous FULL sweep — the orphaned-region
+        #: check's "a report arrived after we already knew the grant
+        #: was gone" fence (the ledger runs on the same injected clock).
+        self._prev_full_at: Optional[float] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    # -- the sweep -------------------------------------------------------------
+    def sweep(self, full: Optional[bool] = None) -> dict:
+        """One audit pass.  ``full=None`` lets the cadence decide (every
+        ``full_sweep_every``-th sweep is full); True/False forces."""
+        if not self.cfg.enabled:
+            return {"enabled": False}
+        with self._sweep_lock:
+            t0 = time.monotonic()
+            now = self._clock()
+            self.sweeps_total += 1
+            if full is None:
+                full = (self.sweeps_total %
+                        max(1, self.cfg.full_sweep_every)) == 0
+            # Drain the audit-side dirty sets even on a full sweep (the
+            # full pass covers them; leaving them queued would make the
+            # NEXT delta sweep re-walk ground the full pass just
+            # covered).
+            dirty = self.s.pods.drain_audit_dirty()
+            dirty |= self.s.nodes.drain_audit_dirty()
+            dirty |= self._requeue
+            self._requeue = set()
+            self.last_dirty_nodes = len(dirty)
+            if full:
+                nodes = set(self.s.nodes.list_nodes()) | dirty
+            else:
+                nodes = dirty
+            observed: Dict[Tuple[str, str], dict] = {}
+            covered_nodes: Set[str] = set()
+            # Strip-registry emptiness probed ONCE per sweep (both are
+            # empty on healthy fleets; the per-node locked reads were
+            # measurable against the overhead budget — a stale answer
+            # is squared by each node's rev re-check).
+            strips = (self.s.quarantine.count() > 0
+                      or bool(self.s.reservations._by_node))
+            for name in sorted(nodes):
+                self._check_node(name, observed, covered_nodes, strips)
+            # Columnar rows for every covered node under ONE cycle-lock
+            # acquisition (a per-node acquire was measurable against
+            # the audit-overhead budget).
+            self._check_columnar_many(covered_nodes, observed)
+            if full:
+                kube_uids = self._check_kube_plane(observed)
+                self._check_ledger(observed, kube_uids)
+                self._check_quota(observed)
+                self._check_reservations(observed, now)
+                self.full_sweeps_total += 1
+                self._prev_full_at = now
+
+            def covered(f: Finding) -> bool:
+                return full or (bool(f.scope) and f.scope in covered_nodes)
+
+            opened, cleared = self.store.reconcile(observed, covered, now)
+            open_now = self.store.open_count()
+            if open_now == 0:
+                self.last_clean_at = now
+                self.last_clean_wall = time.time()
+            dt = time.monotonic() - t0
+            self.last_sweep_s = dt
+            if full:
+                self.last_full_sweep_s = dt
+            perf.registry().record("audit-sweep", dt)
+            if opened:
+                log.warning("audit: %d finding(s) opened (%d open total)"
+                            " — see /auditz", opened, open_now)
+            return {"full": full, "nodes_checked": len(nodes),
+                    "opened": opened, "cleared": cleared,
+                    "open": open_now, "seconds": dt}
+
+    # -- per-node (delta-driven) checks ---------------------------------------
+    def _check_node(self, name: str,
+                    observed: Dict[Tuple[str, str], dict],
+                    covered_nodes: Set[str],
+                    strips: bool = True) -> None:
+        """Registry-plane double-booking + snapshot divergence for ONE
+        node, race-free by revision proof: the revs are read before and
+        after the data, and any movement requeues the node instead of
+        judging a torn view.  Allocation-light by design (the A/B
+        budget): per-chip usage accumulates into plain lists against a
+        rev-cached advertised-totals map — no DeviceUsage churn."""
+        s = self.s
+        r0 = (s.pods.rev_of(name), s.nodes.rev_of(name))
+        info = s.nodes.get_node(name)
+        if info is None:
+            # Node gone: its node-scoped findings are moot (the planes
+            # that disagreed no longer exist) — mark covered so they
+            # auto-clear.
+            self._totals_cache.pop(name, None)
+            covered_nodes.add(name)
+            return
+        # Lock-free by-node read (two GIL-atomic steps — the C-level
+        # list() of a values view runs no Python mid-copy); a racing
+        # mutation is caught by the rev re-check below, exactly the
+        # lock-free discipline PodManager.get/rev_of document.
+        bucket = s.pods._by_node.get(name)
+        pods_on = list(bucket.values()) if bucket else []
+        with s._usage_cache_lock:
+            cached = s._usage_cache.get(name)
+        if (s.pods.rev_of(name), s.nodes.rev_of(name)) != r0:
+            self._requeue.add(name)
+            return
+        cache = self._totals_cache.get(name)
+        if cache is None or cache[0] != r0[1]:
+            cache = (r0[1], {d.id: (d.count, d.devmem, d.cores)
+                             for d in info.devices})
+            self._totals_cache[name] = cache
+        totals = cache[1]
+        used: Dict[str, list] = {}
+        for pod in pods_on:
+            for container in pod.devices:
+                for g in container:
+                    row = used.get(g.uuid)
+                    if row is None:
+                        if g.uuid not in totals:
+                            # Chip vanished (re-registered smaller) —
+                            # same rule as score.build_usage.
+                            continue
+                        row = used[g.uuid] = [0, 0, 0]
+                    row[0] += 1
+                    row[1] += g.usedmem
+                    row[2] += g.usedcores
+        for cid, (us, um, uc) in used.items():
+            ts, tm, tc = totals[cid]
+            if us > ts or um > tm or uc > tc:
+                observed[("double-booking", f"{name}/{cid}")] = {
+                    "scope": name,
+                    "detail": {
+                        "origin": "registry",
+                        "used": [us, um, uc],
+                        "advertised": [ts, tm, tc],
+                        "pods": sorted(
+                            f"{p.namespace}/{p.name}" for p in pods_on
+                            if any(d.uuid == cid for c in p.devices
+                                   for d in c))[:8],
+                    }}
+        self._check_snapshot(name, r0, cached_=cached, totals=totals,
+                             used=used, observed=observed,
+                             strips=strips)
+        covered_nodes.add(name)
+
+    def _check_snapshot(self, name: str, r0: tuple, cached_, totals,
+                        used: Dict[str, list],
+                        observed: Dict[Tuple[str, str], dict],
+                        strips: bool = True) -> None:
+        """The cached usage map vs the registry truth — comparable ONLY
+        when the cache's key matches the proven-stable revs (any other
+        state means a dirty rebuild is already pending, which is the
+        protocol working, not corruption)."""
+        s = self.s
+        if cached_ is None or cached_[0] != r0:
+            return
+        # Quarantined/reserved chips are STRIPPED from cached entries;
+        # the sweep-level probe says whether either registry holds
+        # anything at all (a stale answer is squared by the rev
+        # re-check below).
+        quarantined = s.quarantine.quarantined_on(name) if strips else ()
+        reserved = s.reservations.reserved_on(name) if strips else ()
+        cu = cached_[1]
+        if quarantined or reserved:
+            expected_ids = {cid for cid in totals
+                            if cid not in quarantined
+                            and cid not in reserved}
+        else:
+            expected_ids = totals.keys()
+        diffs: List[str] = []
+        if cu.keys() != expected_ids:
+            diffs.append("chip-set")
+        else:
+            for cid, c in cu.items():
+                u = used.get(cid)
+                if u is None:
+                    if c.used_slots or c.used_mem or c.used_cores:
+                        diffs.append(cid)
+                elif (c.used_slots != u[0] or c.used_mem != u[1]
+                        or c.used_cores != u[2]):
+                    diffs.append(cid)
+                if len(diffs) >= 4:
+                    break
+        if not diffs:
+            return
+        # Strip sets were read after the rev pair: re-confirm stability
+        # before judging (every quarantine/reservation change bumps the
+        # node's rev, so a stable rev proves stable strips).
+        if (s.pods.rev_of(name), s.nodes.rev_of(name)) != r0:
+            self._requeue.add(name)
+            return
+        observed[("snapshot-divergence", name)] = {
+            "scope": name,
+            "detail": {"revs": list(r0), "chips": diffs[:4]}}
+
+    def _check_columnar_many(self, names: Set[str],
+                             observed: Dict[Tuple[str, str], dict]
+                             ) -> None:
+        """Columnar rows vs the snapshot entries they claim to mirror,
+        all under ONE cycle-lock acquisition (no solver mid-flight).
+        Rows carrying in-cycle tentative grants (``touched``) or an
+        unadopted write-through key (``expected_key``) are legitimately
+        ahead of their entry and skipped."""
+        if not names:
+            return
+        eng = self.s.batch
+        with eng._cycle_lock:
+            fl = eng.fleet
+            for name in names:
+                ent = fl._entries.get(name)
+                row = fl.row_of.get(name)
+                if ent is None or row is None or row in fl.touched \
+                        or row in fl.expected_key:
+                    continue
+                usage = ent.usage
+                cols = fl.col_of[row]
+                bad: List[str] = []
+                if cols.keys() != usage.keys():
+                    bad.append("chip-set")
+                else:
+                    p_us = fl.p_used_slots[row]
+                    p_um = fl.p_used_mem[row]
+                    p_uc = fl.p_used_cores[row]
+                    for cid, u in usage.items():
+                        c = cols[cid]
+                        if (p_us[c] != u.used_slots
+                                or p_um[c] != u.used_mem
+                                or p_uc[c] != u.used_cores
+                                or fl.used_slots[row, c] != u.used_slots
+                                or fl.used_mem[row, c] != u.used_mem
+                                or fl.used_cores[row, c]
+                                != u.used_cores):
+                            bad.append(cid)
+                            if len(bad) >= 4:
+                                break
+                if bad:
+                    observed[("columnar-divergence", name)] = {
+                        "scope": name, "detail": {"chips": bad[:4]}}
+
+    # -- cross-plane (full-sweep) checks --------------------------------------
+    def _check_kube_plane(self, observed: Dict[Tuple[str, str], dict]
+                          ) -> Dict[str, dict]:
+        """Annotation-WAL plane: grant↔annotation agreement per pod,
+        WAL-side double-booking per chip, shard split-brain, phantom
+        grants.  Every candidate is confirmed with a point re-read
+        before it opens — the one-event informer-lag window must not
+        read as corruption."""
+        s = self.s
+        try:
+            pods = s.client.list_pods()
+        except Exception:  # noqa: BLE001 — apiserver loss: audit later
+            self.kube_list_failures += 1
+            return {}
+        kube_uids: Dict[str, dict] = {}
+        per_chip: Dict[Tuple[str, str], List[int]] = {}
+        for pod in pods:
+            uid = pod_uid(pod)
+            if not uid:
+                continue
+            kube_uids[uid] = pod
+            if is_pod_terminated(pod):
+                continue
+            anns = pod.get("metadata", {}).get("annotations", {})
+            node = anns.get(ASSIGNED_NODE_ANNOTATION, "")
+            encoded = anns.get(ASSIGNED_IDS_ANNOTATION, "")
+            if not node or not encoded:
+                continue
+            try:
+                devices = codec.decode_pod_devices(encoded)
+            except codec.CodecError as e:
+                observed[("annotation-mismatch", uid)] = {
+                    "scope": "", "detail": {
+                        "pod": f"{pod_namespace(pod)}/{pod_name(pod)}",
+                        "reason": f"malformed-assigned-ids: {e}"}}
+                continue
+            for ctr in devices:
+                for d in ctr:
+                    row = per_chip.setdefault((node, d.uuid), [0, 0, 0])
+                    row[0] += 1
+                    row[1] += d.usedmem
+                    row[2] += d.usedcores
+            self._check_annotation_agreement(pod, uid, node, devices,
+                                             observed)
+            self._check_split_brain(pod, uid, node, anns, observed)
+        for (node, cid), (slots, mem, cores) in per_chip.items():
+            info = s.nodes.get_node(node)
+            if info is None:
+                continue     # unregistered node: the registry-side
+            dev = next((d for d in info.devices if d.id == cid), None)
+            if dev is None:
+                # An annotation naming a chip the node never advertised
+                # is a WAL inconsistency, not overbooking — type it with
+                # the annotation findings so a forged node annotation
+                # reads as one corruption class, not two.
+                observed[("annotation-mismatch", f"{node}/{cid}")] = {
+                    "scope": "", "detail": {"origin": "annotations",
+                                            "reason": "unknown-chip"}}
+            elif slots > dev.count or mem > dev.devmem \
+                    or cores > dev.cores:
+                key = ("double-booking", f"{node}/{cid}")
+                prior = observed.get(key)
+                detail = {"origin": "annotations",
+                          "used": [slots, mem, cores],
+                          "advertised": [dev.count, dev.devmem,
+                                         dev.cores]}
+                if prior is not None:
+                    # Registry plane already flagged this chip: both
+                    # planes agree it is overbooked (the fence-race
+                    # signature) — merge, keep the node scope (the
+                    # registry side reproduces on delta sweeps, so
+                    # node-scoped clearing stays sound).
+                    prior["detail"]["origin"] = "registry+annotations"
+                else:
+                    # WAL-ONLY overbooking (the registry missed an
+                    # event): global scope — a delta sweep never
+                    # re-reads the annotation plane, and node scope
+                    # would let the next churn on this node spuriously
+                    # auto-clear the finding (flapping under the
+                    # VtpuAuditFindingPersistent alert's `for:` window).
+                    observed[key] = {"scope": "", "detail": detail}
+        self._check_phantom_grants(kube_uids, observed)
+        return kube_uids
+
+    def _check_annotation_agreement(self, pod: dict, uid: str, node: str,
+                                    devices,
+                                    observed: Dict[Tuple[str, str], dict]
+                                    ) -> None:
+        s = self.s
+        ref = f"{pod_namespace(pod)}/{pod_name(pod)}"
+        reg = s.pods.get(uid)
+        if reg is None:
+            if s.provenance.last_grant_node(uid) == node:
+                return      # our own decision's echo is still in flight
+            if not self._confirm_kube_disagrees(pod, uid, node):
+                return
+            observed[("annotation-mismatch", uid)] = {
+                "scope": "", "detail": {
+                    "pod": ref, "annotation_node": node,
+                    "registry_node": None,
+                    "reason": "granted-on-kube-unknown-to-registry"}}
+            return
+        if reg.node != node:
+            if not self._confirm_kube_disagrees(pod, uid, node):
+                return
+            if (cur := s.pods.get(uid)) is None or cur.node == node:
+                return      # informer applied mid-check
+            observed[("annotation-mismatch", uid)] = {
+                "scope": "", "detail": {
+                    "pod": ref, "annotation_node": node,
+                    "registry_node": cur.node,
+                    "reason": "node-differs"}}
+            return
+        ann_chips = sorted((d.uuid, d.usedmem, d.usedcores)
+                           for c in devices for d in c)
+        reg_chips = sorted((d.uuid, d.usedmem, d.usedcores)
+                           for c in reg.devices for d in c)
+        if ann_chips != reg_chips:
+            if not self._confirm_kube_disagrees(pod, uid, node):
+                return
+            observed[("annotation-mismatch", uid)] = {
+                "scope": "", "detail": {
+                    "pod": ref, "annotation_node": node,
+                    "reason": "devices-differ",
+                    "annotation_chips": [c[0] for c in ann_chips][:8],
+                    "registry_chips": [c[0] for c in reg_chips][:8]}}
+
+    def _confirm_kube_disagrees(self, pod: dict, uid: str,
+                                node: str) -> bool:
+        """Point re-read: True only when the live pod STILL carries this
+        grant annotation (the list was not stale)."""
+        try:
+            cur = self.s.client.get_pod(pod_namespace(pod),
+                                        pod_name(pod))
+        except NotFound:
+            return False
+        except Exception:  # noqa: BLE001 — can't confirm: don't open
+            return False
+        if pod_uid(cur) != uid:
+            return False
+        anns = cur.get("metadata", {}).get("annotations", {})
+        return anns.get(ASSIGNED_NODE_ANNOTATION, "") == node
+
+    def _check_split_brain(self, pod: dict, uid: str, node: str,
+                           anns: Dict[str, str],
+                           observed: Dict[Tuple[str, str], dict]) -> None:
+        """A decision committed by a PEER replica at the CURRENT epoch
+        on a node THIS replica owns: the shard map lost disjointness
+        (or a fenceless write raced past it).  Adoption replays are
+        legitimately peer-stamped at an OLDER epoch and excluded."""
+        s = self.s
+        if not s.shards.enabled:
+            return
+        owner = anns.get(SHARD_OWNER_ANNOTATION, "")
+        if not owner or owner == s.shards.replica:
+            return
+        try:
+            epoch = int(anns.get(SHARD_EPOCH_ANNOTATION, ""))
+        except ValueError:
+            return
+        if epoch >= s.shards.epoch() and s.shards.owns(node):
+            observed[("split-brain-shard", uid)] = {
+                "scope": "", "detail": {
+                    "pod": f"{pod_namespace(pod)}/{pod_name(pod)}",
+                    "node": node, "committed_by": owner,
+                    "committed_epoch": epoch,
+                    "our_replica": s.shards.replica,
+                    "our_epoch": s.shards.epoch()}}
+
+    def _check_phantom_grants(self, kube_uids: Dict[str, dict],
+                              observed: Dict[Tuple[str, str], dict]
+                              ) -> None:
+        s = self.s
+        for info in s.pods.list_pods():
+            if info.uid in kube_uids:
+                continue
+            try:
+                cur = s.client.get_pod(info.namespace, info.name)
+                gone = pod_uid(cur) != info.uid
+            except NotFound:
+                gone = True
+            except Exception:  # noqa: BLE001 — can't confirm: don't open
+                gone = False
+            if gone and s.pods.get(info.uid) is not None:
+                observed[("phantom-grant", info.uid)] = {
+                    "scope": "", "detail": {
+                        "pod": f"{info.namespace}/{info.name}",
+                        "node": info.node,
+                        "chips": sorted(d.uuid for c in info.devices
+                                        for d in c)[:8]}}
+
+    def _check_ledger(self, observed: Dict[Tuple[str, str], dict],
+                      kube_uids: Dict[str, dict]) -> None:
+        """Shim-region plane (reaching us as ledger usage series):
+        a FRESH series for a grantless, kube-absent uid whose report
+        arrived after the previous full sweep = an orphaned (or
+        resurrected) region slot; a STALE series for a live grant on a
+        node whose other series are fresh = a dropped usage publish."""
+        s = self.s
+        cfg = self.cfg
+        now = s.ledger.now()
+        accounts = s.ledger.accounts()
+        by_uid = {a.uid: a for a in accounts}
+        node_freshest: Dict[str, float] = {}
+        for a in accounts:
+            age = max(0.0, now - a.last_recorded)
+            prev = node_freshest.get(a.node)
+            if prev is None or age < prev:
+                node_freshest[a.node] = age
+        for a in accounts:
+            if now - a.last_recorded > cfg.usage_stale_s:
+                continue
+            if s.pods.get(a.uid) is not None or a.uid in kube_uids:
+                continue
+            if self._prev_full_at is None \
+                    or a.last_recorded <= self._prev_full_at:
+                # No report since the fleet state was last verified:
+                # could be the tail of a legitimate teardown — only a
+                # slot that KEEPS publishing after the grant was known
+                # gone is an orphan.
+                continue
+            observed[("orphaned-region-slot", a.uid)] = {
+                "scope": "", "detail": {
+                    "pod": a.name, "node": a.node,
+                    "last_report_age_s": round(now - a.last_recorded, 3),
+                    "chip_seconds": round(a.chip_seconds, 3)}}
+        for info in s.pods.list_pods():
+            a = by_uid.get(info.uid)
+            if a is None:
+                continue    # never reported: nothing to compare yet
+            age = now - a.last_recorded
+            if age <= cfg.usage_stale_s:
+                continue
+            if node_freshest.get(info.node,
+                                 float("inf")) > cfg.usage_stale_s:
+                continue    # the whole node is silent — a lease story,
+                            # not a per-slot one
+            observed[("usage-report-missing", info.uid)] = {
+                "scope": "", "detail": {
+                    "pod": f"{info.namespace}/{info.name}",
+                    "node": info.node,
+                    "series_age_s": round(age, 3),
+                    "node_freshest_age_s": round(
+                        node_freshest[info.node], 3)}}
+
+    def _check_quota(self, observed: Dict[Tuple[str, str], dict]) -> None:
+        s = self.s
+        if not s.quota.enabled:
+            return
+        stats = s.quota.stats(s.pods.list_pods())
+        for row in stats["queues"]:
+            limit = row["nominal_chips"] + row["borrow_limit_chips"]
+            if row["held_chips"] > limit:
+                observed[("quota-over-admission", row["queue"])] = {
+                    "scope": "", "detail": {
+                        "held_chips": row["held_chips"],
+                        "nominal_chips": row["nominal_chips"],
+                        "borrow_limit_chips": row["borrow_limit_chips"]}}
+
+    def _check_reservations(self, observed: Dict[Tuple[str, str], dict],
+                            now: float) -> None:
+        s = self.s
+        legit: Set[str] = {d.key for d in s.defrag.pending_demand()}
+        inflight = s.defrag.in_flight()
+        legit |= set(inflight)
+        legit |= {f.requester_key for f in inflight.values()}
+        for r in s.reservations.active():
+            if now - r.reserved_at < self.cfg.reservation_grace_s:
+                continue
+            if r.for_key in legit or s.pods.get(r.for_key) is not None:
+                continue
+            observed[("reservation-leak", f"{r.node}:{r.for_key}")] = {
+                "scope": "", "detail": {
+                    "node": r.node, "for_key": r.for_key,
+                    "chips": len(r.chips),
+                    "age_s": round(now - r.reserved_at, 3)}}
+
+    # -- surfaces --------------------------------------------------------------
+    def export(self, limit: int = 64,
+               type_filter: Optional[str] = None) -> dict:
+        """The GET /auditz document (JSON-safe: no NaN/Inf, ages not
+        timestamps — the virtual-clock sims pin it deterministic)."""
+        now = self._clock()
+        by_type = self.store.open_by_type()
+        return {
+            "enabled": self.cfg.enabled,
+            "open_total": self.store.open_count(),
+            "open_by_type": by_type,
+            "open": self.store.open_list(now, limit=limit,
+                                         type_filter=type_filter),
+            "cleared_recent": self.store.cleared_list(now),
+            "counters": {
+                "opened_total": self.store.opened_total,
+                "cleared_total": self.store.cleared_total,
+                "dropped_total": self.store.dropped_total,
+                "kube_list_failures": self.kube_list_failures,
+            },
+            "sweeps": {
+                "total": self.sweeps_total,
+                "full": self.full_sweeps_total,
+                "last_sweep_s": round(self.last_sweep_s, 6),
+                "last_full_sweep_s": round(self.last_full_sweep_s, 6),
+                "last_dirty_nodes": self.last_dirty_nodes,
+                "last_clean_age_s": (
+                    round(max(0.0, now - self.last_clean_at), 3)
+                    if self.last_clean_at is not None else None),
+                "interval_s": self.cfg.interval_s,
+                "full_sweep_every": self.cfg.full_sweep_every,
+            },
+            "finding_types": list(FINDING_TYPES),
+        }
+
+    # -- daemon loop (cmd/scheduler.py; embedders call sweep() directly) ------
+    def start(self, interval_s: Optional[float] = None) -> None:
+        if self._thread is not None or not self.cfg.enabled:
+            return
+        period = interval_s if interval_s is not None \
+            else self.cfg.interval_s
+
+        def loop() -> None:
+            while not self._stop.wait(period):
+                try:
+                    self.sweep()
+                except Exception:  # noqa: BLE001 — keep auditing through glitches
+                    log.exception("audit sweep failed")
+
+        self._thread = threading.Thread(target=loop, name="fleet-audit",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

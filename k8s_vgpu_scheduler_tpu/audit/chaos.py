@@ -1,0 +1,163 @@
+"""Seeded corruption injectors for the audit-sim adversarial proof.
+
+Each injector corrupts exactly ONE plane of truth — deliberately
+WITHOUT the coupled propagation the healthy write paths perform (a
+forged annotation is patched behind the informer's back, a double
+grant is booked the way a fence-disabled race would book it, a region
+slot keeps publishing after its pod died) — and returns a ``revert``
+callable that undoes the corruption so ``make audit-sim`` can also
+prove the finding AUTO-CLEARS once the disagreement is repaired.
+
+These are test/simulator hooks: nothing in the production control
+plane imports this module.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+from ..scheduler.pods import PodInfo
+from ..shard.commit import SHARD_EPOCH_ANNOTATION, SHARD_OWNER_ANNOTATION
+from ..util import codec
+from ..util.types import (
+    ASSIGNED_IDS_ANNOTATION,
+    ASSIGNED_NODE_ANNOTATION,
+    ContainerDevice,
+)
+
+
+def forge_annotation(s, kube, namespace: str, name: str,
+                     wrong_node: str) -> Callable[[], None]:
+    """Rewrite a placed pod's assigned-node annotation behind the
+    informer's back (the watch is detached around the patch, exactly
+    what out-of-band kube tampering or a lost MODIFIED event looks
+    like) → ``annotation-mismatch``."""
+    pod = kube.get_pod(namespace, name)
+    original = pod["metadata"]["annotations"][ASSIGNED_NODE_ANNOTATION]
+
+    def patch(node: str) -> None:
+        kube.unwatch_pods(s.on_pod_event)
+        try:
+            kube.patch_pod_annotations(
+                namespace, name, {ASSIGNED_NODE_ANNOTATION: node})
+        finally:
+            # Re-attach WITHOUT the informer-boot replay watch_pods
+            # performs — a replay would absorb the forged value into
+            # the registry (the planes would agree again) and the
+            # corruption being injected is precisely "kube changed and
+            # the scheduler never heard".
+            with kube._lock:
+                kube._pod_watchers.append(s.on_pod_event)
+
+    patch(wrong_node)
+    return lambda: patch(original)
+
+
+def forge_shard_owner(s, kube, namespace: str,
+                      name: str) -> Callable[[], None]:
+    """Stamp a placed pod's decision as committed by a GHOST peer at
+    the CURRENT epoch on a node this replica owns →
+    ``split-brain-shard`` (an adoption replay would carry an older
+    epoch and is deliberately not a finding)."""
+    pod = kube.get_pod(namespace, name)
+    anns = pod["metadata"]["annotations"]
+    original = {SHARD_OWNER_ANNOTATION: anns.get(SHARD_OWNER_ANNOTATION,
+                                                 ""),
+                SHARD_EPOCH_ANNOTATION: anns.get(SHARD_EPOCH_ANNOTATION,
+                                                 "")}
+    kube.patch_pod_annotations(namespace, name, {
+        SHARD_OWNER_ANNOTATION: "replica-ghost",
+        SHARD_EPOCH_ANNOTATION: str(s.shards.epoch())})
+    return lambda: kube.patch_pod_annotations(namespace, name, original)
+
+
+def double_grant(s, kube, victim_uid: str,
+                 clone_name: str) -> Callable[[], None]:
+    """The fence-disabled race: a SECOND pod lands on kube carrying
+    decision annotations for the SAME chips an existing grant holds —
+    both writes are individually well-formed, the WAL itself is
+    overbooked, and the informer (correctly) mirrors it into the
+    registry → ``double-booking`` on both planes."""
+    victim = s.pods.get(victim_uid)
+    encoded = codec.encode_pod_devices(victim.devices)
+    kube.create_pod({
+        "metadata": {
+            "name": clone_name, "namespace": victim.namespace,
+            "uid": f"uid-{clone_name}",
+            "annotations": {ASSIGNED_NODE_ANNOTATION: victim.node,
+                            ASSIGNED_IDS_ANNOTATION: encoded}},
+        "spec": {"containers": [{"name": "main", "resources": {
+            "limits": {"google.com/tpu": "1"}}}]},
+    })
+    return lambda: kube.delete_pod(victim.namespace, clone_name)
+
+
+def phantom_grant(s, node: str, chip_uuid: str,
+                  uid: str = "uid-audit-phantom") -> Callable[[], None]:
+    """Book a grant in the registry for a pod kube has never heard of
+    (a registry entry that outlived its DELETE, or a forged insert) →
+    ``phantom-grant``.  Small footprint on a chip with headroom so it
+    cannot double as an overbooking."""
+    s.pods.add_pod(PodInfo(
+        uid=uid, name="audit-phantom", namespace="sim", node=node,
+        devices=[[ContainerDevice(uuid=chip_uuid, type="",
+                                  usedmem=1, usedcores=0)]]))
+    return lambda: s.pods.del_pod(uid)
+
+
+def corrupt_snapshot(s, node: str) -> Callable[[], None]:
+    """Mutate the node's published usage-cache map in place WITHOUT
+    bumping its revs (the drift the rev-chain write-through exists to
+    prevent) → ``snapshot-divergence``."""
+    from ..scheduler import score as score_mod
+
+    s.snapshot()    # ensure the entry exists at current revs
+    with s._usage_cache_lock:
+        _key, usage = s._usage_cache[node]
+        cid = sorted(usage)[0]
+        original = usage[cid]
+        forged = score_mod.clone_usage(original)
+        forged.used_mem += 7
+        usage[cid] = forged
+
+    def revert() -> None:
+        with s._usage_cache_lock:
+            cached = s._usage_cache.get(node)
+            if cached is not None and cached[1].get(cid) is forged:
+                cached[1][cid] = original
+
+    return revert
+
+
+def corrupt_columnar(s, node: str) -> Callable[[], None]:
+    """Flip one cell of the columnar fleet's mirrors out from under its
+    snapshot entry → ``columnar-divergence``.  The fleet is settled
+    first (one refresh, exactly what a cycle's prologue runs) so every
+    row has adopted its pending write-through keys — the auditor
+    rightly skips un-adopted rows, and the corruption must land on a
+    row it WILL judge."""
+    fl = s.batch.fleet
+    snap, changed = s.snapshot_for_batch()
+    with s.batch._cycle_lock:
+        fl.refresh(snap, s.batch._drain_deltas(), changed)
+        row = fl.row_of[node]
+        c = 0
+        fl.used_mem[row, c] += 5
+        fl.p_used_mem[row][c] += 5
+
+    def revert() -> None:
+        with s.batch._cycle_lock:
+            fl.used_mem[row, c] -= 5
+            fl.p_used_mem[row][c] -= 5
+
+    return revert
+
+
+def leak_reservation(s, node: str,
+                     chips: List[str]) -> Callable[[], None]:
+    """Reserve chips for a beneficiary that does not exist (and never
+    registered demand) → ``reservation-leak`` once past the grace."""
+    r = s.reservations.reserve(node, set(chips),
+                               for_key="uid-audit-ghost-demand",
+                               ttl_s=10_000.0)
+    return lambda: s.reservations.release(r)

@@ -1,0 +1,162 @@
+"""Typed finding store for the fleet truth auditor.
+
+One finding = one live disagreement between two sources of truth,
+keyed ``(type, subject)`` so repeated sweeps refresh the SAME entry
+instead of minting duplicates.  Lifecycle: a sweep reports everything
+it observed; a previously-open finding whose scope the sweep re-checked
+and did NOT reproduce auto-clears into a bounded recent-cleared ring —
+the operator sees first-seen/last-seen/cleared-at, never an unbounded
+log.  Both sides are bounded (``max_open`` with a drop counter,
+``cleared_keep`` ring), so a corrupted fleet can page, not OOM, the
+scheduler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: Every disagreement class the auditor can type a finding as — the
+#: ``vtpu_audit_findings{type}`` label set (all emitted, zero-valued
+#: when clean, so dashboards never reference a vanishing series) and
+#: the taxonomy table in docs/observability.md.
+FINDING_TYPES = (
+    # Plane-pair: grant registry / decision-annotation WAL vs inventory.
+    "double-booking",          # chips granted beyond advertised capacity
+    "phantom-grant",           # registry grant whose pod is gone from kube
+    "annotation-mismatch",     # decision annotations disagree with registry
+    "split-brain-shard",       # a peer committed on an owned node at the
+                               # current epoch — shard disjointness broken
+    # Plane-pair: node-agent shim regions (via the usage transport) vs
+    # the grant registry.
+    "orphaned-region-slot",    # a region still publishes usage for a
+                               # pod whose grant is gone
+    "usage-report-missing",    # a live grant's usage series went silent
+                               # while its node keeps reporting others
+    # Plane-pair: quota ledger vs grants / reservations vs demand.
+    "quota-over-admission",    # a queue holds more than nominal+borrow
+    "reservation-leak",        # a slice reservation with no beneficiary
+    # Plane-pair: derived in-process views vs the registry they mirror.
+    "snapshot-divergence",     # per-node usage cache != registry rebuild
+                               # at matching revision generations
+    "columnar-divergence",     # columnar fleet row != the snapshot entry
+                               # it claims to mirror
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    type: str
+    subject: str
+    #: Node whose per-node re-check covers (and so can clear) this
+    #: finding; "" = global — only a full-fleet sweep can clear it.
+    scope: str
+    detail: dict
+    first_seen: float
+    last_seen: float
+    sweeps_seen: int = 1
+    cleared_at: Optional[float] = None
+
+    def export(self, now: float) -> dict:
+        doc = {
+            "type": self.type,
+            "subject": self.subject,
+            "detail": self.detail,
+            "first_seen_age_s": round(max(0.0, now - self.first_seen), 3),
+            "last_seen_age_s": round(max(0.0, now - self.last_seen), 3),
+            "sweeps_seen": self.sweeps_seen,
+        }
+        if self.cleared_at is not None:
+            doc["cleared_age_s"] = round(
+                max(0.0, now - self.cleared_at), 3)
+        return doc
+
+
+class FindingStore:
+    """Bounded open-findings map + recent-cleared ring, internally
+    locked (the sweep thread writes; /auditz, the exporter and the CLI
+    read concurrently)."""
+
+    def __init__(self, max_open: int = 1024,
+                 cleared_keep: int = 256) -> None:
+        self.max_open = max_open
+        self._lock = threading.Lock()
+        self._open: Dict[Tuple[str, str], Finding] = {}
+        self._cleared: deque = deque(maxlen=cleared_keep)
+        #: Lifetime counters for the exporter and /auditz.
+        self.opened_total = 0
+        self.cleared_total = 0
+        #: Findings refused at the ``max_open`` cap — nonzero means the
+        #: fleet is more corrupted than the store will enumerate.
+        self.dropped_total = 0
+
+    def reconcile(self, observed: Dict[Tuple[str, str], dict],
+                  covered: Callable[[Finding], bool],
+                  now: float) -> Tuple[int, int]:
+        """Fold one sweep's observations in.  ``observed`` maps
+        ``(type, subject)`` to ``{"scope": node-or-empty, "detail":
+        {...}}``; ``covered(finding)`` says whether this sweep re-ran
+        the check that would have reproduced the finding (a delta sweep
+        must never clear a finding whose scope it did not look at).
+        Returns ``(opened, cleared)`` counts."""
+        opened = cleared = 0
+        with self._lock:
+            for key, obs in observed.items():
+                f = self._open.get(key)
+                if f is not None:
+                    f.last_seen = now
+                    f.sweeps_seen += 1
+                    f.detail = obs["detail"]
+                    f.scope = obs["scope"]
+                    continue
+                if len(self._open) >= self.max_open:
+                    self.dropped_total += 1
+                    continue
+                self._open[key] = Finding(
+                    type=key[0], subject=key[1], scope=obs["scope"],
+                    detail=obs["detail"], first_seen=now, last_seen=now)
+                self.opened_total += 1
+                opened += 1
+            for key in [k for k, f in self._open.items()
+                        if k not in observed and covered(f)]:
+                f = self._open.pop(key)
+                f.cleared_at = now
+                self._cleared.append(f)
+                self.cleared_total += 1
+                cleared += 1
+        return opened, cleared
+
+    def open_count(self) -> int:
+        return len(self._open)
+
+    def open_by_type(self) -> Dict[str, int]:
+        """Open-finding counts over the FULL taxonomy (zero-valued when
+        clean) — the ``vtpu_audit_findings{type}`` read."""
+        counts = {t: 0 for t in FINDING_TYPES}
+        with self._lock:
+            for f in self._open.values():
+                counts[f.type] = counts.get(f.type, 0) + 1
+        return counts
+
+    def open_list(self, now: float, limit: int = 64,
+                  type_filter: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            rows = [f for f in self._open.values()
+                    if type_filter is None or f.type == type_filter]
+        rows.sort(key=lambda f: (f.first_seen, f.type, f.subject))
+        return [f.export(now) for f in rows[:limit]]
+
+    def cleared_list(self, now: float, limit: int = 16) -> List[dict]:
+        with self._lock:
+            rows = list(self._cleared)[-limit:]
+        return [f.export(now) for f in reversed(rows)]
+
+    def has_open(self, type_: str, subject_prefix: str = "") -> bool:
+        """The simulator verdict's probe: any open finding of ``type_``
+        whose subject starts with ``subject_prefix``."""
+        with self._lock:
+            return any(f.type == type_
+                       and f.subject.startswith(subject_prefix)
+                       for f in self._open.values())

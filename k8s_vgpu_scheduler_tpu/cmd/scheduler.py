@@ -277,6 +277,26 @@ def parse_args(argv=None):
     p.add_argument("--explain-event-throttle", type=float, default=300.0,
                    help="at most one Unschedulable event per pod per "
                         "this many seconds while it stays unplaced")
+    # Fleet truth auditor (audit/; docs/observability.md "Fleet audit").
+    p.add_argument("--no-audit", action="store_true",
+                   help="disable the fleet truth auditor (continuous "
+                        "cross-plane invariant verification behind GET "
+                        "/auditz, vtpu-audit and the vtpu_audit_* "
+                        "metrics; the escape hatch and the overhead "
+                        "A/B's baseline)")
+    p.add_argument("--audit-interval", type=float, default=30.0,
+                   help="audit sweep period (seconds); delta sweeps "
+                        "re-verify only nodes that changed since the "
+                        "last sweep, so steady-state cost tracks churn")
+    p.add_argument("--audit-full-sweep-every", type=int, default=8,
+                   help="every Nth sweep is a full-fleet cross-plane "
+                        "pass (kube annotation WAL, usage ledger, "
+                        "quota, reservations) — the bounded-rate "
+                        "backstop behind the delta sweeps")
+    p.add_argument("--audit-usage-stale", type=float, default=120.0,
+                   help="a live grant whose usage series is older than "
+                        "this while its node keeps reporting others is "
+                        "a usage-report-missing finding")
     p.add_argument("--perf-tracemalloc", action="store_true",
                    help="opt-in tracemalloc allocation tracking: "
                         "/perfz then carries the top allocation sites "
@@ -360,6 +380,10 @@ def build_config(args) -> Config:
         enable_debug=args.debug,
         perf_enabled=not args.no_perf,
         perf_tracemalloc=args.perf_tracemalloc,
+        audit_enabled=not args.no_audit,
+        audit_interval_s=args.audit_interval,
+        audit_full_sweep_every=args.audit_full_sweep_every,
+        audit_usage_stale_s=args.audit_usage_stale,
         provenance_enabled=not args.no_provenance,
         provenance_per_pod=args.provenance_per_pod,
         provenance_max_pods=args.provenance_max_pods,
@@ -493,6 +517,11 @@ def main(argv=None):
                         "capacity demand sample failed")
         threading.Thread(target=_capacity_loop,
                          name="capacity-observe", daemon=True).start()
+    # Fleet truth auditor: continuous cross-plane invariant sweeps
+    # (same embedders-own-their-cadence rule as the rescuer; inert
+    # with --no-audit).  After the boot reconcile so the first full
+    # sweep verifies a populated registry, not an empty one.
+    scheduler.auditor.start()
     # Active-active HA: join the shard map SYNCHRONOUSLY before any
     # server accepts traffic (an unfenced replica serving /filter could
     # place on shards it does not own), then keep coordinating on the
@@ -549,6 +578,7 @@ def main(argv=None):
         scheduler.admission.stop()
         scheduler.defrag.stop()
         scheduler.shards.stop()
+        scheduler.auditor.stop()
         http_server.stop()
         grpc_server.stop(grace=2)
 

@@ -125,6 +125,18 @@ pods, and the overbooking / grant-conservation audit:
                       "count": 24},
             "storm_interval_s": 2, "settle_s": 120}}
 
+An ``audit`` scenario is the fleet-truth-auditor proof (docs/
+observability.md "Fleet audit"): a clean storm that must produce zero
+findings, then every seeded corruption class from audit/chaos.py
+detected within one sweep and auto-cleared on repair, plus the paired
+sweep-vs-drain overhead gate:
+
+    {"audit": {"seed": 17,
+               "storm": {"name": "train", "tpu": 1, "tpumem": 2000,
+                         "count": 96},
+               "storm_interval_s": 1, "chunk": 8, "complete_every": 4,
+               "overhead": {"blocks": 6, "pods_per_leg": 256}}}
+
 Usage:
     vtpu-simulate --nodes 4 --chips 8 --hbm 16384 --mesh 4x2 \
                   --workload workload.json [--policy binpack] [--json]
@@ -301,6 +313,24 @@ def run_simulation(workload: dict, *, nodes: int = 0, chips: int = 0,
             "hbm_allocated_fraction": 0.0,
             "fits": bool(result["verdict"]["ok"]),
             "serving": result,
+        }
+
+    audit = workload.get("audit")
+    if audit is not None:
+        # An audit scenario is a self-contained clean-storm +
+        # corruption-injection + overhead proof (it builds its own
+        # sharded scheduler on the virtual clock).
+        result = run_audit_phase(
+            audit, nodes=nodes, chips=chips, hbm=hbm, mesh=mesh,
+            generation=generation, policy=policy or "spread")
+        return {
+            "fleet": {"nodes": nodes, "chips_per_node": chips,
+                      "hbm_mib": hbm, "mesh": list(mesh),
+                      "policy": policy or "spread"},
+            "placed": [], "pending": [], "chips": {},
+            "hbm_allocated_fraction": 0.0,
+            "fits": bool(result["verdict"]["ok"]),
+            "audit": result,
         }
 
     ha = workload.get("ha")
@@ -1596,6 +1626,364 @@ def run_chaos_phase(s: Scheduler, kube: FakeKube, names: List[str],
     }
 
 
+def run_audit_phase(spec: dict, *, nodes: int, chips: int, hbm: int,
+                    mesh, generation: str, policy: str) -> dict:
+    """Fleet-truth-auditor adversarial proof (docs/observability.md
+    "Fleet audit"), three acts on the virtual clock:
+
+    1. **Clean storm** — a sharded scheduler places a pod storm through
+       the batched drain with usage reports flowing and a fraction of
+       pods completing mid-storm, while the auditor sweeps on its real
+       cadence (delta sweeps + the bounded-rate full pass).  The
+       verdict requires ZERO findings at every sweep: the auditor must
+       never read healthy churn as corruption.
+    2. **Seeded corruption injection** — each corruption class from
+       audit/chaos.py is injected in a fixed order; ONE full sweep must
+       detect it, attribute it to the expected finding type, and after
+       the injector's revert ONE more sweep must auto-clear it.
+    3. **ABBA overhead A/B** — the batched drain with a delta sweep at
+       drain cadence vs no sweeps, alternating leg order per block;
+       the pooled-median overhead gates <2%.
+
+    Acts 1–2 are deterministic (SimClock, fixed order, no RNG beyond
+    the seed); act 3 is wall-clock and reported under ``overhead``
+    (excluded from the bit-identical replay pin)."""
+    from ..audit import chaos as audit_chaos
+
+    clock = SimClock()
+    kube = FakeKube()
+    s = Scheduler(kube, Config(
+        node_scheduler_policy=policy,
+        shard_replica="replica-0", shard_ttl_s=10.0,
+        shard_grace_beats=1, shard_stale_ttl_s=5.0,
+        shard_adoption_grace_s=6.0,
+        audit_full_sweep_every=int(spec.get("full_sweep_every", 8)),
+        audit_usage_stale_s=float(spec.get("usage_stale_s", 120.0)),
+        audit_reservation_grace_s=float(
+            spec.get("reservation_grace_s", 60.0))), clock=clock)
+    names = build_fleet(s, kube, nodes, chips, hbm, mesh, generation)
+    kube.watch_pods(s.on_pod_event)
+    for _ in range(3):
+        s.shards.tick()
+        clock.advance(1.0)
+
+    storm_spec = dict(spec.get("storm") or
+                      {"name": "train", "tpu": 1, "tpumem": hbm,
+                       "count": 64})
+    count = int(storm_spec.get("count", 64))
+    interval = float(spec.get("storm_interval_s", 1.0))
+    chunk = int(spec.get("chunk", 8))
+    complete_every = int(spec.get("complete_every", 4))
+    pods = [spec_pod(storm_spec, i) for i in range(count)]
+    for pod in pods:
+        kube.create_pod(pod)
+
+    # The usage feed: every live placed pod's region publishes counters
+    # each beat (the ledger rides the scheduler's SimClock).
+    fed: Dict[str, tuple] = {}     # uid -> (name,)
+
+    def feed(skip: Optional[str] = None) -> None:
+        rows: Dict[str, List[dict]] = {}
+        for uid, (pname,) in fed.items():
+            if uid == skip:
+                continue
+            info = s.pods.get(uid)
+            if info is None:
+                continue
+            rows.setdefault(info.node, []).append({
+                "ctrkey": f"{uid}_{pname}", "chips": 1, "active": True,
+                "chip_seconds": clock(), "hbm_byte_seconds": 1e6,
+                "throttled_seconds": 0.0, "oversub_spill_seconds": 0.0,
+                "window_s": interval})
+        for node, node_rows in rows.items():
+            s.ledger.record(node, node_rows)
+
+    placed: List[dict] = []
+    pending: List[dict] = []
+    completed: List[str] = []
+    storm_max_open = 0
+    storm_sweeps = 0
+    for at in range(0, count, chunk):
+        batch = pods[at:at + chunk]
+        for pod, r in zip(batch, s.filter_many(
+                [(p, names) for p in batch])):
+            name = pod["metadata"]["name"]
+            if r.node:
+                placed.append({"pod": name, "node": r.node})
+                fed[pod["metadata"]["uid"]] = (name,)
+            else:
+                pending.append({"pod": name,
+                                "reason": r.error or "no fit"})
+        # Mid-storm completions: every Nth placed pod's region stops
+        # publishing, then its pod is deleted — healthy churn the
+        # auditor must NOT flag.
+        while complete_every > 0 and \
+                len(completed) < len(placed) // complete_every:
+            victim = placed[len(completed) * complete_every]
+            uid = f"uid-{victim['pod']}"
+            fed.pop(uid, None)
+            try:
+                kube.delete_pod("sim", victim["pod"])
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+            completed.append(victim["pod"])
+        clock.advance(interval)
+        feed()
+        s.shards.tick()
+        rep = s.auditor.sweep()     # cadence decides delta vs full
+        storm_sweeps += 1
+        storm_max_open = max(storm_max_open, rep["open"])
+    settle = s.auditor.sweep(full=True)
+    storm_max_open = max(storm_max_open, settle["open"])
+    clean_doc = s.export_audit()
+
+    # -- act 2: seeded corruption injection -------------------------------
+    live = [p for p in placed if p["pod"] not in completed
+            and s.pods.get(f"uid-{p['pod']}") is not None]
+    target = live[0]
+    target_uid = f"uid-{target['pod']}"
+    wrong_node = next(n for n in names if n != target["node"])
+    snap = s.snapshot()
+    free_chip = next(
+        (n, cid) for n in sorted(snap)
+        for cid, u in sorted(snap[n].usage.items())
+        if u.used_slots == 0)
+    usage_victim = f"uid-{live[1]['pod']}"
+    dead = live[2]
+    dead_uid = f"uid-{dead['pod']}"
+
+    injections = [
+        ("forged-annotation", "annotation-mismatch",
+         lambda: audit_chaos.forge_annotation(
+             s, kube, "sim", target["pod"], wrong_node)),
+        ("forged-shard-owner", "split-brain-shard",
+         lambda: audit_chaos.forge_shard_owner(
+             s, kube, "sim", target["pod"])),
+        ("double-grant-past-fence", "double-booking",
+         lambda: audit_chaos.double_grant(
+             s, kube, target_uid, "audit-clone")),
+        ("phantom-grant", "phantom-grant",
+         lambda: audit_chaos.phantom_grant(s, free_chip[0],
+                                           free_chip[1])),
+        ("snapshot-corruption", "snapshot-divergence",
+         lambda: audit_chaos.corrupt_snapshot(s, names[0])),
+        ("columnar-corruption", "columnar-divergence",
+         lambda: audit_chaos.corrupt_columnar(s, names[1])),
+        ("reservation-leak", "reservation-leak",
+         lambda: _leak_and_age(s, clock, names[2],
+                               [f"{names[2]}-chip-0"], audit_chaos)),
+        ("dropped-usage-publish", "usage-report-missing",
+         lambda: _drop_usage(s, clock, feed, usage_victim)),
+        ("resurrected-region-slot", "orphaned-region-slot",
+         lambda: _resurrect_slot(s, kube, clock, feed, fed,
+                                 dead_uid, dead["pod"])),
+    ]
+    results: List[dict] = []
+    for tag, expected_type, inject in injections:
+        revert = inject()
+        rep = s.auditor.sweep(full=True)
+        detected = s.auditor.store.has_open(expected_type)
+        open_types = sorted(
+            t for t, n in s.auditor.store.open_by_type().items() if n)
+        revert()
+        clear_rep = s.auditor.sweep(full=True)
+        cleared = clear_rep["open"] == 0
+        results.append({
+            "injection": tag, "expected_type": expected_type,
+            "detected_within_one_sweep": detected,
+            "open_types_after_injection": open_types,
+            "auto_cleared_after_repair": cleared,
+            "opened": rep["opened"], "cleared": clear_rep["cleared"],
+        })
+
+    # -- act 3: ABBA overhead on the batched drain ------------------------
+    overhead = _audit_overhead_ab(
+        spec.get("overhead") or {}, nodes=nodes, chips=chips, hbm=hbm,
+        mesh=mesh, generation=generation, policy=policy)
+
+    verdict = {
+        "clean_storm_zero_findings": storm_max_open == 0,
+        "all_detected_within_one_sweep": all(
+            r["detected_within_one_sweep"] for r in results),
+        "all_attributed_to_expected_type": all(
+            r["expected_type"] in r["open_types_after_injection"]
+            for r in results),
+        "all_auto_cleared": all(
+            r["auto_cleared_after_repair"] for r in results),
+        "injected_classes": len(results),
+        "overhead_ok": overhead["overhead_pct"] < overhead["budget_pct"],
+    }
+    verdict["ok"] = (verdict["clean_storm_zero_findings"]
+                     and verdict["all_detected_within_one_sweep"]
+                     and verdict["all_attributed_to_expected_type"]
+                     and verdict["all_auto_cleared"]
+                     and verdict["injected_classes"] >= 6
+                     and verdict["overhead_ok"])
+    result = {
+        "seed": int(spec.get("seed", 0)),
+        "storm": {
+            "pods": count, "placed": len(placed),
+            "pending": len(pending), "completed_mid_storm":
+                len(completed), "sweeps": storm_sweeps,
+            "max_open_findings": storm_max_open,
+            "full_sweeps": clean_doc["sweeps"]["full"],
+            "dirty_nodes_last": clean_doc["sweeps"]["last_dirty_nodes"],
+        },
+        "injections": results,
+        "overhead": overhead,
+        "verdict": verdict,
+    }
+    s.close()
+    return result
+
+
+def _leak_and_age(s, clock, node, chip_ids, audit_chaos):
+    """Leak a reservation AND age it past the grace (the injector's
+    revert is returned unchanged)."""
+    revert = audit_chaos.leak_reservation(s, node, chip_ids)
+    clock.advance(s.auditor.cfg.reservation_grace_s + 5.0)
+    return revert
+
+
+def _drop_usage(s, clock, feed, victim_uid):
+    """Silence ONE live pod's usage series while its node keeps
+    reporting the others, past the staleness threshold."""
+    stale = s.auditor.cfg.usage_stale_s
+    beats = 5
+    for _ in range(beats):
+        clock.advance(stale / beats + 1.0)
+        feed(skip=victim_uid)
+
+    def revert():
+        clock.advance(1.0)
+        feed()
+    return revert
+
+
+def _resurrect_slot(s, kube, clock, feed, fed, dead_uid, dead_name):
+    """Delete a pod, then have its region slot publish one more usage
+    report — the zombie slot the monitor's GC should have reaped."""
+    info = s.pods.get(dead_uid)
+    node = info.node
+    fed.pop(dead_uid, None)
+    # A full sweep first so the auditor has verified the fleet BEFORE
+    # the resurrection (the orphan check requires a report newer than
+    # the previous full sweep).
+    s.auditor.sweep(full=True)
+    kube.delete_pod("sim", dead_name)
+    clock.advance(2.0)
+    s.ledger.record(node, [{
+        "ctrkey": f"{dead_uid}_{dead_name}", "chips": 1, "active": True,
+        "chip_seconds": clock(), "hbm_byte_seconds": 1e6,
+        "throttled_seconds": 0.0, "oversub_spill_seconds": 0.0,
+        "window_s": 1.0}])
+
+    def revert():
+        # The slot stops publishing; once the series ages past the
+        # staleness bound it is no longer "fresh usage for a dead uid".
+        clock.advance(s.auditor.cfg.usage_stale_s + 10.0)
+        feed()
+    return revert
+
+
+def _audit_overhead_ab(spec: dict, *, nodes: int, chips: int, hbm: int,
+                       mesh, generation: str, policy: str) -> dict:
+    """Auditor overhead on the batched drain, gated <2%.
+
+    Every leg runs the storm's own 256-pod drain through filter_many
+    and then the delta sweep that cadence implies, each phase timed
+    separately; per block (min over repeats for each phase, drawn from
+    the SAME legs) the overhead is ``sweep / drain`` and the verdict
+    takes the pooled median.  Pairing the phases inside one leg is
+    what makes the gate CI-stable: a differential two-arm A/B must
+    resolve a ~1% effect under this box's ~10% leg-to-leg noise, which
+    null experiments here read as noise — the paired ratio divides the
+    same-instant drift out (the ISSUE 14 null-calibration lesson,
+    taken one step further).  An A/B sanity figure is still reported:
+    audit-off legs interleave ABBA-style with the on legs, and their
+    drain times must straddle the on legs' (``ab_drain_delta_pct`` —
+    informational, proving dirty-tracking adds nothing measurable to
+    the drain itself).  Wall-clock — excluded from the bit-identical
+    replay pin."""
+    import statistics
+    import time as _time
+
+    # 256-pod legs — the storm's own cycle shape (the same scale the
+    # provenance overhead A/B measured at; smaller legs overstate the
+    # sweep's share because cycle fixed costs shrink with the leg).
+    blocks = int(spec.get("blocks", 6))
+    per_leg = int(spec.get("pods_per_leg", 256))
+    repeats = int(spec.get("repeats", 3))
+    budget_pct = float(spec.get("budget_pct", 2.0))
+    kube = FakeKube()
+    s = Scheduler(kube, Config(node_scheduler_policy=policy))
+    names = build_fleet(s, kube, nodes, chips, hbm, mesh, generation)
+    kube.watch_pods(s.on_pod_event)
+
+    def leg(audit_on: bool, round_: int):
+        batch = [spec_pod({"name": f"ov-{round_}", "tpu": 1,
+                           "tpumem": max(1, hbm // 4)}, i)
+                 for i in range(per_leg)]
+        for pod in batch:
+            kube.create_pod(pod)
+        t0 = _time.monotonic()
+        s.filter_many([(p, names) for p in batch])
+        t1 = _time.monotonic()
+        if audit_on:
+            s.auditor.sweep(full=False)
+        t2 = _time.monotonic()
+        for pod in batch:
+            try:
+                kube.delete_pod("sim", pod["metadata"]["name"])
+            except Exception:  # noqa: BLE001 — unplaced pods still exist
+                pass
+        # Square the delete churn away untimed so every leg starts
+        # from the same empty fleet (and the dirty sets stay drained
+        # in the off legs too).
+        if audit_on:
+            s.auditor.sweep(full=False)
+        else:
+            s.pods.drain_audit_dirty()
+            s.nodes.drain_audit_dirty()
+        return t1 - t0, t2 - t1
+
+    # Warmup (allocates the columnar fleet, class caches, worker pool).
+    leg(True, 0)
+    leg(False, 1)
+    ratios: List[float] = []
+    on_drains: List[float] = []
+    off_drains: List[float] = []
+    rnd = 2
+    for b in range(blocks):
+        drain_min = sweep_min = float("inf")
+        off_min = float("inf")
+        order = (True, False) if b % 2 == 0 else (False, True)
+        for _ in range(repeats):
+            for audit_on in order:
+                drain_s, sweep_s = leg(audit_on, rnd)
+                rnd += 1
+                if audit_on:
+                    drain_min = min(drain_min, drain_s)
+                    sweep_min = min(sweep_min, sweep_s)
+                else:
+                    off_min = min(off_min, drain_s)
+        ratios.append(sweep_min / drain_min)
+        on_drains.append(drain_min)
+        off_drains.append(off_min)
+    s.close()
+    pct = 100.0 * statistics.median(ratios)
+    ab_delta = 100.0 * (statistics.median(on_drains)
+                        / statistics.median(off_drains) - 1.0)
+    return {
+        "blocks": blocks, "pods_per_leg": per_leg,
+        "repeats_per_block": repeats,
+        "block_sweep_over_drain": [round(r, 4) for r in ratios],
+        "overhead_pct": round(pct, 3),
+        "ab_drain_delta_pct": round(ab_delta, 3),
+        "budget_pct": budget_pct,
+    }
+
+
 def run_ha_phase(spec: dict, *, nodes: int, chips: int, hbm: int,
                  mesh, generation: str, policy: str) -> dict:
     """Active-active HA scenario (docs/scheduler-concurrency.md,
@@ -2008,6 +2396,35 @@ def format_capacity(cp: dict) -> str:
     return "\n".join(lines)
 
 
+def format_audit(au: dict) -> str:
+    v = au["verdict"]
+    st = au["storm"]
+    lines = [
+        "fleet truth audit (clean storm + corruption injection; "
+        "docs/observability.md \"Fleet audit\"):",
+        "  clean storm: {placed}/{pods} placed, {completed_mid_storm} "
+        "completed mid-storm, {sweeps} sweep(s) ({full_sweeps} full) — "
+        "max open findings {max_open_findings}".format(
+            pods=st["pods"], **st),
+    ]
+    for r in au["injections"]:
+        lines.append(
+            "  {:<26s} → {:<22s} {} {}".format(
+                r["injection"], r["expected_type"],
+                "detected" if r["detected_within_one_sweep"]
+                else "MISSED",
+                "cleared" if r["auto_cleared_after_repair"]
+                else "NOT CLEARED"))
+    ov = au["overhead"]
+    lines.append(
+        "  drain overhead: {:+.2f}% (audit on vs off, {} blocks × {} "
+        "pods; budget {:.0f}%)".format(
+            ov["overhead_pct"], ov["blocks"], ov["pods_per_leg"],
+            ov["budget_pct"]))
+    lines.append("  verdict: " + ("PASS" if v["ok"] else f"FAIL {v}"))
+    return "\n".join(lines)
+
+
 def format_report(result: dict) -> str:
     cp = result.get("capacity")
     if cp:
@@ -2015,6 +2432,9 @@ def format_report(result: dict) -> str:
     sv = result.get("serving")
     if sv:
         return format_serving(sv)
+    au = result.get("audit")
+    if au:
+        return format_audit(au)
     f = result["fleet"]
     if "source" in f:
         head = ("fleet: {nodes} node(s) from {source}, "

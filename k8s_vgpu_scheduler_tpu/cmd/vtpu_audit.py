@@ -1,0 +1,162 @@
+"""vtpu-audit — fleet truth auditor findings, human-readable.
+
+Fetches the extender's ``GET /auditz`` export (audit/auditor.py) and
+renders the open cross-plane findings grouped by type with their
+lifecycle (first seen / last seen / sweeps observed), the recent
+auto-clears, and the sweep health line operators read first ("when was
+the fleet last verified clean").  Exit code doubles as a probe: 0 =
+clean, 1 = open findings, 2 = cannot fetch / audit disabled — so
+``vtpu-audit --cluster ...`` drops straight into scripts and runbooks
+(docs/operations.md "Fleet audit findings: triage by type").
+
+Usage:
+  vtpu-audit --cluster http://sched:9443
+  vtpu-audit --cluster ... --type double-booking   # one class only
+  vtpu-audit --cluster ... --json                  # raw /auditz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+#: One-line triage hint per finding type (the full runbook lives in
+#: docs/operations.md; this is the 2am version).
+TRIAGE = {
+    "double-booking": "chips granted beyond capacity — evict one "
+                      "grant NOW (docs/operations.md)",
+    "phantom-grant": "registry holds a grant kube lost — restart-"
+                     "reconcile or delete via rescuer",
+    "annotation-mismatch": "decision WAL and registry disagree — "
+                           "check informer lag, then the WAL",
+    "split-brain-shard": "a peer committed on an owned node at the "
+                         "current epoch — check the shard map NOW",
+    "orphaned-region-slot": "a shim region outlived its pod — check "
+                            "the node's monitor GC",
+    "usage-report-missing": "a live grant's usage series went silent "
+                            "— check that pod's container/monitor",
+    "quota-over-admission": "a queue holds more than nominal+borrow "
+                            "— check quota config vs admission loop",
+    "reservation-leak": "a defrag box has no beneficiary — it will "
+                        "TTL out; recurring means a defrag bug",
+    "snapshot-divergence": "usage cache drifted from the registry — "
+                           "restart the replica, keep /auditz output",
+    "columnar-divergence": "columnar fleet drifted from the snapshot "
+                           "— restart the replica, keep /auditz output",
+}
+
+
+def fetch_audit(cluster: str, type_filter: str = "",
+                limit: int = 64) -> dict:
+    """GET /auditz; raises OSError/ValueError on transport/JSON
+    failure.  A 404 body (audit disabled, pre-audit scheduler) is
+    returned as a dict carrying ``enabled``/``error`` when the server
+    sent JSON."""
+    import urllib.error
+    import urllib.request
+
+    from .vtpu_report import _base_url
+
+    url = _base_url(cluster)
+    if not url.endswith("/auditz"):
+        url += "/auditz"
+    url += f"?limit={limit:d}"
+    if type_filter:
+        import urllib.parse
+
+        url += "&type=" + urllib.parse.quote(type_filter, safe="")
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        try:
+            return json.load(e)
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            raise OSError(f"HTTP {e.code} from {url}") from e
+
+
+def render(doc: dict) -> str:
+    sw = doc.get("sweeps", {})
+    clean_age = sw.get("last_clean_age_s")
+    lines = [
+        "fleet audit: {} open finding(s); {} sweep(s) ({} full), last "
+        "clean {}".format(
+            doc.get("open_total", 0), sw.get("total", 0),
+            sw.get("full", 0),
+            f"{clean_age:.0f}s ago" if clean_age is not None
+            else "NEVER"),
+    ]
+    by_type = doc.get("open_by_type", {})
+    open_types = [t for t, n in by_type.items() if n]
+    if not open_types:
+        lines.append("all five planes agree — grant registry, decision "
+                     "WAL, snapshot/columnar views, region usage, "
+                     "quota/reservations.")
+    for t in open_types:
+        lines.append(f"+ {t} ({by_type[t]} open) — "
+                     f"{TRIAGE.get(t, 'see docs/operations.md')}")
+        for f in doc.get("open", []):
+            if f["type"] != t:
+                continue
+            lines.append(
+                "|   {:<40s} first {:>6.0f}s ago, last {:>4.0f}s ago, "
+                "{} sweep(s)".format(
+                    f["subject"][:40], f["first_seen_age_s"],
+                    f["last_seen_age_s"], f["sweeps_seen"]))
+            detail = {k: v for k, v in f.get("detail", {}).items()
+                      if k not in ("pods",)}
+            if detail:
+                lines.append("|     " + json.dumps(detail)[:110])
+    cleared = doc.get("cleared_recent", [])
+    if cleared:
+        lines.append(f"+ recently auto-cleared ({len(cleared)})")
+        for f in cleared[:8]:
+            lines.append(
+                "|   {:<22s} {:<34s} cleared {:>4.0f}s ago".format(
+                    f["type"], f["subject"][:34],
+                    f.get("cleared_age_s", 0.0)))
+    c = doc.get("counters", {})
+    if c.get("dropped_total"):
+        lines.append(f"WARNING: {c['dropped_total']} finding(s) dropped "
+                     "at the store cap — the fleet is more corrupted "
+                     "than this list enumerates")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("vtpu-audit")
+    p.add_argument("--cluster", required=True,
+                   help="extender HTTP base URL (the /auditz endpoint), "
+                        "e.g. http://sched:9443")
+    p.add_argument("--type", default="",
+                   help="show only this finding type")
+    p.add_argument("--limit", type=int, default=64,
+                   help="max findings listed")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="raw /auditz JSON")
+    args = p.parse_args(argv)
+    try:
+        doc = fetch_audit(args.cluster, type_filter=args.type,
+                          limit=args.limit)
+    except (OSError, ValueError) as e:
+        print(f"vtpu-audit: cannot fetch /auditz: {e}", file=sys.stderr)
+        return 2
+    if not doc.get("enabled", True):
+        print("vtpu-audit: fleet audit disabled on this scheduler "
+              "(--no-audit)", file=sys.stderr)
+        return 2
+    if "open_total" not in doc:
+        print(f"vtpu-audit: unexpected /auditz shape: "
+              f"{json.dumps(doc)[:200]}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(render(doc))
+    return 1 if doc.get("open_total") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,24 @@ def fetch_capacity(cluster: str) -> Optional[dict]:
         return None
 
 
+def fetch_audit(cluster: str) -> Optional[dict]:
+    """GET /auditz, or None when the scheduler predates the fleet
+    auditor / runs --no-audit — the report then shows the audit line
+    as '-' instead of a section (the --explain/capacity degradation
+    pattern)."""
+    import urllib.request
+
+    url = _base_url(cluster)
+    if not url.endswith("/auditz"):
+        url += "/auditz"
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            doc = json.load(r)
+    except Exception:  # noqa: BLE001 — audit surface is optional
+        return None
+    return doc if "open_total" in doc else None
+
+
 def fetch_explain(cluster: str, ref: str) -> Optional[dict]:
     """GET /explainz for one pod, or None when the scheduler predates
     decision provenance / runs --no-provenance / never saw the pod —
@@ -204,6 +222,27 @@ def format_capacity(cap: dict) -> str:
     return "\n".join(lines)
 
 
+def format_audit(audit: Optional[dict]) -> str:
+    """The ``vtpu-report`` audit section: open findings by type and the
+    last-clean age (GET /auditz).  ``None`` (pre-audit scheduler, or
+    --no-audit) degrades to a '-' line, mirroring how the pending table
+    shows '-' for pre-provenance schedulers."""
+    if audit is None:
+        return "+ audit: - (no /auditz on this scheduler)"
+    open_types = [(t, n) for t, n in
+                  sorted(audit.get("open_by_type", {}).items()) if n]
+    clean_age = audit.get("sweeps", {}).get("last_clean_age_s")
+    clean = (f"last clean {clean_age:.0f}s ago"
+             if clean_age is not None else "never verified clean")
+    if not open_types:
+        return f"+ audit: clean ({clean}; vtpu-audit for detail)"
+    lines = [f"+ audit: {audit.get('open_total', 0)} OPEN finding(s) "
+             f"({clean}; vtpu-audit for triage)"]
+    for t, n in open_types:
+        lines.append(f"|   {t:<24s} {n}")
+    return "\n".join(lines)
+
+
 def format_report(export: dict, pods: bool = False,
                   stale_after_s: float = DEFAULT_STALE_AFTER_S) -> str:
     fleet = export.get("fleet", {})
@@ -281,6 +320,8 @@ def format_report(export: dict, pods: bool = False,
                     p["granted_chips"], p["node"], p["idle_for_s"]))
     if export.get("capacity"):
         lines.append(format_capacity(export["capacity"]))
+    if "audit" in export:
+        lines.append(format_audit(export["audit"]))
     return "\n".join(lines)
 
 
@@ -301,6 +342,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "silently reporting frozen totals")
     p.add_argument("--no-capacity", action="store_true",
                    help="skip the GET /capacityz capacity section")
+    p.add_argument("--no-audit", action="store_true",
+                   help="skip the GET /auditz fleet-audit section")
     p.add_argument("--explain", default="", metavar="NS/NAME",
                    help="render one pod's decision-provenance timeline "
                         "(the vtpu-explain narrative) instead of the "
@@ -340,6 +383,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         cap = fetch_capacity(args.cluster)
         if cap is not None:
             export["capacity"] = cap
+    if not args.no_audit:
+        # None stays in the export: the section renders the '-'
+        # degradation line instead of vanishing (an operator reading
+        # the report should see that audit state is UNKNOWN, not
+        # silently assume clean).
+        export["audit"] = fetch_audit(args.cluster)
     if args.as_json:
         print(json.dumps(export, indent=1))
     elif args.as_csv:

@@ -21,6 +21,7 @@ from typing import Dict, List, NamedTuple, Optional, Set, Tuple
 
 from ..accounting import efficiency as eff_mod
 from ..accounting import planner as planner_mod
+from ..audit import AuditConfig, FleetAuditor
 from ..accounting.forecast import ForecastConfig
 from ..accounting.ledger import UsageLedger, decode_usage
 from ..accounting.planner import CapacityTracker
@@ -388,6 +389,29 @@ class Scheduler:
         # on the rebuild count staying flat through the storm.
         self.usage_rebuilds = 0
         self.usage_writethroughs = 0
+        # Decision writes that exhausted their path's retries and failed
+        # (the pod's tentative grant was rolled back and it requeued),
+        # by low-cardinality reason — vtpu_decision_write_failures_total.
+        # Previously log-only; a fleet whose decisions silently stop
+        # landing looks healthy from every other counter.
+        self.decision_write_failures: Dict[str, int] = {}
+        self._dwf_lock = threading.Lock()
+        # Fleet truth auditor (audit/; docs/observability.md "Fleet
+        # audit"): continuous cross-plane invariant verification on the
+        # same injected clock as every other time-gated subsystem.  The
+        # background sweep thread is started by the daemon entrypoint;
+        # embedders/tests/the simulator call auditor.sweep() directly —
+        # the rescuer/admission shape.
+        self.auditor = FleetAuditor(
+            self,
+            AuditConfig(
+                enabled=self.cfg.audit_enabled,
+                interval_s=self.cfg.audit_interval_s,
+                full_sweep_every=self.cfg.audit_full_sweep_every,
+                usage_stale_s=self.cfg.audit_usage_stale_s,
+                reservation_grace_s=self.cfg.audit_reservation_grace_s,
+                max_findings=self.cfg.audit_max_findings),
+            clock=clock)
 
     def _del_pod_wt(self, uid: str) -> None:
         """Drop a grant AND write its release through the usage cache +
@@ -1580,6 +1604,17 @@ class Scheduler:
             self._del_pod_wt(uid)
             tr.event(uid, "decision-write-failed",
                      trace_id=tid, error=err)
+            # Count by low-cardinality reason for the exporter
+            # (vtpu_decision_write_failures_total{reason}): the shard
+            # paths carry their fence/CAS token prefix, everything else
+            # is a transport failure.  Shared by the single AND bulk
+            # epilogues — a chunked write that exhausts its retries is
+            # no longer log-only.
+            reason = err.split(":", 1)[0].strip() \
+                if err.startswith("shard-") else "transport"
+            with self._dwf_lock:
+                self.decision_write_failures[reason] = \
+                    self.decision_write_failures.get(reason, 0) + 1
             # The write did not land: stop advertising the grant
             # (a peer may still place the pod on that node, and
             # THAT grant must be seedable) and record the failure
@@ -1771,6 +1806,14 @@ class Scheduler:
                         "retired_pods_total":
                             self.provenance.retired_pods_total}
         return doc
+
+    def export_audit(self, limit: int = 64,
+                     type_filter: Optional[str] = None) -> dict:
+        """Fleet-audit findings (``GET /auditz`` → ``vtpu-audit`` /
+        ``vtpu-report``): open findings by type with lifecycle, recent
+        auto-clears, sweep stats.  Reads only the finding store's own
+        lock — never a scheduler lock."""
+        return self.auditor.export(limit=limit, type_filter=type_filter)
 
     def _note_slice_rejection(self, pod: dict,
                               result: "FilterResult") -> None:
@@ -2365,6 +2408,7 @@ class Scheduler:
         self.admission.stop()
         self.defrag.stop()
         self.shards.stop()
+        self.auditor.stop()
         # Folds whatever is pending and stops the folder thread; the
         # store stays readable (post-mortem explains are the point).
         self.provenance.close()

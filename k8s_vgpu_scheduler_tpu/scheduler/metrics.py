@@ -619,6 +619,80 @@ class ClusterCollector(Collector):
             labels=["podnamespace", "podname"],
         )
 
+        # Fleet truth auditor (audit/; docs/observability.md "Fleet
+        # audit").  Families always emitted — the findings gauge carries
+        # the FULL taxonomy zero-valued when clean, so the alert can
+        # page on any type appearing without referencing a vanishing
+        # series.  Guarded getattr: collector test stubs predate the
+        # audit surface.
+        audit_findings = GaugeMetricFamily(
+            "vtpu_audit_findings",
+            "Open cross-plane audit findings by type (the fleet truth "
+            "auditor's live disagreements between grant registry, "
+            "decision-annotation WAL, snapshot/columnar views, shim-"
+            "region usage reports and the quota/reservation ledgers; "
+            "0 everywhere = the five planes agree — see GET /auditz "
+            "and vtpu-audit for subjects and lifecycle)",
+            labels=["type"],
+        )
+        audit_sweeps = CounterMetricFamily(
+            "vtpu_audit_sweeps",
+            "Audit sweeps run, by mode (delta = dirty nodes only, "
+            "cost tracks churn; full = whole fleet + kube/ledger/"
+            "quota/reservation planes, the bounded-rate backstop)",
+            labels=["mode"],
+        )
+        audit_sweep_s = GaugeMetricFamily(
+            "vtpu_audit_sweep_seconds",
+            "Wall-clock cost of the most recent audit sweep (the "
+            "audit-sweep phase of vtpu_cycle_phase_seconds carries "
+            "the distribution; delta sweeps should stay near zero on "
+            "a quiet fleet)",
+        )
+        audit_last_clean = GaugeMetricFamily(
+            "vtpu_audit_last_clean_timestamp",
+            "Unix time of the last audit sweep that ended with ZERO "
+            "open findings (0 = never since boot; time() minus this "
+            "growing while vtpu_audit_findings is nonzero is the "
+            "VtpuAuditFindingPersistent signal)",
+        )
+        auditor = getattr(self.scheduler, "auditor", None)
+        if auditor is not None:
+            for type_, n in sorted(
+                    auditor.store.open_by_type().items()):
+                audit_findings.add_metric([type_], n)
+            audit_sweeps.add_metric(
+                ["full"], auditor.full_sweeps_total)
+            audit_sweeps.add_metric(
+                ["delta"],
+                auditor.sweeps_total - auditor.full_sweeps_total)
+            audit_sweep_s.add_metric([], auditor.last_sweep_s)
+            audit_last_clean.add_metric([], auditor.last_clean_wall)
+        else:
+            audit_sweeps.add_metric(["full"], 0)
+            audit_sweeps.add_metric(["delta"], 0)
+            audit_sweep_s.add_metric([], 0.0)
+            audit_last_clean.add_metric([], 0.0)
+
+        # Decision writes that exhausted their path's retries and
+        # rolled the tentative grant back (previously log-only — a
+        # fleet whose decisions silently stop landing looked healthy
+        # from every other counter).
+        dwf = CounterMetricFamily(
+            "vtpu_decision_write_failures",
+            "Decision-annotation writes that failed after their path's "
+            "retries, by reason (transport: the apiserver write itself "
+            "failed — batched AND single paths; shard-fence / "
+            "shard-cas: the sharded commit failed closed; every one "
+            "rolled its tentative grant back and requeued the pod)",
+            labels=["reason"],
+        )
+        failures = getattr(self.scheduler, "decision_write_failures",
+                           None) or {}
+        for reason in sorted(set(failures)
+                             | {"transport", "shard-cas", "shard-fence"}):
+            dwf.add_metric([reason], failures.get(reason, 0))
+
         fleet = self.scheduler.grant_efficiency()
         by_uid = {p.uid: p for p in fleet.pods}
         qos_by_class: Dict[str, tuple] = {}
@@ -693,7 +767,9 @@ class ClusterCollector(Collector):
                 defrag_aborted, shard_epoch, shards_owned,
                 shards_orphaned, shard_rebalances, cas_failures,
                 cap_demand, cap_forecast, cap_upper, cap_eta, cap_err,
-                cap_nodes_cur, cap_nodes_rec, series_age,
+                cap_nodes_cur, cap_nodes_rec,
+                audit_findings, audit_sweeps, audit_sweep_s,
+                audit_last_clean, dwf, series_age,
                 u_chip, u_hbm, eff_ratio, idle_grants,
                 qos_wait_family(qos_by_class),
                 pod_qos_weight] + list(phase_metrics())

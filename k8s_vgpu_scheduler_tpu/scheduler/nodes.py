@@ -47,6 +47,9 @@ class NodeManager:
         # Nodes whose inventory changed since the last drain_dirty()
         # (same incremental-snapshot contract as PodManager._dirty).
         self._dirty: Set[str] = set()
+        # The auditor's own change feed (same second-subscriber shape
+        # as PodManager._dirty_audit; bounded by fleet size).
+        self._dirty_audit: Set[str] = set()
         # Fleet-wide registered chips, maintained incrementally — the
         # admission tick's fleet-throttle read without copying the node
         # map and re-summing 10k device lists per tick (ISSUE 12).
@@ -61,6 +64,7 @@ class NodeManager:
         with self._lock:
             self._rev[name] = self._rev.get(name, 0) + 1
             self._dirty.add(name)
+            self._dirty_audit.add(name)
             existing = self._nodes.get(name)
             if existing is None or not existing.devices:
                 self._total_chips += len(info.devices) - (
@@ -101,6 +105,7 @@ class NodeManager:
         with self._lock:
             self._rev[name] = self._rev.get(name, 0) + 1
             self._dirty.add(name)
+            self._dirty_audit.add(name)
 
     def rm_node(self, name: str) -> None:
         """Node agent stream broke → its inventory is no longer trustworthy
@@ -108,6 +113,7 @@ class NodeManager:
         with self._lock:
             self._rev[name] = self._rev.get(name, 0) + 1
             self._dirty.add(name)
+            self._dirty_audit.add(name)
             dropped = self._nodes.pop(name, None)
             if dropped is not None:
                 self._total_chips -= len(dropped.devices)
@@ -128,6 +134,12 @@ class NodeManager:
     def mark_dirty(self, names: Iterable[str]) -> None:
         with self._lock:
             self._dirty.update(names)
+
+    def drain_audit_dirty(self) -> Set[str]:
+        """The auditor's return-and-clear (see PodManager)."""
+        with self._lock:
+            dirty, self._dirty_audit = self._dirty_audit, set()
+            return dirty
 
     def get_node(self, name: str) -> Optional[NodeInfo]:
         # Lock-free single dict read (see PodManager.get).

@@ -64,6 +64,12 @@ class PodManager:
         # incrementally from this instead of re-scanning every node's rev
         # per decision (docs/scheduler-concurrency.md).
         self._dirty: Set[str] = set()
+        # Second subscriber on the same change feed: nodes whose pod set
+        # changed since the AUDITOR's last sweep (audit/auditor.py).
+        # The snapshot's drain is destructive, so the auditor keeps its
+        # own set; bounded by fleet size (node names, never per-event
+        # entries), so an idle auditor costs one set.add per bump.
+        self._dirty_audit: Set[str] = set()
         # Incremental chip accounting: fleet-total granted chips and
         # per-namespace (chips, mem_mib) sums, maintained on every
         # add/refresh/delete.  The quota admission tick reads these
@@ -76,6 +82,7 @@ class PodManager:
     def _bump(self, node: str) -> None:
         self._rev[node] = self._rev.get(node, 0) + 1
         self._dirty.add(node)
+        self._dirty_audit.add(node)
 
     def _charge(self, info: PodInfo, sign: int) -> None:
         chips = mem = 0
@@ -291,3 +298,11 @@ class PodManager:
         mid-refresh returns what it could not process)."""
         with self._lock:
             self._dirty.update(nodes)
+
+    def drain_audit_dirty(self) -> Set[str]:
+        """Return-and-clear the auditor's view of the change feed
+        (audit/auditor.py delta sweeps; independent of the snapshot's
+        drain so neither consumer can starve the other)."""
+        with self._lock:
+            dirty, self._dirty_audit = self._dirty_audit, set()
+            return dirty

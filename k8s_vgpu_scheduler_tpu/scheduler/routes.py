@@ -31,6 +31,17 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
                     quota, shard gates, filter verdicts, solver audit,
                     commit and eviction — for ``vtpu-explain`` and
                     ``vtpu-report --explain``
+- ``GET  /auditz``  fleet truth auditor: open cross-plane findings by
+                    type with first-seen/last-seen lifecycle, recent
+                    auto-clears, sweep stats (``?type=<finding-type>``
+                    filters, ``?limit=<n>`` sizes the list) — for
+                    ``vtpu-audit`` and ``vtpu-report``; 404 carrying
+                    ``enabled: false`` under --no-audit
+
+Shared endpoint semantics (pinned by tests/test_debug_endpoints.py):
+bad query parameters return 400 with a JSON error body, a disabled
+subsystem's 404 carries ``enabled: false``, and every response is
+JSON-serializable with ``allow_nan=False``.
 """
 
 from __future__ import annotations
@@ -193,16 +204,59 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:  # noqa: BLE001 — 500, not a hangup
                 log.exception("capacityz export failed")
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        elif self.path.startswith("/auditz"):
+            # Fleet truth auditor (audit/auditor.py): open cross-plane
+            # findings with lifecycle, the vtpu-audit surface.
+            from urllib.parse import parse_qsl, urlsplit
+
+            from ..audit import FINDING_TYPES
+
+            query = dict(parse_qsl(urlsplit(self.path).query))
+            try:
+                limit = int(query.get("limit", "64"))
+                if not 1 <= limit <= 1024:
+                    raise ValueError(f"out of range [1, 1024]: {limit}")
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad limit: {e}"})
+                return
+            type_filter = query.get("type") or None
+            if type_filter is not None \
+                    and type_filter not in FINDING_TYPES:
+                self._reply(400, {
+                    "error": f"unknown finding type {type_filter!r}",
+                    "known_types": list(FINDING_TYPES)})
+                return
+            if not self.scheduler.auditor.enabled:
+                self._reply(404, {"error": "fleet audit disabled "
+                                           "(--no-audit)",
+                                  "enabled": False})
+                return
+            try:
+                self._reply(200, self.scheduler.export_audit(
+                    limit=limit, type_filter=type_filter))
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("auditz export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         elif self.path.startswith("/usagez"):
             # Per-namespace showback over a trailing window (accounting/
             # efficiency.py) for the vtpu-report CLI; ?window=<seconds>
             # overrides the configured efficiency window.
             from urllib.parse import parse_qsl, urlsplit
 
+            import math
+
             query = dict(parse_qsl(urlsplit(self.path).query))
             try:
                 window = (float(query["window"])
                           if "window" in query else None)
+                # float() accepts nan/inf, which would flow into the
+                # showback math (and break the JSON contract — the
+                # endpoint pin requires allow_nan=False clean bodies);
+                # the contract is 400 on bad input.
+                if window is not None and (
+                        not math.isfinite(window) or window <= 0):
+                    raise ValueError(f"not a positive finite number: "
+                                     f"{query['window']!r}")
             except (ValueError, TypeError) as e:
                 self._reply(400, {"error": f"bad window: {e}"})
                 return

@@ -263,6 +263,25 @@ class Config:
     # apiserver write).
     explain_event_throttle_s: float = 300.0
 
+    # Fleet truth auditor (audit/; docs/observability.md "Fleet
+    # audit").  On by default — delta sweeps re-verify only churned
+    # nodes (cost tracks churn, not fleet size) with a bounded-rate
+    # full cross-plane pass as backstop; findings land on GET /auditz,
+    # vtpu-audit and the vtpu_audit_* metrics.  --no-audit is the
+    # escape hatch and the overhead A/B's baseline leg.
+    audit_enabled: bool = True
+    # Background sweep period (every Nth sweep is the full pass).
+    audit_interval_s: float = 30.0
+    audit_full_sweep_every: int = 8
+    # A live grant whose usage series went silent this long while its
+    # node keeps reporting others is a usage-report-missing finding
+    # (and the freshness bound for orphaned-region-slot findings).
+    audit_usage_stale_s: float = 120.0
+    # Reservations younger than this are never leak candidates.
+    audit_reservation_grace_s: float = 60.0
+    # Open-findings cap (past it, findings are counted, not stored).
+    audit_max_findings: int = 1024
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

@@ -1,0 +1,404 @@
+"""Fleet truth auditor units (audit/; ISSUE 15): finding-store
+lifecycle, delta-sweep mechanics on the audit-side dirty sets,
+per-plane detection against seeded corruption, the zero-false-positive
+discipline, the exporter families, and the decision-write-failure
+counter satellite."""
+
+from __future__ import annotations
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.audit import FINDING_TYPES, chaos
+from k8s_vgpu_scheduler_tpu.audit.findings import FindingStore
+from k8s_vgpu_scheduler_tpu.cmd.simulate import build_fleet, spec_pod
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+
+def _fleet(nodes=4, chips=4, hbm=2000, shard=False, **cfg_kw):
+    clock = SimClock()
+    kube = FakeKube()
+    kw = dict(cfg_kw)
+    if shard:
+        kw.update(shard_replica="replica-0", shard_ttl_s=10.0)
+    s = Scheduler(kube, Config(**kw), clock=clock)
+    names = build_fleet(s, kube, nodes, chips, hbm, (2, 2), "v5e")
+    kube.watch_pods(s.on_pod_event)
+    if shard:
+        for _ in range(3):
+            s.shards.tick()
+            clock.advance(1.0)
+    return s, kube, names, clock
+
+
+def _place(s, kube, names, count, mem=2000, prefix="t"):
+    pods = [spec_pod({"name": prefix, "tpu": 1, "tpumem": mem}, i)
+            for i in range(count)]
+    for p in pods:
+        kube.create_pod(p)
+    results = s.filter_many([(p, names) for p in pods])
+    placed = [p for p, r in zip(pods, results) if r.node]
+    assert placed, [r.error for r in results]
+    return placed
+
+
+class TestFindingStore:
+    def test_lifecycle_open_refresh_clear(self):
+        st = FindingStore()
+        key = ("double-booking", "n/chip-0")
+        obs = {key: {"scope": "n", "detail": {"x": 1}}}
+        opened, cleared = st.reconcile(obs, lambda f: True, now=10.0)
+        assert (opened, cleared) == (1, 0)
+        # Re-observed: refreshed in place, not duplicated.
+        st.reconcile({key: {"scope": "n", "detail": {"x": 2}}},
+                     lambda f: True, now=20.0)
+        assert st.open_count() == 1
+        row = st.open_list(now=25.0)[0]
+        assert row["sweeps_seen"] == 2
+        assert row["detail"] == {"x": 2}
+        assert row["first_seen_age_s"] == 15.0
+        assert row["last_seen_age_s"] == 5.0
+        # Not reproduced while covered: auto-clears into the ring.
+        opened, cleared = st.reconcile({}, lambda f: True, now=30.0)
+        assert (opened, cleared) == (0, 1)
+        assert st.open_count() == 0
+        assert st.cleared_list(now=31.0)[0]["cleared_age_s"] == 1.0
+
+    def test_uncovered_findings_never_clear(self):
+        st = FindingStore()
+        key = ("phantom-grant", "uid-1")
+        st.reconcile({key: {"scope": "", "detail": {}}},
+                     lambda f: True, now=0.0)
+        # A delta sweep that did not cover the global scope must not
+        # clear the finding just because it saw nothing.
+        st.reconcile({}, lambda f: False, now=1.0)
+        assert st.open_count() == 1
+
+    def test_cap_counts_drops(self):
+        st = FindingStore(max_open=2)
+        obs = {("double-booking", f"n/c{i}"): {"scope": "n",
+                                               "detail": {}}
+               for i in range(5)}
+        st.reconcile(obs, lambda f: True, now=0.0)
+        assert st.open_count() == 2
+        assert st.dropped_total == 3
+
+    def test_open_by_type_carries_full_taxonomy(self):
+        st = FindingStore()
+        counts = st.open_by_type()
+        assert set(counts) == set(FINDING_TYPES)
+        assert all(n == 0 for n in counts.values())
+
+
+class TestDeltaSweeps:
+    def test_audit_dirty_set_is_independent_of_snapshot_drain(self):
+        s, kube, names, _clock = _fleet()
+        _place(s, kube, names, 4)
+        # The snapshot's own drain must not starve the auditor's.
+        s.snapshot()
+        rep = s.auditor.sweep(full=False)
+        assert rep["nodes_checked"] > 0
+        # And a quiet fleet's next delta sweep checks nothing.
+        rep = s.auditor.sweep(full=False)
+        assert rep["nodes_checked"] == 0
+        assert rep["open"] == 0
+        s.close()
+
+    def test_delta_sweep_detects_registry_overbooking(self):
+        s, kube, names, _clock = _fleet()
+        placed = _place(s, kube, names, 2)
+        uid = placed[0]["metadata"]["uid"]
+        # Settle, then inject: the forged duplicate dirties its node,
+        # so the DELTA sweep alone must find it.
+        s.auditor.sweep(full=False)
+        revert = chaos.double_grant(s, kube, uid, "clone")
+        rep = s.auditor.sweep(full=False)
+        assert rep["open"] == 1
+        assert s.auditor.store.has_open("double-booking")
+        revert()
+        rep = s.auditor.sweep(full=False)
+        assert rep["open"] == 0, s.export_audit()
+        s.close()
+
+    def test_wal_only_overbooking_survives_delta_sweeps(self):
+        """Review regression: a WAL-plane-only double-booking (the
+        registry missed the event) must be GLOBAL scope — node churn
+        between full sweeps must not let a delta sweep spuriously
+        auto-clear it (a flapping finding never trips the persistent
+        alert's `for:` window)."""
+        from k8s_vgpu_scheduler_tpu.util import codec
+        from k8s_vgpu_scheduler_tpu.util.types import (
+            ASSIGNED_IDS_ANNOTATION, ASSIGNED_NODE_ANNOTATION)
+
+        s, kube, names, _clock = _fleet()
+        placed = _place(s, kube, names, 2)
+        victim = s.pods.get(placed[0]["metadata"]["uid"])
+        # The clone lands ONLY on the WAL: the informer is detached,
+        # so the registry never mirrors it (the lost-event corruption).
+        kube.unwatch_pods(s.on_pod_event)
+        kube.create_pod({
+            "metadata": {"name": "wal-clone", "namespace": "sim",
+                         "uid": "uid-wal-clone", "annotations": {
+                             ASSIGNED_NODE_ANNOTATION: victim.node,
+                             ASSIGNED_IDS_ANNOTATION:
+                                 codec.encode_pod_devices(
+                                     victim.devices)}},
+            "spec": {"containers": [{"name": "main", "resources": {
+                "limits": {"google.com/tpu": "1"}}}]}})
+        with kube._lock:
+            kube._pod_watchers.append(s.on_pod_event)
+        s.auditor.sweep(full=True)
+        assert s.auditor.store.has_open("double-booking")
+        # Churn the victim's node so a DELTA sweep covers it: the
+        # WAL-only finding must survive (only a full sweep re-reads
+        # the annotation plane).
+        s.pods._dirty_audit.add(victim.node)
+        s.auditor.sweep(full=False)
+        assert s.auditor.store.has_open("double-booking"), \
+            "delta sweep spuriously cleared a WAL-only finding"
+        kube.delete_pod("sim", "wal-clone")
+        assert s.auditor.sweep(full=True)["open"] == 0
+        s.close()
+
+    def test_snapshot_divergence_requires_matching_revs(self):
+        """A cache entry at an OLD key is a pending rebuild (the
+        protocol working), never a finding."""
+        s, kube, names, _clock = _fleet()
+        placed = _place(s, kube, names, 2)
+        s.snapshot()
+        node = s.pods.get(placed[0]["metadata"]["uid"]).node
+        with s._usage_cache_lock:
+            key, usage = s._usage_cache[node]
+            # Age the key: the content now "disagrees" with live revs,
+            # which must read as stale-cache, not corruption.
+            s._usage_cache[node] = ((key[0] - 1, key[1]), usage)
+        rep = s.auditor.sweep(full=False)
+        assert rep["open"] == 0
+        s.close()
+
+    def test_clean_sweep_stamps_last_clean(self):
+        s, kube, names, clock = _fleet()
+        _place(s, kube, names, 2)
+        clock.advance(5.0)
+        s.auditor.sweep(full=True)
+        doc = s.export_audit()
+        assert doc["sweeps"]["last_clean_age_s"] == 0.0
+        assert s.auditor.last_clean_wall > 0
+        s.close()
+
+
+class TestCrossPlaneChecks:
+    def test_phantom_grant_and_annotation_mismatch(self):
+        s, kube, names, _clock = _fleet()
+        placed = _place(s, kube, names, 2)
+        revert = chaos.phantom_grant(s, names[-1],
+                                     f"{names[-1]}-chip-3")
+        s.auditor.sweep(full=True)
+        assert s.auditor.store.has_open("phantom-grant",
+                                        "uid-audit-phantom")
+        revert()
+        assert s.auditor.sweep(full=True)["open"] == 0
+        wrong = next(n for n in names
+                     if n != s.pods.get(
+                         placed[0]["metadata"]["uid"]).node)
+        revert = chaos.forge_annotation(
+            s, kube, "sim", placed[0]["metadata"]["name"], wrong)
+        s.auditor.sweep(full=True)
+        assert s.auditor.store.has_open("annotation-mismatch")
+        revert()
+        assert s.auditor.sweep(full=True)["open"] == 0
+        s.close()
+
+    def test_split_brain_needs_current_epoch(self):
+        """A peer-stamped decision at an OLDER epoch is an adoption
+        replay, not split-brain."""
+        s, kube, names, _clock = _fleet(shard=True)
+        placed = _place(s, kube, names, 2)
+        name = placed[0]["metadata"]["name"]
+        revert = chaos.forge_shard_owner(s, kube, "sim", name)
+        s.auditor.sweep(full=True)
+        assert s.auditor.store.has_open("split-brain-shard")
+        revert()
+        assert s.auditor.sweep(full=True)["open"] == 0
+        # Same forged owner, epoch stamped BELOW current: no finding.
+        from k8s_vgpu_scheduler_tpu.shard.commit import (
+            SHARD_EPOCH_ANNOTATION, SHARD_OWNER_ANNOTATION)
+        kube.patch_pod_annotations("sim", name, {
+            SHARD_OWNER_ANNOTATION: "replica-ghost",
+            SHARD_EPOCH_ANNOTATION: str(s.shards.epoch() - 1)})
+        assert s.auditor.sweep(full=True)["open"] == 0
+        s.close()
+
+    def test_quota_over_admission(self):
+        s, kube, names, _clock = _fleet()
+        _place(s, kube, names, 1)
+        s.quota = SimpleNamespace(
+            enabled=True,
+            stats=lambda pods: {"queues": [
+                {"queue": "team-a", "nominal_chips": 2,
+                 "borrow_limit_chips": 1, "held_chips": 5}]})
+        s.auditor.sweep(full=True)
+        assert s.auditor.store.has_open("quota-over-admission",
+                                        "team-a")
+        s.quota.stats = lambda pods: {"queues": [
+            {"queue": "team-a", "nominal_chips": 2,
+             "borrow_limit_chips": 1, "held_chips": 3}]}
+        assert s.auditor.sweep(full=True)["open"] == 0
+        s.close()
+
+    def test_reservation_leak_respects_grace_and_demand(self):
+        s, kube, names, clock = _fleet()
+        _place(s, kube, names, 1)
+        revert = chaos.leak_reservation(s, names[0],
+                                        [f"{names[0]}-chip-1"])
+        # Inside the grace: not a leak yet.
+        assert s.auditor.sweep(full=True)["open"] == 0
+        clock.advance(s.auditor.cfg.reservation_grace_s + 1.0)
+        s.auditor.sweep(full=True)
+        assert s.auditor.store.has_open("reservation-leak")
+        revert()
+        assert s.auditor.sweep(full=True)["open"] == 0
+        s.close()
+
+    def test_auditor_disabled_is_inert(self):
+        s, kube, names, _clock = _fleet(audit_enabled=False)
+        _place(s, kube, names, 2)
+        assert s.auditor.sweep() == {"enabled": False}
+        assert s.export_audit()["enabled"] is False
+        s.close()
+
+
+class TestExporter:
+    def _exposition(self, s) -> str:
+        from prometheus_client import CollectorRegistry, generate_latest
+
+        from k8s_vgpu_scheduler_tpu.scheduler.metrics import (
+            ClusterCollector)
+
+        reg = CollectorRegistry()
+        reg.register(ClusterCollector(s))
+        return generate_latest(reg).decode()
+
+    def test_audit_families_emitted_with_full_taxonomy(self):
+        s, kube, names, _clock = _fleet()
+        _place(s, kube, names, 2)
+        s.auditor.sweep(full=True)
+        text = self._exposition(s)
+        for t in FINDING_TYPES:
+            assert f'vtpu_audit_findings{{type="{t}"}} 0.0' in text, t
+        assert 'vtpu_audit_sweeps_total{mode="full"} 1.0' in text
+        assert "vtpu_audit_sweep_seconds" in text
+        assert "vtpu_audit_last_clean_timestamp" in text
+        # One open finding moves exactly its type's gauge.
+        revert = chaos.phantom_grant(s, names[-1],
+                                     f"{names[-1]}-chip-3")
+        s.auditor.sweep(full=True)
+        text = self._exposition(s)
+        assert 'vtpu_audit_findings{type="phantom-grant"} 1.0' in text
+        revert()
+        s.close()
+
+    def test_decision_write_failures_counter(self):
+        """Satellite: a decision write that exhausts its path's
+        retries lands in vtpu_decision_write_failures_total{reason},
+        not just a log line — on the BULK path too."""
+
+        class FailingKube(FakeKube):
+            fail = False
+
+            def patch_pod_annotations(self, *a, **kw):
+                if self.fail:
+                    raise RuntimeError("injected transport failure")
+                return super().patch_pod_annotations(*a, **kw)
+
+            def patch_pod_annotations_many(self, patches):
+                if self.fail:
+                    return [RuntimeError("injected transport failure")
+                            ] * len(patches)
+                return super().patch_pod_annotations_many(patches)
+
+        clock = SimClock()
+        kube = FailingKube()
+        s = Scheduler(kube, Config(), clock=clock)
+        names = build_fleet(s, kube, 2, 4, 2000, (2, 2), "v5e")
+        kube.watch_pods(s.on_pod_event)
+        pods = [spec_pod({"name": "w", "tpu": 1, "tpumem": 500}, i)
+                for i in range(4)]
+        for p in pods:
+            kube.create_pod(p)
+        kube.fail = True
+        results = s.filter_many([(p, names) for p in pods])
+        assert all(r.node is None and r.error for r in results)
+        assert s.decision_write_failures.get("transport", 0) == 4
+        # Tentative grants rolled back — nothing phantom left behind.
+        assert all(s.pods.get(p["metadata"]["uid"]) is None
+                   for p in pods)
+        # The BULK epilogue emits the decision-write-failed provenance
+        # record too (the explain timeline must narrate the bounce,
+        # not just the logs).
+        doc = s.export_explain(pods[0]["metadata"]["uid"])
+        assert any(r["stage"] == "decision-write-failed"
+                   for r in doc["records"]), doc["records"]
+        text = self._exposition(s)
+        assert ('vtpu_decision_write_failures_total'
+                '{reason="transport"} 4.0') in text
+        # Zero-valued reason series exist for dashboards either way.
+        assert ('vtpu_decision_write_failures_total'
+                '{reason="shard-cas"} 0.0') in text
+        kube.fail = False
+        s.close()
+
+
+class TestCliSurfaces:
+    def test_vtpu_audit_render_and_exit_codes(self):
+        from k8s_vgpu_scheduler_tpu.cmd import vtpu_audit
+
+        s, kube, names, _clock = _fleet()
+        _place(s, kube, names, 2)
+        revert = chaos.phantom_grant(s, names[-1],
+                                     f"{names[-1]}-chip-3")
+        s.auditor.sweep(full=True)
+        doc = s.export_audit()
+        text = vtpu_audit.render(doc)
+        assert "phantom-grant" in text
+        assert "1 open finding(s)" in text
+        revert()
+        s.auditor.sweep(full=True)
+        clean = vtpu_audit.render(s.export_audit())
+        assert "0 open finding(s)" in clean
+        assert "recently auto-cleared" in clean
+        s.close()
+
+    def test_vtpu_report_audit_section_degrades_gracefully(self):
+        """Satellite: vtpu-report's audit section mirrors the
+        --explain/capacity join pattern — a pre-audit scheduler (no
+        /auditz) renders '-', never an exception or a silent 'clean'."""
+        from k8s_vgpu_scheduler_tpu.cmd.vtpu_report import format_audit
+
+        assert format_audit(None).startswith("+ audit: -")
+        s, kube, names, _clock = _fleet()
+        _place(s, kube, names, 2)
+        s.auditor.sweep(full=True)
+        line = format_audit(s.export_audit())
+        assert line.startswith("+ audit: clean")
+        revert = chaos.phantom_grant(s, names[-1],
+                                     f"{names[-1]}-chip-3")
+        s.auditor.sweep(full=True)
+        section = format_audit(s.export_audit())
+        assert "OPEN finding(s)" in section
+        assert "phantom-grant" in section
+        revert()
+        s.close()
+
+
+def test_auditz_export_is_strict_json():
+    s, kube, names, _clock = _fleet()
+    _place(s, kube, names, 2)
+    s.auditor.sweep(full=True)
+    json.dumps(s.export_audit(), allow_nan=False)
+    s.close()

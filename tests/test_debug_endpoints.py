@@ -1,0 +1,124 @@
+"""Shared-semantics pin across every debug/ops endpoint (ISSUE 15
+satellite): one parametrized suite asserting the contract
+docs/observability.md promises for ALL of them —
+
+- bad query parameters return 400 with a JSON error body (never a 500
+  from deep inside an export);
+- a disabled subsystem's 404 carries ``enabled: false`` (so CLIs can
+  distinguish "off" from "wrong URL");
+- every response body is JSON-serializable under ``json.dumps`` with
+  ``allow_nan=False`` (a NaN/Inf leaking into an export breaks every
+  strict JSON consumer downstream — Grafana JSON datasources included).
+
+An endpoint added without riding this suite is exactly the drift this
+pin exists to catch."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler.routes import ExtenderServer
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+
+def _get(base: str, path: str):
+    try:
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = Scheduler(FakeKube(), Config())
+    srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        yield f"http://127.0.0.1:{srv.port}", s
+    finally:
+        srv.stop()
+        s.close()
+
+
+#: (name, good request, expected statuses for it, bad request or None).
+#: A 404 in the good-status set means "valid request whose subject is
+#: absent/disabled" — those bodies must carry the ``enabled`` flag.
+ENDPOINTS = [
+    ("perfz", "/perfz?ticks=4", {200}, "/perfz?ticks=nope"),
+    ("capacityz", "/capacityz", {200}, "/capacityz?horizon=nan"),
+    ("capacityz-neg", "/capacityz", {200}, "/capacityz?horizon=-5"),
+    ("usagez", "/usagez", {200}, "/usagez?window=abc"),
+    ("usagez-nan", "/usagez?window=60", {200}, "/usagez?window=nan"),
+    ("queuez", "/queuez", {200}, None),
+    ("fleetz", "/fleetz", {200}, None),
+    ("auditz", "/auditz?type=double-booking&limit=8", {200},
+     "/auditz?limit=zzz"),
+    ("auditz-type", "/auditz", {200}, "/auditz?type=bogus"),
+    ("explainz", "/explainz?pod=sim/never-seen", {404}, "/explainz"),
+]
+
+
+@pytest.mark.parametrize("name,good,statuses,bad", ENDPOINTS,
+                         ids=[e[0] for e in ENDPOINTS])
+def test_good_request_is_strict_json(server, name, good, statuses, bad):
+    base, _s = server
+    code, body = _get(base, good)
+    assert code in statuses, (good, code, body[:200])
+    doc = json.loads(body)
+    # The strict-JSON contract: re-serialization with allow_nan=False
+    # must not raise — no NaN/Inf anywhere in any export.
+    json.dumps(doc, allow_nan=False)
+    if code == 404:
+        assert "enabled" in doc, doc
+
+
+@pytest.mark.parametrize("name,good,statuses,bad",
+                         [e for e in ENDPOINTS if e[3] is not None],
+                         ids=[e[0] for e in ENDPOINTS if e[3] is not None])
+def test_bad_params_return_400_json(server, name, good, statuses, bad):
+    base, _s = server
+    code, body = _get(base, bad)
+    assert code == 400, (bad, code, body[:200])
+    doc = json.loads(body)
+    assert "error" in doc and doc["error"], doc
+    json.dumps(doc, allow_nan=False)
+
+
+def test_disabled_subsystem_404_carries_enabled_false():
+    """--no-audit and an unknown /explainz pod both answer 404 with an
+    ``enabled`` flag a CLI can branch on."""
+    s = Scheduler(FakeKube(), Config(audit_enabled=False,
+                                     provenance_enabled=False))
+    srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(base, "/auditz")
+        assert code == 404, (code, body[:200])
+        doc = json.loads(body)
+        assert doc["enabled"] is False
+        json.dumps(doc, allow_nan=False)
+        code, body = _get(base, "/explainz?pod=sim/x")
+        assert code == 404
+        assert json.loads(body)["enabled"] is False
+    finally:
+        srv.stop()
+        s.close()
+
+
+def test_queuez_without_quota_reports_enabled_false(server):
+    """/queuez predates the 404 convention (its empty state is a valid
+    200 the report CLI renders); the pinned part is that the body says
+    ``enabled: false`` so nobody mistakes 'no quota layer' for 'no
+    queues held'."""
+    base, _s = server
+    code, body = _get(base, "/queuez")
+    assert code == 200
+    assert json.loads(body)["enabled"] is False

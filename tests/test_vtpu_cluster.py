@@ -221,10 +221,12 @@ def test_grafana_dashboard_uses_real_metric_names():
     referenced = set()
     for e in exprs:
         referenced.update(re.findall(r"[a-z][a-z0-9_]{3,}", e))
-    # promql functions + aggregation labels, not metrics
+    # promql functions + aggregation labels, not metrics ("time" is
+    # the time() function; "mode"/"type" are the audit families'
+    # aggregation labels)
     referenced -= {"rate", "label_values", "node", "histogram_quantile",
                    "phase", "reason", "clamp_min", "class", "queue",
-                   "lock", "generation"}
+                   "lock", "generation", "mode", "type", "time"}
 
     missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
@@ -345,9 +347,12 @@ def test_alert_rules_use_real_metric_names():
     # class label and its hyphenated "latency-critical" value, and the
     # perf phase label with its hyphenated "cycle-total" value
     # (VtpuSchedulerTickStall).
+    # ...plus the audit families' "type" aggregation label and the
+    # decision-write counter's reason label with its "transport" value
+    # (VtpuDecisionWriteFailures).
     referenced -= {"rate", "absent", "clamp_min", "min_over_time",
                    "vtpu", "monitor", "histogram_quantile", "sum",
                    "class", "latency", "critical", "phase", "cycle",
-                   "total"}
+                   "total", "type", "reason", "transport"}
     missing = referenced - _emitted_metrics()
     assert not missing, f"alerts reference unknown metrics: {missing}"
