@@ -3,9 +3,9 @@
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
         defrag-sim ha-sim qos-sim capacity-sim steady-sim explain-sim \
-        audit-sim batch-protocol shard-protocol lint-dashboards dryrun \
-        scenarios controlplane bench-controlplane bench-steady \
-        bench-explain bench wheel clean
+        audit-sim bench-multicore batch-protocol shard-protocol \
+        lint-dashboards dryrun scenarios controlplane \
+        bench-controlplane bench-steady bench-explain bench wheel clean
 
 all: native
 
@@ -103,6 +103,19 @@ capacity-sim:                 ## forecast + what-if capacity verdicts (simulator
 # the full-scale gate lives in `make bench-steady` → STEADY_<round>.json.
 steady-sim:                   ## sustained-storm invariants through a replica kill
 	python benchmarks/controlplane.py steady-ci
+
+# Multicore solve-worker smoke (ISSUE 17): a reduced-scale
+# bench_multicore — the seeded parity stream with --solve-workers 2 vs
+# 0, plus a 2-replica concurrent storm (replicas genuinely driven
+# simultaneously, solve workers mapping the shared columnar segments,
+# audit sweeps live at every wave) against the same storm drained
+# sequentially in-process.  Gates the DETERMINISTIC invariants only —
+# bit-identical decisions, zero audit findings, zero double-booked
+# chips, every pod placed, zero worker restarts — never timing ratios
+# (same CI-noise rule as steady-sim); the scaling/sustained gates live
+# in `python benchmarks/controlplane.py multicore` → STEADY_<round>.json.
+bench-multicore:              ## solve-worker bit-identity + audit smoke
+	python benchmarks/controlplane.py multicore-ci
 
 # Decision-provenance chaos verdict through the REAL sharded control
 # plane on the virtual clock (docs/observability.md "Decision

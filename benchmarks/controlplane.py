@@ -32,9 +32,11 @@ Run:  python benchmarks/controlplane.py        (≈30 s; no chip, no k8s)
 
 from __future__ import annotations
 
+import copy
 import json
 import math
 import os
+import random
 import sys
 import threading
 import time
@@ -335,18 +337,18 @@ def _sharded_run(n_replicas: int, n_nodes: int, n_pods: int,
     """One leg of the sharded A/B: drain ``n_pods`` through
     ``n_replicas`` active-active replicas over one fake apiserver.
 
-    Modeling note (and why this is honest): production replicas are
-    separate PROCESSES; in one CPython process, racing them on threads
-    would measure GIL convoys, not the protocol (the PR 2 lesson).  The
-    shards are disjoint by construction, so each replica drains its
-    partition on this thread, individually timed, and the aggregate is
-    total decisions / the SLOWEST replica's drain — the wall clock N
-    independent processes would see, with the cross-replica costs that
-    DO exist in one process (every replica's informer consumes every
-    other's decision events inline, and every sharded commit pays the
-    CAS) charged against the replica being timed.  The contention story
-    (two replicas racing one pod, fencing under epoch bumps) is proved
-    separately, in tests/test_shard.py and `make ha-sim`.
+    Modeling note: this leg drains each replica's partition on this
+    thread, individually timed, and reports total decisions / the
+    SLOWEST replica's drain — the wall clock N independent processes
+    would see.  It isolates the per-decision O(shard)-vs-O(fleet)
+    effect from single-process thread convoys.  The CONCURRENT
+    measurement — replicas genuinely driven simultaneously, solve
+    workers mapping the shared columnar segments, live audit sweeps —
+    is bench_multicore's `concurrent` leg (`python
+    benchmarks/controlplane.py multicore`), which supersedes the old
+    sequential-drain caveat.  The contention story (two replicas racing
+    one pod, fencing under epoch bumps) is proved separately, in
+    tests/test_shard.py and `make ha-sim`.
 
     1 replica = Config without shard_replica: the shard layer is inert
     and this leg IS the PR 6 batched path, unchanged."""
@@ -483,6 +485,444 @@ def bench_sharded(n_nodes: int = 10000, n_pods: int = 100000) -> dict:
                 quad["aggregate_decisions_per_s"]
                 / max(single["aggregate_decisions_per_s"], 0.1), 2),
         }
+    }
+
+
+def _grants_of(s, uid: str):
+    """The committed grant detail for one pod, as nested tuples (chip
+    uuid, resolved mem, cores per container) — the bit-identity legs
+    compare THESE, not just the chosen node."""
+    pe = s.pods.get(uid)
+    return tuple(tuple((d.uuid, d.usedmem, d.usedcores) for d in cont)
+                 for cont in pe.devices)
+
+
+def _open_findings(s) -> int:
+    return sum(s.auditor.store.open_by_type().values())
+
+
+def _multicore_parity(n_nodes: int, n_pods: int, chips: int = 4,
+                      workers: int = 2, seed: int = 1712) -> dict:
+    """Bit-identity leg of bench_multicore: the SAME seeded pod stream
+    (mixed mem/percentage/cores/multi-chip classes) through one batched
+    scheduler with --solve-workers 0 and again with --solve-workers N.
+    Every decision — node AND chips AND resolved mem/cores — must be
+    identical, the pool must actually have served evaluations (or the
+    leg proved nothing), and a full audit sweep after each run must
+    report zero findings."""
+    outs = {}
+    meta = {}
+    for w in (0, workers):
+        kube = FakeKube()
+        s = Scheduler(kube, Config(filter_batch=True, batch_max=256,
+                                   solve_workers=w))
+        names = [f"node-{i}" for i in range(n_nodes)]
+        for n in names:
+            kube.add_node({"metadata": {"name": n, "annotations": {}}})
+            register_node(s, n, chips=chips, mesh=(4, 1))
+        kube.watch_pods(s.on_pod_event)
+        from tests.test_scheduler_batch import random_pod_stream
+        pods = random_pod_stream(random.Random(seed), n_pods,
+                                 multi_ok=True)
+        for p in pods:
+            kube.create_pod(copy.deepcopy(p))
+        t0 = time.monotonic()
+        results = s.filter_many([(copy.deepcopy(p), names)
+                                 for p in pods])
+        elapsed = time.monotonic() - t0
+        decisions = []
+        for i, r in enumerate(results):
+            decisions.append((r.node,
+                              _grants_of(s, f"u{i}") if r.node
+                              else None))
+        outs[w] = decisions
+        s.auditor.sweep(full=True)
+        pool = s.batch.pool
+        meta[w] = {
+            "decisions_per_s": round(n_pods / elapsed, 1),
+            "evals_offloaded": s.batch.fleet.class_evals_offloaded,
+            "eval_fallbacks": pool.eval_fallbacks if pool else 0,
+            "worker_restarts": pool.restarts_total if pool else 0,
+            "audit_findings": _open_findings(s),
+        }
+        s.close()
+    return {
+        "nodes": n_nodes, "pods": n_pods, "solve_workers": workers,
+        "bit_identical": outs[0] == outs[workers],
+        "in_process": meta[0],
+        "pooled": meta[workers],
+        "ok": (outs[0] == outs[workers]
+               and meta[workers]["evals_offloaded"] > 0
+               and meta[0]["audit_findings"] == 0
+               and meta[workers]["audit_findings"] == 0),
+    }
+
+
+def _multicore_scaling(n_nodes: int = 512, repeats: int = 30,
+                       worker_counts=(1, 2, 4)) -> dict:
+    """Eval-stage scaling leg: repeated whole-fleet class evaluations
+    (fresh class each time — no cache hits) through the solve worker
+    pool at 1/2/4 workers vs the in-process pass, over one seeded
+    snapshot.  Row-throughput ratios are REPORTED always and GATED only
+    when the box has the cores to show them (`cores` rides the
+    artifact; on a 1-core runner near-linear scaling is physically
+    unobservable and the number documents the IPC overhead instead)."""
+    from k8s_vgpu_scheduler_tpu.parallelcp import (SharedColumnStore,
+                                                   SolveWorkerPool)
+    from k8s_vgpu_scheduler_tpu.scheduler import batch as batch_mod
+    from k8s_vgpu_scheduler_tpu.scheduler import score as score_mod
+    from k8s_vgpu_scheduler_tpu.util.types import ContainerDeviceRequest
+    from tests.test_scheduler_batch import random_fleet
+
+    snap = random_fleet(random.Random(4242), n_nodes=n_nodes)
+    affinity = score_mod.parse_affinity({})
+    reqs = [ContainerDeviceRequest(nums=1, type="TPU", memreq=m,
+                                   mem_percentage_req=0, coresreq=c)
+            for m, c in ((500, 0), (2000, 15), (8000, 0))]
+
+    def run(workers: int) -> float:
+        store = SharedColumnStore() if workers else None
+        fleet = batch_mod.ColumnarFleet(store=store)
+        fleet.refresh(snap)
+        fleet.set_gates([True] * fleet.N, [0.0] * fleet.N)
+        pool = SolveWorkerPool(store, workers) if workers else None
+        fleet.pool = pool
+        try:
+            for i in range(3):                 # spawn + warm the path
+                fleet._full_eval(batch_mod._ClassEval(
+                    reqs[i % len(reqs)], affinity, False))
+            t0 = time.monotonic()
+            for i in range(repeats):
+                fleet._full_eval(batch_mod._ClassEval(
+                    reqs[i % len(reqs)], affinity, False))
+            dt = time.monotonic() - t0
+            if workers:
+                assert fleet.class_evals_offloaded >= repeats, \
+                    "pool fell back mid-leg; scaling numbers invalid"
+            return fleet.N * repeats / dt
+        finally:
+            if pool is not None:
+                pool.close()
+            if store is not None:
+                store.close()
+
+    in_process = run(0)
+    by_workers = {w: run(w) for w in worker_counts}
+    w_lo, w_hi = min(worker_counts), max(worker_counts)
+    linearity = (by_workers[w_hi] / by_workers[w_lo]) / (w_hi / w_lo)
+    cores = os.cpu_count() or 1
+    return {
+        "nodes": n_nodes, "repeats": repeats, "cores": cores,
+        "row_evals_per_s_in_process": round(in_process, 1),
+        "row_evals_per_s_by_workers": {
+            str(w): round(v, 1) for w, v in by_workers.items()},
+        "linearity_1_to_4": round(linearity, 3),
+        # ≥0.7x-linear from 1→4 workers is only demonstrable with ≥4
+        # cores; below that the leg documents overhead, not scaling.
+        "scaling_gate_applicable": cores >= w_hi,
+        "scaling_ok": cores < w_hi or linearity >= 0.7,
+    }
+
+
+def _sharded_world(n_replicas: int, n_nodes: int, chips: int,
+                   batch_max: int, solve_workers: int):
+    """The bench_sharded fleet/replica/shard-map setup, reusable:
+    returns (kube, names, reps, owned) with the shard map converged."""
+    kube = FakeKube()
+    names = [f"node-{i}" for i in range(n_nodes)]
+    sharded = n_replicas > 1
+    reps = []
+    for r in range(n_replicas):
+        cfg = Config(filter_batch=True, batch_max=batch_max,
+                     shard_replica=f"r{r}" if sharded else "",
+                     solve_workers=solve_workers)
+        reps.append(Scheduler(kube, cfg))
+    base = reps[0]
+    for n in names:
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        register_node(base, n, chips=chips, mesh=(4, 2))
+    from k8s_vgpu_scheduler_tpu.scheduler.nodes import NodeInfo
+    for s in reps[1:]:
+        for n in names:
+            info = base.nodes.get_node(n)
+            s.nodes.add_node(n, NodeInfo(name=n,
+                                         devices=list(info.devices),
+                                         topology=info.topology))
+    if sharded:
+        for s in reps:
+            s.shards.tick()
+            s.shards.start(interval_s=1.0)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            maps = [s.shards.map for s in reps]
+            if all(m is not None and len(m.replicas) == n_replicas
+                   for m in maps) \
+                    and len({m.epoch for m in maps}) == 1 \
+                    and all(not s.shards.rebalancer.pending_nodes()
+                            for s in reps):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("shard map never converged")
+        m = base.shards.map
+        owned = {s.shards.replica: [] for s in reps}
+        for n in names:
+            owned[m.owner_of(n)].append(n)
+    else:
+        owned = {"": list(names)}
+    return kube, names, reps, owned
+
+
+def _multicore_concurrent(n_replicas: int = 4, n_nodes: int = 512,
+                          chips: int = 8, wave: int = 2000,
+                          waves: int = 4, workers: int = 2,
+                          audit_every: int = 2, batch_max: int = 512,
+                          concurrent: bool = True,
+                          solve_workers_override=None,
+                          collect: bool = True) -> dict:
+    """The concurrent sharded storm: ``n_replicas`` active-active
+    replicas driven SIMULTANEOUSLY on threads (not drained one at a
+    time — this is the leg the old sequential-drain caveat said was
+    missing), each with its own solve worker pool mapping its own
+    shared columnar segments, every replica's informer live for the
+    whole storm.  Placements accumulate over ``waves`` waves with
+    completions between waves (cumulative placements = wave × waves,
+    live set stays bounded); PR 15's audit sweeps run at every
+    ``audit_every``-th wave boundary as the cross-process correctness
+    gate.  Returns the decision map so callers can assert bit-identity
+    against a sequential in-process reference run of the SAME storm
+    (shard ownership is rendezvous-hashed from the same names, the
+    backlog partition is deterministic, and offers are disjoint — so
+    decisions must not depend on the interleaving at all)."""
+    sw = workers if solve_workers_override is None \
+        else solve_workers_override
+    kube, names, reps, owned = _sharded_world(
+        n_replicas, n_nodes, chips, batch_max, sw)
+    sharded = n_replicas > 1
+    for s in reps:
+        kube.watch_pods(s.on_pod_event)
+    decisions = {}
+    sweep_findings = []
+    placements = 0
+    unplaced = 0
+    drain_wall = 0.0
+    for w in range(waves):
+        backlog = {r: [] for r in range(n_replicas)}
+        for i in range(wave):
+            uid = f"m{w}-{i}"
+            pod = kube.create_pod(tpu_pod(uid, uid=uid, mem="500"))
+            backlog[i % n_replicas].append(pod)
+        results = [None] * n_replicas
+
+        def drain(r: int) -> None:
+            s = reps[r]
+            offer = owned[s.shards.replica if sharded else ""]
+            results[r] = s.filter_many([(pod, offer)
+                                        for pod in backlog[r]])
+
+        t0 = time.monotonic()
+        if concurrent:
+            threads = [threading.Thread(target=drain, args=(r,))
+                       for r in range(n_replicas)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            for r in range(n_replicas):
+                drain(r)
+        drain_wall += time.monotonic() - t0
+        for r in range(n_replicas):
+            for pod, res in zip(backlog[r], results[r]):
+                uid = pod["metadata"]["uid"]
+                if res.node is None:
+                    unplaced += 1
+                    if collect:
+                        decisions[uid] = (None, None)
+                elif collect:
+                    decisions[uid] = (res.node,
+                                      _grants_of(reps[r], uid))
+        placements += wave
+        if (w + 1) % audit_every == 0 or w == waves - 1:
+            total_open = 0
+            for s in reps:
+                s.auditor.sweep(full=True)
+                total_open += _open_findings(s)
+            sweep_findings.append(total_open)
+        # Completions: the wave's pods finish before the next arrives —
+        # cumulative placements grow, the live set stays one wave.  The
+        # LAST wave stays live so the closing double-booking audit runs
+        # over real grants, not an empty registry.
+        if w < waves - 1:
+            for r in range(n_replicas):
+                for pod in backlog[r]:
+                    kube.delete_pod("default", pod["metadata"]["name"])
+    for s in reps:
+        kube.unwatch_pods(s.on_pod_event)
+        s.resync_from_apiserver()
+    double_booked = _audit_double_booked(reps[0], names)
+    offloaded = sum(s.batch.fleet.class_evals_offloaded for s in reps)
+    restarts = sum(s.batch.pool.restarts_total for s in reps
+                   if s.batch.pool is not None)
+    fallbacks = sum(s.batch.pool.eval_fallbacks for s in reps
+                    if s.batch.pool is not None)
+    out = {
+        "replicas": n_replicas, "nodes": n_nodes,
+        "solve_workers_per_replica": sw,
+        "concurrent": concurrent,
+        "cumulative_placements": placements,
+        "unplaced": unplaced,
+        "sustained_decisions_per_s": round(placements / drain_wall, 1),
+        "drain_wall_s": round(drain_wall, 2),
+        "audit_sweep_findings": sweep_findings,
+        "audit_sweeps_clean": all(f == 0 for f in sweep_findings),
+        "double_booked_chips": double_booked,
+        "evals_offloaded": offloaded,
+        "worker_restarts": restarts,
+        "eval_fallbacks": fallbacks,
+    }
+    for s in reps:
+        s.close()
+    return decisions, out
+
+
+def _multicore_burst(n_nodes: int, chips: int, n_pods: int,
+                     batch_max: int = 512) -> float:
+    """The burst reference for sustained_over_burst: ONE replica,
+    in-process evaluation, one big backlog drained cold — the classic
+    single-process burst rate over the full (unsharded) fleet."""
+    kube, names, reps, owned = _sharded_world(1, n_nodes, chips,
+                                              batch_max, 0)
+    s = reps[0]
+    kube.watch_pods(s.on_pod_event)
+    pods = [kube.create_pod(tpu_pod(f"b{i}", uid=f"bu{i}", mem="500"))
+            for i in range(n_pods)]
+    t0 = time.monotonic()
+    results = s.filter_many([(p, names) for p in pods])
+    elapsed = time.monotonic() - t0
+    assert all(r.node for r in results)
+    s.close()
+    return n_pods / elapsed
+
+
+def bench_multicore(stretch_placements: int = 1000000) -> dict:
+    """The multicore control-plane proof (`python
+    benchmarks/controlplane.py multicore` → STEADY_<round>.json):
+
+    1. parity — seeded mixed-class stream, --solve-workers 2 vs 0,
+       every grant bit-identical, audits clean both ways;
+    2. scaling — eval-stage row throughput at 1/2/4 workers (gated
+       ≥0.7x-linear only where the box has the cores; `cores` rides
+       the artifact);
+    3. concurrent A/B — 4 replicas driven simultaneously with solve
+       workers vs the same storm drained sequentially in-process:
+       decisions bit-identical, sustained ≥ 1x the single-replica
+       burst, audits live and clean;
+    4. the stretch storm — cumulative placements to the target with
+       audit sweeps live at every boundary, zero findings, zero
+       double-booking."""
+    parity = _multicore_parity(n_nodes=512, n_pods=2000, chips=4,
+                               workers=2)
+    scaling = _multicore_scaling()
+    conc_dec, conc = _multicore_concurrent(
+        n_replicas=4, n_nodes=512, chips=8, wave=2000, waves=4,
+        workers=2, audit_every=2)
+    seq_dec, seq = _multicore_concurrent(
+        n_replicas=4, n_nodes=512, chips=8, wave=2000, waves=4,
+        workers=2, audit_every=4, concurrent=False,
+        solve_workers_override=0)
+    burst = _multicore_burst(n_nodes=512, chips=8, n_pods=8000)
+    sustained_over_burst = conc["sustained_decisions_per_s"] / burst
+    # sustained ≥ 1x burst means 4 replicas + their worker pools
+    # genuinely overlapping — physically unobservable on a box with
+    # fewer cores than replicas, where the concurrent threads convoy
+    # on one CPU (the sequential_reference figure shows the same
+    # storm without the convoy).  Same honesty rule as the scaling
+    # leg: the ratio is always REPORTED, gated only where the cores
+    # exist to meet it.
+    cores = os.cpu_count() or 1
+    sustained_gate_applicable = cores >= 4
+    # The stretch storm: bounded live set, cumulative placements to
+    # the target, audits live.  Wave size fixed; waves derived.
+    stretch_wave = 4000
+    stretch_waves = max(1, stretch_placements // stretch_wave)
+    _dec, stretch = _multicore_concurrent(
+        n_replicas=4, n_nodes=2000, chips=8, wave=stretch_wave,
+        waves=stretch_waves, workers=2, audit_every=10, collect=False)
+    run = {
+        "parity": parity,
+        "scaling": scaling,
+        "concurrent": conc,
+        "sequential_reference": seq,
+        "burst_decisions_per_s": round(burst, 1),
+        "sustained_decisions_per_s": conc["sustained_decisions_per_s"],
+        "sustained_over_burst": round(sustained_over_burst, 3),
+        "sustained_gate_applicable": sustained_gate_applicable,
+        "concurrent_bit_identical": conc_dec == seq_dec,
+        "stretch": stretch,
+        "platform": "cpu (control plane is chip-free)",
+        "cores": cores,
+    }
+    run["passed"] = (
+        parity["ok"]
+        and run["concurrent_bit_identical"]
+        and conc["audit_sweeps_clean"]
+        and conc["double_booked_chips"] == 0
+        and conc["unplaced"] == 0
+        and stretch["audit_sweeps_clean"]
+        and stretch["double_booked_chips"] == 0
+        and stretch["unplaced"] == 0
+        and (not sustained_gate_applicable
+             or sustained_over_burst >= 1.0)
+        and scaling["scaling_ok"]
+    )
+    emit("steady", run)
+    return {"multicore": {
+        "sustained_over_burst": run["sustained_over_burst"],
+        "sustained_decisions_per_s":
+            run["sustained_decisions_per_s"],
+        "burst_decisions_per_s": run["burst_decisions_per_s"],
+        "concurrent_bit_identical": run["concurrent_bit_identical"],
+        "parity_ok": parity["ok"],
+        "linearity_1_to_4": scaling["linearity_1_to_4"],
+        "cores": run["cores"],
+        "stretch_placements": stretch["cumulative_placements"],
+        "passed": run["passed"],
+    }}
+
+
+def bench_multicore_ci() -> dict:
+    """`make bench-multicore` (CI): the reduced-scale smoke of
+    bench_multicore.  Gates ONLY the deterministic invariants — bit
+    identity against the in-process path (both the single-scheduler
+    parity leg and the concurrent-vs-sequential storm), zero audit
+    findings at every live sweep, zero double-booked chips, every pod
+    placed, no worker restarts — never timing ratios a noisy CI
+    neighbor could flake (the steady-sim precedent)."""
+    parity = _multicore_parity(n_nodes=24, n_pods=120, chips=4,
+                               workers=2)
+    conc_dec, conc = _multicore_concurrent(
+        n_replicas=2, n_nodes=24, chips=4, wave=40, waves=2,
+        workers=2, audit_every=1, batch_max=128)
+    seq_dec, seq = _multicore_concurrent(
+        n_replicas=2, n_nodes=24, chips=4, wave=40, waves=2,
+        workers=2, audit_every=2, batch_max=128, concurrent=False,
+        solve_workers_override=0)
+    return {
+        "parity_bit_identical": parity["bit_identical"],
+        "parity_evals_offloaded": parity["pooled"]["evals_offloaded"],
+        "concurrent_bit_identical": conc_dec == seq_dec,
+        "audit_sweep_findings": conc["audit_sweep_findings"],
+        "double_booked_chips": conc["double_booked_chips"],
+        "unplaced": conc["unplaced"],
+        "worker_restarts": conc["worker_restarts"],
+        "eval_fallbacks": conc["eval_fallbacks"],
+        "ok": (parity["ok"]
+               and conc_dec == seq_dec
+               and conc["audit_sweeps_clean"]
+               and conc["double_booked_chips"] == 0
+               and conc["unplaced"] == 0
+               and conc["worker_restarts"] == 0),
     }
 
 
@@ -1564,7 +2004,7 @@ def main() -> None:
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else ""
-    if mode in ("steady", "steady-ci"):
+    if mode in ("steady", "steady-ci", "multicore", "multicore-ci"):
         import faulthandler
         import signal
 
@@ -1582,6 +2022,14 @@ if __name__ == "__main__":
     elif mode == "steady-ci":
         verdict = bench_steady_ci()
         print("steady-sim:", json.dumps(verdict))
+        sys.exit(0 if verdict["ok"] else 1)
+    elif mode == "multicore":
+        out = bench_multicore()
+        print(json.dumps(out, indent=1))
+        sys.exit(0 if out["multicore"]["passed"] else 1)
+    elif mode == "multicore-ci":
+        verdict = bench_multicore_ci()
+        print("bench-multicore:", json.dumps(verdict))
         sys.exit(0 if verdict["ok"] else 1)
     elif mode == "provenance-overhead":
         # The ISSUE 13 acceptance gate: the decision-provenance emit

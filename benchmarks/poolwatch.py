@@ -315,8 +315,11 @@ def snapshot_perf() -> None:
     with open(out, "w") as f:
         json.dump({"captured_at": time.time(), "perfz": doc}, f,
                   indent=1)
+    sw = doc.get("solve_workers") or {}
     log(f"perf snapshot: wrote {out} "
-        f"({len(doc['phases'])} phase(s), {len(doc['locks'])} lock(s))")
+        f"({len(doc['phases'])} phase(s), {len(doc['locks'])} lock(s), "
+        f"{sw.get('workers', 0)}/{sw.get('configured', 0)} solve "
+        f"worker(s), {sw.get('evals_offloaded', 0)} eval(s) offloaded)")
 
 
 def snapshot_explain() -> None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import signal
 import time
 from concurrent import futures
 
@@ -84,6 +85,14 @@ def parse_args(argv=None):
                         "feasible node is served before a flexible pod "
                         "takes it); fifo = sequential argmax in fair-"
                         "share order (serial-path decision parity)")
+    p.add_argument("--solve-workers", type=int, default=0,
+                   help="solve worker processes that map the columnar "
+                        "fleet's shared-memory segments read-only and "
+                        "run the vectorized class evaluations in true "
+                        "parallel; 0 = evaluate in-process (default — "
+                        "decisions are bit-identical either way, see "
+                        "docs/scheduler-concurrency.md, Multicore "
+                        "solve workers)")
     p.add_argument("--gil-switch-interval", type=float, default=0.05,
                    help="sys.setswitchinterval for this process (seconds); "
                         "concurrent Filters are short CPU-bound bursts and "
@@ -396,6 +405,7 @@ def build_config(args) -> Config:
         batch_tick_ms=args.batch_tick_ms,
         batch_max=args.batch_max,
         batch_solver=args.batch_solver,
+        solve_workers=args.solve_workers,
         lease_ttl_s=args.lease_ttl,
         lease_grace_beats=args.lease_grace_beats,
         quarantine_flap_threshold=args.quarantine_flap_threshold,
@@ -565,6 +575,15 @@ def main(argv=None):
         "vtpu-scheduler up: grpc=%s http=%s metrics=:%d",
         args.grpc_bind, args.http_bind, args.metrics_port,
     )
+    # SIGTERM (the kubelet/systemd stop signal) must take the same
+    # graceful path as ^C: without this, solve workers and their shared
+    # segments are reclaimed by pipe-EOF and the multiprocessing
+    # resource tracker rather than drained.
+    def _terminate(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _terminate)
+
     try:
         while True:
             time.sleep(args.resync_seconds)
@@ -581,6 +600,9 @@ def main(argv=None):
         scheduler.auditor.stop()
         http_server.stop()
         grpc_server.stop(grace=2)
+        # Drains the solve-worker pool and unlinks its shared-memory
+        # segments (a no-op with --solve-workers 0).
+        scheduler.close()
 
 
 if __name__ == "__main__":

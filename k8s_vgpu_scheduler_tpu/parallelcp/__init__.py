@@ -1,0 +1,31 @@
+"""Multicore control plane: shared-memory columnar fleet + solve workers.
+
+The reference system coordinates its device processes through an mmap'd
+shared-memory region that every process maps (PAPER.md §1, §5).  This
+package applies the same pattern to the scheduler's own control plane:
+
+- :mod:`.shmem` — the ``ColumnarFleet`` numpy columns live in
+  ``multiprocessing.shared_memory`` segments behind a versioned header
+  (generation counter + column layout manifest), so worker processes can
+  map the fleet read-only and generation-fence every request.
+- :mod:`.workers` — per-shard solve worker processes that run the
+  vectorized class-evaluation stage (``eval_class_full``) over disjoint
+  row ranges of the mapped columns, in true parallel (no GIL).
+
+Commit/CAS stays single-writer in the parent; workers never write the
+segments.  The whole layer is opt-in via ``--solve-workers`` (default 0
+keeps every existing path byte-identical) and any worker failure falls
+back to the in-process evaluation — the pool can slow a cycle, never
+wrong a decision.  Protocol: docs/scheduler-concurrency.md "Multicore
+solve workers".
+"""
+
+from .shmem import SharedColumnStore, SharedColumnView, StaleGeneration
+from .workers import SolveWorkerPool
+
+__all__ = [
+    "SharedColumnStore",
+    "SharedColumnView",
+    "StaleGeneration",
+    "SolveWorkerPool",
+]

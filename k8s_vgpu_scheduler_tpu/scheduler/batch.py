@@ -101,7 +101,17 @@ class ColumnarFleet:
     outgrowing the chip pad) trigger a full rebuild — rare against the
     grant churn the incremental path absorbs."""
 
-    def __init__(self) -> None:
+    def __init__(self, store=None) -> None:
+        #: Optional parallelcp.SharedColumnStore: when set, the numpy
+        #: columns live in shared-memory segments solve worker
+        #: processes map read-only (docs/scheduler-concurrency.md
+        #: "Multicore solve workers").  None (default) keeps plain
+        #: process-private arrays — byte-identical behavior.
+        self.store = store
+        #: Optional parallelcp.SolveWorkerPool installed by the batch
+        #: engine when --solve-workers > 0; full class evaluations are
+        #: offloaded through it, with in-process fallback.
+        self.pool = None
         self._entries: Dict[str, object] = {}   # name -> SnapEntry (identity)
         self.names: List[str] = []
         self.row_of: Dict[str, int] = {}
@@ -147,22 +157,48 @@ class ColumnarFleet:
         self.rows_patched_total = 0
         self.class_rows_patched = 0
         self.class_evals_full = 0
+        #: Full class evaluations served by the solve worker pool
+        #: (subset of class_evals_full — the offload replaces the
+        #: in-process pass bit-for-bit, it does not add evaluations).
+        self.class_evals_offloaded = 0
         self._alloc(0, 1)
 
     # -- storage ---------------------------------------------------------------
     def _alloc(self, n: int, c: int) -> None:
         self.N, self.C = n, c
         shape = (n, c)
-        self.valid = np.zeros(shape, dtype=bool)
-        self.health = np.zeros(shape, dtype=bool)
-        self.type_id = np.zeros(shape, dtype=np.int32)
-        self.total_slots = np.zeros(shape, dtype=np.int64)
-        self.used_slots = np.zeros(shape, dtype=np.int64)
-        self.total_mem = np.zeros(shape, dtype=np.int64)
-        self.used_mem = np.zeros(shape, dtype=np.int64)
-        self.total_cores = np.zeros(shape, dtype=np.int64)
-        self.used_cores = np.zeros(shape, dtype=np.int64)
-        self.has_topology = np.zeros(n, dtype=bool)
+        if self.store is not None:
+            # Shared-memory backing: same dtypes/shapes, same zeroed
+            # start — only the allocation site differs, so the two
+            # modes stay bit-identical.  Allocating bumps the store's
+            # generation; workers holding the old layout are fenced.
+            cols = self.store.alloc(n, c)
+            self.valid = cols["valid"]
+            self.health = cols["health"]
+            self.type_id = cols["type_id"]
+            self.total_slots = cols["total_slots"]
+            self.used_slots = cols["used_slots"]
+            self.total_mem = cols["total_mem"]
+            self.used_mem = cols["used_mem"]
+            self.total_cores = cols["total_cores"]
+            self.used_cores = cols["used_cores"]
+            self.has_topology = cols["has_topology"]
+            self._g_base = cols["base"]
+            self._g_alive = cols["alive"]
+            self._g_bonus = cols["bonus"]
+            self._g_alive[:] = True
+        else:
+            self.valid = np.zeros(shape, dtype=bool)
+            self.health = np.zeros(shape, dtype=bool)
+            self.type_id = np.zeros(shape, dtype=np.int32)
+            self.total_slots = np.zeros(shape, dtype=np.int64)
+            self.used_slots = np.zeros(shape, dtype=np.int64)
+            self.total_mem = np.zeros(shape, dtype=np.int64)
+            self.used_mem = np.zeros(shape, dtype=np.int64)
+            self.total_cores = np.zeros(shape, dtype=np.int64)
+            self.used_cores = np.zeros(shape, dtype=np.int64)
+            self.has_topology = np.zeros(n, dtype=bool)
+            self._g_base = self._g_alive = self._g_bonus = None
         # Python mirrors: mutable per-chip state as lists (solver writes),
         # static per-chip state as tuples, per-row scalars as lists.
         self.p_used_slots: List[List[int]] = [[] for _ in range(n)]
@@ -432,6 +468,8 @@ class ColumnarFleet:
             if tc[c] > 0:
                 b += (tc[c] - uc[c]) / tc[c]
         self.base[row] = b
+        if self._g_base is not None:
+            self._g_base[row] = b
 
     def entry_of(self, name: str):
         return self._entries.get(name)
@@ -469,6 +507,12 @@ class ColumnarFleet:
                     self._note_dirty(r)
         self.alive = alive
         self.bonus = bonus
+        if self._g_alive is not None and len(alive) == self.N:
+            # Mirror into the shared columns so solve workers read the
+            # gates without per-request shipping (a Python float IS an
+            # IEEE float64 — the mirrored values are the same bits).
+            self._g_alive[:] = alive
+            self._g_bonus[:] = bonus
 
     #: Cached class evaluations kept live at once.  Small on purpose:
     #: a storm has a handful of request shapes; an adversarial stream
@@ -497,7 +541,7 @@ class ColumnarFleet:
                     for t in self._types[len(ce.allowed):])
             pending = ce.pending
             if len(pending) * self.PATCH_FRACTION > max(1, self.N):
-                eval_class_full(self, ce)
+                self._full_eval(ce)
                 self.class_evals_full += 1
             else:
                 for row in pending:
@@ -506,12 +550,24 @@ class ColumnarFleet:
             pending.clear()
             return ce
         ce = _ClassEval(req, affinity, binpack)
-        eval_class_full(self, ce)
+        self._full_eval(ce)
         self.class_evals_full += 1
         while len(self._class_cache) >= self.CLASS_CACHE_MAX:
             self._class_cache.popitem(last=False)
         self._class_cache[fp] = ce
         return ce
+
+    def _full_eval(self, ce: "_ClassEval") -> None:
+        """Whole-fleet evaluation of one class: offloaded to the solve
+        worker pool when one is installed (row-sharded across worker
+        processes, bit-identical by construction), in-process
+        otherwise — and in-process as the fallback whenever the pool
+        cannot complete, so pool health never gates correctness."""
+        pool = self.pool
+        if pool is not None and pool.eval_class(self, ce):
+            self.class_evals_offloaded += 1
+            return
+        eval_class_full(self, ce)
 
     def _scratch(self, name: str, shape, dtype) -> np.ndarray:
         """Reused numpy buffer (per name/shape/dtype) — the vectorized
@@ -1142,7 +1198,20 @@ class BatchEngine:
 
     def __init__(self, scheduler) -> None:
         self.s = scheduler
-        self.fleet = ColumnarFleet()
+        self.pool = None
+        workers = int(getattr(scheduler.cfg, "solve_workers", 0) or 0)
+        if workers > 0:
+            # Opt-in multicore path: columns move into shared-memory
+            # segments and full class evaluations fan out to worker
+            # processes.  Deferred import — parallelcp imports this
+            # module for the evaluator it re-executes.
+            from ..parallelcp import SharedColumnStore, SolveWorkerPool
+            store = SharedColumnStore()
+            self.fleet = ColumnarFleet(store=store)
+            self.pool = SolveWorkerPool(store, workers)
+            self.fleet.pool = self.pool
+        else:
+            self.fleet = ColumnarFleet()
         self.stats = BatchStats()
         # One cycle at a time: the columnar state is single-writer.
         self._cycle_lock = threading.Lock()
@@ -1195,6 +1264,18 @@ class BatchEngine:
         with self._delta_lock:
             deltas, self._pending_deltas = self._pending_deltas, {}
         return deltas
+
+    def close(self) -> None:
+        """Drain the solve worker pool and unlink the shared-memory
+        segments (idempotent; a no-op on the default in-process
+        configuration)."""
+        pool, self.pool = self.pool, None
+        self.fleet.pool = None
+        if pool is not None:
+            pool.close()
+        store, self.fleet.store = self.fleet.store, None
+        if store is not None:
+            store.close()
 
     # -- the gate (filter() path) ----------------------------------------------
     def submit(self, job: BatchJob):

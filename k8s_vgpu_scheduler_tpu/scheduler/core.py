@@ -1159,9 +1159,23 @@ class Scheduler:
             "columnar_rows_patched": fleet.rows_patched_total,
             "class_evals_full": fleet.class_evals_full,
             "class_rows_patched": fleet.class_rows_patched,
+            "class_evals_offloaded": fleet.class_evals_offloaded,
             "snapshot_usage_rebuilds": self.usage_rebuilds,
             "snapshot_usage_writethroughs": self.usage_writethroughs,
         }
+        # Multicore solve workers (parallelcp/): pool shape, lifetime
+        # restart/offload counters, per-worker recent eval latency.
+        # Always present so poolwatch and dashboards never see the
+        # section vanish when the pool is off.
+        pool = getattr(self.batch, "pool", None)
+        if pool is not None:
+            doc["solve_workers"] = pool.export()
+        else:
+            doc["solve_workers"] = {
+                "configured": 0, "workers": 0, "restarts_total": 0,
+                "evals_offloaded": 0, "eval_fallbacks": 0,
+                "per_worker": [],
+            }
         return doc
 
     def export_fleet(self) -> dict:
@@ -2409,6 +2423,9 @@ class Scheduler:
         self.defrag.stop()
         self.shards.stop()
         self.auditor.stop()
+        # Drains the solve worker pool and unlinks the shared-memory
+        # segments (no-op on the default in-process configuration).
+        self.batch.close()
         # Folds whatever is pending and stops the folder thread; the
         # store stays readable (post-mortem explains are the point).
         self.provenance.close()

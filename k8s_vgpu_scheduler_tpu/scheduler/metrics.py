@@ -126,6 +126,42 @@ class ClusterCollector(Collector):
             buckets, total = engine.stats.latency_histogram()
             batch_lat.add_metric([], buckets, total)
 
+        # Multicore solve workers (parallelcp/;
+        # docs/scheduler-concurrency.md "Multicore solve workers").
+        # Zero-valued with the pool off — same never-vanishing-series
+        # rule as the batch histograms above.
+        solve_workers = GaugeMetricFamily(
+            "vtpu_solve_workers",
+            "Live solve worker processes mapping the shared-memory "
+            "columnar fleet read-only (0 = class evaluations run "
+            "in-process; raise --solve-workers on multi-core boxes)",
+        )
+        solve_restarts = CounterMetricFamily(
+            "vtpu_solve_worker_restarts",
+            "Solve worker processes respawned after a crash, a "
+            "stale-generation refusal or an unresponsive evaluation "
+            "(each respawn remaps the columnar segments fresh; any "
+            "failed dispatch falls back to the in-process evaluator)",
+        )
+        solve_eval = HistogramMetricFamily(
+            "vtpu_solve_worker_eval_seconds",
+            "Wall-clock latency of one offloaded class evaluation over "
+            "one solve worker's row shard (measured in the worker, "
+            "recorded by the parent at reply collection)",
+            labels=["worker"],
+        )
+        solve_pool = getattr(engine, "pool", None) \
+            if engine is not None else None
+        if solve_pool is not None:
+            solve_workers.add_metric([], solve_pool.alive_count())
+            solve_restarts.add_metric([], solve_pool.restarts_total)
+            for i, ring in enumerate(solve_pool.latency):
+                buckets, total = ring.prom()
+                solve_eval.add_metric([str(i)], buckets, total)
+        else:
+            solve_workers.add_metric([], 0)
+            solve_restarts.add_metric([], 0)
+
         pool_size = GaugeMetricFamily(
             "vtpu_filter_worker_pool_size",
             "Candidate-evaluation worker pool size (0 until the pool is "
@@ -760,6 +796,7 @@ class ClusterCollector(Collector):
                 lock_hold, lock_acquires, lock_sampled, informer_lag,
                 informer_resync, pending_depth,
                 gc_collections, pool_size, busy_peak,
+                solve_workers, solve_restarts, solve_eval,
                 lease_state, leases_unhealthy, chips_quar, quarantines,
                 rescued, q_pending, q_admitted, q_share, q_borrowed,
                 q_reclaims, slice_avail, max_box, reserved,

@@ -103,6 +103,14 @@ class Config:
     # "fifo" (sequential argmax in fair-share order; decision parity
     # with the serial per-pod path, used by the parity suite).
     batch_solver: str = "regret"
+    # Multicore solve workers (parallelcp/; docs/scheduler-concurrency.md
+    # "Multicore solve workers"): worker PROCESSES that map the columnar
+    # fleet's shared-memory segments read-only and run the vectorized
+    # class evaluations row-sharded in true parallel (no GIL).
+    # 0 (default) = in-process evaluation, byte-identical to every
+    # prior release; N > 0 opts in — decisions stay bit-identical, only
+    # where the numpy pass executes changes.
+    solve_workers: int = 0
 
     # Fleet health subsystem (health/; docs/fault-tolerance.md).
     # Leases: seconds without a register-stream heartbeat before a node

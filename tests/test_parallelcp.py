@@ -1,0 +1,328 @@
+"""Multicore control plane units: shared columnar segments + solve
+worker processes (k8s_vgpu_scheduler_tpu/parallelcp/).
+
+The protocol pins (docs/scheduler-concurrency.md, "Multicore solve
+workers"):
+
+- the store/view pair round-trips every column bit-for-bit, views are
+  read-only, and the generation counter fences every remap;
+- a worker asked about a stale generation REFUSES (and the pool
+  respawns it rather than trust its mapping);
+- a parent resize (fleet rebuild → new generation) is absorbed by the
+  workers within one evaluation — the next request carries the new
+  generation and they remap on demand;
+- the pool's row-sharded evaluation is BIT-identical to the in-process
+  ``eval_class_full`` — same floats, same chips, same mems — and any
+  pool failure falls back to the in-process pass, so decisions are
+  identical at every worker count, including through crashes.
+"""
+
+import copy
+import logging
+import random
+
+import numpy as np
+import pytest
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.parallelcp import (SharedColumnStore,
+                                               SharedColumnView,
+                                               SolveWorkerPool,
+                                               StaleGeneration)
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.scheduler import batch as batch_mod
+from k8s_vgpu_scheduler_tpu.scheduler import score as score_mod
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+from tests.test_scheduler_batch import (random_anns, random_fleet,
+                                        random_pod_stream,
+                                        random_request)
+from tests.test_scheduler_core import register_node
+
+
+def shared_fleet(rng, n_nodes):
+    """A ColumnarFleet whose columns live in shared memory, loaded from
+    a seeded snapshot."""
+    snap = random_fleet(rng, n_nodes=n_nodes)
+    store = SharedColumnStore()
+    fleet = batch_mod.ColumnarFleet(store=store)
+    fleet.refresh(snap)
+    return snap, store, fleet
+
+
+def make_ce(rng, multi=False):
+    req = random_request(rng, multi=multi)
+    affinity = score_mod.parse_affinity(random_anns(rng))
+    return batch_mod._ClassEval(req, affinity, binpack=False)
+
+
+class TestSharedColumns:
+    def test_store_view_roundtrip_readonly_and_live(self):
+        store = SharedColumnStore()
+        try:
+            arrs = store.alloc(3, 2)
+            arrs["used_mem"][:] = [[1, 2], [3, 4], [5, 6]]
+            arrs["base"][:] = [0.5, 1.5, 2.5]
+            arrs["alive"][:] = [True, False, True]
+            view = SharedColumnView(store.header_name)
+            try:
+                got = view.ensure(store.generation)
+                np.testing.assert_array_equal(got["used_mem"],
+                                              arrs["used_mem"])
+                np.testing.assert_array_equal(got["base"], arrs["base"])
+                np.testing.assert_array_equal(got["alive"],
+                                              arrs["alive"])
+                assert not got["used_mem"].flags.writeable
+                # Same segment, no copy: a parent cell write is visible
+                # without a remap (within-generation coherence).
+                arrs["used_mem"][0, 0] = 42
+                assert got["used_mem"][0, 0] == 42
+            finally:
+                view.close()
+        finally:
+            store.close()
+
+    def test_generation_fence_on_resize(self):
+        store = SharedColumnStore()
+        try:
+            store.alloc(2, 2)
+            view = SharedColumnView(store.header_name)
+            try:
+                view.ensure(store.generation)
+                old = store.generation
+                store.alloc(5, 3)          # parent resizes mid-flight
+                # The old generation is gone: asking about it must
+                # refuse, never serve the old bytes as if current.
+                with pytest.raises(StaleGeneration):
+                    view.ensure(old)
+                # Asking about a generation that doesn't exist yet
+                # refuses too.
+                with pytest.raises(StaleGeneration):
+                    view.ensure(store.generation + 1)
+                got = view.ensure(store.generation)
+                assert got["used_mem"].shape == (5, 3)
+                assert view.n == 5 and view.c == 3
+            finally:
+                view.close()
+        finally:
+            store.close()
+
+    def test_fleet_alloc_through_store_bumps_generation(self):
+        rng = random.Random(2)
+        snap, store, fleet = shared_fleet(rng, n_nodes=4)
+        try:
+            g1 = store.generation
+            assert g1 >= 1
+            assert fleet.used_mem is store.arrays["used_mem"]
+            # Gates and base mirror into the shared columns.
+            fleet.set_gates([True] * fleet.N, [0.0] * fleet.N)
+            np.testing.assert_array_equal(store.arrays["alive"],
+                                          np.ones(fleet.N, bool))
+            np.testing.assert_array_equal(store.arrays["base"],
+                                          np.asarray(fleet.base))
+            # Membership change → rebuild → new generation.
+            bigger = random_fleet(random.Random(3), n_nodes=7)
+            fleet.refresh(bigger)
+            assert store.generation == g1 + 1
+            assert store.arrays["used_mem"].shape[0] == 7
+        finally:
+            store.close()
+
+
+class TestSolveWorkerPool:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_pool_eval_bit_identical_to_in_process(self, seed):
+        rng = random.Random(100 + seed)
+        snap, store, fleet = shared_fleet(rng, n_nodes=10)
+        pool = SolveWorkerPool(store, 2)
+        try:
+            for trial in range(6):
+                multi = rng.random() < 0.3
+                ref = make_ce(rng, multi=multi)
+                got = batch_mod._ClassEval(ref.req, ref.affinity,
+                                           ref.binpack)
+                batch_mod.eval_class_full(fleet, ref)
+                assert pool.eval_class(fleet, got), \
+                    f"seed {seed} trial {trial}: pool fell back"
+                assert got.score == ref.score, \
+                    f"seed {seed} trial {trial}: scores diverged"
+                assert got.chip == ref.chip
+                assert got.mem == ref.mem
+                assert got.allowed == ref.allowed
+            assert pool.evals_offloaded == 6
+            assert pool.restarts_total == 0
+        finally:
+            pool.close()
+            store.close()
+
+    def test_small_fleet_stays_in_process(self):
+        rng = random.Random(9)
+        snap, store, fleet = shared_fleet(rng, n_nodes=3)
+        pool = SolveWorkerPool(store, 2)
+        try:
+            ce = make_ce(rng)
+            assert not pool.eval_class(fleet, ce)   # below MIN_ROWS
+            assert pool.alive_count() == 0          # never even spawned
+        finally:
+            pool.close()
+            store.close()
+
+    def test_stale_generation_refused_then_respawned(self):
+        rng = random.Random(21)
+        snap, store, fleet = shared_fleet(rng, n_nodes=10)
+        pool = SolveWorkerPool(store, 2)
+        try:
+            ce = make_ce(rng)
+            assert pool.eval_class(fleet, ce)
+            before = pool.restarts_total
+            # A request fenced on a generation the header does not
+            # publish: every worker must REFUSE, the pool respawns
+            # them, and the caller gets the in-process fallback.
+            ce2 = make_ce(rng)
+            assert not pool.eval_class(fleet, ce2,
+                                       gen=store.generation + 7)
+            assert pool.restarts_total > before
+            assert pool.eval_fallbacks == 1
+            # The respawned pool serves the real generation again.
+            ref = make_ce(rng)
+            got = batch_mod._ClassEval(ref.req, ref.affinity,
+                                       ref.binpack)
+            batch_mod.eval_class_full(fleet, ref)
+            assert pool.eval_class(fleet, got)
+            assert got.score == ref.score
+        finally:
+            pool.close()
+            store.close()
+
+    def test_crashed_worker_respawns_and_serves(self):
+        rng = random.Random(31)
+        snap, store, fleet = shared_fleet(rng, n_nodes=10)
+        pool = SolveWorkerPool(store, 2)
+        try:
+            ce = make_ce(rng)
+            assert pool.eval_class(fleet, ce)
+            pool._procs[0].kill()
+            pool._procs[0].join(timeout=5.0)
+            ref = make_ce(rng)
+            got = batch_mod._ClassEval(ref.req, ref.affinity,
+                                       ref.binpack)
+            batch_mod.eval_class_full(fleet, ref)
+            assert pool.eval_class(fleet, got)
+            assert got.score == ref.score
+            assert pool.restarts_total >= 1
+            assert pool.alive_count() == 2
+        finally:
+            pool.close()
+            store.close()
+
+    def test_parent_resize_remaps_workers_within_one_cycle(self):
+        rng = random.Random(41)
+        snap, store, fleet = shared_fleet(rng, n_nodes=9)
+        pool = SolveWorkerPool(store, 2)
+        try:
+            ce = make_ce(rng)
+            assert pool.eval_class(fleet, ce)
+            g1 = store.generation
+            assert all(p[2] == g1 for p in pool.ping())
+            # Parent grows the fleet mid-flight: rebuild → generation
+            # bump.  The very next evaluation must succeed (workers
+            # remap on demand — within one cycle, no restart).
+            fleet.refresh(random_fleet(random.Random(42), n_nodes=14))
+            g2 = store.generation
+            assert g2 == g1 + 1
+            before = pool.restarts_total
+            ref = make_ce(rng)
+            got = batch_mod._ClassEval(ref.req, ref.affinity,
+                                       ref.binpack)
+            batch_mod.eval_class_full(fleet, ref)
+            assert pool.eval_class(fleet, got)
+            assert got.score == ref.score
+            assert pool.restarts_total == before
+            assert all(p[2] == g2 for p in pool.ping())
+        finally:
+            pool.close()
+            store.close()
+
+    def test_perfz_export_shape(self):
+        rng = random.Random(51)
+        snap, store, fleet = shared_fleet(rng, n_nodes=10)
+        pool = SolveWorkerPool(store, 2)
+        try:
+            assert pool.eval_class(fleet, make_ce(rng))
+            doc = pool.export()
+            assert doc["configured"] == 2
+            assert doc["workers"] == 2
+            assert doc["evals_offloaded"] == 1
+            assert len(doc["per_worker"]) == 2
+            assert doc["per_worker"][0]["evals"] >= 1
+            assert doc["per_worker"][0]["p99_ms"] >= 0.0
+        finally:
+            pool.close()
+            store.close()
+
+
+class TestSchedulerEndToEnd:
+    """--solve-workers through the whole batched Filter path: decisions
+    (node AND chips AND mems) bit-identical to --solve-workers 0."""
+
+    def _run(self, workers, n_nodes=12, n_pods=40, seed=77):
+        logging.disable(logging.CRITICAL)
+        try:
+            kube = FakeKube()
+            s = Scheduler(kube, Config(filter_batch=True,
+                                       solve_workers=workers))
+            names = [f"node-{i}" for i in range(n_nodes)]
+            for n in names:
+                kube.add_node({"metadata": {"name": n,
+                                            "annotations": {}}})
+                register_node(s, n, chips=4)
+            kube.watch_pods(s.on_pod_event)
+            rng = random.Random(seed)
+            pods = random_pod_stream(rng, n_pods, multi_ok=True)
+            for p in pods:
+                kube.create_pod(copy.deepcopy(p))
+            results = s.filter_many([(copy.deepcopy(p), names)
+                                     for p in pods])
+            out = []
+            for i, r in enumerate(results):
+                grants = None
+                if r.node is not None:
+                    pe = s.pods.get(f"u{i}")
+                    grants = tuple(
+                        tuple((d.uuid, d.usedmem, d.usedcores)
+                              for d in cont)
+                        for cont in pe.devices)
+                out.append((r.node, grants))
+            offloaded = s.batch.fleet.class_evals_offloaded
+            s.auditor.sweep(full=True)
+            findings = sum(s.auditor.store.open_by_type().values())
+            s.close()
+            return out, offloaded, findings
+        finally:
+            logging.disable(logging.NOTSET)
+
+    def test_decisions_identical_and_audit_clean(self):
+        base, off0, f0 = self._run(0)
+        pooled, off2, f2 = self._run(2)
+        assert pooled == base
+        assert off0 == 0
+        assert off2 > 0, "pool never engaged — the test proved nothing"
+        assert f0 == 0 and f2 == 0
+
+    def test_scheduler_close_drains_pool(self):
+        logging.disable(logging.CRITICAL)
+        try:
+            kube = FakeKube()
+            s = Scheduler(kube, Config(filter_batch=True,
+                                       solve_workers=2))
+            pool = s.batch.pool
+            assert pool is not None
+            store = s.batch.fleet.store
+            s.close()
+            assert s.batch.pool is None
+            assert pool.alive_count() == 0
+            # Segments unlinked: a fresh attach must fail.
+            with pytest.raises(FileNotFoundError):
+                SharedColumnView(store.header_name)
+        finally:
+            logging.disable(logging.NOTSET)

@@ -515,6 +515,68 @@ class TestChurnParity:
             self._assert_cold_parity(s, snap, req, {})
         s.close()
 
+    def test_evict_readd_mid_cache_keeps_dirty_sets_correct(self):
+        """Row-move pin (batch.py `_rebuild`: "row indices move
+        wholesale") — the invariant the shared-memory worker layout
+        depends on: a node eviction + re-add MID-CACHE, with dirty
+        rows pending in cached ``_ClassEval``s, must drop the cache
+        wholesale at each rebuild.  A surviving stale pending set
+        would patch the WRONG rows under the new numbering; the
+        bitwise cold-rebuild parity after both moves proves no stale
+        dirty row leaked through."""
+        import itertools
+
+        kube, s, names = self._env(n_nodes=6)
+        req = ContainerDeviceRequest(nums=1, type="TPU", memreq=500,
+                                     mem_percentage_req=0, coresreq=0)
+        fleet = s.batch.fleet
+        placed = []
+        seq = itertools.count()
+        self._place(kube, s, names, placed, seq, n=12)
+        snap, _r, _p = self._sync(s)
+        self._assert_cold_parity(s, snap, req, {})   # populate cache
+        assert fleet._class_cache
+        stale = dict(fleet._class_cache)
+        # Dirty rows under the CURRENT numbering: completions patch
+        # their rows in place and note them into every cached class's
+        # pending set.
+        for _ in range(3):
+            name, _node = placed.pop()
+            kube.delete_pod("default", name)
+        self._sync(s)
+        assert any(ce.pending for ce in fleet._class_cache.values())
+        # Evict row 0's node: every later row shifts down one.
+        info = s.nodes.get_node(names[0])
+        s.nodes.rm_node(names[0])
+        rebuilds = fleet.rebuilds
+        snap, _r, _p = self._sync(s)
+        assert fleet.rebuilds == rebuilds + 1
+        assert names[0] not in fleet.row_of
+        assert not fleet._class_cache, \
+            "rebuild must drop the class cache wholesale"
+        self._assert_cold_parity(s, snap, req, {})
+        # Dirty again under the SHIFTED numbering (survivor nodes only
+        # — the evicted node has no row to dirty), then re-add the
+        # evicted node (rows move back up).
+        survivors = [i for i, (_n, node) in enumerate(placed)
+                     if node != names[0]]
+        for i in sorted(survivors[:2], reverse=True):
+            name, _node = placed.pop(i)
+            kube.delete_pod("default", name)
+        self._sync(s)
+        assert any(ce.pending for ce in fleet._class_cache.values())
+        s.nodes.add_node(names[0], info)
+        snap, _r, _p = self._sync(s)
+        assert fleet.rebuilds == rebuilds + 2
+        assert names[0] in fleet.row_of
+        assert not fleet._class_cache
+        self._assert_cold_parity(s, snap, req, {})
+        # The pre-eviction cache objects must be gone for good — the
+        # new cache was rebuilt from scratch, not resurrected.
+        for fp, ce in s.batch.fleet._class_cache.items():
+            assert stale.get(fp) is not ce
+        s.close()
+
     def test_commit_round_adopts_without_reload(self):
         """A cycle's own grants must never force reloads at the next
         refresh: the group commit published the usage the columnar
