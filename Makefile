@@ -3,7 +3,7 @@
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
         defrag-sim ha-sim qos-sim capacity-sim steady-sim explain-sim \
-        audit-sim bench-multicore batch-protocol shard-protocol \
+        audit-sim elastic-sim bench-multicore batch-protocol shard-protocol \
         lint-dashboards dryrun scenarios controlplane \
         bench-controlplane bench-steady bench-explain bench wheel clean
 
@@ -149,6 +149,20 @@ audit-sim:                    ## cross-plane corruption-injection proof (simulat
 	    --workload examples/workload-audit.json \
 	    --nodes 24 --chips 4 --hbm 2000 --json \
 	  | python -c "import json,sys; v = json.load(sys.stdin)['audit']['verdict']; assert v['ok'], v; print('audit-sim:', v)"
+
+# Elastic mesh resizing A/B through the REAL admission/reclaim/resize
+# loops on the virtual clock (elastic/; docs/placement.md "Elastic
+# meshes"): an elastic gang borrowing cohort capacity shrinks a rung
+# for a latency burst instead of dying, then grows back under
+# hysteresis.  Deterministic; the verdict gates CI: goodput and burst
+# JCT strictly better than kill-based reclaim, zero kills on the
+# elastic leg, the gang's hash-chain trajectory resumes bit-identically
+# at every resize point, zero double-booking, elastic-off leg inert.
+elastic-sim:                  ## elastic resize-vs-kill A/B in the simulator
+	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
+	    --workload examples/workload-elastic.json \
+	    --nodes 2 --chips 16 --mesh 4x4 --json \
+	  | python -c "import json,sys; v = json.load(sys.stdin)['elastic']['verdict']; assert v['ok'], v; print('elastic-sim:', v)"
 
 # The ISSUE 13 emit-overhead gate at full bench scale: decision
 # provenance ON vs --no-provenance, ABBA per-cycle alternation on

@@ -206,6 +206,24 @@ def parse_args(argv=None):
                         "for its beneficiary before returning to the pool")
     p.add_argument("--defrag-max-victims", type=int, default=8,
                    help="largest victim set a compaction plan may ask")
+    p.add_argument("--enable-elastic", action="store_true",
+                   help="elastic mesh resizing: gangs declaring a "
+                        "vtpu.dev/mesh-min..mesh-max range shrink one "
+                        "rung (checkpoint-restart) instead of dying "
+                        "under reclaim/defrag pressure and grow back "
+                        "when capacity frees (docs/placement.md)")
+    p.add_argument("--elastic-interval", type=float, default=10.0,
+                   help="resize controller loop period, seconds")
+    p.add_argument("--resize-hysteresis", type=float, default=300.0,
+                   help="seconds after any resize before the same gang "
+                        "may grow again (thrash guard)")
+    p.add_argument("--resize-checkpoint-grace", type=float, default=120.0,
+                   help="seconds resize victims get to checkpoint and "
+                        "exit before the resize aborts and rolls back")
+    p.add_argument("--elastic-downgrade-after", type=float, default=30.0,
+                   help="seconds a pending elastic gang must sit "
+                        "Filter-rejected before admission retries it "
+                        "one rung down")
     # Active-active scheduler HA (shard/; docs/scheduler-concurrency.md,
     # "Sharded control plane").
     p.add_argument("--shard-replica", default="",
@@ -435,6 +453,11 @@ def build_config(args) -> Config:
         defrag_checkpoint_grace_s=args.defrag_checkpoint_grace,
         defrag_reservation_ttl_s=args.defrag_reservation_ttl,
         defrag_max_victims=args.defrag_max_victims,
+        enable_elastic=args.enable_elastic,
+        elastic_interval_s=args.elastic_interval,
+        resize_hysteresis_s=args.resize_hysteresis,
+        resize_checkpoint_grace_s=args.resize_checkpoint_grace,
+        elastic_downgrade_after_s=args.elastic_downgrade_after,
         shard_replica=args.shard_replica,
         shard_ttl_s=args.shard_ttl,
         shard_grace_beats=args.shard_grace_beats,
@@ -513,6 +536,10 @@ def main(argv=None):
     # --enable-defrag.
     if scheduler.cfg.enable_defrag:
         scheduler.defrag.start()
+    # Elastic mesh resizing: grow/downgrade loop (shrinks are invoked
+    # synchronously by reclaim/defrag); inert without --enable-elastic.
+    if scheduler.cfg.enable_elastic:
+        scheduler.elastic.start()
     # Predictive capacity: periodic demand sampling into the forecaster
     # (same embedders-own-their-cadence rule — /capacityz also samples
     # on each export, so the thread only densifies the series).
@@ -596,6 +623,7 @@ def main(argv=None):
         scheduler.rescuer.stop()
         scheduler.admission.stop()
         scheduler.defrag.stop()
+        scheduler.elastic.stop()
         scheduler.shards.stop()
         scheduler.auditor.stop()
         http_server.stop()

@@ -64,6 +64,9 @@ def _narrate(stage: str, d: dict) -> str:
             bits.append(f"QoS class {d['qos']}")
         if d.get("mesh"):
             bits.append(f"declared mesh {d['mesh']}")
+        if d.get("mesh_min") or d.get("mesh_max"):
+            bits.append(f"elastic range {d.get('mesh_min')}"
+                        f"..{d.get('mesh_max')}")
         if d.get("queue"):
             bits.append(f"governed by capacity queue {d['queue']}")
         return "; ".join(bits)
@@ -135,6 +138,22 @@ def _narrate(stage: str, d: dict) -> str:
                 "rescued": "grant rescinded by the rescuer"}[stage]
         return (f"{verb} off {d.get('node')}: {d.get('reason')} "
                 f"(requester {d.get('requester')})")
+    if stage in ("resize-shrink", "resize-grow"):
+        verb = ("stepped DOWN a mesh rung"
+                if stage == "resize-shrink" else "grown a mesh rung")
+        req = d.get("requester", "")
+        why = {"reclaim": "quota reclaim chose a shrink over an "
+                          "eviction",
+               "defrag": "defrag chose a shrink over a migration kill",
+               "grow": "capacity freed and the gang was below its "
+                       "declared max",
+               "admission": "the pending gang could not place at its "
+                            "assigned shape"}
+        from ..elastic.controller import requester_label
+        return (f"{verb}: {d.get('mesh_from')} -> {d.get('mesh_to')} "
+                f"({why.get(requester_label(req), 'resize')}; "
+                f"requester {req}) — gang checkpoints and resumes "
+                "bit-identically at the new shape")
     if stage == "deleted":
         return "pod deleted / terminated"
     return ", ".join(f"{k}={v}" for k, v in d.items()) or stage

@@ -138,11 +138,22 @@ def join_pending_reasons(export: dict, cluster: str,
             doc = fetch(cluster, p["pod"])
             reason = None
             if doc is not None:
-                reason = doc.get("dominant_rejection")
-                if reason is None and doc.get("final"):
-                    # Never rejected: the newest stage IS the story
-                    # (quota-hold, rescue-queued, ...).
-                    reason = doc["final"]["stage"]
+                final = doc.get("final") or {}
+                if final.get("stage") in ("resize-shrink",
+                                          "resize-grow"):
+                    # Mid-resize beats any stale rejection tally: the
+                    # pod is pending BECAUSE its gang is restarting at
+                    # a new mesh shape, and the transition says so.
+                    det = final.get("detail") or {}
+                    reason = (f"{final['stage']} "
+                              f"{det.get('mesh_from', '?')}->"
+                              f"{det.get('mesh_to', '?')}")
+                else:
+                    reason = doc.get("dominant_rejection")
+                    if reason is None and final:
+                        # Never rejected: the newest stage IS the story
+                        # (quota-hold, rescue-queued, ...).
+                        reason = final["stage"]
             rows.append({"pod": p["pod"], "queue": q["queue"],
                          "position": p["position"], "chips": p["chips"],
                          "gang": p.get("gang"),

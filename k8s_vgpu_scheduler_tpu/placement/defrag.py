@@ -168,6 +168,7 @@ def plan_compaction(
     chip_seconds_of=lambda uid: 0.0,
     mesh: Optional[Tuple[int, ...]] = None,
     allow_existing: bool = False,
+    shrink_uids: FrozenSet[str] = frozenset(),
 ) -> Optional[DefragPlan]:
     """Cheapest single-node compaction that assembles a contiguous box
     of ``demand_chips`` — or None when no node can be compacted to it.
@@ -183,8 +184,15 @@ def plan_compaction(
       ``mesh`` when one is declared — where none existed before, and
       (for shapeless demands) the node's largest free box strictly
       grows: a move that frees nothing new is never planned;
-    - victim sets are minimal-first: fewest victims, then least sunk
-      chip-seconds, with deterministic tie-breaks.
+    - victim sets are minimal-first: fewest KILLS (a victim in
+      ``shrink_uids`` — an elastic gang member the resize controller
+      can step down a rung, keeping the job alive — is cheaper than any
+      eviction and charges no sunk work), then fewest victims, then
+      least sunk chip-seconds, with deterministic tie-breaks.
+      ``shrink_uids`` members bypass the priority gate (an elastic gang
+      opted into checkpoint-restart by declaring the range) but still
+      honor ``protected_uids``; with the elastic subsystem off the set
+      is empty and plans are byte-identical to before it existed.
     """
     best: Optional[Tuple[tuple, DefragPlan]] = None
     for name in sorted(snapshot):
@@ -226,7 +234,8 @@ def plan_compaction(
             uids_chips = {d.uuid for c in pod.devices for d in c}
             for cid in uids_chips:
                 residents.setdefault(cid, []).append(pod)
-            if pod.priority >= min_victim_priority \
+            if (pod.priority >= min_victim_priority
+                    or pod.uid in shrink_uids) \
                     and pod.uid not in protected_uids:
                 eligible[pod.uid] = VictimRef(
                     uid=pod.uid, namespace=pod.namespace, name=pod.name,
@@ -289,8 +298,13 @@ def plan_compaction(
                 elif max_after < demand_chips \
                         or max_after <= view.max_box:
                     continue  # the move would not strictly improve
-                cost = sum(v.chip_seconds for v in victims)
-                key = (len(victims), cost, name, sorted(box_set))
+                kills = [v for v in victims if v.uid not in shrink_uids]
+                # Sunk work is only LOST on a kill: a shrunk gang keeps
+                # running one rung down, so its chip-seconds don't
+                # count against the plan.
+                cost = sum(v.chip_seconds for v in kills)
+                key = (len(kills), len(victims), cost, name,
+                       sorted(box_set))
                 if best is None or key < best[0]:
                     best = (key, DefragPlan(
                         node=name,
@@ -505,6 +519,12 @@ class Defragmenter:
         protected |= set(self.s.rescuer.pending())
         with self.s._preempt_lock:
             protected |= set(self.s._preempt_requested)
+        # Elastic gang members the resize controller can step down a
+        # rung are the one exception to gang protection: they don't die,
+        # they come back one rung smaller.  Empty dict (and therefore
+        # byte-identical plans) whenever --enable-elastic is off.
+        shrink_map = self.s.elastic.shrinkable_uids()
+        protected -= set(shrink_map)
 
         def chip_seconds_of(uid: str) -> float:
             acct = self.s.ledger.get(uid)
@@ -517,7 +537,8 @@ class Defragmenter:
             max_victims=self.cfg.max_victims_per_plan,
             chip_seconds_of=chip_seconds_of,
             mesh=demand.mesh,
-            allow_existing=demand.count > 1)
+            allow_existing=demand.count > 1,
+            shrink_uids=frozenset(shrink_map))
         if plan is not None:
             plan.demand_key = demand.key
         return plan
@@ -547,8 +568,30 @@ class Defragmenter:
             # returns — the demand's previously assembled ones stand.
             self.s.reservations.release(reservation)
             return
-        self.s._request_preemptions(
-            requester, PreemptionPlan(node=plan.node, victims=victims))
+        # Elastic gang members shrink instead of dying.  Each gang gets
+        # its OWN requester key (suffixed with the gang key) under the
+        # resize controller's ledger entry — sharing defrag's key would
+        # let the resize completion rescind clear the plain victims'
+        # annotations mid-checkpoint.  begin_shrink re-checks its own
+        # guards; if any gang refuses (raced into another resize), the
+        # box can't fully free, so abort this plan and replan next tick.
+        shrink_map = self.s.elastic.shrinkable_uids()
+        gang_keys = sorted({shrink_map[v.uid] for v in victims
+                            if v.uid in shrink_map})
+        shrunk = []
+        for gk in gang_keys:
+            act = self.s.elastic.begin_shrink(
+                gk, f"{requester_key}/{gk}",
+                reason=f"defrag for {demand.key}")
+            if act is None:
+                self.s.reservations.release(reservation)
+                return
+            shrunk.append(act)
+        plain = [v for v in victims if v.uid not in shrink_map]
+        if plain:
+            self.s._request_preemptions(
+                requester,
+                PreemptionPlan(node=plan.node, victims=plain))
         with self._lock:
             self._in_flight[demand.key] = _InFlight(
                 plan=plan, requester_key=requester_key, asked_at=now,
@@ -565,8 +608,10 @@ class Defragmenter:
             "kind": "defrag-plan", "node": plan.node,
             "for": demand.key, "chips": plan.demand_chips,
             "victims": [v.uid for v in plan.victims],
+            "shrinks": [a["gang"] for a in shrunk],
             "max_box_before": plan.max_box_before,
             "max_box_after": plan.max_box_after})
+        actions.extend(shrunk)
 
     def _progress_in_flight(self, now: float,
                             actions: List[dict]) -> None:

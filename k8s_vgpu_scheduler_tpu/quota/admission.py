@@ -479,7 +479,20 @@ class AdmissionLoop:
                 and mgr.governed(p.namespace).name in cohort_names)
             if pending_free >= demand:
                 continue
-            plan = plan_reclaim(demand - pending_free, q, mgr.queues,
+            remaining = demand - pending_free
+            # The CHEAPER action first (elastic/; docs/placement.md
+            # "Elastic meshes"): cohort borrowers that are elastic
+            # gangs step down a rung instead of dying — the job keeps
+            # running at reduced width while the freed chips admit the
+            # entitled pod.  Evictions below only cover the remainder.
+            shrunk = self._shrink_pass(mgr, q, qname, usage, entry,
+                                       remaining, actions)
+            if shrunk > 0:
+                self._last_reclaim[qname] = now
+                remaining -= shrunk
+            if remaining <= 0:
+                continue
+            plan = plan_reclaim(remaining, q, mgr.queues,
                                 usage, pods, protected_uids=protected)
             if plan is None:
                 continue
@@ -524,6 +537,81 @@ class AdmissionLoop:
                     "BorrowedGrantReclaimed",
                     "checkpoint requested: this grant is borrowed "
                     f"capacity reclaimed for queue {qname}")
+
+    def _shrink_pass(self, mgr, q, qname: str, usage, entry,
+                     need: int, actions) -> int:
+        """Shrink cohort-borrowing elastic gangs toward ``need`` chips
+        (selection: quota/reclaim.py plan_shrinks; execution: the
+        resize controller, so the members land in the shared preemption
+        ledger under a ``rescue:reclaim:`` requester key and nothing
+        can stack a second eviction on them).  Returns the net chips
+        the started shrinks will free."""
+        from ..elastic.controller import RECLAIM_SHRINK_PREFIX
+        from .reclaim import ShrinkCandidate, plan_shrinks
+
+        elastic = getattr(self.s, "elastic", None)
+        if elastic is None or not elastic.cfg.enabled or need <= 0:
+            return 0
+        by_gang: dict = {}
+        for uid, gkey in elastic.shrinkable_uids().items():
+            by_gang.setdefault(gkey, []).append(uid)
+        if not by_gang:
+            return 0
+        from ..elastic.ranges import mesh_volume, next_smaller
+
+        candidates = []
+        gangs = {}
+        for gkey in sorted(by_gang):
+            g = elastic.gang(gkey)
+            if g is None:
+                continue
+            target = next_smaller(g.ladder, g.current)
+            if target is None:
+                continue
+            sunk = 0.0
+            for uid in g.member_uids:
+                acct = self.s.ledger.get(uid)
+                if acct is not None:
+                    sunk += acct.chip_seconds
+            gangs[gkey] = g
+            candidates.append(ShrinkCandidate(
+                gang_key=gkey, namespace=g.namespace,
+                freed_chips=(mesh_volume(g.current)
+                             - mesh_volume(target)),
+                sunk_chip_seconds=sunk))
+        freed = 0
+        for c in plan_shrinks(need, q, mgr.queues, usage, candidates):
+            requester_key = (f"{RECLAIM_SHRINK_PREFIX}{entry.uid}"
+                             f"/{c.gang_key}")
+            act = elastic.begin_shrink(
+                c.gang_key, requester_key,
+                reason=f"queue {qname} reclaim")
+            if act is None:
+                continue
+            freed += act["freed_chips"]
+            g = gangs[c.gang_key]
+            vq = mgr.governed(g.namespace)
+            act = dict(act)
+            act.update({
+                "queue": qname,
+                "for": f"{entry.namespace}/{entry.name}",
+                "donor_queue": vq.name if vq else None,
+                "donor_borrowed": (
+                    usage.get(vq.name, QueueUsage()).borrowed_chips(vq)
+                    if vq else 0),
+            })
+            actions.append(act)
+            mgr.reclaims_total += 1
+            log.warning(
+                "queue %s under nominal with %s waiting: shrinking "
+                "elastic gang %s %s -> %s (net %d chip(s)) instead of "
+                "evicting", qname, f"{entry.namespace}/{entry.name}",
+                c.gang_key, act["from"], act["to"], act["freed_chips"])
+            self._event(entry.namespace, entry, "QuotaReclaim",
+                        f"shrinking elastic gang {c.gang_key} to "
+                        f"{act['to']} reclaims {act['freed_chips']} "
+                        f"borrowed chip(s) for queue {qname}")
+        return freed
 
     def _reclaim_trigger(self, mgr, qname: str, blocked,
                          now: float) -> Optional[QueueEntry]:

@@ -39,6 +39,11 @@ from ..k8s.client import (
     pod_qos,
     pod_uid,
 )
+from ..elastic.controller import (
+    ELASTIC_VALUE_PREFIX,
+    ElasticConfig,
+    ResizeController,
+)
 from ..placement.defrag import Defragmenter, DefragConfig
 from ..provenance.store import (
     ProvenanceConfig,
@@ -259,6 +264,21 @@ class Scheduler:
                 reservation_ttl_s=self.cfg.defrag_reservation_ttl_s,
                 min_victim_priority=self.cfg.defrag_min_victim_priority,
                 max_victims_per_plan=self.cfg.defrag_max_victims),
+            clock=clock)
+        # Elastic mesh resizing (elastic/; docs/placement.md "Elastic
+        # meshes").  Inert unless --enable-elastic: shrink offers are
+        # empty, the tick never plans, and every existing path is
+        # byte-identical.  The loop thread is started by the daemon
+        # entrypoint — embedders/tests call elastic.tick() directly,
+        # the defrag/rescuer/admission shape.
+        self.elastic = ResizeController(
+            self,
+            ElasticConfig(
+                enabled=self.cfg.enable_elastic,
+                interval_s=self.cfg.elastic_interval_s,
+                hysteresis_s=self.cfg.resize_hysteresis_s,
+                checkpoint_grace_s=self.cfg.resize_checkpoint_grace_s,
+                downgrade_after_s=self.cfg.elastic_downgrade_after_s),
             clock=clock)
         # Active-active HA shard layer (shard/; docs/scheduler-
         # concurrency.md "Sharded control plane").  Inert without
@@ -783,11 +803,13 @@ class Scheduler:
             requester = anns.get(PREEMPT_ANNOTATION)
             if not requester:
                 continue
-            if requester.startswith(RESCUE_VALUE_PREFIX):
-                # Rescuer-written eviction requests are not requester
-                # uids; their lifecycle (grace, rescind) belongs to the
-                # rescue sweep — reconciling them here would clear a
-                # checkpoint request mid-checkpoint.
+            if requester.startswith(RESCUE_VALUE_PREFIX) \
+                    or requester.startswith(ELASTIC_VALUE_PREFIX):
+                # Rescuer-written eviction requests (and elastic resize
+                # restarts) are not requester uids; their lifecycle
+                # (grace, rescind) belongs to the rescue sweep / the
+                # resize controller — reconciling them here would clear
+                # a checkpoint request mid-checkpoint.
                 continue
             req_pod = by_uid.get(requester)
             still_pending = (
@@ -1522,6 +1544,7 @@ class Scheduler:
         # A placement settles any slice demand this pod (or its gang)
         # had recorded — the defragmenter must not compact for it.
         self.defrag.demand_satisfied(self._reservation_key(pod))
+        self.elastic.demand_satisfied(self._reservation_key(pod))
         if self._preempt_by_requester.get(uid):
             # The pod found a seat after all (capacity freed elsewhere):
             # its outstanding eviction requests are now pointless.
@@ -1891,6 +1914,12 @@ class Scheduler:
             pod_name(pod), chips,
             count=gang[1] if gang is not None else 1,
             mesh=mesh_local)
+        if gang is not None:
+            # The resize controller's admission-downgrade feedback: a
+            # blocked PENDING elastic gang is stepped down a rung once
+            # defrag has had its shot (no-op for non-elastic gangs and
+            # with --enable-elastic off).
+            self.elastic.observe_rejection(self._reservation_key(pod))
 
     def _request_preemptions(self, pod: dict, plan: "PreemptionPlan") -> None:
         """Annotate the plan's victims (apiserver writes, so outside the
@@ -1928,7 +1957,9 @@ class Scheduler:
                     v.uid, "preempt-requested", namespace=v.namespace,
                     name=v.name, requester=pod_uid(pod),
                     requester_pod=pod_name(pod), node=plan.node)
-                if not pod_uid(pod).startswith(RESCUE_VALUE_PREFIX):
+                if not pod_uid(pod).startswith(RESCUE_VALUE_PREFIX) \
+                        and not pod_uid(pod).startswith(
+                            ELASTIC_VALUE_PREFIX):
                     self.provenance.emit(
                         pod_uid(pod), "preemption-planned",
                         namespace=pod_namespace(pod), name=pod_name(pod),
@@ -2421,6 +2452,7 @@ class Scheduler:
         self.rescuer.stop()
         self.admission.stop()
         self.defrag.stop()
+        self.elastic.stop()
         self.shards.stop()
         self.auditor.stop()
         # Drains the solve worker pool and unlinks the shared-memory

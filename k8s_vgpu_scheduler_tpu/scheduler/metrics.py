@@ -384,6 +384,45 @@ class ClusterCollector(Collector):
         defrag_aborted.add_metric(
             [], defrag.aborted_total if defrag else 0)
 
+        # Elastic mesh resizing (elastic/; docs/placement.md "Elastic
+        # meshes").  Always emitted — zero-valued with --enable-elastic
+        # off or no elastic gangs in the fleet — so dashboards never
+        # reference a vanishing series.  Labels are the BOUNDED
+        # requester_label/state vocabularies, never raw requester keys.
+        resizes = CounterMetricFamily(
+            "vtpu_resizes",
+            "Gang mesh resizes begun (checkpoint-restart at a new "
+            "rung), by direction (shrink/grow) and requesting "
+            "subsystem (reclaim/defrag/grow/admission)",
+            labels=["direction", "requester"],
+        )
+        elastic_pods = GaugeMetricFamily(
+            "vtpu_elastic_pods",
+            "Member pods of gangs declaring a mesh range, by state "
+            "(at-max: running at mesh-max; shrunk: running below it; "
+            "resizing: mid checkpoint-restart; pending: not admitted)",
+            labels=["state"],
+        )
+        resize_thrash = CounterMetricFamily(
+            "vtpu_resize_thrash",
+            "Grow attempts suppressed by hysteresis right after a "
+            "shrink (counted once per resize) — a rising rate means "
+            "capacity is oscillating and --resize-hysteresis is "
+            "absorbing shrink/grow ping-pong (VtpuResizeThrash)",
+        )
+        elastic = getattr(self.scheduler, "elastic", None)
+        for direction in ("shrink", "grow"):
+            for req in ("reclaim", "defrag", "grow", "admission"):
+                resizes.add_metric(
+                    [direction, req],
+                    elastic.resizes_total.get((direction, req), 0)
+                    if elastic else 0)
+        states = elastic.pod_states() if elastic else {}
+        for state in ("at-max", "shrunk", "resizing", "pending"):
+            elastic_pods.add_metric([state], states.get(state, 0))
+        resize_thrash.add_metric(
+            [], elastic.thrash_total if elastic else 0)
+
         # Active-active HA shard layer (shard/; docs/scheduler-
         # concurrency.md "Sharded control plane").  All families emitted
         # with the layer inert (epoch 0, owned = whole fleet, zero
@@ -801,7 +840,8 @@ class ClusterCollector(Collector):
                 rescued, q_pending, q_admitted, q_share, q_borrowed,
                 q_reclaims, slice_avail, max_box, reserved,
                 defrag_plans, defrag_migrations, defrag_completed,
-                defrag_aborted, shard_epoch, shards_owned,
+                defrag_aborted, resizes, elastic_pods, resize_thrash,
+                shard_epoch, shards_owned,
                 shards_orphaned, shard_rebalances, cas_failures,
                 cap_demand, cap_forecast, cap_upper, cap_eta, cap_err,
                 cap_nodes_cur, cap_nodes_rec,

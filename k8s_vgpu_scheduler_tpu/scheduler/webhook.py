@@ -258,6 +258,39 @@ def validate_pod_mesh(pod: dict, cfg: Config,
     return f"{MESH_ANNOTATION}: {why}"
 
 
+def validate_pod_mesh_range(pod: dict, cfg: Config,
+                            topologies=None) -> Optional[str]:
+    """Admission-time elastic mesh-range validation (elastic/ranges.py):
+    both bounds present and parseable, gang-scoped, min ≤ max, at least
+    one valid rung folds onto a known topology, and the declared
+    ``vtpu.dev/mesh`` IS one of the rungs.  A pod without range
+    annotations never reaches the validator — bare ``vtpu.dev/mesh``
+    stays exactly as today.  Returns the user-facing rejection message,
+    or None."""
+    from ..elastic.ranges import elastic_range_of, validate_mesh_range
+    from .gang import gang_of
+
+    anns = pod.get("metadata", {}).get("annotations") or {}
+    rng = elastic_range_of(anns)
+    if rng is None:
+        return None
+    try:
+        requests = container_requests(pod, cfg)
+    except ValueError as e:
+        return (f"elastic mesh range: cannot validate against "
+                f"unparseable resources: {e}")
+    nums = max((r.nums for r in requests), default=0)
+    gang = gang_of(pod)
+    # 0 = no gang membership at all (the non-gang 422); a declared
+    # total of 1 is a legitimate fully-shrunk generation.
+    gang_total = gang[1] if gang is not None else 0
+    topos = list(topologies() if callable(topologies)
+                 else (topologies or ()))
+    return validate_mesh_range(rng[0], rng[1],
+                               anns.get(MESH_ANNOTATION, ""),
+                               nums, gang_total, topos)
+
+
 def validate_pod_qos(pod: dict) -> Optional[str]:
     """Admission-time ``vtpu.dev/qos`` validation (docs/serving.md): the
     value must be a known QoS class.  Same discipline as the mesh check —
@@ -290,6 +323,7 @@ def handle_admission_review(body: dict, cfg: Config,
     pod = req.get("object")
     if isinstance(pod, dict) and req.get("operation", "CREATE") == "CREATE":
         why = validate_pod_mesh(pod, cfg, topologies) \
+            or validate_pod_mesh_range(pod, cfg, topologies) \
             or validate_pod_qos(pod)
         if why is not None:
             meta = pod.get("metadata", {})
@@ -340,6 +374,8 @@ def handle_admission_review(body: dict, cfg: Config,
                     trace_id=trace_id,
                     qos=anns.get(QOS_ANNOTATION, ""),
                     mesh=anns.get(MESH_ANNOTATION, ""),
+                    mesh_min=anns.get("vtpu.dev/mesh-min", ""),
+                    mesh_max=anns.get("vtpu.dev/mesh-max", ""),
                     queue=_governing_queue(
                         cfg, req.get("namespace", "")
                         or meta.get("namespace", "default")) or "")

@@ -216,6 +216,29 @@ class Config:
     # A plan asking more victims than this is not "minimal compaction".
     defrag_max_victims: int = 8
 
+    # Elastic mesh resizing (elastic/; docs/placement.md "Elastic
+    # meshes").  Gangs that declare a vtpu.dev/mesh-min/-max range may
+    # be stepped between the range's rungs: quota reclaim and defrag
+    # SHRINK them instead of evicting, the resize controller GROWS
+    # starved ones back when capacity frees, and blocked pending gangs
+    # are downgraded until they fit.  Off by default — resizing imposes
+    # checkpoint-restart cycles, so the operator opts in
+    # (--enable-elastic); with it off every existing path is
+    # byte-identical (the range annotations are inert).
+    enable_elastic: bool = False
+    # Background resize-loop period (cmd/scheduler --elastic-interval).
+    elastic_interval_s: float = 10.0
+    # Quiet window after any resize before the same gang may grow
+    # (--resize-hysteresis); a grow attempt inside it right after a
+    # shrink is thrash — suppressed and counted, never executed.
+    resize_hysteresis_s: float = 300.0
+    # How long resized members get to checkpoint and exit before the
+    # resize aborts and vtpu.dev/mesh-assigned is rolled back.
+    resize_checkpoint_grace_s: float = 120.0
+    # How long a pending elastic gang must stay Filter-rejected before
+    # it is stepped down a rung (defrag gets first shot meanwhile).
+    elastic_downgrade_after_s: float = 30.0
+
     # Active-active scheduler HA (shard/; docs/scheduler-concurrency.md,
     # "Sharded control plane").  shard_replica is this replica's name
     # (the chart passes the pod name); EMPTY = the shard layer is inert

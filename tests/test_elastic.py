@@ -1,0 +1,576 @@
+"""Elastic mesh resizing (elastic/; docs/placement.md "Elastic meshes"):
+the range grammar and rung ladder, the webhook's admission-time range
+validation, and the ResizeController's shrink/grow/downgrade protocol —
+including the seeded no-double-evict proof that reclaim, defrag and the
+rescuer can never stack a second eviction or resize on the same gang.
+
+Everything runs on a virtual clock against the REAL Scheduler + FakeKube
+(the test_quota idiom): fast tier-1 units, no sleeps, deterministic.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from k8s_vgpu_scheduler_tpu.elastic import (
+    ADMISSION_REQUESTER_PREFIX,
+    GROW_REQUESTER_PREFIX,
+    MESH_ASSIGNED_ANNOTATION,
+    MESH_MAX_ANNOTATION,
+    MESH_MIN_ANNOTATION,
+    RECLAIM_SHRINK_PREFIX,
+    elastic_range_of,
+    format_mesh,
+    mesh_ladder,
+    mesh_range_shapes,
+    next_larger,
+    next_smaller,
+    requester_label,
+    validate_mesh_range,
+)
+from k8s_vgpu_scheduler_tpu.health.faults import SimClock
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.placement.mesh import MESH_ANNOTATION
+from k8s_vgpu_scheduler_tpu.scheduler import (
+    DeviceInfo,
+    NodeInfo,
+    Scheduler,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.gang import (
+    GANG_GROUP_ANNOTATION,
+    GANG_TOTAL_ANNOTATION,
+)
+from k8s_vgpu_scheduler_tpu.scheduler.preempt import PREEMPT_ANNOTATION
+from k8s_vgpu_scheduler_tpu.scheduler.webhook import handle_admission_review
+from k8s_vgpu_scheduler_tpu.tpulib import TopologyDesc
+from k8s_vgpu_scheduler_tpu.util import nodelock
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+V5E_4x4 = TopologyDesc(generation="v5e", mesh=(4, 4))
+LADDER_2x2_4x4 = [(4, 4), (4, 2), (2, 4), (2, 2)]
+
+
+# ---------------------------------------------------------------------------
+# range grammar + ladder (pure shape math)
+# ---------------------------------------------------------------------------
+
+class TestRangeGrammar:
+    def test_format_mesh(self):
+        assert format_mesh((2, 4)) == "2x4"
+        assert format_mesh((8,)) == "8"
+
+    def test_divisor_steps_largest_first(self):
+        # Axis sizes step by divisors (min_i | s and s | max_i), never
+        # through shapes GSPMD cannot fold to; largest volume first.
+        assert mesh_range_shapes((2, 2), (4, 4)) == LADDER_2x2_4x4
+
+    def test_min_right_padded_to_max_rank(self):
+        assert mesh_range_shapes((2,), (2, 2)) == [(2, 2), (2, 1)]
+
+    def test_empty_when_no_divisor_step_exists(self):
+        assert mesh_range_shapes((3,), (4,)) == []
+
+    def test_empty_when_min_outranks_max(self):
+        assert mesh_range_shapes((2, 2, 2), (4, 4)) == []
+
+    def test_ladder_requires_whole_member_count(self):
+        assert mesh_ladder((2, 2), (4, 4), 4, [V5E_4x4]) == LADDER_2x2_4x4
+        # nums=3 divides none of the volumes (16, 8, 8, 4): no rungs.
+        assert mesh_ladder((2, 2), (4, 4), 3, [V5E_4x4]) == []
+
+    def test_ladder_empty_fleet_skips_fold_check(self):
+        # The webhook's cold-boot rule: a bootstrapping cluster with no
+        # observed topologies must not reject its first elastic gang.
+        assert mesh_ladder((2, 2), (4, 4), 4, []) == LADDER_2x2_4x4
+
+    def test_ladder_drops_rungs_no_topology_realizes(self):
+        tiny = TopologyDesc(generation="v5e", mesh=(2, 1))
+        assert mesh_ladder((2, 2), (4, 4), 4, [tiny]) == []
+
+    def test_next_smaller_skips_equal_volume_rungs(self):
+        # 4x2 -> 2x2, never the equal-volume 2x4 (a lateral move frees
+        # nothing, so it is not a shrink).
+        assert next_smaller(LADDER_2x2_4x4, (4, 2)) == (2, 2)
+        assert next_smaller(LADDER_2x2_4x4, (4, 4)) == (4, 2)
+        assert next_smaller(LADDER_2x2_4x4, (2, 2)) is None
+
+    def test_next_larger_one_rung_at_a_time(self):
+        assert next_larger(LADDER_2x2_4x4, (2, 2)) == (2, 4)
+        assert next_larger(LADDER_2x2_4x4, (2, 4)) == (4, 4)
+        assert next_larger(LADDER_2x2_4x4, (4, 4)) is None
+
+    def test_elastic_range_of(self):
+        assert elastic_range_of({}) is None
+        assert elastic_range_of({MESH_ANNOTATION: "2x2"}) is None
+        assert elastic_range_of({MESH_MIN_ANNOTATION: "2x2"}) == ("2x2", "")
+        assert elastic_range_of({MESH_MIN_ANNOTATION: "2x2",
+                                 MESH_MAX_ANNOTATION: "4x4"}) \
+            == ("2x2", "4x4")
+
+    def test_requester_label_bounded_cardinality(self):
+        assert requester_label(RECLAIM_SHRINK_PREFIX + "e1/ns/g") == "reclaim"
+        assert requester_label("rescue:defrag:d/ns/g") == "defrag"
+        assert requester_label(GROW_REQUESTER_PREFIX + "ns/g") == "grow"
+        assert requester_label(ADMISSION_REQUESTER_PREFIX + "ns/g") \
+            == "admission"
+        assert requester_label("rescue:lease-expired") == "other"
+
+
+class TestValidateRange:
+    def test_valid_range_passes(self):
+        assert validate_mesh_range("2x2", "4x4", "4x4", 4, 4,
+                                   [V5E_4x4]) is None
+
+    def test_single_member_generation_is_legitimate(self):
+        # gang-total 1 is a fully-shrunk generation (one member's worth
+        # of chips), NOT a non-gang pod.
+        assert validate_mesh_range("2x2", "4x4", "2x2", 4, 1,
+                                   [V5E_4x4]) is None
+        why = validate_mesh_range("2x2", "4x4", "2x2", 4, 0, [V5E_4x4])
+        assert why is not None and "non-gang" in why
+
+    def test_malformed_current_mesh_not_double_reported(self):
+        # validate_mesh already rejects "2x" with its own message.
+        assert validate_mesh_range("2x2", "4x4", "2x", 4, 4,
+                                   [V5E_4x4]) is None
+
+
+# ---------------------------------------------------------------------------
+# webhook: malformed ranges are 422s, bare vtpu.dev/mesh stays inert
+# ---------------------------------------------------------------------------
+
+def range_pod(name="m", uid="um", tpu=4, mesh="4x4", mn="2x2", mx="4x4",
+              gang="train", gang_total=4):
+    anns = {}
+    if mesh:
+        anns[MESH_ANNOTATION] = mesh
+    if mn:
+        anns[MESH_MIN_ANNOTATION] = mn
+    if mx:
+        anns[MESH_MAX_ANNOTATION] = mx
+    if gang:
+        anns[GANG_GROUP_ANNOTATION] = gang
+        anns[GANG_TOTAL_ANNOTATION] = str(gang_total)
+    return {
+        "metadata": {"name": name, "namespace": "default", "uid": uid,
+                     "annotations": anns},
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {"google.com/tpu": str(tpu),
+                                     "google.com/tpumem": "4000"}}}]},
+    }
+
+
+class TestWebhookRangeValidation:
+    CFG = Config()
+
+    def _review(self, pod, topologies=(V5E_4x4,)):
+        body = {"request": {"uid": "rq", "operation": "CREATE",
+                            "object": pod}}
+        return handle_admission_review(body, self.CFG,
+                                       topologies=list(topologies))
+
+    def _rejects(self, pod, *needles):
+        r = self._review(pod)["response"]
+        assert r["allowed"] is False
+        assert r["status"]["code"] == 422
+        for needle in needles:
+            assert needle in r["status"]["message"], r["status"]["message"]
+
+    def test_valid_range_admits(self):
+        out = self._review(range_pod())
+        assert out["response"]["allowed"] is True
+
+    def test_bare_mesh_without_range_stays_inert(self):
+        # No range annotations: exactly today's behavior, range
+        # validation never runs (inert-without-range parity).
+        out = self._review(range_pod(mn=None, mx=None))
+        assert out["response"]["allowed"] is True
+
+    def test_min_without_max_422(self):
+        self._rejects(range_pod(mx=None), "without", MESH_MAX_ANNOTATION)
+
+    def test_max_without_min_422(self):
+        self._rejects(range_pod(mn=None), "without", MESH_MIN_ANNOTATION)
+
+    def test_malformed_min_422(self):
+        self._rejects(range_pod(mn="2x"), MESH_MIN_ANNOTATION)
+
+    def test_malformed_max_422(self):
+        self._rejects(range_pod(mx="x4"), MESH_MAX_ANNOTATION)
+
+    def test_non_gang_pod_422(self):
+        self._rejects(range_pod(gang=None, mesh="2x2"), "non-gang",
+                      "pod-group")
+
+    def test_single_member_generation_admits(self):
+        out = self._review(range_pod(mesh="2x2", gang_total=1))
+        assert out["response"]["allowed"] is True
+
+    def test_range_without_current_mesh_422(self):
+        self._rejects(range_pod(mesh=None), "current shape")
+
+    def test_min_volume_exceeds_max_422(self):
+        self._rejects(range_pod(mn="4x4", mx="2x2", mesh="2x2",
+                                gang_total=1), "exceeds")
+
+    def test_min_rank_exceeds_max_422(self):
+        self._rejects(range_pod(mn="2x2x2", mx="4x4"), "more axes")
+
+    def test_empty_ladder_422(self):
+        # 3..4 admits no divisor step on the axis: the grammar is empty.
+        self._rejects(range_pod(mn="3x1", mx="4x1", mesh="4x1",
+                                gang_total=1), "no valid mesh shape")
+
+    def test_current_mesh_off_ladder_422(self):
+        self._rejects(range_pod(mesh="4x1", gang_total=1),
+                      "not a valid rung", "valid:")
+
+
+# ---------------------------------------------------------------------------
+# ResizeController protocol on the real scheduler
+# ---------------------------------------------------------------------------
+
+def build(nodes=1, enable_elastic=True, **cfg_kw):
+    """A 4x4-topology fleet (16 chips/node) on a virtual clock — the
+    test_quota builder with a 2-D mesh so gang slices exist."""
+    clock = SimClock()
+    cfg_kw.setdefault("resize_hysteresis_s", 60.0)
+    cfg_kw.setdefault("resize_checkpoint_grace_s", 50.0)
+    cfg_kw.setdefault("elastic_downgrade_after_s", 5.0)
+    cfg = Config(enable_elastic=enable_elastic, **cfg_kw)
+    kube = FakeKube()
+    s = Scheduler(kube, cfg, clock=clock)
+    names = []
+    for i in range(nodes):
+        n = f"n{i}"
+        names.append(n)
+        kube.add_node({"metadata": {"name": n, "annotations": {}}})
+        devs = [DeviceInfo(id=f"{n}-c{x}-{y}", count=1, devmem=16384,
+                           type="TPU-v5e", health=True, coords=(x, y))
+                for x, y in itertools.product(range(4), range(4))]
+        s.nodes.add_node(n, NodeInfo(name=n, devices=devs,
+                                     topology=V5E_4x4))
+    kube.watch_pods(s.on_pod_event)
+    return s, kube, names, clock
+
+
+def gang_manifests(mesh="4x4", gen=0, group="train", nums=4,
+                   mn="2x2", mx="4x4", ns="default"):
+    vol = 1
+    for d in mesh.split("x"):
+        vol *= int(d)
+    total = vol // nums
+    pods = []
+    for i in range(total):
+        name = f"{group}-g{gen}-{i}"
+        pods.append({
+            "metadata": {
+                "name": name, "namespace": ns, "uid": f"uid-{ns}-{name}",
+                "annotations": {
+                    MESH_ANNOTATION: mesh,
+                    MESH_MIN_ANNOTATION: mn,
+                    MESH_MAX_ANNOTATION: mx,
+                    GANG_GROUP_ANNOTATION: group,
+                    GANG_TOTAL_ANNOTATION: str(total),
+                }},
+            "spec": {"containers": [{
+                "name": "main",
+                "resources": {"limits": {"google.com/tpu": str(nums),
+                                         "google.com/tpumem": "4000"}}}]},
+        })
+    return pods
+
+
+def place_gang(s, kube, pods, names):
+    for p in pods:
+        kube.create_pod(p)
+    for p in pods:
+        s.filter(p, names)   # co-scheduling barrier: members register
+    for p in pods:
+        r = s.filter(p, names)
+        assert r.node, f"{p['metadata']['name']}: {r.error}"
+        s.bind(p["metadata"]["namespace"], p["metadata"]["name"],
+               p["metadata"]["uid"], r.node)
+        nodelock.release_node(kube, r.node)
+
+
+def checkpoint_and_exit(s, kube, pods):
+    """Play the in-container watch: the flagged members checkpoint and
+    terminate (the workload controller's recreate is a separate step)."""
+    for p in pods:
+        kube.delete_pod(p["metadata"]["namespace"], p["metadata"]["name"])
+
+
+class TestResizeController:
+    def test_discovery_and_shrinkable_set(self):
+        s, kube, names, clock = build()
+        place_gang(s, kube, gang_manifests(), names)
+        gangs = s.elastic.elastic_gangs()
+        assert len(gangs) == 1
+        g = gangs[0]
+        assert g.key == "default/train"
+        assert g.current == (4, 4) and g.at_max and g.admitted
+        assert g.ladder == LADDER_2x2_4x4
+        assert g.nums == 4
+        offers = s.elastic.shrinkable_uids()
+        assert set(offers) == set(g.member_uids)
+        assert set(offers.values()) == {"default/train"}
+        s.close()
+
+    def test_off_switch_is_inert(self):
+        s, kube, names, clock = build(enable_elastic=False)
+        place_gang(s, kube, gang_manifests(), names)
+        # Discovery still reads (pure), but every planner-facing
+        # surface is empty/None — existing paths stay byte-identical.
+        assert s.elastic.shrinkable_uids() == {}
+        assert s.elastic.begin_shrink(
+            "default/train", RECLAIM_SHRINK_PREFIX + "e/default/train") \
+            is None
+        assert s.elastic.tick() == []
+        s.elastic.observe_rejection("default/train")
+        assert s.elastic.in_flight() == {}
+        assert s.elastic.resizes_total == {} and s.elastic.thrash_total == 0
+        s.close()
+
+    def test_shrink_protocol_end_to_end(self):
+        s, kube, names, clock = build()
+        gen0 = gang_manifests()
+        place_gang(s, kube, gen0, names)
+        requester = RECLAIM_SHRINK_PREFIX + "entry1/default/train"
+        act = s.elastic.begin_shrink("default/train", requester,
+                                     reason="queue a over quota")
+        assert act is not None
+        assert act["kind"] == "resize-shrink"
+        assert act["from"] == "4x4" and act["to"] == "4x2"
+        assert act["freed_chips"] == 8 and act["members"] == 4
+        assert s.elastic.resizes_total == {("shrink", "reclaim"): 1}
+        # Every member carries the assigned rung AND the checkpoint
+        # request, and sits in the shared preemption ledger.
+        for p in gen0:
+            live = kube.get_pod("default", p["metadata"]["name"])
+            anns = live["metadata"]["annotations"]
+            assert anns[MESH_ASSIGNED_ANNOTATION] == "4x2"
+            assert anns.get(PREEMPT_ANNOTATION)
+            assert p["metadata"]["uid"] in s._preempt_requested
+            stages = [r["stage"] for r in
+                      s.provenance.explain(p["metadata"]["uid"])["records"]]
+            assert "resize-shrink" in stages
+        assert s.elastic.pod_states()["resizing"] == 4
+        # Members checkpoint and exit; the next tick completes the
+        # resize and rescinds the synthetic requester's preemptions.
+        checkpoint_and_exit(s, kube, gen0)
+        acts = s.elastic.tick()
+        assert [a["kind"] for a in acts] == ["resize-complete"]
+        assert acts[0]["to"] == "4x2"
+        assert s.elastic.completed_total == 1
+        assert s.elastic.in_flight() == {}
+        assert s._preempt_requested == {}
+        # The workload controller recreates the gang one rung down:
+        # fresh uids, same group, new total — re-admitted normally.
+        gen1 = gang_manifests(mesh="4x2", gen=1)
+        assert len(gen1) == 2
+        place_gang(s, kube, gen1, names)
+        g = s.elastic.elastic_gangs()[0]
+        assert g.current == (4, 2) and g.admitted and not g.at_max
+        assert s.elastic.pod_states()["shrunk"] == 2
+        s.close()
+
+    def test_no_double_evict_across_requesters(self):
+        """The acceptance-criteria proof: once ANY mover holds a gang —
+        an in-flight resize, a rescuer sweep, or another requester's
+        preemption — reclaim, defrag and the controller itself all see
+        it as busy.  No member ever carries two eviction requests."""
+        s, kube, names, clock = build()
+        gen0 = gang_manifests()
+        place_gang(s, kube, gen0, names)
+        uids = [p["metadata"]["uid"] for p in gen0]
+
+        # 1. Reclaim wins the race: the shrink goes in-flight.
+        assert s.elastic.begin_shrink(
+            "default/train", RECLAIM_SHRINK_PREFIX + "e1/default/train") \
+            is not None
+        # The eligibility set BOTH planners consume is now empty, so
+        # neither reclaim nor defrag can select these members again.
+        assert s.elastic.shrinkable_uids() == {}
+        # A concurrent defrag shrink of the same gang is refused...
+        assert s.elastic.begin_shrink(
+            "default/train", "rescue:defrag:d1/default/train") is None
+        # ...as is a concurrent grow, and the tick plans nothing new.
+        assert s.elastic.begin_grow("default/train") is None
+        assert all(a["kind"] != "resize-grow" for a in s.elastic.tick())
+        # Exactly one preemption request per member, owned by reclaim.
+        assert sorted(s._preempt_requested) == sorted(uids)
+        assert s.elastic.resizes_total == {("shrink", "reclaim"): 1}
+
+        # 2. Symmetric half: with the resize done and a NEW generation
+        # admitted, a rescuer sweep holding one member blocks resize.
+        checkpoint_and_exit(s, kube, gen0)
+        s.elastic.tick()
+        gen1 = gang_manifests(mesh="4x2", gen=1)
+        place_gang(s, kube, gen1, names)
+        clock.advance(1000.0)   # clear hysteresis/backoff
+        assert s.elastic.shrinkable_uids() != {}
+        s.rescuer.enqueue(gen1[0]["metadata"]["uid"], "lease-expired")
+        assert s.elastic.shrinkable_uids() == {}
+        assert s.elastic.begin_shrink(
+            "default/train", RECLAIM_SHRINK_PREFIX + "e2/default/train") \
+            is None
+        assert all(a["kind"] != "resize-grow" for a in s.elastic.tick())
+        s.close()
+
+    def test_grow_blocked_by_capacity_is_not_thrash(self):
+        # One 16-chip node: a 4x2 gang can never grow to 4x4 without
+        # counting its own chips.  A full fleet is not oscillation —
+        # the thrash counter must stay at zero.
+        s, kube, names, clock = build(nodes=1)
+        place_gang(s, kube, gang_manifests(), names)
+        s.elastic.begin_shrink("default/train",
+                               RECLAIM_SHRINK_PREFIX + "e1/default/train")
+        checkpoint_and_exit(s, kube, gang_manifests())
+        s.elastic.tick()
+        gen1 = gang_manifests(mesh="4x2", gen=1)
+        place_gang(s, kube, gen1, names)
+        for _ in range(5):
+            clock.advance(10.0)
+            assert all(a["kind"] != "resize-grow"
+                       for a in s.elastic.tick())
+        assert s.elastic.thrash_total == 0
+        s.close()
+
+    def test_grow_hysteresis_counts_thrash_once_then_grows(self):
+        # Two nodes: after the shrink the fleet COULD host 4x4 again
+        # immediately — growing right back is thrash.  The attempt is
+        # suppressed (counted once, not per tick) until the quiet
+        # window passes, then the gang steps back up.
+        s, kube, names, clock = build(nodes=2, resize_hysteresis_s=60.0)
+        place_gang(s, kube, gang_manifests(), names)
+        s.elastic.begin_shrink("default/train",
+                               RECLAIM_SHRINK_PREFIX + "e1/default/train")
+        checkpoint_and_exit(s, kube, gang_manifests())
+        s.elastic.tick()
+        gen1 = gang_manifests(mesh="4x2", gen=1)
+        place_gang(s, kube, gen1, names)
+        clock.advance(10.0)
+        assert s.elastic.tick() == []
+        assert s.elastic.thrash_total == 1
+        clock.advance(10.0)
+        assert s.elastic.tick() == []
+        assert s.elastic.thrash_total == 1   # once per resize, not per tick
+        clock.advance(60.0)
+        acts = s.elastic.tick()
+        assert [a["kind"] for a in acts] == ["resize-grow"]
+        assert acts[0]["from"] == "4x2" and acts[0]["to"] == "4x4"
+        assert s.elastic.resizes_total[("grow", "grow")] == 1
+        # Grow completes through the same checkpoint-restart protocol.
+        checkpoint_and_exit(s, kube, gen1)
+        acts = s.elastic.tick()
+        assert [a["kind"] for a in acts] == ["resize-complete"]
+        gen2 = gang_manifests(mesh="4x4", gen=2)
+        place_gang(s, kube, gen2, names)
+        assert s.elastic.elastic_gangs()[0].current == (4, 4)
+        assert s.elastic.pod_states()["at-max"] == 4
+        s.close()
+
+    def test_checkpoint_grace_abort_rolls_back(self):
+        s, kube, names, clock = build(resize_checkpoint_grace_s=50.0)
+        gen0 = gang_manifests()
+        place_gang(s, kube, gen0, names)
+        s.elastic.begin_shrink("default/train",
+                               RECLAIM_SHRINK_PREFIX + "e1/default/train")
+        # Members never checkpoint: past the grace the resize aborts,
+        # mesh-assigned rolls back, and the gang backs off.
+        clock.advance(51.0)
+        acts = s.elastic.tick()
+        assert [a["kind"] for a in acts] == ["resize-abort"]
+        assert s.elastic.aborted_total == 1
+        assert s._preempt_requested == {}
+        for p in gen0:
+            live = kube.get_pod("default", p["metadata"]["name"])
+            assert not live["metadata"]["annotations"].get(
+                MESH_ASSIGNED_ANNOTATION)
+        assert s.elastic.begin_shrink(
+            "default/train", RECLAIM_SHRINK_PREFIX + "e2/default/train") \
+            is None   # backoff window
+        clock.advance(51.0)
+        assert s.elastic.begin_shrink(
+            "default/train", RECLAIM_SHRINK_PREFIX + "e3/default/train") \
+            is not None
+        s.close()
+
+    def test_admission_downgrade_steps_pending_gang_down(self):
+        # 8 of 16 chips occupied: a 4x4 gang (16 chips) can never
+        # place, but its 4x2 rung (8 chips) can.  The controller steps
+        # the PENDING gang down after sustained Filter rejections.
+        s, kube, names, clock = build(nodes=1,
+                                      elastic_downgrade_after_s=5.0)
+        for i in range(2):
+            filler = {
+                "metadata": {"name": f"f{i}", "namespace": "default",
+                             "uid": f"uid-f{i}", "annotations": {}},
+                "spec": {"containers": [{
+                    "name": "main",
+                    "resources": {"limits": {
+                        "google.com/tpu": "4",
+                        "google.com/tpumem": "4000"}}}]},
+            }
+            kube.create_pod(filler)
+            r = s.filter(filler, names)
+            assert r.node
+            s.bind("default", f"f{i}", f"uid-f{i}", r.node)
+            nodelock.release_node(kube, r.node)
+        gen0 = gang_manifests()
+        for p in gen0:
+            kube.create_pod(p)
+        for p in gen0:
+            assert not s.filter(p, names).node   # rejection observed
+        clock.advance(6.0)
+        for p in gen0:
+            assert not s.filter(p, names).node
+        acts = s.elastic.tick()
+        assert [a["kind"] for a in acts] == ["resize-downgrade"]
+        assert acts[0]["from"] == "4x4" and acts[0]["to"] == "4x2"
+        assert acts[0]["requester"].startswith(ADMISSION_REQUESTER_PREFIX)
+        assert s.elastic.resizes_total[("shrink", "admission")] == 1
+        for p in gen0:
+            live = kube.get_pod("default", p["metadata"]["name"])
+            assert live["metadata"]["annotations"][
+                MESH_ASSIGNED_ANNOTATION] == "4x2"
+        # The same generation is never stepped down twice in a row
+        # while the workload controller recreates it (backoff).
+        assert s.elastic.tick() == []
+        # Recreated at the assigned rung, it places.
+        checkpoint_and_exit(s, kube, gen0)
+        gen1 = gang_manifests(mesh="4x2", gen=1)
+        place_gang(s, kube, gen1, names)
+        assert s.elastic.elastic_gangs()[0].admitted
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-shape checkpoint restore (the resume-bit-identical contract)
+# ---------------------------------------------------------------------------
+
+class TestCrossShapeRestore:
+    def test_resharded_restore_is_bit_identical(self):
+        """The workload-controller half of the resize protocol: a
+        checkpoint taken at one rung, restored at another member
+        count, must continue the trajectory bit-identically.  Modeled
+        as member-sharded state gathered to a canonical array and
+        re-sharded; the simulator's hash-chain (cmd/simulate.py
+        elastic section) proves the same property end-to-end."""
+        rng = np.random.default_rng(7)
+        state = rng.standard_normal((16, 8))
+
+        def run(member_counts):
+            x = state.copy()
+            for step, members in enumerate(member_counts):
+                shards = np.split(x, members, axis=0)   # checkpoint…
+                x = np.concatenate(shards, axis=0)      # …restore
+                x = x * 1.000001 + step                 # one train step
+            return x
+
+        steady = run([4, 4, 4, 4])
+        resized = run([4, 2, 1, 4])   # shrink, shrink, grow past start
+        np.testing.assert_array_equal(steady, resized)

@@ -226,7 +226,8 @@ def test_grafana_dashboard_uses_real_metric_names():
     # aggregation labels)
     referenced -= {"rate", "label_values", "node", "histogram_quantile",
                    "phase", "reason", "clamp_min", "class", "queue",
-                   "lock", "generation", "mode", "type", "time"}
+                   "lock", "generation", "mode", "type", "time",
+                   "direction", "requester", "state"}
 
     missing = referenced - _emitted_metrics()
     assert not missing, f"dashboard references unknown metrics: {missing}"
