@@ -3,7 +3,8 @@
 
 .PHONY: all native test test-fast test-slow chaos-smoke quota-sim \
         defrag-sim ha-sim qos-sim capacity-sim steady-sim explain-sim \
-        audit-sim elastic-sim bench-multicore batch-protocol shard-protocol \
+        audit-sim elastic-sim slo-sim bench-multicore batch-protocol \
+        shard-protocol \
         lint-dashboards dryrun scenarios controlplane \
         bench-controlplane bench-steady bench-explain bench wheel clean
 
@@ -163,6 +164,21 @@ elastic-sim:                  ## elastic resize-vs-kill A/B in the simulator
 	    --workload examples/workload-elastic.json \
 	    --nodes 2 --chips 16 --mesh 4x4 --json \
 	  | python -c "import json,sys; v = json.load(sys.stdin)['elastic']['verdict']; assert v['ok'], v; print('elastic-sim:', v)"
+
+# Fleet SLO engine adversarial proof (slo/; docs/observability.md
+# "SLOs"): three acts on the virtual clock — clean storm (100%
+# attainment, zero burn signals), overload + replica kill (exactly the
+# two targeted objectives breach, fast/page pairs fire within one short
+# window of the first bad event and strictly before slow/ticket,
+# budgets deplete monotonically), recovery (every signal auto-clears,
+# budgets still show the damage).  Deterministic apart from the
+# wall-clock overhead A/B, which gates the engine sweep <2% of the
+# 256-pod batched drain.  The verdict gates CI.
+slo-sim:                      ## burn-rate/error-budget three-act proof (simulator)
+	python -m k8s_vgpu_scheduler_tpu.cmd.simulate \
+	    --workload examples/workload-slo.json \
+	    --nodes 6 --chips 4 --hbm 8000 --json \
+	  | python -c "import json,sys; v = json.load(sys.stdin)['slo']['verdict']; assert v['ok'], v; print('slo-sim:', v)"
 
 # The ISSUE 13 emit-overhead gate at full bench scale: decision
 # provenance ON vs --no-provenance, ABBA per-cycle alternation on

@@ -421,6 +421,48 @@ def snapshot_audit() -> None:
         f"{doc['sweeps'].get('last_clean_age_s')!r}s ago)")
 
 
+def snapshot_slo() -> None:
+    """Fleet SLO capture (docs/observability.md "SLOs"): during any
+    healthy window, snapshot a LIVE scheduler's /sloz — per-objective
+    attainment, error-budget remainders, open multi-window burn
+    signals — into benchmarks/captured-slo-<round>.json alongside the
+    other captures.  A real fleet's attainment mix (and which window
+    pairs actually fire) is the ground truth the slo-sim's thresholds
+    and the alert rules are calibrated against.  Pure HTTP + JSON —
+    never touches the chip or the pool claim; skips loudly when no
+    scheduler is reachable or the engine is disabled."""
+    url = os.environ.get("VTPU_SCHED_URL", "")
+    if not url:
+        log("slo snapshot: VTPU_SCHED_URL unset; skipping")
+        return
+    import urllib.request
+
+    base = url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    try:
+        with urllib.request.urlopen(base + "/sloz", timeout=10) as r:
+            doc = json.load(r)
+    except Exception as e:  # noqa: BLE001 — capture is best-effort
+        log(f"slo snapshot: cannot fetch {base}/sloz: {e!r}")
+        return
+    if "objectives" not in doc:
+        log("slo snapshot: /sloz disabled or pre-SLO scheduler; "
+            "skipping")
+        return
+    if not doc.get("sweeps", {}).get("total"):
+        log("slo snapshot: no sweeps recorded yet; skipping")
+        return
+    out = os.path.join(REPO, "benchmarks",
+                       f"captured-slo-{round_id()}.json")
+    with open(out, "w") as f:
+        json.dump({"captured_at": time.time(), "sloz": doc}, f,
+                  indent=1)
+    log(f"slo snapshot: wrote {out} ({len(doc['objectives'])} "
+        f"objective(s), {len(doc.get('signals_open', []))} open burn "
+        f"signal(s), {doc['sweeps']['total']} sweep(s))")
+
+
 def run_queue(kinds) -> bool:
     """Run the queue sequentially; False if a child overran or left a
     detached claim-holder (stop — the pool claim may still be held)."""
@@ -436,6 +478,8 @@ def run_queue(kinds) -> bool:
         snapshot_explain()
     if "audit" in kinds:
         snapshot_audit()
+    if "slo" in kinds:
+        snapshot_slo()
 
     tmpdir = tempfile.mkdtemp(prefix="poolwatch-")
     env = bench.shim_env(tmpdir)
@@ -546,7 +590,7 @@ def main() -> None:
     ap.add_argument("--max-hours", type=float, default=6.0)
     ap.add_argument(
         "--tasks",
-        default="bench,model,micro,scen,oversub,capacity,perf,explain,audit")
+        default="bench,model,micro,scen,oversub,capacity,perf,explain,audit,slo")
     a = ap.parse_args()
     # One round identity for the whole run: model_tasks' per-round retry
     # markers and run_queue's scenario children both read SCENARIO_ROUND,

@@ -88,6 +88,11 @@ class UsageLedger:
         #: make a Prometheus counter go backwards (rate() then reads
         #: the dip as a reset and reports a spurious spike).
         self._qos_retired: Dict[str, tuple] = {}
+        #: Lifetime count of absorbed usage rows — a cheap dirty check
+        #: for readers that derive purely from ledger state (the SLO
+        #: engine skips its ledger-sourced SLIs on sweeps where no new
+        #: row arrived).
+        self.records_total = 0
 
     def now(self) -> float:
         return self._clock()
@@ -151,6 +156,7 @@ class UsageLedger:
                 acct._series.append(
                     (now, acct.chip_seconds, acct.hbm_byte_seconds))
                 n += 1
+            self.records_total += n
             self._prune_locked(now)
         return n
 

@@ -315,6 +315,23 @@ def parse_args(argv=None):
                    help="audit sweep period (seconds); delta sweeps "
                         "re-verify only nodes that changed since the "
                         "last sweep, so steady-state cost tracks churn")
+    # Fleet SLO engine (slo/; docs/observability.md "SLOs").
+    p.add_argument("--no-slo", action="store_true",
+                   help="disable the fleet SLO engine (error-budget "
+                        "ledgers and multi-window burn-rate signals "
+                        "behind GET /sloz, vtpu-slo and the vtpu_slo_* "
+                        "metrics); the engine is also inert when "
+                        "--slo-config declares no objectives")
+    p.add_argument("--slo-config", default="",
+                   help="path to the SLO objective config JSON/YAML "
+                        "({'objectives': [{'name', 'sli', 'target', "
+                        "'scope', 'threshold_s', ...}]}); empty = no "
+                        "objectives and the engine stays inert")
+    p.add_argument("--slo-interval", type=float, default=15.0,
+                   help="SLO sweep period (seconds); each sweep drains "
+                        "new events from the quota release log, "
+                        "provenance spans and counters, then "
+                        "re-evaluates burn-rate windows")
     p.add_argument("--audit-full-sweep-every", type=int, default=8,
                    help="every Nth sweep is a full-fleet cross-plane "
                         "pass (kube annotation WAL, usage ledger, "
@@ -389,6 +406,37 @@ def load_quota_config(path: str) -> tuple:
     return tuple(doc.get("queues", []))
 
 
+def load_slo_config(path: str) -> tuple:
+    """--slo-config file → Config.slo_objectives tuple.  Same
+    discipline as load_quota_config: JSON first, YAML fallback (the
+    chart renders values into slo.yaml), and parse_slo_config raises
+    at boot so a misdeclared objective never comes up half-measured."""
+    if not path:
+        return ()
+    import json
+
+    from ..slo.objectives import parse_slo_config
+
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        import yaml
+
+        doc = yaml.safe_load(text)
+    if doc is None:
+        return ()  # empty / comments-only file = SLO engine inert
+    if not isinstance(doc, (dict, list)):
+        raise ValueError(
+            f"--slo-config {path}: expected a mapping with an "
+            f"'objectives' list, got {type(doc).__name__}")
+    parse_slo_config(doc)  # raise early on bad config
+    if isinstance(doc, list):
+        return tuple(doc)
+    return tuple(doc.get("objectives", []))
+
+
 def build_config(args) -> Config:
     return Config(
         resources=ResourceNames(
@@ -409,6 +457,9 @@ def build_config(args) -> Config:
         perf_tracemalloc=args.perf_tracemalloc,
         audit_enabled=not args.no_audit,
         audit_interval_s=args.audit_interval,
+        slo_enabled=not args.no_slo,
+        slo_objectives=load_slo_config(args.slo_config),
+        slo_interval_s=args.slo_interval,
         audit_full_sweep_every=args.audit_full_sweep_every,
         audit_usage_stale_s=args.audit_usage_stale,
         provenance_enabled=not args.no_provenance,
@@ -559,6 +610,10 @@ def main(argv=None):
     # with --no-audit).  After the boot reconcile so the first full
     # sweep verifies a populated registry, not an empty one.
     scheduler.auditor.start()
+    # Fleet SLO engine: error-budget sweeps over the sources the
+    # auditor and ledgers already maintain (no new probes).  Inert
+    # without --slo-config objectives or with --no-slo.
+    scheduler.slo.start()
     # Active-active HA: join the shard map SYNCHRONOUSLY before any
     # server accepts traffic (an unfenced replica serving /filter could
     # place on shards it does not own), then keep coordinating on the
@@ -626,6 +681,7 @@ def main(argv=None):
         scheduler.elastic.stop()
         scheduler.shards.stop()
         scheduler.auditor.stop()
+        scheduler.slo.stop()
         http_server.stop()
         grpc_server.stop(grace=2)
         # Drains the solve-worker pool and unlinks its shared-memory

@@ -106,6 +106,24 @@ def fetch_audit(cluster: str) -> Optional[dict]:
     return doc if "open_total" in doc else None
 
 
+def fetch_slo(cluster: str) -> Optional[dict]:
+    """GET /sloz, or None when the scheduler predates the SLO engine /
+    runs --no-slo / declares no objectives — the report then shows the
+    slo line as '-' instead of a section (same degradation pattern as
+    fetch_audit)."""
+    import urllib.request
+
+    url = _base_url(cluster)
+    if not url.endswith("/sloz"):
+        url += "/sloz"
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            doc = json.load(r)
+    except Exception:  # noqa: BLE001 — SLO surface is optional
+        return None
+    return doc if "objectives" in doc else None
+
+
 def fetch_explain(cluster: str, ref: str) -> Optional[dict]:
     """GET /explainz for one pod, or None when the scheduler predates
     decision provenance / runs --no-provenance / never saw the pod —
@@ -254,6 +272,35 @@ def format_audit(audit: Optional[dict]) -> str:
     return "\n".join(lines)
 
 
+def format_slo(slo: Optional[dict]) -> str:
+    """The ``vtpu-report`` slo section: attainment and budget per
+    objective plus any open burn signals (GET /sloz).  ``None``
+    (pre-SLO scheduler, --no-slo, or no objectives declared) degrades
+    to a '-' line, same as the audit section."""
+    if slo is None:
+        return "+ slo: - (no /sloz on this scheduler)"
+    objectives = slo.get("objectives", [])
+    open_sig = slo.get("signals_open", [])
+    if not open_sig:
+        head = (f"+ slo: {len(objectives)} objective(s), no burn "
+                "signal open (vtpu-slo for detail)")
+    else:
+        by_sev = slo.get("signals_open_by_severity", {})
+        head = (f"+ slo: {len(open_sig)} OPEN burn signal(s) "
+                f"({by_sev.get('page', 0)} page, "
+                f"{by_sev.get('ticket', 0)} ticket; vtpu-slo for "
+                "triage)")
+    lines = [head]
+    for o in objectives:
+        att = o.get("attainment")
+        lines.append(
+            "|   {:<34s} attained {:>9s}  budget {:>6.1%}".format(
+                o["objective"][:34],
+                f"{att:.4%}" if att is not None else "-",
+                o.get("error_budget_remaining_ratio", 1.0)))
+    return "\n".join(lines)
+
+
 def format_report(export: dict, pods: bool = False,
                   stale_after_s: float = DEFAULT_STALE_AFTER_S) -> str:
     fleet = export.get("fleet", {})
@@ -333,6 +380,8 @@ def format_report(export: dict, pods: bool = False,
         lines.append(format_capacity(export["capacity"]))
     if "audit" in export:
         lines.append(format_audit(export["audit"]))
+    if "slo" in export:
+        lines.append(format_slo(export["slo"]))
     return "\n".join(lines)
 
 
@@ -355,6 +404,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="skip the GET /capacityz capacity section")
     p.add_argument("--no-audit", action="store_true",
                    help="skip the GET /auditz fleet-audit section")
+    p.add_argument("--no-slo", action="store_true",
+                   help="skip the GET /sloz SLO section")
     p.add_argument("--explain", default="", metavar="NS/NAME",
                    help="render one pod's decision-provenance timeline "
                         "(the vtpu-explain narrative) instead of the "
@@ -400,6 +451,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the report should see that audit state is UNKNOWN, not
         # silently assume clean).
         export["audit"] = fetch_audit(args.cluster)
+    if not args.no_slo:
+        # Same None-stays-in-the-export rule as audit: '-' over
+        # silently assuming every budget is healthy.
+        export["slo"] = fetch_slo(args.cluster)
     if args.as_json:
         print(json.dumps(export, indent=1))
     elif args.as_csv:

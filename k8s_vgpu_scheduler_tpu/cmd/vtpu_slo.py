@@ -1,0 +1,167 @@
+"""vtpu-slo — fleet SLO attainment and burn signals, human-readable.
+
+Fetches the extender's ``GET /sloz`` export (slo/engine.py) and renders
+the per-objective attainment/error-budget table plus the open
+multi-window burn-rate signals in triage order (pages before tickets).
+Exit code doubles as a probe: 0 = every budget healthy and no signals,
+1 = open burn signals, 2 = cannot fetch / SLO engine disabled — so
+``vtpu-slo --cluster ...`` drops straight into scripts and runbooks
+(docs/operations.md "Error-budget burn: triage by window").
+
+Usage:
+  vtpu-slo --cluster http://sched:9443
+  vtpu-slo --cluster ... --objective admission-latency   # one objective
+  vtpu-slo --cluster ... --json                          # raw /sloz
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+#: One-line triage hint per burn severity (the full runbook lives in
+#: docs/operations.md; this is the 2am version).
+TRIAGE = {
+    "page": "fast burn — at this rate the budget is gone in hours; "
+            "find the regressing release/tenant NOW",
+    "ticket": "slow burn — days of budget left; file it, fix it this "
+              "week before the fast window fires",
+}
+
+
+def fetch_slo(cluster: str, objective: str = "",
+              window: str = "") -> dict:
+    """GET /sloz; raises OSError/ValueError on transport/JSON failure.
+    A 404 body (engine disabled / no objectives declared) is returned
+    as a dict carrying ``enabled``/``error`` when the server sent
+    JSON."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from .vtpu_report import _base_url
+
+    url = _base_url(cluster)
+    if not url.endswith("/sloz"):
+        url += "/sloz"
+    params = []
+    if objective:
+        params.append("objective=" + urllib.parse.quote(objective,
+                                                        safe=""))
+    if window:
+        params.append("window=" + urllib.parse.quote(window, safe=""))
+    if params:
+        url += "?" + "&".join(params)
+    try:
+        with urllib.request.urlopen(url, timeout=15) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        try:
+            return json.load(e)
+        except Exception:  # noqa: BLE001 — non-JSON error body
+            raise OSError(f"HTTP {e.code} from {url}") from e
+
+
+def _budget_bar(ratio: float, width: int = 20) -> str:
+    filled = int(round(max(0.0, min(1.0, ratio)) * width))
+    return "#" * filled + "." * (width - filled)
+
+
+def render(doc: dict) -> str:
+    sw = doc.get("sweeps", {})
+    open_sig = doc.get("signals_open", [])
+    by_sev = doc.get("signals_open_by_severity", {})
+    lines = [
+        "fleet SLOs: {} objective(s); {} open burn signal(s) "
+        "({} page, {} ticket); {} sweep(s)".format(
+            len(doc.get("objectives", [])), len(open_sig),
+            by_sev.get("page", 0), by_sev.get("ticket", 0),
+            sw.get("total", 0)),
+    ]
+    for o in doc.get("objectives", []):
+        att = o.get("attainment")
+        budget = o.get("error_budget_remaining_ratio", 1.0)
+        lines.append(
+            "+ {:<34s} [{}] target {:>8.4%}  attained {:>9s}  "
+            "budget {:>6.1%} |{}|".format(
+                o["objective"][:34], o["sli"], o["target"],
+                f"{att:.4%}" if att is not None else "-",
+                budget, _budget_bar(budget)))
+        burning = {wl: w for wl, w in o.get("windows", {}).items()
+                   if w.get("burn_rate", 0.0) > 1.0}
+        if burning:
+            lines.append("|     burning > 1x budget: " + ", ".join(
+                f"{wl}={w['burn_rate']:.1f}x"
+                for wl, w in sorted(burning.items(),
+                                    key=lambda kv: -kv[1]["window_s"])))
+        if o.get("resets_observed"):
+            lines.append(f"|     {o['resets_observed']} source counter "
+                         "reset(s) absorbed (replica restarts)")
+    for s in open_sig:
+        lines.append(
+            "! {:<7s} {:<34s} {:<6s} long {:>5.1f}x / short {:>5.1f}x "
+            "(>= {:.1f}x) first {:>6.0f}s ago".format(
+                s["severity"].upper(), s["objective"][:34], s["pair"],
+                s["burn_long"], s["burn_short"], s["threshold"],
+                s["first_seen_age_s"]))
+        lines.append("|     "
+                     + TRIAGE.get(s["severity"],
+                                  "see docs/operations.md"))
+    if not open_sig:
+        lines.append("no burn signal open — every objective is "
+                     "spending its error budget slower than declared.")
+    cleared = doc.get("signals_cleared_recent", [])
+    if cleared:
+        lines.append(f"+ recently auto-cleared ({len(cleared)})")
+        for s in cleared[:8]:
+            lines.append(
+                "|   {:<7s} {:<34s} {:<6s} cleared, last burn "
+                "{:>4.0f}s ago".format(
+                    s["severity"], s["objective"][:34], s["pair"],
+                    s.get("last_seen_age_s", 0.0)))
+    c = doc.get("counters", {})
+    if c.get("dropped_total"):
+        lines.append(f"WARNING: {c['dropped_total']} signal(s) dropped "
+                     "at the store cap — more objectives are burning "
+                     "than this list enumerates")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser("vtpu-slo")
+    p.add_argument("--cluster", required=True,
+                   help="extender HTTP base URL (the /sloz endpoint), "
+                        "e.g. http://sched:9443")
+    p.add_argument("--objective", default="",
+                   help="show only this objective")
+    p.add_argument("--window", default="",
+                   help="show only this burn window (e.g. 1h, 5m)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="raw /sloz JSON")
+    args = p.parse_args(argv)
+    try:
+        doc = fetch_slo(args.cluster, objective=args.objective,
+                        window=args.window)
+    except (OSError, ValueError) as e:
+        print(f"vtpu-slo: cannot fetch /sloz: {e}", file=sys.stderr)
+        return 2
+    if not doc.get("enabled", True):
+        print("vtpu-slo: SLO engine disabled on this scheduler "
+              "(--no-slo, or no --slo-config objectives declared)",
+              file=sys.stderr)
+        return 2
+    if "objectives" not in doc:
+        print(f"vtpu-slo: unexpected /sloz shape: "
+              f"{json.dumps(doc)[:200]}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(render(doc))
+    return 1 if doc.get("signals_open") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,8 +97,15 @@ class ProvenanceConfig:
 class ProvenanceStore:
     """Per-process decision-timeline store (one per Scheduler)."""
 
-    def __init__(self, cfg: Optional[ProvenanceConfig] = None) -> None:
+    def __init__(self, cfg: Optional[ProvenanceConfig] = None,
+                 clock=None) -> None:
         self.cfg = cfg or ProvenanceConfig()
+        #: Record-timestamp source.  Wall time by default (explain
+        #: timelines carry operator-readable times); the simulator
+        #: injects its virtual clock so record-to-record latency math
+        #: (the SLO placement SLI) is deterministic.  Every record in
+        #: one store shares one base, so span deltas never mix clocks.
+        self._now = clock or time.time
         #: Mutable enable switch — the overhead A/B toggles it per leg;
         #: --no-provenance sets it False for the process lifetime.
         self.enabled = self.cfg.enabled
@@ -148,6 +155,14 @@ class ProvenanceStore:
         #: one redundant (deduped, correctly-ordered) wal-adopted seed,
         #: never a wrong answer.
         self._last_grant: Dict[str, str] = {}
+        #: uids whose decision-committed record folded since the last
+        #: ``terminal_spans(fresh_only=True)`` drain — the SLO engine's
+        #: incremental cursor, so each sweep touches O(new placements)
+        #: timelines instead of rescanning the whole store.  Tracking
+        #: starts at the first fresh-only call (which full-scans once);
+        #: until then folds pay nothing for it.
+        self._terminal_fresh: Dict[str, bool] = {}
+        self._track_terminals = False
         #: Solver name of the newest folded cycle segment — cycle
         #: records carry raw hand-over tuples; the explain read path
         #: stamps this into their normalized detail.
@@ -169,7 +184,7 @@ class ProvenanceStore:
         ring."""
         if not self.enabled or not uid:
             return
-        t = time.time()
+        t = self._now()
         with self._lock:
             if self._inbox:
                 self._fold_pending_locked()
@@ -196,6 +211,8 @@ class ProvenanceStore:
             recs.append((tl[_SEQ], t, stage, detail))
             tl[_SEQ] += 1
             self.emitted_total += 1
+            if self._track_terminals and stage == "decision-committed":
+                self._terminal_fresh[uid] = True
         if stage in TERMINAL_STAGES:
             # GIL-atomic dict store, read lock-free by the informer's
             # per-event guard.
@@ -211,7 +228,7 @@ class ProvenanceStore:
         (cycle emitters never repeat a record within a cycle)."""
         if not self.enabled or not records:
             return
-        self._inbox.append((time.time(), records))
+        self._inbox.append((self._now(), records))
         if self._folder is None and not self._closed:
             self._start_folder()
         if len(self._inbox) >= _INBOX_SEGMENTS:
@@ -237,7 +254,7 @@ class ProvenanceStore:
         (decision-committed) by definition."""
         if not self.enabled or not records:
             return
-        self._inbox.append((time.time(), (solver, records)))
+        self._inbox.append((self._now(), (solver, records)))
         if self._folder is None and not self._closed:
             self._start_folder()
         if len(self._inbox) >= _INBOX_SEGMENTS:
@@ -261,6 +278,8 @@ class ProvenanceStore:
         trim_at = self.cfg.trim_at
         admit = self._admit
         terminal = TERMINAL_STAGES
+        track = self._track_terminals
+        fresh = self._terminal_fresh
         i_recs, i_seq, i_touch, i_name = _RECS, _SEQ, _TOUCH, _NAME
         tick = self._tick + 1
         self._tick = tick
@@ -289,6 +308,8 @@ class ProvenanceStore:
                                  rec))
                     tl[i_seq] += 1
                     grants[uid] = rec[3]
+                    if track:
+                        fresh[uid] = True
                 folded += len(cycle)
                 continue
             for uid, stage, namespace, name, detail in records:
@@ -308,6 +329,8 @@ class ProvenanceStore:
                 tl[i_seq] += 1
                 if stage in terminal:
                     grants[uid] = detail.get("node", "")
+                    if track and stage == "decision-committed":
+                        fresh[uid] = True
             folded += len(records)
         self.emitted_total += folded
 
@@ -450,6 +473,58 @@ class ProvenanceStore:
             if tl is not None:
                 self._names_dirty = True
                 self._last_grant.pop(uid, None)
+
+    def terminal_spans(self, fresh_only: bool = False) -> List[tuple]:
+        """Placement-latency spans for the SLO engine: ``(uid,
+        terminal_seq, queue, namespace, start_t, end_t)`` for every
+        live timeline whose NEWEST record is a decision-committed
+        grant.  ``start_t`` is the newest quota-released record's
+        timestamp (the moment fair-share handed the pod to placement;
+        its detail carries the queue name), falling back to the
+        timeline's first record when quota is off.  ``wal-adopted``
+        terminals are excluded on purpose — those are another replica's
+        (or a previous incarnation's) decisions replayed through the
+        WAL, and a span against THIS store's record times would be a
+        fake latency.  All timestamps share this store's single clock
+        base.  The caller dedupes by (uid, terminal_seq): a pod evicted
+        and re-placed surfaces again with a newer seq.
+
+        ``fresh_only=True`` is the sweep-cadence form: the FIRST call
+        scans every timeline (and arms fold-time tracking), later
+        calls drain only uids whose decision-committed record folded
+        since the previous drain — O(new placements) per sweep, so the
+        engine's cost does not grow with the store's history."""
+        out = []
+        with self._lock:
+            if self._inbox:
+                self._fold_pending_locked()
+            if fresh_only and self._track_terminals:
+                uids = list(self._terminal_fresh)
+                self._terminal_fresh.clear()
+                items = [(u, self._timelines.get(u)) for u in uids]
+            else:
+                if fresh_only:
+                    self._track_terminals = True
+                items = list(self._timelines.items())
+            for uid, tl in items:
+                if tl is None:
+                    continue        # retired between fold and drain
+                recs = tl[_RECS]
+                if not recs or recs[-1][2] != "decision-committed":
+                    continue
+                last = recs[-1]
+                start = recs[0][1]
+                queue = ""
+                for rec in reversed(recs):
+                    if rec[2] == "quota-released":
+                        detail = rec[3]
+                        if isinstance(detail, dict):
+                            queue = detail.get("queue", "")
+                        start = rec[1]
+                        break
+                out.append((uid, last[0], queue, tl[_NS], start,
+                            last[1]))
+        return out
 
     # -- reading ---------------------------------------------------------------
     def resolve(self, ref: str) -> Optional[str]:

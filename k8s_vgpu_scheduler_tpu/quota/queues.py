@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from typing import Dict, Iterable, List, Mapping, Optional, Tuple
 
 from ..k8s.client import pod_annotations, pod_name, pod_namespace, pod_uid
@@ -214,6 +215,16 @@ class QuotaManager:
         self._release_unwritten: set = set()
         #: Release ordinal counter (QueueEntry.release_seq source).
         self._release_counter = 0
+        #: Bounded admission-latency event log: (release_seq, queue,
+        #: namespace, wait_s) per release(), oldest dropped.  The SLO
+        #: engine tails it by release_seq cursor — a released entry
+        #: leaves the manager once placed, so a sweep-time scan of
+        #: _entries would miss every admission that completed between
+        #: sweeps.  WAL adoptions (observe_pod's released-by-a-previous
+        #: -scheduler path) are deliberately NOT logged: their
+        #: enqueued_at is this process's boot, and the fake latency
+        #: would charge the admission SLO for a restart.
+        self.release_log: deque = deque(maxlen=4096)
 
     @property
     def enabled(self) -> bool:
@@ -366,11 +377,25 @@ class QuotaManager:
             e.backfilled = backfilled
             self.admitted_total[e.queue] = \
                 self.admitted_total.get(e.queue, 0) + 1
+            # Quota-clock wait: enqueued_at and released_at share one
+            # base, so the SLO admission-latency SLI never mixes clocks.
+            self.release_log.append(
+                (e.release_seq, e.queue, e.namespace,
+                 max(0.0, e.released_at - e.enqueued_at)))
             return dataclasses.replace(e)
 
     def entries(self) -> List[QueueEntry]:
         with self._lock:
             return [dataclasses.replace(e) for e in self._entries.values()]
+
+    def releases_since(self, after_seq: int) -> List[tuple]:
+        """Admission-latency events newer than ``after_seq``, oldest
+        first: (release_seq, queue, namespace, wait_s).  The SLO
+        engine's tail read — the bounded log means a consumer that
+        stalls past 4096 releases loses the oldest events (undercounts,
+        never double-counts: seqs are strictly monotonic)."""
+        with self._lock:
+            return [r for r in self.release_log if r[0] > after_seq]
 
     def release_seq_of(self, uid: str) -> Optional[int]:
         """The fair-share release ordinal of an admitted pod (None for

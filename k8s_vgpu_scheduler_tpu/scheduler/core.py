@@ -52,6 +52,7 @@ from ..provenance.store import (
 )
 from ..placement.mesh import MESH_ANNOTATION, local_mesh_for, parse_mesh
 from ..placement.reserve import SliceReservations
+from ..slo import SloEngine, build_engine_config
 from ..quota.admission import AdmissionConfig, AdmissionLoop
 from ..quota.queues import QuotaManager
 from ..shard import commit as shard_commit
@@ -183,7 +184,11 @@ class Scheduler:
         self.provenance = ProvenanceStore(ProvenanceConfig(
             per_pod=self.cfg.provenance_per_pod,
             max_pods=self.cfg.provenance_max_pods,
-            enabled=self.cfg.provenance_enabled))
+            enabled=self.cfg.provenance_enabled),
+            # The raw injected clock (None in production → wall time
+            # inside the store): record timestamps stay operator-
+            # readable live, deterministic under the simulator.
+            clock=clock)
         # Sustained-unplaceability tracking for the Unschedulable kube
         # Events: uid -> [first unplaced at, last event at] (monotonic).
         # Own lock (the rejection paths race); bounded by the same
@@ -415,6 +420,12 @@ class Scheduler:
         # Previously log-only; a fleet whose decisions silently stop
         # landing looks healthy from every other counter.
         self.decision_write_failures: Dict[str, int] = {}
+        # Every decision write attempted, success or failure, across
+        # BOTH transports (DecisionBatcher WAL and the sharded CAS
+        # commit) — the decision-write SLI's denominator (slo/engine).
+        # Counted in the shared _conclude_decision epilogue so neither
+        # path can drift out of the ledger.
+        self.decision_writes_total = 0
         self._dwf_lock = threading.Lock()
         # Fleet truth auditor (audit/; docs/observability.md "Fleet
         # audit"): continuous cross-plane invariant verification on the
@@ -432,6 +443,14 @@ class Scheduler:
                 reservation_grace_s=self.cfg.audit_reservation_grace_s,
                 max_findings=self.cfg.audit_max_findings),
             clock=clock)
+        # SLO engine (slo/; docs/observability.md "SLO pipeline"):
+        # declared objectives, error-budget ledgers and multi-window
+        # burn-rate signals over the telemetry the subsystems above
+        # already collect.  Inert without --slo-config; the daemon
+        # entrypoint starts the sweep thread, embedders/tests/the
+        # simulator call slo.sweep() directly — the auditor shape.
+        self.slo = SloEngine(self, build_engine_config(self.cfg),
+                             clock=clock)
 
     def _del_pod_wt(self, uid: str) -> None:
         """Drop a grant AND write its release through the usage cache +
@@ -1637,6 +1656,8 @@ class Scheduler:
         uid = pod_uid(pod)
         tid = trace.trace_id_of(pod)
         tr = trace.tracer()
+        with self._dwf_lock:
+            self.decision_writes_total += 1
         if err is not None:
             self._del_pod_wt(uid)
             tr.event(uid, "decision-write-failed",
@@ -1851,6 +1872,13 @@ class Scheduler:
         auto-clears, sweep stats.  Reads only the finding store's own
         lock — never a scheduler lock."""
         return self.auditor.export(limit=limit, type_filter=type_filter)
+
+    def export_slo(self, objective: Optional[str] = None,
+                   window: Optional[str] = None) -> dict:
+        """SLO attainment, budgets and burn signals (``GET /sloz`` →
+        ``vtpu-slo`` / ``vtpu-report``).  Reads only the engine's own
+        sweep lock — never a scheduler lock."""
+        return self.slo.export(objective=objective, window=window)
 
     def _note_slice_rejection(self, pod: dict,
                               result: "FilterResult") -> None:
@@ -2455,6 +2483,7 @@ class Scheduler:
         self.elastic.stop()
         self.shards.stop()
         self.auditor.stop()
+        self.slo.stop()
         # Drains the solve worker pool and unlinks the shared-memory
         # segments (no-op on the default in-process configuration).
         self.batch.close()

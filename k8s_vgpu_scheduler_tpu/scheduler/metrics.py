@@ -749,6 +749,59 @@ class ClusterCollector(Collector):
             audit_sweep_s.add_metric([], 0.0)
             audit_last_clean.add_metric([], 0.0)
 
+        # Fleet SLO engine (slo/; docs/observability.md "SLO
+        # pipeline").  Families always emitted; a scrape reads the
+        # engine's cached per-sweep view (never triggers a sweep), so
+        # series appear only for declared objectives — cardinality is
+        # bounded by config x live tenants and vanished queues retire
+        # their series within one sweep.  The burn-alerts gauge carries
+        # the full severity taxonomy zero-valued, the
+        # VtpuErrorBudgetBurn* discipline.
+        slo_attainment = GaugeMetricFamily(
+            "vtpu_slo_attainment_ratio",
+            "Fraction of good events over each objective's budget "
+            "window (compare against the declared target; absent "
+            "until the objective has seen any event — GET /sloz and "
+            "vtpu-slo carry targets, budgets and per-window detail)",
+            labels=["objective"],
+        )
+        slo_budget = GaugeMetricFamily(
+            "vtpu_slo_error_budget_remaining_ratio",
+            "Unspent fraction of each objective's error budget over "
+            "its budget window, clamped to [0, 1] (0 = the promise is "
+            "fully broken for this window; the burn-rate gauges say "
+            "how fast it got there)",
+            labels=["objective"],
+        )
+        slo_burn = GaugeMetricFamily(
+            "vtpu_slo_burn_rate",
+            "Error-budget consumption speed per evaluation window, as "
+            "a multiple of 'exactly on budget' (1.0 = burning the "
+            "whole budget in one budget window; the multi-window rule "
+            "fires a signal only while BOTH a pair's windows exceed "
+            "its threshold)",
+            labels=["objective", "window"],
+        )
+        slo_alerts = GaugeMetricFamily(
+            "vtpu_slo_burn_alerts",
+            "Active multi-window burn signals by severity (page = the "
+            "fast 1h/5m pair, ticket = the slow 24h/6h pair; any "
+            "sustained nonzero fires VtpuErrorBudgetBurnFast/Slow — "
+            "vtpu-slo for the objective, burn rates and triage)",
+            labels=["severity"],
+        )
+        slo = getattr(self.scheduler, "slo", None)
+        slo_view = slo.metrics_view() if slo is not None else {}
+        for instance, v in slo_view.get("attainment", ()):
+            slo_attainment.add_metric([instance], v)
+        for instance, v in slo_view.get("budget", ()):
+            slo_budget.add_metric([instance], v)
+        for instance, window, v in slo_view.get("burn", ()):
+            slo_burn.add_metric([instance, window], v)
+        alerts = slo_view.get("alerts") or {"page": 0, "ticket": 0}
+        for severity in sorted(alerts):
+            slo_alerts.add_metric([severity], alerts[severity])
+
         # Decision writes that exhausted their path's retries and
         # rolled the tentative grant back (previously log-only — a
         # fleet whose decisions silently stop landing looked healthy
@@ -846,7 +899,8 @@ class ClusterCollector(Collector):
                 cap_demand, cap_forecast, cap_upper, cap_eta, cap_err,
                 cap_nodes_cur, cap_nodes_rec,
                 audit_findings, audit_sweeps, audit_sweep_s,
-                audit_last_clean, dwf, series_age,
+                audit_last_clean, slo_attainment, slo_budget,
+                slo_burn, slo_alerts, dwf, series_age,
                 u_chip, u_hbm, eff_ratio, idle_grants,
                 qos_wait_family(qos_by_class),
                 pod_qos_weight] + list(phase_metrics())

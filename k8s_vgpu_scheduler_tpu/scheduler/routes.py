@@ -37,6 +37,12 @@ WebHookRoute 122–131) speaking scheduler-extender v1 JSON:
                     filters, ``?limit=<n>`` sizes the list) — for
                     ``vtpu-audit`` and ``vtpu-report``; 404 carrying
                     ``enabled: false`` under --no-audit
+- ``GET  /sloz``    fleet SLO engine: per-objective attainment, error
+                    budgets and active burn signals
+                    (``?objective=<name>`` filters, ``?window=<label>``
+                    narrows the per-window table) — for ``vtpu-slo``
+                    and ``vtpu-report``; 404 carrying ``enabled:
+                    false`` under --no-slo or without --slo-config
 
 Shared endpoint semantics (pinned by tests/test_debug_endpoints.py):
 bad query parameters return 400 with a JSON error body, a disabled
@@ -236,6 +242,40 @@ class _Handler(BaseHTTPRequestHandler):
                     limit=limit, type_filter=type_filter))
             except Exception as e:  # noqa: BLE001 — 500, not a hangup
                 log.exception("auditz export failed")
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        elif self.path.startswith("/sloz"):
+            # SLO attainment, error budgets and burn signals (slo/):
+            # the vtpu-slo and vtpu-report surface.  Bad params 400
+            # BEFORE the enabled check (the shared endpoint contract);
+            # with no --slo-config every filter value is unknown.
+            from urllib.parse import parse_qsl, urlsplit
+
+            query = dict(parse_qsl(urlsplit(self.path).query))
+            slo = self.scheduler.slo
+            objective = query.get("objective") or None
+            if objective is not None \
+                    and objective not in slo.objective_names():
+                self._reply(400, {
+                    "error": f"unknown objective {objective!r}",
+                    "known_objectives": slo.objective_names()})
+                return
+            window = query.get("window") or None
+            if window is not None and window not in slo.window_names():
+                self._reply(400, {
+                    "error": f"unknown window {window!r}",
+                    "known_windows": slo.window_names()})
+                return
+            if not slo.enabled:
+                self._reply(404, {
+                    "error": "slo engine disabled (--no-slo, or no "
+                             "--slo-config objectives declared)",
+                    "enabled": False})
+                return
+            try:
+                self._reply(200, self.scheduler.export_slo(
+                    objective=objective, window=window))
+            except Exception as e:  # noqa: BLE001 — 500, not a hangup
+                log.exception("sloz export failed")
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         elif self.path.startswith("/usagez"):
             # Per-namespace showback over a trailing window (accounting/

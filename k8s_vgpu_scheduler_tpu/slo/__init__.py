@@ -1,0 +1,24 @@
+"""Fleet SLO engine: tenant-facing SLIs, error-budget ledgers and
+multi-window burn-rate signals over telemetry the control plane
+already collects (docs/observability.md, "SLO pipeline")."""
+
+from .budget import BurnSignal, BurnSignalStore, SliSeries
+from .engine import SloEngine, SloEngineConfig, build_engine_config, \
+    format_window
+from .objectives import (
+    DEFAULT_PAIRS,
+    EVENT_SLIS,
+    SEVERITIES,
+    SLI_KINDS,
+    Objective,
+    WindowPair,
+    parse_slo_config,
+)
+
+__all__ = [
+    "BurnSignal", "BurnSignalStore", "SliSeries",
+    "SloEngine", "SloEngineConfig", "build_engine_config",
+    "format_window",
+    "DEFAULT_PAIRS", "EVENT_SLIS", "SEVERITIES", "SLI_KINDS",
+    "Objective", "WindowPair", "parse_slo_config",
+]

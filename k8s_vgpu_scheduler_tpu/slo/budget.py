@@ -1,0 +1,250 @@
+"""Error-budget ledgers + multi-window multi-burn-rate evaluation.
+
+The ledger discipline is accounting/ledger.py's, applied to promises
+instead of usage: every SLI is a pair of CUMULATIVE monotonic counters
+(good events, total events); a bounded ring of ``(t, good, total)``
+snapshots — one point per engine sweep, virtual-clock friendly — gives
+windowed deltas without per-event storage; and counter resets are
+absorbed on ingestion (a raw value below its predecessor is treated as
+a fresh process whose whole count is new), so a restart can never
+REFUND budget that was already burned.
+
+Derived quantities, all over event deltas within a window ``W``::
+
+    attainment(W) = good_delta / total_delta          (None: no events)
+    burn_rate(W)  = (1 - attainment(W)) / (1 - target)
+    budget_remaining = 1 - bad_delta / ((1 - target) * total_delta)
+
+Burn rate 1.0 means "consuming budget exactly as fast as the target
+allows"; the ratio-of-events definition makes it scale-invariant in
+window length on steady traffic (tests/test_slo.py pins this as a
+property), and gives the fast-before-slow ordering the multi-window
+rule wants for free — a long window full of clean history dilutes a
+fresh breach that already saturates the short one.
+
+Burn signals follow the SRE-workbook multi-window multi-burn-rate
+rule: a :class:`~.objectives.WindowPair` fires only while BOTH its
+long- and short-window burn rates exceed the pair's threshold.  Active
+signals live in a :class:`BurnSignalStore` with the
+first-seen/last-seen/auto-clear lifecycle of audit/findings.py —
+bounded, oldest-dropped-loudly, recent clears kept for operators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .objectives import SEVERITIES
+
+
+class SliSeries:
+    """One objective instance's (good, total) history: internal
+    monotonic accumulators + a bounded snapshot ring.
+
+    Not thread-safe — the engine owns each series and touches it only
+    under its sweep lock (the FindingStore/ledger discipline)."""
+
+    __slots__ = ("_ring", "good", "total", "_raw_good", "_raw_total",
+                 "resets_observed")
+
+    def __init__(self, maxlen: int = 2048) -> None:
+        #: (t, good, total) snapshots, oldest first.
+        self._ring: deque = deque(maxlen=maxlen)
+        self.good = 0.0
+        self.total = 0.0
+        #: Last raw cumulative readings (reset detection).
+        self._raw_good: Optional[float] = None
+        self._raw_total: Optional[float] = None
+        self.resets_observed = 0
+
+    # -- ingestion -------------------------------------------------------------
+    def add_events(self, good: float, bad: float) -> None:
+        """Direct event ingestion (deltas, both >= 0): the event-source
+        SLIs (admission, placement) and the sweep-sampled booleans
+        (goodput, audit-clean)."""
+        if good > 0:
+            self.good += good
+            self.total += good
+        if bad > 0:
+            self.total += bad
+
+    def observe_cumulative(self, raw_good: float, raw_total: float
+                           ) -> None:
+        """Counter-source ingestion (dispatch-wait histogram sums,
+        decision-write counters): fold the delta since the last
+        reading into the internal accumulators, absorbing resets the
+        ledger way — a raw value BELOW its predecessor means the
+        counter restarted and the whole raw value is new events.  The
+        internal accumulators only ever grow, so a reset can never
+        refund budget."""
+        prev_g, prev_t = self._raw_good, self._raw_total
+        if prev_t is None or raw_total < prev_t or raw_good < prev_g:
+            if prev_t is not None:
+                self.resets_observed += 1
+            d_total, d_good = raw_total, raw_good
+        else:
+            d_total = raw_total - prev_t
+            d_good = raw_good - prev_g
+        self._raw_good, self._raw_total = raw_good, raw_total
+        # Clamp to sane deltas: good ⊆ total by definition.
+        d_total = max(0.0, d_total)
+        d_good = min(max(0.0, d_good), d_total)
+        self.good += d_good
+        self.total += d_total
+
+    def snapshot(self, now: float) -> None:
+        """Close the sweep: pin the current accumulators at ``now``.
+        Window math interpolates nothing — it reads the newest point at
+        or before the window's left edge as the baseline, so attainment
+        resolution is the sweep interval (exactly the auditor's
+        detection-latency contract)."""
+        self._ring.append((now, self.good, self.total))
+
+    # -- windowed reads --------------------------------------------------------
+    def window_delta(self, window_s: float, now: float
+                     ) -> Tuple[float, float]:
+        """(good_delta, total_delta) of events inside ``[now - window_s,
+        now]``.  History shorter than the window falls back to the
+        oldest point — early in a process's life every window sees the
+        same (complete) history, which is the honest answer."""
+        baseline_g = baseline_t = 0.0
+        edge = now - window_s
+        for t, g, tot in self._ring:
+            if t > edge:
+                break
+            baseline_g, baseline_t = g, tot
+        return (max(0.0, self.good - baseline_g),
+                max(0.0, self.total - baseline_t))
+
+    def attainment(self, window_s: float, now: float) -> Optional[float]:
+        good_d, total_d = self.window_delta(window_s, now)
+        if total_d <= 0:
+            return None
+        return good_d / total_d
+
+    def burn_rate(self, window_s: float, now: float, target: float
+                  ) -> float:
+        """How many times faster than "exactly on budget" this window
+        is consuming error budget (0.0 = no events or all good)."""
+        att = self.attainment(window_s, now)
+        if att is None:
+            return 0.0
+        return (1.0 - att) / max(1e-9, 1.0 - target)
+
+    def budget_remaining(self, window_s: float, now: float,
+                         target: float) -> float:
+        """Fraction of the window's error budget still unspent, clamped
+        to [0, 1] — the ledger never reports a negative balance, it
+        reports zero and lets the burn rate say how far past it is."""
+        good_d, total_d = self.window_delta(window_s, now)
+        if total_d <= 0:
+            return 1.0
+        allowed = (1.0 - target) * total_d
+        bad = total_d - good_d
+        if allowed <= 0:
+            return 0.0 if bad > 0 else 1.0
+        return max(0.0, min(1.0, 1.0 - bad / allowed))
+
+
+@dataclasses.dataclass
+class BurnSignal:
+    """One firing multi-window burn rule, with lifecycle."""
+
+    objective: str       # instance label ("name" or "name/tenant")
+    pair: str            # "fast" | "slow"
+    severity: str        # "page" | "ticket"
+    burn_long: float
+    burn_short: float
+    threshold: float
+    long_s: float
+    short_s: float
+    first_seen: float
+    last_seen: float
+
+    def export(self, now: float) -> dict:
+        """JSON-safe view (ages not timestamps — deterministic under
+        the virtual clock, same as Finding.export)."""
+        return {
+            "objective": self.objective,
+            "pair": self.pair,
+            "severity": self.severity,
+            "burn_long": round(self.burn_long, 3),
+            "burn_short": round(self.burn_short, 3),
+            "threshold": self.threshold,
+            "long_s": self.long_s,
+            "short_s": self.short_s,
+            "first_seen_age_s": round(max(0.0, now - self.first_seen), 3),
+            "last_seen_age_s": round(max(0.0, now - self.last_seen), 3),
+        }
+
+
+class BurnSignalStore:
+    """Bounded active-signal set keyed (objective instance, pair), with
+    the audit FindingStore's reconcile lifecycle: a rule observed firing
+    opens (or refreshes) its signal; a rule observed quiet auto-clears
+    it into a small recent-clears ring.  Not thread-safe — owned by the
+    engine, mutated only under its sweep lock."""
+
+    def __init__(self, max_open: int = 256, cleared_keep: int = 32
+                 ) -> None:
+        self.max_open = max_open
+        self._open: Dict[Tuple[str, str], BurnSignal] = {}
+        self._cleared: deque = deque(maxlen=cleared_keep)
+        self.fired_total = 0
+        self.cleared_total = 0
+        self.dropped_total = 0
+
+    def reconcile(self, active: Dict[Tuple[str, str], BurnSignal],
+                  now: float) -> Tuple[int, int]:
+        """``active`` is THIS sweep's complete firing set.  Returns
+        (newly_fired, cleared).  Signals for instances the engine
+        retired (vanished queues) simply stop appearing in ``active``
+        and clear here — retirement needs no special case."""
+        fired = cleared = 0
+        for key, sig in active.items():
+            cur = self._open.get(key)
+            if cur is None:
+                if len(self._open) >= self.max_open:
+                    self.dropped_total += 1
+                    continue
+                sig.first_seen = now
+                sig.last_seen = now
+                self._open[key] = sig
+                self.fired_total += 1
+                fired += 1
+            else:
+                cur.last_seen = now
+                cur.burn_long = sig.burn_long
+                cur.burn_short = sig.burn_short
+        for key in [k for k in self._open if k not in active]:
+            sig = self._open.pop(key)
+            sig.last_seen = now
+            self._cleared.append(sig)
+            self.cleared_total += 1
+            cleared += 1
+        return fired, cleared
+
+    def open_count(self) -> int:
+        return len(self._open)
+
+    def open_by_severity(self) -> Dict[str, int]:
+        """Always the full taxonomy, zero-valued — the
+        vtpu_slo_burn_alerts family never drops a label value."""
+        out = {s: 0 for s in SEVERITIES}
+        for sig in self._open.values():
+            out[sig.severity] = out.get(sig.severity, 0) + 1
+        return out
+
+    def open_list(self, now: float) -> List[dict]:
+        """Pages first, then tickets, then by age (oldest first) — the
+        triage order vtpu-slo renders."""
+        rank = {s: i for i, s in enumerate(SEVERITIES)}
+        return [s.export(now) for s in sorted(
+            self._open.values(),
+            key=lambda s: (rank.get(s.severity, len(rank)),
+                           s.first_seen, s.objective, s.pair))]
+
+    def cleared_list(self, now: float) -> List[dict]:
+        return [s.export(now) for s in reversed(self._cleared)]

@@ -1,0 +1,526 @@
+"""The SLO engine: fold existing telemetry into objectives, budgets
+and burn signals on a background sweep (the auditor/rescuer shape).
+
+One engine per scheduler replica.  ``sweep()`` is reentrant-safe
+(serialized by its own lock) and callable directly by embedders, tests
+and the simulator; the daemon entrypoint runs it on a thread.  Each
+sweep:
+
+1. ingests new events from the sources (quota release log, provenance
+   terminal spans, ledger dispatch-wait histograms, decision-write
+   counters, grant-efficiency sample, audit sweep outcomes) — every
+   source already exists; the engine adds no probe and holds at most
+   one subsystem lock at a time, never nested;
+2. retires series whose tenant vanished (fanned per-queue /
+   per-namespace objectives follow the quota config's live set, so
+   ``vtpu_slo_*`` cardinality is bounded by config x live tenants);
+3. pins a snapshot point per series (the ring :mod:`.budget` windows
+   over), evaluates every window pair, and reconciles the burn-signal
+   store — firing rules open signals, quiet rules auto-clear them;
+4. republishes the metrics view the exporter scrapes (scrapes read a
+   cached snapshot; they never trigger source work).
+
+Clock discipline: admission waits are quota-clock deltas, placement
+spans are provenance-clock deltas — each SLI's latency math stays
+inside ONE clock base; the engine's own ``now`` (ring timestamps,
+signal lifecycle) rides the scheduler's injected clock so the whole
+layer is deterministic under the simulator's virtual clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .budget import BurnSignal, BurnSignalStore, SliSeries
+from .objectives import SEVERITIES, Objective, parse_slo_config
+
+log = logging.getLogger(__name__)
+
+
+def format_window(seconds: float) -> str:
+    """3600 → "1h", 300 → "5m", 75 → "75s" — the {window} label value
+    and the /sloz / vtpu-slo column key."""
+    s = int(seconds)
+    if s >= 3600 and s % 3600 == 0:
+        return f"{s // 3600}h"
+    if s >= 60 and s % 60 == 0:
+        return f"{s // 60}m"
+    return f"{s}s"
+
+
+@dataclasses.dataclass(frozen=True)
+class SloEngineConfig:
+    """Engine knobs (Config.slo_* via cmd/scheduler.py flags)."""
+
+    #: --no-slo sets False; True with zero objectives is still inert.
+    enabled: bool = True
+    #: Background sweep period (cmd/scheduler --slo-interval).
+    interval_s: float = 15.0
+    #: Parsed objectives (objectives.parse_slo_config).
+    objectives: Tuple[Objective, ...] = ()
+    #: Burn-signal store bound (beyond it new signals drop, counted).
+    max_signals: int = 256
+
+
+class SloEngine:
+    """One replica's SLO evaluation over its local telemetry."""
+
+    def __init__(self, scheduler, cfg: Optional[SloEngineConfig] = None,
+                 clock=None) -> None:
+        self.s = scheduler
+        self.cfg = cfg or SloEngineConfig()
+        self._clock = clock or time.monotonic
+        self._sweep_lock = threading.Lock()
+        #: (objective name, tenant label) -> series.  Label "" for
+        #: fleet / filtered scopes; fanned scopes key per tenant.
+        self._series: Dict[Tuple[str, str], SliSeries] = {}
+        self.signals = BurnSignalStore(max_open=self.cfg.max_signals)
+        #: Quota release-log cursor (release_seq of the newest
+        #: admission event already ingested).
+        self._release_cursor = 0
+        #: uid -> terminal seq of the newest placement span ingested;
+        #: rebuilt each sweep from the live span set, so it cannot
+        #: outgrow the provenance store's own timeline cap.
+        self._span_seen: Dict[str, int] = {}
+        #: Ledger row count at the last ledger-sourced ingest (the
+        #: sweep's dirty check for dispatch-wait/goodput).
+        self._ledger_rows_seen: Optional[int] = -1
+        #: Audit sweeps already folded into audit-clean samples.
+        self._audit_sweeps_seen = 0
+        #: Sweep accounting (exported on /sloz + vtpu-slo).
+        self.sweeps_total = 0
+        self.last_sweep_s = 0.0
+        #: Cached metrics view (scheduler/metrics.py reads this
+        #: GIL-atomically; a scrape never sweeps).
+        self._metrics = {"attainment": [], "budget": [], "burn": [],
+                         "alerts": {s: 0 for s in SEVERITIES}}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def enabled(self) -> bool:
+        """Inert without declared objectives: --slo-config is the on
+        switch, --no-slo the off switch."""
+        return self.cfg.enabled and bool(self.cfg.objectives)
+
+    # -- series plumbing -------------------------------------------------------
+    def _series_for(self, obj: Objective, label: str) -> SliSeries:
+        key = (obj.name, label)
+        series = self._series.get(key)
+        if series is None:
+            series = self._series[key] = SliSeries()
+        return series
+
+    @staticmethod
+    def _instance(obj: Objective, label: str) -> str:
+        return f"{obj.name}/{label}" if label else obj.name
+
+    def _route_event(self, obj: Objective, queue: str, namespace: str
+                     ) -> Optional[str]:
+        """The tenant label an event lands under for ``obj`` (None =
+        out of scope)."""
+        scope = obj.scope
+        if scope == "fleet":
+            return ""
+        if scope == "per-queue":
+            return queue or None
+        if scope == "per-namespace":
+            return namespace or None
+        if scope.startswith("queue:"):
+            return "" if queue == scope[len("queue:"):] else None
+        return "" if namespace == scope[len("namespace:"):] else None
+
+    # -- source ingestion ------------------------------------------------------
+    def _ingest_admission(self) -> None:
+        quota = getattr(self.s, "quota", None)
+        if quota is None or not quota.enabled:
+            return
+        events = quota.releases_since(self._release_cursor)
+        if not events:
+            return
+        self._release_cursor = events[-1][0]
+        targets = [o for o in self.cfg.objectives
+                   if o.sli == "admission-latency"]
+        for _seq, queue, namespace, wait_s in events:
+            for obj in targets:
+                label = self._route_event(obj, queue, namespace)
+                if label is None:
+                    continue
+                good = wait_s <= obj.threshold
+                self._series_for(obj, label).add_events(
+                    1.0 if good else 0.0, 0.0 if good else 1.0)
+
+    def _ingest_placement(self) -> None:
+        prov = getattr(self.s, "provenance", None)
+        if prov is None or not prov.enabled:
+            return
+        targets = [o for o in self.cfg.objectives
+                   if o.sli == "placement-latency"]
+        if not targets:
+            return
+        # fresh_only drains each committed span at most once (the
+        # store's fold-time cursor), but the FIRST drain is a full
+        # scan, and a full scan can re-surface a span the engine
+        # already folded if the engine restarts against a live store —
+        # the (uid, seq) memory covers exactly that seam.
+        seen = self._span_seen
+        seen_get = seen.get
+        # (queue, namespace) -> [(threshold, series)]: spans arrive in
+        # storm-sized runs sharing a handful of tenant identities, so
+        # routing resolves once per identity per sweep, not per span —
+        # an out-of-scope span (empty list) costs one dict probe.
+        routes: Dict[tuple, list] = {}
+        for uid, seq, queue, namespace, start, end in \
+                prov.terminal_spans(fresh_only=True):
+            if seen_get(uid) == seq:
+                continue        # span already folded
+            seen[uid] = seq
+            key = (queue, namespace)
+            routed = routes.get(key)
+            if routed is None:
+                routed = routes[key] = [
+                    (obj.threshold, self._series_for(obj, label))
+                    for obj in targets
+                    for label in (self._route_event(obj, queue,
+                                                    namespace),)
+                    if label is not None]
+            if not routed:
+                continue
+            latency = max(0.0, end - start)
+            for threshold, series in routed:
+                good = latency <= threshold
+                series.add_events(
+                    1.0 if good else 0.0, 0.0 if good else 1.0)
+        if len(seen) > 65536:
+            # The memory exists for the restart seam only; a bounded
+            # reset merely risks one double-count per pod across it.
+            seen.clear()
+
+    def _ingest_dispatch_wait(self) -> None:
+        """Latency-critical dispatch-wait from the ledger's log2-us
+        region histograms: bucket k covers [2^(k-1), 2^k) us, so every
+        event in buckets whose upper bound is within the threshold is
+        good.  Lifetime-cumulative counts — observe_cumulative absorbs
+        node restarts."""
+        targets = [o for o in self.cfg.objectives
+                   if o.sli == "dispatch-wait"]
+        ledger = getattr(self.s, "ledger", None)
+        if not targets or ledger is None:
+            return
+        from ..monitor.metrics import _fold_hist
+
+        by_class: Dict[str, tuple] = {}
+        for cls, (hist, s) in ledger.qos_retired().items():
+            _fold_hist(by_class, cls, hist, s)
+        for acct in ledger.accounts():
+            if acct.qos_class:
+                _fold_hist(by_class, acct.qos_class, acct.qos_wait_hist,
+                           acct.qos_wait_seconds_total)
+        counts, _sum = by_class.get("latency-critical", ([], 0.0))
+        if not counts:
+            return
+        total = float(sum(counts))
+        for obj in targets:
+            good = float(sum(
+                n for k, n in enumerate(counts)
+                if (1 << k) / 1e6 <= obj.threshold))
+            self._series_for(obj, "").observe_cumulative(good, total)
+
+    def _ingest_decision_writes(self) -> None:
+        targets = [o for o in self.cfg.objectives
+                   if o.sli == "decision-write"]
+        if not targets:
+            return
+        # decision_writes_total counts every attempted write across
+        # BOTH transports (DecisionBatcher WAL and the sharded CAS
+        # path) in the shared epilogue; the failure map is the same
+        # epilogue's by-reason tally, so good = total - failures.
+        writes = float(getattr(self.s, "decision_writes_total", 0))
+        if writes <= 0:
+            return
+        failures = float(sum(
+            (getattr(self.s, "decision_write_failures", None) or {})
+            .values()))
+        good = max(0.0, writes - failures)
+        for obj in targets:
+            self._series_for(obj, "").observe_cumulative(good, writes)
+
+    def _ingest_goodput(self) -> None:
+        """One boolean sample per sweep: is the fleet's measured
+        grant-efficiency ratio above the objective's floor?  No usage
+        reports yet (fleet_efficiency None) = no signal, not a breach."""
+        targets = [o for o in self.cfg.objectives if o.sli == "goodput"]
+        if not targets:
+            return
+        try:
+            eff = self.s.grant_efficiency().fleet_efficiency
+        except Exception:  # noqa: BLE001 — a source glitch is not a breach
+            log.exception("slo: grant_efficiency read failed")
+            return
+        if eff is None:
+            return
+        for obj in targets:
+            good = eff >= obj.threshold
+            self._series_for(obj, "").add_events(
+                1.0 if good else 0.0, 0.0 if good else 1.0)
+
+    def _ingest_audit(self) -> None:
+        """Each fleet-audit sweep since our last look becomes one
+        sample: good while the finding store is clean — "sweeps since
+        last open finding" as an attainment ratio."""
+        targets = [o for o in self.cfg.objectives
+                   if o.sli == "audit-clean"]
+        auditor = getattr(self.s, "auditor", None)
+        if not targets or auditor is None or not auditor.enabled:
+            return
+        swept = auditor.sweeps_total
+        new = swept - self._audit_sweeps_seen
+        if new <= 0:
+            return
+        self._audit_sweeps_seen = swept
+        clean = auditor.store.open_count() == 0
+        for obj in targets:
+            self._series_for(obj, "").add_events(
+                float(new) if clean else 0.0,
+                0.0 if clean else float(new))
+
+    def _retire_vanished(self) -> None:
+        """Drop fanned series whose tenant left the quota config — the
+        no-unbounded-cardinality contract.  Their burn signals stop
+        appearing in the active set and auto-clear on this sweep."""
+        fanned = [o for o in self.cfg.objectives if o.fanned]
+        if not fanned:
+            return
+        quota = getattr(self.s, "quota", None)
+        queues = set(quota.queues) if quota is not None else set()
+        namespaces = set()
+        for q in (quota.queues.values() if quota is not None else ()):
+            namespaces.update(q.namespaces)
+        live = {"per-queue": queues, "per-namespace": namespaces}
+        for obj in fanned:
+            keep = live[obj.scope]
+            for key in [k for k in self._series
+                        if k[0] == obj.name and k[1] and k[1] not in keep]:
+                del self._series[key]
+
+    # -- the sweep -------------------------------------------------------------
+    def sweep(self) -> dict:
+        """One evaluation pass; returns a small summary (the daemon
+        loop discards it; sims and tests read it)."""
+        if not self.enabled:
+            return {"enabled": False}
+        with self._sweep_lock:
+            t0 = time.monotonic()
+            now = self._clock()
+            self.sweeps_total += 1
+            self._ingest_admission()
+            self._ingest_placement()
+            # The dispatch-wait and goodput SLIs derive purely from
+            # ledger state: on a sweep where no usage row arrived they
+            # would recompute yesterday's answer, so the row counter
+            # gates both.  (No counter / no ledger = never skip.)
+            ledger = getattr(self.s, "ledger", None)
+            rows = getattr(ledger, "records_total", None) \
+                if ledger is not None else None
+            if rows is None or rows != self._ledger_rows_seen:
+                self._ingest_dispatch_wait()
+                self._ingest_goodput()
+                self._ledger_rows_seen = rows
+            self._ingest_decision_writes()
+            self._ingest_audit()
+            self._retire_vanished()
+            for series in self._series.values():
+                series.snapshot(now)
+            active = self._evaluate_signals(now)
+            fired, cleared = self.signals.reconcile(active, now)
+            self._publish_metrics(now)
+            self.last_sweep_s = time.monotonic() - t0
+            return {
+                "enabled": True,
+                "sweep": self.sweeps_total,
+                "series": len(self._series),
+                "signals_open": self.signals.open_count(),
+                "fired": fired,
+                "cleared": cleared,
+            }
+
+    def _instances(self) -> List[Tuple[Objective, str]]:
+        """(objective, label) for every live series, config order then
+        tenant order — fixed scopes appear even before any event so the
+        surfaces show the promise, not just the history."""
+        out = []
+        for obj in self.cfg.objectives:
+            if obj.fanned:
+                out.extend((obj, label) for (name, label)
+                           in sorted(self._series)
+                           if name == obj.name and label)
+            else:
+                self._series_for(obj, "")
+                out.append((obj, ""))
+        return out
+
+    def _evaluate_signals(self, now: float
+                          ) -> Dict[Tuple[str, str], BurnSignal]:
+        active: Dict[Tuple[str, str], BurnSignal] = {}
+        for obj, label in self._instances():
+            series = self._series.get((obj.name, label))
+            if series is None:
+                continue
+            instance = self._instance(obj, label)
+            for pair in obj.pairs:
+                burn_long = series.burn_rate(pair.long_s, now, obj.target)
+                burn_short = series.burn_rate(pair.short_s, now,
+                                              obj.target)
+                if burn_long > pair.burn_threshold \
+                        and burn_short > pair.burn_threshold:
+                    active[(instance, pair.name)] = BurnSignal(
+                        objective=instance, pair=pair.name,
+                        severity=pair.severity, burn_long=burn_long,
+                        burn_short=burn_short,
+                        threshold=pair.burn_threshold,
+                        long_s=pair.long_s, short_s=pair.short_s,
+                        first_seen=now, last_seen=now)
+        return active
+
+    def _publish_metrics(self, now: float) -> None:
+        attainment, budget, burn = [], [], []
+        for obj, label in self._instances():
+            series = self._series.get((obj.name, label))
+            if series is None:
+                continue
+            instance = self._instance(obj, label)
+            att = series.attainment(obj.budget_window_s, now)
+            if att is not None:
+                attainment.append((instance, att))
+            budget.append((instance, series.budget_remaining(
+                obj.budget_window_s, now, obj.target)))
+            for w in obj.window_seconds():
+                burn.append((instance, format_window(w),
+                             series.burn_rate(w, now, obj.target)))
+        self._metrics = {
+            "attainment": attainment,
+            "budget": budget,
+            "burn": burn,
+            "alerts": self.signals.open_by_severity(),
+        }
+
+    def metrics_view(self) -> dict:
+        """The exporter's cached snapshot (GIL-atomic attribute read —
+        a Prometheus scrape never takes the sweep lock)."""
+        return self._metrics
+
+    # -- surfaces --------------------------------------------------------------
+    def objective_names(self) -> List[str]:
+        return [o.name for o in self.cfg.objectives]
+
+    def window_names(self) -> List[str]:
+        names = []
+        for obj in self.cfg.objectives:
+            for w in obj.window_seconds() + (obj.budget_window_s,):
+                label = format_window(w)
+                if label not in names:
+                    names.append(label)
+        return names
+
+    def export(self, objective: Optional[str] = None,
+               window: Optional[str] = None) -> dict:
+        """The GET /sloz document (JSON-safe: no NaN/Inf, ages not
+        timestamps — deterministic under the virtual clock)."""
+        with self._sweep_lock:
+            now = self._clock()
+            docs = []
+            for obj, label in self._instances():
+                if objective is not None and obj.name != objective:
+                    continue
+                series = self._series.get((obj.name, label))
+                if series is None:
+                    continue
+                att = series.attainment(obj.budget_window_s, now)
+                windows = {}
+                for w in obj.window_seconds():
+                    wl = format_window(w)
+                    if window is not None and wl != window:
+                        continue
+                    w_att = series.attainment(w, now)
+                    windows[wl] = {
+                        "window_s": w,
+                        "attainment": (round(w_att, 6)
+                                       if w_att is not None else None),
+                        "burn_rate": round(
+                            series.burn_rate(w, now, obj.target), 3),
+                    }
+                docs.append({
+                    "objective": self._instance(obj, label),
+                    "name": obj.name,
+                    "sli": obj.sli,
+                    "scope": obj.scope,
+                    "target": obj.target,
+                    "threshold": obj.threshold,
+                    "budget_window_s": obj.budget_window_s,
+                    "description": obj.description,
+                    "events_total": round(series.total, 3),
+                    "events_good": round(series.good, 3),
+                    "attainment": (round(att, 6)
+                                   if att is not None else None),
+                    "error_budget_remaining_ratio": round(
+                        series.budget_remaining(
+                            obj.budget_window_s, now, obj.target), 6),
+                    "windows": windows,
+                    "resets_observed": series.resets_observed,
+                })
+            return {
+                "enabled": self.enabled,
+                "objectives": docs,
+                "signals_open": self.signals.open_list(now),
+                "signals_open_by_severity":
+                    self.signals.open_by_severity(),
+                "signals_cleared_recent": self.signals.cleared_list(now),
+                "counters": {
+                    "fired_total": self.signals.fired_total,
+                    "cleared_total": self.signals.cleared_total,
+                    "dropped_total": self.signals.dropped_total,
+                },
+                "sweeps": {
+                    "total": self.sweeps_total,
+                    "last_sweep_s": round(self.last_sweep_s, 6),
+                    "interval_s": self.cfg.interval_s,
+                },
+            }
+
+    # -- daemon loop (cmd/scheduler.py; embedders call sweep() directly) ------
+    def start(self, interval_s: Optional[float] = None) -> None:
+        if self._thread is not None or not self.enabled:
+            return
+        period = interval_s if interval_s is not None \
+            else self.cfg.interval_s
+
+        def loop() -> None:
+            while not self._stop.wait(period):
+                try:
+                    self.sweep()
+                except Exception:  # noqa: BLE001 — keep evaluating through glitches
+                    log.exception("slo sweep failed")
+
+        self._thread = threading.Thread(target=loop, name="slo-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def build_engine_config(cfg) -> SloEngineConfig:
+    """util.config.Config → SloEngineConfig (Config carries the raw
+    --slo-config dicts like quota_queues; parse loudly here so an
+    embedder constructing Scheduler(cfg) gets the same boot-time
+    validation cmd/scheduler.py gives the daemon)."""
+    return SloEngineConfig(
+        enabled=cfg.slo_enabled,
+        interval_s=cfg.slo_interval_s,
+        objectives=parse_slo_config(
+            {"objectives": list(cfg.slo_objectives)}),
+    )

@@ -1,0 +1,232 @@
+"""Declarative SLO objectives — what the fleet PROMISES, per tenant.
+
+The paper's framing (PAPER.md §1) is a contract: hard per-tenant
+enforcement of fractional-device promises.  PRs 11-15 built the sensing
+(/capacityz, /perfz, /explainz, /auditz); this module declares which of
+those observations are *promises* — a named SLI, a target, and the
+windows the error budget is judged over.  Everything is computed from
+telemetry the control plane already collects; an objective never adds a
+probe.
+
+The six SLI kinds and their sources:
+
+- ``admission-latency``   queued→released wait per admitted pod
+                          (quota release log; single clock base)
+- ``placement-latency``   released→decision-committed per placed pod
+                          (provenance terminal spans; single clock base)
+- ``dispatch-wait``       latency-critical dispatch-wait region
+                          histograms (accounting ledger, PR 10)
+- ``goodput``             fleet grant-efficiency ratio sampled per
+                          sweep (accounting/efficiency.py, PR 4)
+- ``decision-write``      decision-annotation write success rate
+                          (decision batcher + the PR 15
+                          vtpu_decision_write_failures_total counters)
+- ``audit-clean``         fraction of fleet-audit sweeps that ended
+                          with zero open findings (audit/findings.py)
+
+Every SLI reduces to cumulative monotonic (good, total) event counters,
+so one budget ledger (:mod:`.budget`) serves all six.  The config file
+(``--slo-config``, JSON or YAML, chart-mounted like quota.yaml):
+
+.. code-block:: yaml
+
+    objectives:
+      - name: admission-latency
+        sli: admission-latency
+        target: 0.99          # fraction of events that must be good
+        threshold_s: 60       # an admission slower than this is "bad"
+        scope: per-queue      # fan out one series per capacity queue
+        budget_window_s: 86400
+        windows:              # optional; SRE-workbook defaults below
+          fast: {long_s: 3600, short_s: 300, burn: 14.4}
+          slow: {long_s: 86400, short_s: 21600, burn: 6.0}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+#: Valid ``sli:`` values, in display order.
+SLI_KINDS = (
+    "admission-latency",
+    "placement-latency",
+    "dispatch-wait",
+    "goodput",
+    "decision-write",
+    "audit-clean",
+)
+
+#: SLIs whose events carry (queue, namespace) identity and may
+#: therefore be scoped or fanned out per tenant; the rest are
+#: fleet-global by construction.
+EVENT_SLIS = ("admission-latency", "placement-latency")
+
+#: Default "bad" threshold per SLI when the config omits one.  Latency
+#: SLIs: seconds; goodput: minimum grant-efficiency ratio (matches the
+#: VtpuFleetEfficiencyLow alert floor); dispatch-wait: seconds (matches
+#: the VtpuCriticalDispatchWaitHigh 50ms target).  decision-write and
+#: audit-clean are success/failure events — no threshold.
+DEFAULT_THRESHOLDS = {
+    "admission-latency": 60.0,
+    "placement-latency": 5.0,
+    "dispatch-wait": 0.05,
+    "goodput": 0.2,
+    "decision-write": 0.0,
+    "audit-clean": 0.0,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowPair:
+    """One multi-window burn-rate rule (SRE workbook ch. 5): the signal
+    fires only while BOTH the long and the short window burn above the
+    threshold — long for significance, short for "still happening"."""
+
+    name: str            # "fast" | "slow" (display + signal key)
+    long_s: float
+    short_s: float
+    burn_threshold: float
+    severity: str        # "page" | "ticket"
+
+
+#: SRE-workbook defaults: a fast pair that pages (14.4x burn exhausts a
+#: 30-day budget in ~2 days; over 1h/5m it means "burning NOW") and a
+#: slow pair that files a ticket (6x over 24h/6h).  Sims compress these
+#: via the per-objective ``windows:`` override.
+DEFAULT_PAIRS = (
+    WindowPair("fast", 3600.0, 300.0, 14.4, "page"),
+    WindowPair("slow", 86400.0, 21600.0, 6.0, "ticket"),
+)
+
+#: Burn-signal severities, in escalation order (zero-valued metric
+#: taxonomy — vtpu_slo_burn_alerts always emits both).
+SEVERITIES = ("page", "ticket")
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declared promise.  ``scope`` is ``fleet`` (one series),
+    ``queue:<name>`` / ``namespace:<ns>`` (one filtered series), or
+    ``per-queue`` / ``per-namespace`` (fan out one series per live
+    tenant, retired when the tenant vanishes)."""
+
+    name: str
+    sli: str
+    target: float
+    scope: str = "fleet"
+    threshold: float = 0.0
+    budget_window_s: float = 86400.0
+    pairs: Tuple[WindowPair, ...] = DEFAULT_PAIRS
+    description: str = ""
+
+    @property
+    def fanned(self) -> bool:
+        return self.scope in ("per-queue", "per-namespace")
+
+    def window_seconds(self) -> Tuple[float, ...]:
+        """Every distinct evaluation window, longest first (the /sloz
+        per-window attainment table's column order)."""
+        seen = []
+        for p in self.pairs:
+            for w in (p.long_s, p.short_s):
+                if w not in seen:
+                    seen.append(w)
+        return tuple(sorted(seen, reverse=True))
+
+
+def _parse_pair(name: str, spec, default: WindowPair) -> WindowPair:
+    """One ``windows: {fast: {...}}`` entry → WindowPair (defaults fill
+    omitted fields; severity is fixed by the pair name — fast pages,
+    slow tickets — so a config cannot invert the escalation order)."""
+    if spec is None:
+        return default
+    if not isinstance(spec, dict):
+        raise ValueError(f"windows.{name}: expected a mapping, "
+                         f"got {type(spec).__name__}")
+    long_s = float(spec.get("long_s", default.long_s))
+    short_s = float(spec.get("short_s", default.short_s))
+    burn = float(spec.get("burn", default.burn_threshold))
+    if long_s <= 0 or short_s <= 0:
+        raise ValueError(f"windows.{name}: windows must be > 0s")
+    if short_s >= long_s:
+        raise ValueError(
+            f"windows.{name}: short_s ({short_s}) must be shorter "
+            f"than long_s ({long_s}) — the short window is the "
+            f"'still happening' confirmation")
+    if burn <= 1.0:
+        raise ValueError(
+            f"windows.{name}: burn threshold must be > 1 (1.0 means "
+            f"'exactly on budget'; alert thresholds sit above it)")
+    return WindowPair(name, long_s, short_s, burn, default.severity)
+
+
+def parse_slo_config(doc) -> Tuple[Objective, ...]:
+    """``{"objectives": [...]}`` (the --slo-config file / chart values
+    shape) → Objective tuple.  Raises ValueError on anything ambiguous
+    — a half-parsed promise is worse than none (the parse_quota_config
+    discipline: loud and at boot).  Accepts already-parsed Objective
+    instances pass-through so Config can carry either form."""
+    if not doc:
+        return ()
+    entries = doc.get("objectives", []) if isinstance(doc, dict) else doc
+    out = []
+    seen = set()
+    for i, entry in enumerate(entries):
+        if isinstance(entry, Objective):
+            obj = entry
+        else:
+            try:
+                name = entry["name"]
+            except (KeyError, TypeError):
+                raise ValueError(f"objective[{i}]: missing 'name'")
+            sli = entry.get("sli", name)
+            if sli not in SLI_KINDS:
+                raise ValueError(
+                    f"objective {name}: unknown sli {sli!r} "
+                    f"(known: {', '.join(SLI_KINDS)})")
+            target = float(entry.get("target", 0.99))
+            if not 0.0 < target < 1.0:
+                raise ValueError(
+                    f"objective {name}: target must be in (0, 1), "
+                    f"got {target} (1.0 leaves no error budget at all)")
+            scope = str(entry.get("scope", "fleet"))
+            scope_ok = (scope == "fleet"
+                        or scope in ("per-queue", "per-namespace")
+                        or scope.startswith(("queue:", "namespace:")))
+            if not scope_ok:
+                raise ValueError(
+                    f"objective {name}: bad scope {scope!r} (fleet, "
+                    f"per-queue, per-namespace, queue:<name> or "
+                    f"namespace:<ns>)")
+            if scope != "fleet" and sli not in EVENT_SLIS:
+                raise ValueError(
+                    f"objective {name}: sli {sli!r} is fleet-global — "
+                    f"only {', '.join(EVENT_SLIS)} carry per-tenant "
+                    f"identity")
+            windows = entry.get("windows") or {}
+            pairs = tuple(
+                _parse_pair(d.name, windows.get(d.name), d)
+                for d in DEFAULT_PAIRS)
+            budget_s = float(entry.get("budget_window_s",
+                                       max(p.long_s for p in pairs)))
+            if budget_s <= 0:
+                raise ValueError(
+                    f"objective {name}: budget_window_s must be > 0")
+            obj = Objective(
+                name=name,
+                sli=sli,
+                target=target,
+                scope=scope,
+                threshold=float(entry.get(
+                    "threshold_s",
+                    entry.get("threshold", DEFAULT_THRESHOLDS[sli]))),
+                budget_window_s=budget_s,
+                pairs=pairs,
+                description=str(entry.get("description", "")),
+            )
+        if obj.name in seen:
+            raise ValueError(f"duplicate objective name {obj.name}")
+        seen.add(obj.name)
+        out.append(obj)
+    return tuple(out)

@@ -313,6 +313,17 @@ class Config:
     # Open-findings cap (past it, findings are counted, not stored).
     audit_max_findings: int = 1024
 
+    # Fleet SLO engine (slo/; docs/observability.md "SLO pipeline").
+    # slo_objectives carries the raw --slo-config "objectives" dicts
+    # (the quota_queues discipline — parsed loudly at Scheduler boot by
+    # slo.objectives.parse_slo_config); empty means the engine is
+    # inert: no sweep thread, /sloz answers 404, zero overhead.
+    # --no-slo is the hard off switch even with a config mounted.
+    slo_enabled: bool = True
+    slo_objectives: tuple = ()
+    # Background sweep period (also the burn-signal detection latency).
+    slo_interval_s: float = 15.0
+
     # /debug/* profiling endpoints (stacks, wall-clock profile, vars) on the
     # extender HTTP server — SURVEY §5's optional-profiling rebuild note.
     # Default OFF: the surface is unauthenticated and the HTTP port binds

@@ -62,6 +62,11 @@ ENDPOINTS = [
      "/auditz?limit=zzz"),
     ("auditz-type", "/auditz", {200}, "/auditz?type=bogus"),
     ("explainz", "/explainz?pod=sim/never-seen", {404}, "/explainz"),
+    # No --slo-config on the shared server: the valid request answers
+    # 404/enabled:false, and an unknown filter value still 400s FIRST
+    # (with no objectives declared every filter value is unknown).
+    ("sloz", "/sloz", {404}, "/sloz?window=bogus"),
+    ("sloz-objective", "/sloz", {404}, "/sloz?objective=bogus"),
 ]
 
 
@@ -108,6 +113,40 @@ def test_disabled_subsystem_404_carries_enabled_false():
         code, body = _get(base, "/explainz?pod=sim/x")
         assert code == 404
         assert json.loads(body)["enabled"] is False
+    finally:
+        srv.stop()
+        s.close()
+
+
+def test_sloz_enabled_export_honors_contract():
+    """With objectives declared the good request is a strict-JSON 200,
+    the objective filter narrows the export, and a bogus filter still
+    400s with the known values listed."""
+    s = Scheduler(FakeKube(), Config(slo_objectives=(
+        {"name": "decision-write", "sli": "decision-write",
+         "target": 0.99},
+        {"name": "goodput", "sli": "goodput", "target": 0.7,
+         "threshold": 0.05},
+    )))
+    srv = ExtenderServer(s, s.cfg, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(base, "/sloz")
+        assert code == 200, (code, body[:200])
+        doc = json.loads(body)
+        json.dumps(doc, allow_nan=False)
+        assert [o["objective"] for o in doc["objectives"]] \
+            == ["decision-write", "goodput"]
+        code, body = _get(base, "/sloz?objective=goodput")
+        assert code == 200
+        doc = json.loads(body)
+        assert [o["objective"] for o in doc["objectives"]] == ["goodput"]
+        code, body = _get(base, "/sloz?objective=nope")
+        assert code == 400
+        doc = json.loads(body)
+        assert doc["known_objectives"] == ["decision-write", "goodput"]
+        json.dumps(doc, allow_nan=False)
     finally:
         srv.stop()
         s.close()

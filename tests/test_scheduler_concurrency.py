@@ -207,8 +207,13 @@ class TestOptimisticCommitProtocol:
     def test_exhausted_retries_fall_back_to_locked_decide(self):
         """A conflict storm beyond commit_retries must degrade to the
         serial locked path — and still place, proving convergence is
-        unconditional."""
-        kube, s, names = make_env(n_nodes=2, commit_retries=1)
+        unconditional.  Pinned to the per-pod path like the lost-race
+        test above: the forced storm hooks s.snapshot and disables
+        _refit_live_locked, mechanics the batched cycle never touches
+        (its conflict convergence is
+        test_scheduler_batch.test_lost_group_commit_falls_back)."""
+        kube, s, names = make_env(n_nodes=2, commit_retries=1,
+                                  filter_batch=False)
         real_snapshot = s.snapshot
         bumps = {"n": 0}
 

@@ -1,0 +1,258 @@
+"""Property pins for the SLO engine's ledger math (ISSUE 19
+satellite): the error-budget ledger can gate paging alerts only if its
+invariants hold under arbitrary traffic, so the core ones are pinned
+as properties rather than examples —
+
+- ``budget_remaining`` is always within [0, 1]: the ledger reports
+  zero and lets the burn rate say how far past it is, never a negative
+  balance (which would render as a >100%-spent gauge and an absurd
+  budget bar);
+- burn rate is scale-invariant in window length on steady traffic: the
+  ratio-of-events definition is what makes a multi-window rule
+  comparable across its own windows;
+- ``observe_cumulative`` absorbs counter resets without ever shrinking
+  the accumulators: a source restart can never REFUND budget that was
+  already burned;
+- fanned per-queue series retire when their queue vanishes from the
+  quota config, and their open signals auto-clear through the ordinary
+  reconcile lifecycle.
+
+Each property runs twice: a seeded exhaustive sweep that needs nothing
+beyond the stdlib (so the invariants are checked even where hypothesis
+isn't installed), and a hypothesis search over the same space where it
+is (CI installs it — see .github/workflows/main.yml)."""
+
+from __future__ import annotations
+
+import math
+import random
+
+from k8s_vgpu_scheduler_tpu.k8s import FakeKube
+from k8s_vgpu_scheduler_tpu.scheduler import Scheduler
+from k8s_vgpu_scheduler_tpu.slo.budget import (BurnSignal,
+                                               BurnSignalStore,
+                                               SliSeries)
+from k8s_vgpu_scheduler_tpu.util.config import Config
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ModuleNotFoundError:  # pragma: no cover - CI always has it
+    given = None
+
+
+# -- the invariants (shared by both drivers) ----------------------------------
+
+def check_budget_always_within_unit_interval(events, target, window_s):
+    s = SliSeries()
+    now = 0.0
+    for good, bad in events:
+        s.add_events(good, bad)
+        now += 1.0
+        s.snapshot(now)
+        budget = s.budget_remaining(window_s, now, target)
+        assert 0.0 <= budget <= 1.0, (budget, good, bad, target)
+        assert not math.isnan(s.burn_rate(window_s, now, target))
+
+
+def check_burn_scale_invariant(good_rate, bad_rate, target, windows):
+    """On perfectly steady traffic every window sees the same good/bad
+    RATIO, so every window's burn rate must agree — the property that
+    lets one threshold mean the same thing on a 5m and a 1h window."""
+    s = SliSeries()
+    ticks = max(windows) + 5
+    for i in range(ticks):
+        s.add_events(good_rate, bad_rate)
+        s.snapshot(float(i + 1))
+    now = float(ticks)
+    burns = [s.burn_rate(float(w), now, target) for w in windows]
+    if good_rate + bad_rate == 0.0:
+        assert all(b == 0.0 for b in burns), burns
+        return
+    ref = burns[0]
+    for b in burns[1:]:
+        assert abs(b - ref) <= 1e-6 * max(1.0, abs(ref)), burns
+
+
+def check_resets_never_refund(segments):
+    """Each segment is one source process reporting non-decreasing raw
+    counters; a new segment restarts the counters from scratch.  The
+    series' internal accumulators must never decrease across any
+    boundary (a decrease would refund burned budget), and exactly the
+    restarts that are detectable (raw dropped below its predecessor)
+    must be counted."""
+    s = SliSeries()
+    prev_good = prev_total = 0.0
+    last_raw = None
+    expected_resets = 0
+    for seg in segments:
+        raw_good = raw_total = 0.0
+        first = True
+        for good, bad in seg:
+            raw_good += good
+            raw_total += good + bad
+            if first and last_raw is not None and (
+                    raw_total < last_raw[1] or raw_good < last_raw[0]):
+                expected_resets += 1
+            first = False
+            s.observe_cumulative(raw_good, raw_total)
+            assert s.good >= prev_good - 1e-9
+            assert s.total >= prev_total - 1e-9
+            assert s.good <= s.total + 1e-6
+            prev_good, prev_total = s.good, s.total
+        last_raw = (raw_good, raw_total)
+    assert s.resets_observed == expected_resets
+
+
+# -- seeded drivers (always run, stdlib only) ---------------------------------
+
+def test_budget_remaining_always_within_unit_interval_seeded():
+    rng = random.Random(0xBEEF)
+    for _ in range(200):
+        events = [(rng.uniform(0, 50), rng.uniform(0, 50))
+                  for _ in range(rng.randint(1, 40))]
+        check_budget_always_within_unit_interval(
+            events, rng.uniform(0.5, 0.9999), rng.uniform(1.0, 3600.0))
+    # The sharp corners a uniform draw never lands on exactly.
+    check_budget_always_within_unit_interval([(0.0, 0.0)], 0.999, 60.0)
+    check_budget_always_within_unit_interval([(0.0, 10.0)], 0.999, 60.0)
+    check_budget_always_within_unit_interval([(10.0, 0.0)], 0.999, 60.0)
+
+
+def test_burn_rate_scale_invariant_seeded():
+    rng = random.Random(0xFEED)
+    for _ in range(200):
+        windows = rng.sample(range(1, 61), rng.randint(2, 5))
+        check_burn_scale_invariant(
+            rng.uniform(0, 20), rng.uniform(0, 20),
+            rng.uniform(0.5, 0.999), windows)
+    check_burn_scale_invariant(0.0, 0.0, 0.99, [5, 60])
+    check_burn_scale_invariant(0.0, 7.0, 0.99, [5, 60])
+
+
+def test_cumulative_resets_never_refund_seeded():
+    rng = random.Random(0xCAFE)
+    for _ in range(200):
+        segments = [[(rng.uniform(0, 1e6), rng.uniform(0, 1e6))
+                     for _ in range(rng.randint(1, 10))]
+                    for _ in range(rng.randint(1, 5))]
+        check_resets_never_refund(segments)
+    # Zero-traffic restarts are undetectable by design (raw never
+    # drops): the ledger must absorb them without phantom resets.
+    check_resets_never_refund([[(0.0, 0.0)], [(0.0, 0.0)]])
+
+
+# -- hypothesis drivers (CI) --------------------------------------------------
+
+if given is not None:
+    #: (good, bad) event batches per sweep — including all-good,
+    #: all-bad and empty sweeps.
+    EVENTS = st.lists(
+        st.tuples(st.floats(min_value=0.0, max_value=50.0,
+                            allow_nan=False),
+                  st.floats(min_value=0.0, max_value=50.0,
+                            allow_nan=False)),
+        min_size=1, max_size=40)
+
+    @settings(max_examples=200, deadline=None)
+    @given(events=EVENTS,
+           target=st.floats(min_value=0.5, max_value=0.9999),
+           window_s=st.floats(min_value=1.0, max_value=3600.0))
+    def test_budget_remaining_always_within_unit_interval(
+            events, target, window_s):
+        check_budget_always_within_unit_interval(events, target,
+                                                 window_s)
+
+    @settings(max_examples=200, deadline=None)
+    @given(good_rate=st.floats(min_value=0.0, max_value=20.0),
+           bad_rate=st.floats(min_value=0.0, max_value=20.0),
+           target=st.floats(min_value=0.5, max_value=0.999),
+           windows=st.lists(st.integers(min_value=1, max_value=60),
+                            min_size=2, max_size=5, unique=True))
+    def test_burn_rate_scale_invariant(good_rate, bad_rate, target,
+                                       windows):
+        check_burn_scale_invariant(good_rate, bad_rate, target,
+                                   windows)
+
+    @settings(max_examples=200, deadline=None)
+    @given(segments=st.lists(
+        st.lists(st.tuples(st.floats(min_value=0.0, max_value=1e6,
+                                     allow_nan=False),
+                           st.floats(min_value=0.0, max_value=1e6,
+                                     allow_nan=False)),
+                 min_size=1, max_size=10),
+        min_size=1, max_size=5))
+    def test_cumulative_resets_never_refund(segments):
+        check_resets_never_refund(segments)
+
+
+# -- lifecycle pins (deterministic) -------------------------------------------
+
+def _burn(objective="o", pair="fast", severity="page"):
+    return BurnSignal(objective=objective, pair=pair,
+                      severity=severity, burn_long=5.0, burn_short=5.0,
+                      threshold=2.0, long_s=3600.0, short_s=300.0,
+                      first_seen=0.0, last_seen=0.0)
+
+
+def test_signal_store_lifecycle_counters_balance():
+    store = BurnSignalStore(max_open=2)
+    fired, cleared = store.reconcile(
+        {("a", "fast"): _burn("a"), ("b", "fast"): _burn("b")},
+        now=1.0)
+    assert (fired, cleared) == (2, 0)
+    # Third signal hits the cap: dropped loudly, not silently.
+    fired, cleared = store.reconcile(
+        {("a", "fast"): _burn("a"), ("b", "fast"): _burn("b"),
+         ("c", "fast"): _burn("c")}, now=2.0)
+    assert (fired, cleared) == (0, 0)
+    assert store.dropped_total == 1
+    # Everything quiet: all clear, ledger balances.
+    fired, cleared = store.reconcile({}, now=3.0)
+    assert cleared == 2
+    assert store.fired_total == store.cleared_total == 2
+    assert store.open_count() == 0
+    assert [c["objective"] for c in store.cleared_list(3.0)]
+
+
+def test_vanished_queue_retires_fanned_series_and_signals():
+    """A per-queue objective fans one series per tenant; when the queue
+    disappears from the quota config the series must retire (no ghost
+    rows on /sloz) and its open burn signals must auto-clear through
+    the ordinary reconcile path."""
+    s = Scheduler(FakeKube(), Config(
+        quota_queues=({"name": "batch", "namespaces": ["nb"],
+                       "quota": {"chips": 4}},
+                      {"name": "svc", "namespaces": ["ns"],
+                       "quota": {"chips": 4}}),
+        slo_objectives=({"name": "admission-latency",
+                         "sli": "admission-latency", "target": 0.9,
+                         "threshold_s": 30.0, "scope": "per-queue"},)))
+    try:
+        engine = s.slo
+        obj = engine.cfg.objectives[0]
+        # Burn hard on both queues, then sweep: signals open for both.
+        for label in ("batch", "svc"):
+            engine._series_for(obj, label).add_events(0.0, 50.0)
+        engine.sweep()
+        export = s.export_slo()
+        assert {o["objective"] for o in export["objectives"]} \
+            >= {"admission-latency/batch", "admission-latency/svc"}
+        open_objs = {sig["objective"]
+                     for sig in export["signals_open"]}
+        assert "admission-latency/batch" in open_objs
+        assert "admission-latency/svc" in open_objs
+        # The svc queue vanishes from the quota config (operator edit).
+        del s.quota.queues["svc"]
+        engine.sweep()
+        export = s.export_slo()
+        names = {o["objective"] for o in export["objectives"]}
+        assert "admission-latency/svc" not in names
+        assert "admission-latency/batch" in names
+        open_objs = {sig["objective"]
+                     for sig in export["signals_open"]}
+        assert "admission-latency/svc" not in open_objs
+        assert "admission-latency/batch" in open_objs
+        # The retired instance's clear went through the normal ledger.
+        assert engine.signals.cleared_total >= 1
+    finally:
+        s.close()

@@ -350,10 +350,12 @@ def test_alert_rules_use_real_metric_names():
     # (VtpuSchedulerTickStall).
     # ...plus the audit families' "type" aggregation label and the
     # decision-write counter's reason label with its "transport" value
-    # (VtpuDecisionWriteFailures).
+    # (VtpuDecisionWriteFailures), and the burn-alert gauge's severity
+    # label with its "page"/"ticket" values (VtpuErrorBudgetBurn*).
     referenced -= {"rate", "absent", "clamp_min", "min_over_time",
                    "vtpu", "monitor", "histogram_quantile", "sum",
                    "class", "latency", "critical", "phase", "cycle",
-                   "total", "type", "reason", "transport"}
+                   "total", "type", "reason", "transport",
+                   "severity", "page", "ticket"}
     missing = referenced - _emitted_metrics()
     assert not missing, f"alerts reference unknown metrics: {missing}"
